@@ -1,0 +1,24 @@
+"""cronsun_tpu: a TPU-native distributed cron framework.
+
+A ground-up rebuild of the capabilities of qlchan/cronsun (reference mounted
+at /root/reference) with a batched decision core: all cron schedules live as
+bitmask arrays on a TPU, one JAX kernel evaluates every schedule against a
+time window per tick, and job->node placement is a vmapped constrained
+assignment over the full jobs x nodes problem.
+
+Subpackages:
+  cron      - spec compiler + scalar reference semantics (correctness anchor)
+  ops       - device schedule table and batched tick / next-fire / eligibility
+              / assignment kernels
+  parallel  - jax.sharding mesh utilities; multi-chip tick+assign
+  core      - domain model (Job/Group/Node/Process/JobLog/Account) + keyspace
+  store     - coordination store with etcd semantics (KV/watch/lease/txn)
+  sched     - the central TPU scheduler service
+  agent     - per-machine executor agent
+  web       - REST API + UI
+  notice    - failure notification
+  conf      - configuration system
+  utils     - event bus, ids, local ip
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+"""Cron spec compiler and scalar schedule evaluation.
+
+The textual grammar and activation semantics mirror the reference's vendored
+robfig/cron fork (reference: node/cron/); the compiled representation (six
+uint64 bitmasks per spec) is designed to batch directly into the TPU schedule
+table (cronsun_tpu.ops.schedule_table).
+"""
+
+from .goduration import DurationError, parse_duration_ns, parse_duration_seconds
+from .parser import (
+    CronSpec,
+    EverySpec,
+    ParseError,
+    STAR_BIT,
+    parse,
+    parse_standard,
+)
+from .schedule import (
+    Schedule,
+    day_matches,
+    every_next_after,
+    next_after,
+)
+
+__all__ = [
+    "CronSpec", "EverySpec", "ParseError", "STAR_BIT", "parse",
+    "parse_standard", "Schedule", "day_matches", "every_next_after",
+    "next_after", "DurationError", "parse_duration_ns",
+    "parse_duration_seconds",
+]

@@ -1,0 +1,13 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so every multi-chip sharding
+path (jax.sharding.Mesh over jobs/nodes axes) is exercised without TPU
+hardware.  The env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

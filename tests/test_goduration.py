@@ -1,0 +1,57 @@
+import pytest
+
+from cronsun_tpu.cron.goduration import DurationError, parse_duration_ns, parse_duration_seconds
+
+NS = 1
+US = 1_000
+MS = 1_000_000
+S = 1_000_000_000
+M = 60 * S
+H = 3600 * S
+
+
+@pytest.mark.parametrize("s,want", [
+    ("0", 0),
+    ("5s", 5 * S),
+    ("30s", 30 * S),
+    ("1478s", 1478 * S),
+    ("-5s", -5 * S),
+    ("+5s", 5 * S),
+    ("-0", 0),
+    ("+0", 0),
+    ("5.0s", 5 * S),
+    ("5.6s", 5 * S + 600 * MS),
+    ("5.s", 5 * S),
+    (".5s", 500 * MS),
+    ("1.0s", 1 * S),
+    ("1.00s", 1 * S),
+    ("1.004s", 1 * S + 4 * MS),
+    ("1.0040s", 1 * S + 4 * MS),
+    ("100.00100s", 100 * S + 1 * MS),
+    ("10ns", 10 * NS),
+    ("11us", 11 * US),
+    ("12µs", 12 * US),
+    ("12μs", 12 * US),
+    ("13ms", 13 * MS),
+    ("14s", 14 * S),
+    ("15m", 15 * M),
+    ("16h", 16 * H),
+    ("3h30m", 3 * H + 30 * M),
+    ("10.5s4m", 4 * M + 10 * S + 500 * MS),
+    ("-2m3.4s", -(2 * M + 3 * S + 400 * MS)),
+    ("1h2m3s4ms5us6ns", 1 * H + 2 * M + 3 * S + 4 * MS + 5 * US + 6 * NS),
+    ("39h9m14.425s", 39 * H + 9 * M + 14 * S + 425 * MS),
+])
+def test_parse_duration(s, want):
+    assert parse_duration_ns(s) == want
+
+
+@pytest.mark.parametrize("s", ["", "3", "-", "s", ".", "-.", ".s", "+.s", "1d", "x5s", "5x"])
+def test_parse_duration_errors(s):
+    with pytest.raises(DurationError):
+        parse_duration_ns(s)
+
+
+def test_seconds():
+    assert parse_duration_seconds("90s") == 90.0
+    assert parse_duration_seconds("1h30m") == 5400.0

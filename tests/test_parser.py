@@ -1,0 +1,165 @@
+"""Bit-exact parser conformance tests.
+
+The expected masks mirror the reference's parser semantics
+(reference: node/cron/parser_test.go, parser.go:188-306): same grammar,
+same bit layouts, same star-bit rule.
+"""
+
+import pytest
+
+from cronsun_tpu.cron import CronSpec, EverySpec, ParseError, STAR_BIT, parse, parse_standard
+from cronsun_tpu.cron.parser import (
+    DOM, DOW, HOURS, MINUTES, MONTHS, SECONDS,
+    _all_bits, _bits, _parse_field, _parse_range,
+)
+
+
+def bits_of(*values):
+    out = 0
+    for v in values:
+        out |= 1 << v
+    return out
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("5", bits_of(5)),
+    ("0", bits_of(0)),
+    ("0-4", bits_of(0, 1, 2, 3, 4)),
+    ("57-59", bits_of(57, 58, 59)),
+    ("0,5,7", bits_of(0) | bits_of(5) | bits_of(7)),  # via field, below
+])
+def test_range_simple(expr, want):
+    if "," in expr:
+        assert _parse_field(expr, MINUTES) == want
+    else:
+        assert _parse_range(expr, MINUTES) == want
+
+
+def test_range_star_and_steps():
+    assert _parse_range("*", MINUTES) == _bits(0, 59, 1) | STAR_BIT
+    assert _parse_range("?", MINUTES) == _bits(0, 59, 1) | STAR_BIT
+    assert _parse_range("*/2", MINUTES) == _bits(0, 59, 2) | STAR_BIT
+    assert _parse_range("5/15", MINUTES) == bits_of(5, 20, 35, 50)
+    assert _parse_range("5-20/15", MINUTES) == bits_of(5, 20)
+    assert _parse_range("5-30/15", MINUTES) == bits_of(5, 20)
+    assert _parse_range("5-35/15", MINUTES) == bits_of(5, 20, 35)
+
+
+def test_range_names():
+    assert _parse_range("Sun", DOW) == bits_of(0)
+    assert _parse_field("SUN,MON,TUE", DOW) == bits_of(0, 1, 2)
+    assert _parse_range("jan-mar", MONTHS) == bits_of(1, 2, 3)
+    assert _parse_range("Dec", MONTHS) == bits_of(12)
+
+
+@pytest.mark.parametrize("expr,bounds", [
+    ("60", MINUTES),          # above max
+    ("5-70", MINUTES),        # end above max
+    ("30-20", MINUTES),       # start beyond end
+    ("5--10", MINUTES),       # too many hyphens
+    ("5/10/2", MINUTES),      # too many slashes
+    ("5/0", MINUTES),         # zero step
+    ("xyz", MINUTES),         # garbage
+    ("-5", MINUTES),          # negative
+    ("0", DOM),               # below dom min
+    ("32", DOM),              # above dom max
+    ("13", MONTHS),
+    ("7", DOW),
+])
+def test_range_errors(expr, bounds):
+    with pytest.raises(ParseError):
+        _parse_range(expr, bounds)
+
+
+def test_parse_full_spec():
+    s = parse("0 5 * * * *")
+    assert isinstance(s, CronSpec)
+    assert s.second == bits_of(0)
+    assert s.minute == bits_of(5)
+    assert s.hour == _all_bits(HOURS)
+    assert s.dom == _all_bits(DOM)
+    assert s.month == _all_bits(MONTHS)
+    assert s.dow == _all_bits(DOW)
+
+
+def test_parse_dow_optional():
+    five = parse("0 5 * * *")     # 5 fields: dow defaults to *
+    six = parse("0 5 * * * *")
+    assert five == six
+
+
+def test_parse_standard_five_fields():
+    s = parse_standard("5 * * * *")
+    assert s.second == bits_of(0)  # standard spec: seconds pinned to 0
+    assert s.minute == bits_of(5)
+    with pytest.raises(ParseError):
+        parse_standard("0 5 * * * *")  # six fields rejected
+    with pytest.raises(ParseError):
+        parse_standard("5 * * *")
+
+
+@pytest.mark.parametrize("spec", [
+    "",          # empty
+    "xyz",       # garbage
+    "60 0 * * *",
+    "0 60 * * *",
+    "0 0 * * XYZ",
+    "* * * *",           # too few
+    "* * * * * * *",     # too many
+    "@unrecognized",
+    "@every",
+    "@every 1",
+])
+def test_parse_errors(spec):
+    with pytest.raises(ParseError):
+        parse(spec)
+
+
+def test_descriptors():
+    yearly = parse("@yearly")
+    assert yearly == parse("@annually")
+    assert yearly.second == bits_of(0)
+    assert yearly.minute == bits_of(0)
+    assert yearly.hour == bits_of(0)
+    assert yearly.dom == bits_of(1)
+    assert yearly.month == bits_of(1)
+    assert yearly.dow == _all_bits(DOW)
+
+    monthly = parse("@monthly")
+    assert monthly.dom == bits_of(1)
+    assert monthly.month == _all_bits(MONTHS)
+
+    weekly = parse("@weekly")
+    assert weekly.dow == bits_of(0)
+    assert weekly.dom == _all_bits(DOM)
+
+    daily = parse("@daily")
+    assert daily == parse("@midnight")
+    assert daily.hour == bits_of(0)
+
+    hourly = parse("@hourly")
+    assert hourly.hour == _all_bits(HOURS)
+    assert hourly.minute == bits_of(0)
+
+
+def test_every():
+    e = parse("@every 5m")
+    assert isinstance(e, EverySpec)
+    assert e.period_s == 300
+    assert parse("@every 1h30m").period_s == 5400
+    # floored to 1s minimum, truncated to whole seconds
+    assert parse("@every 100ms").period_s == 1
+    assert parse("@every 1500ms").period_s == 1
+    assert parse("@every 2500ms").period_s == 2
+
+
+def test_star_bits():
+    s = parse("* * * * * *")
+    assert s.dom_star and s.dow_star
+    s = parse("0 * * 1,15 * Sun")
+    assert not s.dom_star and not s.dow_star
+    s = parse("0 * * * * Mon")
+    assert s.dom_star and not s.dow_star
+    s = parse("0 * * */10 * Sun")
+    # */10 still sets the star bit (star with step)
+    assert s.dom_star and not s.dow_star

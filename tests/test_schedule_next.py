@@ -1,0 +1,220 @@
+"""Scalar schedule semantics conformance tests.
+
+Activation/next tables assert the same behaviors the reference's
+node/cron/spec_test.go covers: step schedules, named fields, DOM/DOW
+star-vs-restricted interaction, wrap-around of every field, leap years,
+daylight-saving transitions (spring gap skips, fall-back double fire),
+unsatisfiable specs, and non-UTC fixed offsets.
+"""
+
+import datetime as dt
+from datetime import timedelta, timezone
+from zoneinfo import ZoneInfo
+
+import pytest
+
+from cronsun_tpu.cron import Schedule, parse
+
+NY = ZoneInfo("America/New_York")
+UTC = timezone.utc
+
+
+def t_utc(s: str) -> dt.datetime:
+    """Parse 'YYYY-MM-DD HH:MM[:SS]' as UTC."""
+    if len(s) == 16:
+        s += ":00"
+    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=UTC)
+
+
+def t_ny(s: str, fold: int = 0) -> dt.datetime:
+    if len(s) == 16:
+        s += ":00"
+    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=NY, fold=fold)
+
+
+def nxt(spec: str, t: dt.datetime):
+    return Schedule(parse(spec)).next(t)
+
+
+# ---------------------------------------------------------------- activation
+
+ACTIVATION = [
+    # (time, spec, fires-at-exactly-that-time)
+    ("2012-07-09 15:00", "0 0/15 * * *", True),
+    ("2012-07-09 15:45", "0 0/15 * * *", True),
+    ("2012-07-09 15:40", "0 0/15 * * *", False),
+    ("2012-07-09 15:05", "0 5/15 * * *", True),
+    ("2012-07-09 15:20", "0 5/15 * * *", True),
+    ("2012-07-09 15:50", "0 5/15 * * *", True),
+    ("2012-07-15 15:00", "0 0/15 * * Jul", True),
+    ("2012-07-15 15:00", "0 0/15 * * Jun", False),
+    ("2012-07-15 08:30", "0 30 08 ? Jul Sun", True),   # Jul 15 2012 is a Sunday
+    ("2012-07-15 08:30", "0 30 08 15 Jul ?", True),
+    ("2012-07-16 08:30", "0 30 08 ? Jul Sun", False),  # Monday
+    ("2012-07-16 08:30", "0 30 08 15 Jul ?", False),
+    ("2012-07-09 15:00", "@hourly", True),
+    ("2012-07-09 15:04", "@hourly", False),
+    ("2012-07-09 15:00", "@daily", False),
+    ("2012-07-09 00:00", "@daily", True),
+    ("2012-07-09 00:00", "@weekly", False),
+    ("2012-07-08 00:00", "@weekly", True),             # Sunday
+    ("2012-07-08 01:00", "@weekly", False),
+    ("2012-07-08 00:00", "@monthly", False),
+    ("2012-07-01 00:00", "@monthly", True),
+    # DOM/DOW both restricted: OR semantics.
+    ("2012-07-15 00:00", "0 * * 1,15 * Sun", True),
+    ("2012-06-15 00:00", "0 * * 1,15 * Sun", True),    # Friday, but dom=15
+    ("2012-08-01 00:00", "0 * * 1,15 * Sun", True),    # Wednesday, but dom=1
+    # One starred: AND semantics.
+    ("2012-07-15 00:00", "0 * * * * Mon", False),      # Sunday
+    ("2012-07-15 00:00", "0 * * */10 * Sun", False),   # dom 15 not in 1,11,21,31
+    ("2012-07-09 00:00", "0 * * 1,15 * *", False),
+    ("2012-07-15 00:00", "0 * * 1,15 * *", True),
+    ("2012-07-15 00:00", "0 * * */2 * Sun", True),     # dom 15 in 1,3,..,31
+]
+
+
+@pytest.mark.parametrize("time_s,spec,expected", ACTIVATION)
+def test_activation(time_s, spec, expected):
+    t = t_utc(time_s)
+    got = nxt(spec, t - timedelta(seconds=1))
+    assert (got == t) == expected, f"{spec} at {time_s}: next={got}"
+
+
+# ---------------------------------------------------------------------- next
+
+NEXT = [
+    ("2012-07-09 14:45", "0 0/15 * * *", "2012-07-09 15:00"),
+    ("2012-07-09 14:59", "0 0/15 * * *", "2012-07-09 15:00"),
+    ("2012-07-09 14:59:59", "0 0/15 * * *", "2012-07-09 15:00"),
+    # Wrap around hours
+    ("2012-07-09 15:45", "0 20-35/15 * * *", "2012-07-09 16:20"),
+    # Wrap around days
+    ("2012-07-09 23:46", "0 */15 * * *", "2012-07-10 00:00"),
+    ("2012-07-09 23:45", "0 20-35/15 * * *", "2012-07-10 00:20"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 * * *", "2012-07-10 00:20:15"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 1/2 * *", "2012-07-10 01:20:15"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 10-12 * *", "2012-07-10 10:20:15"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 1/2 */2 * *", "2012-07-11 01:20:15"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 * 9-20 * *", "2012-07-10 00:20:15"),
+    ("2012-07-09 23:35:51", "15/35 20-35/15 * 9-20 Jul *", "2012-07-10 00:20:15"),
+    # Wrap around months
+    ("2012-07-09 23:35", "0 0 0 9 Apr-Oct ?", "2012-08-09 00:00"),
+    ("2012-07-09 23:35", "0 0 0 */5 Apr,Aug,Oct Mon", "2012-08-06 00:00"),
+    ("2012-07-09 23:35", "0 0 0 */5 Oct Mon", "2012-10-01 00:00"),
+    # Wrap around years
+    ("2012-07-09 23:35", "0 0 0 * Feb Mon", "2013-02-04 00:00"),
+    ("2012-07-09 23:35", "0 0 0 * Feb Mon/2", "2013-02-01 00:00"),
+    # Wrap around minute, hour, day, month, and year
+    ("2012-12-31 23:59:45", "0 * * * * *", "2013-01-01 00:00:00"),
+    # Leap year
+    ("2012-07-09 23:35", "0 0 0 29 Feb ?", "2016-02-29 00:00"),
+]
+
+
+@pytest.mark.parametrize("time_s,spec,want_s", NEXT)
+def test_next_utc(time_s, spec, want_s):
+    assert nxt(spec, t_utc(time_s)) == t_utc(want_s)
+
+
+def test_unsatisfiable():
+    assert nxt("0 0 0 30 Feb ?", t_utc("2012-07-09 23:35")) is None
+    assert nxt("0 0 0 31 Apr ?", t_utc("2012-07-09 23:35")) is None
+
+
+# ---------------------------------------------------------------------- DST
+
+def ts(t):
+    return t.astimezone(UTC)
+
+
+def test_dst_spring_gap_2am_job_skips_a_year():
+    # 2:30am on Mar 11 2012 does not exist in America/New_York (spring
+    # forward).  The walk lands on Mar 11 *2013* 2:30 EDT.
+    got = nxt("0 30 2 11 Mar ?", t_ny("2012-03-11 00:00"))
+    assert ts(got) == ts(t_ny("2013-03-11 02:30"))
+
+
+def test_dst_spring_hourly():
+    got = nxt("0 0 * * * ?", t_ny("2012-03-11 00:00"))
+    assert ts(got) == ts(t_ny("2012-03-11 01:00"))
+    got = nxt("0 0 * * * ?", t_ny("2012-03-11 01:00"))
+    # 2am doesn't exist; next hour boundary is 3am EDT.
+    assert ts(got) == ts(t_ny("2012-03-11 03:00"))
+    got = nxt("0 0 * * * ?", t_ny("2012-03-11 03:00"))
+    assert ts(got) == ts(t_ny("2012-03-11 04:00"))
+
+
+def test_dst_spring_nightly():
+    got = nxt("0 0 1 * * ?", t_ny("2012-03-11 00:00"))
+    assert ts(got) == ts(t_ny("2012-03-11 01:00"))
+    got = nxt("0 0 1 * * ?", t_ny("2012-03-11 01:00"))
+    assert ts(got) == ts(t_ny("2012-03-12 01:00"))
+    # 2am nightly job is skipped on spring-forward day.
+    got = nxt("0 0 2 * * ?", t_ny("2012-03-11 00:00"))
+    assert ts(got) == ts(t_ny("2012-03-12 02:00"))
+
+
+def test_dst_fall_back():
+    # Nov 4 2012: 2am EDT -> 1am EST; 1am occurs twice.
+    got = nxt("0 30 2 04 Nov ?", t_ny("2012-11-04 00:00", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 02:30", fold=1))  # 2:30 EST
+    got = nxt("0 30 1 04 Nov ?", t_ny("2012-11-04 01:45", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 01:30", fold=1))  # second 1:30 (EST)
+
+
+def test_dst_fall_hourly_runs_twice():
+    got = nxt("0 0 * * * ?", t_ny("2012-11-04 00:00", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 01:00", fold=0))  # 1am EDT
+    got = nxt("0 0 * * * ?", t_ny("2012-11-04 01:00", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 01:00", fold=1))  # 1am EST (again)
+    got = nxt("0 0 * * * ?", t_ny("2012-11-04 01:00", fold=1))
+    assert ts(got) == ts(t_ny("2012-11-04 02:00", fold=1))
+
+
+def test_dst_fall_nightly():
+    got = nxt("0 0 1 * * ?", t_ny("2012-11-04 01:00", fold=1))
+    assert ts(got) == ts(t_ny("2012-11-05 01:00"))
+    got = nxt("0 0 2 * * ?", t_ny("2012-11-04 00:00", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 02:00", fold=1))
+    got = nxt("0 0 3 * * ?", t_ny("2012-11-04 00:00", fold=0))
+    assert ts(got) == ts(t_ny("2012-11-04 03:00"))
+
+
+# ------------------------------------------------------------ fixed offsets
+
+IST = timezone(timedelta(hours=5, minutes=30))
+
+
+def t_ist(s):
+    if len(s) == 16:
+        s += ":00"
+    return dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=IST)
+
+
+@pytest.mark.parametrize("time_s,spec,want_s", [
+    ("2016-01-03 13:09:03", "0 14 14 * * *", "2016-01-03 14:14:00"),
+    ("2016-01-03 04:09:03", "0 14 14 * * ?", "2016-01-03 14:14:00"),
+    ("2016-01-03 14:09:03", "0 14 14 * * *", "2016-01-03 14:14:00"),
+    ("2016-01-03 14:00:00", "0 14 14 * * ?", "2016-01-03 14:14:00"),
+])
+def test_next_with_tz(time_s, spec, want_s):
+    assert nxt(spec, t_ist(time_s)) == t_ist(want_s)
+
+
+# -------------------------------------------------------------------- @every
+
+def test_every_next():
+    s = Schedule(parse("@every 5s"))
+    t0 = t_utc("2012-07-09 15:00:00")
+    assert s.next(t0) == t_utc("2012-07-09 15:00:05")
+    # sub-second truncation: microseconds dropped before adding
+    t1 = t0.replace(microsecond=250_000)
+    assert s.next(t1) == t_utc("2012-07-09 15:00:05")
+
+
+def test_next_strictly_greater():
+    # next() must return a time strictly greater than the input
+    t = t_utc("2012-07-09 15:00:00")
+    got = nxt("0 0 15 * * *", t)
+    assert got == t_utc("2012-07-10 15:00:00")
