@@ -1,0 +1,17 @@
+"""Device-side batched scheduling kernels.
+
+The decision core of the framework: every per-node ``Schedule.Next()`` walk in
+the reference (node/cron/spec.go:55-145, node/cron/cron.go:210-275) collapses
+into batched JAX programs over dense schedule tables:
+
+- :mod:`timecal` — host-side calendar decomposition (epoch seconds -> cron
+  field indices), vectorized for fixed-offset timezones.
+- :mod:`schedule_table` — compiled ``CronSpec``/``EverySpec`` batches as
+  device-resident struct-of-arrays bitmask tables.
+- :mod:`tick` — windowed fire-mask evaluation and batched next-fire.
+- :mod:`eligibility` — bitpacked job x node placement masks.
+- :mod:`assign` — load-balanced capacity-constrained job->node assignment.
+"""
+
+from .schedule_table import ScheduleTable, FRAMEWORK_EPOCH  # noqa: F401
+from .tick import fire_mask, next_fire  # noqa: F401

@@ -1,0 +1,157 @@
+"""Device-resident batched schedule table.
+
+A compiled cron spec is six uint64 bitmasks (reference: node/cron/spec.go:7-9).
+On TPU the native integer width is 32 bits, so each 64-bit mask is stored as a
+(lo, hi) uint32 pair and the star bits (bit 63, node/cron/spec.go:48-51) are
+hoisted into separate bool columns — they only matter for the day-of-month vs
+day-of-week OR/AND rule (node/cron/spec.go:149-158).
+
+``@every`` schedules (node/cron/constantdelay.go) are held in the same table
+as (period, phase) rows: a job fires when
+``(t - phase) mod period == 0``.  Phase is anchored at registration time, so
+the fire train matches the reference's chained ``prev + period`` behaviour as
+long as no window is skipped; unlike the reference, a lagging scheduler does
+not shift the phase (deliberate divergence — deterministic fire instants).
+
+All epoch arithmetic is relative to :data:`FRAMEWORK_EPOCH` (2020-01-01 UTC)
+so device-side seconds fit int32 until 2088 without enabling x64.
+
+Tables are fixed-capacity: allocate for ``capacity`` jobs, mark live rows with
+``active``; row churn from watch deltas is in-place buffer donation, never a
+reshape, so XLA never recompiles on job add/remove (SURVEY.md §7 "incremental
+updates without recompile").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cron.parser import CronSpec, EverySpec, parse
+
+# 2020-01-01T00:00:00Z — device times are int32 seconds relative to this.
+FRAMEWORK_EPOCH = 1577836800
+
+_MASK32 = (1 << 32) - 1
+_STAR_OFF = ~(1 << 63)  # strip star bit before splitting
+
+
+def _split64(mask: int) -> "tuple[int, int]":
+    m = mask & _STAR_OFF
+    return m & _MASK32, (m >> 32) & _MASK32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ScheduleTable:
+    """Struct-of-arrays schedule batch; every field is shape [capacity]."""
+
+    sec_lo: jax.Array   # uint32
+    sec_hi: jax.Array   # uint32 (bits 32..59)
+    min_lo: jax.Array   # uint32
+    min_hi: jax.Array   # uint32
+    hour: jax.Array     # uint32 (bits 0..23)
+    dom: jax.Array      # uint32 (bits 1..31)
+    month: jax.Array    # uint32 (bits 1..12)
+    dow: jax.Array      # uint32 (bits 0..6)
+    dom_star: jax.Array  # bool
+    dow_star: jax.Array  # bool
+    is_every: jax.Array  # bool
+    period: jax.Array    # int32, >=1 always (1 for cron rows: no div-by-zero)
+    phase_mod: jax.Array  # int32, phase mod period (framework-epoch relative)
+    active: jax.Array    # bool — live row
+    paused: jax.Array    # bool — Job.Pause (reference job.go:53)
+
+    @property
+    def capacity(self) -> int:
+        return self.sec_lo.shape[0]
+
+
+def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
+             paused: bool = False) -> dict:
+    """Host-side row dict for one spec (strings are parsed)."""
+    if isinstance(spec, str):
+        spec = parse(spec)
+    if isinstance(spec, EverySpec):
+        period = max(1, spec.period_s)
+        return dict(
+            sec_lo=0, sec_hi=0, min_lo=0, min_hi=0, hour=0, dom=0, month=0,
+            dow=0, dom_star=False, dow_star=False, is_every=True,
+            period=period,
+            phase_mod=int((phase_epoch_s - FRAMEWORK_EPOCH) % period),
+            active=True, paused=paused)
+    sec_lo, sec_hi = _split64(spec.second)
+    min_lo, min_hi = _split64(spec.minute)
+    return dict(
+        sec_lo=sec_lo, sec_hi=sec_hi, min_lo=min_lo, min_hi=min_hi,
+        hour=spec.hour & _MASK32, dom=spec.dom & _MASK32,
+        month=spec.month & _MASK32, dow=spec.dow & _MASK32,
+        dom_star=spec.dom_star, dow_star=spec.dow_star,
+        is_every=False, period=1, phase_mod=0, active=True, paused=paused)
+
+
+_DTYPES = dict(
+    sec_lo=np.uint32, sec_hi=np.uint32, min_lo=np.uint32, min_hi=np.uint32,
+    hour=np.uint32, dom=np.uint32, month=np.uint32, dow=np.uint32,
+    dom_star=np.bool_, dow_star=np.bool_, is_every=np.bool_,
+    period=np.int32, phase_mod=np.int32, active=np.bool_, paused=np.bool_,
+)
+
+_INACTIVE_ROW = dict(
+    sec_lo=0, sec_hi=0, min_lo=0, min_hi=0, hour=0, dom=0, month=0, dow=0,
+    dom_star=False, dow_star=False, is_every=False, period=1, phase_mod=0,
+    active=False, paused=False)
+
+
+def build_table(specs: List[Union[CronSpec, EverySpec, str]],
+                capacity: Optional[int] = None,
+                phase_epoch_s: int = 0,
+                paused: Optional[List[bool]] = None,
+                device=None, sharding=None) -> ScheduleTable:
+    """Compile a list of specs into a device ScheduleTable.
+
+    ``capacity`` pads the table (inactive rows) to a fixed size; defaults to
+    the next power of two >= len(specs) so later growth rarely re-allocates.
+    """
+    n = len(specs)
+    if capacity is None:
+        capacity = max(1, 1 << (n - 1).bit_length()) if n else 1
+    if capacity < n:
+        raise ValueError(f"capacity {capacity} < {n} specs")
+    cols = {k: np.full(capacity, _INACTIVE_ROW[k], dtype=dt)
+            for k, dt in _DTYPES.items()}
+    for i, spec in enumerate(specs):
+        row = make_row(spec, phase_epoch_s=phase_epoch_s,
+                       paused=bool(paused[i]) if paused else False)
+        for k, v in row.items():
+            cols[k][i] = v
+    if sharding is not None:
+        arrs = {k: jax.device_put(v, sharding) for k, v in cols.items()}
+    elif device is not None:
+        arrs = {k: jax.device_put(v, device) for k, v in cols.items()}
+    else:
+        arrs = {k: jnp.asarray(v) for k, v in cols.items()}
+    return ScheduleTable(**arrs)
+
+
+def update_rows(table: ScheduleTable, indices: np.ndarray,
+                rows: List[dict]) -> ScheduleTable:
+    """Functionally update rows at ``indices`` (watch-delta path).
+
+    Scatter at fixed shapes — no recompile, and under jit with donated
+    buffers this is an in-place update.
+    """
+    idx = jnp.asarray(np.asarray(indices, dtype=np.int32))
+    new = {}
+    for k, dt in _DTYPES.items():
+        vals = jnp.asarray(np.array([r[k] for r in rows], dtype=dt))
+        new[k] = getattr(table, k).at[idx].set(vals)
+    return ScheduleTable(**new)
+
+
+def deactivate_rows(table: ScheduleTable, indices: np.ndarray) -> ScheduleTable:
+    return update_rows(table, indices, [_INACTIVE_ROW] * len(indices))

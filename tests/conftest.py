@@ -2,12 +2,22 @@
 
 Tests run on CPU with 8 virtual XLA devices so every multi-chip sharding
 path (jax.sharding.Mesh over jobs/nodes axes) is exercised without TPU
-hardware.  The env vars must be set before jax is imported anywhere.
+hardware.
+
+The environment ships an always-on TPU tunnel (the ``axon`` PJRT plugin,
+``_AXON_REGISTERED=1``) that overrides ``JAX_PLATFORMS`` from the
+environment, so the only reliable override is ``jax.config`` before any
+backend is initialized — which is why this conftest imports jax eagerly.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()

@@ -1,0 +1,229 @@
+"""Differential tests: batched device kernels vs the scalar schedule engine.
+
+The scalar engine (cronsun_tpu.cron.schedule) is the conformance-tested port
+of the reference's field-walking Next (node/cron/spec.go:55-145).  The batched
+path (cronsun_tpu.ops.tick) uses a completely different algorithm — windowed
+bitmask scans with host-side calendar decomposition — so agreement over random
+specs and instants is strong evidence of correctness.
+"""
+
+import datetime as dt
+import random
+from datetime import timezone
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from cronsun_tpu.cron.parser import parse
+from cronsun_tpu.cron.schedule import next_after
+from cronsun_tpu.ops.schedule_table import FRAMEWORK_EPOCH, build_table
+from cronsun_tpu.ops.tick import fire_mask, first_fire_offset, next_fire
+from cronsun_tpu.ops.timecal import decompose_utc, window_fields
+
+UTC = timezone.utc
+
+
+def _epoch(t: dt.datetime) -> int:
+    return int(t.timestamp())
+
+
+# ---------------------------------------------------------------- timecal
+
+def test_decompose_utc_matches_datetime():
+    rng = random.Random(7)
+    epochs = [rng.randrange(0, 4_000_000_000) for _ in range(500)]
+    s, m, h, d, mo, w = decompose_utc(np.array(epochs))
+    for i, e in enumerate(epochs):
+        t = dt.datetime.fromtimestamp(e, UTC)
+        assert (s[i], m[i], h[i], d[i], mo[i]) == (
+            t.second, t.minute, t.hour, t.day, t.month), e
+        assert w[i] == (t.weekday() + 1) % 7, e
+
+
+def test_window_fields_dst_zone_matches_datetime():
+    tz = ZoneInfo("America/New_York")
+    # Spring forward 2026-03-08 07:00 UTC (02:00 EST -> 03:00 EDT).
+    start = _epoch(dt.datetime(2026, 3, 8, 6, 58, tzinfo=UTC))
+    f = window_fields(start, 300, step_s=1, tz=tz)
+    for i in range(300):
+        loc = dt.datetime.fromtimestamp(start + i, tz)
+        assert f["sec"][i] == loc.second and f["min"][i] == loc.minute
+        assert f["hour"][i] == loc.hour and f["dom"][i] == loc.day
+    # Hour 2 never appears in the gap window.
+    assert 2 not in set(f["hour"].tolist())
+
+
+# ---------------------------------------------------------------- fire_mask
+
+SPEC_CORPUS = [
+    "* * * * * *",
+    "0 * * * * *",
+    "0 0 * * * *",
+    "0 0 0 * * *",
+    "5 4 3 2 1 ?",
+    "*/15 * * * * *",
+    "0 */5 * * * *",
+    "30 30 14 ? * Mon-Fri",
+    "0 0 12 1,15 * ?",
+    "0 0 0 29 2 ?",
+    "1-5 10-20/3 6-18 * * *",
+    "0 0 0 ? * 0",
+    "0 0 0 * 2 1",
+    "7 7 7 7 7 ?",
+    "@hourly",
+    "@daily",
+    "@weekly",
+    "@monthly",
+    "@yearly",
+]
+
+
+def _scalar_matches(spec, t: dt.datetime) -> bool:
+    """Does the instant match the compiled spec?  Field logic straight off the
+    masks with Python datetime fields (independent of the numpy calendar)."""
+    from cronsun_tpu.cron.schedule import day_matches
+    return bool(
+        (1 << t.second) & spec.second
+        and (1 << t.minute) & spec.minute
+        and (1 << t.hour) & spec.hour
+        and day_matches(spec, t.day, (t.weekday() + 1) % 7)
+        and (1 << t.month) & spec.month
+    )
+
+
+def test_fire_mask_matches_scalar_over_random_windows():
+    specs = [parse(s) for s in SPEC_CORPUS]
+    table = build_table(specs)
+    rng = random.Random(42)
+    for _ in range(10):
+        start = rng.randrange(1_600_000_000, 2_000_000_000)
+        W = 120
+        fire = np.asarray(fire_mask(table, start, W))
+        for w in range(0, W, 7):
+            t = dt.datetime.fromtimestamp(start + w, UTC)
+            for j, spec in enumerate(specs):
+                assert fire[j, w] == _scalar_matches(spec, t), (
+                    SPEC_CORPUS[j], t)
+        # Padded rows never fire.
+        assert not fire[len(specs):].any()
+
+
+def test_fire_mask_every_modular_phase():
+    t0 = 1_700_000_000
+    table = build_table([parse("@every 10s"), parse("@every 1m30s")],
+                        phase_epoch_s=t0)
+    fire = np.asarray(fire_mask(table, t0, 200))
+    exp10 = [(w % 10) == 0 for w in range(200)]
+    exp90 = [(w % 90) == 0 for w in range(200)]
+    assert fire[0].tolist() == exp10
+    assert fire[1].tolist() == exp90
+
+
+def test_paused_and_inactive_rows_do_not_fire():
+    from cronsun_tpu.ops.schedule_table import deactivate_rows
+    table = build_table([parse("* * * * * *")] * 3, paused=[False, True, False])
+    table = deactivate_rows(table, np.array([2]))
+    fire = np.asarray(fire_mask(table, 1_700_000_000, 5))
+    assert fire[0].all() and not fire[1].any() and not fire[2].any()
+
+
+# ---------------------------------------------------------------- next_fire
+
+def test_next_fire_differential_utc():
+    specs = [parse(s) for s in SPEC_CORPUS]
+    table = build_table(specs)
+    rng = random.Random(1234)
+    for _ in range(8):
+        after = rng.randrange(1_600_000_000, 1_900_000_000)
+        got = next_fire(table, after)
+        t = dt.datetime.fromtimestamp(after, UTC)
+        for j, spec in enumerate(specs):
+            want = next_after(spec, t)
+            want_e = -1 if want is None else _epoch(want)
+            assert got[j] == want_e, (SPEC_CORPUS[j], t, got[j], want_e)
+
+
+def test_next_fire_random_specs_differential():
+    rng = random.Random(99)
+
+    def rand_field(lo, hi, star_ok=True):
+        r = rng.random()
+        if star_ok and r < 0.3:
+            return "*" if rng.random() < 0.7 else f"*/{rng.randint(2, 20)}"
+        if r < 0.6:
+            return str(rng.randint(lo, hi))
+        a = rng.randint(lo, hi - 1)
+        b = rng.randint(a + 1, hi)
+        s = f"{a}-{b}"
+        if rng.random() < 0.3:
+            s += f"/{rng.randint(1, 9)}"
+        return s
+
+    specs, texts = [], []
+    for _ in range(60):
+        txt = " ".join([
+            rand_field(0, 59), rand_field(0, 59), rand_field(0, 23),
+            rand_field(1, 28), rand_field(1, 12), rand_field(0, 6),
+        ])
+        texts.append(txt)
+        specs.append(parse(txt))
+    table = build_table(specs)
+    for _ in range(4):
+        after = rng.randrange(1_600_000_000, 1_900_000_000)
+        got = next_fire(table, after)
+        t = dt.datetime.fromtimestamp(after, UTC)
+        for j, spec in enumerate(specs):
+            want = next_after(spec, t)
+            want_e = -1 if want is None else _epoch(want)
+            assert got[j] == want_e, (texts[j], t, got[j], want_e)
+
+
+def test_next_fire_every_from_phase():
+    t0 = 1_750_000_000
+    table = build_table([parse("@every 90s")], phase_epoch_s=t0)
+    assert next_fire(table, t0)[0] == t0 + 90
+    assert next_fire(table, t0 + 89)[0] == t0 + 90
+    assert next_fire(table, t0 + 90)[0] == t0 + 180
+
+
+def test_next_fire_unsatisfiable_gives_up():
+    table = build_table([parse("0 0 0 30 2 ?")])
+    got = next_fire(table, 1_700_000_000, horizon_s=90 * 86400)
+    assert got[0] == -1
+
+
+def test_next_fire_dst_spring_forward():
+    tz = ZoneInfo("America/New_York")
+    table = build_table([parse("0 30 2 * * *")])
+    # 2026-03-08: 02:30 EST does not exist; the walker lands on 03-09 02:30.
+    after = _epoch(dt.datetime(2026, 3, 8, 1, 0, tzinfo=tz))
+    got = int(next_fire(table, after, tz=tz)[0])
+    scalar = next_after(parse("0 30 2 * * *"),
+                        dt.datetime.fromtimestamp(after, tz))
+    assert got == _epoch(scalar)
+    loc = dt.datetime.fromtimestamp(got, tz)
+    assert (loc.month, loc.day, loc.hour, loc.minute) == (3, 9, 2, 30)
+
+
+def test_next_fire_dst_fall_back_fires_both_occurrences():
+    tz = ZoneInfo("America/New_York")
+    table = build_table([parse("0 30 1 * * *")])
+    # 2026-11-01: 01:30 occurs twice (EDT then EST).
+    after = _epoch(dt.datetime(2026, 11, 1, 0, 0, tzinfo=tz))
+    first = int(next_fire(table, after, tz=tz)[0])
+    second = int(next_fire(table, first, tz=tz)[0])
+    assert second == first + 3600
+    scalar1 = next_after(parse("0 30 1 * * *"),
+                         dt.datetime.fromtimestamp(after, tz))
+    assert first == _epoch(scalar1)
+
+
+def test_first_fire_offset():
+    table = build_table([parse("30 * * * * *"), parse("0 0 0 1 1 ?")])
+    start = 1_700_000_000 - (1_700_000_000 % 60)  # minute boundary
+    fire = fire_mask(table, start, 60)
+    off, any_f = first_fire_offset(fire)
+    off = np.asarray(off); any_f = np.asarray(any_f)
+    assert any_f[0] and off[0] == 30
+    assert not any_f[1]
