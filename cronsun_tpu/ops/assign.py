@@ -1,0 +1,171 @@
+"""Load-balanced, capacity-constrained job->node assignment.
+
+Replaces the reference's *implicit* placement protocol — every eligible node
+races for an etcd lock at fire time and an arbitrary winner runs the job
+(job.go:243-271, client.go:95-109) — with one deterministic batched solve:
+
+- jobs of kind Alone/Interval ("exclusive") are placed on exactly one
+  eligible node, chosen by least load with capacity rationing;
+- jobs of kind Common fan out to every eligible node (the reference's
+  semantics: no lock, all eligible nodes fire — job.go:141-147), and their
+  cost is accumulated into node loads in one fused pass.
+
+The solve runs ``rounds`` bid/accept rounds over the whole fired bucket:
+
+  bid:    every unplaced job picks its least-loaded open eligible node
+          (argmin over load + deterministic tie-hash).
+  accept: bidders on the same node are ranked (stable sort by node) and
+          accepted up to (a) remaining node capacity and (b) a waterfill
+          quota — the chunk's target load level — so one min-load node is
+          never dogpiled; losers rebid against updated loads.  The final
+          round accepts anything within capacity.
+
+The bid and the Common fan-out are the bandwidth-critical steps; on TPU they
+run as Pallas kernels over the *bitpacked* eligibility (see pallas_kernels:
+~30x less HBM traffic than materializing [K, N] floats).  A jnp reference
+path (same tie-hash, bit-identical choices) serves CPU tests and the
+multichip dry-run.
+
+Capacity semantics: a -1 result for an exclusive fired job with eligible
+nodes means every one of them filled up — the reference's Parallels-gate
+"skip this run" outcome (job.go:176-180).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pallas_kernels import _TJ, _tie, bid_argmin, fanout_add
+
+__all__ = ["assign", "unpack_tile"]
+
+
+def unpack_tile(packed: jax.Array, n_nodes: int) -> jax.Array:
+    """[K, W32] uint32 -> [K, n_nodes] bool eligibility tile (reference path;
+    materializes the dense matrix — test/CPU scale only)."""
+    cols = jnp.arange(n_nodes, dtype=jnp.int32)
+    words = packed[:, cols // 32]
+    return ((words >> (cols % 32).astype(jnp.uint32)) & 1) != 0
+
+
+def _bid_jnp(packed, load_eff):
+    K = packed.shape[0]
+    w32 = packed.shape[1]
+    n = w32 * 32
+    elig = unpack_tile(packed, n)
+    jix = jnp.arange(K, dtype=jnp.uint32)[:, None]
+    nix = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    score = jnp.where(elig, load_eff[None, :] + _tie(jix, nix), jnp.inf)
+    # Exact score ties (16-bit tie-hash collisions happen at 10k nodes) must
+    # resolve in the same order as the pallas kernel, which scans bit planes
+    # b=0..31 outer, words w inner — i.e. lexicographic (score, b, w) with
+    # n = w*32 + b.  Argmin in that permuted order, then map back.
+    score_bw = score.reshape(K, w32, 32).transpose(0, 2, 1).reshape(K, n)
+    p = jnp.argmin(score_bw, axis=1).astype(jnp.int32)
+    choice = (p % w32) * 32 + p // w32
+    return jnp.min(score, axis=1), choice
+
+
+def _fanout_jnp(packed, w):
+    n = packed.shape[1] * 32
+    elig = unpack_tile(packed, n)
+    return jnp.einsum("jn,j->n", elig.astype(jnp.float32), w,
+                      preferred_element_type=jnp.float32)
+
+
+def _steps(impl: str):
+    if impl == "jnp":
+        return _bid_jnp, _fanout_jnp
+    interp = impl == "interpret"
+    return (functools.partial(bid_argmin, interpret=interp),
+            functools.partial(fanout_add, interpret=interp))
+
+
+def _rank_within_choice(key: jax.Array):
+    """Stable sort by key; returns (rank within equal keys, sort order,
+    sorted keys, segment-start positions)."""
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    first = jnp.searchsorted(sorted_key, sorted_key, side="left")
+    rank = jnp.arange(key.shape[0], dtype=jnp.int32) - first.astype(jnp.int32)
+    return rank, order, sorted_key, first
+
+
+@functools.partial(jax.jit, static_argnames=("rounds", "impl"))
+def _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
+                 rounds: int, impl: str):
+    K = fire.shape[0]
+    n_nodes = rem_cap.shape[0]
+    n_padded = elig_packed.shape[1] * 32
+    bid, fanout = _steps(impl)
+
+    # Pad node vectors to the bitpacked width; pad columns have zero
+    # capacity so they are never chosen.
+    pad = n_padded - n_nodes
+    load = jnp.pad(load, (0, pad))
+    rem_cap = jnp.pad(rem_cap, (0, pad))
+
+    cost = cost.astype(jnp.float32)
+    common_w = jnp.where(fire & ~exclusive, cost, 0.0)
+    load = load + fanout(elig_packed, common_w)
+
+    need0 = fire & exclusive
+    assigned = jnp.full(K, -1, dtype=jnp.int32)
+
+    for r in range(rounds):
+        load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
+        best, choice = bid(elig_packed, load_eff)
+        cand = need0 & (assigned < 0) & jnp.isfinite(best)
+        key = jnp.where(cand, choice, n_padded)
+        rank, order, sorted_key, first = _rank_within_choice(key)
+        safe_key = jnp.clip(sorted_key, 0, n_padded - 1)
+        cap_at = rem_cap[safe_key]
+
+        # Waterfill quota (see module docstring): accept per node only up to
+        # the target level; rank 0 always lands; final round caps only.
+        w = jnp.where(cand, cost, 0.0)
+        open_n = rem_cap > 0
+        n_open = jnp.maximum(jnp.sum(open_n), 1)
+        level = (jnp.sum(jnp.where(open_n, load, 0.0)) + jnp.sum(w)) / n_open
+        w_sorted = w[order]
+        cum_excl = jnp.cumsum(w_sorted) - w_sorted
+        cum_in_seg = cum_excl - cum_excl[first]
+        headroom = level - load[safe_key]
+        fits = (rank == 0) | (cum_in_seg + w_sorted <= headroom)
+        is_final = r == rounds - 1
+        accept_sorted = (sorted_key < n_padded) & (rank < cap_at) & (is_final | fits)
+        accept = jnp.zeros(K, dtype=bool).at[order].set(accept_sorted)
+        assigned = jnp.where(accept, choice, assigned)
+        load = load.at[choice].add(jnp.where(accept, cost, 0.0))
+        rem_cap = rem_cap.at[choice].add(-accept.astype(jnp.int32))
+
+    return assigned, load[:n_nodes], rem_cap[:n_nodes]
+
+
+def assign(fire: jax.Array, elig_packed: jax.Array, exclusive: jax.Array,
+           load: jax.Array, rem_cap: jax.Array, cost: jax.Array,
+           rounds: int = 3, impl: str = "auto"):
+    """Place all fired jobs for one tick.
+
+    Args:
+      fire: [K] bool — jobs firing this tick (K = fired bucket or full J).
+      elig_packed: [K, W32] uint32 bitpacked eligibility.
+      exclusive: [K] bool — Alone/Interval kinds (exactly-one placement).
+      load: [N] f32 per-node load; rem_cap: [N] i32 remaining slots (0 for
+        dead columns); cost: [K] f32 per-job expected cost (the reference's
+        AvgTime EWMA, job.go:581-589).
+      rounds: bid/accept rounds.
+      impl: "auto" (pallas on TPU, jnp elsewhere), "pallas", "jnp", or
+        "interpret" (pallas interpreter — tests).
+
+    Returns: (assigned [K] i32 node column or -1, new load, new rem_cap).
+    """
+    if impl == "auto":
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and fire.shape[0] % _TJ == 0 else "jnp")
+    return _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
+                        rounds, impl)

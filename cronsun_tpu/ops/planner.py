@@ -1,0 +1,212 @@
+"""TickPlanner: the device-resident scheduling state + one-call tick plan.
+
+This is the TPU replacement for the reference's entire per-node hot loop
+(node/cron/cron.go:210-275): instead of N nodes each sorting entries and
+walking ``Schedule.Next`` per job, one planner holds ALL jobs' compiled
+schedules, the bitpacked eligibility matrix, per-node loads and capacities on
+device, and answers "who fires this second, and where does each run" in a
+single fused dispatch chain:
+
+    fire_mask [J] -> compact fired rows into a fixed bucket [K] ->
+    capacity-constrained waterfill assign on the bucket -> scatter back [J]
+
+Compaction is the key asymmetry: fire rates are sparse (a second matches few
+schedules), so the expensive [K, N] solve runs on the fired bucket, not all
+J rows.  Bucket sizes snap to powers of two so XLA compiles a handful of
+variants, never per-tick.
+
+State updates (job churn, node churn, load decay, completed executions) are
+in-place scatters at fixed shapes — no recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from datetime import timezone
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .assign import assign
+from .schedule_table import ScheduleTable, build_table
+from .tick import fire_mask
+
+_UTC = timezone.utc
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _compact(fire: jax.Array, k: int):
+    """Indices of up to k fired jobs + validity mask + overflow count."""
+    total = jnp.sum(fire.astype(jnp.int32))
+    idx = jnp.nonzero(fire, size=k, fill_value=0)[0].astype(jnp.int32)
+    valid = jnp.arange(k, dtype=jnp.int32) < total
+    return idx, valid, total
+
+
+def _bucket_assign(idx, valid, elig_packed, exclusive, cost, load, rem_cap,
+                   rounds, impl):
+    packed_k = elig_packed[idx]
+    excl_k = exclusive[idx]
+    cost_k = cost[idx]
+    return assign(valid, packed_k, excl_k, load, rem_cap, cost_k,
+                  rounds=rounds, impl=impl)
+
+
+@partial(jax.jit, static_argnames=("k", "rounds", "impl"),
+         donate_argnames=("load", "rem_cap"))
+def _plan_step(table: ScheduleTable, fields, elig, exclusive, cost, load,
+               rem_cap, k: int, rounds: int, impl: str):
+    """One fused tick: fire -> compact -> solve -> pack.
+
+    ``fields`` is a single [7] int32 upload (sec,min,hour,dom,month,dow,
+    t_rel) — one host->device transfer per tick.  The result is packed as
+    [3, k] int32 (fired idx / total at [1,0] / assignment) so the host needs
+    exactly one device->host transfer.
+    """
+    from .tick import _fire_mask_jit
+    f = [fields[i:i + 1] for i in range(7)]
+    fire = _fire_mask_jit(table, *f)[:, 0]
+    idx, valid, total = _compact(fire, k)
+    assigned_k, load, rem_cap = _bucket_assign(
+        idx, valid, elig, exclusive, cost, load, rem_cap, rounds, impl)
+    total_row = jnp.zeros_like(idx).at[0].set(total)
+    packed_out = jnp.stack([idx, total_row, assigned_k], axis=0)
+    return packed_out, load, rem_cap
+
+
+@dataclasses.dataclass
+class TickPlan:
+    """Result of one planning step (host-side views)."""
+    epoch_s: int
+    fired: np.ndarray        # [F] job rows that fired (valid entries)
+    assigned: np.ndarray     # [F] node column for exclusive jobs, -1 for
+                             #     Common (fan-out) or no-capacity skips
+    overflow: int            # fired jobs beyond the bucket SLA (dropped)
+
+
+class TickPlanner:
+    """Owns device state; call :meth:`plan` once per second (or window).
+
+    Capacity model: ``rem_cap[n]`` is the node's remaining concurrency
+    budget for *exclusive* placements.  The solve reserves a slot at plan
+    time (rem_cap decremented inside assign); executors release it with
+    :meth:`job_finished` at completion — the batched analogue of the
+    reference's in-process Parallels accounting (job.go:165-187).
+    Common-kind fan-out runs never consume rem_cap; they contribute load
+    only (via the fanout kernel at plan time, released with
+    :meth:`common_finished`).
+    """
+
+    def __init__(self, job_capacity: int, node_capacity: int,
+                 tz=_UTC, rounds: int = 3, impl: str = "auto",
+                 max_fire_bucket: int = 65536):
+        self.tz = tz
+        self.impl = impl
+        self.rounds = rounds
+        self.max_fire_bucket = max_fire_bucket
+        self.J = _next_pow2(job_capacity)
+        self.N = ((node_capacity + 31) // 32) * 32
+        self.table: ScheduleTable = build_table([], capacity=self.J)
+        self.elig = jnp.zeros((self.J, self.N // 32), jnp.uint32)
+        self.exclusive = jnp.zeros(self.J, bool)
+        self.cost = jnp.ones(self.J, jnp.float32)
+        self.load = jnp.zeros(self.N, jnp.float32)
+        self.rem_cap = jnp.zeros(self.N, jnp.int32)   # dead columns stay 0
+        # Adaptive fired-bucket: sized from the last observed fire count so
+        # quiet tables don't pay the max-SLA solve.  Starts at max.
+        self._last_total = max_fire_bucket
+
+    # -- state maintenance (all fixed-shape scatters) ----------------------
+
+    def set_table(self, table: ScheduleTable):
+        if table.capacity != self.J:
+            raise ValueError(f"table capacity {table.capacity} != {self.J}")
+        self.table = table
+
+    def set_eligibility_rows(self, rows: np.ndarray, values: np.ndarray):
+        if len(rows):
+            self.elig = self.elig.at[jnp.asarray(rows)].set(jnp.asarray(values))
+
+    def set_job_meta(self, rows: np.ndarray, exclusive: np.ndarray,
+                     cost: np.ndarray):
+        if len(rows):
+            r = jnp.asarray(np.asarray(rows, np.int32))
+            self.exclusive = self.exclusive.at[r].set(jnp.asarray(exclusive))
+            self.cost = self.cost.at[r].set(jnp.asarray(cost, ).astype(jnp.float32))
+
+    def set_node_capacity(self, cols: Sequence[int], caps: Sequence[int]):
+        if len(cols):
+            c = jnp.asarray(np.asarray(cols, np.int32))
+            self.rem_cap = self.rem_cap.at[c].set(
+                jnp.asarray(np.asarray(caps, np.int32)))
+
+    def job_finished(self, node_col: int, cost: float):
+        """Exclusive execution completed: release the capacity slot the
+        solve reserved and retire its load."""
+        self.rem_cap = self.rem_cap.at[node_col].add(1)
+        self.load = self.load.at[node_col].add(-float(cost))
+
+    def common_finished(self, node_col: int, cost: float):
+        """Common (fan-out) execution completed: retire load only — Common
+        runs never held a capacity slot."""
+        self.load = self.load.at[node_col].add(-float(cost))
+
+    def decay_load(self, factor: float = 0.99):
+        self.load = self.load * factor
+
+    # -- the tick ----------------------------------------------------------
+
+    def plan_async(self, epoch_s: int, sla_bucket: Optional[int] = None):
+        """Dispatch one tick; return (epoch_s, k, device [3,k] result).
+
+        Does not synchronize — callers can pipeline several ticks and
+        materialize with :meth:`gather`.  ``plan`` is the sync convenience.
+        """
+        from .schedule_table import FRAMEWORK_EPOCH
+        from .timecal import window_fields
+        if sla_bucket is None:
+            # Headroom factor 2 over the last tick's count; overflowed ticks
+            # bounce back up to the max SLA immediately.
+            k = max(2048, 2 * self._last_total)
+        else:
+            k = sla_bucket
+        k = min(_next_pow2(min(k, self.max_fire_bucket)), self.J)
+        impl = self.impl
+        if impl == "auto":
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    and k % 256 == 0 else "jnp")
+        f = window_fields(epoch_s, 1, tz=self.tz)
+        fields = np.empty(7, np.int32)
+        fields[0] = f["sec"][0]; fields[1] = f["min"][0]
+        fields[2] = f["hour"][0]; fields[3] = f["dom"][0]
+        fields[4] = f["month"][0]; fields[5] = f["dow"][0]
+        fields[6] = epoch_s - FRAMEWORK_EPOCH
+        packed_out, self.load, self.rem_cap = _plan_step(
+            self.table, jnp.asarray(fields),
+            self.elig, self.exclusive, self.cost, self.load, self.rem_cap,
+            k, self.rounds, impl)
+        return epoch_s, k, packed_out
+
+    def gather(self, handle) -> TickPlan:
+        """Materialize a plan_async result (the single host transfer)."""
+        epoch_s, k, packed_out = handle
+        out = np.asarray(packed_out)
+        total_h = int(out[1, 0])
+        self._last_total = total_h
+        n_valid = min(total_h, k)
+        return TickPlan(
+            epoch_s=epoch_s,
+            fired=out[0, :n_valid],
+            assigned=out[2, :n_valid],
+            overflow=max(0, total_h - k),
+        )
+
+    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
+        """Fire + place every job due at ``epoch_s`` (one-second tick)."""
+        return self.gather(self.plan_async(epoch_s, sla_bucket))

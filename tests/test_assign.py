@@ -1,0 +1,205 @@
+"""Eligibility packing and assignment-solve invariants."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from cronsun_tpu.ops.assign import assign, unpack_tile
+from cronsun_tpu.ops.eligibility import (
+    EligibilityBuilder, NodeUniverse, pack_bitmask, pack_eligibility)
+
+
+# ------------------------------------------------------------- eligibility
+
+def test_pack_bitmask_roundtrip():
+    row = pack_bitmask([0, 31, 32, 63, 70], 3)
+    bits = np.asarray(unpack_tile(jnp.asarray(row[None, :]), 96))[0]
+    assert set(np.nonzero(bits)[0]) == {0, 31, 32, 63, 70}
+
+
+def test_pack_eligibility_semantics():
+    n_words = 2
+    g = pack_bitmask([3, 4, 5], n_words)
+    row = pack_eligibility([1, 4], [g], [4, 5], n_words)
+    bits = np.asarray(unpack_tile(jnp.asarray(row[None, :]), 64))[0]
+    assert set(np.nonzero(bits)[0]) == {1, 3}  # (1,4)∪(3,4,5) − (4,5)
+
+
+def test_empty_includes_means_nowhere():
+    row = pack_eligibility([], [], [], 2)
+    assert not row.any()
+
+
+def test_builder_group_edit_rebuilds_member_jobs():
+    u = NodeUniverse(64)
+    for i in range(6):
+        u.add(f"n{i}")
+    b = EligibilityBuilder(u, job_capacity=8)
+    b.set_group("g1", ["n0", "n1"])
+    b.set_job(0, [], ["g1"], [])
+    b.set_job(1, ["n5"], ["g1"], ["n0"])
+    rows, vals = b.dirty_rows()
+    assert rows.tolist() == [0, 1]
+    bits0 = np.asarray(unpack_tile(jnp.asarray(vals[0:1]), 64))[0]
+    bits1 = np.asarray(unpack_tile(jnp.asarray(vals[1:2]), 64))[0]
+    assert set(np.nonzero(bits0)[0]) == {u.index["n0"], u.index["n1"]}
+    assert set(np.nonzero(bits1)[0]) == {u.index["n1"], u.index["n5"]}
+    # group edit propagates to both member jobs
+    b.set_group("g1", ["n2"])
+    rows, vals = b.dirty_rows()
+    assert rows.tolist() == [0, 1]
+    bits0 = np.asarray(unpack_tile(jnp.asarray(vals[0:1]), 64))[0]
+    assert set(np.nonzero(bits0)[0]) == {u.index["n2"]}
+    # deleting the job clears its row
+    b.del_job(0)
+    rows, vals = b.dirty_rows()
+    assert rows.tolist() == [0] and not vals.any()
+
+
+def test_builder_del_group():
+    u = NodeUniverse(32)
+    u.add("a"); u.add("b")
+    b = EligibilityBuilder(u, job_capacity=4)
+    b.set_group("g", ["a", "b"])
+    b.set_job(2, [], ["g"], [])
+    b.dirty_rows()
+    b.del_group("g")
+    rows, vals = b.dirty_rows()
+    assert rows.tolist() == [2] and not vals.any()
+
+
+# ------------------------------------------------------------------ assign
+
+def _mk(J, N, elig_np, fire_np, excl_np, cap=10**6, cost=None):
+    w32 = (N + 31) // 32
+    packed = np.zeros((J, w32), dtype=np.uint32)
+    for j in range(J):
+        packed[j] = pack_bitmask(np.nonzero(elig_np[j])[0].tolist(), w32)
+    return (jnp.asarray(fire_np), jnp.asarray(packed), jnp.asarray(excl_np),
+            jnp.zeros(N, jnp.float32),
+            jnp.full(N, cap, jnp.int32),
+            jnp.asarray(cost if cost is not None else np.ones(J, np.float32)))
+
+
+def test_assign_respects_eligibility_and_balances():
+    rng = np.random.default_rng(0)
+    J, N = 256, 16
+    elig = rng.random((J, N)) < 0.5
+    elig[:, 0] = True  # every job has at least one option
+    fire = np.ones(J, bool)
+    excl = np.ones(J, bool)
+    a, load, cap = assign(*_mk(J, N, elig, fire, excl))
+    a = np.asarray(a)
+    assert (a >= 0).all()
+    for j in range(J):
+        assert elig[j, a[j]], j
+    counts = np.bincount(a, minlength=N)
+    # ~16 jobs/node on average; the tie-broken greedy should stay within 3x.
+    assert counts.max() <= 48, counts
+
+def test_assign_capacity_never_exceeded():
+    J, N = 128, 4
+    elig = np.ones((J, N), bool)
+    fire = np.ones(J, bool)
+    excl = np.ones(J, bool)
+    a, load, rem = assign(*_mk(J, N, elig, fire, excl, cap=5))
+    a = np.asarray(a)
+    counts = np.bincount(a[a >= 0], minlength=N)
+    assert (counts <= 5).all()
+    assert counts.sum() == 20              # 4 nodes x 5 slots all filled
+    assert (a < 0).sum() == J - 20         # the rest skipped (Parallels gate)
+    assert np.asarray(rem).tolist() == [0, 0, 0, 0]
+
+
+def test_assign_no_eligible_gives_minus_one():
+    J, N = 64, 8
+    elig = np.zeros((J, N), bool)
+    fire = np.ones(J, bool)
+    excl = np.ones(J, bool)
+    a, load, rem = assign(*_mk(J, N, elig, fire, excl))
+    assert (np.asarray(a) == -1).all()
+    assert np.asarray(load).sum() == 0
+
+
+def test_assign_common_fans_out_into_load_only():
+    J, N = 64, 8
+    elig = np.zeros((J, N), bool)
+    elig[:, 2] = True
+    elig[:, 5] = True
+    fire = np.zeros(J, bool); fire[:10] = True
+    excl = np.zeros(J, bool)               # all Common
+    cost = np.full(J, 2.0, np.float32)
+    a, load, rem = assign(*_mk(J, N, elig, fire, excl, cost=cost))
+    assert (np.asarray(a) == -1).all()     # no exclusive placement
+    load = np.asarray(load)
+    assert load[2] == pytest.approx(20.0) and load[5] == pytest.approx(20.0)
+    assert load.sum() == pytest.approx(40.0)
+
+
+def test_assign_unfired_jobs_untouched():
+    J, N = 64, 8
+    elig = np.ones((J, N), bool)
+    fire = np.zeros(J, bool)
+    excl = np.ones(J, bool)
+    a, load, rem = assign(*_mk(J, N, elig, fire, excl))
+    assert (np.asarray(a) == -1).all()
+    assert np.asarray(load).sum() == 0
+
+
+def test_assign_prefers_lighter_nodes():
+    J, N = 64, 2
+    elig = np.ones((J, N), bool)
+    fire = np.ones(J, bool)
+    excl = np.ones(J, bool)
+    f, p, e, load, cap, cost = _mk(J, N, elig, fire, excl)
+    load = jnp.asarray(np.array([100.0, 0.0], np.float32))
+    a, new_load, _ = assign(f, p, e, load, cap, cost)
+    counts = np.bincount(np.asarray(a), minlength=N)
+    assert counts[1] > counts[0]
+
+
+def test_assign_deterministic():
+    rng = np.random.default_rng(3)
+    J, N = 128, 8
+    elig = rng.random((J, N)) < 0.7
+    fire = rng.random(J) < 0.9
+    excl = rng.random(J) < 0.8
+    args = _mk(J, N, elig, fire, excl)
+    a1, l1, c1 = assign(*args)
+    a2, l2, c2 = assign(*args)
+    assert (np.asarray(a1) == np.asarray(a2)).all()
+    assert np.allclose(np.asarray(l1), np.asarray(l2))
+
+
+def test_builder_node_removed_scrubs_recycled_column():
+    u = NodeUniverse(8)
+    u.add("old")
+    b = EligibilityBuilder(u, job_capacity=4)
+    b.set_group("g", ["old"])
+    b.set_job(0, ["old"], [], [])
+    b.set_job(1, [], ["g"], [])
+    b.dirty_rows()
+    b.node_removed("old")
+    rows, vals = b.dirty_rows()
+    assert set(rows.tolist()) == {0, 1}
+    assert not vals.any()
+    # recycled column must not leak old eligibility
+    col = u.add("new")
+    assert not (b.matrix[:, col // 32] & np.uint32(1 << (col % 32))).any()
+    assert not b.group_mask["g"].any()
+
+
+def test_builder_group_recreation_restores_members():
+    u = NodeUniverse(8)
+    u.add("a"); u.add("b")
+    b = EligibilityBuilder(u, job_capacity=4)
+    b.set_group("g", ["a", "b"])
+    b.set_job(2, [], ["g"], [])
+    b.dirty_rows()
+    b.del_group("g")
+    b.dirty_rows()
+    b.set_group("g", ["a"])              # same gid recreated
+    rows, vals = b.dirty_rows()
+    assert rows.tolist() == [2]
+    bits = np.asarray(unpack_tile(jnp.asarray(vals[0:1]), 8))[0]
+    assert set(np.nonzero(bits)[0]) == {u.index["a"]}

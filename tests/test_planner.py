@@ -1,0 +1,76 @@
+"""TickPlanner end-to-end: table + eligibility + capacity -> per-tick plan."""
+
+import numpy as np
+
+from cronsun_tpu.cron.parser import parse
+from cronsun_tpu.ops.eligibility import EligibilityBuilder, NodeUniverse
+from cronsun_tpu.ops.planner import TickPlanner
+from cronsun_tpu.ops.schedule_table import build_table
+
+
+def _setup(n_jobs=6, node_ids=("n0", "n1", "n2")):
+    p = TickPlanner(job_capacity=64, node_capacity=64, max_fire_bucket=4096)
+    u = NodeUniverse(p.N)
+    cols = [u.add(n) for n in node_ids]
+    b = EligibilityBuilder(u, job_capacity=p.J)
+    p.set_node_capacity(cols, [10] * len(cols))
+    return p, u, b
+
+
+def test_plan_fires_and_places_exclusive_jobs():
+    p, u, b = _setup()
+    # jobs 0,1: every-second cron, exclusive, eligible on all three nodes
+    specs = [parse("* * * * * *"), parse("* * * * * *"),
+             parse("0 30 4 * * *")]
+    p.set_table(build_table(specs, capacity=p.J))
+    for row in (0, 1, 2):
+        b.set_job(row, ["n0", "n1", "n2"], [], [])
+    rows, vals = b.dirty_rows()
+    p.set_eligibility_rows(rows, vals)
+    p.set_job_meta(np.array([0, 1, 2]), np.array([True, True, True]),
+                   np.ones(3, np.float32))
+    plan = p.plan(1_753_000_000)
+    assert set(plan.fired.tolist()) == {0, 1}
+    assert plan.overflow == 0
+    assert (plan.assigned >= 0).all()
+    # both jobs placed, load spread over distinct nodes
+    assert len(set(plan.assigned.tolist())) == 2
+
+
+def test_plan_common_jobs_get_minus_one_and_load():
+    p, u, b = _setup()
+    p.set_table(build_table([parse("* * * * * *")], capacity=p.J))
+    b.set_job(0, ["n0", "n1"], [], [])
+    rows, vals = b.dirty_rows()
+    p.set_eligibility_rows(rows, vals)
+    p.set_job_meta(np.array([0]), np.array([False]), np.array([2.0], np.float32))
+    plan = p.plan(1_753_000_000)
+    assert plan.fired.tolist() == [0]
+    assert plan.assigned.tolist() == [-1]
+    load = np.asarray(p.load)
+    assert load[u.index["n0"]] == 2.0 and load[u.index["n1"]] == 2.0
+
+
+def test_plan_capacity_accounting_roundtrip():
+    p, u, b = _setup(node_ids=("n0",))
+    p.set_table(build_table([parse("* * * * * *")] * 3, capacity=p.J))
+    for row in range(3):
+        b.set_job(row, ["n0"], [], [])
+    rows, vals = b.dirty_rows()
+    p.set_eligibility_rows(rows, vals)
+    p.set_job_meta(np.arange(3), np.ones(3, bool), np.ones(3, np.float32))
+    p.set_node_capacity([u.index["n0"]], [2])
+    plan = p.plan(1_753_000_000)
+    placed = (plan.assigned >= 0).sum()
+    assert placed == 2                       # third skipped: capacity gate
+    assert int(np.asarray(p.rem_cap)[u.index["n0"]]) == 0
+    p.job_finished(u.index["n0"], cost=1.0)
+    assert int(np.asarray(p.rem_cap)[u.index["n0"]]) == 1
+    plan2 = p.plan(1_753_000_001)
+    assert (plan2.assigned >= 0).sum() == 1  # one slot free again
+
+
+def test_plan_inactive_table_fires_nothing():
+    p, u, b = _setup()
+    plan = p.plan(1_753_000_000)
+    assert len(plan.fired) == 0 and plan.overflow == 0
