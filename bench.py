@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Benchmark ladder (BASELINE.md) — prints ONE JSON line on stdout.
+
+Headline: tick+assign latency @ 1M jobs x 10k nodes on one chip, sustained
+(pipelined) per-tick — the north-star metric from BASELINE.json (<100 ms p99).
+``vs_baseline`` is target_ms / measured_p99 (>1.0 beats the target).
+
+Detail for every ladder config goes to bench_detail.json and stderr.
+
+Run from the repo root (the axon TPU tunnel breaks under PYTHONPATH).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_MS = 100.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def synth_table(J, fire_period_lo, fire_period_hi, seed=0):
+    import jax.numpy as jnp
+    from cronsun_tpu.ops.schedule_table import ScheduleTable
+    rng = np.random.default_rng(seed)
+    cols = dict(
+        sec_lo=np.zeros(J, np.uint32), sec_hi=np.zeros(J, np.uint32),
+        min_lo=np.zeros(J, np.uint32), min_hi=np.zeros(J, np.uint32),
+        hour=np.zeros(J, np.uint32), dom=np.zeros(J, np.uint32),
+        month=np.zeros(J, np.uint32), dow=np.zeros(J, np.uint32),
+        dom_star=np.zeros(J, bool), dow_star=np.zeros(J, bool),
+        is_every=np.ones(J, bool),
+        period=rng.integers(fire_period_lo, fire_period_hi, J).astype(np.int32),
+        active=np.ones(J, bool), paused=np.zeros(J, bool))
+    # Uniform phases over each job's own period: steady aggregate fire rate
+    # (clustered phases make bursty seconds that overflow the fired bucket).
+    cols["phase_mod"] = (rng.integers(0, 1 << 30, J) % cols["period"]).astype(np.int32)
+    return ScheduleTable(**{k: jnp.asarray(v) for k, v in cols.items()})
+
+
+def bench_ticks(p, t0, n, pipeline=8, sla=None):
+    """Sustained pipelined per-tick ms over n ticks (fixed SLA bucket so
+    adaptive resizing never recompiles inside the timed region)."""
+    handles = []
+    start = time.time()
+    for i in range(n):
+        handles.append(p.plan_async(t0 + i, sla_bucket=sla))
+        if len(handles) > pipeline:
+            p.gather(handles.pop(0))
+    for h in handles:
+        p.gather(h)
+    return (time.time() - start) / n * 1000
+
+
+def bench_windows(p, t0, n_windows, W, pipeline=2, sla=None):
+    """Sustained windowed per-tick ms: n_windows dispatches of W seconds."""
+    handles = []
+    start = time.time()
+    for i in range(n_windows):
+        handles.append(p.plan_window_async(t0 + i * W, W, sla_bucket=sla))
+        if len(handles) > pipeline:
+            p.gather_window(handles.pop(0))
+    for h in handles:
+        p.gather_window(h)
+    return (time.time() - start) / (n_windows * W) * 1000
+
+
+def bench_ticks_sync(p, t0, n, sla=None):
+    lat = []
+    for i in range(n):
+        s = time.time()
+        p.plan(t0 + i, sla_bucket=sla)
+        lat.append((time.time() - s) * 1000)
+    return np.array(lat)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax
+    import jax.numpy as jnp
+    from cronsun_tpu.cron.parser import parse
+    from cronsun_tpu.ops.planner import TickPlanner
+    from cronsun_tpu.ops.schedule_table import build_table
+    from cronsun_tpu.ops.tick import next_fire
+    detail = {"backend": jax.default_backend(),
+              "device": str(jax.devices()[0])}
+    T0 = 1_753_000_000
+    rng = np.random.default_rng(0)
+
+    # ---- config 1: 100-job single-node tick --------------------------------
+    log("config 1: 100-job single-node tick")
+    p1 = TickPlanner(job_capacity=128, node_capacity=32, max_fire_bucket=128)
+    specs = [parse(f"{i % 60} * * * * *") for i in range(60)] + \
+            [parse("* * * * * *")] * 40
+    p1.set_table(build_table(specs, capacity=p1.J))
+    p1.elig = jnp.ones_like(p1.elig)
+    p1.exclusive = jnp.ones(p1.J, bool)
+    p1.set_node_capacity([0], [1 << 20])
+    bench_ticks_sync(p1, T0, 3)  # warm
+    lat1 = bench_ticks_sync(p1, T0 + 10, 10 if quick else 60)
+    detail["c1_100job_tick_p50_ms"] = round(float(np.percentile(lat1, 50)), 2)
+    detail["c1_100job_tick_p99_ms"] = round(float(np.percentile(lat1, 99)), 2)
+
+    # ---- config 2: 10k mixed specs, batched next-fire ----------------------
+    log("config 2: 10k mixed cron specs, batched next-fire")
+    mixed = []
+    for i in range(10_000):
+        r = i % 5
+        if r == 0:
+            mixed.append(f"@every {rng.integers(1, 300)}s")
+        elif r == 1:
+            mixed.append(f"{rng.integers(0,60)} {rng.integers(0,60)} * * * *")
+        elif r == 2:
+            mixed.append(f"*/{rng.integers(2,30)} * * * * *")
+        elif r == 3:
+            mixed.append(f"0 {rng.integers(0,60)} {rng.integers(0,24)} * * "
+                         f"{rng.integers(0,7)}")
+        else:
+            mixed.append(f"0 0 {rng.integers(0,24)} {rng.integers(1,29)} * ?")
+    t2 = build_table([parse(s) for s in mixed], phase_epoch_s=T0)
+    next_fire(t2, T0)  # warm/compile
+    ts = []
+    for i in range(3 if quick else 10):
+        s = time.time()
+        r = next_fire(t2, T0 + i * 37)
+        ts.append((time.time() - s) * 1000)
+    detail["c2_10k_nextfire_p50_ms"] = round(float(np.median(ts)), 2)
+    detail["c2_10k_nextfire_resolved"] = int((r >= 0).sum())
+
+    # ---- configs 3-5: eligibility + assignment ladder ----------------------
+    def ladder(name, J, N, fire_rate, caps, bucket, ticks):
+        log(f"{name}: {J} jobs x {N} nodes, fire~{fire_rate:.0%}")
+        p = TickPlanner(job_capacity=J, node_capacity=N,
+                        max_fire_bucket=bucket)
+        period_lo = max(2, int(1 / fire_rate * 0.7))
+        period_hi = max(period_lo + 2, int(1 / fire_rate * 1.4))
+        p.set_table(synth_table(p.J, period_lo, period_hi))
+        p.elig = jax.random.bits(jax.random.PRNGKey(1), (p.J, p.N // 32),
+                                 dtype=jnp.uint32)
+        p.exclusive = jnp.asarray(rng.random(p.J) < 0.5)
+        p.set_node_capacity(list(range(p.N)), [caps] * p.N)
+        bench_ticks(p, T0, 3, sla=bucket)  # warm + compile
+        sus = bench_ticks(p, T0 + 100, ticks, sla=bucket)
+        lat = bench_ticks_sync(p, T0 + 1000, max(5, ticks // 2), sla=bucket)
+        fired = p.gather(p.plan_async(T0 + 2000, sla_bucket=bucket)).fired
+        return {f"{name}_sustained_ms": round(sus, 2),
+                f"{name}_sync_p50_ms": round(float(np.percentile(lat, 50)), 2),
+                f"{name}_sync_p99_ms": round(float(np.percentile(lat, 99)), 2),
+                f"{name}_fired_per_tick": int(len(fired))}
+
+    n_ticks = 6 if quick else 30
+    detail.update(ladder("c3_10kx1k", 10_000, 1024, 0.5, 1 << 20, 8192,
+                         n_ticks))
+    detail.update(ladder("c4_100kx1k", 100_000, 1024, 0.2, 64, 32768,
+                         n_ticks))
+    r5 = ladder("c5_1Mx10k", 1 << 20, 10240, 0.02, 1 << 20, 65536, n_ticks)
+    detail.update(r5)
+
+    # headline: windowed planning (the production cadence — plan W seconds
+    # ahead in one dispatch; semantics identical to W sequential ticks).
+    import jax
+    W = 8
+    p99_samples = []
+    p = TickPlanner(job_capacity=1 << 20, node_capacity=10240,
+                    max_fire_bucket=65536)
+    p.set_table(synth_table(p.J, 35, 70))
+    p.elig = jax.random.bits(jax.random.PRNGKey(2), (p.J, p.N // 32),
+                             dtype=jnp.uint32)
+    p.exclusive = jnp.asarray(rng.random(p.J) < 0.5)
+    p.set_node_capacity(list(range(p.N)), [1 << 20] * p.N)
+    log(f"headline: 1M x 10k windowed (W={W})")
+    SLA = 32768
+    bench_windows(p, T0, 2, W, sla=SLA)  # warm + compile
+    for rep in range(3 if quick else 6):
+        p99_samples.append(bench_windows(p, T0 + 1000 * rep, 4, W, sla=SLA))
+    headline_p99 = float(np.percentile(p99_samples, 99))
+    fired = p.gather(p.plan_async(T0 + 50000, sla_bucket=SLA)).fired
+    detail["headline_windowed_p99_ms_per_tick"] = round(headline_p99, 2)
+    detail["headline_window_s"] = W
+    detail["headline_fired_per_tick"] = int(len(fired))
+    detail["headline_jobs_per_sec_per_chip"] = int(
+        len(fired) / (headline_p99 / 1000))
+
+    with open("bench_detail.json", "w") as f:
+        json.dump(detail, f, indent=1)
+    log(json.dumps(detail, indent=1))
+
+    print(json.dumps({
+        "metric": "tick+assign sustained p99 @ 1M jobs x 10k nodes, 1 chip",
+        "value": round(headline_p99, 2),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / headline_p99, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""Sentinel errors (reference: errors.go:5-20)."""
+
+
+class CronsunError(Exception):
+    pass
+
+
+class NotFound(CronsunError):
+    pass
+
+
+class ValidationError(CronsunError):
+    pass
+
+
+class SecurityInvalid(ValidationError):
+    """Command/user rejected by the security policy (reference
+    job.go:633-656)."""

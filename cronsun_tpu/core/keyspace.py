@@ -1,0 +1,84 @@
+"""Key layout — identical shape to the reference's etcd keyspace
+(SURVEY.md appendix; conf normalizes the prefixes, conf/conf.go:124-157),
+plus the new ``dispatch`` prefix: the central planner's per-node execution
+orders, which replace the per-node cron loops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Keyspace:
+    prefix: str = "/cronsun"
+
+    @property
+    def cmd(self) -> str:        # job JSON, /cmd/<group>/<jobID>
+        return f"{self.prefix}/cmd/"
+
+    @property
+    def node(self) -> str:       # node liveness, /node/<id> (leased)
+        return f"{self.prefix}/node/"
+
+    @property
+    def proc(self) -> str:       # running executions (leased)
+        return f"{self.prefix}/proc/"
+
+    @property
+    def once(self) -> str:       # run-now triggers
+        return f"{self.prefix}/once/"
+
+    @property
+    def lock(self) -> str:       # execution fence tokens
+        return f"{self.prefix}/lock/"
+
+    @property
+    def group(self) -> str:      # node groups
+        return f"{self.prefix}/group/"
+
+    @property
+    def noticer(self) -> str:    # failure messages node -> web
+        return f"{self.prefix}/noticer/"
+
+    @property
+    def sess(self) -> str:       # web sessions (leased)
+        return f"{self.prefix}/sess/"
+
+    @property
+    def dispatch(self) -> str:   # planner -> agent execution orders (leased)
+        return f"{self.prefix}/dispatch/"
+
+    @property
+    def leader(self) -> str:     # scheduler leader election
+        return f"{self.prefix}/leader"
+
+    # -- key builders ------------------------------------------------------
+
+    def job_key(self, group: str, job_id: str) -> str:
+        return f"{self.cmd}{group}/{job_id}"
+
+    def node_key(self, node_id: str) -> str:
+        return f"{self.node}{node_id}"
+
+    def group_key(self, gid: str) -> str:
+        return f"{self.group}{gid}"
+
+    def once_key(self, group: str, job_id: str) -> str:
+        return f"{self.once}{group}/{job_id}"
+
+    def lock_key(self, job_id: str, epoch_s: int) -> str:
+        return f"{self.lock}{job_id}/{epoch_s}"
+
+    def proc_key(self, node_id: str, group: str, job_id: str, pid) -> str:
+        return f"{self.proc}{node_id}/{group}/{job_id}/{pid}"
+
+    def noticer_key(self, node_id: str) -> str:
+        return f"{self.noticer}{node_id}"
+
+    def dispatch_key(self, node_id: str, epoch_s: int, group: str,
+                     job_id: str) -> str:
+        return f"{self.dispatch}{node_id}/{epoch_s}/{group}/{job_id}"
+
+    def sess_key(self, sid: str) -> str:
+        return f"{self.sess}{sid}"

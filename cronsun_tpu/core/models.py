@@ -1,0 +1,225 @@
+"""Domain models: Job, JobRule, Group, Node, Account.
+
+Field-compatible with the reference's JSON wire format (job.go:38-84,
+group.go:17-22, node.go:25-35, account.go:14-25) so stored state is
+interoperable; validation mirrors Check/Valid (job.go:502-537,633-656).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import List, Optional
+
+from ..cron.parser import ParseError, parse
+from .errors import SecurityInvalid, ValidationError
+from .ids import next_id
+
+KIND_COMMON = 0    # runs on every eligible node, no mutual exclusion
+KIND_ALONE = 1     # exactly one execution fleet-wide at a time
+KIND_INTERVAL = 2  # at most one start per schedule interval
+
+ROLE_ADMIN = 1
+ROLE_DEVELOPER = 2
+
+
+def _clean(s: Optional[str]) -> str:
+    return (s or "").strip()
+
+
+@dataclasses.dataclass
+class JobRule:
+    """Placement rule: cron timer + include nodes/groups − exclude nodes
+    (reference job.go:76-84)."""
+    id: str = ""
+    timer: str = ""
+    gids: List[str] = dataclasses.field(default_factory=list)
+    nids: List[str] = dataclasses.field(default_factory=list)
+    exclude_nids: List[str] = dataclasses.field(default_factory=list)
+
+    def validate(self):
+        self.timer = _clean(self.timer)
+        if not self.timer:
+            raise ValidationError("rule timer required")
+        try:
+            parse(self.timer)
+        except ParseError as e:
+            raise ValidationError(f"invalid timer {self.timer!r}: {e}")
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "timer": self.timer, "gids": self.gids,
+                "nids": self.nids, "exclude_nids": self.exclude_nids}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobRule":
+        return cls(id=d.get("id", ""), timer=d.get("timer", ""),
+                   gids=list(d.get("gids") or []),
+                   nids=list(d.get("nids") or []),
+                   exclude_nids=list(d.get("exclude_nids") or []))
+
+
+@dataclasses.dataclass
+class Job:
+    """A schedulable command (reference job.go:38-74)."""
+    id: str = ""
+    name: str = ""
+    group: str = ""
+    command: str = ""
+    user: str = ""
+    rules: List[JobRule] = dataclasses.field(default_factory=list)
+    pause: bool = False
+    timeout: int = 0            # seconds; 0 = unlimited
+    parallels: int = 0          # max concurrent per node; 0 = unlimited
+    retry: int = 0
+    interval: int = 0           # seconds between retries
+    kind: int = KIND_COMMON
+    avg_time: float = 0.0       # EWMA execution seconds (job.go:581-589)
+    fail_notify: bool = False
+    to: List[str] = dataclasses.field(default_factory=list)
+
+    # ---- validation (reference job.go:502-537) ---------------------------
+
+    def check(self):
+        self.id = _clean(self.id) or next_id()
+        self.name = _clean(self.name)
+        if not self.name:
+            raise ValidationError("job name required")
+        self.group = _clean(self.group) or "default"
+        if "/" in self.group:
+            raise ValidationError("group name must not contain '/'")
+        if self.timeout < 0:
+            raise ValidationError("timeout must be >= 0")
+        if self.parallels < 0:
+            raise ValidationError("parallels must be >= 0")
+        if self.retry < 0:
+            raise ValidationError("retry must be >= 0")
+        if self.interval < 0:
+            raise ValidationError("interval must be >= 0")
+        if self.kind not in (KIND_COMMON, KIND_ALONE, KIND_INTERVAL):
+            raise ValidationError(f"unknown kind {self.kind}")
+        if not _clean(self.command):
+            raise ValidationError("command required")
+        for rule in self.rules:
+            rule.id = _clean(rule.id) or next_id()
+            rule.validate()
+
+    def security_valid(self, security) -> None:
+        """Reject commands/users outside the policy (reference
+        job.go:633-656).  ``security`` is conf.Security or None."""
+        if security is None or security.open is False:
+            return
+        if security.users and self.user not in security.users:
+            raise SecurityInvalid(
+                f"user {self.user!r} not in allowed users")
+        if security.exts:
+            cmd = _clean(self.command).split()[0] if _clean(self.command) else ""
+            if not any(cmd.endswith(ext) for ext in security.exts):
+                raise SecurityInvalid(
+                    f"command {cmd!r} does not match allowed suffixes")
+
+    @property
+    def exclusive(self) -> bool:
+        return self.kind in (KIND_ALONE, KIND_INTERVAL)
+
+    def update_avg_time(self, seconds: float):
+        """avg of the last two (reference job.go:581-589)."""
+        self.avg_time = seconds if self.avg_time == 0 \
+            else (self.avg_time + seconds) / 2
+
+    # ---- wire ------------------------------------------------------------
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["rules"] = [r.to_dict() if isinstance(r, JobRule) else r
+                      for r in self.rules]
+        return json.dumps(d, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Job":
+        d = json.loads(s)
+        rules = [JobRule.from_dict(r) for r in d.get("rules") or []]
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known and k != "rules"}
+        return cls(rules=rules, **kw)
+
+
+@dataclasses.dataclass
+class Group:
+    """Named node set (reference group.go:17-22)."""
+    id: str = ""
+    name: str = ""
+    node_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def check(self):
+        self.id = _clean(self.id) or next_id()
+        self.name = _clean(self.name)
+        if not self.name:
+            raise ValidationError("group name required")
+        if "/" in self.id:
+            raise ValidationError("group id must not contain '/'")
+
+    def included(self, node_id: str) -> bool:
+        return node_id in self.node_ids
+
+    def to_json(self) -> str:
+        return json.dumps({"id": self.id, "name": self.name,
+                           "nids": self.node_ids}, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Group":
+        d = json.loads(s)
+        return cls(id=d.get("id", ""), name=d.get("name", ""),
+                   node_ids=list(d.get("nids") or []))
+
+
+@dataclasses.dataclass
+class Node:
+    """Machine identity + liveness (reference node.go:25-35)."""
+    id: str = ""                 # IP in the reference; any stable id here
+    pid: int = 0
+    ip: str = ""
+    hostname: str = ""
+    version: str = ""
+    up_ts: float = 0.0
+    alived: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Node":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def hash_password(password: str, salt: str) -> str:
+    """Double sha256(pwd+salt) — same shape as the reference's double-MD5
+    (web/authentication.go:54-58) with a modern hash."""
+    h1 = hashlib.sha256((password + salt).encode()).hexdigest()
+    return hashlib.sha256((h1 + salt).encode()).hexdigest()
+
+
+@dataclasses.dataclass
+class Account:
+    """Web user (reference account.go:14-25)."""
+    email: str = ""
+    password: str = ""           # hash_password output
+    salt: str = ""
+    role: int = ROLE_DEVELOPER
+    status: int = 1              # 1 enabled, 0 banned
+    session: str = ""
+    unchangeable: bool = False
+
+    def check_password(self, password: str) -> bool:
+        return hash_password(password, self.salt) == self.password
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Account":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})

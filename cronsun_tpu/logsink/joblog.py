@@ -1,0 +1,262 @@
+"""SQLite-backed execution log + stats + account storage.
+
+Mirrors the reference's Mongo collections and their access patterns:
+
+- ``job_log``      — one row per execution (job_log.go:19-31)
+- ``job_latest_log`` — latest row per (job, node) (job_log.go:12-16, upsert
+  at job_log.go:103-117)
+- ``stat``         — overall + per-day success/fail counters
+  (job_log.go:118-132)
+- ``node``         — liveness mirror for the UI (node.go:129-142)
+- ``account``      — web users (account.go:67-105)
+
+Thread-safe (single connection + lock; WAL mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+import time
+from typing import List, Optional, Tuple
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job_log (
+  id INTEGER PRIMARY KEY AUTOINCREMENT,
+  job_id TEXT NOT NULL, job_group TEXT NOT NULL, name TEXT NOT NULL,
+  node TEXT NOT NULL, job_user TEXT DEFAULT '', command TEXT DEFAULT '',
+  output TEXT DEFAULT '', success INTEGER NOT NULL,
+  begin_ts REAL NOT NULL, end_ts REAL NOT NULL);
+CREATE INDEX IF NOT EXISTS il_job ON job_log(job_id, begin_ts DESC);
+CREATE INDEX IF NOT EXISTS il_node ON job_log(node, begin_ts DESC);
+CREATE INDEX IF NOT EXISTS il_begin ON job_log(begin_ts DESC);
+
+CREATE TABLE IF NOT EXISTS job_latest_log (
+  job_id TEXT NOT NULL, node TEXT NOT NULL,
+  job_group TEXT NOT NULL, name TEXT NOT NULL,
+  job_user TEXT DEFAULT '', command TEXT DEFAULT '', output TEXT DEFAULT '',
+  success INTEGER NOT NULL, begin_ts REAL NOT NULL, end_ts REAL NOT NULL,
+  PRIMARY KEY (job_id, node));
+
+CREATE TABLE IF NOT EXISTS stat (
+  day TEXT PRIMARY KEY,           -- '' = overall
+  total INTEGER NOT NULL DEFAULT 0,
+  successed INTEGER NOT NULL DEFAULT 0,
+  failed INTEGER NOT NULL DEFAULT 0);
+
+CREATE TABLE IF NOT EXISTS node (
+  id TEXT PRIMARY KEY, doc TEXT NOT NULL, alived INTEGER NOT NULL DEFAULT 0);
+
+CREATE TABLE IF NOT EXISTS account (
+  email TEXT PRIMARY KEY, doc TEXT NOT NULL);
+"""
+
+
+@dataclasses.dataclass
+class LogRecord:
+    job_id: str
+    job_group: str
+    name: str
+    node: str
+    user: str
+    command: str
+    output: str
+    success: bool
+    begin_ts: float
+    end_ts: float
+    id: Optional[int] = None
+
+    @property
+    def seconds(self) -> float:
+        return max(0.0, self.end_ts - self.begin_ts)
+
+
+class JobLogStore:
+    def __init__(self, path: str = ":memory:"):
+        self._lock = threading.RLock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.row_factory = sqlite3.Row
+        with self._lock:
+            if path != ":memory:":
+                self._db.execute("PRAGMA journal_mode=WAL")
+            self._db.executescript(_SCHEMA)
+            self._db.commit()
+
+    def close(self):
+        with self._lock:
+            self._db.close()
+
+    # ---- writes (the 4-write pattern of CreateJobLog) --------------------
+
+    def create_job_log(self, rec: LogRecord):
+        day = time.strftime("%Y-%m-%d", time.gmtime(rec.begin_ts))
+        ok = 1 if rec.success else 0
+        with self._lock:
+            cur = self._db.execute(
+                "INSERT INTO job_log (job_id, job_group, name, node, "
+                "job_user, command, output, success, begin_ts, end_ts) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?)",
+                (rec.job_id, rec.job_group, rec.name, rec.node, rec.user,
+                 rec.command, rec.output, ok, rec.begin_ts, rec.end_ts))
+            rec.id = cur.lastrowid
+            self._db.execute(
+                "INSERT INTO job_latest_log VALUES (?,?,?,?,?,?,?,?,?,?) "
+                "ON CONFLICT(job_id, node) DO UPDATE SET "
+                "job_group=excluded.job_group, name=excluded.name, "
+                "job_user=excluded.job_user, command=excluded.command, "
+                "output=excluded.output, success=excluded.success, "
+                "begin_ts=excluded.begin_ts, end_ts=excluded.end_ts",
+                (rec.job_id, rec.node, rec.job_group, rec.name, rec.user,
+                 rec.command, rec.output, ok, rec.begin_ts, rec.end_ts))
+            for d in ("", day):
+                self._db.execute(
+                    "INSERT INTO stat (day, total, successed, failed) "
+                    "VALUES (?,1,?,?) ON CONFLICT(day) DO UPDATE SET "
+                    "total=total+1, successed=successed+?, failed=failed+?",
+                    (d, ok, 1 - ok, ok, 1 - ok))
+            self._db.commit()
+
+    # ---- queries (web/job_log.go:18-113) ---------------------------------
+
+    def query_logs(self, node: Optional[str] = None,
+                   job_ids: Optional[List[str]] = None,
+                   name_like: Optional[str] = None,
+                   begin: Optional[float] = None,
+                   end: Optional[float] = None,
+                   failed_only: bool = False,
+                   latest: bool = False,
+                   page: int = 1, page_size: int = 50
+                   ) -> Tuple[List[LogRecord], int]:
+        table = "job_latest_log" if latest else "job_log"
+        where, args = [], []
+        if node:
+            where.append("node = ?"); args.append(node)
+        if job_ids:
+            where.append(f"job_id IN ({','.join('?' * len(job_ids))})")
+            args.extend(job_ids)
+        if name_like:
+            where.append("name LIKE ?"); args.append(f"%{name_like}%")
+        if begin is not None:
+            where.append("begin_ts >= ?"); args.append(begin)
+        if end is not None:
+            where.append("begin_ts < ?"); args.append(end)
+        if failed_only:
+            where.append("success = 0")
+        cond = (" WHERE " + " AND ".join(where)) if where else ""
+        page = max(1, page)
+        page_size = max(1, min(page_size, 500))
+        with self._lock:
+            total = self._db.execute(
+                f"SELECT COUNT(*) c FROM {table}{cond}", args).fetchone()["c"]
+            rows = self._db.execute(
+                f"SELECT * FROM {table}{cond} ORDER BY begin_ts DESC "
+                f"LIMIT ? OFFSET ?",
+                args + [page_size, (page - 1) * page_size]).fetchall()
+        return [self._row_to_rec(r, latest) for r in rows], total
+
+    def get_log(self, log_id: int) -> Optional[LogRecord]:
+        with self._lock:
+            r = self._db.execute("SELECT * FROM job_log WHERE id = ?",
+                                 (log_id,)).fetchone()
+        return self._row_to_rec(r, False) if r else None
+
+    @staticmethod
+    def _row_to_rec(r, latest: bool) -> LogRecord:
+        return LogRecord(
+            id=None if latest else r["id"],
+            job_id=r["job_id"], job_group=r["job_group"], name=r["name"],
+            node=r["node"], user=r["job_user"], command=r["command"],
+            output=r["output"], success=bool(r["success"]),
+            begin_ts=r["begin_ts"], end_ts=r["end_ts"])
+
+    # ---- stats -----------------------------------------------------------
+
+    def stat_overall(self) -> dict:
+        return self._stat("")
+
+    def stat_day(self, day: str) -> dict:
+        return self._stat(day)
+
+    def _stat(self, day: str) -> dict:
+        with self._lock:
+            r = self._db.execute("SELECT * FROM stat WHERE day = ?",
+                                 (day,)).fetchone()
+        if r is None:
+            return {"total": 0, "successed": 0, "failed": 0}
+        return {"total": r["total"], "successed": r["successed"],
+                "failed": r["failed"]}
+
+    def stat_days(self, n_days: int) -> List[dict]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT * FROM stat WHERE day != '' ORDER BY day DESC "
+                "LIMIT ?", (n_days,)).fetchall()
+        return [{"day": r["day"], "total": r["total"],
+                 "successed": r["successed"], "failed": r["failed"]}
+                for r in rows]
+
+    # ---- node mirror -----------------------------------------------------
+
+    def upsert_node(self, node_id: str, doc: str, alived: bool):
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO node VALUES (?,?,?) ON CONFLICT(id) DO UPDATE "
+                "SET doc=excluded.doc, alived=excluded.alived",
+                (node_id, doc, 1 if alived else 0))
+            self._db.commit()
+
+    def set_node_alived(self, node_id: str, alived: bool):
+        with self._lock:
+            self._db.execute("UPDATE node SET alived=? WHERE id=?",
+                             (1 if alived else 0, node_id))
+            self._db.commit()
+
+    def get_nodes(self) -> List[dict]:
+        with self._lock:
+            rows = self._db.execute("SELECT * FROM node ORDER BY id").fetchall()
+        out = []
+        for r in rows:
+            d = json.loads(r["doc"])
+            d["alived"] = bool(r["alived"])
+            out.append(d)
+        return out
+
+    def get_node(self, node_id: str) -> Optional[dict]:
+        with self._lock:
+            r = self._db.execute("SELECT * FROM node WHERE id=?",
+                                 (node_id,)).fetchone()
+        if r is None:
+            return None
+        d = json.loads(r["doc"])
+        d["alived"] = bool(r["alived"])
+        return d
+
+    # ---- accounts --------------------------------------------------------
+
+    def upsert_account(self, email: str, doc: str):
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO account VALUES (?,?) ON CONFLICT(email) DO "
+                "UPDATE SET doc=excluded.doc", (email, doc))
+            self._db.commit()
+
+    def get_account(self, email: str) -> Optional[str]:
+        with self._lock:
+            r = self._db.execute("SELECT doc FROM account WHERE email=?",
+                                 (email,)).fetchone()
+        return r["doc"] if r else None
+
+    def list_accounts(self) -> List[str]:
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT doc FROM account ORDER BY email").fetchall()
+        return [r["doc"] for r in rows]
+
+    def delete_account(self, email: str) -> bool:
+        with self._lock:
+            cur = self._db.execute("DELETE FROM account WHERE email=?",
+                                   (email,))
+            self._db.commit()
+            return cur.rowcount > 0

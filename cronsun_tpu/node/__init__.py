@@ -1,0 +1,3 @@
+"""Node-side runtime: executor, process registry, agent."""
+
+from .executor import ExecResult, Executor  # noqa: F401

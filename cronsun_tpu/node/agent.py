@@ -1,0 +1,252 @@
+"""Node agent: a thin watch-and-exec shell.
+
+Where the reference's node runs a full cron engine (node/node.go:445-464),
+this agent only:
+
+- registers its identity under a lease and keeps it alive
+  (node/node.go:64-119 semantics: re-grant + re-put after lapses);
+- watches its dispatch prefix for execution orders from the leader
+  scheduler and runs them through the Executor;
+- watches the once prefix for run-now triggers (value == own id or "" —
+  reference node/node.go:423-442; bypasses locks and the parallels gate);
+- fences exclusive executions with a create-if-absent (job, second) lock so
+  a double-dispatch (leader failover race) still runs exactly once —
+  the lease-fenced safety net the central assignment keeps from the
+  reference's lock protocol (job.go:243-271);
+- maintains the proc registry (leased running-execution keys,
+  proc.go:209-256), writes the execution record + stats, and posts failure
+  notices for the noticer (job.go:549-579).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..core import Job, Keyspace, Node
+from ..logsink import JobLogStore, LogRecord
+from ..store.memstore import DELETE, MemStore
+from .executor import ExecResult, Executor
+
+VERSION = "v0.1.0-tpu"
+
+
+class NodeAgent:
+    def __init__(self, store: MemStore, sink: JobLogStore,
+                 node_id: Optional[str] = None,
+                 ks: Optional[Keyspace] = None,
+                 ttl: float = 10.0, proc_ttl: float = 600.0,
+                 lock_ttl: float = 300.0,
+                 executor: Optional[Executor] = None,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.sink = sink
+        self.ks = ks or Keyspace()
+        self.id = node_id or _local_id()
+        self.ttl = ttl
+        self.proc_ttl = proc_ttl
+        self.lock_ttl = lock_ttl
+        self.executor = executor or Executor()
+        self.clock = clock
+
+        self._lease: Optional[int] = None
+        self._proc_lease: Optional[int] = None
+        self._stop = threading.Event()
+        self._threads = []
+        self._w_dispatch = store.watch(self.ks.dispatch + self.id + "/")
+        self._w_once = store.watch(self.ks.once)
+        self.running: Dict[str, threading.Thread] = {}
+
+    # ---- registration (node/node.go:64-119) ------------------------------
+
+    def register(self):
+        self._lease = self.store.grant(self.ttl + 2)
+        self.store.put(self.ks.node_key(self.id), str(os.getpid()),
+                       lease=self._lease)
+        self._proc_lease = self.store.grant(self.proc_ttl)
+        node = Node(id=self.id, pid=os.getpid(), ip=self.id,
+                    hostname=socket.gethostname(), version=VERSION,
+                    up_ts=self.clock(), alived=True)
+        self.sink.upsert_node(self.id, node.to_json(), alived=True)
+
+    def keepalive_once(self) -> bool:
+        ok = self._lease is not None and self.store.keepalive(self._lease)
+        if not ok:
+            self.register()     # reference re-registers after a lapse
+        if self._proc_lease is not None:
+            self.store.keepalive(self._proc_lease)
+        return ok
+
+    def unregister(self):
+        if self._lease is not None:
+            self.store.revoke(self._lease)
+            self._lease = None
+        if self._proc_lease is not None:
+            self.store.revoke(self._proc_lease)
+            self._proc_lease = None
+        self.sink.set_node_alived(self.id, False)
+
+    # ---- job lookup ------------------------------------------------------
+
+    def _get_job(self, group: str, job_id: str) -> Optional[Job]:
+        kv = self.store.get(self.ks.job_key(group, job_id))
+        if kv is None:
+            return None
+        try:
+            job = Job.from_json(kv.value)
+        except (json.JSONDecodeError, TypeError):
+            return None
+        job.group, job.id = group, job_id
+        return job
+
+    # ---- execution -------------------------------------------------------
+
+    def _execute(self, job: Job, epoch_s: int, fenced: bool,
+                 use_gate: bool = True):
+        if fenced and job.exclusive:
+            lease = self.store.grant(self.lock_ttl)
+            if not self.store.put_if_absent(
+                    self.ks.lock_key(job.id, epoch_s), self.id, lease=lease):
+                self.store.revoke(lease)
+                return  # another node already ran this (job, second)
+        proc_key = self.ks.proc_key(self.id, job.group, job.id,
+                                    f"{epoch_s}-{os.getpid()}")
+        self.store.put(proc_key, json.dumps({"time": self.clock()}),
+                       lease=self._proc_lease or 0)
+        try:
+            res = self.executor.run_job(
+                job_id=job.id, command=job.command, user=job.user,
+                timeout=job.timeout, retry=job.retry, interval=job.interval,
+                parallels=job.parallels if use_gate else 0)
+        finally:
+            self.store.delete(proc_key)
+        self._record(job, res)
+
+    def _record(self, job: Job, res: ExecResult):
+        if res.skipped:
+            return
+        self.sink.create_job_log(LogRecord(
+            job_id=job.id, job_group=job.group, name=job.name, node=self.id,
+            user=job.user, command=job.command,
+            output=res.output if res.success
+            else f"{res.output}\n[error] {res.error}".strip(),
+            success=res.success, begin_ts=res.begin_ts, end_ts=res.end_ts))
+        if not res.success and job.fail_notify:
+            msg = {"subject": f"[cronsun] job [{job.name}] fail",
+                   "body": f"job: {job.group}/{job.id}\nnode: {self.id}\n"
+                           f"output: {res.output}\nerror: {res.error}",
+                   "to": job.to}
+            self.store.put(self.ks.noticer_key(self.id),
+                           json.dumps(msg, separators=(",", ":")))
+
+    # ---- event processing (synchronous; threads call these) --------------
+
+    def poll(self, wait: float = 0.0) -> int:
+        """Drain watchers, spawn executions.  Returns orders handled."""
+        n = 0
+        deadline = self.clock() + wait
+        while True:
+            n += self._poll_dispatch()
+            n += self._poll_once()
+            if self.clock() >= deadline:
+                break
+            time.sleep(0.01)
+        return n
+
+    def _poll_dispatch(self) -> int:
+        n = 0
+        for ev in self._w_dispatch.drain():
+            if ev.type == DELETE:
+                continue
+            rest = ev.kv.key[len(self.ks.dispatch) + len(self.id) + 1:]
+            parts = rest.split("/")
+            if len(parts) != 3:
+                continue
+            epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
+            job = self._get_job(group, job_id)
+            self.store.delete(ev.kv.key)  # consume the order
+            if job is None or job.pause:
+                continue
+            self._spawn(job, epoch_s, fenced=True)
+            n += 1
+        return n
+
+    def _poll_once(self) -> int:
+        n = 0
+        for ev in self._w_once.drain():
+            if ev.type == DELETE:
+                continue
+            if ev.kv.value not in ("", self.id):
+                continue
+            rest = ev.kv.key[len(self.ks.once):]
+            if "/" not in rest:
+                continue
+            group, job_id = rest.split("/", 1)
+            job = self._get_job(group, job_id)
+            if job is None:
+                continue
+            # run-now bypasses locks and the parallels gate
+            # (reference job.go:472-482)
+            self._spawn(job, int(self.clock()), fenced=False, use_gate=False)
+            n += 1
+        return n
+
+    def _spawn(self, job: Job, epoch_s: int, fenced: bool,
+               use_gate: bool = True):
+        t = threading.Thread(
+            target=self._execute, args=(job, epoch_s, fenced, use_gate),
+            daemon=True, name=f"exec-{job.id}-{epoch_s}")
+        self.running[t.name] = t
+        t.start()
+
+    def join_running(self, timeout: float = 10.0):
+        for name, t in list(self.running.items()):
+            t.join(timeout=timeout)
+            if not t.is_alive():
+                self.running.pop(name, None)
+
+    # ---- background loop -------------------------------------------------
+
+    def start(self):
+        self.register()
+
+        def keepalive_loop():
+            while not self._stop.wait(max(1.0, self.ttl / 3)):
+                self.keepalive_once()
+
+        def poll_loop():
+            while not self._stop.is_set():
+                self.poll()
+                time.sleep(0.05)
+
+        for fn in (keepalive_loop, poll_loop):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"agent-{fn.__name__}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=3)
+        self._threads.clear()
+        self.join_running()
+        self.unregister()
+
+
+def _local_id() -> str:
+    """Node identity: first non-loopback IPv4, like the reference
+    (utils/local_ip.go:10-31); falls back to hostname."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return socket.gethostname()

@@ -87,11 +87,19 @@ def _steps(impl: str):
 
 def _rank_within_choice(key: jax.Array):
     """Stable sort by key; returns (rank within equal keys, sort order,
-    sorted keys, segment-start positions)."""
+    sorted keys, segment-start positions).
+
+    Segment starts come from a cummax over change points — one sort total
+    per round (searchsorted would be a second O(K log K) pass; sorts are
+    the TPU-expensive step here)."""
+    K = key.shape[0]
     order = jnp.argsort(key, stable=True)
     sorted_key = key[order]
-    first = jnp.searchsorted(sorted_key, sorted_key, side="left")
-    rank = jnp.arange(key.shape[0], dtype=jnp.int32) - first.astype(jnp.int32)
+    pos = jnp.arange(K, dtype=jnp.int32)
+    is_first = jnp.concatenate([jnp.ones(1, bool),
+                                sorted_key[1:] != sorted_key[:-1]])
+    first = jax.lax.cummax(jnp.where(is_first, pos, 0))
+    rank = pos - first
     return rank, order, sorted_key, first
 
 
@@ -120,30 +128,46 @@ def _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
         load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
         best, choice = bid(elig_packed, load_eff)
         cand = need0 & (assigned < 0) & jnp.isfinite(best)
-        key = jnp.where(cand, choice, n_padded)
-        rank, order, sorted_key, first = _rank_within_choice(key)
-        safe_key = jnp.clip(sorted_key, 0, n_padded - 1)
-        cap_at = rem_cap[safe_key]
-
-        # Waterfill quota (see module docstring): accept per node only up to
-        # the target level; rank 0 always lands; final round caps only.
-        w = jnp.where(cand, cost, 0.0)
-        open_n = rem_cap > 0
-        n_open = jnp.maximum(jnp.sum(open_n), 1)
-        level = (jnp.sum(jnp.where(open_n, load, 0.0)) + jnp.sum(w)) / n_open
-        w_sorted = w[order]
-        cum_excl = jnp.cumsum(w_sorted) - w_sorted
-        cum_in_seg = cum_excl - cum_excl[first]
-        headroom = level - load[safe_key]
-        fits = (rank == 0) | (cum_in_seg + w_sorted <= headroom)
-        is_final = r == rounds - 1
-        accept_sorted = (sorted_key < n_padded) & (rank < cap_at) & (is_final | fits)
-        accept = jnp.zeros(K, dtype=bool).at[order].set(accept_sorted)
+        accept, load, rem_cap = waterfill_accept(
+            cand, choice, cost, load, rem_cap, r == rounds - 1)
         assigned = jnp.where(accept, choice, assigned)
-        load = load.at[choice].add(jnp.where(accept, cost, 0.0))
-        rem_cap = rem_cap.at[choice].add(-accept.astype(jnp.int32))
 
     return assigned, load[:n_nodes], rem_cap[:n_nodes]
+
+
+def waterfill_accept(cand, choice, cost, load, rem_cap, is_final):
+    """One accept step: ration candidate bids per node.
+
+    Accept per node only up to remaining capacity AND (unless final) a
+    waterfill quota — the global target load level — so a min-load node is
+    never dogpiled; rank 0 always lands (progress guarantee).
+
+    Pure function of replicated state: the multichip path runs it
+    identically on every shard after all-gathering the candidate bids.
+
+    Returns (accept [K] bool, new load [N'], new rem_cap [N']).
+    """
+    K = cand.shape[0]
+    n_padded = load.shape[0]
+    key = jnp.where(cand, choice, n_padded)
+    rank, order, sorted_key, first = _rank_within_choice(key)
+    safe_key = jnp.clip(sorted_key, 0, n_padded - 1)
+    cap_at = rem_cap[safe_key]
+
+    w = jnp.where(cand, cost, 0.0)
+    open_n = rem_cap > 0
+    n_open = jnp.maximum(jnp.sum(open_n), 1)
+    level = (jnp.sum(jnp.where(open_n, load, 0.0)) + jnp.sum(w)) / n_open
+    w_sorted = w[order]
+    cum_excl = jnp.cumsum(w_sorted) - w_sorted
+    cum_in_seg = cum_excl - cum_excl[first]
+    headroom = level - load[safe_key]
+    fits = (rank == 0) | (cum_in_seg + w_sorted <= headroom)
+    accept_sorted = (sorted_key < n_padded) & (rank < cap_at) & (is_final | fits)
+    accept = jnp.zeros(K, dtype=bool).at[order].set(accept_sorted)
+    load = load.at[choice].add(jnp.where(accept, cost, 0.0))
+    rem_cap = rem_cap.at[choice].add(-accept.astype(jnp.int32))
+    return accept, load, rem_cap
 
 
 def assign(fire: jax.Array, elig_packed: jax.Array, exclusive: jax.Array,

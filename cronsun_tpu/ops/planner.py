@@ -58,17 +58,10 @@ def _bucket_assign(idx, valid, elig_packed, exclusive, cost, load, rem_cap,
                   rounds=rounds, impl=impl)
 
 
-@partial(jax.jit, static_argnames=("k", "rounds", "impl"),
-         donate_argnames=("load", "rem_cap"))
-def _plan_step(table: ScheduleTable, fields, elig, exclusive, cost, load,
-               rem_cap, k: int, rounds: int, impl: str):
-    """One fused tick: fire -> compact -> solve -> pack.
-
-    ``fields`` is a single [7] int32 upload (sec,min,hour,dom,month,dow,
-    t_rel) — one host->device transfer per tick.  The result is packed as
-    [3, k] int32 (fired idx / total at [1,0] / assignment) so the host needs
-    exactly one device->host transfer.
-    """
+def _tick_body(table, fields, elig, exclusive, cost, load, rem_cap,
+               k: int, rounds: int, impl: str):
+    """One second: fire -> compact -> solve -> pack [3, k] int32
+    (fired idx / total at [1,0] / assignment)."""
     from .tick import _fire_mask_jit
     f = [fields[i:i + 1] for i in range(7)]
     fire = _fire_mask_jit(table, *f)[:, 0]
@@ -78,6 +71,26 @@ def _plan_step(table: ScheduleTable, fields, elig, exclusive, cost, load,
     total_row = jnp.zeros_like(idx).at[0].set(total)
     packed_out = jnp.stack([idx, total_row, assigned_k], axis=0)
     return packed_out, load, rem_cap
+
+
+@partial(jax.jit, static_argnames=("k", "rounds", "impl"),
+         donate_argnames=("load", "rem_cap"))
+def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
+                      load, rem_cap, k: int, rounds: int, impl: str):
+    """W seconds in one dispatch: lax.scan over the window, exactly the
+    semantics of W consecutive single ticks (load/capacity carry through),
+    but one dispatch + one [W, 3, k] fetch — the host round-trip amortizes
+    over the window.  This is how the production loop plans ahead of
+    wall-clock (window [t+1, t+W] is solved while t executes)."""
+    def body(carry, fvec):
+        load, rem_cap = carry
+        out, load, rem_cap = _tick_body(
+            table, fvec, elig, exclusive, cost, load, rem_cap,
+            k, rounds, impl)
+        return (load, rem_cap), out
+
+    (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fields_w)
+    return outs, load, rem_cap
 
 
 @dataclasses.dataclass
@@ -119,8 +132,13 @@ class TickPlanner:
         self.load = jnp.zeros(self.N, jnp.float32)
         self.rem_cap = jnp.zeros(self.N, jnp.int32)   # dead columns stay 0
         # Adaptive fired-bucket: sized from the last observed fire count so
-        # quiet tables don't pay the max-SLA solve.  Starts at max.
+        # quiet tables don't pay the max-SLA solve.  Starts at max.  Shrinks
+        # only after a long streak of small ticks (hysteresis — every bucket
+        # change recompiles the plan step, ~20s on TPU).
         self._last_total = max_fire_bucket
+        self._cur_k = 0
+        self._shrink_streak = 0
+        self._ticks_pending = 0
 
     # -- state maintenance (all fixed-shape scatters) ----------------------
 
@@ -160,53 +178,96 @@ class TickPlanner:
     def decay_load(self, factor: float = 0.99):
         self.load = self.load * factor
 
+    def _bucket(self, sla_bucket: Optional[int]) -> int:
+        """Adaptive fired-bucket size: ~1.3x headroom over the last observed
+        fire count (overflowed ticks bounce back to the max SLA because
+        ``_last_total`` reports the true total, not the truncated bucket).
+        Grows immediately; shrinks only after 300 consecutive smaller ticks
+        (seconds of planned time, regardless of window size), so the bucket
+        (and the compiled plan step) doesn't flap."""
+        if sla_bucket is not None:
+            return min(_next_pow2(min(sla_bucket, self.max_fire_bucket)),
+                       self.J)
+        ticks = max(1, self._ticks_pending)
+        self._ticks_pending = 0
+        want = max(2048, self._last_total + (self._last_total >> 2)
+                   + (self._last_total >> 4))
+        want = min(_next_pow2(min(want, self.max_fire_bucket)), self.J)
+        if not self._cur_k or want > self._cur_k:
+            self._cur_k = want
+            self._shrink_streak = 0
+        elif want < self._cur_k:
+            self._shrink_streak += ticks
+            if self._shrink_streak >= 300:
+                self._cur_k = want
+                self._shrink_streak = 0
+        else:
+            self._shrink_streak = 0
+        return self._cur_k
+
+    def _impl(self, k: int) -> str:
+        if self.impl != "auto":
+            return self.impl
+        return ("pallas" if jax.default_backend() == "tpu" and k % 256 == 0
+                else "jnp")
+
     # -- the tick ----------------------------------------------------------
 
     def plan_async(self, epoch_s: int, sla_bucket: Optional[int] = None):
-        """Dispatch one tick; return (epoch_s, k, device [3,k] result).
-
-        Does not synchronize — callers can pipeline several ticks and
-        materialize with :meth:`gather`.  ``plan`` is the sync convenience.
-        """
-        from .schedule_table import FRAMEWORK_EPOCH
-        from .timecal import window_fields
-        if sla_bucket is None:
-            # Headroom factor 2 over the last tick's count; overflowed ticks
-            # bounce back up to the max SLA immediately.
-            k = max(2048, 2 * self._last_total)
-        else:
-            k = sla_bucket
-        k = min(_next_pow2(min(k, self.max_fire_bucket)), self.J)
-        impl = self.impl
-        if impl == "auto":
-            impl = ("pallas" if jax.default_backend() == "tpu"
-                    and k % 256 == 0 else "jnp")
-        f = window_fields(epoch_s, 1, tz=self.tz)
-        fields = np.empty(7, np.int32)
-        fields[0] = f["sec"][0]; fields[1] = f["min"][0]
-        fields[2] = f["hour"][0]; fields[3] = f["dom"][0]
-        fields[4] = f["month"][0]; fields[5] = f["dow"][0]
-        fields[6] = epoch_s - FRAMEWORK_EPOCH
-        packed_out, self.load, self.rem_cap = _plan_step(
-            self.table, jnp.asarray(fields),
-            self.elig, self.exclusive, self.cost, self.load, self.rem_cap,
-            k, self.rounds, impl)
-        return epoch_s, k, packed_out
+        """Dispatch one tick (a one-second window).  Does not synchronize —
+        callers can pipeline several ticks and materialize with
+        :meth:`gather`.  ``plan`` is the sync convenience."""
+        return self.plan_window_async(epoch_s, 1, sla_bucket)
 
     def gather(self, handle) -> TickPlan:
         """Materialize a plan_async result (the single host transfer)."""
-        epoch_s, k, packed_out = handle
-        out = np.asarray(packed_out)
-        total_h = int(out[1, 0])
-        self._last_total = total_h
-        n_valid = min(total_h, k)
-        return TickPlan(
-            epoch_s=epoch_s,
-            fired=out[0, :n_valid],
-            assigned=out[2, :n_valid],
-            overflow=max(0, total_h - k),
-        )
+        return self.gather_window(handle)[0]
 
     def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
         """Fire + place every job due at ``epoch_s`` (one-second tick)."""
         return self.gather(self.plan_async(epoch_s, sla_bucket))
+
+    # -- windowed planning -------------------------------------------------
+
+    def plan_window_async(self, epoch_s: int, window_s: int,
+                          sla_bucket: Optional[int] = None):
+        """Dispatch one window of ``window_s`` consecutive seconds."""
+        from .schedule_table import FRAMEWORK_EPOCH
+        from .timecal import window_fields
+        k = self._bucket(sla_bucket)
+        impl = self._impl(k)
+        f = window_fields(epoch_s, window_s, tz=self.tz)
+        fields_w = np.stack([
+            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+            np.arange(window_s, dtype=np.int64) + (epoch_s - FRAMEWORK_EPOCH),
+        ], axis=1).astype(np.int32)                     # [W, 7]
+        outs, self.load, self.rem_cap = _plan_window_step(
+            self.table, jnp.asarray(fields_w),
+            self.elig, self.exclusive, self.cost, self.load, self.rem_cap,
+            k, self.rounds, impl)
+        return epoch_s, k, outs
+
+    def gather_window(self, handle):
+        """Materialize a window dispatch into a list of TickPlans."""
+        epoch_s, k, outs = handle
+        o = np.asarray(outs)                            # [W, 3, k]
+        plans = []
+        for w in range(o.shape[0]):
+            total_h = int(o[w, 1, 0])
+            n_valid = min(total_h, k)
+            plans.append(TickPlan(
+                epoch_s=epoch_s + w,
+                fired=o[w, 0, :n_valid],
+                assigned=o[w, 2, :n_valid],
+                overflow=max(0, total_h - k)))
+        if o.shape[0]:
+            # adaptive bucket sizing tracks the window's worst second; the
+            # shrink hysteresis counts *ticks*, not calls
+            self._last_total = int(o[:, 1, 0].max())
+            self._ticks_pending += o.shape[0]
+        return plans
+
+    def plan_window(self, epoch_s: int, window_s: int,
+                    sla_bucket: Optional[int] = None):
+        return self.gather_window(
+            self.plan_window_async(epoch_s, window_s, sla_bucket))

@@ -148,12 +148,18 @@ def _minute_scan_jit(t: ScheduleTable, mnt, hour, dom, month, dow, m_rel):
 
 def next_fire(table: ScheduleTable, after_epoch_s: int, tz=_UTC,
               horizon_s: int = FIVE_YEARS_S,
-              chunk_minutes: int = 4096) -> np.ndarray:
+              chunk_minutes: Optional[int] = None) -> np.ndarray:
     """Batched Schedule.Next: for every job, the first fire instant strictly
     after ``after_epoch_s``.  Returns [J] int64 epoch seconds; -1 where no
     fire occurs within ``horizon_s`` (the reference's zero time).
+
+    ``chunk_minutes`` defaults to an element budget: wide chunks for small
+    tables (fewer host round-trips on sparse schedules), narrow for huge
+    ones (bounded [J, W] intermediate).
     """
     J = table.capacity
+    if chunk_minutes is None:
+        chunk_minutes = max(1024, min(16384, (1 << 28) // max(J, 1)))
     result = np.full(J, -1, dtype=np.int64)
     active = np.asarray(table.active & ~table.paused)
     unresolved = active.copy()

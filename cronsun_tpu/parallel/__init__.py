@@ -1,0 +1,9 @@
+"""Multi-chip scaling: SPMD tick+assign over a jax.sharding.Mesh.
+
+The jobs axis shards (schedule table + eligibility matrix are the big
+arrays); node load/capacity vectors stay replicated.  Bid rounds exchange
+only the compacted per-shard candidate buckets over ICI (`all_gather`), so
+inter-chip traffic per tick is O(fired bucket), not O(jobs).
+"""
+
+from .mesh import ShardedTickPlanner, make_mesh  # noqa: F401

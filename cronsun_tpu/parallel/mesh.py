@@ -1,0 +1,186 @@
+"""SPMD tick+assign over a device mesh (shard_map + XLA collectives).
+
+Sharding layout (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+- mesh: 1-D ``("jobs",)`` — jobs are the big axis (1M rows x ~1.3 KB of
+  schedule+eligibility state each); each device owns J/D rows.
+- replicated: node load/capacity vectors ([N] — tiny), time fields.
+- per tick, each shard: local fire_mask -> local compact (K/D bucket) ->
+  local pallas bid.  Then ONE ``all_gather`` of the compacted candidate bids
+  (choice/cost/flags, O(K) bytes — rides ICI) and every shard runs the
+  *identical* waterfill accept on the gathered bucket, keeping load/rem_cap
+  replicated without a reduce.  D-1 more bid rounds repeat the exchange.
+- result: each shard scatters its slice of the accept verdicts back to its
+  local bucket; outputs concatenate along the bucket axis.
+
+Inter-chip traffic per tick is O(fired-bucket), independent of J — the
+design scales to multi-host DCN the same way (the gather payload is a few
+hundred KB).
+
+The reference has no analogue (every Go node redundantly runs the full cron
+loop, node/cron/cron.go:210-275); this module is the scale-out story that
+replaces "replicate all state on every node".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.assign import _steps, waterfill_accept
+from ..ops.planner import TickPlan, _compact, _next_pow2
+from ..ops.schedule_table import FRAMEWORK_EPOCH, ScheduleTable
+from ..ops.tick import _fire_mask_jit
+from ..ops.timecal import window_fields
+
+AXIS = "jobs"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
+                       k_local: int, rounds: int, impl: str):
+    """Runs per-shard inside shard_map.  All [J/D]-shaped inputs are the
+    local shard; load/rem_cap are replicated."""
+    bid, fanout = _steps(impl)
+    d = jax.lax.axis_index(AXIS)
+    j_local = elig.shape[0]
+
+    f = [fields[i:i + 1] for i in range(7)]
+    fire = _fire_mask_jit(table, *f)[:, 0]
+    idx, valid, total = _compact(fire, k_local)
+    packed_k = elig[idx]
+    excl_k = exclusive[idx]
+    cost_k = cost[idx].astype(jnp.float32)
+
+    # Common fan-out: local partial load, summed across shards.
+    common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
+    load = load + jax.lax.psum(fanout(packed_k, common_w), AXIS)
+
+    need0 = valid & excl_k
+    assigned = jnp.full(k_local, -1, dtype=jnp.int32)
+    for r in range(rounds):
+        load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
+        best, choice = bid(packed_k, load_eff)
+        cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
+        # Exchange compacted bids; every shard sees the same global bucket.
+        cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
+        choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
+        cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
+        accept_g, load, rem_cap = waterfill_accept(
+            cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
+        accept_l = jax.lax.dynamic_slice(accept_g, (d * k_local,), (k_local,))
+        assigned = jnp.where(accept_l, choice, assigned)
+
+    idx_global = jnp.where(jnp.arange(k_local) < total,
+                           d * j_local + idx, -1).astype(jnp.int32)
+    total_row = jnp.zeros_like(idx).at[0].set(total)
+    out = jnp.stack([idx_global, total_row, assigned], axis=0)  # [3, k_local]
+    return out, load, rem_cap
+
+
+class ShardedTickPlanner:
+    """TickPlanner over a jobs-sharded mesh.  Same contract as
+    ops.planner.TickPlanner; state arrays live sharded across devices."""
+
+    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
+                 rounds: int = 3, impl: str = "auto",
+                 max_fire_bucket: int = 65536, tz=None):
+        import datetime
+        self.mesh = mesh
+        self.tz = tz or datetime.timezone.utc
+        self.rounds = rounds
+        self.D = mesh.devices.size
+        self.impl = impl
+        self.J = _next_pow2(max(job_capacity, self.D * 256))
+        if self.J % self.D:
+            raise ValueError("job capacity must shard evenly")
+        self.N = ((node_capacity + 31) // 32) * 32
+        self.max_fire_bucket = max_fire_bucket
+        self._shard = NamedSharding(mesh, P(AXIS))
+        self._shard2 = NamedSharding(mesh, P(AXIS, None))
+        self._repl = NamedSharding(mesh, P())
+
+        from ..ops.schedule_table import build_table
+        self.table = build_table([], capacity=self.J, sharding=self._shard)
+        self.elig = jax.device_put(
+            np.zeros((self.J, self.N // 32), np.uint32), self._shard2)
+        self.exclusive = jax.device_put(np.zeros(self.J, bool), self._shard)
+        self.cost = jax.device_put(np.ones(self.J, np.float32), self._shard)
+        self.load = jax.device_put(np.zeros(self.N, np.float32), self._repl)
+        self.rem_cap = jax.device_put(np.zeros(self.N, np.int32), self._repl)
+        self._step_cache = {}
+
+    def _step(self, k_local: int, impl: str):
+        key = (k_local, impl)
+        if key not in self._step_cache:
+            from jax import shard_map
+            body = partial(_sharded_plan_body, k_local=k_local,
+                           rounds=self.rounds, impl=impl)
+            sm = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(AXIS), P(), P(AXIS, None), P(AXIS), P(AXIS),
+                          P(), P()),
+                out_specs=(P(None, AXIS), P(), P()),
+                check_vma=False)
+            self._step_cache[key] = jax.jit(sm)
+        return self._step_cache[key]
+
+    # -- state maintenance -------------------------------------------------
+
+    def set_table(self, table: ScheduleTable):
+        if table.capacity != self.J:
+            raise ValueError(f"table capacity {table.capacity} != {self.J}")
+        self.table = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._shard), table)
+
+    def set_eligibility(self, matrix: np.ndarray):
+        self.elig = jax.device_put(matrix, self._shard2)
+
+    def set_job_meta_full(self, exclusive: np.ndarray, cost: np.ndarray):
+        self.exclusive = jax.device_put(exclusive, self._shard)
+        self.cost = jax.device_put(cost.astype(np.float32), self._shard)
+
+    def set_node_capacity_full(self, caps: np.ndarray):
+        self.rem_cap = jax.device_put(caps.astype(np.int32), self._repl)
+
+    # -- tick --------------------------------------------------------------
+
+    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.D)
+        impl = self.impl
+        if impl == "auto":
+            impl = ("pallas" if jax.default_backend() == "tpu"
+                    and k_local % 256 == 0 else "jnp")
+        f = window_fields(epoch_s, 1, tz=self.tz)
+        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
+                           f["dom"][0], f["month"][0], f["dow"][0],
+                           epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
+        out, self.load, self.rem_cap = self._step(k_local, impl)(
+            self.table, jax.device_put(fields, self._repl), self.elig,
+            self.exclusive, self.cost, self.load, self.rem_cap)
+        o = np.asarray(out)              # [3, D*k_local]
+        totals = o[1, 0::k_local]
+        total = int(totals.sum())
+        fired, assigned = [], []
+        for s in range(self.D):
+            t_s = int(o[1, s * k_local])
+            n_s = min(t_s, k_local)
+            fired.append(o[0, s * k_local:s * k_local + n_s])
+            assigned.append(o[2, s * k_local:s * k_local + n_s])
+        fired = np.concatenate(fired)
+        assigned = np.concatenate(assigned)
+        return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
+                        overflow=max(0, total - len(fired)))

@@ -1,0 +1,397 @@
+"""The leader scheduler: store watches -> planner deltas -> dispatches.
+
+Data flow per cycle (:meth:`step`):
+
+1. drain cmd/group/node watch events into host mirrors (row allocator,
+   EligibilityBuilder, schedule-row updates) — the analogue of the
+   reference's watchJobs/watchGroups delta handlers (node/node.go:361-421),
+   but feeding ONE device table instead of N in-process cron loops;
+2. reconcile node capacity/load from the proc registry (crash-safe: derived
+   from leased keys, so dead executions age out);
+3. push dirty rows to the device (fixed-shape scatters);
+4. plan the next window of seconds on device;
+5. write one leased dispatch key per (node, second, job) execution order —
+   exclusive jobs to their assigned node, Common jobs fanned out to every
+   eligible node (reference job kinds, job.go:30-34).
+
+Leadership: create-if-absent on the leader key under a lease
+(client.go:95-109 pattern).  Standby instances keep retrying; on leader
+death the lease expires and a standby takes over within ``lease_ttl``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..core import Group, Job, Keyspace
+from ..cron.parser import ParseError, parse
+from ..ops.eligibility import EligibilityBuilder, NodeUniverse
+from ..ops.planner import TickPlanner
+from ..ops.schedule_table import make_row, update_rows, _INACTIVE_ROW
+from ..store.memstore import DELETE, MemStore
+
+
+class _Rows:
+    """Row allocator: (group, job_id, rule_id) -> schedule-table row."""
+
+    def __init__(self, capacity: int):
+        self._free = list(range(capacity - 1, -1, -1))
+        self.by_cmd: Dict[Tuple[str, str, str], int] = {}
+        self.by_row: Dict[int, Tuple[str, str, str]] = {}
+        self.by_job: Dict[Tuple[str, str], Set[str]] = {}
+
+    def acquire(self, group: str, job_id: str, rule_id: str) -> int:
+        key = (group, job_id, rule_id)
+        row = self.by_cmd.get(key)
+        if row is None:
+            if not self._free:
+                raise RuntimeError("job row capacity exhausted")
+            row = self._free.pop()
+            self.by_cmd[key] = row
+            self.by_row[row] = key
+            self.by_job.setdefault((group, job_id), set()).add(rule_id)
+        return row
+
+    def release_rule(self, group: str, job_id: str, rule_id: str) -> Optional[int]:
+        row = self.by_cmd.pop((group, job_id, rule_id), None)
+        if row is not None:
+            self._free.append(row)
+            self.by_row.pop(row, None)
+            rules = self.by_job.get((group, job_id))
+            if rules:
+                rules.discard(rule_id)
+                if not rules:
+                    del self.by_job[(group, job_id)]
+        return row
+
+    def rules_of(self, group: str, job_id: str) -> Set[str]:
+        return set(self.by_job.get((group, job_id), ()))
+
+
+class SchedulerService:
+    def __init__(self, store: MemStore, ks: Optional[Keyspace] = None,
+                 job_capacity: int = 4096, node_capacity: int = 256,
+                 window_s: int = 4, lease_ttl: float = 10.0,
+                 dispatch_ttl: float = 300.0,
+                 default_node_cap: int = 1 << 20,
+                 node_id: str = "scheduler-1",
+                 planner: Optional[TickPlanner] = None,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.ks = ks or Keyspace()
+        self.clock = clock
+        self.window_s = window_s
+        self.lease_ttl = lease_ttl
+        self.dispatch_ttl = dispatch_ttl
+        self.default_node_cap = default_node_cap
+        self.node_id = node_id
+
+        self.planner = planner or TickPlanner(
+            job_capacity=job_capacity, node_capacity=node_capacity,
+            max_fire_bucket=min(65536, job_capacity))
+        self.universe = NodeUniverse(self.planner.N)
+        self.builder = EligibilityBuilder(self.universe, self.planner.J)
+        self.rows = _Rows(self.planner.J)
+        self.jobs: Dict[Tuple[str, str], Job] = {}
+        self.groups: Dict[str, Group] = {}
+        self.node_caps: Dict[str, int] = {}
+
+        self._table_updates: Dict[int, dict] = {}
+        self._meta_updates: Dict[int, Tuple[bool, float]] = {}
+
+        self._w_jobs = store.watch(self.ks.cmd)
+        self._w_groups = store.watch(self.ks.group)
+        self._w_nodes = store.watch(self.ks.node)
+
+        self._leader_lease: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_epoch: Optional[int] = None
+        self.max_catchup_s = 120
+        self.stats = {"overflow_drops": 0, "skipped_seconds": 0}
+
+        self._load_initial()
+
+    # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
+
+    def _load_initial(self):
+        for kv in self.store.get_prefix(self.ks.group):
+            self._apply_group(kv.value)
+        for kv in self.store.get_prefix(self.ks.node):
+            self._node_up(kv.key[len(self.ks.node):])
+        for kv in self.store.get_prefix(self.ks.cmd):
+            self._apply_job(kv.key, kv.value)
+        self._flush_device()
+
+    # ---- leadership ------------------------------------------------------
+
+    def try_lead(self) -> bool:
+        if self._leader_lease is not None:
+            if self.store.keepalive(self._leader_lease):
+                return True
+            self._leader_lease = None
+        lease = self.store.grant(self.lease_ttl)
+        if self.store.put_if_absent(self.ks.leader, self.node_id, lease=lease):
+            self._leader_lease = lease
+            return True
+        self.store.revoke(lease)
+        return False
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader_lease is not None
+
+    # ---- watch delta handlers -------------------------------------------
+
+    def _apply_job(self, key: str, value: str):
+        rest = key[len(self.ks.cmd):]
+        if "/" not in rest:
+            return
+        group, job_id = rest.split("/", 1)
+        try:
+            job = Job.from_json(value)
+        except (json.JSONDecodeError, TypeError):
+            return
+        job.group, job.id = group, job_id
+        old_rules = self.rows.rules_of(group, job_id)
+        new_rules = set()
+        self.jobs[(group, job_id)] = job
+        for rule in job.rules:
+            try:
+                spec = parse(rule.timer)
+            except ParseError:
+                continue
+            new_rules.add(rule.id)
+            row = self.rows.acquire(group, job_id, rule.id)
+            self._table_updates[row] = make_row(
+                spec, phase_epoch_s=int(self.clock()), paused=job.pause)
+            self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
+            self._meta_updates[row] = (job.exclusive,
+                                       job.avg_time if job.avg_time > 0 else 1.0)
+        for rule_id in old_rules - new_rules:
+            self._drop_rule(group, job_id, rule_id)
+
+    def _drop_rule(self, group: str, job_id: str, rule_id: str):
+        row = self.rows.release_rule(group, job_id, rule_id)
+        if row is not None:
+            self._table_updates[row] = dict(_INACTIVE_ROW)
+            self.builder.del_job(row)
+            self._meta_updates.pop(row, None)
+
+    def _drop_job(self, group: str, job_id: str):
+        for rule_id in self.rows.rules_of(group, job_id):
+            self._drop_rule(group, job_id, rule_id)
+        self.jobs.pop((group, job_id), None)
+
+    def _apply_group(self, value: str):
+        try:
+            g = Group.from_json(value)
+        except (json.JSONDecodeError, TypeError):
+            return
+        self.groups[g.id] = g
+        self.builder.set_group(g.id, g.node_ids)
+
+    def _drop_group(self, gid: str):
+        self.groups.pop(gid, None)
+        self.builder.del_group(gid)
+
+    def _node_up(self, node_id: str):
+        if node_id in self.universe.index:
+            return
+        self.builder.node_added(node_id)
+        for g in self.groups.values():         # re-derive group masks
+            if node_id in g.node_ids:
+                self.builder.set_group(g.id, g.node_ids)
+        col = self.universe.index[node_id]
+        cap = self.node_caps.get(node_id, self.default_node_cap)
+        self.planner.set_node_capacity([col], [cap])
+
+    def _node_down(self, node_id: str):
+        col = self.universe.index.get(node_id)
+        if col is None:
+            return
+        self.builder.node_removed(node_id)
+        self.planner.set_node_capacity([col], [0])
+
+    def drain_watches(self):
+        for ev in self._w_groups.drain():
+            gid = ev.kv.key[len(self.ks.group):]
+            if ev.type == DELETE:
+                self._drop_group(gid)
+            else:
+                self._apply_group(ev.kv.value)
+        for ev in self._w_nodes.drain():
+            node_id = ev.kv.key[len(self.ks.node):]
+            if ev.type == DELETE:
+                self._node_down(node_id)
+            else:
+                self._node_up(node_id)
+        for ev in self._w_jobs.drain():
+            if ev.type == DELETE:
+                rest = ev.kv.key[len(self.ks.cmd):]
+                if "/" in rest:
+                    group, job_id = rest.split("/", 1)
+                    self._drop_job(group, job_id)
+            else:
+                self._apply_job(ev.kv.key, ev.kv.value)
+
+    def _flush_device(self):
+        if self._table_updates:
+            rows = np.array(sorted(self._table_updates), dtype=np.int32)
+            vals = [self._table_updates[int(r)] for r in rows]
+            self.planner.set_table(update_rows(self.planner.table, rows, vals))
+            self._table_updates.clear()
+        dirty, mat = self.builder.dirty_rows()
+        if len(dirty):
+            self.planner.set_eligibility_rows(dirty, mat)
+        if self._meta_updates:
+            rows = np.array(sorted(self._meta_updates), dtype=np.int32)
+            excl = np.array([self._meta_updates[int(r)][0] for r in rows])
+            cost = np.array([self._meta_updates[int(r)][1] for r in rows],
+                            dtype=np.float32)
+            self.planner.set_job_meta(rows, excl, cost)
+            self._meta_updates.clear()
+
+    # ---- capacity reconciliation ----------------------------------------
+
+    def reconcile_capacity(self):
+        """Derive per-node running load from the (leased) proc registry.
+        Crash-safe by construction: procs of dead nodes expire with their
+        lease (reference proc.go:21-35 ProcTtl)."""
+        running_excl: Dict[str, int] = {}
+        running_load: Dict[str, float] = {}
+        for kv in self.store.get_prefix(self.ks.proc):
+            rest = kv.key[len(self.ks.proc):].split("/")
+            if len(rest) != 4:
+                continue
+            node_id, group, job_id, _pid = rest
+            job = self.jobs.get((group, job_id))
+            cost = (job.avg_time if job and job.avg_time > 0 else 1.0)
+            running_load[node_id] = running_load.get(node_id, 0.0) + cost
+            if job and job.exclusive:
+                running_excl[node_id] = running_excl.get(node_id, 0) + 1
+        cols, caps = [], []
+        loads = np.zeros(self.planner.N, np.float32)
+        for node_id, col in self.universe.index.items():
+            cap = self.node_caps.get(node_id, self.default_node_cap)
+            cols.append(col)
+            caps.append(max(0, cap - running_excl.get(node_id, 0)))
+            loads[col] = running_load.get(node_id, 0.0)
+        if cols:
+            self.planner.set_node_capacity(cols, caps)
+        import jax.numpy as jnp
+        self.planner.load = jnp.asarray(loads)
+
+    # ---- planning + dispatch --------------------------------------------
+
+    def step(self, now: Optional[int] = None) -> int:
+        """One full cycle; returns the number of dispatches written.
+
+        If planning fell behind wall-clock (leader failover, a recompile
+        stall), the missed seconds are planned late rather than skipped —
+        the reference fires late too, never never (cron.go:212-215) — up to
+        ``max_catchup_s`` back; anything older is dropped and counted in
+        ``stats['skipped_seconds']``."""
+        now = int(now if now is not None else self.clock())
+        if not self.try_lead():
+            self._next_epoch = None
+            return 0
+        self.drain_watches()
+        self.reconcile_capacity()
+        self._flush_device()
+        start = self._next_epoch
+        if start is None:
+            start = now + 1
+        elif start < now + 1 - self.max_catchup_s:
+            self.stats["skipped_seconds"] += (now + 1 - self.max_catchup_s
+                                              - start)
+            start = now + 1 - self.max_catchup_s
+        window = max(1, self.window_s)
+        plans = self.planner.plan_window(start, window)
+        self._next_epoch = start + window
+        col_to_node = {c: n for n, c in self.universe.index.items()}
+        n_dispatch = 0
+        lease = self.store.grant(self.dispatch_ttl)
+        for plan in plans:
+            if plan.overflow:
+                # fired jobs beyond the bucket SLA were dropped this second;
+                # _last_total already re-escalates the bucket for the next
+                # window, so this is transient — but never silent.
+                self.stats["overflow_drops"] += plan.overflow
+                print(f"[scheduler] WARNING: {plan.overflow} fires over the "
+                      f"bucket SLA dropped at t={plan.epoch_s}", flush=True)
+            for row, node_col in zip(plan.fired.tolist(),
+                                     plan.assigned.tolist()):
+                cmd = self._row_cmd(row)
+                if cmd is None:
+                    continue
+                group, job_id, rule_id = cmd
+                job = self.jobs.get((group, job_id))
+                if job is None:
+                    continue
+                if job.exclusive:
+                    node = col_to_node.get(node_col)
+                    targets = [node] if node else []
+                else:
+                    targets = self._eligible_nodes(row, col_to_node)
+                for node in targets:
+                    self.store.put(
+                        self.ks.dispatch_key(node, plan.epoch_s, group, job_id),
+                        json.dumps({"rule": rule_id, "kind": job.kind},
+                                   separators=(",", ":")),
+                        lease=lease)
+                    n_dispatch += 1
+        return n_dispatch
+
+    def _row_cmd(self, row: int) -> Optional[Tuple[str, str, str]]:
+        return self.rows.by_row.get(row)
+
+    def _eligible_nodes(self, row: int, col_to_node: Dict[int, str]) -> List[str]:
+        bits = self.builder.matrix[row]
+        out = []
+        for word_ix in np.nonzero(bits)[0]:
+            w = int(bits[word_ix])
+            b = 0
+            while w:
+                if w & 1:
+                    node = col_to_node.get(int(word_ix) * 32 + b)
+                    if node:
+                        out.append(node)
+                w >>= 1
+                b += 1
+        return out
+
+    # ---- background loop -------------------------------------------------
+
+    def start(self):
+        if self._thread:
+            return
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    import traceback
+                    traceback.print_exc()
+                # plan ahead: sleep until the window is nearly consumed
+                nxt = (self._next_epoch or 0) - 1.5
+                delay = max(0.2, min(self.window_s, nxt - self.clock()))
+                if self._stop.wait(delay):
+                    return
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="scheduler-loop")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._leader_lease is not None:
+            self.store.revoke(self._leader_lease)
+            self._leader_lease = None

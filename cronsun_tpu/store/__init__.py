@@ -1,0 +1,18 @@
+"""Coordination store: the control plane.
+
+The reference coordinates everything through an etcd v3 keyspace — watch
+streams for pub/sub, leases for liveness/TTL, txns for CAS and locks, prefix
+KV for state (reference client.go, SURVEY.md appendix).  This package keeps
+that architecture but behind a small interface:
+
+- :class:`memstore.MemStore` — a faithful in-process implementation of the
+  semantics the system needs (create/mod revisions, prefix watch with prev-kv,
+  lease expiry, compare-and-swap, create-if-absent locks).  It is both the
+  test harness the reference never had (multi-node scenarios in one process,
+  SURVEY.md §4) and a perfectly good single-host production store.
+- a real etcd can be slotted in behind the same surface for multi-host
+  deployments (adapter not bundled: no etcd client library in this
+  environment).
+"""
+
+from .memstore import Event, KV, Lease, MemStore, Watcher  # noqa: F401

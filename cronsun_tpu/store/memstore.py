@@ -1,0 +1,284 @@
+"""In-memory coordination store with etcd v3 semantics.
+
+Implements exactly the subset the framework (and the reference) relies on:
+
+- revisioned KV: every key carries (create_rev, mod_rev); a global revision
+  counter advances on every mutation (etcd's store revision).
+- prefix gets and prefix watches; watch events carry the previous KV for
+  delete/modify deltas (the reference watches groups WithPrevKV,
+  group.go:64-66).
+- leases: grant(ttl)/keepalive/revoke; keys attached to an expired lease are
+  deleted *with events*, which is how node death detection works
+  (noticer.go:172-200).
+- txns: put-if-absent on create_rev==0 (the distributed lock,
+  client.go:95-109) and put-if-mod-rev CAS (pause toggle / group scrub,
+  client.go:44-65).
+
+Thread-safe; watchers receive events through unbounded queues on the
+mutating thread.  Lease expiry is checked lazily on every operation and by
+an optional sweeper thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+PUT = "PUT"
+DELETE = "DELETE"
+
+
+@dataclasses.dataclass(frozen=True)
+class KV:
+    key: str
+    value: str
+    create_rev: int
+    mod_rev: int
+    lease: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    type: str                 # PUT | DELETE
+    kv: KV
+    prev_kv: Optional[KV]
+
+    @property
+    def is_create(self) -> bool:
+        return self.type == PUT and self.prev_kv is None
+
+    @property
+    def is_modify(self) -> bool:
+        return self.type == PUT and self.prev_kv is not None
+
+
+@dataclasses.dataclass
+class Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: set = dataclasses.field(default_factory=set)
+
+
+class Watcher:
+    """A watch stream over a key prefix."""
+
+    def __init__(self, store: "MemStore", prefix: str, start_rev: int):
+        self._store = store
+        self.prefix = prefix
+        self.start_rev = start_rev
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._closed = False
+
+    def _emit(self, ev: Event):
+        if not self._closed:
+            self._q.put(ev)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event, or None on timeout/close."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def drain(self) -> List[Event]:
+        out = []
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except queue.Empty:
+                return out
+            if ev is not None:
+                out.append(ev)
+
+    def close(self):
+        self._closed = True
+        self._store._remove_watcher(self)
+        self._q.put(None)
+
+    def __iter__(self):
+        while not self._closed:
+            ev = self.get()
+            if ev is None:
+                return
+            yield ev
+
+
+class MemStore:
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.RLock()
+        self._clock = clock
+        self._kv: Dict[str, KV] = {}
+        self._rev = 0
+        self._leases: Dict[int, Lease] = {}
+        self._next_lease = 1
+        self._watchers: List[Watcher] = []
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start_sweeper(self, interval: float = 0.2):
+        if self._sweeper:
+            return
+        def run():
+            while not self._stop.wait(interval):
+                self._expire_leases()
+        self._sweeper = threading.Thread(target=run, daemon=True,
+                                         name="memstore-sweeper")
+        self._sweeper.start()
+
+    def close(self):
+        self._stop.set()
+        with self._lock:
+            for w in list(self._watchers):
+                w.close()
+
+    # ---- KV --------------------------------------------------------------
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        with self._lock:
+            self._expire_leases()
+            return self._put_locked(key, value, lease)
+
+    def _put_locked(self, key: str, value: str, lease: int) -> int:
+        prev = self._kv.get(key)
+        if lease:
+            l = self._leases.get(lease)
+            if l is None:
+                raise KeyError(f"lease {lease} not found")
+            l.keys.add(key)
+        self._rev += 1
+        kv = KV(key, value, prev.create_rev if prev else self._rev,
+                self._rev, lease)
+        self._kv[key] = kv
+        self._notify(Event(PUT, kv, prev))
+        return self._rev
+
+    def get(self, key: str) -> Optional[KV]:
+        with self._lock:
+            self._expire_leases()
+            return self._kv.get(key)
+
+    def get_prefix(self, prefix: str) -> List[KV]:
+        with self._lock:
+            self._expire_leases()
+            return sorted((kv for k, kv in self._kv.items()
+                           if k.startswith(prefix)), key=lambda kv: kv.key)
+
+    def count_prefix(self, prefix: str) -> int:
+        with self._lock:
+            self._expire_leases()
+            return sum(1 for k in self._kv if k.startswith(prefix))
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            self._expire_leases()
+            return self._delete_locked(key)
+
+    def _delete_locked(self, key: str) -> bool:
+        prev = self._kv.pop(key, None)
+        if prev is None:
+            return False
+        if prev.lease and prev.lease in self._leases:
+            self._leases[prev.lease].keys.discard(key)
+        self._rev += 1
+        tomb = KV(key, "", prev.create_rev, self._rev, 0)
+        self._notify(Event(DELETE, tomb, prev))
+        return True
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._lock:
+            self._expire_leases()
+            keys = [k for k in self._kv if k.startswith(prefix)]
+            for k in keys:
+                self._delete_locked(k)
+            return len(keys)
+
+    # ---- txns ------------------------------------------------------------
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        """Txn If(create_rev(key)==0) Then(put) — the distributed lock
+        acquire (reference client.go:95-109)."""
+        with self._lock:
+            self._expire_leases()
+            if key in self._kv:
+                return False
+            self._put_locked(key, value, lease)
+            return True
+
+    def put_if_mod_rev(self, key: str, value: str, mod_rev: int,
+                       lease: int = 0) -> bool:
+        """CAS on mod revision (reference client.go:44-65).  mod_rev 0 means
+        'must not exist'."""
+        with self._lock:
+            self._expire_leases()
+            cur = self._kv.get(key)
+            if mod_rev == 0:
+                if cur is not None:
+                    return False
+            elif cur is None or cur.mod_rev != mod_rev:
+                return False
+            self._put_locked(key, value, lease)
+            return True
+
+    # ---- leases ----------------------------------------------------------
+
+    def grant(self, ttl: float) -> int:
+        with self._lock:
+            lid = self._next_lease
+            self._next_lease += 1
+            self._leases[lid] = Lease(lid, ttl, self._clock() + ttl)
+            return lid
+
+    def keepalive(self, lease_id: int) -> bool:
+        with self._lock:
+            self._expire_leases()
+            l = self._leases.get(lease_id)
+            if l is None:
+                return False
+            l.deadline = self._clock() + l.ttl
+            return True
+
+    def revoke(self, lease_id: int) -> bool:
+        with self._lock:
+            l = self._leases.pop(lease_id, None)
+            if l is None:
+                return False
+            for k in sorted(l.keys):
+                self._delete_locked(k)
+            return True
+
+    def lease_ttl_remaining(self, lease_id: int) -> Optional[float]:
+        with self._lock:
+            l = self._leases.get(lease_id)
+            return None if l is None else l.deadline - self._clock()
+
+    def _expire_leases(self):
+        now = self._clock()
+        expired = [l for l in self._leases.values() if l.deadline <= now]
+        for l in expired:
+            del self._leases[l.id]
+            for k in sorted(l.keys):
+                self._delete_locked(k)
+
+    # ---- watch -----------------------------------------------------------
+
+    def watch(self, prefix: str) -> Watcher:
+        with self._lock:
+            w = Watcher(self, prefix, self._rev)
+            self._watchers.append(w)
+            return w
+
+    def _remove_watcher(self, w: Watcher):
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def _notify(self, ev: Event):
+        for w in self._watchers:
+            if ev.kv.key.startswith(w.prefix):
+                w._emit(ev)

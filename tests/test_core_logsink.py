@@ -1,0 +1,168 @@
+"""Domain models + result store."""
+
+import time
+
+import pytest
+
+from cronsun_tpu.core import (
+    Account, Group, Job, JobRule, Keyspace, ValidationError, next_id)
+from cronsun_tpu.core.models import hash_password
+from cronsun_tpu.logsink import JobLogStore, LogRecord
+
+
+# ------------------------------------------------------------------ models
+
+def test_job_check_fills_ids_and_validates():
+    j = Job(name=" backup ", command="tar -czf /tmp/b.tgz /data",
+            rules=[JobRule(timer="0 0 3 * * *")])
+    j.check()
+    assert j.id and j.name == "backup" and j.group == "default"
+    assert j.rules[0].id
+    assert not j.exclusive
+
+
+def test_job_check_rejects_bad_input():
+    with pytest.raises(ValidationError):
+        Job(name="", command="x").check()
+    with pytest.raises(ValidationError):
+        Job(name="a", command="").check()
+    with pytest.raises(ValidationError):
+        Job(name="a", command="x", group="a/b").check()
+    with pytest.raises(ValidationError):
+        Job(name="a", command="x",
+            rules=[JobRule(timer="not a cron")]).check()
+    with pytest.raises(ValidationError):
+        Job(name="a", command="x", timeout=-1).check()
+    with pytest.raises(ValidationError):
+        Job(name="a", command="x", kind=9).check()
+
+
+def test_job_json_roundtrip():
+    j = Job(name="n", command="c", kind=1, retry=2,
+            rules=[JobRule(id="r1", timer="0 * * * * *", gids=["g1"],
+                           nids=["n1"], exclude_nids=["n2"])])
+    j.check()
+    j2 = Job.from_json(j.to_json())
+    assert j2.name == "n" and j2.kind == 1 and j2.exclusive
+    assert j2.rules[0].gids == ["g1"] and j2.rules[0].exclude_nids == ["n2"]
+
+
+def test_job_json_ignores_unknown_fields():
+    j = Job.from_json('{"id":"x","name":"n","command":"c","bogus":1}')
+    assert j.id == "x"
+
+
+def test_avg_time_ewma():
+    j = Job(name="n", command="c")
+    j.update_avg_time(10)
+    assert j.avg_time == 10
+    j.update_avg_time(20)
+    assert j.avg_time == 15
+
+
+def test_group_roundtrip_and_check():
+    g = Group(name="web", node_ids=["a", "b"])
+    g.check()
+    g2 = Group.from_json(g.to_json())
+    assert g2.node_ids == ["a", "b"] and g2.included("a")
+    with pytest.raises(ValidationError):
+        Group(name="").check()
+
+
+def test_account_password():
+    salt = "s4lt"
+    a = Account(email="x@y.z", salt=salt,
+                password=hash_password("secret", salt))
+    assert a.check_password("secret")
+    assert not a.check_password("wrong")
+
+
+def test_keyspace_layout():
+    ks = Keyspace()
+    assert ks.job_key("g", "j") == "/cronsun/cmd/g/j"
+    assert ks.dispatch_key("n1", 123, "g", "j") == "/cronsun/dispatch/n1/123/g/j"
+    assert ks.lock_key("j", 5) == "/cronsun/lock/j/5"
+
+
+def test_next_id_unique():
+    ids = {next_id() for _ in range(100)}
+    assert len(ids) == 100 and all(len(i) == 8 for i in ids)
+
+
+# ----------------------------------------------------------------- logsink
+
+@pytest.fixture
+def sink():
+    return JobLogStore()
+
+
+def _rec(job="j1", node="n1", ok=True, t=1_753_000_000.0):
+    return LogRecord(job_id=job, job_group="g", name="job-" + job, node=node,
+                     user="", command="echo hi", output="hi",
+                     success=ok, begin_ts=t, end_ts=t + 1.5)
+
+
+def test_create_and_query_logs(sink):
+    sink.create_job_log(_rec(ok=True))
+    sink.create_job_log(_rec(ok=False, t=1_753_000_100.0))
+    logs, total = sink.query_logs()
+    assert total == 2 and logs[0].begin_ts > logs[1].begin_ts
+    failed, t2 = sink.query_logs(failed_only=True)
+    assert t2 == 1 and not failed[0].success
+    assert sink.get_log(logs[0].id).job_id == "j1"
+
+
+def test_latest_log_upsert(sink):
+    sink.create_job_log(_rec(t=1_753_000_000.0))
+    sink.create_job_log(_rec(t=1_753_000_100.0))
+    sink.create_job_log(_rec(node="n2", t=1_753_000_050.0))
+    latest, total = sink.query_logs(latest=True)
+    assert total == 2  # one per (job, node)
+    by_node = {l.node: l for l in latest}
+    assert by_node["n1"].begin_ts == 1_753_000_100.0
+
+
+def test_stat_counters(sink):
+    sink.create_job_log(_rec(ok=True))
+    sink.create_job_log(_rec(ok=False))
+    s = sink.stat_overall()
+    assert s == {"total": 2, "successed": 1, "failed": 1}
+    days = sink.stat_days(7)
+    assert len(days) == 1 and days[0]["total"] == 2
+
+
+def test_query_filters(sink):
+    sink.create_job_log(_rec(job="a", node="n1"))
+    sink.create_job_log(_rec(job="b", node="n2"))
+    logs, t = sink.query_logs(node="n2")
+    assert t == 1 and logs[0].job_id == "b"
+    logs, t = sink.query_logs(job_ids=["a"])
+    assert t == 1 and logs[0].job_id == "a"
+    logs, t = sink.query_logs(name_like="job-a")
+    assert t == 1
+    logs, t = sink.query_logs(begin=1_753_000_000.0, end=1_753_000_001.0)
+    assert t == 2
+
+
+def test_pagination(sink):
+    for i in range(25):
+        sink.create_job_log(_rec(t=1_753_000_000.0 + i))
+    logs, total = sink.query_logs(page=2, page_size=10)
+    assert total == 25 and len(logs) == 10
+    assert logs[0].begin_ts == 1_753_000_014.0
+
+
+def test_node_mirror(sink):
+    sink.upsert_node("n1", '{"id":"n1","hostname":"h"}', alived=True)
+    assert sink.get_node("n1")["alived"] is True
+    sink.set_node_alived("n1", False)
+    assert sink.get_node("n1")["alived"] is False
+    assert len(sink.get_nodes()) == 1
+
+
+def test_accounts_crud(sink):
+    sink.upsert_account("a@b.c", '{"email":"a@b.c"}')
+    assert sink.get_account("a@b.c")
+    assert len(sink.list_accounts()) == 1
+    assert sink.delete_account("a@b.c")
+    assert not sink.delete_account("a@b.c")

@@ -1,0 +1,105 @@
+"""Executor: capture, exit codes, timeout, retry, parallels gate."""
+
+import threading
+import time
+
+import pytest
+
+from cronsun_tpu.node.executor import Executor
+
+
+@pytest.fixture
+def ex():
+    return Executor()
+
+
+def test_success_captures_stdout(ex):
+    r = ex.run_once("echo hello world")
+    assert r.success and r.exit_code == 0
+    assert r.output.strip() == "hello world"
+
+
+def test_failure_exit_code(ex):
+    r = ex.run_once("false")
+    assert not r.success and r.exit_code == 1
+    assert "exit status 1" in r.error
+
+
+def test_stderr_combined(ex):
+    r = ex.run_once("sh -c 'echo out; echo err >&2'")
+    assert "out" in r.output and "err" in r.output
+
+
+def test_quoted_arguments(ex):
+    r = ex.run_once("echo 'one two'  three")
+    assert r.output.strip() == "one two three"
+
+
+def test_missing_binary(ex):
+    r = ex.run_once("definitely-not-a-real-binary-xyz")
+    assert not r.success and r.error
+
+
+def test_empty_command(ex):
+    r = ex.run_once("")
+    assert not r.success and "empty command" in r.error
+
+
+def test_unknown_user(ex):
+    r = ex.run_once("echo hi", user="no-such-user-xyz")
+    assert not r.success and "not found" in r.error
+
+
+def test_timeout_kills_process_group(ex):
+    t0 = time.time()
+    r = ex.run_once("sh -c 'sleep 30'", timeout=1)
+    assert time.time() - t0 < 5
+    assert not r.success and "timeout" in r.error
+
+
+def test_output_truncation():
+    ex = Executor(max_output=100)
+    r = ex.run_once("sh -c 'yes x | head -c 10000'")
+    assert len(r.output) < 200 and "[truncated]" in r.output
+
+
+def test_retry_until_success(ex, tmp_path):
+    flag = tmp_path / "flag"
+    cmd = f"sh -c 'test -f {flag} && exit 0 || {{ touch {flag}; exit 1; }}'"
+    r = ex.run_job("j1", cmd, retry=3)
+    assert r.success and r.retries_used == 1
+
+
+def test_retry_exhausted(ex):
+    slept = []
+    r = ex.run_job("j2", "false", retry=2, interval=1,
+                   sleep=lambda s: slept.append(s))
+    assert not r.success and r.retries_used == 2
+    assert slept == [1, 1]
+
+
+def test_parallels_gate_skips(ex):
+    started = threading.Event()
+    release = threading.Event()
+    results = {}
+
+    def long_run():
+        started.set()
+        results["long"] = ex.run_job(
+            "j3", "sh -c 'sleep 2'", parallels=1)
+
+    t = threading.Thread(target=long_run)
+    t.start()
+    started.wait()
+    time.sleep(0.2)  # ensure the gate is held
+    r = ex.run_job("j3", "echo quick", parallels=1)
+    assert r.skipped and not r.success
+    t.join()
+    # gate released afterwards
+    r2 = ex.run_job("j3", "echo again", parallels=1)
+    assert r2.success
+
+
+def test_run_duration_recorded(ex):
+    r = ex.run_once("sh -c 'sleep 0.2'")
+    assert 0.15 <= r.seconds < 2.0

@@ -1,0 +1,176 @@
+"""End-to-end: store + scheduler + agents in one process.
+
+The multi-node test harness the reference never had (SURVEY.md §4): real
+MemStore watches, a real planner on the CPU backend, real subprocess
+executions — only wall-clock is compressed by stepping the scheduler with
+explicit epochs.
+"""
+
+import json
+import time
+
+import pytest
+
+from cronsun_tpu.core import (
+    Group, Job, JobRule, Keyspace, KIND_ALONE, KIND_COMMON)
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.node.agent import NodeAgent
+from cronsun_tpu.sched import SchedulerService
+from cronsun_tpu.store import MemStore
+
+KS = Keyspace()
+
+
+@pytest.fixture
+def world():
+    store = MemStore()
+    sink = JobLogStore()
+    agents = [NodeAgent(store, sink, node_id=f"node-{i}") for i in range(2)]
+    for a in agents:
+        a.register()
+    sched = SchedulerService(store, job_capacity=256, node_capacity=64,
+                             window_s=2)
+    yield store, sink, sched, agents
+    store.close()
+
+
+def put_job(store, job: Job):
+    job.check()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+
+
+def drive(sched, agents, t0, seconds):
+    """Step the scheduler over [t0, t0+seconds), letting agents consume."""
+    t = t0
+    end = t0 + seconds
+    while t < end:
+        sched.step(now=t)
+        for a in agents:
+            a.poll()
+        for a in agents:
+            a.join_running()
+        t = sched._next_epoch  # continue from where planning got to
+    for a in agents:
+        a.poll()
+        a.join_running()
+
+
+def test_common_job_runs_on_all_eligible_nodes(world):
+    store, sink, sched, agents = world
+    job = Job(name="hello", command="echo hi", kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *",
+                             nids=["node-0", "node-1"])])
+    put_job(store, job)
+    t0 = 1_753_000_000
+    drive(sched, agents, t0, 3)
+    logs, total = sink.query_logs(job_ids=[job.id])
+    assert total >= 4  # >= 2 seconds x 2 nodes
+    nodes = {l.node for l in logs}
+    assert nodes == {"node-0", "node-1"}
+    assert all(l.success for l in logs)
+
+
+def test_alone_job_runs_on_exactly_one_node_per_second(world):
+    store, sink, sched, agents = world
+    job = Job(name="solo", command="echo solo", kind=KIND_ALONE,
+              rules=[JobRule(timer="* * * * * *",
+                             nids=["node-0", "node-1"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_100, 4)
+    logs, total = sink.query_logs(job_ids=[job.id])
+    assert total >= 3
+    # exactly-one semantics: every planned second produced ONE execution —
+    # the lock fence keys record each (job, second) that actually ran
+    locks = store.get_prefix(KS.lock + job.id + "/")
+    assert len(locks) == total
+
+
+def test_exclude_nids_subtractive(world):
+    store, sink, sched, agents = world
+    g = Group(id="all", name="all", node_ids=["node-0", "node-1"])
+    store.put(KS.group_key(g.id), g.to_json())
+    job = Job(name="excl", command="echo x", kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *", gids=["all"],
+                             exclude_nids=["node-1"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_200, 3)
+    logs, total = sink.query_logs(job_ids=[job.id])
+    assert total >= 1
+    assert {l.node for l in logs} == {"node-0"}
+
+
+def test_job_delete_stops_firing(world):
+    store, sink, sched, agents = world
+    job = Job(name="gone", command="echo gone", kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_300, 2)
+    _, before = sink.query_logs(job_ids=[job.id])
+    assert before >= 1
+    store.delete(KS.job_key(job.group, job.id))
+    drive(sched, agents, 1_753_000_310, 3)
+    _, after = sink.query_logs(job_ids=[job.id])
+    assert after == before
+
+
+def test_pause_suppresses_firing(world):
+    store, sink, sched, agents = world
+    job = Job(name="paused", command="echo p", pause=True, kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_400, 3)
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 0
+
+
+def test_once_trigger_runs_immediately(world):
+    store, sink, sched, agents = world
+    job = Job(name="manual", command="echo now", kind=KIND_COMMON,
+              rules=[JobRule(timer="0 0 0 1 1 ?", nids=["node-0"])])
+    put_job(store, job)
+    store.put(KS.once_key(job.group, job.id), "node-1")  # explicit target
+    for a in agents:
+        a.poll()
+        a.join_running()
+    logs, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1 and logs[0].node == "node-1"
+
+
+def test_failed_job_posts_notice(world):
+    store, sink, sched, agents = world
+    job = Job(name="failer", command="false", kind=KIND_COMMON,
+              fail_notify=True, to=["ops@example.com"],
+              rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_500, 2)
+    logs, total = sink.query_logs(job_ids=[job.id], failed_only=True)
+    assert total >= 1
+    kv = store.get(KS.noticer_key("node-0"))
+    assert kv is not None
+    msg = json.loads(kv.value)
+    assert "failer" in msg["subject"] and msg["to"] == ["ops@example.com"]
+
+
+def test_node_death_reroutes_exclusive_job(world):
+    store, sink, sched, agents = world
+    job = Job(name="failover", command="echo f", kind=KIND_ALONE,
+              rules=[JobRule(timer="* * * * * *",
+                             nids=["node-0", "node-1"])])
+    put_job(store, job)
+    drive(sched, agents, 1_753_000_600, 2)
+    agents[0].unregister()  # node-0 dies (lease revoked -> DELETE event)
+    drive(sched, agents, 1_753_000_610, 3)
+    logs, _ = sink.query_logs(job_ids=[job.id])
+    late = [l for l in logs if l.begin_ts >= time.time() - 300]
+    # all executions after the death that were dispatched to node-1
+    assert any(l.node == "node-1" for l in logs)
+
+
+def test_leader_election_single_leader(world):
+    store, sink, sched, agents = world
+    sched2 = SchedulerService(store, job_capacity=256, node_capacity=64,
+                              node_id="scheduler-2")
+    assert sched.try_lead()
+    assert not sched2.try_lead()
+    sched.stop()  # releases leadership
+    assert sched2.try_lead()

@@ -1,0 +1,140 @@
+"""MemStore: the etcd-v3 semantics the framework relies on."""
+
+import pytest
+
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.store.memstore import DELETE, PUT
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return MemStore(clock=clock)
+
+
+def test_put_get_revisions(store):
+    r1 = store.put("/a", "1")
+    kv = store.get("/a")
+    assert kv.value == "1" and kv.create_rev == r1 and kv.mod_rev == r1
+    r2 = store.put("/a", "2")
+    kv = store.get("/a")
+    assert kv.value == "2" and kv.create_rev == r1 and kv.mod_rev == r2 > r1
+
+
+def test_prefix_get_sorted(store):
+    store.put("/cmd/g1/j2", "b")
+    store.put("/cmd/g1/j1", "a")
+    store.put("/node/x", "n")
+    kvs = store.get_prefix("/cmd/")
+    assert [kv.key for kv in kvs] == ["/cmd/g1/j1", "/cmd/g1/j2"]
+    assert store.count_prefix("/cmd/") == 2
+
+
+def test_delete_and_tombstone_event(store):
+    w = store.watch("/k")
+    store.put("/k1", "v")
+    assert store.delete("/k1")
+    assert not store.delete("/k1")
+    evs = w.drain()
+    assert [e.type for e in evs] == [PUT, DELETE]
+    assert evs[1].prev_kv.value == "v"
+
+
+def test_watch_prefix_create_modify_delete(store):
+    w = store.watch("/cmd/")
+    store.put("/cmd/a", "1")
+    store.put("/cmd/a", "2")
+    store.put("/other", "x")
+    store.delete("/cmd/a")
+    evs = w.drain()
+    assert len(evs) == 3
+    assert evs[0].is_create and evs[0].kv.value == "1"
+    assert evs[1].is_modify and evs[1].prev_kv.value == "1"
+    assert evs[2].type == DELETE
+    w.close()
+    store.put("/cmd/b", "3")
+    assert w.drain() == []
+
+
+def test_put_if_absent_lock_race(store):
+    assert store.put_if_absent("/lock/j1", "node-a")
+    assert not store.put_if_absent("/lock/j1", "node-b")
+    assert store.get("/lock/j1").value == "node-a"
+    store.delete("/lock/j1")
+    assert store.put_if_absent("/lock/j1", "node-b")
+
+
+def test_cas_put_if_mod_rev(store):
+    r = store.put("/job", "v1")
+    assert not store.put_if_mod_rev("/job", "v2", r + 999)
+    assert store.put_if_mod_rev("/job", "v2", r)
+    assert store.get("/job").value == "v2"
+    # mod_rev 0 == must-not-exist
+    assert not store.put_if_mod_rev("/job", "v3", 0)
+    assert store.put_if_mod_rev("/new", "n", 0)
+
+
+def test_lease_expiry_deletes_keys_with_events(store, clock):
+    w = store.watch("/node/")
+    lid = store.grant(ttl=10)
+    store.put("/node/10.0.0.1", "123", lease=lid)
+    clock.advance(5)
+    assert store.keepalive(lid)
+    clock.advance(8)          # within renewed ttl
+    assert store.get("/node/10.0.0.1") is not None
+    clock.advance(3)          # past deadline
+    assert store.get("/node/10.0.0.1") is None
+    evs = w.drain()
+    assert evs[-1].type == DELETE
+    assert not store.keepalive(lid)
+
+
+def test_lease_revoke(store, clock):
+    lid = store.grant(ttl=100)
+    store.put("/proc/a", "t0", lease=lid)
+    store.put("/proc/b", "t1", lease=lid)
+    assert store.revoke(lid)
+    assert store.get_prefix("/proc/") == []
+    assert not store.revoke(lid)
+
+
+def test_put_unknown_lease_raises(store):
+    with pytest.raises(KeyError):
+        store.put("/x", "v", lease=999)
+
+
+def test_delete_prefix(store):
+    for i in range(5):
+        store.put(f"/sess/{i}", "s")
+    assert store.delete_prefix("/sess/") == 5
+    assert store.get_prefix("/sess/") == []
+
+
+def test_multi_watcher_fanout(store):
+    w1 = store.watch("/once/")
+    w2 = store.watch("/once/")
+    store.put("/once/g/j", "node-1")
+    assert len(w1.drain()) == 1
+    assert len(w2.drain()) == 1
+
+
+def test_lease_ttl_remaining(store, clock):
+    lid = store.grant(ttl=30)
+    clock.advance(10)
+    rem = store.lease_ttl_remaining(lid)
+    assert rem == pytest.approx(20)

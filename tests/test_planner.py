@@ -74,3 +74,33 @@ def test_plan_inactive_table_fires_nothing():
     p, u, b = _setup()
     plan = p.plan(1_753_000_000)
     assert len(plan.fired) == 0 and plan.overflow == 0
+
+
+def test_plan_window_equals_sequential_ticks():
+    import numpy as np
+
+    def build():
+        p, u, b = _setup()
+        specs = [parse("* * * * * *"), parse("*/2 * * * * *"),
+                 parse("*/3 * * * * *")]
+        p.set_table(build_table(specs, capacity=p.J))
+        for row in range(3):
+            b.set_job(row, ["n0", "n1", "n2"], [], [])
+        rows, vals = b.dirty_rows()
+        p.set_eligibility_rows(rows, vals)
+        p.set_job_meta(np.arange(3), np.ones(3, bool), np.ones(3, np.float32))
+        return p
+
+    t0 = 1_753_000_080
+    pw = build()
+    plans_w = pw.plan_window(t0, 6, sla_bucket=64)
+    ps = build()
+    plans_s = [ps.plan(t0 + i, sla_bucket=64) for i in range(6)]
+    assert len(plans_w) == 6
+    for a, b_ in zip(plans_w, plans_s):
+        assert a.epoch_s == b_.epoch_s
+        assert a.fired.tolist() == b_.fired.tolist()
+        assert a.assigned.tolist() == b_.assigned.tolist()
+        assert a.overflow == b_.overflow
+    np.testing.assert_allclose(np.asarray(pw.load), np.asarray(ps.load))
+    assert np.asarray(pw.rem_cap).tolist() == np.asarray(ps.rem_cap).tolist()
