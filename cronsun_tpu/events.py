@@ -1,0 +1,53 @@
+"""Process-wide event bus + signal wait (reference event/event.go:20-94).
+
+On/Emit/Off with handler dedupe by identity; Wait() blocks until
+SIGINT/SIGTERM, then emits EXIT — the shutdown fan-out the entrypoints use.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Callable, Dict, List
+
+EXIT = "exit"
+WAIT = "wait"   # config reloaded (reference: fsnotify -> WAIT)
+
+_lock = threading.Lock()
+_handlers: Dict[str, List[Callable]] = {}
+
+
+def on(name: str, *fns: Callable):
+    with _lock:
+        hs = _handlers.setdefault(name, [])
+        for fn in fns:
+            if all(fn is not h for h in hs):   # dedupe by identity
+                hs.append(fn)
+
+
+def off(name: str, *fns: Callable):
+    with _lock:
+        hs = _handlers.get(name, [])
+        for fn in fns:
+            _handlers[name] = hs = [h for h in hs if h is not fn]
+
+
+def emit(name: str, arg=None):
+    with _lock:
+        hs = list(_handlers.get(name, []))
+    for fn in hs:
+        fn(arg) if fn.__code__.co_argcount else fn()
+
+
+def clear():
+    with _lock:
+        _handlers.clear()
+
+
+def wait():
+    """Block until SIGINT/SIGTERM, then emit EXIT."""
+    done = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    done.wait()
+    emit(EXIT)

@@ -1,0 +1,164 @@
+"""Failure notification (reference noticer.go).
+
+Agents put JSON messages under /cronsun/noticer/<node>; a Noticer hosted by
+the web process watches the prefix and delivers — by SMTP (connection kept
+alive between sends, closed after ``keepalive`` idle seconds,
+noticer.go:70-104) or by POSTing to an HTTP API (noticer.go:114-145).
+Node-death monitoring (noticer.go:172-200): a DELETE of a node key whose
+result-store mirror still says alive means a crash, not a clean shutdown —
+that also produces a notice.
+"""
+
+from __future__ import annotations
+
+import json
+import smtplib
+import threading
+import time
+import urllib.request
+from email.mime.text import MIMEText
+from typing import Callable, List, Optional
+
+from .core import Keyspace
+from .logsink import JobLogStore
+from .store.memstore import DELETE, MemStore
+
+
+class Notice:
+    def __init__(self, subject: str, body: str, to: Optional[List[str]] = None):
+        self.subject = subject
+        self.body = body
+        self.to = to or []
+
+
+class MailNoticer:
+    """SMTP sender with a kept-alive connection."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 default_to: List[str], keepalive: int = 30,
+                 use_tls: bool = True):
+        self.host, self.port = host, port
+        self.user, self.password = user, password
+        self.default_to = default_to
+        self.keepalive = keepalive
+        self.use_tls = use_tls
+        self._conn: Optional[smtplib.SMTP] = None
+        self._last_send = 0.0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> smtplib.SMTP:
+        conn = smtplib.SMTP(self.host, self.port, timeout=10)
+        if self.use_tls:
+            conn.starttls()
+        if self.user:
+            conn.login(self.user, self.password)
+        return conn
+
+    def send(self, notice: Notice):
+        to = notice.to or self.default_to
+        if not to:
+            return
+        msg = MIMEText(notice.body)
+        msg["Subject"] = notice.subject
+        msg["From"] = self.user
+        msg["To"] = ", ".join(to)
+        with self._lock:
+            if self._conn is None:
+                self._conn = self._connect()
+            try:
+                self._conn.sendmail(self.user, to, msg.as_string())
+            except smtplib.SMTPException:
+                self._conn = self._connect()     # reconnect once
+                self._conn.sendmail(self.user, to, msg.as_string())
+            self._last_send = time.time()
+
+    def idle_check(self):
+        """Close the cached connection after ``keepalive`` idle seconds."""
+        with self._lock:
+            if self._conn is not None and \
+                    time.time() - self._last_send > self.keepalive:
+                try:
+                    self._conn.quit()
+                except smtplib.SMTPException:
+                    pass
+                self._conn = None
+
+
+class HttpNoticer:
+    """POST the notice as JSON to an HTTP API (noticer.go:114-145)."""
+
+    def __init__(self, url: str):
+        self.url = url
+
+    def send(self, notice: Notice):
+        payload = json.dumps({"subject": notice.subject, "body": notice.body,
+                              "to": notice.to}).encode()
+        req = urllib.request.Request(
+            self.url, data=payload, method="POST",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10)
+
+
+class NoticerHost:
+    """Watches the noticer prefix + node deaths; fans out to a sender."""
+
+    def __init__(self, store: MemStore, sink: JobLogStore, sender,
+                 ks: Optional[Keyspace] = None):
+        self.store = store
+        self.sink = sink
+        self.sender = sender
+        self.ks = ks or Keyspace()
+        self._w_notice = store.watch(self.ks.noticer)
+        self._w_nodes = store.watch(self.ks.node)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.sent: List[Notice] = []     # for introspection/tests
+
+    def poll(self) -> int:
+        n = 0
+        for ev in self._w_notice.drain():
+            if ev.type == DELETE:
+                continue
+            try:
+                d = json.loads(ev.kv.value)
+            except json.JSONDecodeError:
+                continue
+            n += self._deliver(Notice(d.get("subject", ""),
+                                      d.get("body", ""), d.get("to")))
+            self.store.delete(ev.kv.key)
+        for ev in self._w_nodes.drain():
+            if ev.type != DELETE:
+                continue
+            node_id = ev.kv.key[len(self.ks.node):]
+            mirror = self.sink.get_node(node_id)
+            if mirror and mirror.get("alived"):
+                # lease expired but the node never said goodbye: a fault
+                # (reference node.go:93-102 ISNodeFault)
+                n += self._deliver(Notice(
+                    f"[cronsun] node [{node_id}] down",
+                    f"node {node_id} lease expired without clean shutdown"))
+        return n
+
+    def _deliver(self, notice: Notice) -> int:
+        try:
+            self.sender.send(notice)
+        except Exception as e:  # noqa: BLE001 — notification must not crash
+            print(f"[noticer] send failed: {e}", flush=True)
+            return 0
+        self.sent.append(notice)
+        return 1
+
+    def start(self):
+        def run():
+            while not self._stop.wait(0.5):
+                self.poll()
+                if hasattr(self.sender, "idle_check"):
+                    self.sender.idle_check()
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="noticer")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=3)

@@ -1,0 +1,545 @@
+"""The /v1 REST API (reference web/routers.go:17-114 — all routes).
+
+Stdlib ThreadingHTTPServer + a regex route table.  Handlers mirror the
+reference's semantics:
+
+- session login/logout + salted-hash accounts, bootstrap admin
+  (web/authentication.go:20-133)
+- role-gated admin account CRUD with force-logout on edit and the
+  Unchangeable guard (web/administrator.go)
+- job CRUD against the coordination store — CAS pause toggle, group-move
+  delete, run-now via the once key, node resolution include ∪ groups −
+  exclude (web/job.go)
+- executing-list from the proc registry (web/job.go:278-337)
+- group CRUD with the job-scrub on delete (web/node.go:78-139)
+- paged/filtered log queries (web/job_log.go)
+- overview + configurations (web/info.go, web/configuration.go)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http import HTTPStatus
+from http.cookies import SimpleCookie
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..core import (
+    Account, Group, Job, Keyspace, ROLE_ADMIN, ValidationError, next_id)
+from ..core.models import hash_password
+from ..logsink import JobLogStore
+from ..store.memstore import MemStore
+from .sessions import SessionStore
+from .ui import INDEX_HTML
+
+VERSION = "v0.1.0-tpu"
+BOOTSTRAP_ADMIN = "admin@admin.com"
+BOOTSTRAP_PASSWORD = "admin"
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+        self.msg = msg
+
+
+class ApiServer:
+    def __init__(self, store: MemStore, sink: JobLogStore,
+                 ks: Optional[Keyspace] = None, security=None, alarm=None,
+                 host: str = "127.0.0.1", port: int = 7079):
+        self.store = store
+        self.sink = sink
+        self.ks = ks or Keyspace()
+        self.security = security
+        self.alarm = alarm
+        self.sessions = SessionStore(store, self.ks)
+        self.host, self.port = host, port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._bootstrap_admin()
+        self.routes = self._build_routes()
+
+    # ---- bootstrap (web/authentication.go:20-52) -------------------------
+
+    def _bootstrap_admin(self):
+        if self.sink.get_account(BOOTSTRAP_ADMIN) is None:
+            salt = next_id()
+            acc = Account(email=BOOTSTRAP_ADMIN, salt=salt,
+                          password=hash_password(BOOTSTRAP_PASSWORD, salt),
+                          role=ROLE_ADMIN, unchangeable=True)
+            self.sink.upsert_account(acc.email, acc.to_json())
+
+    # ---- routing ---------------------------------------------------------
+
+    def _build_routes(self):
+        R = []
+
+        def route(method, pattern, fn, auth=True, admin=False):
+            R.append((method, re.compile("^" + pattern + "$"), fn, auth,
+                      admin))
+
+        route("GET", r"/v1/version", self.get_version, auth=False)
+        route("GET", r"/v1/session", self.login, auth=False)
+        route("DELETE", r"/v1/session", self.logout)
+        route("POST", r"/v1/user/setpwd", self.set_password)
+        route("GET", r"/v1/admin/accounts", self.admin_list, admin=True)
+        route("GET", r"/v1/admin/account/(?P<email>[^/]+)", self.admin_get,
+              admin=True)
+        route("PUT", r"/v1/admin/account", self.admin_add, admin=True)
+        route("POST", r"/v1/admin/account", self.admin_update, admin=True)
+        route("GET", r"/v1/jobs", self.job_list)
+        route("GET", r"/v1/job/groups", self.job_groups)
+        route("PUT", r"/v1/job", self.job_update)
+        route("GET", r"/v1/job/executing", self.job_executing)
+        route("POST", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)",
+              self.job_change_status)
+        route("GET", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)", self.job_get)
+        route("DELETE", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)",
+              self.job_delete)
+        route("GET", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)/nodes",
+              self.job_nodes)
+        route("PUT", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)/execute",
+              self.job_execute)
+        route("GET", r"/v1/logs", self.log_list)
+        route("GET", r"/v1/log/(?P<id>\d+)", self.log_detail)
+        route("GET", r"/v1/nodes", self.node_list)
+        route("GET", r"/v1/node/groups", self.group_list)
+        route("GET", r"/v1/node/group/(?P<id>[^/]+)", self.group_get)
+        route("PUT", r"/v1/node/group", self.group_update)
+        route("DELETE", r"/v1/node/group/(?P<id>[^/]+)", self.group_delete)
+        route("GET", r"/v1/info/overview", self.overview)
+        route("GET", r"/v1/configurations", self.configurations)
+        return R
+
+    # ---- handlers: auth --------------------------------------------------
+
+    def get_version(self, ctx):
+        return VERSION
+
+    def login(self, ctx):
+        email = ctx.q("email")
+        password = ctx.q("password")
+        doc = self.sink.get_account(email)
+        if doc is None:
+            raise HttpError(401, "invalid email or password")
+        acc = Account.from_json(doc)
+        if acc.status == 0 or not acc.check_password(password):
+            raise HttpError(401, "invalid email or password")
+        sid = self.sessions.create(acc.email, acc.role)
+        ctx.set_cookie("sid", sid)
+        return {"email": acc.email, "role": acc.role}
+
+    def logout(self, ctx):
+        if ctx.sid:
+            self.sessions.destroy(ctx.sid)
+        ctx.set_cookie("sid", "")
+        return {}
+
+    def set_password(self, ctx):
+        body = ctx.json()
+        old, new = body.get("password", ""), body.get("newPassword", "")
+        if len(new) < 4:
+            raise HttpError(400, "new password too short")
+        doc = self.sink.get_account(ctx.session.email)
+        acc = Account.from_json(doc)
+        if not acc.check_password(old):
+            raise HttpError(401, "wrong password")
+        acc.salt = next_id()
+        acc.password = hash_password(new, acc.salt)
+        self.sink.upsert_account(acc.email, acc.to_json())
+        return {}
+
+    # ---- handlers: admin accounts ---------------------------------------
+
+    @staticmethod
+    def _pub(acc: Account) -> dict:
+        return {"email": acc.email, "role": acc.role, "status": acc.status,
+                "unchangeable": acc.unchangeable}
+
+    def admin_list(self, ctx):
+        return [self._pub(Account.from_json(d))
+                for d in self.sink.list_accounts()]
+
+    def admin_get(self, ctx):
+        doc = self.sink.get_account(ctx.path_args["email"])
+        if doc is None:
+            raise HttpError(404, "no such account")
+        return self._pub(Account.from_json(doc))
+
+    def admin_add(self, ctx):
+        body = ctx.json()
+        email = (body.get("email") or "").strip().lower()
+        password = body.get("password") or ""
+        if "@" not in email or len(password) < 4:
+            raise HttpError(400, "invalid email or password")
+        if self.sink.get_account(email) is not None:
+            raise HttpError(409, "account exists")
+        salt = next_id()
+        acc = Account(email=email, salt=salt,
+                      password=hash_password(password, salt),
+                      role=int(body.get("role", 2)),
+                      status=int(body.get("status", 1)))
+        self.sink.upsert_account(acc.email, acc.to_json())
+        return {}
+
+    def admin_update(self, ctx):
+        body = ctx.json()
+        email = (body.get("email") or "").strip().lower()
+        doc = self.sink.get_account(email)
+        if doc is None:
+            raise HttpError(404, "no such account")
+        acc = Account.from_json(doc)
+        if acc.unchangeable and ctx.session.email != acc.email:
+            raise HttpError(403, "account is unchangeable")
+        if "role" in body:
+            acc.role = int(body["role"])
+        if "status" in body:
+            acc.status = int(body["status"])
+        if body.get("password"):
+            acc.salt = next_id()
+            acc.password = hash_password(body["password"], acc.salt)
+        self.sink.upsert_account(acc.email, acc.to_json())
+        self.sessions.destroy_email(email)   # force re-login on edit
+        return {}
+
+    # ---- handlers: jobs --------------------------------------------------
+
+    def job_list(self, ctx):
+        group = ctx.q("group")
+        prefix = self.ks.cmd + (group + "/" if group else "")
+        out = []
+        latest, _ = self.sink.query_logs(latest=True, page_size=500)
+        status = {}
+        for l in latest:
+            cur = status.setdefault(l.job_id, {"success": 0, "failed": 0})
+            cur["success" if l.success else "failed"] += 1
+        for kv in self.store.get_prefix(prefix):
+            try:
+                job = Job.from_json(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            d = json.loads(job.to_json())
+            d["latest_status"] = status.get(job.id)
+            out.append(d)
+        return out
+
+    def job_groups(self, ctx):
+        groups = set()
+        for kv in self.store.get_prefix(self.ks.cmd):
+            rest = kv.key[len(self.ks.cmd):]
+            if "/" in rest:
+                groups.add(rest.split("/", 1)[0])
+        return sorted(groups)
+
+    def job_update(self, ctx):
+        body = ctx.json()
+        old_group = (body.pop("oldGroup", "") or "").strip()
+        job = Job.from_json(json.dumps(body))
+        try:
+            job.check()
+            job.security_valid(self.security)
+        except ValidationError as e:
+            raise HttpError(400, str(e))
+        if old_group and old_group != job.group:
+            self.store.delete(self.ks.job_key(old_group, job.id))
+        self.store.put(self.ks.job_key(job.group, job.id), job.to_json())
+        return {"id": job.id, "group": job.group}
+
+    def _load_job(self, ctx) -> Job:
+        group, job_id = ctx.path_args["group"], ctx.path_args["id"]
+        kv = self.store.get(self.ks.job_key(group, job_id))
+        if kv is None:
+            raise HttpError(404, "no such job")
+        job = Job.from_json(kv.value)
+        job.group, job.id = group, job_id
+        job._mod_rev = kv.mod_rev
+        return job
+
+    def job_get(self, ctx):
+        return json.loads(self._load_job(ctx).to_json())
+
+    def job_delete(self, ctx):
+        group, job_id = ctx.path_args["group"], ctx.path_args["id"]
+        if not self.store.delete(self.ks.job_key(group, job_id)):
+            raise HttpError(404, "no such job")
+        return {}
+
+    def job_change_status(self, ctx):
+        """Pause/resume via CAS (reference web/job.go:54-79)."""
+        job = self._load_job(ctx)
+        body = ctx.json()
+        job.pause = bool(body.get("pause"))
+        if not self.store.put_if_mod_rev(
+                self.ks.job_key(job.group, job.id), job.to_json(),
+                job._mod_rev):
+            raise HttpError(409, "job was modified concurrently, retry")
+        return json.loads(job.to_json())
+
+    def job_nodes(self, ctx):
+        """include ∪ groups − exclude (reference web/job.go:222-257)."""
+        job = self._load_job(ctx)
+        nodes = set()
+        for rule in job.rules:
+            nodes.update(rule.nids)
+            for gid in rule.gids:
+                kv = self.store.get(self.ks.group_key(gid))
+                if kv is not None:
+                    nodes.update(Group.from_json(kv.value).node_ids)
+            nodes.difference_update(rule.exclude_nids)
+        return sorted(nodes)
+
+    def job_execute(self, ctx):
+        """Run-now (reference web/job.go:259-276 -> once.go:14-17)."""
+        group, job_id = ctx.path_args["group"], ctx.path_args["id"]
+        if self.store.get(self.ks.job_key(group, job_id)) is None:
+            raise HttpError(404, "no such job")
+        node = ctx.q("node")
+        self.store.put(self.ks.once_key(group, job_id), node)
+        return {}
+
+    def job_executing(self, ctx):
+        """Scan of the proc registry (reference web/job.go:278-337)."""
+        node_f, job_f = ctx.q("node"), ctx.q("jobId")
+        out = []
+        for kv in self.store.get_prefix(self.ks.proc):
+            parts = kv.key[len(self.ks.proc):].split("/")
+            if len(parts) != 4:
+                continue
+            node, group, job_id, pid = parts
+            if node_f and node != node_f:
+                continue
+            if job_f and job_id != job_f:
+                continue
+            try:
+                info = json.loads(kv.value)
+            except json.JSONDecodeError:
+                info = {}
+            out.append({"node": node, "group": group, "jobId": job_id,
+                        "pid": pid, "time": info.get("time")})
+        return sorted(out, key=lambda d: (d["node"], d["jobId"]))
+
+    # ---- handlers: logs --------------------------------------------------
+
+    def log_list(self, ctx):
+        recs, total = self.sink.query_logs(
+            node=ctx.q("node") or None,
+            job_ids=ctx.q("ids").split(",") if ctx.q("ids") else None,
+            name_like=ctx.q("names") or None,
+            begin=float(ctx.q("begin")) if ctx.q("begin") else None,
+            end=float(ctx.q("end")) if ctx.q("end") else None,
+            failed_only=ctx.q("failedOnly") in ("true", "1"),
+            latest=ctx.q("latest") in ("true", "1"),
+            page=int(ctx.q("page") or 1),
+            page_size=int(ctx.q("pageSize") or 50))
+        return {"total": total, "list": [self._log_dict(r) for r in recs]}
+
+    @staticmethod
+    def _log_dict(r) -> dict:
+        return {"id": r.id, "jobId": r.job_id, "jobGroup": r.job_group,
+                "name": r.name, "node": r.node, "user": r.user,
+                "command": r.command, "output": r.output,
+                "success": r.success, "beginTime": r.begin_ts,
+                "endTime": r.end_ts}
+
+    def log_detail(self, ctx):
+        rec = self.sink.get_log(int(ctx.path_args["id"]))
+        if rec is None:
+            raise HttpError(404, "no such log")
+        return self._log_dict(rec)
+
+    # ---- handlers: nodes + groups ---------------------------------------
+
+    def node_list(self, ctx):
+        """Result-store mirror ⋈ live keys (reference web/node.go:141-165)."""
+        live = {kv.key[len(self.ks.node):]
+                for kv in self.store.get_prefix(self.ks.node)}
+        out = []
+        for doc in self.sink.get_nodes():
+            doc["connected"] = doc.get("id") in live
+            out.append(doc)
+        return out
+
+    def group_list(self, ctx):
+        return [json.loads(kv.value)
+                for kv in self.store.get_prefix(self.ks.group)]
+
+    def group_get(self, ctx):
+        kv = self.store.get(self.ks.group_key(ctx.path_args["id"]))
+        if kv is None:
+            raise HttpError(404, "no such group")
+        return json.loads(kv.value)
+
+    def group_update(self, ctx):
+        body = ctx.json()
+        g = Group(id=body.get("id", ""), name=body.get("name", ""),
+                  node_ids=list(body.get("nids") or []))
+        try:
+            g.check()
+        except ValidationError as e:
+            raise HttpError(400, str(e))
+        self.store.put(self.ks.group_key(g.id), g.to_json())
+        return {"id": g.id}
+
+    def group_delete(self, ctx):
+        """Delete + scrub the gid from every job's rules via CAS
+        (reference web/node.go:78-139)."""
+        gid = ctx.path_args["id"]
+        if not self.store.delete(self.ks.group_key(gid)):
+            raise HttpError(404, "no such group")
+        for kv in self.store.get_prefix(self.ks.cmd):
+            try:
+                job = Job.from_json(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            dirty = False
+            for rule in job.rules:
+                if gid in rule.gids:
+                    rule.gids.remove(gid)
+                    dirty = True
+            if dirty:
+                self.store.put_if_mod_rev(kv.key, job.to_json(), kv.mod_rev)
+        return {}
+
+    # ---- handlers: info --------------------------------------------------
+
+    def overview(self, ctx):
+        live = self.store.count_prefix(self.ks.node)
+        return {
+            "totalJobs": self.store.count_prefix(self.ks.cmd),
+            "jobExecuted": self.sink.stat_overall(),
+            "jobExecutedDaily": self.sink.stat_days(7),
+            "nodeCount": len(self.sink.get_nodes()),
+            "nodeAlived": live,
+        }
+
+    def configurations(self, ctx):
+        sec = self.security
+        return {
+            "security": {
+                "open": bool(sec and sec.open),
+                "users": list(sec.users) if sec else [],
+                "exts": list(sec.exts) if sec else [],
+            },
+            "alarm": bool(self.alarm),
+        }
+
+    # ---- plumbing --------------------------------------------------------
+
+    def handle(self, method: str, path: str, query: dict, body: bytes,
+               cookies: dict):
+        """Transport-independent dispatch (tests call this directly)."""
+        ctx = _Ctx(query, body, cookies)
+        for m, rx, fn, need_auth, need_admin in self.routes:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if not match:
+                continue
+            ctx.path_args = match.groupdict()
+            if need_auth or need_admin:
+                ctx.session = self.sessions.get(ctx.sid)
+                if ctx.session is None:
+                    raise HttpError(401, "not logged in")
+                if need_admin and ctx.session.role != ROLE_ADMIN:
+                    raise HttpError(403, "admin only")
+            return fn(ctx), ctx
+        raise HttpError(404, "no such route")
+
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _run(self, method):
+                parsed = urlparse(self.path)
+                if parsed.path == "/" or parsed.path.startswith("/ui"):
+                    page = INDEX_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(page)))
+                    self.end_headers()
+                    self.wfile.write(page)
+                    return
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                cookies = {}
+                if self.headers.get("Cookie"):
+                    c = SimpleCookie(self.headers["Cookie"])
+                    cookies = {k: v.value for k, v in c.items()}
+                try:
+                    result, ctx = server.handle(method, parsed.path, query,
+                                                body, cookies)
+                    payload = json.dumps(result).encode()
+                    self.send_response(200)
+                    for k, v in ctx.out_cookies.items():
+                        self.send_header(
+                            "Set-Cookie", f"sid={v}; Path=/; HttpOnly")
+                except HttpError as e:
+                    payload = json.dumps({"error": e.msg}).encode()
+                    self.send_response(e.status)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                             name="api-server")
+        t.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class _Ctx:
+    def __init__(self, query: dict, body: bytes, cookies: dict):
+        self.query = query
+        self.body = body
+        self.cookies = cookies
+        self.path_args: dict = {}
+        self.session = None
+        self.out_cookies: dict = {}
+
+    @property
+    def sid(self) -> str:
+        return self.cookies.get("sid", "")
+
+    def q(self, name: str) -> str:
+        return self.query.get(name, "")
+
+    def json(self) -> dict:
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError:
+            raise HttpError(400, "bad JSON body")
+
+    def set_cookie(self, name: str, value: str):
+        self.out_cookies[name] = value

@@ -1,0 +1,147 @@
+"""Single-file management UI (replaces the reference's Vue SPA, web/ui/).
+
+Functionally equivalent surface against the same /v1 REST API: dashboard
+overview, job CRUD + pause + run-now, node list with liveness, node groups,
+execution logs with filters, executing view.  Zero build step: one HTML
+string served at /ui/.
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html><head><meta charset="utf-8"><title>cronsun-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f5f6f8;color:#222}
+ header{background:#1a2733;color:#fff;padding:10px 18px;display:flex;gap:18px;align-items:center}
+ header b{font-size:17px} header a{color:#cfd8e3;cursor:pointer;text-decoration:none;padding:4px 8px;border-radius:4px}
+ header a.active,header a:hover{background:#2e4052;color:#fff}
+ main{padding:18px;max-width:1100px;margin:auto}
+ table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+ th,td{padding:7px 10px;border-bottom:1px solid #e7eaee;text-align:left;font-size:13.5px;vertical-align:top}
+ th{background:#eef1f5} tr:hover td{background:#f7fafd}
+ .ok{color:#0a7d38}.bad{color:#c0392b}.muted{color:#888}
+ button{background:#2d6cdf;color:#fff;border:0;border-radius:4px;padding:5px 11px;cursor:pointer;font-size:13px}
+ button.warn{background:#c0392b} button.plain{background:#7c8aa0}
+ input,select,textarea{padding:6px;border:1px solid #c8d0da;border-radius:4px;font-size:13.5px}
+ .cards{display:flex;gap:14px;margin-bottom:18px;flex-wrap:wrap}
+ .card{background:#fff;box-shadow:0 1px 2px #0002;border-radius:6px;padding:14px 20px;min-width:130px}
+ .card .n{font-size:26px;font-weight:600}.card .t{color:#778;font-size:12.5px}
+ #login{max-width:320px;margin:90px auto;background:#fff;padding:26px;border-radius:8px;box-shadow:0 2px 8px #0003;display:flex;flex-direction:column;gap:10px}
+ dialog{border:0;border-radius:8px;box-shadow:0 4px 20px #0005;padding:20px;min-width:520px}
+ dialog label{display:block;margin:8px 0 2px;font-size:12.5px;color:#556}
+ dialog input,dialog select,dialog textarea{width:100%;box-sizing:border-box}
+ .row{display:flex;gap:10px}.row>*{flex:1}
+ pre{white-space:pre-wrap;background:#0e1620;color:#d7e3ef;padding:10px;border-radius:6px;max-height:300px;overflow:auto}
+ .bar{display:flex;gap:8px;margin-bottom:12px;align-items:center;flex-wrap:wrap}
+</style></head><body>
+<header><b>cronsun-tpu</b>
+ <a data-v=dash>Dashboard</a><a data-v=jobs>Jobs</a><a data-v=nodes>Nodes</a>
+ <a data-v=groups>Groups</a><a data-v=logs>Logs</a><a data-v=exec>Executing</a>
+ <span style="flex:1"></span><span id=who class=muted></span><a id=logout>logout</a>
+</header>
+<main id=main></main>
+<script>
+const $=s=>document.querySelector(s);
+const api=async(m,p,b)=>{const r=await fetch(p,{method:m,headers:{'Content-Type':'application/json'},
+  body:b?JSON.stringify(b):undefined});const d=await r.json().catch(()=>({}));
+  if(r.status===401){login();throw 'auth'}if(!r.ok)throw (d.error||r.status);return d};
+const esc=s=>String(s??'').replace(/[&<>"]/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+const ts=t=>t?new Date(t*1000).toLocaleString():'';
+let view='dash';
+function login(){$('#main').innerHTML=`<form id=login>
+ <b>Sign in</b><input id=em placeholder=email value="admin@admin.com">
+ <input id=pw type=password placeholder=password value="admin">
+ <button>Login</button><span id=err class=bad></span></form>`;
+ $('#login').onsubmit=async e=>{e.preventDefault();try{
+  const d=await api('GET','/v1/session?email='+encodeURIComponent($('#em').value)+'&password='+encodeURIComponent($('#pw').value));
+  $('#who').textContent=d.email;nav(view)}catch(x){$('#err').textContent=x}}}
+$('#logout').onclick=async()=>{await api('DELETE','/v1/session');login()};
+document.querySelectorAll('header a[data-v]').forEach(a=>a.onclick=()=>nav(a.dataset.v));
+function nav(v){view=v;document.querySelectorAll('header a[data-v]').forEach(a=>
+ a.classList.toggle('active',a.dataset.v===v));render[v]().catch(e=>{if(e!=='auth')$('#main').innerHTML='<p class=bad>'+esc(e)+'</p>'})}
+const render={
+ async dash(){const o=await api('GET','/v1/info/overview');
+  $('#main').innerHTML=`<div class=cards>
+   <div class=card><div class=n>${o.totalJobs}</div><div class=t>jobs</div></div>
+   <div class=card><div class=n>${o.nodeAlived}</div><div class=t>nodes alive</div></div>
+   <div class=card><div class=n>${o.jobExecuted.total}</div><div class=t>executions</div></div>
+   <div class=card><div class=n class=ok>${o.jobExecuted.successed}</div><div class=t>succeeded</div></div>
+   <div class=card><div class=n class=bad>${o.jobExecuted.failed}</div><div class=t>failed</div></div></div>
+  <h3>Daily</h3><table><tr><th>day</th><th>total</th><th>success</th><th>failed</th></tr>
+  ${o.jobExecutedDaily.map(d=>`<tr><td>${d.day}</td><td>${d.total}</td><td class=ok>${d.successed}</td><td class=bad>${d.failed}</td></tr>`).join('')}</table>`},
+ async jobs(){const js=await api('GET','/v1/jobs');
+  $('#main').innerHTML=`<div class=bar><button onclick="editJob()">+ New job</button></div>
+  <table><tr><th>name</th><th>group</th><th>command</th><th>kind</th><th>timers</th><th>status</th><th></th></tr>
+  ${js.map(j=>`<tr><td>${esc(j.name)}</td><td>${esc(j.group)}</td><td><code>${esc(j.command)}</code></td>
+   <td>${['Common','Alone','Interval'][j.kind]||j.kind}</td>
+   <td>${(j.rules||[]).map(r=>esc(r.timer)).join('<br>')}</td>
+   <td>${j.pause?'<span class=muted>paused</span>':'<span class=ok>active</span>'}</td>
+   <td><button class=plain onclick='editJob(${JSON.stringify(j)})'>edit</button>
+    <button class=plain onclick="toggleJob('${j.group}','${j.id}',${!j.pause})">${j.pause?'resume':'pause'}</button>
+    <button onclick="runNow('${j.group}','${j.id}')">run</button>
+    <button class=warn onclick="delJob('${j.group}','${j.id}')">del</button></td></tr>`).join('')}</table>`},
+ async nodes(){const ns=await api('GET','/v1/nodes');
+  $('#main').innerHTML=`<table><tr><th>id</th><th>hostname</th><th>pid</th><th>version</th><th>up since</th><th>status</th></tr>
+  ${ns.map(n=>`<tr><td>${esc(n.id)}</td><td>${esc(n.hostname)}</td><td>${n.pid}</td><td>${esc(n.version)}</td>
+   <td>${ts(n.up_ts)}</td><td>${n.connected?'<span class=ok>connected</span>':'<span class=bad>down</span>'}</td></tr>`).join('')}</table>`},
+ async groups(){const gs=await api('GET','/v1/node/groups');
+  $('#main').innerHTML=`<div class=bar><button onclick="editGroup()">+ New group</button></div>
+  <table><tr><th>id</th><th>name</th><th>nodes</th><th></th></tr>
+  ${gs.map(g=>`<tr><td>${esc(g.id)}</td><td>${esc(g.name)}</td><td>${(g.nids||[]).map(esc).join(', ')}</td>
+   <td><button class=plain onclick='editGroup(${JSON.stringify(g)})'>edit</button>
+   <button class=warn onclick="delGroup('${g.id}')">del</button></td></tr>`).join('')}</table>`},
+ async logs(){const failed=$('#flt')?.checked?'&failedOnly=true':'';
+  const d=await api('GET','/v1/logs?pageSize=100'+failed);
+  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt onchange="nav('logs')"> failed only</label>
+   <span class=muted>${d.total} records</span></div>
+  <table><tr><th>job</th><th>node</th><th>begin</th><th>secs</th><th>ok</th><th>output</th></tr>
+  ${d.list.map(l=>`<tr><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
+   <td>${(l.endTime-l.beginTime).toFixed(1)}</td>
+   <td>${l.success?'<span class=ok>✓</span>':'<span class=bad>✗</span>'}</td>
+   <td><code>${esc((l.output||'').slice(0,160))}</code></td></tr>`).join('')}</table>`},
+ async exec(){const xs=await api('GET','/v1/job/executing');
+  $('#main').innerHTML=`<table><tr><th>node</th><th>group</th><th>job</th><th>pid</th><th>since</th></tr>
+  ${xs.map(x=>`<tr><td>${esc(x.node)}</td><td>${esc(x.group)}</td><td>${esc(x.jobId)}</td>
+   <td>${esc(x.pid)}</td><td>${ts(x.time)}</td></tr>`).join('')||'<tr><td colspan=5 class=muted>nothing running</td></tr>'}</table>`},
+};
+window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
+window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert('dispatched')};
+window.delJob=async(g,id)=>{if(confirm('delete job?')){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
+window.delGroup=async id=>{if(confirm('delete group?')){await api('DELETE','/v1/node/group/'+id);nav('groups')}};
+window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
+ document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
+  <b>${j.id?'Edit':'New'} job</b>
+  <div class=row><div><label>name</label><input id=jn value="${esc(j.name||'')}"></div>
+  <div><label>group</label><input id=jg value="${esc(j.group||'default')}"></div></div>
+  <label>command</label><textarea id=jc rows=2>${esc(j.command||'')}</textarea>
+  <div class=row><div><label>kind</label><select id=jk>
+    <option value=0 ${j.kind==0?'selected':''}>Common (all eligible nodes)</option>
+    <option value=1 ${j.kind==1?'selected':''}>Alone (exactly one)</option>
+    <option value=2 ${j.kind==2?'selected':''}>Interval (one per interval)</option></select></div>
+  <div><label>user</label><input id=ju value="${esc(j.user||'')}"></div></div>
+  <div class=row><div><label>timeout s</label><input id=jt type=number value="${j.timeout||0}"></div>
+  <div><label>retry</label><input id=jr type=number value="${j.retry||0}"></div>
+  <div><label>parallels</label><input id=jp type=number value="${j.parallels||0}"></div></div>
+  <label>cron timer (sec min hour dom month dow)</label><input id=rt value="${esc(r.timer||'0 */5 * * * *')}">
+  <div class=row><div><label>node ids (comma)</label><input id=rn value="${esc((r.nids||[]).join(','))}"></div>
+  <div><label>group ids</label><input id=rg value="${esc((r.gids||[]).join(','))}"></div>
+  <div><label>exclude nodes</label><input id=rx value="${esc((r.exclude_nids||[]).join(','))}"></div></div>
+  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+ </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
+ $('#sv').onclick=async e=>{e.preventDefault();const csv=v=>v.split(',').map(s=>s.trim()).filter(Boolean);
+  try{await api('PUT','/v1/job',{id:j.id,name:$('#jn').value,group:$('#jg').value,oldGroup:j.group,
+   command:$('#jc').value,kind:+$('#jk').value,user:$('#ju').value,timeout:+$('#jt').value,
+   retry:+$('#jr').value,parallels:+$('#jp').value,pause:!!j.pause,
+   rules:[{id:r.id,timer:$('#rt').value,nids:csv($('#rn').value),gids:csv($('#rg').value),
+           exclude_nids:csv($('#rx').value)}]});dlg.close();nav('jobs')}catch(x){alert(x)}}};
+window.editGroup=(g)=>{g=g||{};
+ document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
+  <b>${g.id?'Edit':'New'} group</b>
+  <label>name</label><input id=gn value="${esc(g.name||'')}">
+  <label>node ids (comma)</label><input id=gm value="${esc((g.nids||[]).join(','))}">
+  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+ </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
+ $('#sv').onclick=async e=>{e.preventDefault();try{
+  await api('PUT','/v1/node/group',{id:g.id,name:$('#gn').value,
+   nids:$('#gm').value.split(',').map(s=>s.trim()).filter(Boolean)});dlg.close();nav('groups')}catch(x){alert(x)}}};
+api('GET','/v1/info/overview').then(()=>nav('dash')).catch(()=>login());
+</script></body></html>
+"""

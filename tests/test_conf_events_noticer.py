@@ -1,0 +1,141 @@
+"""Config system, event bus, noticer."""
+
+import json
+import time
+
+import pytest
+
+from cronsun_tpu import events
+from cronsun_tpu.conf import Config, ConfigWatcher, load_file, parse
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.noticer import HttpNoticer, Notice, NoticerHost
+from cronsun_tpu.store import MemStore
+
+KS = Keyspace()
+
+
+# -------------------------------------------------------------------- conf
+
+def test_defaults():
+    cfg = parse(None)
+    assert cfg.node_ttl == 10 and cfg.lock_ttl == 300
+    assert cfg.prefix == "/cronsun"
+
+
+def test_extend_and_substitution(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"node_ttl": 30, "proc_ttl": 700,
+                                "log_db": "@pwd@/x.db"}))
+    child = tmp_path / "child.json"
+    child.write_text(json.dumps({"@extend:": "base.json", "proc_ttl": 99}))
+    cfg = parse(str(child))
+    assert cfg.node_ttl == 30          # from base
+    assert cfg.proc_ttl == 99          # child overrides
+    assert cfg.log_db == str(tmp_path / "x.db")  # @pwd@ expanded
+
+
+def test_nested_sections(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({
+        "security": {"open": True, "users": ["worker"], "exts": [".sh"]},
+        "web": {"port": 8080}}))
+    cfg = parse(str(p))
+    assert cfg.security.open and cfg.security.users == ["worker"]
+    assert cfg.web.port == 8080
+
+
+def test_hot_reload_excludes_connection_settings(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({"lock_ttl": 100, "web": {"port": 1111}}))
+    cfg = parse(str(p))
+    reloaded = []
+    w = ConfigWatcher(str(p), cfg, lambda c: reloaded.append(c),
+                      poll_s=0.05, debounce_s=0.1)
+    w.start()
+    time.sleep(0.2)
+    p.write_text(json.dumps({"lock_ttl": 200, "web": {"port": 2222}}))
+    deadline = time.time() + 5
+    while not reloaded and time.time() < deadline:
+        time.sleep(0.05)
+    w.stop()
+    assert reloaded
+    assert cfg.lock_ttl == 200         # reloaded
+    assert cfg.web.port == 1111        # excluded from reload
+
+
+# ------------------------------------------------------------------ events
+
+def test_event_bus_on_emit_off_dedupe():
+    events.clear()
+    hits = []
+    fn = lambda: hits.append(1)
+    events.on("x", fn)
+    events.on("x", fn)                  # dedupe
+    events.emit("x")
+    assert hits == [1]
+    events.off("x", fn)
+    events.emit("x")
+    assert hits == [1]
+
+
+def test_event_bus_arg_passing():
+    events.clear()
+    got = []
+    events.on("cfg", lambda c: got.append(c))
+    events.emit("cfg", {"a": 1})
+    assert got == [{"a": 1}]
+
+
+# ----------------------------------------------------------------- noticer
+
+class CollectSender:
+    def __init__(self):
+        self.notices = []
+
+    def send(self, n):
+        self.notices.append(n)
+
+
+def test_noticer_delivers_and_consumes():
+    store = MemStore()
+    sink = JobLogStore()
+    sender = CollectSender()
+    host = NoticerHost(store, sink, sender)
+    store.put(KS.noticer_key("n1"),
+              json.dumps({"subject": "s", "body": "b", "to": ["a@b.c"]}))
+    assert host.poll() == 1
+    assert sender.notices[0].subject == "s"
+    assert store.get(KS.noticer_key("n1")) is None  # consumed
+
+
+def test_noticer_node_fault_detection():
+    store = MemStore()
+    sink = JobLogStore()
+    sender = CollectSender()
+    host = NoticerHost(store, sink, sender)
+    sink.upsert_node("n1", '{"id":"n1"}', alived=True)   # mirror says alive
+    store.put(KS.node_key("n1"), "123")
+    host.poll()
+    store.delete(KS.node_key("n1"))                      # crash
+    assert host.poll() == 1
+    assert "down" in sender.notices[0].subject
+    # clean shutdown: mirror says not alive -> no notice
+    sink.set_node_alived("n1", False)
+    store.put(KS.node_key("n1"), "123")
+    host.poll()
+    store.delete(KS.node_key("n1"))
+    assert host.poll() == 0
+
+
+def test_noticer_sender_failure_does_not_crash():
+    store = MemStore()
+    sink = JobLogStore()
+
+    class Boom:
+        def send(self, n):
+            raise RuntimeError("smtp down")
+
+    host = NoticerHost(store, sink, Boom())
+    store.put(KS.noticer_key("n1"), json.dumps({"subject": "s", "body": "b"}))
+    assert host.poll() == 0

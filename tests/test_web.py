@@ -1,0 +1,216 @@
+"""API server: the /v1 surface over HTTP (real ThreadingHTTPServer)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from cronsun_tpu.core import Group, Job, JobRule, Keyspace
+from cronsun_tpu.logsink import JobLogStore, LogRecord
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.web import ApiServer
+
+KS = Keyspace()
+
+
+class Client:
+    def __init__(self, port):
+        self.base = f"http://127.0.0.1:{port}"
+        self.sid = ""
+
+    def req(self, method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(self.base + path, data=data, method=method)
+        if self.sid:
+            r.add_header("Cookie", f"sid={self.sid}")
+        try:
+            resp = urllib.request.urlopen(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+        cookie = resp.headers.get("Set-Cookie", "")
+        if cookie.startswith("sid="):
+            sid = cookie.split(";")[0][4:]
+            if sid:
+                self.sid = sid
+        return resp.status, json.loads(resp.read())
+
+    def login(self, email="admin@admin.com", password="admin"):
+        return self.req("GET", f"/v1/session?email={email}&password={password}")
+
+
+@pytest.fixture
+def world():
+    store = MemStore()
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, port=0).start()
+    yield store, sink, srv, Client(srv.port)
+    srv.stop()
+    store.close()
+
+
+def test_version_no_auth(world):
+    _, _, _, c = world
+    code, v = c.req("GET", "/v1/version")
+    assert code == 200 and "tpu" in v
+
+
+def test_login_required(world):
+    _, _, _, c = world
+    code, body = c.req("GET", "/v1/jobs")
+    assert code == 401
+
+
+def test_login_and_job_crud_roundtrip(world):
+    store, _, _, c = world
+    code, who = c.login()
+    assert code == 200 and who["role"] == 1
+    # create
+    code, out = c.req("PUT", "/v1/job", {
+        "name": "bk", "group": "infra", "command": "echo 1",
+        "rules": [{"timer": "0 0 3 * * *", "nids": ["n1"]}]})
+    assert code == 200
+    jid = out["id"]
+    assert store.get(KS.job_key("infra", jid)) is not None
+    # read
+    code, job = c.req("GET", f"/v1/job/infra-{jid}")
+    assert code == 200 and job["name"] == "bk"
+    # list + groups
+    code, jobs = c.req("GET", "/v1/jobs")
+    assert len(jobs) == 1
+    code, groups = c.req("GET", "/v1/job/groups")
+    assert groups == ["infra"]
+    # pause via CAS
+    code, job = c.req("POST", f"/v1/job/infra-{jid}", {"pause": True})
+    assert code == 200 and job["pause"] is True
+    # group move
+    code, out = c.req("PUT", "/v1/job", {
+        "id": jid, "name": "bk", "group": "ops", "oldGroup": "infra",
+        "command": "echo 1", "rules": [{"timer": "0 0 3 * * *"}]})
+    assert store.get(KS.job_key("infra", jid)) is None
+    assert store.get(KS.job_key("ops", jid)) is not None
+    # delete
+    code, _ = c.req("DELETE", f"/v1/job/ops-{jid}")
+    assert code == 200
+    code, _ = c.req("GET", f"/v1/job/ops-{jid}")
+    assert code == 404
+
+
+def test_job_validation_rejected(world):
+    _, _, _, c = world
+    c.login()
+    code, err = c.req("PUT", "/v1/job", {"name": "", "command": "x"})
+    assert code == 400 and "name" in err["error"]
+    code, err = c.req("PUT", "/v1/job", {
+        "name": "a", "command": "x", "rules": [{"timer": "bogus"}]})
+    assert code == 400
+
+
+def test_job_nodes_resolution(world):
+    store, _, _, c = world
+    c.login()
+    g = Group(id="g1", name="g1", node_ids=["n1", "n2", "n3"])
+    store.put(KS.group_key("g1"), g.to_json())
+    code, out = c.req("PUT", "/v1/job", {
+        "name": "j", "command": "x",
+        "rules": [{"timer": "* * * * * *", "gids": ["g1"], "nids": ["n9"],
+                   "exclude_nids": ["n2"]}]})
+    jid = out["id"]
+    code, nodes = c.req("GET", f"/v1/job/default-{jid}/nodes")
+    assert nodes == ["n1", "n3", "n9"]
+
+
+def test_execute_writes_once_key(world):
+    store, _, _, c = world
+    c.login()
+    _, out = c.req("PUT", "/v1/job", {
+        "name": "j", "command": "x", "rules": [{"timer": "* * * * * *"}]})
+    jid = out["id"]
+    code, _ = c.req("PUT", f"/v1/job/default-{jid}/execute?node=n7")
+    assert code == 200
+    assert store.get(KS.once_key("default", jid)).value == "n7"
+
+
+def test_group_crud_and_delete_scrubs_jobs(world):
+    store, _, _, c = world
+    c.login()
+    code, out = c.req("PUT", "/v1/node/group",
+                      {"id": "web", "name": "web", "nids": ["a", "b"]})
+    assert code == 200
+    _, out2 = c.req("PUT", "/v1/job", {
+        "name": "j", "command": "x",
+        "rules": [{"timer": "* * * * * *", "gids": ["web"]}]})
+    jid = out2["id"]
+    code, gs = c.req("GET", "/v1/node/groups")
+    assert len(gs) == 1
+    code, _ = c.req("DELETE", "/v1/node/group/web")
+    assert code == 200
+    _, job = c.req("GET", f"/v1/job/default-{jid}")
+    assert job["rules"][0]["gids"] == []
+
+
+def test_logs_and_overview(world):
+    store, sink, _, c = world
+    c.login()
+    sink.create_job_log(LogRecord(
+        job_id="j1", job_group="g", name="n", node="n1", user="",
+        command="c", output="o", success=False,
+        begin_ts=1_753_000_000.0, end_ts=1_753_000_001.0))
+    code, d = c.req("GET", "/v1/logs?failedOnly=true")
+    assert d["total"] == 1
+    log_id = d["list"][0]["id"]
+    code, detail = c.req("GET", f"/v1/log/{log_id}")
+    assert detail["output"] == "o"
+    code, ov = c.req("GET", "/v1/info/overview")
+    assert ov["jobExecuted"]["failed"] == 1
+
+
+def test_admin_account_lifecycle(world):
+    _, _, _, c = world
+    c.login()
+    code, _ = c.req("PUT", "/v1/admin/account",
+                    {"email": "dev@x.io", "password": "passw", "role": 2})
+    assert code == 200
+    code, accs = c.req("GET", "/v1/admin/accounts")
+    assert {a["email"] for a in accs} == {"admin@admin.com", "dev@x.io"}
+    # new account can log in but is not admin
+    c2 = Client(c.base.rsplit(":", 1)[1])
+    c2.base = c.base
+    code, _ = c2.login("dev@x.io", "passw")
+    assert code == 200
+    code, _ = c2.req("GET", "/v1/admin/accounts")
+    assert code == 403
+    # ban the account -> login refused
+    code, _ = c.req("POST", "/v1/admin/account",
+                    {"email": "dev@x.io", "status": 0})
+    assert code == 200
+    c3 = Client(0); c3.base = c.base
+    code, _ = c3.login("dev@x.io", "passw")
+    assert code == 401
+
+
+def test_setpwd(world):
+    _, _, _, c = world
+    c.login()
+    code, _ = c.req("POST", "/v1/user/setpwd",
+                    {"password": "admin", "newPassword": "newpass"})
+    assert code == 200
+    c2 = Client(0); c2.base = c.base
+    assert c2.login(password="admin")[0] == 401
+    assert c2.login(password="newpass")[0] == 200
+
+
+def test_executing_view(world):
+    store, _, _, c = world
+    c.login()
+    store.put(KS.proc_key("n1", "g", "j1", "555-1"),
+              json.dumps({"time": 123.0}))
+    code, xs = c.req("GET", "/v1/job/executing")
+    assert xs == [{"node": "n1", "group": "g", "jobId": "j1",
+                   "pid": "555-1", "time": 123.0}]
+
+
+def test_ui_served(world):
+    _, _, srv, c = world
+    import urllib.request
+    html = urllib.request.urlopen(c.base + "/ui/").read().decode()
+    assert "cronsun-tpu" in html
