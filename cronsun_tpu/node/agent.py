@@ -55,6 +55,8 @@ class NodeAgent:
 
         self._lease: Optional[int] = None
         self._proc_lease: Optional[int] = None
+        self._procs: Dict[str, str] = {}   # live proc keys -> value
+        self._procs_mu = threading.Lock()  # guards _procs + _proc_lease
         self._stop = threading.Event()
         self._threads = []
         self._w_dispatch = store.watch(self.ks.dispatch + self.id + "/")
@@ -67,18 +69,31 @@ class NodeAgent:
         self._lease = self.store.grant(self.ttl + 2)
         self.store.put(self.ks.node_key(self.id), str(os.getpid()),
                        lease=self._lease)
-        self._proc_lease = self.store.grant(self.proc_ttl)
+        self._ensure_proc_lease()
         node = Node(id=self.id, pid=os.getpid(), ip=self.id,
                     hostname=socket.gethostname(), version=VERSION,
                     up_ts=self.clock(), alived=True)
         self.sink.upsert_node(self.id, node.to_json(), alived=True)
 
+    def _ensure_proc_lease(self):
+        """Keep the shared proc lease alive; on a lapse grant a fresh one
+        and re-attach the proc keys of still-running executions (on a lapse
+        the keys die with the old lease and the executing list / capacity
+        reconciliation would otherwise lose them).  A healthy lease is
+        reused — no spurious re-puts."""
+        with self._procs_mu:
+            if (self._proc_lease is None
+                    or not self.store.keepalive(self._proc_lease)):
+                self._proc_lease = self.store.grant(self.proc_ttl)
+                for k, v in self._procs.items():
+                    self.store.put(k, v, lease=self._proc_lease)
+
     def keepalive_once(self) -> bool:
         ok = self._lease is not None and self.store.keepalive(self._lease)
         if not ok:
             self.register()     # reference re-registers after a lapse
-        if self._proc_lease is not None:
-            self.store.keepalive(self._proc_lease)
+        else:
+            self._ensure_proc_lease()
         return ok
 
     def unregister(self):
@@ -105,8 +120,23 @@ class NodeAgent:
 
     # ---- execution -------------------------------------------------------
 
+    def _wait_until(self, epoch_s: int) -> bool:
+        """Block until ``epoch_s`` arrives.  The scheduler publishes the
+        whole planned window [t+1, t+W] ahead of wall-clock; a job must
+        never run before its cron instant (the reference only ever fires
+        late — cron.go:212-215).  Returns False if the agent is stopping."""
+        while True:
+            delay = epoch_s - self.clock()
+            if delay <= 0:
+                return True
+            # bounded naps so injected (virtual) clocks still make progress
+            if self._stop.wait(min(delay, 0.05)):
+                return False
+
     def _execute(self, job: Job, epoch_s: int, fenced: bool,
                  use_gate: bool = True):
+        if not self._wait_until(epoch_s):
+            return
         if fenced and job.exclusive:
             lease = self.store.grant(self.lock_ttl)
             if not self.store.put_if_absent(
@@ -115,15 +145,26 @@ class NodeAgent:
                 return  # another node already ran this (job, second)
         proc_key = self.ks.proc_key(self.id, job.group, job.id,
                                     f"{epoch_s}-{os.getpid()}")
-        self.store.put(proc_key, json.dumps({"time": self.clock()}),
-                       lease=self._proc_lease or 0)
+        proc_val = json.dumps({"time": self.clock()})
+        with self._procs_mu:
+            self._procs[proc_key] = proc_val
+            try:
+                self.store.put(proc_key, proc_val,
+                               lease=self._proc_lease or 0)
+            except KeyError:
+                # proc lease expired under us — repair and re-attach
+                self._proc_lease = self.store.grant(self.proc_ttl)
+                for k, v in self._procs.items():
+                    self.store.put(k, v, lease=self._proc_lease)
         try:
             res = self.executor.run_job(
                 job_id=job.id, command=job.command, user=job.user,
                 timeout=job.timeout, retry=job.retry, interval=job.interval,
                 parallels=job.parallels if use_gate else 0)
         finally:
-            self.store.delete(proc_key)
+            with self._procs_mu:
+                self._procs.pop(proc_key, None)
+                self.store.delete(proc_key)
         self._record(job, res)
 
     def _record(self, job: Job, res: ExecResult):

@@ -146,11 +146,19 @@ class MemStore:
 
     def _put_locked(self, key: str, value: str, lease: int) -> int:
         prev = self._kv.get(key)
+        new_lease = None
         if lease:
-            l = self._leases.get(lease)
-            if l is None:
+            new_lease = self._leases.get(lease)
+            if new_lease is None:   # validate BEFORE any mutation
                 raise KeyError(f"lease {lease} not found")
-            l.keys.add(key)
+        if prev and prev.lease and prev.lease != lease:
+            # etcd semantics: a put re-binds the key's lease attachment —
+            # the old lease must no longer own (and delete) this key.
+            old = self._leases.get(prev.lease)
+            if old is not None:
+                old.keys.discard(key)
+        if new_lease is not None:
+            new_lease.keys.add(key)
         self._rev += 1
         kv = KV(key, value, prev.create_rev if prev else self._rev,
                 self._rev, lease)

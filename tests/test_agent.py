@@ -1,0 +1,123 @@
+"""NodeAgent unit tests: dispatch timing and lease-lapse recovery.
+
+The scheduler publishes the whole planned window [t+1, t+W] ahead of
+wall-clock; the agent must hold each order until its cron instant (the
+reference only ever fires late, never early — cron.go:212-215).
+"""
+
+import json
+import time
+
+from cronsun_tpu.core import Job, JobRule, Keyspace, KIND_COMMON
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.node.agent import NodeAgent
+from cronsun_tpu.store import MemStore
+
+KS = Keyspace()
+
+
+def make_job(name="j", command="echo hi"):
+    job = Job(name=name, command=command, kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *", nids=["n0"])])
+    job.check()
+    return job
+
+
+def test_dispatch_waits_for_scheduled_second():
+    store, sink = MemStore(), JobLogStore()
+    t = [1_753_000_000.0]
+    agent = NodeAgent(store, sink, node_id="n0", clock=lambda: t[0])
+    agent.register()
+    job = make_job()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    epoch = int(t[0]) + 3   # order for 3 (virtual) seconds in the future
+    store.put(KS.dispatch_key("n0", epoch, job.group, job.id),
+              json.dumps({"rule": job.rules[0].id, "kind": job.kind}))
+    agent.poll()
+    time.sleep(0.3)         # real time passes; the virtual second hasn't
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 0, "job ran before its scheduled second"
+    t[0] = epoch + 0.5      # the second arrives
+    agent.join_running()
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1
+    store.close()
+
+
+def test_past_dispatch_runs_immediately():
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    job = make_job()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    epoch = int(time.time()) - 5    # late order: run now, not never
+    store.put(KS.dispatch_key("n0", epoch, job.group, job.id),
+              json.dumps({"rule": job.rules[0].id, "kind": job.kind}))
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1
+    store.close()
+
+
+def test_stop_abandons_pending_future_orders():
+    store, sink = MemStore(), JobLogStore()
+    t = [1_753_000_000.0]
+    agent = NodeAgent(store, sink, node_id="n0", clock=lambda: t[0])
+    agent.register()
+    job = make_job()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    store.put(KS.dispatch_key("n0", int(t[0]) + 3600, job.group, job.id),
+              json.dumps({"rule": job.rules[0].id, "kind": job.kind}))
+    agent.poll()
+    agent.stop()            # must not hang on the hour-away order
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 0
+    store.close()
+
+
+def test_proc_keys_survive_lease_reregister():
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    old_proc_lease = agent._proc_lease
+    job = make_job(name="slow", command="sleep 1")
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    agent._spawn(job, int(time.time()) - 1, fenced=False)
+    deadline = time.time() + 3
+    while time.time() < deadline and not store.get_prefix(KS.proc):
+        time.sleep(0.02)
+    assert store.get_prefix(KS.proc), "proc key never appeared"
+    # simulate a full connectivity lapse: both leases expire, the leased
+    # proc key dies with them
+    store.revoke(agent._lease)
+    store.revoke(old_proc_lease)
+    assert not store.get_prefix(KS.proc)
+    agent.keepalive_once()          # re-registers + repairs the proc lease
+    assert store.get_prefix(KS.proc), \
+        "running execution vanished from the proc registry after re-register"
+    agent.join_running()
+    assert not store.get_prefix(KS.proc)
+    store.close()
+
+
+def test_proc_lease_lapse_repaired_by_keepalive():
+    """If the proc lease expires while the node lease stays healthy,
+    keepalive_once must grant a fresh proc lease and re-attach running
+    proc keys."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    job = make_job(name="slow2", command="sleep 1")
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    agent._spawn(job, int(time.time()) - 1, fenced=False)
+    deadline = time.time() + 3
+    while time.time() < deadline and not store.get_prefix(KS.proc):
+        time.sleep(0.02)
+    assert store.get_prefix(KS.proc)
+    store.revoke(agent._proc_lease)     # proc lease dies, node lease lives
+    assert not store.get_prefix(KS.proc)
+    agent.keepalive_once()
+    assert store.get_prefix(KS.proc), "proc key not re-attached after repair"
+    agent.join_running()
+    store.close()

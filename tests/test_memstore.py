@@ -138,3 +138,34 @@ def test_lease_ttl_remaining(store, clock):
     clock.advance(10)
     rem = store.lease_ttl_remaining(lid)
     assert rem == pytest.approx(20)
+
+
+def test_put_rebinds_lease_attachment():
+    """etcd semantics: a put with a new lease detaches the key from its old
+    lease, so revoking the old lease must not delete the key."""
+    s = MemStore()
+    l1, l2 = s.grant(60), s.grant(60)
+    s.put("/k", "a", lease=l1)
+    s.put("/k", "b", lease=l2)
+    s.revoke(l1)
+    kv = s.get("/k")
+    assert kv is not None and kv.value == "b"
+    s.revoke(l2)
+    assert s.get("/k") is None
+    s.close()
+
+
+def test_put_with_dead_lease_leaves_old_binding_intact():
+    """A put naming an unknown/expired lease must fail without mutating
+    the key's existing lease attachment."""
+    s = MemStore()
+    l1 = s.grant(60)
+    s.put("/k", "a", lease=l1)
+    try:
+        s.put("/k", "b", lease=9999)
+        assert False, "expected KeyError"
+    except KeyError:
+        pass
+    s.revoke(l1)
+    assert s.get("/k") is None  # still owned (and deleted) by l1
+    s.close()
