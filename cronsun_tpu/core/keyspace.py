@@ -70,6 +70,23 @@ class Keyspace:
     def lock_key(self, job_id: str, epoch_s: int) -> str:
         return f"{self.lock}{job_id}/{epoch_s}"
 
+    def alone_lock_key(self, job_id: str) -> str:
+        """Fleet-wide running lock for KindAlone jobs — held with keepalive
+        for the execution's whole lifetime (reference job.go:87-123), unlike
+        the per-(job, second) dedup fence of :meth:`lock_key`."""
+        return f"{self.lock}alone/{job_id}"
+
+    @property
+    def hwm(self) -> str:        # scheduler planning high-water mark
+        return f"{self.prefix}/hwm"
+
+    @property
+    def phase(self) -> str:      # @every phase anchors, survive failover
+        return f"{self.prefix}/phase/"
+
+    def phase_key(self, group: str, job_id: str, rule_id: str) -> str:
+        return f"{self.phase}{group}/{job_id}/{rule_id}"
+
     def proc_key(self, node_id: str, group: str, job_id: str, pid) -> str:
         return f"{self.proc}{node_id}/{group}/{job_id}/{pid}"
 

@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..core import Job, Keyspace, Node
+from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
 from ..store.memstore import DELETE, MemStore
 from .executor import ExecResult, Executor
@@ -84,9 +85,14 @@ class NodeAgent:
         with self._procs_mu:
             if (self._proc_lease is None
                     or not self.store.keepalive(self._proc_lease)):
-                self._proc_lease = self.store.grant(self.proc_ttl)
-                for k, v in self._procs.items():
-                    self.store.put(k, v, lease=self._proc_lease)
+                self._repair_proc_lease_locked()
+
+    def _repair_proc_lease_locked(self):
+        """Grant a fresh proc lease and re-attach live proc keys.  Caller
+        must hold ``_procs_mu``."""
+        self._proc_lease = self.store.grant(self.proc_ttl)
+        for k, v in self._procs.items():
+            self.store.put(k, v, lease=self._proc_lease)
 
     def keepalive_once(self) -> bool:
         ok = self._lease is not None and self.store.keepalive(self._lease)
@@ -133,39 +139,108 @@ class NodeAgent:
             if self._stop.wait(min(delay, 0.05)):
                 return False
 
+    def _acquire_alone_lock(self, job: Job):
+        """Fleet-wide running lock for KindAlone: held under a lease with
+        keepalive for the execution's lifetime, released on completion
+        (reference job.go:87-123).  A still-running Alone job blocks the
+        next fire everywhere.  Returns (lease, stop_event) or None if the
+        lock is already live."""
+        # TTL is a crash-safety net only (keepalive holds the lock while we
+        # live); sized from the cost estimate like the reference's lockTtl
+        # (job.go:194-233).
+        ttl = max(5.0, min(self.lock_ttl, 2.0 * job.avg_time + 5.0))
+        lease = self.store.grant(ttl)
+        if not self.store.put_if_absent(
+                self.ks.alone_lock_key(job.id), self.id, lease=lease):
+            self.store.revoke(lease)
+            return None
+        stop = threading.Event()
+
+        def ka_loop():
+            while not stop.wait(max(0.5, ttl / 3)):
+                if not self.store.keepalive(lease):
+                    return
+        threading.Thread(target=ka_loop, daemon=True,
+                         name=f"alone-ka-{job.id}").start()
+        return lease, stop
+
     def _execute(self, job: Job, epoch_s: int, fenced: bool,
-                 use_gate: bool = True):
+                 use_gate: bool = True, order_key: Optional[str] = None):
         if not self._wait_until(epoch_s):
             return
-        if fenced and job.exclusive:
-            lease = self.store.grant(self.lock_ttl)
-            if not self.store.put_if_absent(
-                    self.ks.lock_key(job.id, epoch_s), self.id, lease=lease):
-                self.store.revoke(lease)
-                return  # another node already ran this (job, second)
-        proc_key = self.ks.proc_key(self.id, job.group, job.id,
-                                    f"{epoch_s}-{os.getpid()}")
-        proc_val = json.dumps({"time": self.clock()})
-        with self._procs_mu:
-            self._procs[proc_key] = proc_val
-            try:
-                self.store.put(proc_key, proc_val,
-                               lease=self._proc_lease or 0)
-            except KeyError:
-                # proc lease expired under us — repair and re-attach
-                self._proc_lease = self.store.grant(self.proc_ttl)
-                for k, v in self._procs.items():
-                    self.store.put(k, v, lease=self._proc_lease)
+        alone = None
         try:
-            res = self.executor.run_job(
-                job_id=job.id, command=job.command, user=job.user,
-                timeout=job.timeout, retry=job.retry, interval=job.interval,
-                parallels=job.parallels if use_gate else 0)
-        finally:
+            if fenced and job.kind == KIND_ALONE:
+                # lifetime lock FIRST: a skip because the previous run is
+                # still live must not consume the (job, second) fence
+                alone = self._acquire_alone_lock(job)
+                if alone is None:
+                    return  # previous Alone run still live fleet-wide
+            if fenced and job.exclusive:
+                lease = self.store.grant(self.lock_ttl)
+                if not self.store.put_if_absent(
+                        self.ks.lock_key(job.id, epoch_s), self.id,
+                        lease=lease):
+                    self.store.revoke(lease)
+                    return  # another node already ran this (job, second)
+            proc_key = self.ks.proc_key(self.id, job.group, job.id,
+                                        f"{epoch_s}-{os.getpid()}")
+            proc_val = json.dumps({"time": self.clock()})
             with self._procs_mu:
-                self._procs.pop(proc_key, None)
-                self.store.delete(proc_key)
+                self._procs[proc_key] = proc_val
+                try:
+                    self.store.put(proc_key, proc_val,
+                                   lease=self._proc_lease or 0)
+                except KeyError:
+                    # proc lease expired under us — repair and re-attach
+                    self._repair_proc_lease_locked()
+            if order_key is not None:
+                # consume the order only now: until the proc key exists the
+                # dispatch key is what the scheduler's capacity reconciler
+                # counts as an outstanding reservation
+                self.store.delete(order_key)
+                order_key = None
+            try:
+                res = self.executor.run_job(
+                    job_id=job.id, command=job.command, user=job.user,
+                    timeout=job.timeout, retry=job.retry,
+                    interval=job.interval,
+                    parallels=job.parallels if use_gate else 0)
+            finally:
+                with self._procs_mu:
+                    self._procs.pop(proc_key, None)
+                    self.store.delete(proc_key)
+        finally:
+            if alone is not None:
+                lease, stop = alone
+                stop.set()
+                self.store.revoke(lease)   # deletes the alone lock key
+            if order_key is not None:      # skipped before consumption
+                self.store.delete(order_key)
         self._record(job, res)
+        self._update_avg_time(job, res)
+
+    def _update_avg_time(self, job: Job, res: ExecResult):
+        """Close the cost loop: fold the measured runtime into the job's
+        EWMA and persist it CAS-style (reference job.go:581-589,
+        job_log.go:85-86).  The resulting watch event flows the new cost
+        into the planner's waterfill."""
+        if res.skipped:
+            return
+        dur = max(0.0, res.end_ts - res.begin_ts)
+        key = self.ks.job_key(job.group, job.id)
+        for _ in range(3):
+            kv = self.store.get(key)
+            if kv is None:
+                return
+            try:
+                cur = Job.from_json(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                return
+            cur.group, cur.id = job.group, job.id
+            cur.update_avg_time(dur)
+            if self.store.put_if_mod_rev(key, cur.to_json(), kv.mod_rev):
+                return
 
     def _record(self, job: Job, res: ExecResult):
         if res.skipped:
@@ -209,10 +284,13 @@ class NodeAgent:
                 continue
             epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
             job = self._get_job(group, job_id)
-            self.store.delete(ev.kv.key)  # consume the order
             if job is None or job.pause:
+                self.store.delete(ev.kv.key)
                 continue
-            self._spawn(job, epoch_s, fenced=True)
+            # the order key stays in the store until the execution's proc
+            # key exists — the scheduler counts it as an outstanding
+            # capacity reservation in the meantime
+            self._spawn(job, epoch_s, fenced=True, order_key=ev.kv.key)
             n += 1
         return n
 
@@ -237,9 +315,10 @@ class NodeAgent:
         return n
 
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
-               use_gate: bool = True):
+               use_gate: bool = True, order_key: Optional[str] = None):
         t = threading.Thread(
-            target=self._execute, args=(job, epoch_s, fenced, use_gate),
+            target=self._execute,
+            args=(job, epoch_s, fenced, use_gate, order_key),
             daemon=True, name=f"exec-{job.id}-{epoch_s}")
         self.running[t.name] = t
         t.start()

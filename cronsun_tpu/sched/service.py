@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..core import Group, Job, Keyspace
+from ..core.models import KIND_ALONE
 from ..cron.parser import ParseError, parse
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
@@ -103,6 +104,10 @@ class SchedulerService:
 
         self._table_updates: Dict[int, dict] = {}
         self._meta_updates: Dict[int, Tuple[bool, float]] = {}
+        # row -> (timer string, phase anchor): @every phases are anchored at
+        # first registration and must survive unrelated job rewrites (pause
+        # toggles, avg_time updates) — only a changed timer re-anchors.
+        self._row_phase: Dict[int, Tuple[str, int]] = {}
 
         self._w_jobs = store.watch(self.ks.cmd)
         self._w_groups = store.watch(self.ks.group)
@@ -168,13 +173,40 @@ class SchedulerService:
                 continue
             new_rules.add(rule.id)
             row = self.rows.acquire(group, job_id, rule.id)
+            prev = self._row_phase.get(row)
+            if prev is not None and prev[0] == rule.timer:
+                phase_epoch = prev[1]       # unchanged rule keeps its phase
+            else:
+                phase_epoch = self._phase_anchor(group, job_id, rule.id,
+                                                 rule.timer)
+                self._row_phase[row] = (rule.timer, phase_epoch)
             self._table_updates[row] = make_row(
-                spec, phase_epoch_s=int(self.clock()), paused=job.pause)
+                spec, phase_epoch_s=phase_epoch, paused=job.pause)
             self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
             self._meta_updates[row] = (job.exclusive,
                                        job.avg_time if job.avg_time > 0 else 1.0)
         for rule_id in old_rules - new_rules:
             self._drop_rule(group, job_id, rule_id)
+
+    def _phase_anchor(self, group: str, job_id: str, rule_id: str,
+                      timer: str) -> int:
+        """First-registration anchor for a rule's @every phase, persisted so
+        it survives leader failover (an in-memory anchor would re-anchor
+        every @every rule to the new leader's start time, delaying the next
+        fire by up to a full period).  A changed timer re-anchors."""
+        key = self.ks.phase_key(group, job_id, rule_id)
+        now = int(self.clock())
+        self.store.put_if_absent(key, f"{timer}|{now}")
+        kv = self.store.get(key)
+        if kv is not None:
+            t, _, e = kv.value.rpartition("|")
+            if t == timer:
+                try:
+                    return int(e)
+                except ValueError:
+                    pass
+        self.store.put(key, f"{timer}|{now}")   # timer changed: re-anchor
+        return now
 
     def _drop_rule(self, group: str, job_id: str, rule_id: str):
         row = self.rows.release_rule(group, job_id, rule_id)
@@ -182,6 +214,8 @@ class SchedulerService:
             self._table_updates[row] = dict(_INACTIVE_ROW)
             self.builder.del_job(row)
             self._meta_updates.pop(row, None)
+            self._row_phase.pop(row, None)
+            self.store.delete(self.ks.phase_key(group, job_id, rule_id))
 
     def _drop_job(self, group: str, job_id: str):
         for rule_id in self.rows.rules_of(group, job_id):
@@ -260,21 +294,35 @@ class SchedulerService:
     # ---- capacity reconciliation ----------------------------------------
 
     def reconcile_capacity(self):
-        """Derive per-node running load from the (leased) proc registry.
-        Crash-safe by construction: procs of dead nodes expire with their
-        lease (reference proc.go:21-35 ProcTtl)."""
+        """Derive per-node running load from the (leased) proc registry
+        PLUS still-outstanding dispatch orders (written but not yet picked
+        up / started — agents keep the order key until the proc key
+        exists), so a node at capacity can't be over-committed during the
+        dispatch->spawn gap.  Crash-safe by construction: procs of dead
+        nodes expire with their lease (reference proc.go:21-35 ProcTtl),
+        orders with the dispatch lease."""
         running_excl: Dict[str, int] = {}
         running_load: Dict[str, float] = {}
-        for kv in self.store.get_prefix(self.ks.proc):
-            rest = kv.key[len(self.ks.proc):].split("/")
-            if len(rest) != 4:
-                continue
-            node_id, group, job_id, _pid = rest
+
+        def account(node_id: str, group: str, job_id: str):
             job = self.jobs.get((group, job_id))
             cost = (job.avg_time if job and job.avg_time > 0 else 1.0)
             running_load[node_id] = running_load.get(node_id, 0.0) + cost
             if job and job.exclusive:
                 running_excl[node_id] = running_excl.get(node_id, 0) + 1
+
+        for kv in self.store.get_prefix(self.ks.proc):
+            rest = kv.key[len(self.ks.proc):].split("/")
+            if len(rest) != 4:
+                continue
+            node_id, group, job_id, _pid = rest
+            account(node_id, group, job_id)
+        for kv in self.store.get_prefix(self.ks.dispatch):
+            rest = kv.key[len(self.ks.dispatch):].split("/")
+            if len(rest) != 4:
+                continue
+            node_id, _epoch, group, job_id = rest
+            account(node_id, group, job_id)
         cols, caps = [], []
         loads = np.zeros(self.planner.N, np.float32)
         for node_id, col in self.universe.index.items():
@@ -306,14 +354,30 @@ class SchedulerService:
         self._flush_device()
         start = self._next_epoch
         if start is None:
+            # fresh leadership: resume from the persisted high-water mark so
+            # seconds the previous leader already dispatched aren't planned
+            # twice (Common jobs have no per-second fence)
             start = now + 1
-        elif start < now + 1 - self.max_catchup_s:
+            hwm_kv = self.store.get(self.ks.hwm)
+            if hwm_kv is not None:
+                try:
+                    # never ahead of a sane bound; the catch-up clamp below
+                    # bounds how far back we re-plan
+                    start = min(int(hwm_kv.value), start + 3600)
+                except ValueError:
+                    pass
+        if start < now + 1 - self.max_catchup_s:
             self.stats["skipped_seconds"] += (now + 1 - self.max_catchup_s
                                               - start)
             start = now + 1 - self.max_catchup_s
         window = max(1, self.window_s)
         plans = self.planner.plan_window(start, window)
         self._next_epoch = start + window
+        # KindAlone lifetime exclusion: don't dispatch an Alone job whose
+        # running lock is still live anywhere (reference job.go:87-123)
+        alone_pfx = self.ks.lock + "alone/"
+        alone_live = {kv.key[len(alone_pfx):]
+                      for kv in self.store.get_prefix(alone_pfx)}
         col_to_node = {c: n for n, c in self.universe.index.items()}
         n_dispatch = 0
         lease = self.store.grant(self.dispatch_ttl)
@@ -334,6 +398,8 @@ class SchedulerService:
                 job = self.jobs.get((group, job_id))
                 if job is None:
                     continue
+                if job.kind == KIND_ALONE and job_id in alone_live:
+                    continue   # previous run still holds the fleet lock
                 if job.exclusive:
                     node = col_to_node.get(node_col)
                     targets = [node] if node else []
@@ -346,7 +412,25 @@ class SchedulerService:
                                    separators=(",", ":")),
                         lease=lease)
                     n_dispatch += 1
+        # Persist the high-water mark only AFTER the orders are in the
+        # store (a crash in between re-plans the window — a rare double
+        # fire beats silently missing it), and monotonically via CAS so a
+        # deposed-but-stalled leader can't regress the new leader's mark.
+        self._advance_hwm(self._next_epoch)
         return n_dispatch
+
+    def _advance_hwm(self, value: int):
+        for _ in range(8):
+            kv = self.store.get(self.ks.hwm)
+            if kv is not None:
+                try:
+                    if int(kv.value) >= value:
+                        return
+                except ValueError:
+                    pass
+            if self.store.put_if_mod_rev(self.ks.hwm, str(value),
+                                         kv.mod_rev if kv else 0):
+                return
 
     def _row_cmd(self, row: int) -> Optional[Tuple[str, str, str]]:
         return self.rows.by_row.get(row)
