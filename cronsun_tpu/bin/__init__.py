@@ -1,0 +1,10 @@
+"""Production entrypoints (reference bin/node/server.go, bin/web/server.go).
+
+Each is a real OS process wired through conf + logging + the event bus,
+talking to the coordination store over TCP:
+
+    python -m cronsun_tpu.bin.store --port 7070          # the store
+    python -m cronsun_tpu.bin.sched --store H:P          # leader scheduler
+    python -m cronsun_tpu.bin.node  --store H:P          # execution agent
+    python -m cronsun_tpu.bin.web   --store H:P          # API/UI + noticer
+"""

@@ -1,0 +1,41 @@
+"""Shared entrypoint wiring: flags, conf, logging, store connection."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+from .. import events, log
+from ..conf import Config, ConfigWatcher, parse as parse_conf
+from ..core import Keyspace
+from ..store.remote import RemoteStore
+
+
+def base_parser(doc: str, store_required: bool = True) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=doc)
+    ap.add_argument("--conf", default=None, help="JSON config file")
+    ap.add_argument("--log-level", default="info",
+                    choices=("debug", "info", "warn", "error"))
+    if store_required:
+        ap.add_argument("--store", default="127.0.0.1:7070",
+                        metavar="HOST:PORT",
+                        help="coordination store address")
+    return ap
+
+
+def setup_common(args) -> Tuple[Config, Keyspace, Optional[ConfigWatcher]]:
+    """Logging + conf + hot-reload watcher (reload emits events.WAIT, the
+    reference's fsnotify->WAIT wiring, conf/conf.go:159-193)."""
+    log.setup(args.log_level)
+    cfg = parse_conf(args.conf)
+    watcher = None
+    if args.conf:
+        watcher = ConfigWatcher(
+            args.conf, cfg, lambda c: events.emit(events.WAIT, c))
+        watcher.start()
+    return cfg, Keyspace(cfg.prefix), watcher
+
+
+def connect_store(addr: str) -> RemoteStore:
+    host, _, port = addr.rpartition(":")
+    return RemoteStore(host or "127.0.0.1", int(port))

@@ -1,0 +1,49 @@
+"""Execution agent — one per machine (reference bin/node/server.go:23-70).
+
+    python -m cronsun_tpu.bin.node --store H:P [--node-id ID] [--conf F]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import events, log
+from ..logsink import JobLogStore
+from ..node.agent import NodeAgent
+from .common import base_parser, connect_store, setup_common
+
+
+def main(argv=None) -> int:
+    ap = base_parser(__doc__)
+    ap.add_argument("--node-id", default=None,
+                    help="stable node identity (default: local IP)")
+    args = ap.parse_args(argv)
+    cfg, ks, watcher = setup_common(args)
+
+    store = connect_store(args.store)
+    sink = JobLogStore(cfg.log_db)
+    agent = NodeAgent(store, sink, node_id=args.node_id, ks=ks,
+                      ttl=cfg.node_ttl, proc_ttl=cfg.proc_ttl,
+                      lock_ttl=cfg.lock_ttl, proc_req=cfg.proc_req)
+    agent.start()
+    log.infof("cronsun-node %s up (store %s)", agent.id, args.store)
+    print(f"READY {agent.id}", flush=True)
+
+    def reload_conf(c):
+        # dynamic knobs only — the reference reloads the proc lease the
+        # same way (proc.go:37-52)
+        agent.ttl = c.node_ttl
+        agent.proc_ttl = c.proc_ttl
+        agent.lock_ttl = c.lock_ttl
+        agent.proc_req = c.proc_req
+        log.infof("config reloaded")
+    events.on(events.WAIT, reload_conf)
+    events.on(events.EXIT, agent.stop, store.close)
+    if watcher:
+        events.on(events.EXIT, watcher.stop)
+    events.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

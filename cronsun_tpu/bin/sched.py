@@ -1,0 +1,43 @@
+"""Leader scheduler — run one or more; they elect a leader.
+
+    python -m cronsun_tpu.bin.sched --store H:P [--conf F]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import events, log
+from ..sched import SchedulerService
+from .common import base_parser, connect_store, setup_common
+
+
+def main(argv=None) -> int:
+    ap = base_parser(__doc__)
+    ap.add_argument("--node-id", default="scheduler-1")
+    args = ap.parse_args(argv)
+    cfg, ks, watcher = setup_common(args)
+
+    tz = None
+    if cfg.timezone and cfg.timezone.upper() != "UTC":
+        from zoneinfo import ZoneInfo
+        tz = ZoneInfo(cfg.timezone)
+    store = connect_store(args.store)
+    sched = SchedulerService(
+        store, ks=ks, job_capacity=cfg.job_capacity,
+        node_capacity=cfg.node_capacity, window_s=cfg.window_s,
+        default_node_cap=cfg.default_node_cap, node_id=args.node_id,
+        dispatch_ttl=cfg.lock_ttl, tz=tz)
+    sched.start()
+    log.infof("cronsun-sched %s up (store %s, tz %s)",
+              args.node_id, args.store, cfg.timezone)
+    print(f"READY {args.node_id}", flush=True)
+    events.on(events.EXIT, sched.stop, store.close)
+    if watcher:
+        events.on(events.EXIT, watcher.stop)
+    events.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

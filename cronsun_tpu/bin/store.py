@@ -1,0 +1,33 @@
+"""Coordination store server — the rebuild's etcd.
+
+    python -m cronsun_tpu.bin.store [--host H] [--port P] [--conf F]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import events, log
+from ..store.remote import StoreServer
+from .common import base_parser, setup_common
+
+
+def main(argv=None) -> int:
+    ap = base_parser(__doc__, store_required=False)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7070)
+    args = ap.parse_args(argv)
+    cfg, ks, watcher = setup_common(args)
+
+    srv = StoreServer(host=args.host, port=args.port).start()
+    log.infof("cronsun-store serving on %s:%d", srv.host, srv.port)
+    print(f"READY {srv.host}:{srv.port}", flush=True)
+    events.on(events.EXIT, srv.stop)
+    if watcher:
+        events.on(events.EXIT, watcher.stop)
+    events.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from .. import log
 from ..core import Job, Keyspace, Node
 from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
@@ -41,7 +42,7 @@ class NodeAgent:
                  node_id: Optional[str] = None,
                  ks: Optional[Keyspace] = None,
                  ttl: float = 10.0, proc_ttl: float = 600.0,
-                 lock_ttl: float = 300.0,
+                 lock_ttl: float = 300.0, proc_req: float = 0.0,
                  executor: Optional[Executor] = None,
                  clock: Callable[[], float] = time.time):
         self.store = store
@@ -51,6 +52,7 @@ class NodeAgent:
         self.ttl = ttl
         self.proc_ttl = proc_ttl
         self.lock_ttl = lock_ttl
+        self.proc_req = proc_req   # short-run suppression (proc.go:218-236)
         self.executor = executor or Executor()
         self.clock = clock
 
@@ -157,9 +159,16 @@ class NodeAgent:
         stop = threading.Event()
 
         def ka_loop():
+            # transient store errors (RPC timeout, reconnecting TCP) must
+            # not kill the keepalive — the lock would expire mid-run and a
+            # second Alone execution could overlap
             while not stop.wait(max(0.5, ttl / 3)):
-                if not self.store.keepalive(lease):
-                    return
+                try:
+                    if not self.store.keepalive(lease):
+                        return   # lease definitively gone
+                except Exception as e:  # noqa: BLE001
+                    log.warnf("alone-lock keepalive for %s failed "
+                              "(retrying): %s", job.id, e)
         threading.Thread(target=ka_loop, daemon=True,
                          name=f"alone-ka-{job.id}").start()
         return lease, stop
@@ -186,20 +195,34 @@ class NodeAgent:
             proc_key = self.ks.proc_key(self.id, job.group, job.id,
                                         f"{epoch_s}-{os.getpid()}")
             proc_val = json.dumps({"time": self.clock()})
-            with self._procs_mu:
-                self._procs[proc_key] = proc_val
-                try:
-                    self.store.put(proc_key, proc_val,
-                                   lease=self._proc_lease or 0)
-                except KeyError:
-                    # proc lease expired under us — repair and re-attach
-                    self._repair_proc_lease_locked()
-            if order_key is not None:
-                # consume the order only now: until the proc key exists the
-                # dispatch key is what the scheduler's capacity reconciler
-                # counts as an outstanding reservation
-                self.store.delete(order_key)
-                order_key = None
+            finished = [False]
+            timer = None
+
+            def put_proc():
+                """Register the running execution.  With proc_req > 0 this
+                runs from a delay timer so sub-threshold jobs never touch
+                the store (reference proc.go:218-236); the dispatch order
+                key is consumed in the same breath — until then it is the
+                scheduler's outstanding-capacity reservation."""
+                with self._procs_mu:
+                    if finished[0]:
+                        return
+                    self._procs[proc_key] = proc_val
+                    try:
+                        self.store.put(proc_key, proc_val,
+                                       lease=self._proc_lease or 0)
+                    except KeyError:
+                        # proc lease expired under us — repair + re-attach
+                        self._repair_proc_lease_locked()
+                if order_key is not None:
+                    self.store.delete(order_key)
+
+            if self.proc_req > 0:
+                timer = threading.Timer(self.proc_req, put_proc)
+                timer.daemon = True
+                timer.start()
+            else:
+                put_proc()
             try:
                 res = self.executor.run_job(
                     job_id=job.id, command=job.command, user=job.user,
@@ -207,15 +230,18 @@ class NodeAgent:
                     interval=job.interval,
                     parallels=job.parallels if use_gate else 0)
             finally:
+                if timer is not None:
+                    timer.cancel()
                 with self._procs_mu:
-                    self._procs.pop(proc_key, None)
-                    self.store.delete(proc_key)
+                    finished[0] = True
+                    if self._procs.pop(proc_key, None) is not None:
+                        self.store.delete(proc_key)
         finally:
             if alone is not None:
                 lease, stop = alone
                 stop.set()
                 self.store.revoke(lease)   # deletes the alone lock key
-            if order_key is not None:      # skipped before consumption
+            if order_key is not None:      # consume the order regardless
                 self.store.delete(order_key)
         self._record(job, res)
         self._update_avg_time(job, res)
@@ -314,13 +340,25 @@ class NodeAgent:
             n += 1
         return n
 
+    _spawn_seq = 0
+
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
                use_gate: bool = True, order_key: Optional[str] = None):
-        t = threading.Thread(
-            target=self._execute,
-            args=(job, epoch_s, fenced, use_gate, order_key),
-            daemon=True, name=f"exec-{job.id}-{epoch_s}")
-        self.running[t.name] = t
+        NodeAgent._spawn_seq += 1
+        name = f"exec-{job.id}-{epoch_s}-{NodeAgent._spawn_seq}"
+
+        def run():
+            try:
+                self._execute(job, epoch_s, fenced, use_gate, order_key)
+            except Exception as e:  # noqa: BLE001 — log, don't die silent
+                log.errorf("execution %s failed: %s", name, e)
+            finally:
+                # self-prune: a long-running agent must not accumulate one
+                # dead Thread per execution
+                self.running.pop(name, None)
+
+        t = threading.Thread(target=run, daemon=True, name=name)
+        self.running[name] = t
         t.start()
 
     def join_running(self, timeout: float = 10.0):
@@ -335,12 +373,21 @@ class NodeAgent:
         self.register()
 
         def keepalive_loop():
+            # a transient store failure must not permanently kill the node
+            # (the lease would expire and the fleet would mark it dead)
             while not self._stop.wait(max(1.0, self.ttl / 3)):
-                self.keepalive_once()
+                try:
+                    self.keepalive_once()
+                except Exception as e:  # noqa: BLE001
+                    log.warnf("keepalive failed (retrying): %s", e)
 
         def poll_loop():
             while not self._stop.is_set():
-                self.poll()
+                try:
+                    self.poll()
+                except Exception as e:  # noqa: BLE001
+                    log.warnf("poll failed (retrying): %s", e)
+                    time.sleep(0.5)
                 time.sleep(0.05)
 
         for fn in (keepalive_loop, poll_loop):

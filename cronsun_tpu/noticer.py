@@ -20,6 +20,7 @@ from email.mime.text import MIMEText
 from typing import Callable, List, Optional
 
 from .core import Keyspace
+from . import log
 from .logsink import JobLogStore
 from .store.memstore import DELETE, MemStore
 
@@ -143,7 +144,7 @@ class NoticerHost:
         try:
             self.sender.send(notice)
         except Exception as e:  # noqa: BLE001 — notification must not crash
-            print(f"[noticer] send failed: {e}", flush=True)
+            log.errorf("noticer send failed: %s", e)
             return 0
         self.sent.append(notice)
         return 1

@@ -28,6 +28,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from .. import log
 from ..core import Group, Job, Keyspace
 from ..core.models import KIND_ALONE
 from ..cron.parser import ParseError, parse
@@ -82,6 +83,7 @@ class SchedulerService:
                  default_node_cap: int = 1 << 20,
                  node_id: str = "scheduler-1",
                  planner: Optional[TickPlanner] = None,
+                 tz=None,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -92,9 +94,10 @@ class SchedulerService:
         self.default_node_cap = default_node_cap
         self.node_id = node_id
 
+        planner_kw = {} if tz is None else {"tz": tz}
         self.planner = planner or TickPlanner(
             job_capacity=job_capacity, node_capacity=node_capacity,
-            max_fire_bucket=min(65536, job_capacity))
+            max_fire_bucket=min(65536, job_capacity), **planner_kw)
         self.universe = NodeUniverse(self.planner.N)
         self.builder = EligibilityBuilder(self.universe, self.planner.J)
         self.rows = _Rows(self.planner.J)
@@ -379,7 +382,7 @@ class SchedulerService:
         alone_live = {kv.key[len(alone_pfx):]
                       for kv in self.store.get_prefix(alone_pfx)}
         col_to_node = {c: n for n, c in self.universe.index.items()}
-        n_dispatch = 0
+        orders: List[Tuple[str, str]] = []
         lease = self.store.grant(self.dispatch_ttl)
         for plan in plans:
             if plan.overflow:
@@ -387,8 +390,8 @@ class SchedulerService:
                 # _last_total already re-escalates the bucket for the next
                 # window, so this is transient — but never silent.
                 self.stats["overflow_drops"] += plan.overflow
-                print(f"[scheduler] WARNING: {plan.overflow} fires over the "
-                      f"bucket SLA dropped at t={plan.epoch_s}", flush=True)
+                log.warnf("%d fires over the bucket SLA dropped at t=%d",
+                          plan.overflow, plan.epoch_s)
             for row, node_col in zip(plan.fired.tolist(),
                                      plan.assigned.tolist()):
                 cmd = self._row_cmd(row)
@@ -405,13 +408,16 @@ class SchedulerService:
                     targets = [node] if node else []
                 else:
                     targets = self._eligible_nodes(row, col_to_node)
+                payload = json.dumps({"rule": rule_id, "kind": job.kind},
+                                     separators=(",", ":"))
                 for node in targets:
-                    self.store.put(
-                        self.ks.dispatch_key(node, plan.epoch_s, group, job_id),
-                        json.dumps({"rule": rule_id, "kind": job.kind},
-                                   separators=(",", ":")),
-                        lease=lease)
-                    n_dispatch += 1
+                    orders.append((self.ks.dispatch_key(
+                        node, plan.epoch_s, group, job_id), payload))
+        if orders:
+            # one bulk write for the whole window — the dispatch plane is
+            # one store round trip, not one per (node, second, job)
+            self.store.put_many(orders, lease=lease)
+        n_dispatch = len(orders)
         # Persist the high-water mark only AFTER the orders are in the
         # store (a crash in between re-plans the window — a rare double
         # fire beats silently missing it), and monotonically via CAS so a

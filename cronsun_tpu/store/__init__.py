@@ -10,9 +10,14 @@ that architecture but behind a small interface:
   lease expiry, compare-and-swap, create-if-absent locks).  It is both the
   test harness the reference never had (multi-node scenarios in one process,
   SURVEY.md §4) and a perfectly good single-host production store.
-- a real etcd can be slotted in behind the same surface for multi-host
-  deployments (adapter not bundled: no etcd client library in this
-  environment).
+- :class:`remote.StoreServer` / :class:`remote.RemoteStore` — the same
+  semantics over TCP: the server hosts a MemStore, the client is a drop-in
+  replacement, and N processes/machines coordinate through it exactly as
+  the reference's fleet does through etcd (client.go:24-114).
+- a real etcd can also be slotted in behind the same surface (adapter not
+  bundled: no etcd client library in this environment).
 """
 
-from .memstore import Event, KV, Lease, MemStore, Watcher  # noqa: F401
+from .memstore import (CompactedError, Event, KV, Lease,  # noqa: F401
+                       MemStore, Watcher)
+from .remote import RemoteStore, StoreServer  # noqa: F401

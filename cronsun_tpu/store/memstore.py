@@ -21,14 +21,21 @@ an optional sweeper thread.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 PUT = "PUT"
 DELETE = "DELETE"
+
+
+class CompactedError(RuntimeError):
+    """watch(start_rev) asked for revisions older than the bounded event
+    history retains (etcd's ErrCompacted): the caller must re-list the
+    prefix and watch from the current revision instead."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,7 +115,8 @@ class Watcher:
 
 
 class MemStore:
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 history: int = 65536):
         self._lock = threading.RLock()
         self._clock = clock
         self._kv: Dict[str, KV] = {}
@@ -116,6 +124,8 @@ class MemStore:
         self._leases: Dict[int, Lease] = {}
         self._next_lease = 1
         self._watchers: List[Watcher] = []
+        self._history: "collections.deque[Event]" = \
+            collections.deque(maxlen=history)
         self._sweeper: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -143,6 +153,17 @@ class MemStore:
         with self._lock:
             self._expire_leases()
             return self._put_locked(key, value, lease)
+
+    def put_many(self, items: Sequence[Sequence[str]], lease: int = 0) -> int:
+        """Bulk put under ONE lock acquisition — the dispatch plane writes
+        whole planned windows at once.  ``items`` is [(key, value), ...];
+        the lease (if any) applies to every key."""
+        with self._lock:
+            self._expire_leases()
+            rev = self._rev
+            for key, value in items:
+                rev = self._put_locked(key, value, lease)
+            return rev
 
     def _put_locked(self, key: str, value: str, lease: int) -> int:
         prev = self._kv.get(key)
@@ -275,9 +296,27 @@ class MemStore:
 
     # ---- watch -----------------------------------------------------------
 
-    def watch(self, prefix: str) -> Watcher:
+    def watch(self, prefix: str, start_rev: int = 0) -> Watcher:
+        """Watch a prefix.  With ``start_rev`` > 0, replay retained events
+        with mod_rev >= start_rev first (etcd WithRev) — a reconnecting
+        watcher resumes without losing deltas.  Raises
+        :class:`CompactedError` if the bounded history no longer reaches
+        back that far."""
         with self._lock:
-            w = Watcher(self, prefix, self._rev)
+            w = Watcher(self, prefix, start_rev or self._rev)
+            if start_rev and start_rev <= self._rev:
+                # every revision 1..rev emitted exactly one event, so the
+                # replay is complete iff the ring still holds start_rev
+                oldest = (self._history[0].kv.mod_rev if self._history
+                          else self._rev + 1)
+                if start_rev < oldest and oldest > 1:
+                    raise CompactedError(
+                        f"start_rev {start_rev} compacted "
+                        f"(oldest retained {oldest})")
+                for ev in self._history:
+                    if (ev.kv.mod_rev >= start_rev
+                            and ev.kv.key.startswith(prefix)):
+                        w._emit(ev)
             self._watchers.append(w)
             return w
 
@@ -287,6 +326,7 @@ class MemStore:
                 self._watchers.remove(w)
 
     def _notify(self, ev: Event):
+        self._history.append(ev)
         for w in self._watchers:
             if ev.kv.key.startswith(w.prefix):
                 w._emit(ev)

@@ -1,0 +1,473 @@
+"""Networked coordination store: MemStore served over TCP.
+
+The reference's topology is N machines talking to etcd over gRPC
+(client.go:24-114, watches at job.go:369-371).  This module provides the
+same boundary for the rebuild: :class:`StoreServer` exposes a MemStore's
+full API (revisioned KV, prefix watches with prev-kv, leases, CAS txns)
+over a line-delimited JSON protocol, and :class:`RemoteStore` is a
+drop-in client with the identical Python surface — every component
+(scheduler, agents, web, noticer) runs unchanged against either.
+
+Wire protocol (one JSON object per line, UTF-8):
+
+    client -> server   {"i": <id>, "o": <op>, "a": [args...]}
+    server -> client   {"i": <id>, "r": <result>}            (ok)
+                       {"i": <id>, "e": <msg>, "k": <kind>}  (error)
+                       {"w": <wid>, "ev": <event>}           (watch push)
+
+KV wire form: [key, value, create_rev, mod_rev, lease]
+Event wire form: [type, kv, prev_kv-or-null]
+
+Design notes:
+- One reader thread per client demuxes RPC replies (by id) and watch
+  events (by wid).  Calls are synchronous RPCs; any thread may call.
+- Leases live server-side and expire by TTL whether or not the client is
+  connected — exactly etcd's behaviour, and what node-death detection
+  relies on (noticer.go:172-200).  A dropped connection closes its
+  watches but never its leases.
+- ``put_many`` batches order publication into one round trip (the
+  scheduler's dispatch plane writes whole windows at once).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import log
+from .memstore import CompactedError, DELETE, PUT, Event, KV, MemStore, \
+    Watcher
+
+
+def _kv_wire(kv: Optional[KV]):
+    if kv is None:
+        return None
+    return [kv.key, kv.value, kv.create_rev, kv.mod_rev, kv.lease]
+
+
+def _kv_unwire(w) -> Optional[KV]:
+    if w is None:
+        return None
+    return KV(key=w[0], value=w[1], create_rev=w[2], mod_rev=w[3],
+              lease=w[4])
+
+
+def _ev_wire(ev: Event):
+    return [ev.type, _kv_wire(ev.kv), _kv_wire(ev.prev_kv)]
+
+
+def _ev_unwire(w) -> Event:
+    return Event(type=w[0], kv=_kv_unwire(w[1]), prev_kv=_kv_unwire(w[2]))
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+_OPS = ("put", "put_many", "get", "get_prefix", "count_prefix", "delete",
+        "delete_prefix", "put_if_absent", "put_if_mod_rev", "grant",
+        "keepalive", "revoke", "lease_ttl_remaining")
+
+
+class _Conn(socketserver.BaseRequestHandler):
+    def setup(self):
+        self.wlock = threading.Lock()
+        self.watchers: Dict[int, Tuple[Watcher, threading.Thread]] = {}
+        self.alive = True
+        self.rfile = self.request.makefile("rb")
+
+    def _send(self, obj):
+        data = (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+        with self.wlock:
+            try:
+                self.request.sendall(data)
+            except OSError:
+                self.alive = False
+
+    def _pump(self, wid: int, w: Watcher):
+        """Forward one watcher's events to the client until closed."""
+        while self.alive:
+            ev = w.get(timeout=0.25)
+            if ev is None:
+                if w._closed:
+                    return
+                continue
+            self._send({"w": wid, "ev": _ev_wire(ev)})
+
+    def handle(self):
+        store: MemStore = self.server.store      # type: ignore[attr-defined]
+        while self.alive:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                return
+            rid, op, args = req.get("i"), req.get("o"), req.get("a", [])
+            try:
+                if op == "watch":
+                    prefix, start_rev = args[0], args[1]
+                    w = store.watch(prefix, start_rev=start_rev) \
+                        if start_rev else store.watch(prefix)
+                    wid = rid
+                    t = threading.Thread(target=self._pump, args=(wid, w),
+                                         daemon=True,
+                                         name=f"store-pump-{wid}")
+                    self.watchers[wid] = (w, t)
+                    t.start()
+                    self._send({"i": rid, "r": wid})
+                elif op == "unwatch":
+                    ent = self.watchers.pop(args[0], None)
+                    if ent:
+                        ent[0].close()
+                    self._send({"i": rid, "r": True})
+                elif op in _OPS:
+                    r = getattr(store, op)(*args)
+                    if op == "get":
+                        r = _kv_wire(r)
+                    elif op == "get_prefix":
+                        r = [_kv_wire(kv) for kv in r]
+                    self._send({"i": rid, "r": r})
+                else:
+                    self._send({"i": rid, "e": f"unknown op {op!r}",
+                                "k": "ValueError"})
+            except KeyError as e:
+                self._send({"i": rid, "e": str(e), "k": "KeyError"})
+            except CompactedError as e:
+                self._send({"i": rid, "e": str(e), "k": "CompactedError"})
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                self._send({"i": rid, "e": f"{type(e).__name__}: {e}",
+                            "k": "RuntimeError"})
+
+    def finish(self):
+        self.alive = False
+        for w, _t in self.watchers.values():
+            w.close()
+        self.watchers.clear()
+
+
+class StoreServer:
+    """Serve a MemStore over TCP.  ``addr`` like ("127.0.0.1", 7070);
+    port 0 picks a free port (see :attr:`port`)."""
+
+    def __init__(self, store: Optional[MemStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store or MemStore()
+        self.store.start_sweeper()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+        self._srv = _Server((host, port), _Conn)
+        self._srv.store = self.store                 # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StoreServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="store-server")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=3)
+        self.store.close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RemoteWatcher:
+    """Client-side watch stream; same surface as memstore.Watcher."""
+
+    def __init__(self, store: "RemoteStore", wid: int, prefix: str,
+                 start_rev: int = 0):
+        self._store = store
+        self._wid = wid
+        self.prefix = prefix
+        self.start_rev = start_rev
+        self.last_rev = 0          # highest mod_rev seen (resume point)
+        import queue
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._closed = False
+
+    def _emit(self, ev: Event):
+        if not self._closed:
+            if ev.kv.mod_rev > self.last_rev:
+                self.last_rev = ev.kv.mod_rev
+            self._q.put(ev)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        import queue
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def drain(self) -> List[Event]:
+        import queue
+        out = []
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except queue.Empty:
+                return out
+            if ev is not None:
+                out.append(ev)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._store._unwatch(self._wid)
+        self._q.put(None)
+
+    def __iter__(self):
+        while not self._closed:
+            ev = self.get()
+            if ev is None:
+                return
+            yield ev
+
+
+class RemoteStoreError(RuntimeError):
+    pass
+
+
+class RemoteStore:
+    """TCP client with MemStore's exact API — scheduler/agent/web/noticer
+    run unchanged against it (the rebuild's etcd clientv3,
+    client.go:24-114).
+
+    Self-healing: a dropped connection fails in-flight calls (callers see
+    :class:`RemoteStoreError` and retry at their own cadence), then a
+    background loop reconnects with backoff and re-establishes every open
+    watch from its last seen revision — replaying the missed deltas.  If
+    the server has compacted past that revision the watch resumes from
+    the current revision and the gap is logged (callers that need
+    completeness re-list, exactly like an etcd client)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 reconnect: bool = True):
+        self.host, self.port = host, port
+        self._timeout = timeout
+        self._reconnect = reconnect
+        self._wlock = threading.Lock()
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self._pending: Dict[int, dict] = {}
+        self._pending_ev: Dict[int, threading.Event] = {}
+        self._watchers: Dict[int, RemoteWatcher] = {}
+        self._closed = False
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._connect()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _connect(self):
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=30)
+        self._sock.settimeout(None)
+        self._rfile = self._sock.makefile("rb")
+        threading.Thread(target=self._read_loop,
+                         args=(self._sock, self._rfile), daemon=True,
+                         name="remote-store-reader").start()
+
+    def _read_loop(self, sock, rfile):
+        while not self._closed:
+            try:
+                line = rfile.readline()
+            except OSError:
+                break
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "w" in msg:
+                w = self._watchers.get(msg["w"])
+                if w is not None:
+                    w._emit(_ev_unwire(msg["ev"]))
+                continue
+            rid = msg.get("i")
+            ev = self._pending_ev.get(rid)
+            if ev is not None:
+                self._pending[rid] = msg
+                ev.set()
+        # connection gone: fail in-flight calls, then heal or finalize
+        for rid, ev in list(self._pending_ev.items()):
+            self._pending.setdefault(rid, {"e": "connection closed",
+                                           "k": "RemoteStoreError"})
+            ev.set()
+        if self._closed or not self._reconnect:
+            self._finalize()
+            return
+        threading.Thread(target=self._heal, daemon=True,
+                         name="remote-store-heal").start()
+
+    def _finalize(self):
+        self._closed = True
+        for w in list(self._watchers.values()):
+            w._closed = True
+            w._q.put(None)
+
+    def _heal(self):
+        delay = 0.2
+        while not self._closed:
+            try:
+                self._connect()
+                break
+            except OSError:
+                time.sleep(delay)
+                delay = min(2.0, delay * 2)
+        if self._closed:
+            self._finalize()
+            return
+        # re-establish watches, resuming after the last delivered event
+        for wid, w in list(self._watchers.items()):
+            if w._closed:
+                continue
+            resume = w.last_rev + 1 if w.last_rev else 0
+            try:
+                try:
+                    self._call("watch", w.prefix, resume, rid=wid)
+                except CompactedError:
+                    log.warnf("watch %r resume rev %d compacted; "
+                              "re-watching from current (deltas lost)",
+                              w.prefix, resume)
+                    self._call("watch", w.prefix, 0, rid=wid)
+            except (RemoteStoreError, OSError) as e:
+                log.errorf("watch %r re-establish failed: %s", w.prefix, e)
+        log.infof("store connection re-established (%s:%d)",
+                  self.host, self.port)
+
+    def _call(self, op: str, *args, rid: Optional[int] = None):
+        if self._closed:
+            raise RemoteStoreError("store connection closed")
+        if rid is None:
+            with self._id_lock:
+                rid = self._next_id
+                self._next_id += 1
+        done = threading.Event()
+        self._pending_ev[rid] = done
+        data = (json.dumps({"i": rid, "o": op, "a": list(args)},
+                           separators=(",", ":")) + "\n").encode()
+        try:
+            sock = self._sock
+            if sock is None:
+                raise RemoteStoreError("store disconnected")
+            try:
+                with self._wlock:
+                    sock.sendall(data)
+            except OSError as e:
+                raise RemoteStoreError(f"send failed: {e}")
+            if not done.wait(self._timeout):
+                raise RemoteStoreError(f"rpc timeout: {op}")
+            msg = self._pending.pop(rid)
+        finally:
+            self._pending_ev.pop(rid, None)
+            self._pending.pop(rid, None)
+        if "e" in msg:
+            kind = msg.get("k")
+            if kind == "KeyError":
+                raise KeyError(msg["e"])
+            if kind == "CompactedError":
+                raise CompactedError(msg["e"])
+            raise RemoteStoreError(msg["e"])
+        return msg.get("r")
+
+    # -- KV ----------------------------------------------------------------
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        return self._call("put", key, value, lease)
+
+    def put_many(self, items, lease: int = 0) -> int:
+        return self._call("put_many", list(items), lease)
+
+    def get(self, key: str) -> Optional[KV]:
+        return _kv_unwire(self._call("get", key))
+
+    def get_prefix(self, prefix: str) -> List[KV]:
+        return [_kv_unwire(w) for w in self._call("get_prefix", prefix)]
+
+    def count_prefix(self, prefix: str) -> int:
+        return self._call("count_prefix", prefix)
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key)
+
+    def delete_prefix(self, prefix: str) -> int:
+        return self._call("delete_prefix", prefix)
+
+    # -- txns --------------------------------------------------------------
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        return self._call("put_if_absent", key, value, lease)
+
+    def put_if_mod_rev(self, key: str, value: str, mod_rev: int,
+                       lease: int = 0) -> bool:
+        return self._call("put_if_mod_rev", key, value, mod_rev, lease)
+
+    # -- leases ------------------------------------------------------------
+
+    def grant(self, ttl: float) -> int:
+        return self._call("grant", ttl)
+
+    def keepalive(self, lease_id: int) -> bool:
+        return self._call("keepalive", lease_id)
+
+    def revoke(self, lease_id: int) -> bool:
+        return self._call("revoke", lease_id)
+
+    def lease_ttl_remaining(self, lease_id: int) -> Optional[float]:
+        return self._call("lease_ttl_remaining", lease_id)
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, prefix: str, start_rev: int = 0) -> RemoteWatcher:
+        with self._id_lock:
+            wid = self._next_id          # reserve the id we'll rpc with
+            self._next_id += 1
+        # register the watcher BEFORE the rpc returns so no event races
+        # past the registration (the server keys pushes by the request id)
+        w = RemoteWatcher(self, wid, prefix, start_rev)
+        self._watchers[wid] = w
+        try:
+            self._call("watch", prefix, start_rev, rid=wid)
+        except Exception:
+            self._watchers.pop(wid, None)
+            raise
+        return w
+
+    def _unwatch(self, wid: int):
+        self._watchers.pop(wid, None)
+        if not self._closed:
+            try:
+                self._call("unwatch", wid)
+            except (RemoteStoreError, KeyError):
+                pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    # MemStore compat no-op: the server owns the sweeper
+    def start_sweeper(self, interval: float = 0.2):
+        pass

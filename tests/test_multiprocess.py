@@ -1,0 +1,137 @@
+"""The deployment story, end-to-end: store + scheduler + 2 agents + web
+as SEPARATE OS processes (the reference's N-machines-against-etcd
+topology, bin/node/server.go:23-70, bin/web/server.go:24-88).
+
+A job is created through the REST API, planned by the scheduler process,
+executed by both agent processes, and its results land in the shared
+log database — all plumbing crossing real process boundaries over TCP.
+"""
+
+import http.cookiejar
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from cronsun_tpu.logsink import JobLogStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(mod, *args, env=None):
+    e = dict(os.environ)
+    e["JAX_PLATFORMS"] = "cpu"
+    e["PYTHONPATH"] = REPO
+    e.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", mod, *args], cwd=REPO, env=e,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _await_ready(proc, timeout=90):
+    deadline = time.time() + timeout
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"process died rc={proc.returncode}:\n{''.join(lines)}")
+            continue
+        lines.append(line)
+        if line.startswith("READY"):
+            return line.split(None, 1)[1].strip()
+    raise AssertionError(f"no READY within {timeout}s:\n{''.join(lines)}")
+
+
+def test_full_system_multiprocess(tmp_path):
+    logdb = str(tmp_path / "logs.db")
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": logdb, "window_s": 2, "node_ttl": 5,
+        "job_capacity": 256, "node_capacity": 64, "proc_req": 0}))
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+
+        sched_p = _spawn("cronsun_tpu.bin.sched", "--store", store_addr,
+                         "--conf", str(conf))
+        procs.append(sched_p)
+        node_ps = [
+            _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                   "--conf", str(conf), "--node-id", f"mp-node-{i}")
+            for i in range(2)]
+        procs += node_ps
+        web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--conf", str(conf), "--port", "0")
+        procs.append(web_p)
+
+        _await_ready(sched_p)
+        for p in node_ps:
+            _await_ready(p)
+        web_addr = _await_ready(web_p)
+
+        # -- drive through the REST API (cookie session auth) -------------
+        cj = http.cookiejar.CookieJar()
+        op = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(cj))
+        base = f"http://{web_addr}"
+        q = urllib.parse.urlencode(
+            {"email": "admin@admin.com", "password": "admin"})
+        with op.open(f"{base}/v1/session?{q}", timeout=10) as r:
+            assert r.status == 200
+
+        job = {"name": "mp-hello", "command": "echo multiproc", "kind": 0,
+               "group": "default",
+               "rules": [{"timer": "* * * * * *",
+                          "nids": ["mp-node-0", "mp-node-1"]}]}
+        req = urllib.request.Request(
+            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with op.open(req, timeout=10) as r:
+            assert r.status == 200
+
+        with op.open(f"{base}/v1/nodes", timeout=10) as r:
+            nodes = json.loads(r.read())
+        connected = {n["id"] for n in nodes if n.get("connected")}
+        assert {"mp-node-0", "mp-node-1"} <= connected
+
+        # -- wait for cross-process executions to land --------------------
+        sink = JobLogStore(logdb)
+        deadline = time.time() + 60
+        seen = set()
+        while time.time() < deadline:
+            logs, total = sink.query_logs()
+            seen = {l.node for l in logs}
+            if total >= 4 and seen >= {"mp-node-0", "mp-node-1"}:
+                break
+            time.sleep(1)
+        logs, total = sink.query_logs()
+        assert total >= 4, f"only {total} executions landed"
+        assert {l.node for l in logs} >= {"mp-node-0", "mp-node-1"}
+        assert all(l.success for l in logs)
+        assert all("multiproc" in l.output for l in logs)
+
+        # REST view of the same results
+        with op.open(f"{base}/v1/logs", timeout=10) as r:
+            api_logs = json.loads(r.read())
+        assert api_logs["total"] >= 4
+        sink.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -1,0 +1,193 @@
+"""RemoteStore over StoreServer: the networked store must behave exactly
+like MemStore — KV revisions, prefix watches with prev-kv, leases, CAS
+txns, bulk puts, and watch replay from a revision."""
+
+import time
+
+import pytest
+
+from cronsun_tpu.store import CompactedError, MemStore
+from cronsun_tpu.store.remote import RemoteStore, StoreServer
+
+
+@pytest.fixture
+def remote():
+    srv = StoreServer().start()
+    client = RemoteStore(srv.host, srv.port)
+    yield srv, client
+    client.close()
+    srv.stop()
+
+
+def test_kv_roundtrip_and_revisions(remote):
+    _, s = remote
+    r1 = s.put("/a", "1")
+    r2 = s.put("/a", "2")
+    assert r2 == r1 + 1
+    kv = s.get("/a")
+    assert kv.value == "2" and kv.create_rev == r1 and kv.mod_rev == r2
+    assert s.get("/missing") is None
+    s.put("/a/b", "x")
+    assert [kv.key for kv in s.get_prefix("/a")] == ["/a", "/a/b"]
+    assert s.count_prefix("/a") == 2
+    assert s.delete("/a") is True
+    assert s.delete("/a") is False
+    assert s.delete_prefix("/a") == 1
+
+
+def test_txns(remote):
+    _, s = remote
+    assert s.put_if_absent("/lock", "me") is True
+    assert s.put_if_absent("/lock", "you") is False
+    kv = s.get("/lock")
+    assert kv.value == "me"
+    assert s.put_if_mod_rev("/lock", "me2", kv.mod_rev) is True
+    assert s.put_if_mod_rev("/lock", "me3", kv.mod_rev) is False
+
+
+def test_leases_expire_and_keepalive(remote):
+    _, s = remote
+    l = s.grant(0.4)
+    s.put("/leased", "v", lease=l)
+    assert s.get("/leased") is not None
+    for _ in range(4):
+        time.sleep(0.15)
+        s.keepalive(l)
+    assert s.get("/leased") is not None          # keepalive held it
+    time.sleep(0.8)
+    assert s.get("/leased") is None              # expired server-side
+    assert s.keepalive(l) is False
+    with pytest.raises(KeyError):
+        s.put("/x", "y", lease=l)
+
+
+def test_lease_survives_client_disconnect():
+    """etcd semantics: a dropped connection closes watches, not leases."""
+    srv = StoreServer().start()
+    c1 = RemoteStore(srv.host, srv.port)
+    l = c1.grant(30)
+    c1.put("/k", "v", lease=l)
+    c1.close()
+    time.sleep(0.3)
+    c2 = RemoteStore(srv.host, srv.port)
+    assert c2.get("/k") is not None
+    assert c2.keepalive(l) is True
+    c2.close()
+    srv.stop()
+
+
+def test_watch_stream_and_prev_kv(remote):
+    _, s = remote
+    w = s.watch("/jobs/")
+    s.put("/jobs/a", "1")
+    s.put("/jobs/a", "2")
+    s.put("/other", "x")
+    s.delete("/jobs/a")
+    evs = []
+    deadline = time.time() + 3
+    while len(evs) < 3 and time.time() < deadline:
+        ev = w.get(timeout=0.2)
+        if ev:
+            evs.append(ev)
+    assert [e.type for e in evs] == ["PUT", "PUT", "DELETE"]
+    assert evs[0].is_create and evs[1].is_modify
+    assert evs[1].prev_kv.value == "1"
+    assert evs[2].prev_kv.value == "2"
+    w.close()
+    s.put("/jobs/b", "3")
+    time.sleep(0.2)
+    assert w.drain() == []
+
+
+def test_watch_replay_from_revision(remote):
+    _, s = remote
+    r = s.put("/w/a", "1")
+    s.put("/w/b", "2")
+    s.put("/w/c", "3")
+    w = s.watch("/w/", start_rev=r + 1)          # resume after the first
+    evs = []
+    deadline = time.time() + 3
+    while len(evs) < 2 and time.time() < deadline:
+        ev = w.get(timeout=0.2)
+        if ev:
+            evs.append(ev)
+    assert [e.kv.key for e in evs] == ["/w/b", "/w/c"]
+    # live events still flow after the replay
+    s.put("/w/d", "4")
+    ev = w.get(timeout=2)
+    assert ev is not None and ev.kv.key == "/w/d"
+    w.close()
+
+
+def test_watch_replay_compaction():
+    s = MemStore(history=4)
+    for i in range(10):
+        s.put(f"/k{i}", "v")
+    with pytest.raises(CompactedError):
+        s.watch("/k", start_rev=2)
+    w = s.watch("/k", start_rev=7)               # still retained
+    assert [e.kv.key for e in w.drain()] == ["/k6", "/k7", "/k8", "/k9"]
+    s.close()
+
+
+def test_put_many_single_roundtrip(remote):
+    srv, s = remote
+    items = [[f"/bulk/{i}", str(i)] for i in range(100)]
+    rev = s.put_many(items)
+    assert s.count_prefix("/bulk/") == 100
+    assert srv.store.get("/bulk/99").mod_rev == rev
+    l = s.grant(30)
+    s.put_many([["/bulk-leased/a", "1"]], lease=l)
+    s.revoke(l)
+    assert s.get("/bulk-leased/a") is None
+
+
+def test_concurrent_clients_contend_for_lock(remote):
+    srv, _ = remote
+    import threading
+    wins = []
+    def worker():
+        c = RemoteStore(srv.host, srv.port)
+        if c.put_if_absent("/the-lock", "x"):
+            wins.append(1)
+        c.close()
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(wins) == 1
+
+
+def test_client_heals_connection_and_resumes_watch(remote):
+    """A broken TCP connection must not kill the client: calls fail
+    transiently, then the store reconnects and re-establishes watches
+    from their last seen revision (no deltas lost)."""
+    srv, s = remote
+    w = s.watch("/heal/")
+    s.put("/heal/a", "1")
+    ev = w.get(timeout=2)
+    assert ev is not None and ev.kv.key == "/heal/a"
+    # sever the TCP connection out from under the client
+    s._sock.close()
+    # events written while the client is down...
+    srv.store.put("/heal/b", "2")
+    # ...are replayed after the heal
+    deadline = time.time() + 10
+    got = []
+    while time.time() < deadline and len(got) < 1:
+        ev = w.get(timeout=0.3)
+        if ev:
+            got.append(ev)
+    assert [e.kv.key for e in got] == ["/heal/b"], f"got {got}"
+    # plain RPCs work again too
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            s.put("/heal/c", "3")
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert s.get("/heal/c").value == "3"
+    ev = w.get(timeout=2)
+    assert ev is not None and ev.kv.key == "/heal/c"
