@@ -91,6 +91,45 @@ def main():
     T0 = 1_753_000_000
     rng = np.random.default_rng(0)
 
+    # Host<->device round-trip floor: the minimum any SYNCHRONOUS per-call
+    # metric can reach on this link (dispatch + 4-byte fetch of a trivial
+    # op).  On a locally-attached chip this is sub-ms; through a network
+    # tunnel it is the dominant term of every sync latency below.
+    x = jnp.zeros(1, jnp.int32)
+    np.asarray(x + 1)
+    rtts = []
+    for _ in range(10):
+        s = time.time()
+        np.asarray(x + 1)
+        rtts.append((time.time() - s) * 1000)
+    detail["rtt_floor_ms"] = round(float(np.median(rtts)), 2)
+
+    # On-TPU kernel equivalence: compiled pallas bid/fanout vs the jnp
+    # reference path at collision scale (dense ties across 10k nodes).
+    from cronsun_tpu.ops.assign import _bid_jnp, _fanout_jnp
+    from cronsun_tpu.ops.pallas_kernels import bid_argmin, fanout_add
+    Keq, Neq = 2048, 10240
+    packed_eq = jax.random.bits(jax.random.PRNGKey(7), (Keq, Neq // 32),
+                                dtype=jnp.uint32)
+    # heavy ties: loads quantized to 4 distinct values
+    load_eq = jnp.asarray(
+        rng.integers(0, 4, Neq).astype(np.float32))
+    w_eq = jnp.asarray(rng.random(Keq).astype(np.float32))
+    bp, cp = bid_argmin(packed_eq, load_eq)
+    bj, cj = _bid_jnp(packed_eq, load_eq)
+    fp = fanout_add(packed_eq, w_eq)
+    fj = _fanout_jnp(packed_eq, w_eq)
+    kernels_equal = (
+        # bid choices must be BIT-identical (placement determinism);
+        # fanout is an f32 sum whose MXU accumulation order differs from
+        # einsum's — equality up to accumulation noise (~2e-4 relative
+        # at 2k terms, measured) is the correct bar for a load estimate
+        bool(jnp.array_equal(cp, cj))
+        and bool(jnp.allclose(bp, bj, rtol=1e-6, atol=1e-6))
+        and bool(jnp.allclose(fp, fj, rtol=1e-3, atol=1e-2)))
+    detail["kernels_equal"] = kernels_equal
+    log(f"kernels_equal={kernels_equal} rtt_floor={detail['rtt_floor_ms']}ms")
+
     # ---- config 1: 100-job single-node tick --------------------------------
     log("config 1: 100-job single-node tick")
     p1 = TickPlanner(job_capacity=128, node_capacity=32, max_fire_bucket=128)
@@ -134,8 +173,11 @@ def main():
     # ---- configs 3-5: eligibility + assignment ladder ----------------------
     def ladder(name, J, N, fire_rate, caps, bucket, ticks):
         log(f"{name}: {J} jobs x {N} nodes, fire~{fire_rate:.0%}")
+        # split buckets: ~50% of synth jobs are exclusive, so each kind's
+        # bucket needs half the combined SLA
+        bucket = (max(2048, bucket // 2), max(2048, bucket // 2))
         p = TickPlanner(job_capacity=J, node_capacity=N,
-                        max_fire_bucket=bucket)
+                        max_fire_bucket=max(bucket))
         period_lo = max(2, int(1 / fire_rate * 0.7))
         period_hi = max(period_lo + 2, int(1 / fire_rate * 1.4))
         p.set_table(synth_table(p.J, period_lo, period_hi))
@@ -173,7 +215,7 @@ def main():
     p.exclusive = jnp.asarray(rng.random(p.J) < 0.5)
     p.set_node_capacity(list(range(p.N)), [1 << 20] * p.N)
     log(f"headline: 1M x 10k windowed (W={W})")
-    SLA = 32768
+    SLA = (16384, 16384)
     bench_windows(p, T0, 2, W, sla=SLA)  # warm + compile
     for rep in range(3 if quick else 6):
         p99_samples.append(bench_windows(p, T0 + 1000 * rep, 4, W, sla=SLA))

@@ -97,5 +97,18 @@ class Keyspace:
                      job_id: str) -> str:
         return f"{self.dispatch}{node_id}/{epoch_s}/{group}/{job_id}"
 
+    # Common-kind fan-out: ONE broadcast order per (second, job); each
+    # agent decides eligibility locally (the reference's IsRunOn,
+    # job.go:616-630) instead of the scheduler writing one key per node —
+    # a 1M-job burst to 10k nodes must not be 10^10 store writes.
+    BROADCAST = "_all"
+
+    @property
+    def dispatch_all(self) -> str:
+        return f"{self.dispatch}{self.BROADCAST}/"
+
+    def dispatch_all_key(self, epoch_s: int, group: str, job_id: str) -> str:
+        return f"{self.dispatch_all}{epoch_s}/{group}/{job_id}"
+
     def sess_key(self, sid: str) -> str:
         return f"{self.sess}{sid}"

@@ -28,7 +28,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from .. import log
-from ..core import Job, Keyspace, Node
+from ..core import Group, Job, Keyspace, Node
 from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
 from ..store.memstore import DELETE, MemStore
@@ -63,7 +63,11 @@ class NodeAgent:
         self._stop = threading.Event()
         self._threads = []
         self._w_dispatch = store.watch(self.ks.dispatch + self.id + "/")
+        self._w_broadcast = store.watch(self.ks.dispatch_all)
+        self._w_groups = store.watch(self.ks.group)
         self._w_once = store.watch(self.ks.once)
+        self.groups: Dict[str, Group] = {}
+        self._load_groups()
         self.running: Dict[str, threading.Thread] = {}
 
     # ---- registration (node/node.go:64-119) ------------------------------
@@ -112,6 +116,42 @@ class NodeAgent:
             self.store.revoke(self._proc_lease)
             self._proc_lease = None
         self.sink.set_node_alived(self.id, False)
+
+    # ---- local eligibility (reference IsRunOn, job.go:616-630) -----------
+
+    def _load_groups(self):
+        for kv in self.store.get_prefix(self.ks.group):
+            self._apply_group(kv.value)
+
+    def _apply_group(self, value: str):
+        try:
+            g = Group.from_json(value)
+        except (json.JSONDecodeError, TypeError):
+            return
+        self.groups[g.id] = g
+
+    def _poll_groups(self):
+        for ev in self._w_groups.drain():
+            if ev.type == DELETE:
+                self.groups.pop(ev.kv.key[len(self.ks.group):], None)
+            else:
+                self._apply_group(ev.kv.value)
+
+    def is_run_on(self, job: Job) -> bool:
+        """Does any rule place this job on this node?  Include nodes ∪
+        include groups − exclude nodes, subtractive exclude (the intended
+        semantics; the reference's inner-loop continue is a no-op bug —
+        SURVEY.md §7)."""
+        for rule in job.rules:
+            if self.id in rule.exclude_nids:
+                continue
+            if self.id in rule.nids:
+                return True
+            if any(self.id in g.node_ids
+                   for gid in rule.gids
+                   if (g := self.groups.get(gid)) is not None):
+                return True
+        return False
 
     # ---- job lookup ------------------------------------------------------
 
@@ -292,7 +332,9 @@ class NodeAgent:
         n = 0
         deadline = self.clock() + wait
         while True:
+            self._poll_groups()
             n += self._poll_dispatch()
+            n += self._poll_broadcast()
             n += self._poll_once()
             if self.clock() >= deadline:
                 break
@@ -317,6 +359,26 @@ class NodeAgent:
             # key exists — the scheduler counts it as an outstanding
             # capacity reservation in the meantime
             self._spawn(job, epoch_s, fenced=True, order_key=ev.kv.key)
+            n += 1
+        return n
+
+    def _poll_broadcast(self) -> int:
+        """Common-kind fan-out: one order per (second, job) for the whole
+        fleet; this node runs it iff it is eligible (local IsRunOn).  The
+        key is shared — never deleted by a consumer; its lease GCs it."""
+        n = 0
+        for ev in self._w_broadcast.drain():
+            if ev.type == DELETE:
+                continue
+            rest = ev.kv.key[len(self.ks.dispatch_all):]
+            parts = rest.split("/")
+            if len(parts) != 3:
+                continue
+            epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
+            job = self._get_job(group, job_id)
+            if job is None or job.pause or not self.is_run_on(job):
+                continue
+            self._spawn(job, epoch_s, fenced=True)
             n += 1
         return n
 

@@ -103,6 +103,37 @@ def _rank_within_choice(key: jax.Array):
     return rank, order, sorted_key, first
 
 
+def _assign_excl(valid, elig_packed, load, rem_cap, cost, rounds: int,
+                 impl: str):
+    """Bid/accept rounds for a bucket of EXCLUSIVE fired jobs only.
+
+    The split-bucket planner path: Common fan-out is a single
+    :func:`fanout` pass over its own bucket, so the expensive [K, N] bid
+    sweep runs ``rounds`` times over just the exclusive fires (typically
+    a fraction of all fires).  load/rem_cap must already be padded to the
+    bitpacked width.  Traced inside the caller's jit.
+    """
+    K = valid.shape[0]
+    bid, _ = _steps(impl)
+    cost = cost.astype(jnp.float32)
+    assigned = jnp.full(K, -1, dtype=jnp.int32)
+    for r in range(rounds):
+        load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
+        best, choice = bid(elig_packed, load_eff)
+        cand = valid & (assigned < 0) & jnp.isfinite(best)
+        accept, load, rem_cap = waterfill_accept(
+            cand, choice, cost, load, rem_cap, r == rounds - 1)
+        assigned = jnp.where(accept, choice, assigned)
+    return assigned, load, rem_cap
+
+
+def _fanout_load(elig_packed, valid, cost, load, impl: str):
+    """Accumulate Common-bucket cost into per-node load (one fused pass)."""
+    _, fanout = _steps(impl)
+    w = jnp.where(valid, cost.astype(jnp.float32), 0.0)
+    return load + fanout(elig_packed, w)
+
+
 @functools.partial(jax.jit, static_argnames=("rounds", "impl"))
 def _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
                  rounds: int, impl: str):

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .assign import assign
+from .assign import _assign_excl, _fanout_load, assign
 from .schedule_table import ScheduleTable, build_table
 from .tick import fire_mask
 
@@ -40,57 +40,125 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+_CB = 256   # compact block width
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _compact(fire: jax.Array, k: int):
-    """Indices of up to k fired jobs + validity mask + overflow count."""
-    total = jnp.sum(fire.astype(jnp.int32))
-    idx = jnp.nonzero(fire, size=k, fill_value=0)[0].astype(jnp.int32)
-    valid = jnp.arange(k, dtype=jnp.int32) < total
-    return idx, valid, total
+    """Indices of up to k fired jobs + validity mask + overflow count.
+
+    NOT ``jnp.nonzero``: XLA lowers nonzero-with-size through a full sort
+    of all J rows (~9 ms/tick at 1M on v5e — measured, it dominated the
+    plan step).  Two-level counting instead: per-block fire counts + a
+    short block-level cumsum locate each output's block by binary search;
+    a [k, block] gather + row-wise running count finds the exact element.
+    Sort-free, no J-length cumsum, identical output order to nonzero."""
+    J = fire.shape[0]
+    if J % _CB:
+        # small/odd tables: plain cumsum + searchsorted (still sort-free)
+        total = jnp.sum(fire.astype(jnp.int32))
+        counts = jnp.cumsum(fire.astype(jnp.int32))
+        t = jnp.arange(1, k + 1, dtype=jnp.int32)
+        idx = jnp.searchsorted(counts, t, side="left").astype(jnp.int32)
+        valid = t <= total
+        return jnp.where(valid, idx, 0), valid, total
+    nb = J // _CB
+    f2 = fire.reshape(nb, _CB).astype(jnp.int32)
+    bcum = jnp.cumsum(f2.sum(axis=1))                       # [nb]
+    total = bcum[-1]
+    t = jnp.arange(1, k + 1, dtype=jnp.int32)
+    blk = jnp.minimum(jnp.searchsorted(bcum, t, side="left"),
+                      nb - 1).astype(jnp.int32)             # [k]
+    rows = f2[blk]                                          # [k, _CB]
+    rcum = jnp.cumsum(rows, axis=1)
+    prev = jnp.where(blk > 0, bcum[jnp.maximum(blk - 1, 0)], 0)
+    tin = (t - prev)[:, None]
+    off = jnp.sum((rcum < tin).astype(jnp.int32), axis=1)
+    idx = blk * _CB + off
+    valid = t <= total
+    return jnp.where(valid, idx, 0), valid, total
 
 
-def _bucket_assign(idx, valid, elig_packed, exclusive, cost, load, rem_cap,
-                   rounds, impl):
-    packed_k = elig_packed[idx]
-    excl_k = exclusive[idx]
-    cost_k = cost[idx]
-    return assign(valid, packed_k, excl_k, load, rem_cap, cost_k,
-                  rounds=rounds, impl=impl)
-
-
-def _tick_body(table, fields, elig, exclusive, cost, load, rem_cap,
-               k: int, rounds: int, impl: str):
-    """One second: fire -> compact -> solve -> pack [3, k] int32
-    (fired idx / total at [1,0] / assignment)."""
-    from .tick import _fire_mask_jit
-    f = [fields[i:i + 1] for i in range(7)]
-    fire = _fire_mask_jit(table, *f)[:, 0]
-    idx, valid, total = _compact(fire, k)
-    assigned_k, load, rem_cap = _bucket_assign(
-        idx, valid, elig, exclusive, cost, load, rem_cap, rounds, impl)
-    total_row = jnp.zeros_like(idx).at[0].set(total)
-    packed_out = jnp.stack([idx, total_row, assigned_k], axis=0)
-    return packed_out, load, rem_cap
-
-
-@partial(jax.jit, static_argnames=("k", "rounds", "impl"),
+@partial(jax.jit, static_argnames=("kx", "kc", "rounds", "impl"),
          donate_argnames=("load", "rem_cap"))
 def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
-                      load, rem_cap, k: int, rounds: int, impl: str):
+                      load, rem_cap, kx: int, kc: int, rounds: int,
+                      impl: str):
     """W seconds in one dispatch: lax.scan over the window, exactly the
     semantics of W consecutive single ticks (load/capacity carry through),
-    but one dispatch + one [W, 3, k] fetch — the host round-trip amortizes
-    over the window.  This is how the production loop plans ahead of
-    wall-clock (window [t+1, t+W] is solved while t executes)."""
-    def body(carry, fvec):
+    but one dispatch + one fetch — the host round-trip amortizes over the
+    window.  This is how the production loop plans ahead of wall-clock
+    (window [t+1, t+W] is solved while t executes).
+
+    Two latency asymmetries exploited:
+    - the fire mask for ALL W seconds is one fused pass before the scan —
+      the schedule table (the big [J]-width read) streams from HBM once
+      per window, not once per second;
+    - fired jobs compact into SEPARATE buckets by kind: only exclusive
+      fires (bucket kx) pay the ``rounds``x [K, N] bid sweep; Common
+      fires (bucket kc) need exactly one fan-out pass for their load.
+    """
+    from .tick import _fire_mask_jit
+    cols = [fields_w[:, i] for i in range(7)]
+    fire_w = _fire_mask_jit(table, *cols)                  # [J, W]
+
+    def body(carry, fire_col):
         load, rem_cap = carry
-        out, load, rem_cap = _tick_body(
-            table, fvec, elig, exclusive, cost, load, rem_cap,
-            k, rounds, impl)
+        xidx, xvalid, xtotal = _compact(fire_col & exclusive, kx)
+        cidx, cvalid, ctotal = _compact(fire_col & ~exclusive, kc)
+        load = _fanout_load(elig[cidx], cvalid, cost[cidx], load, impl)
+        assigned, load, rem_cap = _assign_excl(
+            xvalid, elig[xidx], load, rem_cap, cost[xidx], rounds, impl)
+        # ONE flat output per second — two arrays would be two host
+        # fetches (two tunnel round-trips) at materialize time
+        out = jnp.concatenate([
+            jnp.asarray([xtotal, ctotal], jnp.int32),
+            xidx, assigned, cidx])                     # [2 + 2*kx + kc]
         return (load, rem_cap), out
 
-    (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fields_w)
+    (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
     return outs, load, rem_cap
+
+
+class _AdaptiveBucket:
+    """Adaptive fired-bucket size: ~1.3x headroom over the last observed
+    fire count (overflowed ticks bounce back because ``feed`` reports the
+    true total, not the truncated bucket).  Grows immediately; shrinks
+    only after 300 consecutive smaller ticks (seconds of planned time,
+    regardless of window size), so the bucket — and the compiled plan
+    step — doesn't flap (a bucket change recompiles, ~20s on TPU)."""
+
+    def __init__(self, max_bucket: int, cap: int):
+        self.max_bucket = max_bucket
+        self.cap = cap
+        self.last_total = max_bucket
+        self.cur_k = 0
+        self._shrink_streak = 0
+        self._ticks_pending = 0
+
+    def feed(self, total: int, ticks: int):
+        self.last_total = total
+        self._ticks_pending += ticks
+
+    def size(self, sla: Optional[int]) -> int:
+        if sla is not None:
+            return min(_next_pow2(min(sla, self.max_bucket)), self.cap)
+        ticks = max(1, self._ticks_pending)
+        self._ticks_pending = 0
+        want = max(2048, self.last_total + (self.last_total >> 2)
+                   + (self.last_total >> 4))
+        want = min(_next_pow2(min(want, self.max_bucket)), self.cap)
+        if not self.cur_k or want > self.cur_k:
+            self.cur_k = want
+            self._shrink_streak = 0
+        elif want < self.cur_k:
+            self._shrink_streak += ticks
+            if self._shrink_streak >= 300:
+                self.cur_k = want
+                self._shrink_streak = 0
+        else:
+            self._shrink_streak = 0
+        return self.cur_k
 
 
 @dataclasses.dataclass
@@ -117,8 +185,13 @@ class TickPlanner:
     """
 
     def __init__(self, job_capacity: int, node_capacity: int,
-                 tz=_UTC, rounds: int = 3, impl: str = "auto",
+                 tz=_UTC, rounds: int = 2, impl: str = "auto",
                  max_fire_bucket: int = 65536):
+        # rounds=2 (one waterfill-quota round + one capacity-final round)
+        # is the latency/balance sweet spot on v5e: each extra round costs
+        # ~5 ms/tick at 10k nodes for marginal placement-spread gains.
+        # The reference has NO load balancing at all (lock races,
+        # job.go:243-271), so even rounds=1 dominates it on balance.
         self.tz = tz
         self.impl = impl
         self.rounds = rounds
@@ -131,14 +204,11 @@ class TickPlanner:
         self.cost = jnp.ones(self.J, jnp.float32)
         self.load = jnp.zeros(self.N, jnp.float32)
         self.rem_cap = jnp.zeros(self.N, jnp.int32)   # dead columns stay 0
-        # Adaptive fired-bucket: sized from the last observed fire count so
-        # quiet tables don't pay the max-SLA solve.  Starts at max.  Shrinks
-        # only after a long streak of small ticks (hysteresis — every bucket
-        # change recompiles the plan step, ~20s on TPU).
-        self._last_total = max_fire_bucket
-        self._cur_k = 0
-        self._shrink_streak = 0
-        self._ticks_pending = 0
+        # Adaptive fired-buckets (one per kind — exclusive fires pay the
+        # bid rounds, Common fires only the fan-out): sized from the last
+        # observed fire count so quiet tables don't pay the max-SLA solve.
+        self._bx = _AdaptiveBucket(max_fire_bucket, self.J)
+        self._bc = _AdaptiveBucket(max_fire_bucket, self.J)
 
     # -- state maintenance (all fixed-shape scatters) ----------------------
 
@@ -178,38 +248,11 @@ class TickPlanner:
     def decay_load(self, factor: float = 0.99):
         self.load = self.load * factor
 
-    def _bucket(self, sla_bucket: Optional[int]) -> int:
-        """Adaptive fired-bucket size: ~1.3x headroom over the last observed
-        fire count (overflowed ticks bounce back to the max SLA because
-        ``_last_total`` reports the true total, not the truncated bucket).
-        Grows immediately; shrinks only after 300 consecutive smaller ticks
-        (seconds of planned time, regardless of window size), so the bucket
-        (and the compiled plan step) doesn't flap."""
-        if sla_bucket is not None:
-            return min(_next_pow2(min(sla_bucket, self.max_fire_bucket)),
-                       self.J)
-        ticks = max(1, self._ticks_pending)
-        self._ticks_pending = 0
-        want = max(2048, self._last_total + (self._last_total >> 2)
-                   + (self._last_total >> 4))
-        want = min(_next_pow2(min(want, self.max_fire_bucket)), self.J)
-        if not self._cur_k or want > self._cur_k:
-            self._cur_k = want
-            self._shrink_streak = 0
-        elif want < self._cur_k:
-            self._shrink_streak += ticks
-            if self._shrink_streak >= 300:
-                self._cur_k = want
-                self._shrink_streak = 0
-        else:
-            self._shrink_streak = 0
-        return self._cur_k
-
-    def _impl(self, k: int) -> str:
+    def _impl(self, kx: int, kc: int) -> str:
         if self.impl != "auto":
             return self.impl
-        return ("pallas" if jax.default_backend() == "tpu" and k % 256 == 0
-                else "jnp")
+        return ("pallas" if jax.default_backend() == "tpu"
+                and kx % 256 == 0 and kc % 256 == 0 else "jnp")
 
     # -- the tick ----------------------------------------------------------
 
@@ -231,11 +274,19 @@ class TickPlanner:
 
     def plan_window_async(self, epoch_s: int, window_s: int,
                           sla_bucket: Optional[int] = None):
-        """Dispatch one window of ``window_s`` consecutive seconds."""
+        """Dispatch one window of ``window_s`` consecutive seconds.
+
+        ``sla_bucket`` pins both buckets: an int pins each to it, a
+        (kx, kc) tuple pins them separately."""
         from .schedule_table import FRAMEWORK_EPOCH
         from .timecal import window_fields
-        k = self._bucket(sla_bucket)
-        impl = self._impl(k)
+        if isinstance(sla_bucket, tuple):
+            sla_x, sla_c = sla_bucket
+        else:
+            sla_x = sla_c = sla_bucket
+        kx = self._bx.size(sla_x)
+        kc = self._bc.size(sla_c)
+        impl = self._impl(kx, kc)
         f = window_fields(epoch_s, window_s, tz=self.tz)
         fields_w = np.stack([
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
@@ -244,27 +295,36 @@ class TickPlanner:
         outs, self.load, self.rem_cap = _plan_window_step(
             self.table, jnp.asarray(fields_w),
             self.elig, self.exclusive, self.cost, self.load, self.rem_cap,
-            k, self.rounds, impl)
-        return epoch_s, k, outs
+            kx, kc, self.rounds, impl)
+        return epoch_s, kx, kc, outs
 
     def gather_window(self, handle):
-        """Materialize a window dispatch into a list of TickPlans."""
-        epoch_s, k, outs = handle
-        o = np.asarray(outs)                            # [W, 3, k]
+        """Materialize a window dispatch into a list of TickPlans.
+
+        Exclusive placements come first in ``fired``/``assigned``; Common
+        fires follow with assigned = -1 (fan-out is the dispatcher's job).
+        """
+        epoch_s, kx, kc, outs = handle
+        o = np.asarray(outs)                            # [W, 2 + 2*kx + kc]
         plans = []
-        for w in range(o.shape[0]):
-            total_h = int(o[w, 1, 0])
-            n_valid = min(total_h, k)
+        W = o.shape[0]
+        for w in range(W):
+            xt, ct = int(o[w, 0]), int(o[w, 1])
+            nx, nc = min(xt, kx), min(ct, kc)
+            xidx = o[w, 2:2 + nx]
+            assigned_x = o[w, 2 + kx:2 + kx + nx]
+            cidx = o[w, 2 + 2 * kx:2 + 2 * kx + nc]
+            fired = np.concatenate([xidx, cidx])
+            assigned = np.concatenate(
+                [assigned_x, np.full(nc, -1, np.int32)])
             plans.append(TickPlan(
-                epoch_s=epoch_s + w,
-                fired=o[w, 0, :n_valid],
-                assigned=o[w, 2, :n_valid],
-                overflow=max(0, total_h - k)))
-        if o.shape[0]:
-            # adaptive bucket sizing tracks the window's worst second; the
-            # shrink hysteresis counts *ticks*, not calls
-            self._last_total = int(o[:, 1, 0].max())
-            self._ticks_pending += o.shape[0]
+                epoch_s=epoch_s + w, fired=fired, assigned=assigned,
+                overflow=max(0, xt - kx) + max(0, ct - kc)))
+        if W:
+            # adaptive sizing tracks each bucket's worst second; the shrink
+            # hysteresis counts *ticks*, not calls
+            self._bx.feed(int(o[:, 0].max()), W)
+            self._bc.feed(int(o[:, 1].max()), W)
         return plans
 
     def plan_window(self, epoch_s: int, window_s: int,

@@ -10,9 +10,11 @@ Data flow per cycle (:meth:`step`):
    from leased keys, so dead executions age out);
 3. push dirty rows to the device (fixed-shape scatters);
 4. plan the next window of seconds on device;
-5. write one leased dispatch key per (node, second, job) execution order —
-   exclusive jobs to their assigned node, Common jobs fanned out to every
-   eligible node (reference job kinds, job.go:30-34).
+5. publish leased execution orders in one bulk write: exclusive jobs get a
+   per-(node, second, job) key on their assigned node; Common jobs get ONE
+   broadcast key per (second, job) that every eligible agent picks up via
+   its local IsRunOn (reference job kinds job.go:30-34, IsRunOn
+   job.go:616-630).
 
 Leadership: create-if-absent on the leader key under a lease
 (client.go:95-109 pattern).  Standby instances keep retrying; on leader
@@ -322,7 +324,9 @@ class SchedulerService:
             account(node_id, group, job_id)
         for kv in self.store.get_prefix(self.ks.dispatch):
             rest = kv.key[len(self.ks.dispatch):].split("/")
-            if len(rest) != 4:
+            if len(rest) != 4 or rest[0] == Keyspace.BROADCAST:
+                # broadcast (Common) orders reserve no exclusive capacity;
+                # their load lands via proc keys once running
                 continue
             node_id, _epoch, group, job_id = rest
             account(node_id, group, job_id)
@@ -403,16 +407,19 @@ class SchedulerService:
                     continue
                 if job.kind == KIND_ALONE and job_id in alone_live:
                     continue   # previous run still holds the fleet lock
-                if job.exclusive:
-                    node = col_to_node.get(node_col)
-                    targets = [node] if node else []
-                else:
-                    targets = self._eligible_nodes(row, col_to_node)
                 payload = json.dumps({"rule": rule_id, "kind": job.kind},
                                      separators=(",", ":"))
-                for node in targets:
-                    orders.append((self.ks.dispatch_key(
-                        node, plan.epoch_s, group, job_id), payload))
+                if job.exclusive:
+                    node = col_to_node.get(node_col)
+                    if node:
+                        orders.append((self.ks.dispatch_key(
+                            node, plan.epoch_s, group, job_id), payload))
+                else:
+                    # Common fan-out: ONE broadcast order; eligible agents
+                    # each pick it up via their local IsRunOn — the host
+                    # never walks the [J, N] matrix per fire
+                    orders.append((self.ks.dispatch_all_key(
+                        plan.epoch_s, group, job_id), payload))
         if orders:
             # one bulk write for the whole window — the dispatch plane is
             # one store round trip, not one per (node, second, job)
@@ -440,21 +447,6 @@ class SchedulerService:
 
     def _row_cmd(self, row: int) -> Optional[Tuple[str, str, str]]:
         return self.rows.by_row.get(row)
-
-    def _eligible_nodes(self, row: int, col_to_node: Dict[int, str]) -> List[str]:
-        bits = self.builder.matrix[row]
-        out = []
-        for word_ix in np.nonzero(bits)[0]:
-            w = int(bits[word_ix])
-            b = 0
-            while w:
-                if w & 1:
-                    node = col_to_node.get(int(word_ix) * 32 + b)
-                    if node:
-                        out.append(node)
-                w >>= 1
-                b += 1
-        return out
 
     # ---- background loop -------------------------------------------------
 

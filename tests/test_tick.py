@@ -227,3 +227,52 @@ def test_first_fire_offset():
     off = np.asarray(off); any_f = np.asarray(any_f)
     assert any_f[0] and off[0] == 30
     assert not any_f[1]
+
+
+def test_next_fire_sparse_specs_day_scan_differential():
+    """Yearly/monthly specs resolve via the day-granularity scan; must
+    still match the scalar walker exactly."""
+    rng = random.Random(7)
+    specs, texts = [], []
+    for _ in range(40):
+        txt = (f"{rng.randint(0,59)} {rng.randint(0,59)} {rng.randint(0,23)} "
+               f"{rng.randint(1,28)} {rng.randint(1,12)} ?")
+        texts.append(txt)
+        specs.append(parse(txt))
+    # a couple of dow-only sparse specs (first-sunday-of-march style ranges)
+    for txt in ("0 0 5 ? 3 0", "30 15 22 ? 12 6", "0 0 0 29 2 ?"):
+        texts.append(txt)
+        specs.append(parse(txt))
+    table = build_table(specs)
+    for _ in range(4):
+        after = rng.randrange(1_600_000_000, 1_900_000_000)
+        got = next_fire(table, after)
+        t = dt.datetime.fromtimestamp(after, UTC)
+        for j, spec in enumerate(specs):
+            want = next_after(spec, t)
+            want_e = -1 if want is None else _epoch(want)
+            assert got[j] == want_e, (texts[j], t, got[j], want_e)
+
+
+def test_next_fire_dst_zone_random_differential():
+    """Random specs in a DST zone: day-scan candidates on transition days
+    are re-verified by the scalar engine — results must match it always."""
+    tz = ZoneInfo("America/New_York")
+    rng = random.Random(11)
+    specs, texts = [], []
+    for _ in range(25):
+        txt = (f"{rng.randint(0,59)} {rng.randint(0,59)} {rng.randint(0,23)} "
+               f"{rng.randint(1,28)} {rng.randint(1,12)} ?")
+        texts.append(txt)
+        specs.append(parse(txt))
+    table = build_table(specs)
+    # dates straddling both 2026 transitions
+    for after in (_epoch(dt.datetime(2026, 3, 7, 12, 0, tzinfo=tz)),
+                  _epoch(dt.datetime(2026, 10, 31, 12, 0, tzinfo=tz)),
+                  1_770_000_000):
+        got = next_fire(table, after, tz=tz)
+        t = dt.datetime.fromtimestamp(after, tz)
+        for j, spec in enumerate(specs):
+            want = next_after(spec, t)
+            want_e = -1 if want is None else _epoch(want)
+            assert got[j] == want_e, (texts[j], t, got[j], want_e)
