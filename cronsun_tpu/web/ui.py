@@ -35,7 +35,8 @@ INDEX_HTML = r"""<!doctype html>
 <header><b>cronsun-tpu</b>
  <a data-v=dash>Dashboard</a><a data-v=jobs>Jobs</a><a data-v=nodes>Nodes</a>
  <a data-v=groups>Groups</a><a data-v=logs>Logs</a><a data-v=exec>Executing</a>
- <span style="flex:1"></span><span id=who class=muted></span><a id=logout>logout</a>
+ <a data-v=accounts id=nav-acc style="display:none">Accounts</a>
+ <span style="flex:1"></span><a data-v=profile id=who class=muted></a><a id=logout>logout</a>
 </header>
 <main id=main></main>
 <script>
@@ -45,14 +46,15 @@ const api=async(m,p,b)=>{const r=await fetch(p,{method:m,headers:{'Content-Type'
   if(r.status===401){login();throw 'auth'}if(!r.ok)throw (d.error||r.status);return d};
 const esc=s=>String(s??'').replace(/[&<>"]/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
 const ts=t=>t?new Date(t*1000).toLocaleString():'';
-let view='dash';
+let view='dash',me={};
 function login(){$('#main').innerHTML=`<form id=login>
  <b>Sign in</b><input id=em placeholder=email value="admin@admin.com">
  <input id=pw type=password placeholder=password value="admin">
  <button>Login</button><span id=err class=bad></span></form>`;
  $('#login').onsubmit=async e=>{e.preventDefault();try{
   const d=await api('GET','/v1/session?email='+encodeURIComponent($('#em').value)+'&password='+encodeURIComponent($('#pw').value));
-  $('#who').textContent=d.email;nav(view)}catch(x){$('#err').textContent=x}}}
+  me=d;$('#who').textContent=d.email;$('#nav-acc').style.display=d.role===1?'':'none';
+  nav(view)}catch(x){$('#err').textContent=x}}}
 $('#logout').onclick=async()=>{await api('DELETE','/v1/session');login()};
 document.querySelectorAll('header a[data-v]').forEach(a=>a.onclick=()=>nav(a.dataset.v));
 function nav(v){view=v;document.querySelectorAll('header a[data-v]').forEach(a=>
@@ -101,7 +103,43 @@ const render={
   $('#main').innerHTML=`<table><tr><th>node</th><th>group</th><th>job</th><th>pid</th><th>since</th></tr>
   ${xs.map(x=>`<tr><td>${esc(x.node)}</td><td>${esc(x.group)}</td><td>${esc(x.jobId)}</td>
    <td>${esc(x.pid)}</td><td>${ts(x.time)}</td></tr>`).join('')||'<tr><td colspan=5 class=muted>nothing running</td></tr>'}</table>`},
+ async accounts(){const as=await api('GET','/v1/admin/accounts');
+  $('#main').innerHTML=`<div class=bar><button onclick="editAccount()">+ New account</button></div>
+  <table><tr><th>email</th><th>role</th><th>status</th><th></th></tr>
+  ${as.map(a=>`<tr><td>${esc(a.email)}${a.unchangeable?' <span class=muted>(built-in)</span>':''}</td>
+   <td>${a.role===1?'Administrator':'Developer'}</td>
+   <td>${a.status===1?'<span class=ok>enabled</span>':'<span class=bad>banned</span>'}</td>
+   <td><button class=plain onclick='editAccount(${JSON.stringify(a)})'>edit</button></td></tr>`).join('')}</table>`},
+ async profile(){
+  $('#main').innerHTML=`<h3>Profile — ${esc(me.email||'')}</h3>
+  <form id=pf style="max-width:340px;display:flex;flex-direction:column;gap:8px;background:#fff;padding:18px;border-radius:8px;box-shadow:0 1px 2px #0002">
+   <label>current password</label><input id=po type=password>
+   <label>new password</label><input id=pn type=password>
+   <label>repeat new password</label><input id=pn2 type=password>
+   <button>Change password</button><span id=pmsg></span></form>`;
+  $('#pf').onsubmit=async e=>{e.preventDefault();const m=$('#pmsg');
+   if($('#pn').value!==$('#pn2').value){m.className='bad';m.textContent='passwords differ';return}
+   try{await api('POST','/v1/user/setpwd',{password:$('#po').value,newPassword:$('#pn').value});
+    m.className='ok';m.textContent='password changed'}catch(x){m.className='bad';m.textContent=x}}},
 };
+window.editAccount=(a)=>{a=a||{};
+ document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
+  <b>${a.email?'Edit':'New'} account</b>
+  <label>email</label><input id=ae value="${esc(a.email||'')}" ${a.email?'disabled':''}>
+  <div class=row><div><label>role</label><select id=ar>
+    <option value=2 ${a.role!==1?'selected':''}>Developer</option>
+    <option value=1 ${a.role===1?'selected':''}>Administrator</option></select></div>
+  <div><label>status</label><select id=as_>
+    <option value=1 ${a.status!==0?'selected':''}>enabled</option>
+    <option value=0 ${a.status===0?'selected':''}>banned</option></select></div></div>
+  <label>password ${a.email?'(leave empty to keep)':''}</label><input id=ap type=password>
+  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+ </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
+ $('#sv').onclick=async e=>{e.preventDefault();try{
+  const body={email:a.email||$('#ae').value,role:+$('#ar').value,status:+$('#as_').value};
+  if($('#ap').value)body.password=$('#ap').value;
+  await api(a.email?'POST':'PUT','/v1/admin/account',body);
+  dlg.close();nav('accounts')}catch(x){alert(x)}}};
 window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
 window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert('dispatched')};
 window.delJob=async(g,id)=>{if(confirm('delete job?')){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
@@ -142,6 +180,7 @@ window.editGroup=(g)=>{g=g||{};
  $('#sv').onclick=async e=>{e.preventDefault();try{
   await api('PUT','/v1/node/group',{id:g.id,name:$('#gn').value,
    nids:$('#gm').value.split(',').map(s=>s.trim()).filter(Boolean)});dlg.close();nav('groups')}catch(x){alert(x)}}};
-api('GET','/v1/info/overview').then(()=>nav('dash')).catch(()=>login());
+api('GET','/v1/session/me').then(d=>{me=d;$('#who').textContent=d.email;
+ $('#nav-acc').style.display=d.role===1?'':'none';nav('dash')}).catch(()=>login());
 </script></body></html>
 """
