@@ -8,6 +8,7 @@ from __future__ import annotations
 import sys
 
 from .. import events, log
+from ..core.errors import DuplicateNode
 from ..logsink import JobLogStore
 from ..node.agent import NodeAgent
 from .common import base_parser, connect_store, setup_common
@@ -22,10 +23,21 @@ def main(argv=None) -> int:
 
     store = connect_store(args.store)
     sink = JobLogStore(cfg.log_db)
+    fatal: list = []
+
+    def on_fatal(e):
+        fatal.append(e)
+        events.shutdown()
+
     agent = NodeAgent(store, sink, node_id=args.node_id, ks=ks,
                       ttl=cfg.node_ttl, proc_ttl=cfg.proc_ttl,
-                      lock_ttl=cfg.lock_ttl, proc_req=cfg.proc_req)
-    agent.start()
+                      lock_ttl=cfg.lock_ttl, proc_req=cfg.proc_req,
+                      on_fatal=on_fatal)
+    try:
+        agent.start()
+    except DuplicateNode as e:
+        log.errorf("%s", e)
+        return 1
     log.infof("cronsun-node %s up (store %s)", agent.id, args.store)
     print(f"READY {agent.id}", flush=True)
 
@@ -42,7 +54,7 @@ def main(argv=None) -> int:
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()
-    return 0
+    return 1 if fatal else 0
 
 
 if __name__ == "__main__":

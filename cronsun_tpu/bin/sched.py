@@ -15,8 +15,16 @@ from .common import base_parser, connect_store, setup_common
 def main(argv=None) -> int:
     ap = base_parser(__doc__)
     ap.add_argument("--node-id", default="scheduler-1")
+    ap.add_argument("--profile-port", type=int, default=0, metavar="PORT",
+                    help="start a jax.profiler server (TensorBoard-"
+                         "connectable) so tick/assign spans can be captured "
+                         "live; 0 disables")
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
+    if args.profile_port:
+        import jax
+        jax.profiler.start_server(args.profile_port)
+        log.infof("jax profiler server on :%d", args.profile_port)
 
     tz = None
     if cfg.timezone and cfg.timezone.upper() != "UTC":

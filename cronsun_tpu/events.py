@@ -44,10 +44,21 @@ def clear():
         _handlers.clear()
 
 
+_stop = threading.Event()
+
+
+def shutdown():
+    """Release a blocked :func:`wait` programmatically — the path a
+    component takes when it hits a fatal condition (e.g. the node agent
+    losing its identity to a live replacement) and the process must wind
+    down without an operator signal."""
+    _stop.set()
+
+
 def wait():
-    """Block until SIGINT/SIGTERM, then emit EXIT."""
-    done = threading.Event()
-    signal.signal(signal.SIGINT, lambda *a: done.set())
-    signal.signal(signal.SIGTERM, lambda *a: done.set())
-    done.wait()
+    """Block until SIGINT/SIGTERM (or :func:`shutdown`), then emit EXIT."""
+    _stop.clear()
+    signal.signal(signal.SIGINT, lambda *a: _stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: _stop.set())
+    _stop.wait()
     emit(EXIT)

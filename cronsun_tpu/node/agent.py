@@ -29,6 +29,7 @@ from typing import Callable, Dict, Optional
 
 from .. import log
 from ..core import Group, Job, Keyspace, Node
+from ..core.errors import DuplicateNode
 from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
 from ..store.memstore import DELETE, MemStore
@@ -44,7 +45,8 @@ class NodeAgent:
                  ttl: float = 10.0, proc_ttl: float = 600.0,
                  lock_ttl: float = 300.0, proc_req: float = 0.0,
                  executor: Optional[Executor] = None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 on_fatal: Optional[Callable] = None):
         self.store = store
         self.sink = sink
         self.ks = ks or Keyspace()
@@ -55,6 +57,7 @@ class NodeAgent:
         self.proc_req = proc_req   # short-run suppression (proc.go:218-236)
         self.executor = executor or Executor()
         self.clock = clock
+        self.on_fatal = on_fatal
 
         self._lease: Optional[int] = None
         self._proc_lease: Optional[int] = None
@@ -73,14 +76,51 @@ class NodeAgent:
     # ---- registration (node/node.go:64-119) ------------------------------
 
     def register(self):
+        self._probe_duplicate()
         self._lease = self.store.grant(self.ttl + 2)
-        self.store.put(self.ks.node_key(self.id), str(os.getpid()),
+        self.store.put(self.ks.node_key(self.id),
+                       f"{socket.gethostname()}:{os.getpid()}",
                        lease=self._lease)
         self._ensure_proc_lease()
         node = Node(id=self.id, pid=os.getpid(), ip=self.id,
                     hostname=socket.gethostname(), version=VERSION,
                     up_ts=self.clock(), alived=True)
         self.sink.upsert_node(self.id, node.to_json(), alived=True)
+
+    def _probe_duplicate(self):
+        """Duplicate-node guard (reference node.go:51-79): if the node key
+        is already registered, refuse to start rather than fight over the
+        lease.  The registration value is ``hostname:pid``; the signal-0
+        probe only applies when the registration came from THIS machine —
+        a same-host dead PID (crashed agent) is taken over.  A different
+        host's registration is refused outright while its lease lives
+        (node death clears it within ttl+2 s); we cannot probe a remote
+        PID, and assuming it dead would run two agents under one identity.
+        EPERM from the probe means the process exists (owned by another
+        user) — that is a live duplicate, not a stale key."""
+        kv = self.store.get(self.ks.node_key(self.id))
+        if kv is None:
+            return
+        host, _, pid_s = kv.value.rpartition(":")
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            return          # unparseable legacy value: take over
+        me = socket.gethostname()
+        if host and host != me:
+            raise DuplicateNode(
+                f"node {self.id!r} already registered on host {host!r} "
+                f"(pid {pid}); its lease has not expired")
+        if pid == os.getpid():
+            return          # keepalive re-register path: our own key
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return          # stale registration from a dead process
+        except PermissionError:
+            pass            # exists, different user: live duplicate
+        raise DuplicateNode(
+            f"node {self.id!r} already registered by live pid {pid}")
 
     def _ensure_proc_lease(self):
         """Keep the shared proc lease alive; on a lapse grant a fresh one
@@ -436,10 +476,20 @@ class NodeAgent:
 
         def keepalive_loop():
             # a transient store failure must not permanently kill the node
-            # (the lease would expire and the fleet would mark it dead)
+            # (the lease would expire and the fleet would mark it dead) —
+            # but losing the identity to ANOTHER live agent is fatal: keep
+            # running and this process ghost-executes orders meant for the
+            # replacement
             while not self._stop.wait(max(1.0, self.ttl / 3)):
                 try:
                     self.keepalive_once()
+                except DuplicateNode as e:
+                    log.errorf("node identity lost to a live replacement; "
+                               "shutting down: %s", e)
+                    self._stop.set()
+                    if self.on_fatal is not None:
+                        self.on_fatal(e)
+                    return
                 except Exception as e:  # noqa: BLE001
                     log.warnf("keepalive failed (retrying): %s", e)
 

@@ -100,15 +100,19 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     """
     from .tick import _fire_mask_jit
     cols = [fields_w[:, i] for i in range(7)]
-    fire_w = _fire_mask_jit(table, *cols)                  # [J, W]
+    with jax.named_scope("cronsun.fire_mask"):
+        fire_w = _fire_mask_jit(table, *cols)              # [J, W]
 
     def body(carry, fire_col):
         load, rem_cap = carry
-        xidx, xvalid, xtotal = _compact(fire_col & exclusive, kx)
-        cidx, cvalid, ctotal = _compact(fire_col & ~exclusive, kc)
-        load = _fanout_load(elig[cidx], cvalid, cost[cidx], load, impl)
-        assigned, load, rem_cap = _assign_excl(
-            xvalid, elig[xidx], load, rem_cap, cost[xidx], rounds, impl)
+        with jax.named_scope("cronsun.compact"):
+            xidx, xvalid, xtotal = _compact(fire_col & exclusive, kx)
+            cidx, cvalid, ctotal = _compact(fire_col & ~exclusive, kc)
+        with jax.named_scope("cronsun.fanout"):
+            load = _fanout_load(elig[cidx], cvalid, cost[cidx], load, impl)
+        with jax.named_scope("cronsun.assign"):
+            assigned, load, rem_cap = _assign_excl(
+                xvalid, elig[xidx], load, rem_cap, cost[xidx], rounds, impl)
         # ONE flat output per second — two arrays would be two host
         # fetches (two tunnel round-trips) at materialize time
         out = jnp.concatenate([
@@ -292,10 +296,11 @@ class TickPlanner:
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
             np.arange(window_s, dtype=np.int64) + (epoch_s - FRAMEWORK_EPOCH),
         ], axis=1).astype(np.int32)                     # [W, 7]
-        outs, self.load, self.rem_cap = _plan_window_step(
-            self.table, jnp.asarray(fields_w),
-            self.elig, self.exclusive, self.cost, self.load, self.rem_cap,
-            kx, kc, self.rounds, impl)
+        with jax.profiler.TraceAnnotation("cronsun.plan.dispatch"):
+            outs, self.load, self.rem_cap = _plan_window_step(
+                self.table, jnp.asarray(fields_w),
+                self.elig, self.exclusive, self.cost, self.load,
+                self.rem_cap, kx, kc, self.rounds, impl)
         return epoch_s, kx, kc, outs
 
     def gather_window(self, handle):
@@ -305,7 +310,8 @@ class TickPlanner:
         fires follow with assigned = -1 (fan-out is the dispatcher's job).
         """
         epoch_s, kx, kc, outs = handle
-        o = np.asarray(outs)                            # [W, 2 + 2*kx + kc]
+        with jax.profiler.TraceAnnotation("cronsun.plan.gather"):
+            o = np.asarray(outs)                        # [W, 2 + 2*kx + kc]
         plans = []
         W = o.shape[0]
         for w in range(W):

@@ -121,3 +121,55 @@ def test_proc_lease_lapse_repaired_by_keepalive():
     assert store.get_prefix(KS.proc), "proc key not re-attached after repair"
     agent.join_running()
     store.close()
+
+
+def test_duplicate_node_guard():
+    """A second agent claiming the same node identity while the first's
+    PID is alive must be refused (reference node.go:51-79); a stale
+    same-host registration from a dead PID is taken over; a foreign
+    host's registration is refused while its lease lives (we cannot
+    probe a remote PID)."""
+    import os
+    import socket
+    import pytest
+    from cronsun_tpu.core.errors import DuplicateNode
+    me = socket.gethostname()
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    # live same-host foreign pid owns the identity -> refuse
+    store.put(KS.node_key("n0"), f"{me}:{os.getppid()}")
+    with pytest.raises(DuplicateNode):
+        agent.register()
+    # another machine's registration -> refuse regardless of local pids
+    store.put(KS.node_key("n0"), f"other-host:{os.getppid()}")
+    with pytest.raises(DuplicateNode):
+        agent.register()
+    # stale same-host pid (dead process) -> take over
+    store.put(KS.node_key("n0"), f"{me}:999999999")
+    agent.register()
+    assert store.get(KS.node_key("n0")).value == f"{me}:{os.getpid()}"
+    # own registration (keepalive re-register path) -> fine
+    agent.register()
+    store.close()
+
+
+def test_duplicate_on_reregister_is_fatal():
+    """If the identity is lost to a live replacement while running, the
+    keepalive loop must stop the agent and fire on_fatal — a ghost that
+    keeps polling would execute orders meant for the replacement."""
+    import os
+    import socket
+    fatal = []
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0", ttl=0.3,
+                      on_fatal=fatal.append)
+    agent.start()
+    # replacement takes the identity; kill our lease so keepalive lapses
+    store.revoke(agent._lease)
+    store.put(KS.node_key("n0"), f"{socket.gethostname()}:{os.getppid()}")
+    deadline = time.time() + 5
+    while time.time() < deadline and not fatal:
+        time.sleep(0.05)
+    assert fatal, "agent did not report fatal identity loss"
+    assert agent._stop.is_set()
+    store.close()
