@@ -1,6 +1,12 @@
 """Coordination store server — the rebuild's etcd.
 
     python -m cronsun_tpu.bin.store [--host H] [--port P] [--conf F]
+                                    [--native]
+
+With --native the C++ server (native/stored.cc) serves instead of the
+Python one: same wire protocol and semantics (the conformance suite in
+tests/test_remote_store.py runs against both), no GIL, O(log n) prefix
+scans — the production choice.
 """
 
 from __future__ import annotations
@@ -16,17 +22,32 @@ def main(argv=None) -> int:
     ap = base_parser(__doc__, store_required=False)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=7070)
+    ap.add_argument("--native", action="store_true",
+                    help="serve with the native C++ store")
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
 
-    srv = StoreServer(host=args.host, port=args.port).start()
+    rc = [0]
+    if args.native:
+        from ..store.native import NativeStoreServer
+        srv = NativeStoreServer(host=args.host, port=args.port).start()
+
+        def child_died(code: int):
+            # the wrapper must not sit healthy-looking in front of a dead
+            # store — exit so process supervision restarts the pair
+            log.errorf("native store exited rc=%d; shutting down", code)
+            rc[0] = code if code > 0 else 1   # signal deaths -> plain 1
+            events.shutdown()
+        srv.monitor(child_died)
+    else:
+        srv = StoreServer(host=args.host, port=args.port).start()
     log.infof("cronsun-store serving on %s:%d", srv.host, srv.port)
     print(f"READY {srv.host}:{srv.port}", flush=True)
     events.on(events.EXIT, srv.stop)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()
-    return 0
+    return rc[0]
 
 
 if __name__ == "__main__":

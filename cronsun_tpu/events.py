@@ -6,6 +6,7 @@ SIGINT/SIGTERM, then emits EXIT — the shutdown fan-out the entrypoints use.
 
 from __future__ import annotations
 
+import inspect
 import signal
 import threading
 from typing import Callable, Dict, List
@@ -32,11 +33,24 @@ def off(name: str, *fns: Callable):
             _handlers[name] = hs = [h for h in hs if h is not fn]
 
 
+def _wants_arg(fn: Callable) -> bool:
+    """Does the handler take a positional argument?  (Bound methods must
+    not count ``self`` — ``__code__.co_argcount`` does, which made emit
+    call zero-arg methods like ``server.stop`` with a spurious arg.)"""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return any(
+        p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        for p in sig.parameters.values())
+
+
 def emit(name: str, arg=None):
     with _lock:
         hs = list(_handlers.get(name, []))
     for fn in hs:
-        fn(arg) if fn.__code__.co_argcount else fn()
+        fn(arg) if _wants_arg(fn) else fn()
 
 
 def clear():
@@ -56,9 +70,12 @@ def shutdown():
 
 
 def wait():
-    """Block until SIGINT/SIGTERM (or :func:`shutdown`), then emit EXIT."""
+    """Block until SIGINT/SIGTERM (or :func:`shutdown`), then emit EXIT.
+    Signal handlers install only from the main thread (Python forbids it
+    elsewhere); an embedded wait() still releases via shutdown()."""
     _stop.clear()
-    signal.signal(signal.SIGINT, lambda *a: _stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: _stop.set())
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGINT, lambda *a: _stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: _stop.set())
     _stop.wait()
     emit(EXIT)

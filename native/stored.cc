@@ -1,0 +1,847 @@
+// cronsun-stored: the native coordination store server.
+//
+// The rebuild's etcd (reference client.go:24-114): revisioned KV, prefix
+// watches with prev-kv, TTL leases, CAS txns — served over the exact
+// line-delimited JSON protocol of cronsun_tpu/store/remote.py, so the
+// Python RemoteStore client (and therefore every component: scheduler,
+// agents, web, noticer) runs unchanged against it.
+//
+// Semantics are bit-for-bit those of cronsun_tpu/store/memstore.py —
+// tests/test_remote_store.py runs against both backends as the
+// conformance suite.  Differences are operational only:
+//   - std::map keyspace: prefix scans are O(log n + k), not O(n);
+//   - per-connection bounded outbox + writer thread: a slow watch
+//     consumer stalls (and eventually loses) only its own connection,
+//     never a mutation (memstore notifies under the store lock);
+//   - no GIL: concurrent clients execute ops in parallel up to the
+//     store mutex.
+//
+// Build: make -C native   (g++ -O2 -std=c++17 -pthread)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// minimal JSON (the protocol uses objects, arrays, strings, numbers, bools)
+// ---------------------------------------------------------------------------
+
+struct JV {
+  enum T { NUL, BOOL, INT, DBL, STR, ARR } t = NUL;
+  bool b = false;
+  long long i = 0;
+  double d = 0;
+  std::string s;
+  std::vector<JV> arr;
+
+  long long as_int() const { return t == DBL ? (long long)d : i; }
+  double as_dbl() const { return t == INT ? (double)i : d; }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& in) : p(in.data()), end(in.data() + in.size()) {}
+
+  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++; }
+  bool fail() { ok = false; return false; }
+
+  bool lit(const char* w, size_t n) {
+    if ((size_t)(end - p) < n || memcmp(p, w, n) != 0) return fail();
+    p += n;
+    return true;
+  }
+
+  // parses a value; top-level object fields are captured by the caller
+  bool value(JV& out) {
+    ws();
+    if (p >= end) return fail();
+    switch (*p) {
+      case '{': return fail();  // nested objects never occur in the protocol
+      case '[': {
+        p++;
+        out.t = JV::ARR;
+        ws();
+        if (p < end && *p == ']') { p++; return true; }
+        while (true) {
+          out.arr.emplace_back();
+          if (!value(out.arr.back())) return false;
+          ws();
+          if (p < end && *p == ',') { p++; continue; }
+          if (p < end && *p == ']') { p++; return true; }
+          return fail();
+        }
+      }
+      case '"': out.t = JV::STR; return str(out.s);
+      case 't': out.t = JV::BOOL; out.b = true; return lit("true", 4);
+      case 'f': out.t = JV::BOOL; out.b = false; return lit("false", 5);
+      case 'n': out.t = JV::NUL; return lit("null", 4);
+      default: return num(out);
+    }
+  }
+
+  bool hex4(unsigned& v) {
+    if (end - p < 4) return fail();
+    v = 0;
+    for (int k = 0; k < 4; k++) {
+      char c = *p++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= (unsigned)(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= (unsigned)(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= (unsigned)(c - 'A' + 10);
+      else return fail();
+    }
+    return true;
+  }
+
+  void utf8(std::string& s, unsigned cp) {
+    if (cp < 0x80) s += (char)cp;
+    else if (cp < 0x800) {
+      s += (char)(0xC0 | (cp >> 6));
+      s += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      s += (char)(0xE0 | (cp >> 12));
+      s += (char)(0x80 | ((cp >> 6) & 0x3F));
+      s += (char)(0x80 | (cp & 0x3F));
+    } else {
+      s += (char)(0xF0 | (cp >> 18));
+      s += (char)(0x80 | ((cp >> 12) & 0x3F));
+      s += (char)(0x80 | ((cp >> 6) & 0x3F));
+      s += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool str(std::string& s) {
+    if (*p != '"') return fail();
+    p++;
+    while (p < end) {
+      char c = *p++;
+      if (c == '"') return true;
+      if (c == '\\') {
+        if (p >= end) return fail();
+        char e = *p++;
+        switch (e) {
+          case '"': s += '"'; break;
+          case '\\': s += '\\'; break;
+          case '/': s += '/'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case 'n': s += '\n'; break;
+          case 'r': s += '\r'; break;
+          case 't': s += '\t'; break;
+          case 'u': {
+            unsigned v;
+            if (!hex4(v)) return false;
+            if (v >= 0xD800 && v <= 0xDBFF && end - p >= 6 && p[0] == '\\' && p[1] == 'u') {
+              p += 2;
+              unsigned lo;
+              if (!hex4(lo)) return false;
+              v = 0x10000 + ((v - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            utf8(s, v);
+            break;
+          }
+          default: return fail();
+        }
+      } else {
+        s += c;
+      }
+    }
+    return fail();
+  }
+
+  bool num(JV& out) {
+    const char* start = p;
+    bool isdbl = false;
+    if (p < end && (*p == '-' || *p == '+')) p++;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') isdbl = true;
+      p++;
+    }
+    if (p == start) return fail();
+    std::string tok(start, p);
+    if (isdbl) {
+      out.t = JV::DBL;
+      out.d = strtod(tok.c_str(), nullptr);
+    } else {
+      out.t = JV::INT;
+      out.i = strtoll(tok.c_str(), nullptr, 10);
+    }
+    return true;
+  }
+};
+
+// Parse a protocol request line: {"i": <id>, "o": <op>, "a": [...]}
+// (flat object of known fields — full object parsing isn't needed).
+static bool parse_request(const std::string& line, long long& rid, std::string& op, JV& args) {
+  JParser jp(line);
+  jp.ws();
+  if (jp.p >= jp.end || *jp.p != '{') return false;
+  jp.p++;
+  bool have_i = false, have_o = false;
+  args.t = JV::ARR;
+  while (true) {
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == '}') return have_i && have_o;
+    std::string k;
+    if (!jp.str(k)) return false;
+    jp.ws();
+    if (jp.p >= jp.end || *jp.p != ':') return false;
+    jp.p++;
+    JV v;
+    if (!jp.value(v)) return false;
+    if (k == "i" && v.t == JV::INT) { rid = v.i; have_i = true; }
+    else if (k == "o" && v.t == JV::STR) { op = std::move(v.s); have_o = true; }
+    else if (k == "a" && v.t == JV::ARR) { args = std::move(v); }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == ',') { jp.p++; continue; }
+    jp.ws();
+    if (jp.p < jp.end && *jp.p == '}') return have_i && have_o;
+    return false;
+  }
+}
+
+static void jesc(std::string& out, const std::string& s) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;  // raw UTF-8 passes through
+        }
+    }
+  }
+  out += '"';
+}
+
+static void jint(std::string& out, long long v) {
+  char buf[24];
+  snprintf(buf, sizeof buf, "%lld", v);
+  out += buf;
+}
+
+static void jdbl(std::string& out, double v) {
+  char buf[32];
+  snprintf(buf, sizeof buf, "%.17g", v);
+  // a bare integer-looking double is still valid JSON; keep as-is
+  out += buf;
+}
+
+// ---------------------------------------------------------------------------
+// store (memstore.py semantics)
+// ---------------------------------------------------------------------------
+
+struct KVRec {
+  std::string value;
+  long long create_rev = 0, mod_rev = 0, lease = 0;
+};
+
+struct Ev {
+  bool is_delete = false;
+  std::string key;
+  KVRec kv;        // post-state (tombstone for deletes: value="", lease=0)
+  bool has_prev = false;
+  KVRec prev;
+};
+
+struct LeaseRec {
+  double ttl = 0, deadline = 0;
+  std::set<std::string> keys;
+};
+
+struct Conn;  // fwd
+
+struct Sink {
+  Conn* conn;
+  long long wid;
+  std::string prefix;
+};
+
+static void kv_wire(std::string& out, const std::string& key, const KVRec& kv) {
+  out += '[';
+  jesc(out, key);
+  out += ',';
+  jesc(out, kv.value);
+  out += ',';
+  jint(out, kv.create_rev);
+  out += ',';
+  jint(out, kv.mod_rev);
+  out += ',';
+  jint(out, kv.lease);
+  out += ']';
+}
+
+static void ev_wire(std::string& out, const Ev& e) {
+  out += e.is_delete ? "[\"DELETE\"," : "[\"PUT\",";
+  kv_wire(out, e.key, e.kv);
+  out += ',';
+  if (e.has_prev) kv_wire(out, e.key, e.prev);
+  else out += "null";
+  out += ']';
+}
+
+struct KeyErr { std::string msg; };
+struct CompactedErr { std::string msg; };
+
+class Store {
+ public:
+  explicit Store(size_t history_cap) : history_cap_(history_cap) {}
+
+  // every public op locks; *_locked helpers assume the lock is held
+  std::mutex mu;
+
+  long long put(const std::string& key, const std::string& value, long long lease) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    return put_locked(key, value, lease);
+  }
+
+  long long put_many(const JV& items, long long lease) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    long long rev = rev_;
+    for (const JV& it : items.arr) {
+      if (it.t != JV::ARR || it.arr.size() < 2) throw KeyErr{"bad put_many item"};
+      rev = put_locked(it.arr[0].s, it.arr[1].s, lease);
+    }
+    return rev;
+  }
+
+  bool get(const std::string& key, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    auto it = kv_.find(key);
+    if (it == kv_.end()) return false;
+    kv_wire(out, it->first, it->second);
+    return true;
+  }
+
+  void get_prefix(const std::string& prefix, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    out += '[';
+    bool first = true;
+    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it) {
+      if (!first) out += ',';
+      first = false;
+      kv_wire(out, it->first, it->second);
+    }
+    out += ']';
+  }
+
+  long long count_prefix(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    long long n = 0;
+    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it) n++;
+    return n;
+  }
+
+  bool del(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    return delete_locked(key);
+  }
+
+  long long delete_prefix(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    std::vector<std::string> keys;
+    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it)
+      keys.push_back(it->first);
+    for (const auto& k : keys) delete_locked(k);
+    return (long long)keys.size();
+  }
+
+  bool put_if_absent(const std::string& key, const std::string& value, long long lease) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    if (kv_.count(key)) return false;
+    put_locked(key, value, lease);
+    return true;
+  }
+
+  bool put_if_mod_rev(const std::string& key, const std::string& value, long long mod_rev, long long lease) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    auto it = kv_.find(key);
+    if (mod_rev == 0) {
+      if (it != kv_.end()) return false;
+    } else if (it == kv_.end() || it->second.mod_rev != mod_rev) {
+      return false;
+    }
+    put_locked(key, value, lease);
+    return true;
+  }
+
+  long long grant(double ttl) {
+    std::lock_guard<std::mutex> g(mu);
+    long long lid = next_lease_++;
+    leases_[lid] = LeaseRec{ttl, now() + ttl, {}};
+    return lid;
+  }
+
+  bool keepalive(long long lid) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    auto it = leases_.find(lid);
+    if (it == leases_.end()) return false;
+    it->second.deadline = now() + it->second.ttl;
+    return true;
+  }
+
+  bool revoke(long long lid) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = leases_.find(lid);
+    if (it == leases_.end()) return false;
+    std::set<std::string> keys = std::move(it->second.keys);  // already sorted
+    leases_.erase(it);
+    for (const auto& k : keys) delete_locked(k);
+    return true;
+  }
+
+  bool lease_ttl_remaining(long long lid, double& out) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = leases_.find(lid);
+    if (it == leases_.end()) return false;
+    out = it->second.deadline - now();
+    return true;
+  }
+
+  void sweep() {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+  }
+
+  // watch: registers the sink and (with start_rev) replays retained
+  // events — registration AND replay delivery happen under the lock, so
+  // no concurrent mutation can be enqueued ahead of (or between) the
+  // replayed events: the client sees a strictly ordered stream.
+  void watch(Sink sink, long long start_rev);
+  void unwatch(Conn* conn, long long wid) {
+    std::lock_guard<std::mutex> g(mu);
+    for (size_t i = 0; i < sinks_.size(); i++) {
+      if (sinks_[i].conn == conn && sinks_[i].wid == wid) {
+        sinks_.erase(sinks_.begin() + i);
+        return;
+      }
+    }
+  }
+  void drop_conn(Conn* conn) {
+    std::lock_guard<std::mutex> g(mu);
+    sinks_.erase(std::remove_if(sinks_.begin(), sinks_.end(),
+                                [conn](const Sink& s) { return s.conn == conn; }),
+                 sinks_.end());
+  }
+
+ private:
+  static bool starts_with(const std::string& s, const std::string& p) {
+    return s.size() >= p.size() && memcmp(s.data(), p.data(), p.size()) == 0;
+  }
+
+  static double now() {
+    return std::chrono::duration<double>(std::chrono::steady_clock::now().time_since_epoch()).count();
+  }
+
+  long long put_locked(const std::string& key, const std::string& value, long long lease) {
+    auto prev_it = kv_.find(key);
+    LeaseRec* nl = nullptr;
+    if (lease) {
+      auto lit = leases_.find(lease);
+      if (lit == leases_.end())  // validate BEFORE any mutation
+        throw KeyErr{"lease " + std::to_string(lease) + " not found"};
+      nl = &lit->second;
+    }
+    Ev ev;
+    ev.key = key;
+    if (prev_it != kv_.end()) {
+      ev.has_prev = true;
+      ev.prev = prev_it->second;
+      if (ev.prev.lease && ev.prev.lease != lease) {
+        // a put re-binds the key's lease attachment
+        auto old = leases_.find(ev.prev.lease);
+        if (old != leases_.end()) old->second.keys.erase(key);
+      }
+    }
+    if (nl) nl->keys.insert(key);
+    rev_++;
+    KVRec rec{value, ev.has_prev ? ev.prev.create_rev : rev_, rev_, lease};
+    kv_[key] = rec;
+    ev.kv = rec;
+    notify_locked(std::move(ev));
+    return rev_;
+  }
+
+  bool delete_locked(const std::string& key) {
+    auto it = kv_.find(key);
+    if (it == kv_.end()) return false;
+    Ev ev;
+    ev.key = key;
+    ev.is_delete = true;
+    ev.has_prev = true;
+    ev.prev = it->second;
+    if (ev.prev.lease) {
+      auto lit = leases_.find(ev.prev.lease);
+      if (lit != leases_.end()) lit->second.keys.erase(key);
+    }
+    kv_.erase(it);
+    rev_++;
+    ev.kv = KVRec{"", ev.prev.create_rev, rev_, 0};  // tombstone
+    notify_locked(std::move(ev));
+    return true;
+  }
+
+  void expire_locked() {
+    double t = now();
+    std::vector<long long> dead;
+    for (auto& [lid, l] : leases_)
+      if (l.deadline <= t) dead.push_back(lid);
+    for (long long lid : dead) {
+      std::set<std::string> keys = std::move(leases_[lid].keys);
+      leases_.erase(lid);
+      for (const auto& k : keys) delete_locked(k);
+    }
+  }
+
+  void notify_locked(Ev ev);
+
+  std::map<std::string, KVRec> kv_;
+  long long rev_ = 0;
+  std::unordered_map<long long, LeaseRec> leases_;
+  long long next_lease_ = 1;
+  std::vector<Sink> sinks_;
+  std::deque<Ev> history_;
+  size_t history_cap_;
+};
+
+// ---------------------------------------------------------------------------
+// connections
+// ---------------------------------------------------------------------------
+
+struct Conn : std::enable_shared_from_this<Conn> {
+  int fd;
+  Store* store;
+  std::mutex omu;
+  std::condition_variable ocv;
+  std::deque<std::string> outbox;
+  bool dead = false;
+  // a consumer this far behind has lost the stream anyway; cut it rather
+  // than grow without bound (etcd cancels slow watchers the same way)
+  static constexpr size_t kMaxOutbox = 1u << 20;
+
+  Conn(int f, Store* s) : fd(f), store(s) {}
+
+  void enqueue(std::string msg) {
+    std::lock_guard<std::mutex> g(omu);
+    if (dead) return;
+    if (outbox.size() >= kMaxOutbox) {
+      dead = true;  // writer notices and closes
+      ocv.notify_all();
+      return;
+    }
+    outbox.push_back(std::move(msg));
+    ocv.notify_all();
+  }
+
+  void writer() {
+    while (true) {
+      std::string msg;
+      {
+        std::unique_lock<std::mutex> g(omu);
+        ocv.wait(g, [this] { return dead || !outbox.empty(); });
+        if (dead && outbox.empty()) break;
+        if (dead) break;  // dropped for overflow: don't flush
+        msg = std::move(outbox.front());
+        outbox.pop_front();
+      }
+      size_t off = 0;
+      while (off < msg.size()) {
+        ssize_t n = ::send(fd, msg.data() + off, msg.size() - off, MSG_NOSIGNAL);
+        if (n <= 0) {
+          std::lock_guard<std::mutex> g(omu);
+          dead = true;
+          break;
+        }
+        off += (size_t)n;
+      }
+      {
+        std::lock_guard<std::mutex> g(omu);
+        if (dead) break;
+      }
+    }
+    ::shutdown(fd, SHUT_RDWR);
+  }
+
+  void kill() {
+    std::lock_guard<std::mutex> g(omu);
+    dead = true;
+    ocv.notify_all();
+  }
+};
+
+void Store::notify_locked(Ev ev) {
+  // shared event body; per-sink envelope
+  std::string body;
+  ev_wire(body, ev);
+  for (const Sink& s : sinks_) {
+    if (ev.key.size() >= s.prefix.size() &&
+        memcmp(ev.key.data(), s.prefix.data(), s.prefix.size()) == 0) {
+      std::string msg = "{\"w\":";
+      jint(msg, s.wid);
+      msg += ",\"ev\":";
+      msg += body;
+      msg += "}\n";
+      s.conn->enqueue(std::move(msg));
+    }
+  }
+  history_.push_back(std::move(ev));
+  if (history_.size() > history_cap_) history_.pop_front();
+}
+
+void Store::watch(Sink sink, long long start_rev) {
+  std::lock_guard<std::mutex> g(mu);
+  if (start_rev && start_rev <= rev_) {
+    // every revision 1..rev emitted exactly one event, so the replay is
+    // complete iff the ring still holds start_rev
+    long long oldest = history_.empty() ? rev_ + 1 : history_.front().kv.mod_rev;
+    if (start_rev < oldest && oldest > 1)
+      throw CompactedErr{"start_rev " + std::to_string(start_rev) + " compacted (oldest retained " +
+                         std::to_string(oldest) + ")"};
+    for (const Ev& ev : history_) {
+      if (ev.kv.mod_rev >= start_rev && ev.key.size() >= sink.prefix.size() &&
+          memcmp(ev.key.data(), sink.prefix.data(), sink.prefix.size()) == 0) {
+        std::string msg = "{\"w\":";
+        jint(msg, sink.wid);
+        msg += ",\"ev\":";
+        ev_wire(msg, ev);
+        msg += "}\n";
+        sink.conn->enqueue(std::move(msg));
+      }
+    }
+  }
+  sinks_.push_back(std::move(sink));
+}
+
+// ---------------------------------------------------------------------------
+// request handling
+// ---------------------------------------------------------------------------
+
+static const std::string S = "";  // default string arg
+
+static const std::string& arg_s(const JV& a, size_t i) {
+  static const std::string empty;
+  return (i < a.arr.size() && a.arr[i].t == JV::STR) ? a.arr[i].s : empty;
+}
+static long long arg_i(const JV& a, size_t i, long long dflt = 0) {
+  if (i >= a.arr.size()) return dflt;
+  const JV& v = a.arr[i];
+  return (v.t == JV::INT || v.t == JV::DBL) ? v.as_int() : dflt;
+}
+static double arg_d(const JV& a, size_t i, double dflt = 0) {
+  if (i >= a.arr.size()) return dflt;
+  const JV& v = a.arr[i];
+  return (v.t == JV::INT || v.t == JV::DBL) ? v.as_dbl() : dflt;
+}
+
+static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
+  long long rid = 0;
+  std::string op;
+  JV args;
+  if (!parse_request(line, rid, op, args)) {
+    c->kill();  // protocol violation: drop, like the Python server
+    return;
+  }
+  // result built separately: a thrown error must not leave a half-written
+  // ,"r": prefix in the response
+  std::string res;
+  std::string out = "{\"i\":";
+  jint(out, rid);
+  try {
+    if (op == "put") {
+      jint(res, c->store->put(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)));
+    } else if (op == "put_many") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& items = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
+      jint(res, c->store->put_many(items, arg_i(args, 1)));
+    } else if (op == "get") {
+      if (!c->store->get(arg_s(args, 0), res)) res = "null";
+    } else if (op == "get_prefix") {
+      c->store->get_prefix(arg_s(args, 0), res);
+    } else if (op == "count_prefix") {
+      jint(res, c->store->count_prefix(arg_s(args, 0)));
+    } else if (op == "delete") {
+      res = c->store->del(arg_s(args, 0)) ? "true" : "false";
+    } else if (op == "delete_prefix") {
+      jint(res, c->store->delete_prefix(arg_s(args, 0)));
+    } else if (op == "put_if_absent") {
+      res = c->store->put_if_absent(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)) ? "true" : "false";
+    } else if (op == "put_if_mod_rev") {
+      res = c->store->put_if_mod_rev(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2), arg_i(args, 3))
+                ? "true"
+                : "false";
+    } else if (op == "grant") {
+      jint(res, c->store->grant(arg_d(args, 0)));
+    } else if (op == "keepalive") {
+      res = c->store->keepalive(arg_i(args, 0)) ? "true" : "false";
+    } else if (op == "revoke") {
+      res = c->store->revoke(arg_i(args, 0)) ? "true" : "false";
+    } else if (op == "lease_ttl_remaining") {
+      double rem;
+      if (c->store->lease_ttl_remaining(arg_i(args, 0), rem)) jdbl(res, rem);
+      else res = "null";
+    } else if (op == "watch") {
+      c->store->watch(Sink{c.get(), rid, arg_s(args, 0)}, arg_i(args, 1));
+      jint(res, rid);
+    } else if (op == "unwatch") {
+      c->store->unwatch(c.get(), arg_i(args, 0));
+      res = "true";
+    } else {
+      out += ",\"e\":\"unknown op\",\"k\":\"ValueError\"}\n";
+      c->enqueue(std::move(out));
+      return;
+    }
+    out += ",\"r\":";
+    out += res;
+  } catch (const KeyErr& e) {
+    out += ",\"e\":";
+    jesc(out, e.msg);
+    out += ",\"k\":\"KeyError\"";
+  } catch (const CompactedErr& e) {
+    out += ",\"e\":";
+    jesc(out, e.msg);
+    out += ",\"k\":\"CompactedError\"";
+  } catch (const std::exception& e) {
+    out += ",\"e\":";
+    jesc(out, std::string(e.what()));
+    out += ",\"k\":\"RuntimeError\"";
+  }
+  out += "}\n";
+  c->enqueue(std::move(out));
+}
+
+static void reader(std::shared_ptr<Conn> c) {
+  std::string buf;
+  char chunk[65536];
+  while (true) {
+    ssize_t n = ::recv(c->fd, chunk, sizeof chunk, 0);
+    if (n <= 0) break;
+    buf.append(chunk, (size_t)n);
+    size_t start = 0;
+    while (true) {
+      size_t nl = buf.find('\n', start);
+      if (nl == std::string::npos) break;
+      handle_request(c, buf.substr(start, nl - start));
+      start = nl + 1;
+    }
+    if (start) buf.erase(0, start);
+    {
+      std::lock_guard<std::mutex> g(c->omu);
+      if (c->dead) break;
+    }
+  }
+  // connection gone: its watches die with it (leases do NOT — etcd
+  // semantics; node-death detection relies on server-side TTL expiry)
+  c->store->drop_conn(c.get());
+  c->kill();
+}
+
+// ---------------------------------------------------------------------------
+// main
+// ---------------------------------------------------------------------------
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 7070;
+  size_t history = 65536;
+  double sweep_s = 0.2;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--history") history = (size_t)atoll(next());
+    else if (a == "--sweep-interval") sweep_s = atof(next());
+    else if (a == "--help") {
+      printf("cronsun-stored --host H --port P [--history N] [--sweep-interval S]\n");
+      return 0;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad host %s\n", host.c_str());
+    return 1;
+  }
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 512) != 0) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (sockaddr*)&addr, &alen);  // resolve port 0
+  printf("READY %s:%d\n", host.c_str(), (int)ntohs(addr.sin_port));
+  fflush(stdout);
+
+  static Store store(history);
+  std::thread([&] {
+    while (true) {
+      std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));
+      store.sweep();
+    }
+  }).detach();
+
+  while (true) {
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    auto c = std::make_shared<Conn>(fd, &store);
+    std::thread([c] { c->writer(); }).detach();
+    std::thread([c] {
+      reader(c);
+      ::close(c->fd);
+    }).detach();
+  }
+}

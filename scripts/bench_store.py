@@ -1,0 +1,66 @@
+"""Store server throughput: Python StoreServer vs native cronsun-stored.
+
+Aggregate put/get throughput from N concurrent client *processes* (each
+agent in a real deployment is its own process; a single-process client
+bench measures the client GIL, not the server).
+
+    python scripts/bench_store.py [--clients 8] [--n 3000]
+"""
+
+import argparse
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def worker(host, port, k, n, q):
+    from cronsun_tpu.store.remote import RemoteStore
+    c = RemoteStore(host, port)
+    t0 = time.perf_counter()
+    for i in range(n):
+        c.put(f"/c{k}/{i % 50}", "x" * 64)
+    for i in range(n):
+        c.get(f"/c{k}/{i % 50}")
+    q.put(2 * n / (time.perf_counter() - t0))
+    c.close()
+
+
+def bench(host, port, label, nclients, n):
+    q = mp.Queue()
+    ps = [mp.Process(target=worker, args=(host, port, k, n, q))
+          for k in range(nclients)]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join()
+    total = 2 * nclients * n / (time.perf_counter() - t0)
+    print(f"{label}: {total:.0f} ops/s aggregate "
+          f"({nclients} client processes)")
+    return total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--n", type=int, default=3000)
+    args = ap.parse_args()
+
+    from cronsun_tpu.store.native import NativeStoreServer
+    from cronsun_tpu.store.remote import StoreServer
+
+    py = StoreServer().start()
+    p = bench(py.host, py.port, "python", args.clients, args.n)
+    py.stop()
+    nt = NativeStoreServer()
+    n = bench(nt.host, nt.port, "native", args.clients, args.n)
+    nt.stop()
+    print(f"native/python: {n / p:.2f}x")
+
+
+if __name__ == "__main__":
+    mp.set_start_method("fork")
+    sys.exit(main())

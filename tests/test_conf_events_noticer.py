@@ -139,3 +139,49 @@ def test_noticer_sender_failure_does_not_crash():
     host = NoticerHost(store, sink, Boom())
     store.put(KS.noticer_key("n1"), json.dumps({"subject": "s", "body": "b"}))
     assert host.poll() == 0
+
+
+def test_event_bus_bound_method_arity():
+    """emit must not pass the arg to zero-arg bound methods (co_argcount
+    counts self; server.stop() as an EXIT handler used to blow up)."""
+    from cronsun_tpu import events
+
+    class Srv:
+        def __init__(self):
+            self.stopped = 0
+            self.seen = []
+
+        def stop(self):
+            self.stopped += 1
+
+        def reload(self, cfg):
+            self.seen.append(cfg)
+
+    s = Srv()
+    events.clear()
+    events.on("x", s.stop, s.reload)
+    events.emit("x", "cfg1")
+    assert s.stopped == 1
+    assert s.seen == ["cfg1"]
+    events.clear()
+
+
+def test_events_shutdown_releases_wait():
+    """events.shutdown() must release a blocked events.wait() — the fatal
+    path a component takes when the process must wind down without an
+    operator signal."""
+    import threading
+    import time
+    from cronsun_tpu import events
+
+    events.clear()
+    done = []
+    t = threading.Thread(target=lambda: (events.wait(), done.append(1)),
+                         daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not done
+    events.shutdown()
+    t.join(timeout=3)
+    assert done, "wait() did not release on shutdown()"
+    events.clear()

@@ -50,7 +50,12 @@ def _await_ready(proc, timeout=90):
     raise AssertionError(f"no READY within {timeout}s:\n{''.join(lines)}")
 
 
-def test_full_system_multiprocess(tmp_path):
+@pytest.mark.parametrize("store_backend", ["py", "native"])
+def test_full_system_multiprocess(tmp_path, store_backend):
+    if store_backend == "native":
+        from cronsun_tpu.store.native import find_binary
+        if find_binary() is None:
+            pytest.skip("native store binary unavailable")
     logdb = str(tmp_path / "logs.db")
     conf = tmp_path / "conf.json"
     conf.write_text(json.dumps({
@@ -59,7 +64,10 @@ def test_full_system_multiprocess(tmp_path):
 
     procs = []
     try:
-        store_p = _spawn("cronsun_tpu.bin.store", "--port", "0")
+        store_args = ["--port", "0"]
+        if store_backend == "native":
+            store_args.append("--native")
+        store_p = _spawn("cronsun_tpu.bin.store", *store_args)
         procs.append(store_p)
         store_addr = _await_ready(store_p)
 

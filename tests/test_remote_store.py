@@ -1,26 +1,41 @@
-"""RemoteStore over StoreServer: the networked store must behave exactly
-like MemStore — KV revisions, prefix watches with prev-kv, leases, CAS
-txns, bulk puts, and watch replay from a revision."""
+"""RemoteStore conformance over BOTH server backends: the Python
+StoreServer and the native C++ cronsun-stored must behave exactly like
+MemStore — KV revisions, prefix watches with prev-kv, leases, CAS txns,
+bulk puts, and watch replay from a revision.  One suite, two backends."""
 
 import time
 
 import pytest
 
 from cronsun_tpu.store import CompactedError, MemStore
+from cronsun_tpu.store.native import NativeStoreServer, find_binary
 from cronsun_tpu.store.remote import RemoteStore, StoreServer
 
+BACKENDS = ["py", "native"]
 
-@pytest.fixture
-def remote():
-    srv = StoreServer().start()
+
+def _make_server(backend, history=65536):
+    if backend == "py":
+        return StoreServer(MemStore(history=history)).start()
+    binary = find_binary()
+    if binary is None:
+        pytest.skip("native store binary unavailable")
+    return NativeStoreServer(binary=binary, history=history)
+
+
+@pytest.fixture(params=BACKENDS)
+def remote(request):
+    srv = _make_server(request.param)
     client = RemoteStore(srv.host, srv.port)
-    yield srv, client
+    aux = RemoteStore(srv.host, srv.port)   # independent connection
+    yield srv, client, aux
     client.close()
+    aux.close()
     srv.stop()
 
 
 def test_kv_roundtrip_and_revisions(remote):
-    _, s = remote
+    _, s, _ = remote
     r1 = s.put("/a", "1")
     r2 = s.put("/a", "2")
     assert r2 == r1 + 1
@@ -36,7 +51,7 @@ def test_kv_roundtrip_and_revisions(remote):
 
 
 def test_txns(remote):
-    _, s = remote
+    _, s, _ = remote
     assert s.put_if_absent("/lock", "me") is True
     assert s.put_if_absent("/lock", "you") is False
     kv = s.get("/lock")
@@ -46,7 +61,7 @@ def test_txns(remote):
 
 
 def test_leases_expire_and_keepalive(remote):
-    _, s = remote
+    _, s, _ = remote
     l = s.grant(0.4)
     s.put("/leased", "v", lease=l)
     assert s.get("/leased") is not None
@@ -61,9 +76,10 @@ def test_leases_expire_and_keepalive(remote):
         s.put("/x", "y", lease=l)
 
 
-def test_lease_survives_client_disconnect():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_lease_survives_client_disconnect(backend):
     """etcd semantics: a dropped connection closes watches, not leases."""
-    srv = StoreServer().start()
+    srv = _make_server(backend)
     c1 = RemoteStore(srv.host, srv.port)
     l = c1.grant(30)
     c1.put("/k", "v", lease=l)
@@ -77,7 +93,7 @@ def test_lease_survives_client_disconnect():
 
 
 def test_watch_stream_and_prev_kv(remote):
-    _, s = remote
+    _, s, _ = remote
     w = s.watch("/jobs/")
     s.put("/jobs/a", "1")
     s.put("/jobs/a", "2")
@@ -100,7 +116,7 @@ def test_watch_stream_and_prev_kv(remote):
 
 
 def test_watch_replay_from_revision(remote):
-    _, s = remote
+    _, s, _ = remote
     r = s.put("/w/a", "1")
     s.put("/w/b", "2")
     s.put("/w/c", "3")
@@ -130,12 +146,35 @@ def test_watch_replay_compaction():
     s.close()
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_watch_replay_compaction_over_wire(backend):
+    """Same compaction contract over the wire against both servers."""
+    srv = _make_server(backend, history=4)
+    s = RemoteStore(srv.host, srv.port)
+    try:
+        for i in range(10):
+            s.put(f"/k{i}", "v")
+        with pytest.raises(CompactedError):
+            s.watch("/k", start_rev=2)
+        w = s.watch("/k", start_rev=7)
+        evs = []
+        deadline = time.time() + 3
+        while len(evs) < 4 and time.time() < deadline:
+            ev = w.get(timeout=0.2)
+            if ev:
+                evs.append(ev)
+        assert [e.kv.key for e in evs] == ["/k6", "/k7", "/k8", "/k9"]
+    finally:
+        s.close()
+        srv.stop()
+
+
 def test_put_many_single_roundtrip(remote):
-    srv, s = remote
+    _, s, aux = remote
     items = [[f"/bulk/{i}", str(i)] for i in range(100)]
     rev = s.put_many(items)
     assert s.count_prefix("/bulk/") == 100
-    assert srv.store.get("/bulk/99").mod_rev == rev
+    assert aux.get("/bulk/99").mod_rev == rev
     l = s.grant(30)
     s.put_many([["/bulk-leased/a", "1"]], lease=l)
     s.revoke(l)
@@ -143,7 +182,7 @@ def test_put_many_single_roundtrip(remote):
 
 
 def test_concurrent_clients_contend_for_lock(remote):
-    srv, _ = remote
+    srv, _, _ = remote
     import threading
     wins = []
     def worker():
@@ -163,7 +202,7 @@ def test_client_heals_connection_and_resumes_watch(remote):
     """A broken TCP connection must not kill the client: calls fail
     transiently, then the store reconnects and re-establishes watches
     from their last seen revision (no deltas lost)."""
-    srv, s = remote
+    srv, s, aux = remote
     w = s.watch("/heal/")
     s.put("/heal/a", "1")
     ev = w.get(timeout=2)
@@ -171,7 +210,7 @@ def test_client_heals_connection_and_resumes_watch(remote):
     # sever the TCP connection out from under the client
     s._sock.close()
     # events written while the client is down...
-    srv.store.put("/heal/b", "2")
+    aux.put("/heal/b", "2")
     # ...are replayed after the heal
     deadline = time.time() + 10
     got = []
