@@ -199,7 +199,9 @@ def main():
                          n_ticks))
     detail.update(ladder("c4_100kx1k", 100_000, 1024, 0.2, 64, 32768,
                          n_ticks))
-    r5 = ladder("c5_1Mx10k", 1 << 20, 10240, 0.02, 1 << 20, 65536, n_ticks)
+    # bucket (16384, 16384): fired ~20.8k/tick splits ~10.4k per kind —
+    # 2x headroom per bucket at half the fetch bytes of the old 65536
+    r5 = ladder("c5_1Mx10k", 1 << 20, 10240, 0.02, 1 << 20, 32768, n_ticks)
     detail.update(r5)
 
     # headline: windowed planning (the production cadence — plan W seconds
