@@ -83,6 +83,7 @@ class ApiServer:
 
         route("GET", r"/v1/version", self.get_version, auth=False)
         route("GET", r"/v1/session", self.login, auth=False)
+        route("GET", r"/v1/session/me", self.session_me)
         route("DELETE", r"/v1/session", self.logout)
         route("POST", r"/v1/user/setpwd", self.set_password)
         route("GET", r"/v1/admin/accounts", self.admin_list, admin=True)
@@ -131,6 +132,11 @@ class ApiServer:
         sid = self.sessions.create(acc.email, acc.role)
         ctx.set_cookie("sid", sid)
         return {"email": acc.email, "role": acc.role}
+
+    def session_me(self, ctx):
+        """Who am I — the UI restores its logged-in state across page
+        reloads from this (the auth gate already resolved the session)."""
+        return {"email": ctx.session.email, "role": ctx.session.role}
 
     def logout(self, ctx):
         if ctx.sid:

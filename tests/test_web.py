@@ -214,3 +214,39 @@ def test_ui_served(world):
     import urllib.request
     html = urllib.request.urlopen(c.base + "/ui/").read().decode()
     assert "cronsun-tpu" in html
+
+
+def test_ui_api_contract(world):
+    """Every /v1 path the UI's JS calls must resolve against the server's
+    route table (the reference pairs web/ui/src/libraries/rest-client.js
+    with web/routers.go:17-114; this keeps our single-file SPA and route
+    table from drifting apart)."""
+    import re
+    from cronsun_tpu.web import ui as ui_mod
+    _, _, srv, _ = world
+    html = ui_mod.INDEX_HTML
+    called = set(re.findall(r"/v1/[A-Za-z0-9_/${}().#-]*", html))
+    assert len(called) >= 10, f"UI references too few API paths: {called}"
+    patterns = [rx for (_m, rx, *_rest) in srv.routes]
+    for path in called:
+        # JS template params -> plausible concrete values
+        concrete = re.sub(r"\$\{[^}]*\}", "x", path).split("?")[0]
+        concrete = concrete.rstrip("/#(")
+        if concrete.endswith("/v1/job/x"):  # ${gid}-${id} collapses to x
+            concrete = "/v1/job/g-x"
+        ok = any(rx.match(concrete) for rx in patterns)
+        assert ok, f"UI calls {path} -> {concrete!r}: no route matches"
+
+
+def test_session_me_restores_identity(world):
+    """GET /v1/session/me returns the logged-in identity (UI reload path)
+    and 401s without a session."""
+    _, _, srv, c = world
+    c.login()
+    code, me = c.req("GET", "/v1/session/me")
+    assert code == 200 and me["email"] == "admin@admin.com"
+    from cronsun_tpu.web.server import HttpError
+    import pytest as _pt
+    with _pt.raises(HttpError) as e:
+        srv.handle("GET", "/v1/session/me", {}, b"", {})
+    assert e.value.status == 401
