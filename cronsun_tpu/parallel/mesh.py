@@ -40,6 +40,7 @@ from ..ops.tick import _fire_mask_jit
 from ..ops.timecal import window_fields
 
 AXIS = "jobs"
+NAXIS = "nodes"
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -47,6 +48,17 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (AXIS,))
+
+
+def make_mesh2d(dj: int, dn: int) -> Mesh:
+    """2-D mesh (jobs x nodes): shards the [J, N] eligibility matrix both
+    ways.  The jobs axis is the capacity axis (schedule state); the nodes
+    axis exists for fleets whose bitpacked matrix exceeds one device's HBM
+    even after jobs-sharding (1M x 100k nodes is ~12 GB)."""
+    devs = jax.devices()
+    if dj * dn > len(devs):
+        raise ValueError(f"need {dj * dn} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:dj * dn]).reshape(dj, dn), (AXIS, NAXIS))
 
 
 def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
@@ -88,6 +100,184 @@ def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
     total_row = jnp.zeros_like(idx).at[0].set(total)
     out = jnp.stack([idx_global, total_row, assigned], axis=0)  # [3, k_local]
     return out, load, rem_cap
+
+
+def _bid_block(packed, load_blk, col0):
+    """Bid over a node-column BLOCK: like assign._bid_jnp but with the
+    tie-hash and returned choice in GLOBAL node coordinates, so the
+    cross-shard argmin reduce is deterministic regardless of how columns
+    are split."""
+    from ..ops.assign import unpack_tile
+    from ..ops.pallas_kernels import _tie
+    K, w32 = packed.shape
+    n = w32 * 32
+    elig = unpack_tile(packed, n)
+    jix = jnp.arange(K, dtype=jnp.uint32)[:, None]
+    nix = (col0 + jnp.arange(n)).astype(jnp.uint32)[None, :]
+    score = jnp.where(elig, load_blk[None, :] + _tie(jix, nix), jnp.inf)
+    score_bw = score.reshape(K, w32, 32).transpose(0, 2, 1).reshape(K, n)
+    p = jnp.argmin(score_bw, axis=1).astype(jnp.int32)
+    choice = (p % w32) * 32 + p // w32 + col0
+    best = jnp.min(score, axis=1)
+    return best, jnp.where(jnp.isfinite(best), choice, 0)
+
+
+def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
+                         rem_cap, k_local: int, rounds: int):
+    """Per-device body over the (jobs, nodes) mesh.  elig is the local
+    [J/Dj, W32/Dn] block; table/exclusive/cost are jobs-sharded
+    (replicated along nodes); load/rem_cap replicated.
+
+    Collectives per tick: one all_gather of the Common fan-out block
+    along nodes (O(N)), and per bid round one (best, choice) exchange
+    along nodes (O(Dn*K)) + the candidate exchange along jobs (O(K)) —
+    never anything proportional to J or the matrix."""
+    from ..ops.assign import _fanout_jnp
+    dj = jax.lax.axis_index(AXIS)
+    dn = jax.lax.axis_index(NAXIS)
+    j_local = elig.shape[0]
+    n_local = elig.shape[1] * 32
+    col0 = dn * n_local
+
+    f = [fields[i:i + 1] for i in range(7)]
+    fire = _fire_mask_jit(table, *f)[:, 0]
+    idx, valid, total = _compact(fire, k_local)
+    packed_k = elig[idx]
+    excl_k = exclusive[idx]
+    cost_k = cost[idx].astype(jnp.float32)
+
+    # Common fan-out: per-block partial -> concat along nodes -> sum along
+    # jobs; load stays replicated everywhere.
+    common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
+    block = _fanout_jnp(packed_k, common_w)                    # [n_local]
+    full = jax.lax.all_gather(block, NAXIS, tiled=True)        # [N]
+    load = load + jax.lax.psum(full, AXIS)
+
+    need0 = valid & excl_k
+    assigned = jnp.full(k_local, -1, dtype=jnp.int32)
+    for r in range(rounds):
+        load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
+        load_blk = jax.lax.dynamic_slice(load_eff, (col0,), (n_local,))
+        best_l, choice_l = _bid_block(packed_k, load_blk, col0)
+        # argmin reduce across the nodes axis: min score, ties to the
+        # lowest global node id (deterministic)
+        bests = jax.lax.all_gather(best_l, NAXIS)              # [Dn, k]
+        choices = jax.lax.all_gather(choice_l, NAXIS)
+        best = jnp.min(bests, axis=0)
+        is_min = (bests == best[None, :]) & jnp.isfinite(bests)
+        choice = jnp.min(jnp.where(is_min, choices, jnp.int32(1) << 30),
+                         axis=0)
+        choice = jnp.where(jnp.isfinite(best), choice, 0)
+        cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
+        # candidate exchange along jobs; identical accept on every shard
+        cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
+        choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
+        cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
+        accept_g, load, rem_cap = waterfill_accept(
+            cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
+        accept_l = jax.lax.dynamic_slice(accept_g, (dj * k_local,),
+                                         (k_local,))
+        assigned = jnp.where(accept_l, choice, assigned)
+
+    idx_global = jnp.where(jnp.arange(k_local) < total,
+                           dj * j_local + idx, -1).astype(jnp.int32)
+    total_row = jnp.zeros_like(idx).at[0].set(total)
+    out = jnp.stack([idx_global, total_row, assigned], axis=0)
+    return out, load, rem_cap
+
+
+class Sharded2DTickPlanner:
+    """Tick+assign over a (jobs x nodes) 2-D mesh: the eligibility matrix
+    shards both ways, so neither 1M-row schedule state nor 100k-node
+    bitmask width needs to fit one device.  Same contract as
+    ShardedTickPlanner."""
+
+    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
+                 rounds: int = 3, max_fire_bucket: int = 65536, tz=None):
+        import datetime
+        if mesh.axis_names != (AXIS, NAXIS):
+            raise ValueError(f"need a ({AXIS!r}, {NAXIS!r}) mesh")
+        self.mesh = mesh
+        self.tz = tz or datetime.timezone.utc
+        self.rounds = rounds
+        self.Dj = mesh.shape[AXIS]
+        self.Dn = mesh.shape[NAXIS]
+        self.J = _next_pow2(max(job_capacity, self.Dj * 256))
+        if self.J % self.Dj:
+            raise ValueError("job capacity must shard evenly")
+        word_align = 32 * self.Dn
+        self.N = ((node_capacity + word_align - 1) // word_align) * word_align
+        self.max_fire_bucket = max_fire_bucket
+        self._shard = NamedSharding(mesh, P(AXIS))
+        self._shard2 = NamedSharding(mesh, P(AXIS, NAXIS))
+        self._repl = NamedSharding(mesh, P())
+
+        from ..ops.schedule_table import build_table
+        self.table = build_table([], capacity=self.J, sharding=self._shard)
+        self.elig = jax.device_put(
+            np.zeros((self.J, self.N // 32), np.uint32), self._shard2)
+        self.exclusive = jax.device_put(np.zeros(self.J, bool), self._shard)
+        self.cost = jax.device_put(np.ones(self.J, np.float32), self._shard)
+        self.load = jax.device_put(np.zeros(self.N, np.float32), self._repl)
+        self.rem_cap = jax.device_put(np.zeros(self.N, np.int32), self._repl)
+        self._step_cache = {}
+
+    def _step(self, k_local: int):
+        if k_local not in self._step_cache:
+            from jax import shard_map
+            body = partial(_sharded2d_plan_body, k_local=k_local,
+                           rounds=self.rounds)
+            sm = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(AXIS), P(), P(AXIS, NAXIS), P(AXIS), P(AXIS),
+                          P(), P()),
+                out_specs=(P(None, AXIS), P(), P()),
+                check_vma=False)
+            self._step_cache[k_local] = jax.jit(sm)
+        return self._step_cache[k_local]
+
+    # -- state maintenance (same surface as ShardedTickPlanner) ------------
+
+    def set_table(self, table: ScheduleTable):
+        if table.capacity != self.J:
+            raise ValueError(f"table capacity {table.capacity} != {self.J}")
+        self.table = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, self._shard), table)
+
+    def set_eligibility(self, matrix: np.ndarray):
+        self.elig = jax.device_put(matrix, self._shard2)
+
+    def set_job_meta_full(self, exclusive: np.ndarray, cost: np.ndarray):
+        self.exclusive = jax.device_put(exclusive, self._shard)
+        self.cost = jax.device_put(cost.astype(np.float32), self._shard)
+
+    def set_node_capacity_full(self, caps: np.ndarray):
+        self.rem_cap = jax.device_put(caps.astype(np.int32), self._repl)
+
+    # -- tick --------------------------------------------------------------
+
+    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        f = window_fields(epoch_s, 1, tz=self.tz)
+        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
+                           f["dom"][0], f["month"][0], f["dow"][0],
+                           epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
+        out, self.load, self.rem_cap = self._step(k_local)(
+            self.table, jax.device_put(fields, self._repl), self.elig,
+            self.exclusive, self.cost, self.load, self.rem_cap)
+        o = np.asarray(out)              # [3, Dj*k_local]
+        fired, assigned, total = [], [], 0
+        for s in range(self.Dj):
+            t_s = int(o[1, s * k_local])
+            total += t_s
+            n_s = min(t_s, k_local)
+            fired.append(o[0, s * k_local:s * k_local + n_s])
+            assigned.append(o[2, s * k_local:s * k_local + n_s])
+        fired = np.concatenate(fired)
+        assigned = np.concatenate(assigned)
+        return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
+                        overflow=max(0, total - len(fired)))
 
 
 class ShardedTickPlanner:

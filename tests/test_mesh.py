@@ -101,3 +101,85 @@ def test_sharded_plan_load_replication_consistent(mesh):
     load = np.asarray(sp.load)
     assert np.isfinite(load).all() and (load >= 0).all()
     assert load.sum() > 0
+
+
+def test_sharded2d_plan_matches_fired_set_and_invariants():
+    """(jobs x nodes) 2-D mesh: fired set identical to the single-chip
+    planner; placements respect eligibility + capacity; replicated
+    load/rem_cap stay consistent."""
+    from cronsun_tpu.parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+    mesh2 = make_mesh2d(4, 2)
+    J, N = 4096, 128   # N shards into 2 column blocks of 64
+    specs, elig, excl, cost, caps = _random_state(J, N, seed=5)
+
+    sp = Sharded2DTickPlanner(mesh2, job_capacity=J, node_capacity=N,
+                              max_fire_bucket=2048)
+    sp.set_table(build_table(specs, capacity=sp.J))
+    full_elig = np.zeros((sp.J, sp.N // 32), np.uint32)
+    full_elig[:J, :N // 32] = elig
+    sp.set_eligibility(full_elig)
+    fe = np.zeros(sp.J, bool); fe[:J] = excl
+    sp.set_job_meta_full(fe, np.ones(sp.J, np.float32))
+    fcaps = np.zeros(sp.N, np.int32); fcaps[:N] = caps
+    sp.set_node_capacity_full(fcaps)
+
+    single = TickPlanner(job_capacity=sp.J, node_capacity=sp.N,
+                         max_fire_bucket=2048, impl="jnp")
+    single.set_table(build_table(specs, capacity=single.J))
+    single.set_eligibility_rows(np.arange(sp.J), full_elig)
+    single.set_job_meta(np.arange(sp.J), fe, np.ones(sp.J, np.float32))
+    single.set_node_capacity(np.arange(sp.N), fcaps)
+
+    t = 1_753_000_000
+    plan_s = sp.plan(t)
+    plan_1 = single.plan(t)
+    assert set(plan_s.fired.tolist()) == set(plan_1.fired.tolist())
+    assert plan_s.overflow == 0
+
+    unpack = lambda row: {c for c in range(N)
+                          if (elig[row, c // 32] >> (c % 32)) & 1}
+    placed = {}
+    for row, node in zip(plan_s.fired.tolist(), plan_s.assigned.tolist()):
+        if node >= 0:
+            assert excl[row], "only exclusive jobs get placements"
+            assert node in unpack(row), (row, node)
+            placed[node] = placed.get(node, 0) + 1
+    assert placed, "some placements expected"
+    for node, cnt in placed.items():
+        assert cnt <= caps[node]
+    rem = np.asarray(sp.rem_cap)[:N]
+    for node, cnt in placed.items():
+        assert rem[node] == caps[node] - cnt
+
+
+def test_sharded2d_matches_1d_exclusive_placement_counts():
+    """1-D and 2-D meshes must solve the same instance to plans of equal
+    quality: same fired set, same number of placements, both under
+    capacity (placement identity can differ — tie-hash coordinates
+    change — but coverage must not)."""
+    from cronsun_tpu.parallel.mesh import (Sharded2DTickPlanner,
+                                           ShardedTickPlanner,
+                                           make_mesh, make_mesh2d)
+    J, N = 2048, 64
+    specs, elig, excl, cost, caps = _random_state(J, N, seed=9)
+    caps = np.full(N, 10**6, np.int32)
+
+    def build(cls, mesh, **kw):
+        sp = cls(mesh, job_capacity=J, node_capacity=N,
+                 max_fire_bucket=2048, **kw)
+        sp.set_table(build_table(specs, capacity=sp.J))
+        full = np.zeros((sp.J, sp.N // 32), np.uint32)
+        full[:J, :N // 32] = elig
+        sp.set_eligibility(full)
+        fe = np.zeros(sp.J, bool); fe[:J] = excl
+        sp.set_job_meta_full(fe, np.ones(sp.J, np.float32))
+        fc = np.zeros(sp.N, np.int32); fc[:N] = caps
+        sp.set_node_capacity_full(fc)
+        return sp
+
+    p1 = build(ShardedTickPlanner, make_mesh(8), impl="jnp").plan(1_753_000_000)
+    p2 = build(Sharded2DTickPlanner, make_mesh2d(2, 4)).plan(1_753_000_000)
+    assert set(p1.fired.tolist()) == set(p2.fired.tolist())
+    n1 = sum(1 for a in p1.assigned.tolist() if a >= 0)
+    n2 = sum(1 for a in p2.assigned.tolist() if a >= 0)
+    assert n1 == n2, f"1-D placed {n1}, 2-D placed {n2}"
