@@ -2,8 +2,9 @@
 
 Functionally equivalent surface against the same /v1 REST API: dashboard
 overview, job CRUD + pause + run-now, node list with liveness, node groups,
-execution logs with filters, executing view.  Zero build step: one HTML
-string served at /ui/.
+execution logs with filters, executing view, account administration,
+profile/set-password — with en / zh-CN i18n (reference web/ui/src/i18n/).
+Zero build step: one HTML string served at /ui/.
 """
 
 INDEX_HTML = r"""<!doctype html>
@@ -33,14 +34,78 @@ INDEX_HTML = r"""<!doctype html>
  .bar{display:flex;gap:8px;margin-bottom:12px;align-items:center;flex-wrap:wrap}
 </style></head><body>
 <header><b>cronsun-tpu</b>
- <a data-v=dash>Dashboard</a><a data-v=jobs>Jobs</a><a data-v=nodes>Nodes</a>
- <a data-v=groups>Groups</a><a data-v=logs>Logs</a><a data-v=exec>Executing</a>
- <a data-v=accounts id=nav-acc style="display:none">Accounts</a>
- <span style="flex:1"></span><a data-v=profile id=who class=muted></a><a id=logout>logout</a>
+ <a data-v=dash></a><a data-v=jobs></a><a data-v=nodes></a>
+ <a data-v=groups></a><a data-v=logs></a><a data-v=exec></a>
+ <a data-v=accounts id=nav-acc style="display:none"></a>
+ <span style="flex:1"></span><a data-v=profile id=who class=muted></a>
+ <a id=langbtn title="language"></a><a id=logout></a>
 </header>
 <main id=main></main>
 <script>
 const $=s=>document.querySelector(s);
+// ---- i18n (reference: web/ui/src/i18n/ en + zh-CN) ----
+const L={en:{
+ dash:'Dashboard',jobs:'Jobs',nodes:'Nodes',groups:'Groups',logs:'Logs',
+ exec:'Executing',accounts:'Accounts',logout:'logout',signin:'Sign in',
+ email:'email',password:'password',loginBtn:'Login',
+ cJobs:'jobs',cAlive:'nodes alive',cExecs:'executions',cOk:'succeeded',cFail:'failed',
+ daily:'Daily',day:'day',total:'total',success:'success',failed:'failed',
+ newJob:'+ New job',name:'name',group:'group',command:'command',kind:'kind',
+ timers:'timers',status:'status',edit:'edit',del:'del',run:'run',
+ pause:'pause',resume:'resume',paused:'paused',active:'active',
+ hostname:'hostname',version:'version',upSince:'up since',connected:'connected',down:'down',
+ newGroup:'+ New group',nodesCol:'nodes',
+ failedOnly:'failed only',records:'records',job:'job',node:'node',begin:'begin',
+ secs:'secs',output:'output',since:'since',nothingRunning:'nothing running',
+ newAccount:'+ New account',role:'role',builtIn:'built-in',enabled:'enabled',banned:'banned',
+ admin:'Administrator',dev:'Developer',
+ profile:'Profile',curPw:'current password',newPw:'new password',
+ repPw:'repeat new password',changePw:'Change password',
+ pwDiffer:'passwords differ',pwChanged:'password changed',
+ editT:'Edit',newT:'New',account:'account',save:'Save',cancel:'Cancel',
+ keepEmpty:'(leave empty to keep)',
+ kCommon:'Common (all eligible nodes)',kAlone:'Alone (exactly one)',
+ kInterval:'Interval (one per interval)',user:'user',timeoutS:'timeout s',
+ retry:'retry',parallels:'parallels',
+ cronTimer:'cron timer (sec min hour dom month dow)',
+ nodeIds:'node ids (comma)',groupIds:'group ids',excludeNodes:'exclude nodes',
+ delJobQ:'delete job?',delGroupQ:'delete group?',dispatched:'dispatched',
+},zh:{
+ dash:'仪表盘',jobs:'任务',nodes:'节点',groups:'节点分组',logs:'执行日志',
+ exec:'正在执行',accounts:'账户',logout:'退出',signin:'登录',
+ email:'邮箱',password:'密码',loginBtn:'登录',
+ cJobs:'任务数',cAlive:'在线节点',cExecs:'执行次数',cOk:'成功',cFail:'失败',
+ daily:'每日统计',day:'日期',total:'总数',success:'成功',failed:'失败',
+ newJob:'+ 新建任务',name:'名称',group:'分组',command:'命令',kind:'类型',
+ timers:'定时器',status:'状态',edit:'编辑',del:'删除',run:'执行',
+ pause:'暂停',resume:'恢复',paused:'已暂停',active:'启用',
+ hostname:'主机名',version:'版本',upSince:'启动时间',connected:'在线',down:'离线',
+ newGroup:'+ 新建分组',nodesCol:'节点',
+ failedOnly:'只看失败',records:'条记录',job:'任务',node:'节点',begin:'开始时间',
+ secs:'耗时(秒)',output:'输出',since:'开始于',nothingRunning:'没有正在执行的任务',
+ newAccount:'+ 新建账户',role:'角色',builtIn:'内置',enabled:'启用',banned:'禁用',
+ admin:'管理员',dev:'开发者',
+ profile:'个人资料',curPw:'当前密码',newPw:'新密码',
+ repPw:'重复新密码',changePw:'修改密码',
+ pwDiffer:'两次输入的密码不一致',pwChanged:'密码已修改',
+ editT:'编辑',newT:'新建',account:'账户',save:'保存',cancel:'取消',
+ keepEmpty:'（留空保持不变）',
+ kCommon:'普通（所有可选节点执行）',kAlone:'单机（只在一个节点执行）',
+ kInterval:'间隔（每个间隔一次）',user:'用户',timeoutS:'超时(秒)',
+ retry:'重试次数',parallels:'并发上限',
+ cronTimer:'cron 定时器（秒 分 时 日 月 周）',
+ nodeIds:'节点 ID（逗号分隔）',groupIds:'分组 ID',excludeNodes:'排除节点',
+ delJobQ:'确定删除该任务？',delGroupQ:'确定删除该分组？',dispatched:'已派发',
+}};
+let lang=localStorage.lang||'en';
+const t=k=>(L[lang]&&L[lang][k])||L.en[k]||k;
+function chrome(){document.querySelectorAll('header a[data-v]').forEach(a=>{
+  if(a.id!=='who')a.textContent=t(a.dataset.v)});
+ $('#langbtn').textContent=lang==='en'?'中文':'EN';
+ $('#logout').textContent=t('logout')}
+$('#langbtn').onclick=()=>{lang=lang==='en'?'zh':'en';localStorage.lang=lang;
+ chrome();render[view]?nav(view):login()};
+// ---- plumbing ----
 const api=async(m,p,b)=>{const r=await fetch(p,{method:m,headers:{'Content-Type':'application/json'},
   body:b?JSON.stringify(b):undefined});const d=await r.json().catch(()=>({}));
   if(r.status===401){login();throw 'auth'}if(!r.ok)throw (d.error||r.status);return d};
@@ -48,9 +113,9 @@ const esc=s=>String(s??'').replace(/[&<>"]/g,c=>({'&':'&amp;','<':'&lt;','>':'&g
 const ts=t=>t?new Date(t*1000).toLocaleString():'';
 let view='dash',me={};
 function login(){$('#main').innerHTML=`<form id=login>
- <b>Sign in</b><input id=em placeholder=email value="admin@admin.com">
- <input id=pw type=password placeholder=password value="admin">
- <button>Login</button><span id=err class=bad></span></form>`;
+ <b>${t('signin')}</b><input id=em placeholder="${t('email')}" value="admin@admin.com">
+ <input id=pw type=password placeholder="${t('password')}" value="admin">
+ <button>${t('loginBtn')}</button><span id=err class=bad></span></form>`;
  $('#login').onsubmit=async e=>{e.preventDefault();try{
   const d=await api('GET','/v1/session?email='+encodeURIComponent($('#em').value)+'&password='+encodeURIComponent($('#pw').value));
   me=d;$('#who').textContent=d.email;$('#nav-acc').style.display=d.role===1?'':'none';
@@ -62,78 +127,78 @@ function nav(v){view=v;document.querySelectorAll('header a[data-v]').forEach(a=>
 const render={
  async dash(){const o=await api('GET','/v1/info/overview');
   $('#main').innerHTML=`<div class=cards>
-   <div class=card><div class=n>${o.totalJobs}</div><div class=t>jobs</div></div>
-   <div class=card><div class=n>${o.nodeAlived}</div><div class=t>nodes alive</div></div>
-   <div class=card><div class=n>${o.jobExecuted.total}</div><div class=t>executions</div></div>
-   <div class=card><div class=n class=ok>${o.jobExecuted.successed}</div><div class=t>succeeded</div></div>
-   <div class=card><div class=n class=bad>${o.jobExecuted.failed}</div><div class=t>failed</div></div></div>
-  <h3>Daily</h3><table><tr><th>day</th><th>total</th><th>success</th><th>failed</th></tr>
+   <div class=card><div class=n>${o.totalJobs}</div><div class=t>${t('cJobs')}</div></div>
+   <div class=card><div class=n>${o.nodeAlived}</div><div class=t>${t('cAlive')}</div></div>
+   <div class=card><div class=n>${o.jobExecuted.total}</div><div class=t>${t('cExecs')}</div></div>
+   <div class=card><div class=n class=ok>${o.jobExecuted.successed}</div><div class=t>${t('cOk')}</div></div>
+   <div class=card><div class=n class=bad>${o.jobExecuted.failed}</div><div class=t>${t('cFail')}</div></div></div>
+  <h3>${t('daily')}</h3><table><tr><th>${t('day')}</th><th>${t('total')}</th><th>${t('success')}</th><th>${t('failed')}</th></tr>
   ${o.jobExecutedDaily.map(d=>`<tr><td>${d.day}</td><td>${d.total}</td><td class=ok>${d.successed}</td><td class=bad>${d.failed}</td></tr>`).join('')}</table>`},
  async jobs(){const js=await api('GET','/v1/jobs');
-  $('#main').innerHTML=`<div class=bar><button onclick="editJob()">+ New job</button></div>
-  <table><tr><th>name</th><th>group</th><th>command</th><th>kind</th><th>timers</th><th>status</th><th></th></tr>
+  $('#main').innerHTML=`<div class=bar><button onclick="editJob()">${t('newJob')}</button></div>
+  <table><tr><th>${t('name')}</th><th>${t('group')}</th><th>${t('command')}</th><th>${t('kind')}</th><th>${t('timers')}</th><th>${t('status')}</th><th></th></tr>
   ${js.map(j=>`<tr><td>${esc(j.name)}</td><td>${esc(j.group)}</td><td><code>${esc(j.command)}</code></td>
    <td>${['Common','Alone','Interval'][j.kind]||j.kind}</td>
    <td>${(j.rules||[]).map(r=>esc(r.timer)).join('<br>')}</td>
-   <td>${j.pause?'<span class=muted>paused</span>':'<span class=ok>active</span>'}</td>
-   <td><button class=plain onclick='editJob(${JSON.stringify(j)})'>edit</button>
-    <button class=plain onclick="toggleJob('${j.group}','${j.id}',${!j.pause})">${j.pause?'resume':'pause'}</button>
-    <button onclick="runNow('${j.group}','${j.id}')">run</button>
-    <button class=warn onclick="delJob('${j.group}','${j.id}')">del</button></td></tr>`).join('')}</table>`},
+   <td>${j.pause?`<span class=muted>${t('paused')}</span>`:`<span class=ok>${t('active')}</span>`}</td>
+   <td><button class=plain onclick='editJob(${JSON.stringify(j)})'>${t('edit')}</button>
+    <button class=plain onclick="toggleJob('${j.group}','${j.id}',${!j.pause})">${j.pause?t('resume'):t('pause')}</button>
+    <button onclick="runNow('${j.group}','${j.id}')">${t('run')}</button>
+    <button class=warn onclick="delJob('${j.group}','${j.id}')">${t('del')}</button></td></tr>`).join('')}</table>`},
  async nodes(){const ns=await api('GET','/v1/nodes');
-  $('#main').innerHTML=`<table><tr><th>id</th><th>hostname</th><th>pid</th><th>version</th><th>up since</th><th>status</th></tr>
+  $('#main').innerHTML=`<table><tr><th>id</th><th>${t('hostname')}</th><th>pid</th><th>${t('version')}</th><th>${t('upSince')}</th><th>${t('status')}</th></tr>
   ${ns.map(n=>`<tr><td>${esc(n.id)}</td><td>${esc(n.hostname)}</td><td>${n.pid}</td><td>${esc(n.version)}</td>
-   <td>${ts(n.up_ts)}</td><td>${n.connected?'<span class=ok>connected</span>':'<span class=bad>down</span>'}</td></tr>`).join('')}</table>`},
+   <td>${ts(n.up_ts)}</td><td>${n.connected?`<span class=ok>${t('connected')}</span>`:`<span class=bad>${t('down')}</span>`}</td></tr>`).join('')}</table>`},
  async groups(){const gs=await api('GET','/v1/node/groups');
-  $('#main').innerHTML=`<div class=bar><button onclick="editGroup()">+ New group</button></div>
-  <table><tr><th>id</th><th>name</th><th>nodes</th><th></th></tr>
+  $('#main').innerHTML=`<div class=bar><button onclick="editGroup()">${t('newGroup')}</button></div>
+  <table><tr><th>id</th><th>${t('name')}</th><th>${t('nodesCol')}</th><th></th></tr>
   ${gs.map(g=>`<tr><td>${esc(g.id)}</td><td>${esc(g.name)}</td><td>${(g.nids||[]).map(esc).join(', ')}</td>
-   <td><button class=plain onclick='editGroup(${JSON.stringify(g)})'>edit</button>
-   <button class=warn onclick="delGroup('${g.id}')">del</button></td></tr>`).join('')}</table>`},
+   <td><button class=plain onclick='editGroup(${JSON.stringify(g)})'>${t('edit')}</button>
+   <button class=warn onclick="delGroup('${g.id}')">${t('del')}</button></td></tr>`).join('')}</table>`},
  async logs(){const failed=$('#flt')?.checked?'&failedOnly=true':'';
   const d=await api('GET','/v1/logs?pageSize=100'+failed);
-  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt onchange="nav('logs')"> failed only</label>
-   <span class=muted>${d.total} records</span></div>
-  <table><tr><th>job</th><th>node</th><th>begin</th><th>secs</th><th>ok</th><th>output</th></tr>
+  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt onchange="nav('logs')"> ${t('failedOnly')}</label>
+   <span class=muted>${d.total} ${t('records')}</span></div>
+  <table><tr><th>${t('job')}</th><th>${t('node')}</th><th>${t('begin')}</th><th>${t('secs')}</th><th>ok</th><th>${t('output')}</th></tr>
   ${d.list.map(l=>`<tr><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
    <td>${(l.endTime-l.beginTime).toFixed(1)}</td>
    <td>${l.success?'<span class=ok>✓</span>':'<span class=bad>✗</span>'}</td>
    <td><code>${esc((l.output||'').slice(0,160))}</code></td></tr>`).join('')}</table>`},
  async exec(){const xs=await api('GET','/v1/job/executing');
-  $('#main').innerHTML=`<table><tr><th>node</th><th>group</th><th>job</th><th>pid</th><th>since</th></tr>
+  $('#main').innerHTML=`<table><tr><th>${t('node')}</th><th>${t('group')}</th><th>${t('job')}</th><th>pid</th><th>${t('since')}</th></tr>
   ${xs.map(x=>`<tr><td>${esc(x.node)}</td><td>${esc(x.group)}</td><td>${esc(x.jobId)}</td>
-   <td>${esc(x.pid)}</td><td>${ts(x.time)}</td></tr>`).join('')||'<tr><td colspan=5 class=muted>nothing running</td></tr>'}</table>`},
+   <td>${esc(x.pid)}</td><td>${ts(x.time)}</td></tr>`).join('')||`<tr><td colspan=5 class=muted>${t('nothingRunning')}</td></tr>`}</table>`},
  async accounts(){const as=await api('GET','/v1/admin/accounts');
-  $('#main').innerHTML=`<div class=bar><button onclick="editAccount()">+ New account</button></div>
-  <table><tr><th>email</th><th>role</th><th>status</th><th></th></tr>
-  ${as.map(a=>`<tr><td>${esc(a.email)}${a.unchangeable?' <span class=muted>(built-in)</span>':''}</td>
-   <td>${a.role===1?'Administrator':'Developer'}</td>
-   <td>${a.status===1?'<span class=ok>enabled</span>':'<span class=bad>banned</span>'}</td>
-   <td><button class=plain onclick='editAccount(${JSON.stringify(a)})'>edit</button></td></tr>`).join('')}</table>`},
+  $('#main').innerHTML=`<div class=bar><button onclick="editAccount()">${t('newAccount')}</button></div>
+  <table><tr><th>${t('email')}</th><th>${t('role')}</th><th>${t('status')}</th><th></th></tr>
+  ${as.map(a=>`<tr><td>${esc(a.email)}${a.unchangeable?` <span class=muted>(${t('builtIn')})</span>`:''}</td>
+   <td>${a.role===1?t('admin'):t('dev')}</td>
+   <td>${a.status===1?`<span class=ok>${t('enabled')}</span>`:`<span class=bad>${t('banned')}</span>`}</td>
+   <td><button class=plain onclick='editAccount(${JSON.stringify(a)})'>${t('edit')}</button></td></tr>`).join('')}</table>`},
  async profile(){
-  $('#main').innerHTML=`<h3>Profile — ${esc(me.email||'')}</h3>
+  $('#main').innerHTML=`<h3>${t('profile')} — ${esc(me.email||'')}</h3>
   <form id=pf style="max-width:340px;display:flex;flex-direction:column;gap:8px;background:#fff;padding:18px;border-radius:8px;box-shadow:0 1px 2px #0002">
-   <label>current password</label><input id=po type=password>
-   <label>new password</label><input id=pn type=password>
-   <label>repeat new password</label><input id=pn2 type=password>
-   <button>Change password</button><span id=pmsg></span></form>`;
+   <label>${t('curPw')}</label><input id=po type=password>
+   <label>${t('newPw')}</label><input id=pn type=password>
+   <label>${t('repPw')}</label><input id=pn2 type=password>
+   <button>${t('changePw')}</button><span id=pmsg></span></form>`;
   $('#pf').onsubmit=async e=>{e.preventDefault();const m=$('#pmsg');
-   if($('#pn').value!==$('#pn2').value){m.className='bad';m.textContent='passwords differ';return}
+   if($('#pn').value!==$('#pn2').value){m.className='bad';m.textContent=t('pwDiffer');return}
    try{await api('POST','/v1/user/setpwd',{password:$('#po').value,newPassword:$('#pn').value});
-    m.className='ok';m.textContent='password changed'}catch(x){m.className='bad';m.textContent=x}}},
+    m.className='ok';m.textContent=t('pwChanged')}catch(x){m.className='bad';m.textContent=x}}},
 };
 window.editAccount=(a)=>{a=a||{};
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
-  <b>${a.email?'Edit':'New'} account</b>
-  <label>email</label><input id=ae value="${esc(a.email||'')}" ${a.email?'disabled':''}>
-  <div class=row><div><label>role</label><select id=ar>
-    <option value=2 ${a.role!==1?'selected':''}>Developer</option>
-    <option value=1 ${a.role===1?'selected':''}>Administrator</option></select></div>
-  <div><label>status</label><select id=as_>
-    <option value=1 ${a.status!==0?'selected':''}>enabled</option>
-    <option value=0 ${a.status===0?'selected':''}>banned</option></select></div></div>
-  <label>password ${a.email?'(leave empty to keep)':''}</label><input id=ap type=password>
-  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+  <b>${a.email?t('editT'):t('newT')} ${t('account')}</b>
+  <label>${t('email')}</label><input id=ae value="${esc(a.email||'')}" ${a.email?'disabled':''}>
+  <div class=row><div><label>${t('role')}</label><select id=ar>
+    <option value=2 ${a.role!==1?'selected':''}>${t('dev')}</option>
+    <option value=1 ${a.role===1?'selected':''}>${t('admin')}</option></select></div>
+  <div><label>${t('status')}</label><select id=as_>
+    <option value=1 ${a.status!==0?'selected':''}>${t('enabled')}</option>
+    <option value=0 ${a.status===0?'selected':''}>${t('banned')}</option></select></div></div>
+  <label>${t('password')} ${a.email?t('keepEmpty'):''}</label><input id=ap type=password>
+  <div class=bar style="margin-top:14px"><button id=sv>${t('save')}</button><button class=plain>${t('cancel')}</button></div>
  </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();try{
   const body={email:a.email||$('#ae').value,role:+$('#ar').value,status:+$('#as_').value};
@@ -141,28 +206,28 @@ window.editAccount=(a)=>{a=a||{};
   await api(a.email?'POST':'PUT','/v1/admin/account',body);
   dlg.close();nav('accounts')}catch(x){alert(x)}}};
 window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
-window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert('dispatched')};
-window.delJob=async(g,id)=>{if(confirm('delete job?')){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
-window.delGroup=async id=>{if(confirm('delete group?')){await api('DELETE','/v1/node/group/'+id);nav('groups')}};
+window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert(t('dispatched'))};
+window.delJob=async(g,id)=>{if(confirm(t('delJobQ'))){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
+window.delGroup=async id=>{if(confirm(t('delGroupQ'))){await api('DELETE','/v1/node/group/'+id);nav('groups')}};
 window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
-  <b>${j.id?'Edit':'New'} job</b>
-  <div class=row><div><label>name</label><input id=jn value="${esc(j.name||'')}"></div>
-  <div><label>group</label><input id=jg value="${esc(j.group||'default')}"></div></div>
-  <label>command</label><textarea id=jc rows=2>${esc(j.command||'')}</textarea>
-  <div class=row><div><label>kind</label><select id=jk>
-    <option value=0 ${j.kind==0?'selected':''}>Common (all eligible nodes)</option>
-    <option value=1 ${j.kind==1?'selected':''}>Alone (exactly one)</option>
-    <option value=2 ${j.kind==2?'selected':''}>Interval (one per interval)</option></select></div>
-  <div><label>user</label><input id=ju value="${esc(j.user||'')}"></div></div>
-  <div class=row><div><label>timeout s</label><input id=jt type=number value="${j.timeout||0}"></div>
-  <div><label>retry</label><input id=jr type=number value="${j.retry||0}"></div>
-  <div><label>parallels</label><input id=jp type=number value="${j.parallels||0}"></div></div>
-  <label>cron timer (sec min hour dom month dow)</label><input id=rt value="${esc(r.timer||'0 */5 * * * *')}">
-  <div class=row><div><label>node ids (comma)</label><input id=rn value="${esc((r.nids||[]).join(','))}"></div>
-  <div><label>group ids</label><input id=rg value="${esc((r.gids||[]).join(','))}"></div>
-  <div><label>exclude nodes</label><input id=rx value="${esc((r.exclude_nids||[]).join(','))}"></div></div>
-  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+  <b>${j.id?t('editT'):t('newT')} ${t('job')}</b>
+  <div class=row><div><label>${t('name')}</label><input id=jn value="${esc(j.name||'')}"></div>
+  <div><label>${t('group')}</label><input id=jg value="${esc(j.group||'default')}"></div></div>
+  <label>${t('command')}</label><textarea id=jc rows=2>${esc(j.command||'')}</textarea>
+  <div class=row><div><label>${t('kind')}</label><select id=jk>
+    <option value=0 ${j.kind==0?'selected':''}>${t('kCommon')}</option>
+    <option value=1 ${j.kind==1?'selected':''}>${t('kAlone')}</option>
+    <option value=2 ${j.kind==2?'selected':''}>${t('kInterval')}</option></select></div>
+  <div><label>${t('user')}</label><input id=ju value="${esc(j.user||'')}"></div></div>
+  <div class=row><div><label>${t('timeoutS')}</label><input id=jt type=number value="${j.timeout||0}"></div>
+  <div><label>${t('retry')}</label><input id=jr type=number value="${j.retry||0}"></div>
+  <div><label>${t('parallels')}</label><input id=jp type=number value="${j.parallels||0}"></div></div>
+  <label>${t('cronTimer')}</label><input id=rt value="${esc(r.timer||'0 */5 * * * *')}">
+  <div class=row><div><label>${t('nodeIds')}</label><input id=rn value="${esc((r.nids||[]).join(','))}"></div>
+  <div><label>${t('groupIds')}</label><input id=rg value="${esc((r.gids||[]).join(','))}"></div>
+  <div><label>${t('excludeNodes')}</label><input id=rx value="${esc((r.exclude_nids||[]).join(','))}"></div></div>
+  <div class=bar style="margin-top:14px"><button id=sv>${t('save')}</button><button class=plain>${t('cancel')}</button></div>
  </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();const csv=v=>v.split(',').map(s=>s.trim()).filter(Boolean);
   try{await api('PUT','/v1/job',{id:j.id,name:$('#jn').value,group:$('#jg').value,oldGroup:j.group,
@@ -172,14 +237,15 @@ window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
            exclude_nids:csv($('#rx').value)}]});dlg.close();nav('jobs')}catch(x){alert(x)}}};
 window.editGroup=(g)=>{g=g||{};
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
-  <b>${g.id?'Edit':'New'} group</b>
-  <label>name</label><input id=gn value="${esc(g.name||'')}">
-  <label>node ids (comma)</label><input id=gm value="${esc((g.nids||[]).join(','))}">
-  <div class=bar style="margin-top:14px"><button id=sv>Save</button><button class=plain>Cancel</button></div>
+  <b>${g.id?t('editT'):t('newT')} ${t('group')}</b>
+  <label>${t('name')}</label><input id=gn value="${esc(g.name||'')}">
+  <label>${t('nodeIds')}</label><input id=gm value="${esc((g.nids||[]).join(','))}">
+  <div class=bar style="margin-top:14px"><button id=sv>${t('save')}</button><button class=plain>${t('cancel')}</button></div>
  </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();try{
   await api('PUT','/v1/node/group',{id:g.id,name:$('#gn').value,
    nids:$('#gm').value.split(',').map(s=>s.trim()).filter(Boolean)});dlg.close();nav('groups')}catch(x){alert(x)}}};
+chrome();
 api('GET','/v1/session/me').then(d=>{me=d;$('#who').textContent=d.email;
  $('#nav-acc').style.display=d.role===1?'':'none';nav('dash')}).catch(()=>login());
 </script></body></html>

@@ -250,3 +250,20 @@ def test_session_me_restores_identity(world):
     with _pt.raises(HttpError) as e:
         srv.handle("GET", "/v1/session/me", {}, b"", {})
     assert e.value.status == 401
+
+
+def test_ui_i18n_locales_complete():
+    """en and zh locales must define identical key sets (a missing zh key
+    silently falls back to en at runtime — catch drift here)."""
+    import re
+    from cronsun_tpu.web.ui import INDEX_HTML
+    m = re.search(r"const L=\{en:\{(.*?)\},zh:\{(.*?)\}\};", INDEX_HTML,
+                  re.S)
+    assert m, "i18n table not found"
+    en = set(re.findall(r"(\w+):'", m.group(1)))
+    zh = set(re.findall(r"(\w+):'", m.group(2)))
+    assert en == zh, f"locale drift: en-only={en - zh}, zh-only={zh - en}"
+    assert len(en) > 40
+    # every statically-referenced key exists
+    used = set(re.findall(r"\bt\('([A-Za-z]+)'\)", INDEX_HTML))
+    assert used <= en, f"undefined keys: {used - en}"
