@@ -52,22 +52,39 @@ def unpack_tile(packed: jax.Array, n_nodes: int) -> jax.Array:
     return ((words >> (cols % 32).astype(jnp.uint32)) & 1) != 0
 
 
-def _bid_jnp(packed, load_eff):
+def bid_block_jnp(packed, load_blk, col0=0, bitplane_ties=True):
+    """Dense-reference bid over a node-column block.
+
+    ``col0`` puts the tie-hash and the returned choice in GLOBAL node
+    coordinates (the 2-D mesh shards columns).  Exact-score ties (16-bit
+    tie-hash collisions happen at 10k nodes) resolve per
+    ``bitplane_ties``:
+
+    - True: the pallas kernel's scan order — bit planes b=0..31 outer,
+      words w inner, i.e. lexicographic (score, b, w) with n = w*32 + b.
+      Required wherever jnp and pallas paths must pick bit-identically.
+    - False: natural column order (lowest global node id).  This order is
+      invariant to how columns are split across a nodes axis — the 2-D
+      mesh's cross-shard argmin reduce composes with it exactly.
+    """
     K = packed.shape[0]
     w32 = packed.shape[1]
     n = w32 * 32
     elig = unpack_tile(packed, n)
     jix = jnp.arange(K, dtype=jnp.uint32)[:, None]
-    nix = jnp.arange(n, dtype=jnp.uint32)[None, :]
-    score = jnp.where(elig, load_eff[None, :] + _tie(jix, nix), jnp.inf)
-    # Exact score ties (16-bit tie-hash collisions happen at 10k nodes) must
-    # resolve in the same order as the pallas kernel, which scans bit planes
-    # b=0..31 outer, words w inner — i.e. lexicographic (score, b, w) with
-    # n = w*32 + b.  Argmin in that permuted order, then map back.
-    score_bw = score.reshape(K, w32, 32).transpose(0, 2, 1).reshape(K, n)
-    p = jnp.argmin(score_bw, axis=1).astype(jnp.int32)
-    choice = (p % w32) * 32 + p // w32
-    return jnp.min(score, axis=1), choice
+    nix = (col0 + jnp.arange(n)).astype(jnp.uint32)[None, :]
+    score = jnp.where(elig, load_blk[None, :] + _tie(jix, nix), jnp.inf)
+    if bitplane_ties:
+        score_bw = score.reshape(K, w32, 32).transpose(0, 2, 1).reshape(K, n)
+        p = jnp.argmin(score_bw, axis=1).astype(jnp.int32)
+        choice = (p % w32) * 32 + p // w32
+    else:
+        choice = jnp.argmin(score, axis=1).astype(jnp.int32)
+    return jnp.min(score, axis=1), choice + col0
+
+
+def _bid_jnp(packed, load_eff):
+    return bid_block_jnp(packed, load_eff, col0=0, bitplane_ties=True)
 
 
 def _fanout_jnp(packed, w):

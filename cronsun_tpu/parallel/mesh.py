@@ -102,28 +102,8 @@ def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
     return out, load, rem_cap
 
 
-def _bid_block(packed, load_blk, col0):
-    """Bid over a node-column BLOCK: like assign._bid_jnp but with the
-    tie-hash and returned choice in GLOBAL node coordinates, so the
-    cross-shard argmin reduce is deterministic regardless of how columns
-    are split."""
-    from ..ops.assign import unpack_tile
-    from ..ops.pallas_kernels import _tie
-    K, w32 = packed.shape
-    n = w32 * 32
-    elig = unpack_tile(packed, n)
-    jix = jnp.arange(K, dtype=jnp.uint32)[:, None]
-    nix = (col0 + jnp.arange(n)).astype(jnp.uint32)[None, :]
-    score = jnp.where(elig, load_blk[None, :] + _tie(jix, nix), jnp.inf)
-    score_bw = score.reshape(K, w32, 32).transpose(0, 2, 1).reshape(K, n)
-    p = jnp.argmin(score_bw, axis=1).astype(jnp.int32)
-    choice = (p % w32) * 32 + p // w32 + col0
-    best = jnp.min(score, axis=1)
-    return best, jnp.where(jnp.isfinite(best), choice, 0)
-
-
 def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
-                         rem_cap, k_local: int, rounds: int):
+                         rem_cap, k_local: int, rounds: int, impl: str):
     """Per-device body over the (jobs, nodes) mesh.  elig is the local
     [J/Dj, W32/Dn] block; table/exclusive/cost are jobs-sharded
     (replicated along nodes); load/rem_cap replicated.
@@ -131,8 +111,18 @@ def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
     Collectives per tick: one all_gather of the Common fan-out block
     along nodes (O(N)), and per bid round one (best, choice) exchange
     along nodes (O(Dn*K)) + the candidate exchange along jobs (O(K)) —
-    never anything proportional to J or the matrix."""
-    from ..ops.assign import _fanout_jnp
+    never anything proportional to J or the matrix.
+
+    Tie order: with impl="jnp" the block bid breaks exact-score ties by
+    lowest GLOBAL node id, which composes exactly with the cross-shard
+    argmin reduce — placements are invariant to how columns are split.
+    With impl="pallas" (the HBM-efficient path over bitpacked words) the
+    in-block order is the kernel's bit-plane scan with a block-local tie
+    hash: still fully deterministic for a fixed mesh shape (what failover
+    replay needs — replicas run the same mesh), but a different shape can
+    break ties differently."""
+    from ..ops.assign import _steps, bid_block_jnp
+    bid_k, fanout = _steps(impl)
     dj = jax.lax.axis_index(AXIS)
     dn = jax.lax.axis_index(NAXIS)
     j_local = elig.shape[0]
@@ -149,16 +139,25 @@ def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
     # Common fan-out: per-block partial -> concat along nodes -> sum along
     # jobs; load stays replicated everywhere.
     common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
-    block = _fanout_jnp(packed_k, common_w)                    # [n_local]
+    block = fanout(packed_k, common_w)                         # [n_local]
     full = jax.lax.all_gather(block, NAXIS, tiled=True)        # [N]
     load = load + jax.lax.psum(full, AXIS)
+
+    def bid_block(packed, load_blk):
+        if impl == "jnp":
+            best, choice = bid_block_jnp(packed, load_blk, col0=col0,
+                                         bitplane_ties=False)
+        else:
+            best, choice = bid_k(packed, load_blk)
+            choice = choice + col0
+        return best, jnp.where(jnp.isfinite(best), choice, 0)
 
     need0 = valid & excl_k
     assigned = jnp.full(k_local, -1, dtype=jnp.int32)
     for r in range(rounds):
         load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
         load_blk = jax.lax.dynamic_slice(load_eff, (col0,), (n_local,))
-        best_l, choice_l = _bid_block(packed_k, load_blk, col0)
+        best_l, choice_l = bid_block(packed_k, load_blk)
         # argmin reduce across the nodes axis: min score, ties to the
         # lowest global node id (deterministic)
         bests = jax.lax.all_gather(best_l, NAXIS)              # [Dn, k]
@@ -186,120 +185,28 @@ def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
     return out, load, rem_cap
 
 
-class Sharded2DTickPlanner:
-    """Tick+assign over a (jobs x nodes) 2-D mesh: the eligibility matrix
-    shards both ways, so neither 1M-row schedule state nor 100k-node
-    bitmask width needs to fit one device.  Same contract as
-    ShardedTickPlanner."""
+class _ShardedPlannerBase:
+    """State surface + plan decode shared by the mesh planners.  A
+    subclass provides ``_elig_spec`` (how the matrix shards), ``Dj`` (the
+    jobs-axis size the fired bucket divides over), a node ``word_align``,
+    and ``_body`` (the shard_map body factory)."""
 
-    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
-                 rounds: int = 3, max_fire_bucket: int = 65536, tz=None):
+    def _init_common(self, mesh: Mesh, job_capacity: int,
+                     node_capacity: int, rounds: int, impl: str,
+                     max_fire_bucket: int, tz, word_align: int):
         import datetime
-        if mesh.axis_names != (AXIS, NAXIS):
-            raise ValueError(f"need a ({AXIS!r}, {NAXIS!r}) mesh")
         self.mesh = mesh
         self.tz = tz or datetime.timezone.utc
         self.rounds = rounds
-        self.Dj = mesh.shape[AXIS]
-        self.Dn = mesh.shape[NAXIS]
+        self.impl = impl
         self.J = _next_pow2(max(job_capacity, self.Dj * 256))
         if self.J % self.Dj:
             raise ValueError("job capacity must shard evenly")
-        word_align = 32 * self.Dn
-        self.N = ((node_capacity + word_align - 1) // word_align) * word_align
+        self.N = ((node_capacity + word_align - 1)
+                  // word_align) * word_align
         self.max_fire_bucket = max_fire_bucket
         self._shard = NamedSharding(mesh, P(AXIS))
-        self._shard2 = NamedSharding(mesh, P(AXIS, NAXIS))
-        self._repl = NamedSharding(mesh, P())
-
-        from ..ops.schedule_table import build_table
-        self.table = build_table([], capacity=self.J, sharding=self._shard)
-        self.elig = jax.device_put(
-            np.zeros((self.J, self.N // 32), np.uint32), self._shard2)
-        self.exclusive = jax.device_put(np.zeros(self.J, bool), self._shard)
-        self.cost = jax.device_put(np.ones(self.J, np.float32), self._shard)
-        self.load = jax.device_put(np.zeros(self.N, np.float32), self._repl)
-        self.rem_cap = jax.device_put(np.zeros(self.N, np.int32), self._repl)
-        self._step_cache = {}
-
-    def _step(self, k_local: int):
-        if k_local not in self._step_cache:
-            from jax import shard_map
-            body = partial(_sharded2d_plan_body, k_local=k_local,
-                           rounds=self.rounds)
-            sm = shard_map(
-                body, mesh=self.mesh,
-                in_specs=(P(AXIS), P(), P(AXIS, NAXIS), P(AXIS), P(AXIS),
-                          P(), P()),
-                out_specs=(P(None, AXIS), P(), P()),
-                check_vma=False)
-            self._step_cache[k_local] = jax.jit(sm)
-        return self._step_cache[k_local]
-
-    # -- state maintenance (same surface as ShardedTickPlanner) ------------
-
-    def set_table(self, table: ScheduleTable):
-        if table.capacity != self.J:
-            raise ValueError(f"table capacity {table.capacity} != {self.J}")
-        self.table = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, self._shard), table)
-
-    def set_eligibility(self, matrix: np.ndarray):
-        self.elig = jax.device_put(matrix, self._shard2)
-
-    def set_job_meta_full(self, exclusive: np.ndarray, cost: np.ndarray):
-        self.exclusive = jax.device_put(exclusive, self._shard)
-        self.cost = jax.device_put(cost.astype(np.float32), self._shard)
-
-    def set_node_capacity_full(self, caps: np.ndarray):
-        self.rem_cap = jax.device_put(caps.astype(np.int32), self._repl)
-
-    # -- tick --------------------------------------------------------------
-
-    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
-        k = sla_bucket or self.max_fire_bucket
-        k_local = max(256, _next_pow2(k) // self.Dj)
-        f = window_fields(epoch_s, 1, tz=self.tz)
-        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
-                           f["dom"][0], f["month"][0], f["dow"][0],
-                           epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
-        out, self.load, self.rem_cap = self._step(k_local)(
-            self.table, jax.device_put(fields, self._repl), self.elig,
-            self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(out)              # [3, Dj*k_local]
-        fired, assigned, total = [], [], 0
-        for s in range(self.Dj):
-            t_s = int(o[1, s * k_local])
-            total += t_s
-            n_s = min(t_s, k_local)
-            fired.append(o[0, s * k_local:s * k_local + n_s])
-            assigned.append(o[2, s * k_local:s * k_local + n_s])
-        fired = np.concatenate(fired)
-        assigned = np.concatenate(assigned)
-        return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
-                        overflow=max(0, total - len(fired)))
-
-
-class ShardedTickPlanner:
-    """TickPlanner over a jobs-sharded mesh.  Same contract as
-    ops.planner.TickPlanner; state arrays live sharded across devices."""
-
-    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
-                 rounds: int = 3, impl: str = "auto",
-                 max_fire_bucket: int = 65536, tz=None):
-        import datetime
-        self.mesh = mesh
-        self.tz = tz or datetime.timezone.utc
-        self.rounds = rounds
-        self.D = mesh.devices.size
-        self.impl = impl
-        self.J = _next_pow2(max(job_capacity, self.D * 256))
-        if self.J % self.D:
-            raise ValueError("job capacity must shard evenly")
-        self.N = ((node_capacity + 31) // 32) * 32
-        self.max_fire_bucket = max_fire_bucket
-        self._shard = NamedSharding(mesh, P(AXIS))
-        self._shard2 = NamedSharding(mesh, P(AXIS, None))
+        self._shard2 = NamedSharding(mesh, self._elig_spec)
         self._repl = NamedSharding(mesh, P())
 
         from ..ops.schedule_table import build_table
@@ -316,11 +223,9 @@ class ShardedTickPlanner:
         key = (k_local, impl)
         if key not in self._step_cache:
             from jax import shard_map
-            body = partial(_sharded_plan_body, k_local=k_local,
-                           rounds=self.rounds, impl=impl)
             sm = shard_map(
-                body, mesh=self.mesh,
-                in_specs=(P(AXIS), P(), P(AXIS, None), P(AXIS), P(AXIS),
+                self._body(k_local, impl), mesh=self.mesh,
+                in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
                           P(), P()),
                 out_specs=(P(None, AXIS), P(), P()),
                 check_vma=False)
@@ -349,7 +254,7 @@ class ShardedTickPlanner:
 
     def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
         k = sla_bucket or self.max_fire_bucket
-        k_local = max(256, _next_pow2(k) // self.D)
+        k_local = max(256, _next_pow2(k) // self.Dj)
         impl = self.impl
         if impl == "auto":
             impl = ("pallas" if jax.default_backend() == "tpu"
@@ -361,12 +266,11 @@ class ShardedTickPlanner:
         out, self.load, self.rem_cap = self._step(k_local, impl)(
             self.table, jax.device_put(fields, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(out)              # [3, D*k_local]
-        totals = o[1, 0::k_local]
-        total = int(totals.sum())
-        fired, assigned = [], []
-        for s in range(self.D):
+        o = np.asarray(out)              # [3, Dj*k_local]
+        fired, assigned, total = [], [], 0
+        for s in range(self.Dj):
             t_s = int(o[1, s * k_local])
+            total += t_s
             n_s = min(t_s, k_local)
             fired.append(o[0, s * k_local:s * k_local + n_s])
             assigned.append(o[2, s * k_local:s * k_local + n_s])
@@ -374,3 +278,47 @@ class ShardedTickPlanner:
         assigned = np.concatenate(assigned)
         return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
                         overflow=max(0, total - len(fired)))
+
+
+class ShardedTickPlanner(_ShardedPlannerBase):
+    """TickPlanner over a 1-D jobs-sharded mesh.  Same contract as
+    ops.planner.TickPlanner; state arrays live sharded across devices."""
+
+    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
+                 rounds: int = 3, impl: str = "auto",
+                 max_fire_bucket: int = 65536, tz=None):
+        self.Dj = self.D = mesh.devices.size
+        self._elig_spec = P(AXIS, None)
+        self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
+                          max_fire_bucket, tz, word_align=32)
+
+    def _body(self, k_local: int, impl: str):
+        return partial(_sharded_plan_body, k_local=k_local,
+                       rounds=self.rounds, impl=impl)
+
+
+class Sharded2DTickPlanner(_ShardedPlannerBase):
+    """Tick+assign over a (jobs x nodes) 2-D mesh: the eligibility matrix
+    shards both ways, so neither 1M-row schedule state nor 100k-node
+    bitmask width needs to fit one device.  Same contract as
+    ShardedTickPlanner.
+
+    impl="jnp" (default) breaks exact-score ties by lowest global node
+    id — placements invariant to the column split; impl="pallas" runs the
+    HBM-efficient bitpacked block kernel — deterministic per mesh shape
+    (see _sharded2d_plan_body)."""
+
+    def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
+                 rounds: int = 3, impl: str = "jnp",
+                 max_fire_bucket: int = 65536, tz=None):
+        if mesh.axis_names != (AXIS, NAXIS):
+            raise ValueError(f"need a ({AXIS!r}, {NAXIS!r}) mesh")
+        self.Dj = mesh.shape[AXIS]
+        self.Dn = mesh.shape[NAXIS]
+        self._elig_spec = P(AXIS, NAXIS)
+        self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
+                          max_fire_bucket, tz, word_align=32 * self.Dn)
+
+    def _body(self, k_local: int, impl: str):
+        return partial(_sharded2d_plan_body, k_local=k_local,
+                       rounds=self.rounds, impl=impl)

@@ -183,3 +183,31 @@ def test_sharded2d_matches_1d_exclusive_placement_counts():
     n1 = sum(1 for a in p1.assigned.tolist() if a >= 0)
     n2 = sum(1 for a in p2.assigned.tolist() if a >= 0)
     assert n1 == n2, f"1-D placed {n1}, 2-D placed {n2}"
+
+
+def test_sharded2d_placements_invariant_to_column_split():
+    """With impl='jnp', exact-score ties break by lowest global node id,
+    so placements must be IDENTICAL regardless of how the node columns
+    split across the nodes axis (same jobs split -> same tie-hash)."""
+    from cronsun_tpu.parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+    J, N = 2048, 64
+    specs, elig, excl, cost, caps = _random_state(J, N, seed=11)
+    # all-zero load + flat costs: every bid is a tie-hash tie festival
+
+    def run(dn):
+        sp = Sharded2DTickPlanner(make_mesh2d(4, dn), job_capacity=J,
+                                  node_capacity=N, max_fire_bucket=2048)
+        sp.set_table(build_table(specs, capacity=sp.J))
+        full = np.zeros((sp.J, sp.N // 32), np.uint32)
+        full[:J, :N // 32] = elig
+        sp.set_eligibility(full)
+        fe = np.zeros(sp.J, bool); fe[:J] = excl
+        sp.set_job_meta_full(fe, np.ones(sp.J, np.float32))
+        fc = np.zeros(sp.N, np.int32); fc[:N] = 10**6
+        sp.set_node_capacity_full(fc)
+        p = sp.plan(1_753_000_000)
+        return dict(zip(p.fired.tolist(), p.assigned.tolist()))
+
+    a, b = run(1), run(2)
+    assert a == b, {k: (a.get(k), b.get(k))
+                    for k in set(a) | set(b) if a.get(k) != b.get(k)}

@@ -276,3 +276,56 @@ def test_next_fire_dst_zone_random_differential():
             want = next_after(spec, t)
             want_e = -1 if want is None else _epoch(want)
             assert got[j] == want_e, (texts[j], t, got[j], want_e)
+
+
+# ------------------------------------------------- hypothesis fuzz (SURVEY §4c)
+
+from hypothesis import given, settings, strategies as st
+
+MONTH_NAMES = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+               "sep", "oct", "nov", "dec"]
+DOW_NAMES = ["sun", "mon", "tue", "wed", "thu", "fri", "sat"]
+
+
+def _field_st(lo, hi, names=None):
+    scalar = st.integers(lo, hi).map(str)
+    if names:
+        scalar = st.one_of(scalar, st.sampled_from(names))
+    rng_ = st.tuples(st.integers(lo, hi), st.integers(lo, hi)).map(
+        lambda ab: f"{min(ab)}-{max(ab)}")
+    stepped = st.tuples(rng_, st.integers(1, 15)).map(
+        lambda rs: f"{rs[0]}/{rs[1]}")
+    star = st.sampled_from(["*"] + [f"*/{k}" for k in (2, 3, 5, 7, 11, 30)])
+    item = st.one_of(scalar, rng_, stepped)
+    lst = st.lists(item, min_size=1, max_size=3).map(",".join)
+    return st.one_of(star, lst)
+
+
+spec_st = st.one_of(
+    st.tuples(_field_st(0, 59), _field_st(0, 59), _field_st(0, 23),
+              st.one_of(_field_st(1, 28), st.just("?")),
+              _field_st(1, 12, MONTH_NAMES),
+              st.one_of(_field_st(0, 6, DOW_NAMES), st.just("?")),
+              ).map(" ".join),
+    st.integers(1, 4000).map(lambda n: f"@every {n}s"),
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(spec=spec_st,
+       after=st.integers(1_600_000_000, 1_950_000_000))
+def test_next_fire_hypothesis_differential(spec, after):
+    """Fuzzed grammar coverage (comma lists, names, ?, @every) — device
+    next_fire must agree with the conformance-anchored scalar engine."""
+    compiled = parse(spec)
+    table = build_table([compiled], phase_epoch_s=after)
+    got = int(next_fire(table, after)[0])
+    t = dt.datetime.fromtimestamp(after, UTC)
+    if spec.startswith("@every"):
+        # phase anchored at `after`: first fire one period later
+        period = int(spec.split()[1][:-1])
+        assert got == after + period
+        return
+    want = next_after(compiled, t)
+    want_e = -1 if want is None else _epoch(want)
+    assert got == want_e, (spec, t, got, want_e)
