@@ -24,13 +24,21 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=7070)
     ap.add_argument("--native", action="store_true",
                     help="serve with the native C++ store")
+    ap.add_argument("--wal", default=None, metavar="FILE",
+                    help="write-ahead log: state survives restarts "
+                         "(requires --native)")
     args = ap.parse_args(argv)
+    if args.wal and not args.native:
+        # pure argv check BEFORE setup_common side effects (conf watcher)
+        print("error: --wal requires --native", file=sys.stderr)
+        return 2
     cfg, ks, watcher = setup_common(args)
 
     rc = [0]
     if args.native:
         from ..store.native import NativeStoreServer
-        srv = NativeStoreServer(host=args.host, port=args.port).start()
+        srv = NativeStoreServer(host=args.host, port=args.port,
+                                wal=args.wal).start()
 
         def child_died(code: int):
             # the wrapper must not sit healthy-looking in front of a dead

@@ -55,6 +55,7 @@ class NativeStoreServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  binary: Optional[str] = None, history: int = 65536,
+                 wal: Optional[str] = None,
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         self.binary = binary or find_binary()
@@ -64,6 +65,8 @@ class NativeStoreServer:
                 "native/)")
         argv = [self.binary, "--host", host, "--port", str(port),
                 "--history", str(history)] + (extra_args or [])
+        if wal:
+            argv += ["--wal", wal]
         # stderr merged into stdout so a startup failure (bind error …)
         # surfaces in the exception instead of vanishing
         self._proc = subprocess.Popen(

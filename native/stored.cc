@@ -27,6 +27,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdarg>
@@ -316,6 +317,51 @@ static void ev_wire(std::string& out, const Ev& e) {
 struct KeyErr { std::string msg; };
 struct CompactedErr { std::string msg; };
 
+// Write-ahead log: every mutation appends one JSON-array line; boot
+// replays the file through the normal mutation paths (with logging
+// suppressed) and then rewrites it as a compacted snapshot.  Appends are
+// flushed to the OS immediately; fdatasync rides the sweeper cadence, so
+// the durability window is one sweep interval (etcd-style group commit).
+class Wal {
+ public:
+  bool open_append(const std::string& path) {
+    std::lock_guard<std::mutex> g(mu_);
+    f_ = fopen(path.c_str(), "a");
+    return f_ != nullptr;
+  }
+  void append(const std::string& line) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_) return;
+    // fail-stop on write errors (ENOSPC...): acknowledging a mutation the
+    // WAL could not record would silently break the durability contract —
+    // etcd panics here for the same reason
+    if (fwrite(line.data(), 1, line.size(), f_) != line.size() ||
+        fputc('\n', f_) == EOF || fflush(f_) != 0) {
+      fprintf(stderr, "FATAL: wal append failed: %s\n", strerror(errno));
+      abort();
+    }
+  }
+  void sync() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (f_) fdatasync(fileno(f_));
+  }
+  void close_file() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (f_) fclose(f_);
+    f_ = nullptr;
+  }
+
+ private:
+  FILE* f_ = nullptr;
+  std::mutex mu_;
+};
+
+static double wall_now() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
 class Store {
  public:
   explicit Store(size_t history_cap) : history_cap_(history_cap) {}
@@ -411,6 +457,16 @@ class Store {
     std::lock_guard<std::mutex> g(mu);
     long long lid = next_lease_++;
     leases_[lid] = LeaseRec{ttl, now() + ttl, {}};
+    if (wal_ && !replaying_) {
+      std::string rec = "[\"g\",";
+      jint(rec, lid);
+      rec += ',';
+      jdbl(rec, ttl);
+      rec += ',';
+      jdbl(rec, wall_now() + ttl);
+      rec += ']';
+      wal_->append(rec);
+    }
     return lid;
   }
 
@@ -420,6 +476,14 @@ class Store {
     auto it = leases_.find(lid);
     if (it == leases_.end()) return false;
     it->second.deadline = now() + it->second.ttl;
+    if (wal_ && !replaying_) {
+      std::string rec = "[\"k\",";
+      jint(rec, lid);
+      rec += ',';
+      jdbl(rec, wall_now() + it->second.ttl);
+      rec += ']';
+      wal_->append(rec);
+    }
     return true;
   }
 
@@ -429,6 +493,14 @@ class Store {
     if (it == leases_.end()) return false;
     std::set<std::string> keys = std::move(it->second.keys);  // already sorted
     leases_.erase(it);
+    // lease removal logs as "x" (no key side effects); the deletions it
+    // causes log themselves — replay is then purely mechanical
+    if (wal_ && !replaying_) {
+      std::string rec = "[\"x\",";
+      jint(rec, lid);
+      rec += ']';
+      wal_->append(rec);
+    }
     for (const auto& k : keys) delete_locked(k);
     return true;
   }
@@ -442,8 +514,104 @@ class Store {
   }
 
   void sweep() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      expire_locked();
+    }
+    // fdatasync outside the store mutex: a slow disk must not stall
+    // every client op for the sync duration (wal_ is set once at boot;
+    // Wal serializes internally)
+    if (wal_) wal_->sync();
+  }
+
+  // Open the WAL: replay an existing file through the normal mutation
+  // paths, rewrite it as a compacted snapshot (full state + exact revs,
+  // no history), then append mutations from here on.  The in-RAM event
+  // ring starts empty after a boot, so a watcher resuming from a
+  // pre-restart revision gets CompactedError and re-lists — exactly
+  // etcd's compaction contract.
+  bool open_wal(const std::string& path, std::string& err) {
     std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    replaying_ = true;
+    FILE* f = fopen(path.c_str(), "r");
+    if (f) {
+      char* lineptr = nullptr;   // getline grows it: records have no
+      size_t cap = 0;            // length limit (values can be large)
+      ssize_t n;
+      bool bad = false;
+      std::string line;
+      while ((n = getline(&lineptr, &cap, f)) != -1) {
+        line.assign(lineptr, (size_t)n);
+        while (!line.empty() &&
+               (line.back() == '\n' || line.back() == '\r'))
+          line.pop_back();
+        if (!line.empty() && !replay_line(line)) {
+          bad = true;   // torn final record (crash mid-append) is fine;
+          break;        // a bad record with more after it is corruption
+        }
+      }
+      if (bad && getline(&lineptr, &cap, f) != -1) {
+        err = "corrupt wal record: " + line.substr(0, 200);
+        free(lineptr);
+        fclose(f);
+        replaying_ = false;
+        return false;
+      }
+      free(lineptr);
+      fclose(f);
+    }
+    replaying_ = false;
+
+    // compacted snapshot -> temp file -> atomic rename
+    std::string tmp = path + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "w");
+    if (!out) {
+      err = "cannot write " + tmp;
+      return false;
+    }
+    std::string rec = "[\"v\",";
+    jint(rec, rev_);
+    rec += ',';
+    jint(rec, next_lease_);
+    rec += "]\n";
+    double steady = now(), wall = wall_now();
+    for (const auto& [lid, l] : leases_) {
+      rec += "[\"g\",";
+      jint(rec, lid);
+      rec += ',';
+      jdbl(rec, l.ttl);
+      rec += ',';
+      jdbl(rec, wall + (l.deadline - steady));
+      rec += "]\n";
+    }
+    for (const auto& [key, kv] : kv_) {
+      rec += "[\"s\",";
+      jesc(rec, key);
+      rec += ',';
+      jesc(rec, kv.value);
+      rec += ',';
+      jint(rec, kv.create_rev);
+      rec += ',';
+      jint(rec, kv.mod_rev);
+      rec += ',';
+      jint(rec, kv.lease);
+      rec += "]\n";
+    }
+    fwrite(rec.data(), 1, rec.size(), out);
+    fflush(out);
+    fdatasync(fileno(out));
+    fclose(out);
+    if (rename(tmp.c_str(), path.c_str()) != 0) {
+      err = "rename failed for " + tmp;
+      return false;
+    }
+    wal_ = &wal_storage_;
+    if (!wal_->open_append(path)) {
+      err = "cannot append to " + path;
+      wal_ = nullptr;
+      return false;
+    }
+    return true;
   }
 
   // watch: registers the sink and (with start_rev) replays retained
@@ -501,6 +669,16 @@ class Store {
     KVRec rec{value, ev.has_prev ? ev.prev.create_rev : rev_, rev_, lease};
     kv_[key] = rec;
     ev.kv = rec;
+    if (wal_ && !replaying_) {
+      std::string w = "[\"p\",";
+      jesc(w, key);
+      w += ',';
+      jesc(w, value);
+      w += ',';
+      jint(w, lease);
+      w += ']';
+      wal_->append(w);
+    }
     notify_locked(std::move(ev));
     return rev_;
   }
@@ -520,6 +698,12 @@ class Store {
     kv_.erase(it);
     rev_++;
     ev.kv = KVRec{"", ev.prev.create_rev, rev_, 0};  // tombstone
+    if (wal_ && !replaying_) {
+      std::string w = "[\"d\",";
+      jesc(w, key);
+      w += ']';
+      wal_->append(w);
+    }
     notify_locked(std::move(ev));
     return true;
   }
@@ -532,11 +716,80 @@ class Store {
     for (long long lid : dead) {
       std::set<std::string> keys = std::move(leases_[lid].keys);
       leases_.erase(lid);
+      if (wal_ && !replaying_) {
+        std::string rec = "[\"x\",";
+        jint(rec, lid);
+        rec += ']';
+        wal_->append(rec);
+      }
       for (const auto& k : keys) delete_locked(k);
     }
   }
 
   void notify_locked(Ev ev);
+
+  // replay one WAL record; false on parse failure
+  bool replay_line(const std::string& line) {
+    JParser jp(line);
+    JV v;
+    if (!jp.value(v) || v.t != JV::ARR || v.arr.empty() ||
+        v.arr[0].t != JV::STR || v.arr[0].s.empty())
+      return false;
+    const std::string& op = v.arr[0].s;
+    auto num = [&](size_t i) -> double {
+      return i < v.arr.size() ? v.arr[i].as_dbl() : 0;
+    };
+    auto inum = [&](size_t i) -> long long {
+      return i < v.arr.size() ? v.arr[i].as_int() : 0;
+    };
+    auto s = [&](size_t i) -> const std::string& {
+      static const std::string empty;
+      return i < v.arr.size() && v.arr[i].t == JV::STR ? v.arr[i].s : empty;
+    };
+    if (op == "p") {
+      if (v.arr.size() < 4) return false;
+      // a put whose lease already expired+vanished during downtime would
+      // throw; recreate-then-expire is indistinguishable, so drop it
+      if (inum(3) && !leases_.count(inum(3))) return true;
+      put_locked(s(1), s(2), inum(3));
+    } else if (op == "d") {
+      delete_locked(s(1));
+    } else if (op == "g") {
+      long long lid = inum(1);
+      leases_[lid] = LeaseRec{num(2), now() + (num(3) - wall_now()), {}};
+      if (lid >= next_lease_) next_lease_ = lid + 1;
+    } else if (op == "k") {
+      auto it = leases_.find(inum(1));
+      if (it != leases_.end())
+        it->second.deadline = now() + (num(2) - wall_now());
+    } else if (op == "x") {
+      // full revoke semantics: delete attached keys too.  The live path
+      // logs "x" then one "d" per key; replaying "x" this way makes the
+      // following "d"s no-ops in the normal case AND closes the crash
+      // window where the process died after flushing "x" but before its
+      // "d"s — otherwise those leased keys would resurrect unleased.
+      auto it = leases_.find(inum(1));
+      if (it != leases_.end()) {
+        std::set<std::string> keys = std::move(it->second.keys);
+        leases_.erase(it);
+        for (const auto& k : keys) delete_locked(k);
+      }
+    } else if (op == "v") {
+      rev_ = inum(1);
+      next_lease_ = inum(2);
+    } else if (op == "s") {
+      if (v.arr.size() < 6) return false;
+      KVRec rec{s(2), inum(3), inum(4), inum(5)};
+      kv_[s(1)] = rec;
+      if (rec.lease) {
+        auto it = leases_.find(rec.lease);
+        if (it != leases_.end()) it->second.keys.insert(s(1));
+      }
+    } else {
+      return false;
+    }
+    return true;
+  }
 
   std::map<std::string, KVRec> kv_;
   long long rev_ = 0;
@@ -545,6 +798,9 @@ class Store {
   std::vector<Sink> sinks_;
   std::deque<Ev> history_;
   size_t history_cap_;
+  Wal wal_storage_;
+  Wal* wal_ = nullptr;
+  bool replaying_ = false;
 };
 
 // ---------------------------------------------------------------------------
@@ -785,6 +1041,7 @@ static void reader(std::shared_ptr<Conn> c) {
 
 int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
+  std::string wal_path;
   int port = 7070;
   size_t history = 65536;
   double sweep_s = 0.2;
@@ -795,8 +1052,10 @@ int main(int argc, char** argv) {
     else if (a == "--port") port = atoi(next());
     else if (a == "--history") history = (size_t)atoll(next());
     else if (a == "--sweep-interval") sweep_s = atof(next());
+    else if (a == "--wal") wal_path = next();
     else if (a == "--help") {
-      printf("cronsun-stored --host H --port P [--history N] [--sweep-interval S]\n");
+      printf("cronsun-stored --host H --port P [--history N] "
+             "[--sweep-interval S] [--wal FILE]\n");
       return 0;
     }
   }
@@ -820,12 +1079,18 @@ int main(int argc, char** argv) {
     perror("listen");
     return 1;
   }
+  static Store store(history);
+  if (!wal_path.empty()) {
+    std::string err;
+    if (!store.open_wal(wal_path, err)) {
+      fprintf(stderr, "wal: %s\n", err.c_str());
+      return 1;
+    }
+  }
   socklen_t alen = sizeof addr;
   getsockname(lfd, (sockaddr*)&addr, &alen);  // resolve port 0
   printf("READY %s:%d\n", host.c_str(), (int)ntohs(addr.sin_port));
   fflush(stdout);
-
-  static Store store(history);
   std::thread([&] {
     while (true) {
       std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));
