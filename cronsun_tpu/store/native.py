@@ -64,7 +64,8 @@ class NativeStoreServer:
                 "cronsun-stored not found (set $CRONSUN_STORED or build "
                 "native/)")
         argv = [self.binary, "--host", host, "--port", str(port),
-                "--history", str(history)] + (extra_args or [])
+                "--history", str(history),
+                "--die-with-parent"] + (extra_args or [])
         if wal:
             argv += ["--wal", wal]
         # stderr merged into stdout so a startup failure (bind error …)

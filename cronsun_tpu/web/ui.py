@@ -160,7 +160,7 @@ const render={
   $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt onchange="nav('logs')"> ${t('failedOnly')}</label>
    <span class=muted>${d.total} ${t('records')}</span></div>
   <table><tr><th>${t('job')}</th><th>${t('node')}</th><th>${t('begin')}</th><th>${t('secs')}</th><th>ok</th><th>${t('output')}</th></tr>
-  ${d.list.map(l=>`<tr><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
+  ${d.list.map(l=>`<tr style=cursor:pointer onclick="logDetail(${l.id})"><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
    <td>${(l.endTime-l.beginTime).toFixed(1)}</td>
    <td>${l.success?'<span class=ok>✓</span>':'<span class=bad>✗</span>'}</td>
    <td><code>${esc((l.output||'').slice(0,160))}</code></td></tr>`).join('')}</table>`},
@@ -205,6 +205,13 @@ window.editAccount=(a)=>{a=a||{};
   if($('#ap').value)body.password=$('#ap').value;
   await api(a.email?'POST':'PUT','/v1/admin/account',body);
   dlg.close();nav('accounts')}catch(x){alert(x)}}};
+window.logDetail=async id=>{const l=await api('GET','/v1/log/'+id);
+ document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg>
+  <b>${esc(l.name)}</b> <span class=muted>@ ${esc(l.node)} · ${ts(l.beginTime)} · ${(l.endTime-l.beginTime).toFixed(2)}s ·
+  ${l.success?`<span class=ok>✓</span>`:`<span class=bad>✗</span>`}</span>
+  <p><code>${esc(l.command)}</code></p><pre>${esc(l.output||'')}</pre>
+  <div class=bar style="margin-top:10px"><form method=dialog><button class=plain>${t('cancel')}</button></form></div>
+ </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove()};
 window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
 window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert(t('dispatched'))};
 window.delJob=async(g,id)=>{if(confirm(t('delJobQ'))){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};

@@ -22,6 +22,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
+#include <sys/prctl.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -1053,9 +1054,16 @@ int main(int argc, char** argv) {
     else if (a == "--history") history = (size_t)atoll(next());
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--wal") wal_path = next();
+    else if (a == "--die-with-parent") {
+      // supervised mode (the Python wrapper passes this): if the
+      // supervisor is SIGKILLed, the server must not linger orphaned
+      // holding the port — opt-in so direct daemonization (nohup) works
+      prctl(PR_SET_PDEATHSIG, SIGKILL);
+      if (getppid() == 1) return 1;   // parent already gone
+    }
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
-             "[--sweep-interval S] [--wal FILE]\n");
+             "[--sweep-interval S] [--wal FILE] [--die-with-parent]\n");
       return 0;
     }
   }

@@ -143,3 +143,104 @@ def test_full_system_multiprocess(tmp_path, store_backend):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_store_crash_restart_fleet_heals(tmp_path):
+    """The deployment resilience story: the native store (with WAL) is
+    killed -9 mid-flight and restarted on the same port; every client
+    (scheduler, agent, web) heals its connection, the job definitions
+    come back from the WAL, and executions resume."""
+    from cronsun_tpu.store.native import find_binary
+    if find_binary() is None:
+        pytest.skip("native store binary unavailable")
+    import socket as _socket
+    from cronsun_tpu.logsink import JobLogStore
+
+    sock = _socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    logdb = str(tmp_path / "logs.db")
+    wal = str(tmp_path / "store.wal")
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": logdb, "window_s": 2, "node_ttl": 5,
+        "job_capacity": 256, "node_capacity": 64, "proc_req": 0}))
+
+    def spawn_store():
+        p = _spawn("cronsun_tpu.bin.store", "--native", "--wal", wal,
+                   "--port", str(port))
+        _await_ready(p)
+        return p
+
+    procs = []
+    try:
+        store_p = spawn_store()
+        sched_p = _spawn("cronsun_tpu.bin.sched", "--store",
+                         f"127.0.0.1:{port}", "--conf", str(conf))
+        node_p = _spawn("cronsun_tpu.bin.node", "--store",
+                        f"127.0.0.1:{port}", "--conf", str(conf),
+                        "--node-id", "hz-node")
+        web_p = _spawn("cronsun_tpu.bin.web", "--store",
+                       f"127.0.0.1:{port}", "--conf", str(conf),
+                       "--port", "0")
+        procs = [sched_p, node_p, web_p]
+        _await_ready(sched_p)
+        _await_ready(node_p)
+        web_addr = _await_ready(web_p)
+
+        cj = http.cookiejar.CookieJar()
+        op = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(cj))
+        base = f"http://{web_addr}"
+        q = urllib.parse.urlencode(
+            {"email": "admin@admin.com", "password": "admin"})
+        op.open(f"{base}/v1/session?{q}", timeout=10)
+        job = {"name": "hz", "command": "echo heal", "kind": 0,
+               "rules": [{"timer": "* * * * * *", "nids": ["hz-node"]}]}
+        req = urllib.request.Request(
+            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        op.open(req, timeout=10)
+
+        sink = JobLogStore(logdb)
+
+        def count():
+            _, n = sink.query_logs()
+            return n
+
+        deadline = time.time() + 45
+        while time.time() < deadline and count() < 3:
+            time.sleep(0.5)
+        before = count()
+        assert before >= 3, f"no executions before crash ({before})"
+
+        # kill -9: wrapper exits via its child monitor
+        store_p.send_signal(signal.SIGKILL)
+        store_p.wait(timeout=10)
+        time.sleep(1)
+        store_p = spawn_store()
+
+        # executions must RESUME (strictly grow past pre-crash count)
+        deadline = time.time() + 60
+        while time.time() < deadline and count() < before + 3:
+            time.sleep(0.5)
+        after = count()
+        assert after >= before + 3, \
+            f"executions did not resume after store restart " \
+            f"({before} -> {after})"
+        # the job survived in the restarted store
+        with op.open(f"{base}/v1/jobs", timeout=10) as r:
+            jobs = json.loads(r.read())
+        assert any(j["name"] == "hz" for j in jobs)
+        sink.close()
+    finally:
+        procs.append(store_p)
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
