@@ -70,6 +70,7 @@ const L={en:{
  cronTimer:'cron timer (sec min hour dom month dow)',
  nodeIds:'node ids (comma)',groupIds:'group ids',excludeNodes:'exclude nodes',
  delJobQ:'delete job?',delGroupQ:'delete group?',dispatched:'dispatched',
+ allNodes:'all eligible nodes',
 },zh:{
  dash:'仪表盘',jobs:'任务',nodes:'节点',groups:'节点分组',logs:'执行日志',
  exec:'正在执行',accounts:'账户',logout:'退出',signin:'登录',
@@ -96,6 +97,7 @@ const L={en:{
  cronTimer:'cron 定时器（秒 分 时 日 月 周）',
  nodeIds:'节点 ID（逗号分隔）',groupIds:'分组 ID',excludeNodes:'排除节点',
  delJobQ:'确定删除该任务？',delGroupQ:'确定删除该分组？',dispatched:'已派发',
+ allNodes:'所有可选节点',
 }};
 let lang=localStorage.lang||'en';
 const t=k=>(L[lang]&&L[lang][k])||L.en[k]||k;
@@ -213,7 +215,17 @@ window.logDetail=async id=>{const l=await api('GET','/v1/log/'+id);
   <div class=bar style="margin-top:10px"><form method=dialog><button class=plain>${t('cancel')}</button></form></div>
  </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove()};
 window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
-window.runNow=async(g,id)=>{await api('PUT',`/v1/job/${g}-${id}/execute?node=`);alert(t('dispatched'))};
+window.runNow=async(g,id)=>{const ns=await api('GET',`/v1/job/${g}-${id}/nodes`);
+ document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg>
+  <b>${t('run')}</b>
+  <label>${t('node')}</label><select id=xn><option value="">${t('allNodes')}</option>
+  ${ns.map(n=>`<option>${esc(n)}</option>`).join('')}</select>
+  <div class=bar style="margin-top:14px"><button id=sv>${t('run')}</button>
+  <form method=dialog style=display:inline><button class=plain>${t('cancel')}</button></form></div>
+ </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
+ $('#sv').onclick=async e=>{e.preventDefault();try{
+  await api('PUT',`/v1/job/${g}-${id}/execute?node=`+encodeURIComponent($('#xn').value));
+  dlg.close();alert(t('dispatched'))}catch(x){alert(x)}}};
 window.delJob=async(g,id)=>{if(confirm(t('delJobQ'))){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
 window.delGroup=async id=>{if(confirm(t('delGroupQ'))){await api('DELETE','/v1/node/group/'+id);nav('groups')}};
 window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
