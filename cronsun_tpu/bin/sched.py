@@ -19,6 +19,9 @@ def main(argv=None) -> int:
                     help="start a jax.profiler server (TensorBoard-"
                          "connectable) so tick/assign spans can be captured "
                          "live; 0 disables")
+    ap.add_argument("--mesh", type=int, default=0, metavar="D",
+                    help="shard the planner over a D-device jobs mesh "
+                         "(0 = single chip)")
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
     if args.profile_port:
@@ -31,11 +34,18 @@ def main(argv=None) -> int:
         from zoneinfo import ZoneInfo
         tz = ZoneInfo(cfg.timezone)
     store = connect_store(args.store)
+    planner = None
+    if args.mesh > 1:
+        from ..parallel.mesh import ShardedTickPlanner, make_mesh
+        planner = ShardedTickPlanner(
+            make_mesh(args.mesh), job_capacity=cfg.job_capacity,
+            node_capacity=cfg.node_capacity, tz=tz)
+        log.infof("planner sharded over %d devices", args.mesh)
     sched = SchedulerService(
         store, ks=ks, job_capacity=cfg.job_capacity,
         node_capacity=cfg.node_capacity, window_s=cfg.window_s,
         default_node_cap=cfg.default_node_cap, node_id=args.node_id,
-        dispatch_ttl=cfg.lock_ttl, tz=tz)
+        dispatch_ttl=cfg.lock_ttl, tz=tz, planner=planner)
     sched.start()
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)

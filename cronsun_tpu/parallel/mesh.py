@@ -250,6 +250,54 @@ class _ShardedPlannerBase:
     def set_node_capacity_full(self, caps: np.ndarray):
         self.rem_cap = jax.device_put(caps.astype(np.int32), self._repl)
 
+    # row-wise incremental setters (the SchedulerService's watch->delta
+    # surface — same contract as ops.planner.TickPlanner); scatters on
+    # sharded arrays re-pin to the canonical sharding afterwards
+
+    def set_eligibility_rows(self, rows: np.ndarray, values: np.ndarray):
+        if len(rows):
+            self.elig = jax.device_put(
+                self.elig.at[jnp.asarray(rows)].set(jnp.asarray(values)),
+                self._shard2)
+
+    def set_job_meta(self, rows: np.ndarray, exclusive: np.ndarray,
+                     cost: np.ndarray):
+        if len(rows):
+            r = jnp.asarray(np.asarray(rows, np.int32))
+            self.exclusive = jax.device_put(
+                self.exclusive.at[r].set(jnp.asarray(exclusive)),
+                self._shard)
+            self.cost = jax.device_put(
+                self.cost.at[r].set(
+                    jnp.asarray(cost).astype(jnp.float32)), self._shard)
+
+    def set_node_capacity(self, cols, caps):
+        if len(cols):
+            c = jnp.asarray(np.asarray(cols, np.int32))
+            self.rem_cap = jax.device_put(
+                self.rem_cap.at[c].set(
+                    jnp.asarray(np.asarray(caps, np.int32))), self._repl)
+
+    # load is assigned wholesale by the service's capacity reconciliation;
+    # re-pin whatever it assigns to the replicated sharding
+    @property
+    def load(self):
+        return self._load
+
+    @load.setter
+    def load(self, v):
+        self._load = jax.device_put(jnp.asarray(v), self._repl)
+
+    def job_finished(self, node_col: int, cost: float):
+        self.rem_cap = self.rem_cap.at[node_col].add(1)
+        self.load = self.load.at[node_col].add(-float(cost))
+
+    def common_finished(self, node_col: int, cost: float):
+        self.load = self.load.at[node_col].add(-float(cost))
+
+    def decay_load(self, factor: float = 0.99):
+        self.load = self.load * factor
+
     # -- tick --------------------------------------------------------------
 
     def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
@@ -278,6 +326,16 @@ class _ShardedPlannerBase:
         assigned = np.concatenate(assigned)
         return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
                         overflow=max(0, total - len(fired)))
+
+    def plan_window(self, epoch_s: int, window_s: int,
+                    sla_bucket=None):
+        """Window = sequential per-second plans (load/capacity carry in
+        self) — same TickPlan-list contract as TickPlanner.plan_window,
+        one dispatch per second.  Lets SchedulerService run unchanged
+        over a mesh; the fused windowed scan stays a single-chip
+        specialization for now."""
+        return [self.plan(epoch_s + w, sla_bucket=sla_bucket)
+                for w in range(window_s)]
 
 
 class ShardedTickPlanner(_ShardedPlannerBase):
