@@ -71,18 +71,34 @@ class Lease:
 
 
 class Watcher:
-    """A watch stream over a key prefix."""
+    """A watch stream over a key prefix.
 
-    def __init__(self, store: "MemStore", prefix: str, start_rev: int):
+    The queue is bounded: a consumer that falls ``max_backlog`` events
+    behind has lost the stream anyway, so the watcher cancels itself
+    (etcd cancels slow watchers the same way; the native server bounds
+    its per-connection outbox identically).  ``lost`` tells the consumer
+    to re-list and re-watch."""
+
+    MAX_BACKLOG = 1 << 17
+
+    def __init__(self, store: "MemStore", prefix: str, start_rev: int,
+                 max_backlog: int = MAX_BACKLOG):
         self._store = store
         self.prefix = prefix
         self.start_rev = start_rev
+        self.lost = False
+        self._max_backlog = max_backlog
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._closed = False
 
     def _emit(self, ev: Event):
-        if not self._closed:
-            self._q.put(ev)
+        if self._closed:
+            return
+        if self._q.qsize() >= self._max_backlog:
+            self.lost = True
+            self.close()
+            return
+        self._q.put(ev)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         """Next event, or None on timeout/close."""
@@ -327,6 +343,8 @@ class MemStore:
 
     def _notify(self, ev: Event):
         self._history.append(ev)
-        for w in self._watchers:
+        # copy: an overflowing watcher cancels itself (removes from the
+        # list) from inside _emit
+        for w in list(self._watchers):
             if ev.kv.key.startswith(w.prefix):
                 w._emit(ev)

@@ -169,3 +169,24 @@ def test_put_with_dead_lease_leaves_old_binding_intact():
     s.revoke(l1)
     assert s.get("/k") is None  # still owned (and deleted) by l1
     s.close()
+
+
+def test_slow_watcher_cancelled_not_unbounded():
+    """A consumer that falls max_backlog behind loses the watch (lost
+    flag set, stream closed) instead of growing memory forever — etcd's
+    slow-watcher cancellation."""
+    s = MemStore()
+    w = s.watch("/k", )
+    w._max_backlog = 100
+    for i in range(150):
+        s.put("/k/x", str(i))
+    assert w.lost is True
+    assert w._closed
+    # the stream drained up to the overflow point, then ended
+    evs = w.drain()
+    assert len(evs) <= 101
+    # other watchers and the store keep working
+    w2 = s.watch("/k")
+    s.put("/k/y", "1")
+    assert w2.get(timeout=1) is not None
+    s.close()
