@@ -32,7 +32,7 @@ from ..core import Group, Job, Keyspace, Node
 from ..core.errors import DuplicateNode
 from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
-from ..store.memstore import DELETE, MemStore
+from ..store.memstore import DELETE, MemStore, WatchLost
 from .executor import ExecResult, Executor
 
 VERSION = "v0.1.0-tpu"
@@ -72,6 +72,7 @@ class NodeAgent:
         self.groups: Dict[str, Group] = {}
         self._load_groups()
         self.running: Dict[str, threading.Thread] = {}
+        self._bseen: Dict[tuple, float] = {}   # broadcast (job, sec) dedup
 
     # ---- registration (node/node.go:64-119) ------------------------------
 
@@ -372,35 +373,94 @@ class NodeAgent:
         n = 0
         deadline = self.clock() + wait
         while True:
-            self._poll_groups()
-            n += self._poll_dispatch()
-            n += self._poll_broadcast()
-            n += self._poll_once()
+            try:
+                self._poll_groups()
+                n += self._poll_dispatch()
+                n += self._poll_broadcast()
+                n += self._poll_once()
+            except WatchLost as e:
+                log.warnf("agent watch lost (%s); resynchronizing", e)
+                n += self.resync_watches()
             if self.clock() >= deadline:
                 break
             time.sleep(0.01)
         return n
+
+    def resync_watches(self) -> int:
+        """Rebuild all watch streams after a loss and reconcile from the
+        store's current contents: groups reload; still-live dispatch
+        orders and broadcasts re-run (exclusive runs are fenced by the
+        (job, second) store lock; Common runs by the in-memory _bseen
+        dedup — either way the retry is exactly-once).  Pending
+        once-triggers are NOT re-run: we cannot know whether the previous
+        stream delivered them and run-now has no fence; at-most-once is
+        the safe reading."""
+        for w in (self._w_dispatch, self._w_broadcast, self._w_groups,
+                  self._w_once):
+            try:
+                w.close()
+            except Exception:   # noqa: BLE001 — already-dead watchers
+                pass
+        self._w_dispatch = self.store.watch(
+            self.ks.dispatch + self.id + "/")
+        self._w_broadcast = self.store.watch(self.ks.dispatch_all)
+        self._w_groups = self.store.watch(self.ks.group)
+        self._w_once = self.store.watch(self.ks.once)
+        self.groups.clear()
+        self._load_groups()
+        n = 0
+        for kv in self.store.get_prefix(self.ks.dispatch + self.id + "/"):
+            n += self._handle_dispatch_kv(kv.key, order_key=kv.key)
+        for kv in self.store.get_prefix(self.ks.dispatch_all):
+            n += self._handle_broadcast_kv(kv.key)
+        return n
+
+    def _handle_dispatch_kv(self, key: str,
+                            order_key: Optional[str] = None) -> int:
+        rest = key[len(self.ks.dispatch) + len(self.id) + 1:]
+        parts = rest.split("/")
+        if len(parts) != 3:
+            return 0
+        epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
+        job = self._get_job(group, job_id)
+        if job is None or job.pause:
+            self.store.delete(key)
+            return 0
+        # the order key stays in the store until the execution's proc
+        # key exists — the scheduler counts it as an outstanding
+        # capacity reservation in the meantime
+        self._spawn(job, epoch_s, fenced=True, order_key=order_key)
+        return 1
 
     def _poll_dispatch(self) -> int:
         n = 0
         for ev in self._w_dispatch.drain():
             if ev.type == DELETE:
                 continue
-            rest = ev.kv.key[len(self.ks.dispatch) + len(self.id) + 1:]
-            parts = rest.split("/")
-            if len(parts) != 3:
-                continue
-            epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
-            job = self._get_job(group, job_id)
-            if job is None or job.pause:
-                self.store.delete(ev.kv.key)
-                continue
-            # the order key stays in the store until the execution's proc
-            # key exists — the scheduler counts it as an outstanding
-            # capacity reservation in the meantime
-            self._spawn(job, epoch_s, fenced=True, order_key=ev.kv.key)
-            n += 1
+            n += self._handle_dispatch_kv(ev.kv.key, order_key=ev.kv.key)
         return n
+
+    def _handle_broadcast_kv(self, key: str) -> int:
+        rest = key[len(self.ks.dispatch_all):]
+        parts = rest.split("/")
+        if len(parts) != 3:
+            return 0
+        epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
+        # Common runs have no store fence; this in-memory (job, second)
+        # dedup keeps the resync re-list (and any stream re-delivery)
+        # from double-running a broadcast this agent already took
+        if (job_id, epoch_s) in self._bseen:
+            return 0
+        job = self._get_job(group, job_id)
+        if job is None or job.pause or not self.is_run_on(job):
+            return 0
+        self._bseen[(job_id, epoch_s)] = self.clock()
+        if len(self._bseen) > 8192:     # prune half-hour-old entries
+            cut = self.clock() - 1800
+            for k2 in [k2 for k2, ts in self._bseen.items() if ts < cut]:
+                del self._bseen[k2]
+        self._spawn(job, epoch_s, fenced=True)
+        return 1
 
     def _poll_broadcast(self) -> int:
         """Common-kind fan-out: one order per (second, job) for the whole
@@ -410,16 +470,7 @@ class NodeAgent:
         for ev in self._w_broadcast.drain():
             if ev.type == DELETE:
                 continue
-            rest = ev.kv.key[len(self.ks.dispatch_all):]
-            parts = rest.split("/")
-            if len(parts) != 3:
-                continue
-            epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
-            job = self._get_job(group, job_id)
-            if job is None or job.pause or not self.is_run_on(job):
-                continue
-            self._spawn(job, epoch_s, fenced=True)
-            n += 1
+            n += self._handle_broadcast_kv(ev.kv.key)
         return n
 
     def _poll_once(self) -> int:

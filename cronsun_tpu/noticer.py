@@ -22,7 +22,7 @@ from typing import Callable, List, Optional
 from .core import Keyspace
 from . import log
 from .logsink import JobLogStore
-from .store.memstore import DELETE, MemStore
+from .store.memstore import DELETE, MemStore, WatchLost
 
 
 class Notice:
@@ -116,6 +116,46 @@ class NoticerHost:
         self.sent: List[Notice] = []     # for introspection/tests
 
     def poll(self) -> int:
+        try:
+            return self._poll_once()
+        except WatchLost as e:
+            log.warnf("noticer watch lost (%s); resynchronizing", e)
+            return self.resync()
+
+    def resync(self) -> int:
+        """Re-watch and deliver any pending notices from a re-list
+        (notices are deleted after delivery, so the retry is safe;
+        node-death events inside the lost window are checked against the
+        alived mirror via the current node list)."""
+        for w in (self._w_notice, self._w_nodes):
+            try:
+                w.close()
+            except Exception:   # noqa: BLE001
+                pass
+        self._w_notice = self.store.watch(self.ks.noticer)
+        self._w_nodes = self.store.watch(self.ks.node)
+        n = 0
+        for kv in self.store.get_prefix(self.ks.noticer):
+            try:
+                d = json.loads(kv.value)
+            except json.JSONDecodeError:
+                continue
+            n += self._deliver(Notice(d.get("subject", ""),
+                                      d.get("body", ""), d.get("to")))
+            self.store.delete(kv.key)
+        # nodes the mirror says are alive but whose lease key vanished
+        # during the gap died uncleanly
+        live = {kv.key[len(self.ks.node):]
+                for kv in self.store.get_prefix(self.ks.node)}
+        for mirror in self.sink.get_nodes():
+            nid = mirror.get("id")
+            if mirror.get("alived") and nid not in live:
+                n += self._deliver(Notice(
+                    f"[cronsun] node [{nid}] down",
+                    f"node {nid} lease expired without clean shutdown"))
+        return n
+
+    def _poll_once(self) -> int:
         n = 0
         for ev in self._w_notice.drain():
             if ev.type == DELETE:

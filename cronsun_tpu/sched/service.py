@@ -37,7 +37,7 @@ from ..cron.parser import ParseError, parse
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
 from ..ops.schedule_table import make_row, update_rows, _INACTIVE_ROW
-from ..store.memstore import DELETE, MemStore
+from ..store.memstore import DELETE, MemStore, WatchLost
 
 
 class _Rows:
@@ -258,6 +258,45 @@ class SchedulerService:
         self.planner.set_node_capacity([col], [0])
 
     def drain_watches(self):
+        try:
+            self._drain_watches_once()
+        except WatchLost as e:
+            log.warnf("scheduler watch lost (%s); resynchronizing", e)
+            self.resync()
+
+    def resync(self):
+        """Anti-entropy: rebuild watchers and reconcile device state with
+        the store's current contents.  Run after a lost watch stream
+        (overflow / compacted reconnect) — re-applying is idempotent and
+        rows whose job/group vanished during the gap are dropped."""
+        for w in (self._w_jobs, self._w_groups, self._w_nodes):
+            try:
+                w.close()
+            except Exception:   # noqa: BLE001 — already-dead watchers
+                pass
+        self._w_jobs = self.store.watch(self.ks.cmd)
+        self._w_groups = self.store.watch(self.ks.group)
+        self._w_nodes = self.store.watch(self.ks.node)
+        live_jobs = set()
+        for kv in self.store.get_prefix(self.ks.cmd):
+            rest = kv.key[len(self.ks.cmd):]
+            if "/" in rest:
+                live_jobs.add(tuple(rest.split("/", 1)))
+        for (group, job_id) in [k for k in list(self.rows.by_job)
+                                if k not in live_jobs]:
+            self._drop_job(group, job_id)
+        live_groups = {kv.key[len(self.ks.group):]
+                       for kv in self.store.get_prefix(self.ks.group)}
+        for gid in [g for g in list(self.groups) if g not in live_groups]:
+            self._drop_group(gid)
+        live_nodes = {kv.key[len(self.ks.node):]
+                      for kv in self.store.get_prefix(self.ks.node)}
+        for nid in [n for n in list(self.universe.index)
+                    if n not in live_nodes]:
+            self._node_down(nid)
+        self._load_initial()
+
+    def _drain_watches_once(self):
         for ev in self._w_groups.drain():
             gid = ev.kv.key[len(self.ks.group):]
             if ev.type == DELETE:

@@ -19,5 +19,5 @@ that architecture but behind a small interface:
 """
 
 from .memstore import (CompactedError, Event, KV, Lease,  # noqa: F401
-                       MemStore, Watcher)
+                       MemStore, WatchLost, Watcher)
 from .remote import RemoteStore, StoreServer  # noqa: F401

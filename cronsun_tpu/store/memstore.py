@@ -14,9 +14,11 @@ Implements exactly the subset the framework (and the reference) relies on:
   client.go:95-109) and put-if-mod-rev CAS (pause toggle / group scrub,
   client.go:44-65).
 
-Thread-safe; watchers receive events through unbounded queues on the
-mutating thread.  Lease expiry is checked lazily on every operation and by
-an optional sweeper thread.
+Thread-safe; watchers receive events through BOUNDED queues on the
+mutating thread — a consumer that falls max_backlog behind loses the
+stream (WatchLost on the next drain/get) and must re-list + re-watch,
+etcd's slow-watcher cancellation.  Lease expiry is checked lazily on
+every operation and by an optional sweeper thread.
 """
 
 from __future__ import annotations
@@ -36,6 +38,13 @@ class CompactedError(RuntimeError):
     """watch(start_rev) asked for revisions older than the bounded event
     history retains (etcd's ErrCompacted): the caller must re-list the
     prefix and watch from the current revision instead."""
+
+
+class WatchLost(RuntimeError):
+    """The watch stream was cancelled because the consumer fell too far
+    behind (etcd's slow-watcher cancellation).  Raised by get()/drain()
+    once the buffered events are exhausted: the consumer must re-watch
+    and re-list the prefix to resynchronize."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,21 +110,34 @@ class Watcher:
         self._q.put(ev)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
-        """Next event, or None on timeout/close."""
+        """Next event, or None on timeout/close.  Raises WatchLost once a
+        cancelled-by-overflow stream has drained its buffered events."""
         try:
-            return self._q.get(timeout=timeout)
+            ev = self._q.get(timeout=timeout)
         except queue.Empty:
+            if self.lost:
+                raise WatchLost(f"watch {self.prefix!r} overflowed")
             return None
+        if ev is None and self.lost:
+            raise WatchLost(f"watch {self.prefix!r} overflowed")
+        return ev
 
     def drain(self) -> List[Event]:
+        """Buffered events.  A cancelled-by-overflow stream first yields
+        its remaining buffer, then raises WatchLost on the next call."""
         out = []
         while True:
             try:
                 ev = self._q.get_nowait()
             except queue.Empty:
+                if self.lost and not out:
+                    raise WatchLost(f"watch {self.prefix!r} overflowed")
                 return out
-            if ev is not None:
-                out.append(ev)
+            if ev is None:
+                if self.lost and not out:
+                    raise WatchLost(f"watch {self.prefix!r} overflowed")
+                return out
+            out.append(ev)
 
     def close(self):
         self._closed = True
@@ -312,14 +334,17 @@ class MemStore:
 
     # ---- watch -----------------------------------------------------------
 
-    def watch(self, prefix: str, start_rev: int = 0) -> Watcher:
+    def watch(self, prefix: str, start_rev: int = 0,
+              max_backlog: Optional[int] = None) -> Watcher:
         """Watch a prefix.  With ``start_rev`` > 0, replay retained events
         with mod_rev >= start_rev first (etcd WithRev) — a reconnecting
         watcher resumes without losing deltas.  Raises
         :class:`CompactedError` if the bounded history no longer reaches
-        back that far."""
+        back that far, and :class:`WatchLost` if the replay itself
+        overflows ``max_backlog`` (re-list instead)."""
         with self._lock:
-            w = Watcher(self, prefix, start_rev or self._rev)
+            w = Watcher(self, prefix, start_rev or self._rev,
+                        max_backlog=max_backlog or Watcher.MAX_BACKLOG)
             if start_rev and start_rev <= self._rev:
                 # every revision 1..rev emitted exactly one event, so the
                 # replay is complete iff the ring still holds start_rev
@@ -333,6 +358,9 @@ class MemStore:
                     if (ev.kv.mod_rev >= start_rev
                             and ev.kv.key.startswith(prefix)):
                         w._emit(ev)
+                if w.lost:   # replay alone overflowed: don't register a
+                    raise WatchLost(   # dead watcher, tell the caller
+                        f"watch {prefix!r} replay overflowed; re-list")
             self._watchers.append(w)
             return w
 

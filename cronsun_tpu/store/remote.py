@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import log
 from .memstore import CompactedError, DELETE, PUT, Event, KV, MemStore, \
-    Watcher
+    WatchLost, Watcher
 
 
 def _kv_wire(kv: Optional[KV]):
@@ -89,9 +89,15 @@ class _Conn(socketserver.BaseRequestHandler):
                 self.alive = False
 
     def _pump(self, wid: int, w: Watcher):
-        """Forward one watcher's events to the client until closed."""
+        """Forward one watcher's events to the client until closed.  A
+        slow-consumer cancellation propagates as a lost notification so
+        the client can re-list + re-watch instead of starving silently."""
         while self.alive:
-            ev = w.get(timeout=0.25)
+            try:
+                ev = w.get(timeout=0.25)
+            except WatchLost:
+                self._send({"w": wid, "lost": True})
+                return
             if ev is None:
                 if w._closed:
                     return
@@ -196,6 +202,7 @@ class RemoteWatcher:
         self.prefix = prefix
         self.start_rev = start_rev
         self.last_rev = 0          # highest mod_rev seen (resume point)
+        self.lost = False
         import queue
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._closed = False
@@ -206,12 +213,25 @@ class RemoteWatcher:
                 self.last_rev = ev.kv.mod_rev
             self._q.put(ev)
 
+    def _mark_lost(self):
+        """Server cancelled this stream (slow consumer): same WatchLost
+        contract as the in-process Watcher."""
+        self.lost = True
+        self._closed = True
+        self._store._watchers.pop(self._wid, None)
+        self._q.put(None)
+
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         import queue
         try:
-            return self._q.get(timeout=timeout)
+            ev = self._q.get(timeout=timeout)
         except queue.Empty:
+            if self.lost:
+                raise WatchLost(f"watch {self.prefix!r} overflowed")
             return None
+        if ev is None and self.lost:
+            raise WatchLost(f"watch {self.prefix!r} overflowed")
+        return ev
 
     def drain(self) -> List[Event]:
         import queue
@@ -220,9 +240,14 @@ class RemoteWatcher:
             try:
                 ev = self._q.get_nowait()
             except queue.Empty:
+                if self.lost and not out:
+                    raise WatchLost(f"watch {self.prefix!r} overflowed")
                 return out
-            if ev is not None:
-                out.append(ev)
+            if ev is None:
+                if self.lost and not out:
+                    raise WatchLost(f"watch {self.prefix!r} overflowed")
+                return out
+            out.append(ev)
 
     def close(self):
         if self._closed:
@@ -298,7 +323,10 @@ class RemoteStore:
             if "w" in msg:
                 w = self._watchers.get(msg["w"])
                 if w is not None:
-                    w._emit(_ev_unwire(msg["ev"]))
+                    if msg.get("lost"):
+                        w._mark_lost()
+                    else:
+                        w._emit(_ev_unwire(msg["ev"]))
                 continue
             rid = msg.get("i")
             ev = self._pending_ev.get(rid)
@@ -342,11 +370,14 @@ class RemoteStore:
             try:
                 try:
                     self._call("watch", w.prefix, resume, rid=wid)
-                except CompactedError:
+                except (CompactedError, WatchLost):
+                    # the gap is unrecoverable: deltas are gone.  Don't
+                    # silently re-watch from current — surface WatchLost
+                    # so the consumer re-lists (anti-entropy), exactly
+                    # like the slow-consumer cancellation path.
                     log.warnf("watch %r resume rev %d compacted; "
-                              "re-watching from current (deltas lost)",
-                              w.prefix, resume)
-                    self._call("watch", w.prefix, 0, rid=wid)
+                              "consumer must re-list", w.prefix, resume)
+                    w._mark_lost()
             except (RemoteStoreError, OSError) as e:
                 log.errorf("watch %r re-establish failed: %s", w.prefix, e)
         log.infof("store connection re-established (%s:%d)",

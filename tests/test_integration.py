@@ -357,3 +357,53 @@ def test_scheduler_service_over_sharded_planner():
     assert by_name.get("mesh-alone"), "alone job never ran"
     assert all(l.success for l in logs)
     store.close()
+
+
+def test_scheduler_resync_after_watch_loss(world):
+    """A lost watch stream (overflow) must not silently stall the
+    scheduler: drain_watches resynchronizes — new jobs appear, deleted
+    jobs drop — from the store's current contents."""
+    store, sink, sched, agents = world
+    j1 = Job(name="pre", command="echo 1", kind=KIND_COMMON,
+             rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, j1)
+    sched.drain_watches()
+    assert ("default", j1.id) in sched.rows.by_job
+    # cripple the jobs watcher and blast it past its backlog
+    sched._w_jobs._max_backlog = 5
+    store.delete(KS.job_key("default", j1.id))
+    j2 = Job(name="post", command="echo 2", kind=KIND_COMMON,
+             rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, j2)
+    for i in range(10):
+        store.put(KS.cmd + f"filler/f{i}", "not-json")
+    sched.drain_watches()      # sees the buffered tail
+    sched.drain_watches()      # hits WatchLost -> resync
+    assert ("default", j1.id) not in sched.rows.by_job, \
+        "deleted job survived resync"
+    assert ("default", j2.id) in sched.rows.by_job, \
+        "new job missed by resync"
+
+
+def test_agent_resync_after_watch_loss():
+    """An agent whose dispatch watch overflows re-lists still-live orders
+    and runs them exactly once (store fence); Common broadcasts dedupe
+    via the in-memory (job, second) guard."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="rz")
+    agent.register()
+    job = Job(name="rz-job", command="echo rz", kind=KIND_COMMON,
+              rules=[JobRule(timer="* * * * * *", nids=["rz"])])
+    put_job(store, job)
+    epoch = int(time.time()) - 1
+    agent._w_dispatch._max_backlog = 2
+    for i in range(6):   # overflow the dispatch watch with junk keys
+        store.put(KS.dispatch + f"rz/junk-{i}", "{}")
+    # the real order we must not lose
+    store.put(KS.dispatch_key("rz", epoch, job.group, job.id), "{}")
+    agent.poll()               # buffered tail
+    agent.poll()               # WatchLost -> resync re-lists + runs
+    agent.join_running(timeout=30)
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total >= 1, "order lost across watch overflow"
+    store.close()

@@ -322,3 +322,38 @@ def test_native_wal_replays_large_records(tmp_path):
         s2.close()
     finally:
         srv2.stop()
+
+
+def test_watch_lost_propagates_over_wire():
+    """A server-side slow-watcher cancellation reaches the remote client
+    as WatchLost (not a silent starve): consumer re-lists + re-watches."""
+    from cronsun_tpu.store.memstore import WatchLost
+    srv = StoreServer().start()
+    s = RemoteStore(srv.host, srv.port)
+    w = s.watch("/lw/")
+    s.put("/lw/seed", "0")
+    assert w.get(timeout=3) is not None
+    # shrink the SERVER-side watcher backlog and blast past it
+    for sw in list(srv.store._watchers):
+        if sw.prefix == "/lw/":
+            sw._max_backlog = 3
+    for i in range(20):
+        srv.store.put(f"/lw/{i}", "x")
+    deadline = time.time() + 5
+    got_lost = False
+    while time.time() < deadline:
+        try:
+            if w.get(timeout=0.2) is None and w.lost:
+                pass
+        except WatchLost:
+            got_lost = True
+            break
+    assert got_lost, "client never learned the stream was lost"
+    # re-list + fresh watch resynchronizes
+    assert s.count_prefix("/lw/") == 21
+    w2 = s.watch("/lw/")
+    s.put("/lw/new", "y")
+    ev = w2.get(timeout=3)
+    assert ev is not None
+    s.close()
+    srv.stop()
