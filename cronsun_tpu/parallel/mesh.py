@@ -105,6 +105,51 @@ def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
     return out, load, rem_cap
 
 
+def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
+                         rem_cap, k_local: int, rounds: int, impl: str):
+    """Fused windowed plan per shard: W seconds under one lax.scan with
+    the tick collectives inside — the production cadence (plan ahead of
+    wall-clock, one dispatch per window) composed with the jobs mesh.
+    Semantics identical to W sequential _sharded_plan_body calls."""
+    bid, fanout = _steps(impl)
+    d = jax.lax.axis_index(AXIS)
+    j_local = elig.shape[0]
+    cols = [fields_w[:, i] for i in range(7)]
+    with jax.named_scope("cronsun.fire_mask"):
+        fire_w = _fire_mask_jit(table, *cols)          # [J/D, W]
+
+    def body(carry, fire_col):
+        load, rem_cap = carry
+        idx, valid, total = _compact(fire_col, k_local)
+        packed_k = elig[idx]
+        excl_k = exclusive[idx]
+        cost_k = cost[idx].astype(jnp.float32)
+        common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
+        load = load + jax.lax.psum(fanout(packed_k, common_w), AXIS)
+        need0 = valid & excl_k
+        assigned = jnp.full(k_local, -1, dtype=jnp.int32)
+        for r in range(rounds):
+            load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
+            best, choice = bid(packed_k, load_eff)
+            cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
+            cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
+            choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
+            cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
+            accept_g, load, rem_cap = waterfill_accept(
+                cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
+            accept_l = jax.lax.dynamic_slice(
+                accept_g, (d * k_local,), (k_local,))
+            assigned = jnp.where(accept_l, choice, assigned)
+        idx_global = jnp.where(jnp.arange(k_local) < total,
+                               d * j_local + idx, -1).astype(jnp.int32)
+        total_row = jnp.zeros_like(idx).at[0].set(total)
+        out = jnp.stack([idx_global, total_row, assigned], axis=0)
+        return (load, rem_cap), out
+
+    (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
+    return outs, load, rem_cap                  # [W, 3, k_local]
+
+
 def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
                          rem_cap, k_local: int, rounds: int, impl: str):
     """Per-device body over the (jobs, nodes) mesh.  elig is the local
@@ -303,21 +348,14 @@ class _ShardedPlannerBase:
 
     # -- tick --------------------------------------------------------------
 
-    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
-        k = sla_bucket or self.max_fire_bucket
-        k_local = max(256, _next_pow2(k) // self.Dj)
-        impl = self.impl
-        if impl == "auto":
-            impl = ("pallas" if jax.default_backend() == "tpu"
-                    and k_local % 256 == 0 else "jnp")
-        f = window_fields(epoch_s, 1, tz=self.tz)
-        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
-                           f["dom"][0], f["month"][0], f["dow"][0],
-                           epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
-        out, self.load, self.rem_cap = self._step(k_local, impl)(
-            self.table, jax.device_put(fields, self._repl), self.elig,
-            self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(out)              # [3, Dj*k_local]
+    def _resolve_impl(self, k_local: int) -> str:
+        if self.impl != "auto":
+            return self.impl
+        return ("pallas" if jax.default_backend() == "tpu"
+                and k_local % 256 == 0 else "jnp")
+
+    def _decode(self, o, epoch_s: int, k_local: int) -> TickPlan:
+        """[3, Dj*k_local] per-shard-concatenated output -> TickPlan."""
         fired, assigned, total = [], [], 0
         for s in range(self.Dj):
             t_s = int(o[1, s * k_local])
@@ -330,13 +368,26 @@ class _ShardedPlannerBase:
         return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
                         overflow=max(0, total - len(fired)))
 
+    def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        impl = self._resolve_impl(k_local)
+        f = window_fields(epoch_s, 1, tz=self.tz)
+        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
+                           f["dom"][0], f["month"][0], f["dow"][0],
+                           epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
+        out, self.load, self.rem_cap = self._step(k_local, impl)(
+            self.table, jax.device_put(fields, self._repl), self.elig,
+            self.exclusive, self.cost, self.load, self.rem_cap)
+        o = np.asarray(out)              # [3, Dj*k_local]
+        return self._decode(o, epoch_s, k_local)
+
     def plan_window(self, epoch_s: int, window_s: int,
                     sla_bucket=None):
         """Window = sequential per-second plans (load/capacity carry in
         self) — same TickPlan-list contract as TickPlanner.plan_window,
-        one dispatch per second.  Lets SchedulerService run unchanged
-        over a mesh; the fused windowed scan stays a single-chip
-        specialization for now."""
+        one dispatch per second.  ShardedTickPlanner overrides this with
+        the fused windowed scan."""
         return [self.plan(epoch_s + w, sla_bucket=sla_bucket)
                 for w in range(window_s)]
 
@@ -356,6 +407,41 @@ class ShardedTickPlanner(_ShardedPlannerBase):
     def _body(self, k_local: int, impl: str):
         return partial(_sharded_plan_body, k_local=k_local,
                        rounds=self.rounds, impl=impl)
+
+    def _window_step(self, k_local: int, impl: str):
+        key = ("window", k_local, impl)
+        if key not in self._step_cache:
+            from jax import shard_map
+            body = partial(_sharded_window_body, k_local=k_local,
+                           rounds=self.rounds, impl=impl)
+            sm = shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(AXIS), P(), P(AXIS, None), P(AXIS), P(AXIS),
+                          P(), P()),
+                out_specs=(P(None, None, AXIS), P(), P()),
+                check_vma=False)
+            self._step_cache[key] = jax.jit(sm)
+        return self._step_cache[key]
+
+    def plan_window(self, epoch_s: int, window_s: int, sla_bucket=None):
+        """Fused windowed scan over the jobs mesh: W seconds, ONE
+        dispatch (the production cadence composed with multichip) —
+        semantics identical to W sequential plans."""
+        from ..ops.schedule_table import FRAMEWORK_EPOCH as FE
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        impl = self._resolve_impl(k_local)
+        f = window_fields(epoch_s, window_s, tz=self.tz)
+        fields_w = np.stack([
+            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+            np.arange(window_s, dtype=np.int64) + (epoch_s - FE),
+        ], axis=1).astype(np.int32)
+        outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
+            self.table, jax.device_put(fields_w, self._repl), self.elig,
+            self.exclusive, self.cost, self.load, self.rem_cap)
+        o = np.asarray(outs)             # [W, 3, Dj*k_local]
+        return [self._decode(o[w], epoch_s + w, k_local)
+                for w in range(window_s)]
 
 
 class Sharded2DTickPlanner(_ShardedPlannerBase):
