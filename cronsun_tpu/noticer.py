@@ -153,6 +153,9 @@ class NoticerHost:
                 n += self._deliver(Notice(
                     f"[cronsun] node [{nid}] down",
                     f"node {nid} lease expired without clean shutdown"))
+                # mark dead in the mirror: the level-triggered check must
+                # not re-alert for the same crash on every future resync
+                self.sink.set_node_alived(nid, False)
         return n
 
     def _poll_once(self) -> int:
@@ -178,6 +181,7 @@ class NoticerHost:
                 n += self._deliver(Notice(
                     f"[cronsun] node [{node_id}] down",
                     f"node {node_id} lease expired without clean shutdown"))
+                self.sink.set_node_alived(node_id, False)
         return n
 
     def _deliver(self, notice: Notice) -> int:

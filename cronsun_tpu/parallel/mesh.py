@@ -64,17 +64,15 @@ def make_mesh2d(dj: int, dn: int) -> Mesh:
     return Mesh(np.array(devs[:dj * dn]).reshape(dj, dn), (AXIS, NAXIS))
 
 
-def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
-                       k_local: int, rounds: int, impl: str):
-    """Runs per-shard inside shard_map.  All [J/D]-shaped inputs are the
-    local shard; load/rem_cap are replicated."""
-    bid, fanout = _steps(impl)
+def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
+                k_local: int, rounds: int, bid, fanout):
+    """One second of the jobs-mesh plan, per shard: local compact + bid,
+    candidate all_gather, replicated waterfill.  THE single definition —
+    both the per-tick body and the fused windowed scan call it, so their
+    semantics cannot drift."""
     d = jax.lax.axis_index(AXIS)
     j_local = elig.shape[0]
-
-    f = [fields[i:i + 1] for i in range(7)]
-    fire = _fire_mask_jit(table, *f)[:, 0]
-    idx, valid, total = _compact(fire, k_local)
+    idx, valid, total = _compact(fire_col, k_local)
     packed_k = elig[idx]
     excl_k = exclusive[idx]
     cost_k = cost[idx].astype(jnp.float32)
@@ -105,45 +103,34 @@ def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
     return out, load, rem_cap
 
 
+def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
+                       k_local: int, rounds: int, impl: str):
+    """Runs per-shard inside shard_map.  All [J/D]-shaped inputs are the
+    local shard; load/rem_cap are replicated."""
+    bid, fanout = _steps(impl)
+    f = [fields[i:i + 1] for i in range(7)]
+    fire = _fire_mask_jit(table, *f)[:, 0]
+    return _tick_local(fire, elig, exclusive, cost, load, rem_cap,
+                       k_local, rounds, bid, fanout)
+
+
 def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
                          rem_cap, k_local: int, rounds: int, impl: str):
     """Fused windowed plan per shard: W seconds under one lax.scan with
     the tick collectives inside — the production cadence (plan ahead of
     wall-clock, one dispatch per window) composed with the jobs mesh.
-    Semantics identical to W sequential _sharded_plan_body calls."""
+    Identical semantics to W sequential _sharded_plan_body calls by
+    construction: both run _tick_local."""
     bid, fanout = _steps(impl)
-    d = jax.lax.axis_index(AXIS)
-    j_local = elig.shape[0]
     cols = [fields_w[:, i] for i in range(7)]
     with jax.named_scope("cronsun.fire_mask"):
         fire_w = _fire_mask_jit(table, *cols)          # [J/D, W]
 
     def body(carry, fire_col):
         load, rem_cap = carry
-        idx, valid, total = _compact(fire_col, k_local)
-        packed_k = elig[idx]
-        excl_k = exclusive[idx]
-        cost_k = cost[idx].astype(jnp.float32)
-        common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
-        load = load + jax.lax.psum(fanout(packed_k, common_w), AXIS)
-        need0 = valid & excl_k
-        assigned = jnp.full(k_local, -1, dtype=jnp.int32)
-        for r in range(rounds):
-            load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
-            best, choice = bid(packed_k, load_eff)
-            cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
-            cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
-            choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
-            cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
-            accept_g, load, rem_cap = waterfill_accept(
-                cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
-            accept_l = jax.lax.dynamic_slice(
-                accept_g, (d * k_local,), (k_local,))
-            assigned = jnp.where(accept_l, choice, assigned)
-        idx_global = jnp.where(jnp.arange(k_local) < total,
-                               d * j_local + idx, -1).astype(jnp.int32)
-        total_row = jnp.zeros_like(idx).at[0].set(total)
-        out = jnp.stack([idx_global, total_row, assigned], axis=0)
+        out, load, rem_cap = _tick_local(
+            fire_col, elig, exclusive, cost, load, rem_cap,
+            k_local, rounds, bid, fanout)
         return (load, rem_cap), out
 
     (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)

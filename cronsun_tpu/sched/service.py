@@ -129,12 +129,17 @@ class SchedulerService:
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
-    def _load_initial(self):
-        for kv in self.store.get_prefix(self.ks.group):
+    def _load_initial(self, groups=None, nodes=None, jobs=None):
+        """Apply the store's current contents; prefetched KV lists avoid
+        re-listing when the caller (resync) already has them."""
+        for kv in (groups if groups is not None
+                   else self.store.get_prefix(self.ks.group)):
             self._apply_group(kv.value)
-        for kv in self.store.get_prefix(self.ks.node):
+        for kv in (nodes if nodes is not None
+                   else self.store.get_prefix(self.ks.node)):
             self._node_up(kv.key[len(self.ks.node):])
-        for kv in self.store.get_prefix(self.ks.cmd):
+        for kv in (jobs if jobs is not None
+                   else self.store.get_prefix(self.ks.cmd)):
             self._apply_job(kv.key, kv.value)
         self._flush_device()
 
@@ -277,24 +282,29 @@ class SchedulerService:
         self._w_jobs = self.store.watch(self.ks.cmd)
         self._w_groups = self.store.watch(self.ks.group)
         self._w_nodes = self.store.watch(self.ks.node)
+        # one listing per prefix serves both the liveness diff and the
+        # reload (recovery runs when the scheduler is already behind)
+        job_kvs = self.store.get_prefix(self.ks.cmd)
+        group_kvs = self.store.get_prefix(self.ks.group)
+        node_kvs = self.store.get_prefix(self.ks.node)
         live_jobs = set()
-        for kv in self.store.get_prefix(self.ks.cmd):
+        for kv in job_kvs:
             rest = kv.key[len(self.ks.cmd):]
             if "/" in rest:
                 live_jobs.add(tuple(rest.split("/", 1)))
-        for (group, job_id) in [k for k in list(self.rows.by_job)
+        # diff against self.jobs (every applied job, including row-less
+        # ones whose rules never parsed), not just rows.by_job
+        for (group, job_id) in [k for k in list(self.jobs)
                                 if k not in live_jobs]:
             self._drop_job(group, job_id)
-        live_groups = {kv.key[len(self.ks.group):]
-                       for kv in self.store.get_prefix(self.ks.group)}
+        live_groups = {kv.key[len(self.ks.group):] for kv in group_kvs}
         for gid in [g for g in list(self.groups) if g not in live_groups]:
             self._drop_group(gid)
-        live_nodes = {kv.key[len(self.ks.node):]
-                      for kv in self.store.get_prefix(self.ks.node)}
+        live_nodes = {kv.key[len(self.ks.node):] for kv in node_kvs}
         for nid in [n for n in list(self.universe.index)
                     if n not in live_nodes]:
             self._node_down(nid)
-        self._load_initial()
+        self._load_initial(groups=group_kvs, nodes=node_kvs, jobs=job_kvs)
 
     def _drain_watches_once(self):
         for ev in self._w_groups.drain():

@@ -146,6 +146,8 @@ class _Conn(socketserver.BaseRequestHandler):
                 self._send({"i": rid, "e": str(e), "k": "KeyError"})
             except CompactedError as e:
                 self._send({"i": rid, "e": str(e), "k": "CompactedError"})
+            except WatchLost as e:
+                self._send({"i": rid, "e": str(e), "k": "WatchLost"})
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 self._send({"i": rid, "e": f"{type(e).__name__}: {e}",
                             "k": "RuntimeError"})
@@ -415,6 +417,8 @@ class RemoteStore:
                 raise KeyError(msg["e"])
             if kind == "CompactedError":
                 raise CompactedError(msg["e"])
+            if kind == "WatchLost":
+                raise WatchLost(msg["e"])
             raise RemoteStoreError(msg["e"])
         return msg.get("r")
 
