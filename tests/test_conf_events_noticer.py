@@ -185,3 +185,34 @@ def test_events_shutdown_releases_wait():
     t.join(timeout=3)
     assert done, "wait() did not release on shutdown()"
     events.clear()
+
+
+def test_node_crash_alert_not_repeated_on_resync():
+    """A crash alert marks the mirror dead, so a later resync (watch
+    loss) must not re-mail the same crash; a node that re-registers and
+    crashes again alerts again."""
+    from cronsun_tpu.core import Keyspace
+    from cronsun_tpu.logsink import JobLogStore
+    from cronsun_tpu.noticer import NoticerHost
+    from cronsun_tpu.store import MemStore
+    ks = Keyspace()
+    store, sink = MemStore(), JobLogStore()
+    sink.upsert_node("nx", '{"id": "nx"}', alived=True)
+    host = NoticerHost(store, sink, CollectSender())
+    # crash: node key vanished while mirror says alive
+    store.put(ks.node_key("nx"), "host:1")
+    store.delete(ks.node_key("nx"))
+    host.poll()
+    downs = [n for n in host.sent if "down" in n.subject]
+    assert len(downs) == 1
+    # watch-loss resyncs must not re-alert the handled crash
+    host.resync()
+    host.resync()
+    downs = [n for n in host.sent if "down" in n.subject]
+    assert len(downs) == 1, "crash re-alerted on resync"
+    # node comes back, crashes again -> one new alert
+    sink.upsert_node("nx", '{"id": "nx"}', alived=True)
+    host.resync()
+    downs = [n for n in host.sent if "down" in n.subject]
+    assert len(downs) == 2
+    store.close()
