@@ -158,9 +158,14 @@ const render={
    <td><button class=plain onclick='editGroup(${JSON.stringify(g)})'>${t('edit')}</button>
    <button class=warn onclick="delGroup('${g.id}')">${t('del')}</button></td></tr>`).join('')}</table>`},
  async logs(){const failed=$('#flt')?.checked?'&failedOnly=true':'';
-  const d=await api('GET','/v1/logs?pageSize=100'+failed);
-  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt onchange="nav('logs')"> ${t('failedOnly')}</label>
-   <span class=muted>${d.total} ${t('records')}</span></div>
+  const page=window._logPage||1,PS=50;
+  const d=await api('GET',`/v1/logs?pageSize=${PS}&page=${page}`+failed);
+  const pages=Math.max(1,Math.ceil(d.total/PS));
+  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt ${failed?'checked':''} onchange="window._logPage=1;nav('logs')"> ${t('failedOnly')}</label>
+   <span class=muted>${d.total} ${t('records')}</span><span style="flex:1"></span>
+   <button class=plain ${page<=1?'disabled':''} onclick="window._logPage=${page-1};nav('logs')">‹</button>
+   <span class=muted>${page} / ${pages}</span>
+   <button class=plain ${page>=pages?'disabled':''} onclick="window._logPage=${page+1};nav('logs')">›</button></div>
   <table><tr><th>${t('job')}</th><th>${t('node')}</th><th>${t('begin')}</th><th>${t('secs')}</th><th>ok</th><th>${t('output')}</th></tr>
   ${d.list.map(l=>`<tr style=cursor:pointer onclick="logDetail(${l.id})"><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
    <td>${(l.endTime-l.beginTime).toFixed(1)}</td>
