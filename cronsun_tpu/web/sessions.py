@@ -37,11 +37,19 @@ class SessionStore:
         return sid
 
     def get(self, sid: str) -> Optional[Session]:
+        """Resolve a session; expiry slides on use (the reference
+        re-stores the session after every request, base.go deferred
+        todos).  The lease keepalive only fires once the remaining TTL
+        drops below half, so hot sessions cost one extra RPC rarely."""
         if not sid:
             return None
         kv = self.store.get(self.ks.sess_key(sid))
         if kv is None:
             return None
+        if kv.lease:
+            rem = self.store.lease_ttl_remaining(kv.lease)
+            if rem is not None and rem < self.ttl / 2:
+                self.store.keepalive(kv.lease)
         try:
             return Session(json.loads(kv.value))
         except json.JSONDecodeError:

@@ -271,3 +271,23 @@ def test_ui_i18n_locales_complete():
     # every statically-referenced key exists
     used = set(re.findall(r"\bt\('([A-Za-z]+)'\)", INDEX_HTML))
     assert used <= en, f"undefined keys: {used - en}"
+
+
+def test_session_expiry_slides_on_use(world):
+    """An active session must not expire mid-use at the original TTL
+    (the reference re-stores sessions per request, sliding the expiry)."""
+    import time as _t
+    store, _, srv, c = world
+    srv.sessions.ttl = 1.0
+    c.login()
+    for _ in range(6):               # keep using it past the original TTL
+        _t.sleep(0.3)
+        code, _ = c.req("GET", "/v1/jobs")
+        assert code == 200, "active session expired"
+    # an idle session does lapse
+    srv.sessions.ttl = 0.4
+    c2 = Client(0); c2.base = c.base
+    c2.login()
+    _t.sleep(1.2)
+    code, _ = c2.req("GET", "/v1/jobs")
+    assert code == 401, "idle session survived its TTL"
