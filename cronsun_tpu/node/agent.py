@@ -65,14 +65,18 @@ class NodeAgent:
         self._procs_mu = threading.Lock()  # guards _procs + _proc_lease
         self._stop = threading.Event()
         self._threads = []
-        self._w_dispatch = store.watch(self.ks.dispatch + self.id + "/")
-        self._w_broadcast = store.watch(self.ks.dispatch_all)
-        self._w_groups = store.watch(self.ks.group)
-        self._w_once = store.watch(self.ks.once)
+        self._open_watches()
         self.groups: Dict[str, Group] = {}
         self._load_groups()
         self.running: Dict[str, threading.Thread] = {}
         self._bseen: Dict[tuple, float] = {}   # broadcast (job, sec) dedup
+
+    def _open_watches(self):
+        self._w_dispatch = self.store.watch(
+            self.ks.dispatch + self.id + "/")
+        self._w_broadcast = self.store.watch(self.ks.dispatch_all)
+        self._w_groups = self.store.watch(self.ks.group)
+        self._w_once = self.store.watch(self.ks.once)
 
     # ---- registration (node/node.go:64-119) ------------------------------
 
@@ -401,11 +405,7 @@ class NodeAgent:
                 w.close()
             except Exception:   # noqa: BLE001 — already-dead watchers
                 pass
-        self._w_dispatch = self.store.watch(
-            self.ks.dispatch + self.id + "/")
-        self._w_broadcast = self.store.watch(self.ks.dispatch_all)
-        self._w_groups = self.store.watch(self.ks.group)
-        self._w_once = self.store.watch(self.ks.once)
+        self._open_watches()
         self.groups.clear()
         self._load_groups()
         n = 0

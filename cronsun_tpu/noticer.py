@@ -109,11 +109,23 @@ class NoticerHost:
         self.sink = sink
         self.sender = sender
         self.ks = ks or Keyspace()
-        self._w_notice = store.watch(self.ks.noticer)
-        self._w_nodes = store.watch(self.ks.node)
+        self._open_watches()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.sent: List[Notice] = []     # for introspection/tests
+
+    def _open_watches(self):
+        self._w_notice = self.store.watch(self.ks.noticer)
+        self._w_nodes = self.store.watch(self.ks.node)
+
+    def _alert_node_down(self, nid: str) -> int:
+        """Deliver the crash alert and mark the mirror dead so the
+        level-triggered resync check cannot re-alert the same crash."""
+        n = self._deliver(Notice(
+            f"[cronsun] node [{nid}] down",
+            f"node {nid} lease expired without clean shutdown"))
+        self.sink.set_node_alived(nid, False)
+        return n
 
     def poll(self) -> int:
         try:
@@ -132,8 +144,7 @@ class NoticerHost:
                 w.close()
             except Exception:   # noqa: BLE001
                 pass
-        self._w_notice = self.store.watch(self.ks.noticer)
-        self._w_nodes = self.store.watch(self.ks.node)
+        self._open_watches()
         n = 0
         for kv in self.store.get_prefix(self.ks.noticer):
             try:
@@ -150,12 +161,7 @@ class NoticerHost:
         for mirror in self.sink.get_nodes():
             nid = mirror.get("id")
             if mirror.get("alived") and nid not in live:
-                n += self._deliver(Notice(
-                    f"[cronsun] node [{nid}] down",
-                    f"node {nid} lease expired without clean shutdown"))
-                # mark dead in the mirror: the level-triggered check must
-                # not re-alert for the same crash on every future resync
-                self.sink.set_node_alived(nid, False)
+                n += self._alert_node_down(nid)
         return n
 
     def _poll_once(self) -> int:
@@ -178,10 +184,7 @@ class NoticerHost:
             if mirror and mirror.get("alived"):
                 # lease expired but the node never said goodbye: a fault
                 # (reference node.go:93-102 ISNodeFault)
-                n += self._deliver(Notice(
-                    f"[cronsun] node [{node_id}] down",
-                    f"node {node_id} lease expired without clean shutdown"))
-                self.sink.set_node_alived(node_id, False)
+                n += self._alert_node_down(node_id)
         return n
 
     def _deliver(self, notice: Notice) -> int:

@@ -114,9 +114,7 @@ class SchedulerService:
         # toggles, avg_time updates) — only a changed timer re-anchors.
         self._row_phase: Dict[int, Tuple[str, int]] = {}
 
-        self._w_jobs = store.watch(self.ks.cmd)
-        self._w_groups = store.watch(self.ks.group)
-        self._w_nodes = store.watch(self.ks.node)
+        self._open_watches()
 
         self._leader_lease: Optional[int] = None
         self._stop = threading.Event()
@@ -126,6 +124,11 @@ class SchedulerService:
         self.stats = {"overflow_drops": 0, "skipped_seconds": 0}
 
         self._load_initial()
+
+    def _open_watches(self):
+        self._w_jobs = self.store.watch(self.ks.cmd)
+        self._w_groups = self.store.watch(self.ks.group)
+        self._w_nodes = self.store.watch(self.ks.node)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -279,9 +282,7 @@ class SchedulerService:
                 w.close()
             except Exception:   # noqa: BLE001 — already-dead watchers
                 pass
-        self._w_jobs = self.store.watch(self.ks.cmd)
-        self._w_groups = self.store.watch(self.ks.group)
-        self._w_nodes = self.store.watch(self.ks.node)
+        self._open_watches()
         # one listing per prefix serves both the liveness diff and the
         # reload (recovery runs when the scheduler is already behind)
         job_kvs = self.store.get_prefix(self.ks.cmd)

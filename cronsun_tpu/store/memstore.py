@@ -79,39 +79,21 @@ class Lease:
     keys: set = dataclasses.field(default_factory=set)
 
 
-class Watcher:
-    """A watch stream over a key prefix.
+class LossyEventStream:
+    """Event-queue base with the WatchLost contract, shared by the
+    in-process :class:`Watcher` and the remote client's watcher: a lost
+    stream first yields its buffered tail, then raises
+    :class:`WatchLost` — never a silent starve."""
 
-    The queue is bounded: a consumer that falls ``max_backlog`` events
-    behind has lost the stream anyway, so the watcher cancels itself
-    (etcd cancels slow watchers the same way; the native server bounds
-    its per-connection outbox identically).  ``lost`` tells the consumer
-    to re-list and re-watch."""
-
-    MAX_BACKLOG = 1 << 17
-
-    def __init__(self, store: "MemStore", prefix: str, start_rev: int,
-                 max_backlog: int = MAX_BACKLOG):
-        self._store = store
+    def __init__(self, prefix: str):
         self.prefix = prefix
-        self.start_rev = start_rev
         self.lost = False
-        self._max_backlog = max_backlog
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._closed = False
 
-    def _emit(self, ev: Event):
-        if self._closed:
-            return
-        if self._q.qsize() >= self._max_backlog:
-            self.lost = True
-            self.close()
-            return
-        self._q.put(ev)
-
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         """Next event, or None on timeout/close.  Raises WatchLost once a
-        cancelled-by-overflow stream has drained its buffered events."""
+        cancelled stream has drained its buffered events."""
         try:
             ev = self._q.get(timeout=timeout)
         except queue.Empty:
@@ -123,8 +105,8 @@ class Watcher:
         return ev
 
     def drain(self) -> List[Event]:
-        """Buffered events.  A cancelled-by-overflow stream first yields
-        its remaining buffer, then raises WatchLost on the next call."""
+        """Buffered events.  A cancelled stream first yields its
+        remaining buffer, then raises WatchLost on the next call."""
         out = []
         while True:
             try:
@@ -139,17 +121,44 @@ class Watcher:
                 return out
             out.append(ev)
 
-    def close(self):
-        self._closed = True
-        self._store._remove_watcher(self)
-        self._q.put(None)
-
     def __iter__(self):
         while not self._closed:
             ev = self.get()
             if ev is None:
                 return
             yield ev
+
+
+class Watcher(LossyEventStream):
+    """A watch stream over a key prefix.
+
+    The queue is bounded: a consumer that falls ``max_backlog`` events
+    behind has lost the stream anyway, so the watcher cancels itself
+    (etcd cancels slow watchers the same way; the native server bounds
+    its per-connection outbox identically)."""
+
+    MAX_BACKLOG = 1 << 17
+
+    def __init__(self, store: "MemStore", prefix: str, start_rev: int,
+                 max_backlog: int = MAX_BACKLOG):
+        super().__init__(prefix)
+        self._store = store
+        self.start_rev = start_rev
+        self._max_backlog = max_backlog
+
+    def _emit(self, ev: Event):
+        if self._closed:
+            return
+        if self._q.qsize() >= self._max_backlog:
+            self.lost = True
+            self.close()
+            return
+        self._q.put(ev)
+
+    def close(self):
+        self._closed = True
+        self._store._remove_watcher(self)
+        self._q.put(None)
 
 
 class MemStore:

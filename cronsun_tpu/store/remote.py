@@ -39,8 +39,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import log
-from .memstore import CompactedError, DELETE, PUT, Event, KV, MemStore, \
-    WatchLost, Watcher
+from .memstore import CompactedError, DELETE, LossyEventStream, PUT, \
+    Event, KV, MemStore, WatchLost, Watcher
 
 
 def _kv_wire(kv: Optional[KV]):
@@ -194,20 +194,17 @@ class StoreServer:
 # client
 # ---------------------------------------------------------------------------
 
-class RemoteWatcher:
-    """Client-side watch stream; same surface as memstore.Watcher."""
+class RemoteWatcher(LossyEventStream):
+    """Client-side watch stream; same surface (and WatchLost contract,
+    via the shared LossyEventStream base) as memstore.Watcher."""
 
     def __init__(self, store: "RemoteStore", wid: int, prefix: str,
                  start_rev: int = 0):
+        super().__init__(prefix)
         self._store = store
         self._wid = wid
-        self.prefix = prefix
         self.start_rev = start_rev
         self.last_rev = 0          # highest mod_rev seen (resume point)
-        self.lost = False
-        import queue
-        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
-        self._closed = False
 
     def _emit(self, ev: Event):
         if not self._closed:
@@ -223,47 +220,12 @@ class RemoteWatcher:
         self._store._watchers.pop(self._wid, None)
         self._q.put(None)
 
-    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
-        import queue
-        try:
-            ev = self._q.get(timeout=timeout)
-        except queue.Empty:
-            if self.lost:
-                raise WatchLost(f"watch {self.prefix!r} overflowed")
-            return None
-        if ev is None and self.lost:
-            raise WatchLost(f"watch {self.prefix!r} overflowed")
-        return ev
-
-    def drain(self) -> List[Event]:
-        import queue
-        out = []
-        while True:
-            try:
-                ev = self._q.get_nowait()
-            except queue.Empty:
-                if self.lost and not out:
-                    raise WatchLost(f"watch {self.prefix!r} overflowed")
-                return out
-            if ev is None:
-                if self.lost and not out:
-                    raise WatchLost(f"watch {self.prefix!r} overflowed")
-                return out
-            out.append(ev)
-
     def close(self):
         if self._closed:
             return
         self._closed = True
         self._store._unwatch(self._wid)
         self._q.put(None)
-
-    def __iter__(self):
-        while not self._closed:
-            ev = self.get()
-            if ev is None:
-                return
-            yield ev
 
 
 class RemoteStoreError(RuntimeError):

@@ -916,8 +916,6 @@ void Store::watch(Sink sink, long long start_rev) {
 // request handling
 // ---------------------------------------------------------------------------
 
-static const std::string S = "";  // default string arg
-
 static const std::string& arg_s(const JV& a, size_t i) {
   static const std::string empty;
   return (i < a.arr.size() && a.arr[i].t == JV::STR) ? a.arr[i].s : empty;
