@@ -56,6 +56,7 @@ def emit(name: str, arg=None):
 def clear():
     with _lock:
         _handlers.clear()
+    _stop.clear()
 
 
 _stop = threading.Event()
@@ -72,8 +73,12 @@ def shutdown():
 def wait():
     """Block until SIGINT/SIGTERM (or :func:`shutdown`), then emit EXIT.
     Signal handlers install only from the main thread (Python forbids it
-    elsewhere); an embedded wait() still releases via shutdown()."""
-    _stop.clear()
+    elsewhere); an embedded wait() still releases via shutdown().
+
+    shutdown() is sticky: one fired *before* main reaches wait() (e.g. a
+    supervised child dying between READY and wait, bin/store.py) still
+    releases immediately instead of being swallowed.  Tests reset the
+    latch via :func:`clear`."""
     if threading.current_thread() is threading.main_thread():
         signal.signal(signal.SIGINT, lambda *a: _stop.set())
         signal.signal(signal.SIGTERM, lambda *a: _stop.set())

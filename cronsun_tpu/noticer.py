@@ -100,8 +100,30 @@ class HttpNoticer:
         urllib.request.urlopen(req, timeout=10)
 
 
+class _Pending:
+    """A notice awaiting (re)delivery.  ``key`` is the store key deleted
+    on success (None for synthesized node-death alerts); ``on_success``
+    runs exactly once after the first successful send."""
+
+    def __init__(self, notice: Notice, key: Optional[str],
+                 on_success: Optional[Callable[[], None]]):
+        self.notice = notice
+        self.key = key
+        self.on_success = on_success
+        self.attempts = 0
+        self.next_at = 0.0
+
+
 class NoticerHost:
-    """Watches the noticer prefix + node deaths; fans out to a sender."""
+    """Watches the noticer prefix + node deaths; fans out to a sender.
+
+    Delivery is durable: the noticer store key is deleted only after a
+    successful send (the reference deletes the etcd key after SMTP
+    delivery, noticer.go:147-170).  A failed send stays queued with
+    exponential backoff (capped at RETRY_CAP seconds), and because the key
+    survives, a noticer restart re-lists and re-delivers via resync()."""
+
+    RETRY_CAP = 30.0
 
     def __init__(self, store: MemStore, sink: JobLogStore, sender,
                  ks: Optional[Keyspace] = None):
@@ -113,19 +135,35 @@ class NoticerHost:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.sent: List[Notice] = []     # for introspection/tests
+        self._pending: dict = {}         # dedupe-key -> _Pending
 
     def _open_watches(self):
         self._w_notice = self.store.watch(self.ks.noticer)
         self._w_nodes = self.store.watch(self.ks.node)
 
     def _alert_node_down(self, nid: str) -> int:
-        """Deliver the crash alert and mark the mirror dead so the
-        level-triggered resync check cannot re-alert the same crash."""
-        n = self._deliver(Notice(
-            f"[cronsun] node [{nid}] down",
-            f"node {nid} lease expired without clean shutdown"))
+        """Queue the crash alert; the mirror is marked dead only once the
+        alert is actually delivered, so a crash of *this* process before
+        delivery leaves the mirror alive and the next resync re-alerts.
+        The dedupe key stops the level-triggered resync check from
+        queueing the same crash twice while delivery is pending."""
+        return self._submit(
+            Notice(f"[cronsun] node [{nid}] down",
+                   f"node {nid} lease expired without clean shutdown"),
+            dedupe=f"nodedown/{nid}",
+            on_success=lambda: self._mark_down_if_still_gone(nid))
+
+    def _mark_down_if_still_gone(self, nid: str):
+        """Delivery can lag crash detection by a long retry outage; if
+        the node re-registered meanwhile, leave the mirror alive — a
+        wrong dead flag here would swallow the alert for its NEXT real
+        crash (both poll and resync gate on mirror alived)."""
+        try:
+            if self.store.get(self.ks.node_key(nid)) is not None:
+                return
+        except Exception:  # noqa: BLE001 — can't verify: keep mirror alive
+            return
         self.sink.set_node_alived(nid, False)
-        return n
 
     def poll(self) -> int:
         try:
@@ -135,10 +173,12 @@ class NoticerHost:
             return self.resync()
 
     def resync(self) -> int:
-        """Re-watch and deliver any pending notices from a re-list
-        (notices are deleted after delivery, so the retry is safe;
-        node-death events inside the lost window are checked against the
-        alived mirror via the current node list)."""
+        """Re-watch and queue any pending notices from a re-list (keys
+        are deleted only after successful delivery, so the re-list sees
+        everything undelivered; the dedupe key makes re-queueing a
+        no-op for notices already awaiting retry).  Node-death events
+        inside the lost window are recovered by checking the alived
+        mirror against the current node list."""
         for w in (self._w_notice, self._w_nodes):
             try:
                 w.close()
@@ -147,13 +187,9 @@ class NoticerHost:
         self._open_watches()
         n = 0
         for kv in self.store.get_prefix(self.ks.noticer):
-            try:
-                d = json.loads(kv.value)
-            except json.JSONDecodeError:
-                continue
-            n += self._deliver(Notice(d.get("subject", ""),
-                                      d.get("body", ""), d.get("to")))
-            self.store.delete(kv.key)
+            notice = self._parse(kv.value)
+            if notice is not None:
+                n += self._submit(notice, key=kv.key)
         # nodes the mirror says are alive but whose lease key vanished
         # during the gap died uncleanly
         live = {kv.key[len(self.ks.node):]
@@ -165,17 +201,13 @@ class NoticerHost:
         return n
 
     def _poll_once(self) -> int:
-        n = 0
+        n = self._retry_due()
         for ev in self._w_notice.drain():
             if ev.type == DELETE:
                 continue
-            try:
-                d = json.loads(ev.kv.value)
-            except json.JSONDecodeError:
-                continue
-            n += self._deliver(Notice(d.get("subject", ""),
-                                      d.get("body", ""), d.get("to")))
-            self.store.delete(ev.kv.key)
+            notice = self._parse(ev.kv.value)
+            if notice is not None:
+                n += self._submit(notice, key=ev.kv.key)
         for ev in self._w_nodes.drain():
             if ev.type != DELETE:
                 continue
@@ -187,14 +219,62 @@ class NoticerHost:
                 n += self._alert_node_down(node_id)
         return n
 
-    def _deliver(self, notice: Notice) -> int:
+    @staticmethod
+    def _parse(value: str) -> Optional[Notice]:
         try:
-            self.sender.send(notice)
-        except Exception as e:  # noqa: BLE001 — notification must not crash
-            log.errorf("noticer send failed: %s", e)
+            d = json.loads(value)
+        except json.JSONDecodeError:
+            return None
+        return Notice(d.get("subject", ""), d.get("body", ""), d.get("to"))
+
+    def _submit(self, notice: Notice, key: Optional[str] = None,
+                dedupe: Optional[str] = None,
+                on_success: Optional[Callable[[], None]] = None) -> int:
+        """Attempt delivery now; on failure park in the retry queue.
+        A notice already parked under the same key is *replaced*, not
+        dropped: agents overwrite one per-node noticer key
+        (node/agent.py), so the store itself only retains the latest
+        value — delivering the stale parked one and deleting the key
+        would silently lose the newer notice."""
+        dk = dedupe or key or f"anon/{id(notice)}"
+        parked = self._pending.get(dk)
+        if parked is not None:
+            parked.notice = notice            # latest wins, keep backoff
             return 0
-        self.sent.append(notice)
-        return 1
+        p = _Pending(notice, key, on_success)
+        if self._attempt(p):
+            return 1
+        self._pending[dk] = p
+        return 0
+
+    def _attempt(self, p: _Pending) -> bool:
+        try:
+            self.sender.send(p.notice)
+        except Exception as e:  # noqa: BLE001 — notification must not crash
+            p.attempts += 1
+            backoff = min(self.RETRY_CAP, 0.5 * (2 ** (p.attempts - 1)))
+            p.next_at = time.time() + backoff
+            log.errorf("noticer send failed (attempt %d, retry in %.1fs): %s",
+                       p.attempts, backoff, e)
+            return False
+        self.sent.append(p.notice)
+        if p.key is not None:
+            try:
+                self.store.delete(p.key)
+            except Exception as e:  # noqa: BLE001 — redelivery beats loss
+                log.warnf("noticer key %r delete failed: %s", p.key, e)
+        if p.on_success is not None:
+            p.on_success()
+        return True
+
+    def _retry_due(self) -> int:
+        now = time.time()
+        n = 0
+        for dk, p in list(self._pending.items()):
+            if p.next_at <= now and self._attempt(p):
+                self._pending.pop(dk, None)
+                n += 1
+        return n
 
     def start(self):
         def run():

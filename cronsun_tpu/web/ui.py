@@ -71,6 +71,9 @@ const L={en:{
  nodeIds:'node ids (comma)',groupIds:'group ids',excludeNodes:'exclude nodes',
  delJobQ:'delete job?',delGroupQ:'delete group?',dispatched:'dispatched',
  allNodes:'all eligible nodes',
+ addTimer:'+ timer',removeTimer:'remove',timerN:'timer',
+ fltName:'name contains',fltNode:'node',fltFrom:'from',fltTo:'to',
+ apply:'Apply',clearF:'Clear',
 },zh:{
  dash:'仪表盘',jobs:'任务',nodes:'节点',groups:'节点分组',logs:'执行日志',
  exec:'正在执行',accounts:'账户',logout:'退出',signin:'登录',
@@ -98,6 +101,9 @@ const L={en:{
  nodeIds:'节点 ID（逗号分隔）',groupIds:'分组 ID',excludeNodes:'排除节点',
  delJobQ:'确定删除该任务？',delGroupQ:'确定删除该分组？',dispatched:'已派发',
  allNodes:'所有可选节点',
+ addTimer:'+ 定时器',removeTimer:'删除',timerN:'定时器',
+ fltName:'名称包含',fltNode:'节点',fltFrom:'开始',fltTo:'结束',
+ apply:'筛选',clearF:'清除',
 }};
 let lang=localStorage.lang||'en';
 const t=k=>(L[lang]&&L[lang][k])||L.en[k]||k;
@@ -136,32 +142,49 @@ const render={
    <div class=card><div class=n class=bad>${o.jobExecuted.failed}</div><div class=t>${t('cFail')}</div></div></div>
   <h3>${t('daily')}</h3><table><tr><th>${t('day')}</th><th>${t('total')}</th><th>${t('success')}</th><th>${t('failed')}</th></tr>
   ${o.jobExecutedDaily.map(d=>`<tr><td>${d.day}</td><td>${d.total}</td><td class=ok>${d.successed}</td><td class=bad>${d.failed}</td></tr>`).join('')}</table>`},
- async jobs(){const js=await api('GET','/v1/jobs');
+ async jobs(){const js=await api('GET','/v1/jobs');window._jobs=js;
+  // row actions reference rows by index (never interpolate user-controlled
+  // ids/groups into JS-string context: a quote in a group name was stored XSS)
   $('#main').innerHTML=`<div class=bar><button onclick="editJob()">${t('newJob')}</button></div>
   <table><tr><th>${t('name')}</th><th>${t('group')}</th><th>${t('command')}</th><th>${t('kind')}</th><th>${t('timers')}</th><th>${t('status')}</th><th></th></tr>
-  ${js.map(j=>`<tr><td>${esc(j.name)}</td><td>${esc(j.group)}</td><td><code>${esc(j.command)}</code></td>
+  ${js.map((j,i)=>`<tr><td>${esc(j.name)}</td><td>${esc(j.group)}</td><td><code>${esc(j.command)}</code></td>
    <td>${['Common','Alone','Interval'][j.kind]||j.kind}</td>
    <td>${(j.rules||[]).map(r=>esc(r.timer)).join('<br>')}</td>
    <td>${j.pause?`<span class=muted>${t('paused')}</span>`:`<span class=ok>${t('active')}</span>`}</td>
-   <td><button class=plain onclick='editJob(${JSON.stringify(j)})'>${t('edit')}</button>
-    <button class=plain onclick="toggleJob('${j.group}','${j.id}',${!j.pause})">${j.pause?t('resume'):t('pause')}</button>
-    <button onclick="runNow('${j.group}','${j.id}')">${t('run')}</button>
-    <button class=warn onclick="delJob('${j.group}','${j.id}')">${t('del')}</button></td></tr>`).join('')}</table>`},
+   <td><button class=plain onclick="editJob(_jobs[${i}])">${t('edit')}</button>
+    <button class=plain onclick="toggleJob(${i})">${j.pause?t('resume'):t('pause')}</button>
+    <button onclick="runNow(${i})">${t('run')}</button>
+    <button class=warn onclick="delJob(${i})">${t('del')}</button></td></tr>`).join('')}</table>`},
  async nodes(){const ns=await api('GET','/v1/nodes');
   $('#main').innerHTML=`<table><tr><th>id</th><th>${t('hostname')}</th><th>pid</th><th>${t('version')}</th><th>${t('upSince')}</th><th>${t('status')}</th></tr>
   ${ns.map(n=>`<tr><td>${esc(n.id)}</td><td>${esc(n.hostname)}</td><td>${n.pid}</td><td>${esc(n.version)}</td>
    <td>${ts(n.up_ts)}</td><td>${n.connected?`<span class=ok>${t('connected')}</span>`:`<span class=bad>${t('down')}</span>`}</td></tr>`).join('')}</table>`},
- async groups(){const gs=await api('GET','/v1/node/groups');
+ async groups(){const gs=await api('GET','/v1/node/groups');window._groups=gs;
   $('#main').innerHTML=`<div class=bar><button onclick="editGroup()">${t('newGroup')}</button></div>
   <table><tr><th>id</th><th>${t('name')}</th><th>${t('nodesCol')}</th><th></th></tr>
-  ${gs.map(g=>`<tr><td>${esc(g.id)}</td><td>${esc(g.name)}</td><td>${(g.nids||[]).map(esc).join(', ')}</td>
-   <td><button class=plain onclick='editGroup(${JSON.stringify(g)})'>${t('edit')}</button>
-   <button class=warn onclick="delGroup('${g.id}')">${t('del')}</button></td></tr>`).join('')}</table>`},
- async logs(){const failed=$('#flt')?.checked?'&failedOnly=true':'';
+  ${gs.map((g,i)=>`<tr><td>${esc(g.id)}</td><td>${esc(g.name)}</td><td>${(g.nids||[]).map(esc).join(', ')}</td>
+   <td><button class=plain onclick="editGroup(_groups[${i}])">${t('edit')}</button>
+   <button class=warn onclick="delGroup(${i})">${t('del')}</button></td></tr>`).join('')}</table>`},
+ async logs(){
+  // filter state persists across renders (reference Log.vue filters:
+  // node / name regex / time window / failedOnly, web/job_log.go:18-113)
+  const F=window._logF=window._logF||{};
   const page=window._logPage||1,PS=50;
-  const d=await api('GET',`/v1/logs?pageSize=${PS}&page=${page}`+failed);
+  const q=[`pageSize=${PS}`,`page=${page}`];
+  if(F.failed)q.push('failedOnly=true');
+  if(F.node)q.push('node='+encodeURIComponent(F.node));
+  if(F.names)q.push('names='+encodeURIComponent(F.names));
+  if(F.begin)q.push('begin='+(new Date(F.begin).getTime()/1000));
+  if(F.end)q.push('end='+(new Date(F.end).getTime()/1000));
+  const d=await api('GET','/v1/logs?'+q.join('&'));
   const pages=Math.max(1,Math.ceil(d.total/PS));
-  $('#main').innerHTML=`<div class=bar><label><input type=checkbox id=flt ${failed?'checked':''} onchange="window._logPage=1;nav('logs')"> ${t('failedOnly')}</label>
+  $('#main').innerHTML=`<div class=bar>
+   <input id=fn placeholder="${t('fltName')}" value="${esc(F.names||'')}" style="width:130px">
+   <input id=fd placeholder="${t('fltNode')}" value="${esc(F.node||'')}" style="width:110px">
+   <label class=muted>${t('fltFrom')}</label><input id=fb type=datetime-local value="${esc(F.begin||'')}">
+   <label class=muted>${t('fltTo')}</label><input id=fe type=datetime-local value="${esc(F.end||'')}">
+   <label><input type=checkbox id=flt ${F.failed?'checked':''}> ${t('failedOnly')}</label>
+   <button id=fapply>${t('apply')}</button><button class=plain id=fclear>${t('clearF')}</button>
    <span class=muted>${d.total} ${t('records')}</span><span style="flex:1"></span>
    <button class=plain ${page<=1?'disabled':''} onclick="window._logPage=${page-1};nav('logs')">‹</button>
    <span class=muted>${page} / ${pages}</span>
@@ -170,18 +193,22 @@ const render={
   ${d.list.map(l=>`<tr style=cursor:pointer onclick="logDetail(${l.id})"><td>${esc(l.name)}</td><td>${esc(l.node)}</td><td>${ts(l.beginTime)}</td>
    <td>${(l.endTime-l.beginTime).toFixed(1)}</td>
    <td>${l.success?'<span class=ok>✓</span>':'<span class=bad>✗</span>'}</td>
-   <td><code>${esc((l.output||'').slice(0,160))}</code></td></tr>`).join('')}</table>`},
+   <td><code>${esc((l.output||'').slice(0,160))}</code></td></tr>`).join('')}</table>`;
+  $('#fapply').onclick=()=>{window._logF={names:$('#fn').value,node:$('#fd').value,
+   begin:$('#fb').value,end:$('#fe').value,failed:$('#flt').checked};
+   window._logPage=1;nav('logs')};
+  $('#fclear').onclick=()=>{window._logF={};window._logPage=1;nav('logs')}},
  async exec(){const xs=await api('GET','/v1/job/executing');
   $('#main').innerHTML=`<table><tr><th>${t('node')}</th><th>${t('group')}</th><th>${t('job')}</th><th>pid</th><th>${t('since')}</th></tr>
   ${xs.map(x=>`<tr><td>${esc(x.node)}</td><td>${esc(x.group)}</td><td>${esc(x.jobId)}</td>
    <td>${esc(x.pid)}</td><td>${ts(x.time)}</td></tr>`).join('')||`<tr><td colspan=5 class=muted>${t('nothingRunning')}</td></tr>`}</table>`},
- async accounts(){const as=await api('GET','/v1/admin/accounts');
+ async accounts(){const as=await api('GET','/v1/admin/accounts');window._accts=as;
   $('#main').innerHTML=`<div class=bar><button onclick="editAccount()">${t('newAccount')}</button></div>
   <table><tr><th>${t('email')}</th><th>${t('role')}</th><th>${t('status')}</th><th></th></tr>
-  ${as.map(a=>`<tr><td>${esc(a.email)}${a.unchangeable?` <span class=muted>(${t('builtIn')})</span>`:''}</td>
+  ${as.map((a,i)=>`<tr><td>${esc(a.email)}${a.unchangeable?` <span class=muted>(${t('builtIn')})</span>`:''}</td>
    <td>${a.role===1?t('admin'):t('dev')}</td>
    <td>${a.status===1?`<span class=ok>${t('enabled')}</span>`:`<span class=bad>${t('banned')}</span>`}</td>
-   <td><button class=plain onclick='editAccount(${JSON.stringify(a)})'>${t('edit')}</button></td></tr>`).join('')}</table>`},
+   <td><button class=plain onclick="editAccount(_accts[${i}])">${t('edit')}</button></td></tr>`).join('')}</table>`},
  async profile(){
   $('#main').innerHTML=`<h3>${t('profile')} — ${esc(me.email||'')}</h3>
   <form id=pf style="max-width:340px;display:flex;flex-direction:column;gap:8px;background:#fff;padding:18px;border-radius:8px;box-shadow:0 1px 2px #0002">
@@ -219,8 +246,11 @@ window.logDetail=async id=>{const l=await api('GET','/v1/log/'+id);
   <p><code>${esc(l.command)}</code></p><pre>${esc(l.output||'')}</pre>
   <div class=bar style="margin-top:10px"><form method=dialog><button class=plain>${t('cancel')}</button></form></div>
  </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove()};
-window.toggleJob=async(g,id,p)=>{await api('POST',`/v1/job/${g}-${id}`,{pause:p});nav('jobs')};
-window.runNow=async(g,id)=>{const ns=await api('GET',`/v1/job/${g}-${id}/nodes`);
+window.toggleJob=async i=>{const j=_jobs[i];
+ await api('POST',`/v1/job/${encodeURIComponent(j.group)}-${encodeURIComponent(j.id)}`,{pause:!j.pause});nav('jobs')};
+window.runNow=async i=>{const j=_jobs[i],
+ key=`${encodeURIComponent(j.group)}-${encodeURIComponent(j.id)}`;
+ const ns=await api('GET',`/v1/job/${key}/nodes`);
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg>
   <b>${t('run')}</b>
   <label>${t('node')}</label><select id=xn><option value="">${t('allNodes')}</option>
@@ -229,11 +259,26 @@ window.runNow=async(g,id)=>{const ns=await api('GET',`/v1/job/${g}-${id}/nodes`)
   <form method=dialog style=display:inline><button class=plain>${t('cancel')}</button></form></div>
  </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();try{
-  await api('PUT',`/v1/job/${g}-${id}/execute?node=`+encodeURIComponent($('#xn').value));
+  await api('PUT',`/v1/job/${key}/execute?node=`+encodeURIComponent($('#xn').value));
   dlg.close();alert(t('dispatched'))}catch(x){alert(x)}}};
-window.delJob=async(g,id)=>{if(confirm(t('delJobQ'))){await api('DELETE',`/v1/job/${g}-${id}`);nav('jobs')}};
-window.delGroup=async id=>{if(confirm(t('delGroupQ'))){await api('DELETE','/v1/node/group/'+id);nav('groups')}};
-window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
+window.delJob=async i=>{const j=_jobs[i];if(confirm(t('delJobQ'))){
+ await api('DELETE',`/v1/job/${encodeURIComponent(j.group)}-${encodeURIComponent(j.id)}`);nav('jobs')}};
+window.delGroup=async i=>{const g=_groups[i];if(confirm(t('delGroupQ'))){
+ await api('DELETE','/v1/node/group/'+encodeURIComponent(g.id));nav('groups')}};
+// Multi-rule job editor (reference JobEditRule.vue edits a LIST of rules per
+// job, web/ui/src/components/JobEdit.vue): every rule renders as its own
+// timer/nids/gids/exclude row with add/remove; saving collects all rows —
+// editing a >=2-rule job must never drop rules.
+window.editJob=(j)=>{j=j||{};
+ const rules=(j.rules&&j.rules.length?j.rules:[{}]).map(r=>({...r}));
+ const ruleRow=(r,k)=>`<fieldset style="border:1px solid #dde;border-radius:6px;margin:8px 0;padding:4px 10px 10px">
+  <legend class=muted style="font-size:12px">${t('timerN')} ${k+1}
+   <a style="cursor:pointer;color:#c0392b" data-rm=${k}>✕ ${t('removeTimer')}</a></legend>
+  <label>${t('cronTimer')}</label><input data-rt=${k} value="${esc(r.timer||'0 */5 * * * *')}">
+  <div class=row><div><label>${t('nodeIds')}</label><input data-rn=${k} value="${esc((r.nids||[]).join(','))}"></div>
+  <div><label>${t('groupIds')}</label><input data-rg=${k} value="${esc((r.gids||[]).join(','))}"></div>
+  <div><label>${t('excludeNodes')}</label><input data-rx=${k} value="${esc((r.exclude_nids||[]).join(','))}"></div></div>
+ </fieldset>`;
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
   <b>${j.id?t('editT'):t('newT')} ${t('job')}</b>
   <div class=row><div><label>${t('name')}</label><input id=jn value="${esc(j.name||'')}"></div>
@@ -247,18 +292,26 @@ window.editJob=(j)=>{j=j||{rules:[{}]};const r=(j.rules&&j.rules[0])||{};
   <div class=row><div><label>${t('timeoutS')}</label><input id=jt type=number value="${j.timeout||0}"></div>
   <div><label>${t('retry')}</label><input id=jr type=number value="${j.retry||0}"></div>
   <div><label>${t('parallels')}</label><input id=jp type=number value="${j.parallels||0}"></div></div>
-  <label>${t('cronTimer')}</label><input id=rt value="${esc(r.timer||'0 */5 * * * *')}">
-  <div class=row><div><label>${t('nodeIds')}</label><input id=rn value="${esc((r.nids||[]).join(','))}"></div>
-  <div><label>${t('groupIds')}</label><input id=rg value="${esc((r.gids||[]).join(','))}"></div>
-  <div><label>${t('excludeNodes')}</label><input id=rx value="${esc((r.exclude_nids||[]).join(','))}"></div></div>
+  <div id=rules></div>
+  <button class=plain id=addr style="margin-top:4px">${t('addTimer')}</button>
   <div class=bar style="margin-top:14px"><button id=sv>${t('save')}</button><button class=plain>${t('cancel')}</button></div>
  </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
- $('#sv').onclick=async e=>{e.preventDefault();const csv=v=>v.split(',').map(s=>s.trim()).filter(Boolean);
+ const csv=v=>v.split(',').map(s=>s.trim()).filter(Boolean);
+ const harvest=()=>{rules.forEach((r,k)=>{const f=s=>dlg.querySelector(`[data-${s}="${k}"]`);
+  if(!f('rt'))return;
+  r.timer=f('rt').value;r.nids=csv(f('rn').value);
+  r.gids=csv(f('rg').value);r.exclude_nids=csv(f('rx').value)})};
+ const paint=()=>{ $('#rules').innerHTML=rules.map(ruleRow).join('');
+  dlg.querySelectorAll('[data-rm]').forEach(a=>a.onclick=e=>{e.preventDefault();
+   harvest();rules.splice(+a.dataset.rm,1);if(!rules.length)rules.push({});paint()})};
+ paint();
+ $('#addr').onclick=e=>{e.preventDefault();harvest();rules.push({});paint()};
+ $('#sv').onclick=async e=>{e.preventDefault();harvest();
   try{await api('PUT','/v1/job',{id:j.id,name:$('#jn').value,group:$('#jg').value,oldGroup:j.group,
    command:$('#jc').value,kind:+$('#jk').value,user:$('#ju').value,timeout:+$('#jt').value,
    retry:+$('#jr').value,parallels:+$('#jp').value,pause:!!j.pause,
-   rules:[{id:r.id,timer:$('#rt').value,nids:csv($('#rn').value),gids:csv($('#rg').value),
-           exclude_nids:csv($('#rx').value)}]});dlg.close();nav('jobs')}catch(x){alert(x)}}};
+   rules:rules.map(r=>({id:r.id,timer:r.timer,nids:r.nids||[],gids:r.gids||[],
+           exclude_nids:r.exclude_nids||[]}))});dlg.close();nav('jobs')}catch(x){alert(x)}}};
 window.editGroup=(g)=>{g=g||{};
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
   <b>${g.id?t('editT'):t('newT')} ${t('group')}</b>

@@ -321,13 +321,19 @@ struct CompactedErr { std::string msg; };
 // Write-ahead log: every mutation appends one JSON-array line; boot
 // replays the file through the normal mutation paths (with logging
 // suppressed) and then rewrites it as a compacted snapshot.  Appends are
-// flushed to the OS immediately; fdatasync rides the sweeper cadence, so
-// the durability window is one sweep interval (etcd-style group commit).
+// flushed to the OS immediately; by default fdatasync rides the sweeper
+// cadence, so mutations are acknowledged BEFORE they are durable and the
+// window of acknowledged-but-lost writes on power loss / OS crash is one
+// sweep interval.  (This is weaker than etcd, which fsyncs before
+// acknowledging.)  --fsync-per-commit closes the window: every append
+// fdatasyncs before the ack, for deployments where e.g. put_if_absent
+// lock acquisitions must survive a host crash.
 class Wal {
  public:
-  bool open_append(const std::string& path) {
+  bool open_append(const std::string& path, bool sync_per_commit) {
     std::lock_guard<std::mutex> g(mu_);
     f_ = fopen(path.c_str(), "a");
+    sync_per_commit_ = sync_per_commit;
     return f_ != nullptr;
   }
   void append(const std::string& line) {
@@ -339,6 +345,10 @@ class Wal {
     if (fwrite(line.data(), 1, line.size(), f_) != line.size() ||
         fputc('\n', f_) == EOF || fflush(f_) != 0) {
       fprintf(stderr, "FATAL: wal append failed: %s\n", strerror(errno));
+      abort();
+    }
+    if (sync_per_commit_ && fdatasync(fileno(f_)) != 0) {
+      fprintf(stderr, "FATAL: wal fdatasync failed: %s\n", strerror(errno));
       abort();
     }
   }
@@ -354,6 +364,7 @@ class Wal {
 
  private:
   FILE* f_ = nullptr;
+  bool sync_per_commit_ = false;
   std::mutex mu_;
 };
 
@@ -531,7 +542,8 @@ class Store {
   // ring starts empty after a boot, so a watcher resuming from a
   // pre-restart revision gets CompactedError and re-lists — exactly
   // etcd's compaction contract.
-  bool open_wal(const std::string& path, std::string& err) {
+  bool open_wal(const std::string& path, std::string& err,
+                bool sync_per_commit = false) {
     std::lock_guard<std::mutex> g(mu);
     replaying_ = true;
     FILE* f = fopen(path.c_str(), "r");
@@ -607,7 +619,7 @@ class Store {
       return false;
     }
     wal_ = &wal_storage_;
-    if (!wal_->open_append(path)) {
+    if (!wal_->open_append(path, sync_per_commit)) {
       err = "cannot append to " + path;
       wal_ = nullptr;
       return false;
@@ -820,6 +832,17 @@ struct Conn : std::enable_shared_from_this<Conn> {
   static constexpr size_t kMaxOutbox = 1u << 20;
 
   Conn(int f, Store* s) : fd(f), store(s) {}
+
+  // The fd is closed exactly once, when the LAST of the two detached
+  // threads (reader, writer) drops its shared_ptr.  Closing any earlier
+  // (the old reader-side ::close) raced the writer's send()/shutdown():
+  // the kernel can reuse the fd number for a new accept()ed connection,
+  // letting the stale writer deliver outbox bytes to — or shut down —
+  // an unrelated client.  Threads wanting to end the connection call
+  // ::shutdown() only; the destructor owns close.
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
 
   void enqueue(std::string msg) {
     std::lock_guard<std::mutex> g(omu);
@@ -1041,6 +1064,7 @@ static void reader(std::shared_ptr<Conn> c) {
 int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   std::string wal_path;
+  bool fsync_per_commit = false;
   int port = 7070;
   size_t history = 65536;
   double sweep_s = 0.2;
@@ -1052,6 +1076,7 @@ int main(int argc, char** argv) {
     else if (a == "--history") history = (size_t)atoll(next());
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--wal") wal_path = next();
+    else if (a == "--fsync-per-commit") fsync_per_commit = true;
     else if (a == "--die-with-parent") {
       // supervised mode (the Python wrapper passes this): if the
       // supervisor is SIGKILLed, the server must not linger orphaned
@@ -1061,7 +1086,8 @@ int main(int argc, char** argv) {
     }
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
-             "[--sweep-interval S] [--wal FILE] [--die-with-parent]\n");
+             "[--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
+             "[--die-with-parent]\n");
       return 0;
     }
   }
@@ -1088,7 +1114,7 @@ int main(int argc, char** argv) {
   static Store store(history);
   if (!wal_path.empty()) {
     std::string err;
-    if (!store.open_wal(wal_path, err)) {
+    if (!store.open_wal(wal_path, err, fsync_per_commit)) {
       fprintf(stderr, "wal: %s\n", err.c_str());
       return 1;
     }
@@ -1112,7 +1138,9 @@ int main(int argc, char** argv) {
     std::thread([c] { c->writer(); }).detach();
     std::thread([c] {
       reader(c);
-      ::close(c->fd);
+      // shutdown (not close) unblocks a writer parked in send();
+      // ~Conn closes the fd once both threads are done
+      ::shutdown(c->fd, SHUT_RDWR);
     }).detach();
   }
 }

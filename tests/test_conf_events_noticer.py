@@ -187,6 +187,155 @@ def test_events_shutdown_releases_wait():
     events.clear()
 
 
+def test_events_shutdown_before_wait_is_sticky():
+    """A shutdown() fired before main reaches wait() (supervised child
+    dying between READY and wait, bin/store.py) must release wait()
+    immediately, not be swallowed."""
+    import threading
+    from cronsun_tpu import events
+
+    events.clear()
+    events.shutdown()                    # fires BEFORE wait() starts
+    done = []
+    t = threading.Thread(target=lambda: (events.wait(), done.append(1)),
+                         daemon=True)
+    t.start()
+    t.join(timeout=3)
+    assert done, "pre-wait shutdown() was lost"
+    events.clear()
+
+
+class FlakySender:
+    """Fails the first ``fail_n`` sends, then delivers."""
+
+    def __init__(self, fail_n=1):
+        self.fail_n = fail_n
+        self.attempts = 0
+        self.notices = []
+
+    def send(self, n):
+        self.attempts += 1
+        if self.attempts <= self.fail_n:
+            raise RuntimeError("smtp down")
+        self.notices.append(n)
+
+
+def test_noticer_failed_send_retries_and_key_survives():
+    """A failed delivery must NOT consume the noticer key; the alert is
+    retried with backoff and the key is deleted only on success."""
+    store = MemStore()
+    sink = JobLogStore()
+    sender = FlakySender(fail_n=1)
+    host = NoticerHost(store, sink, sender)
+    host.RETRY_CAP = 0.01                # fast test
+    store.put(KS.noticer_key("n1"), json.dumps({"subject": "s", "body": "b"}))
+    assert host.poll() == 0              # first attempt fails
+    assert store.get(KS.noticer_key("n1")) is not None, \
+        "key consumed despite failed delivery"
+    # wait out the 0.5s first-attempt backoff, then retry succeeds
+    deadline = time.time() + 5
+    delivered = 0
+    while not delivered and time.time() < deadline:
+        time.sleep(0.05)
+        delivered = host.poll()
+    assert delivered == 1
+    assert sender.notices[0].subject == "s"
+    assert store.get(KS.noticer_key("n1")) is None   # consumed on success
+
+
+def test_noticer_failed_send_survives_restart():
+    """Because the key survives a failed send, a fresh NoticerHost
+    (process restart) re-lists and delivers it."""
+    store = MemStore()
+    sink = JobLogStore()
+
+    class Boom:
+        def send(self, n):
+            raise RuntimeError("smtp down")
+
+    host = NoticerHost(store, sink, Boom())
+    store.put(KS.noticer_key("n1"), json.dumps({"subject": "s", "body": "b"}))
+    assert host.poll() == 0
+    # "restart": new host, working sender
+    sender = CollectSender()
+    host2 = NoticerHost(store, sink, sender)
+    assert host2.resync() == 1
+    assert sender.notices[0].subject == "s"
+    assert store.get(KS.noticer_key("n1")) is None
+
+
+def test_noticer_parked_notice_replaced_by_newer_overwrite():
+    """Agents overwrite ONE per-node noticer key; while a delivery is
+    parked awaiting retry, a newer notice at the same key must replace
+    the parked one — delivering the stale value and deleting the key
+    would lose the newer notice permanently."""
+    store = MemStore()
+    sink = JobLogStore()
+    sender = FlakySender(fail_n=1)
+    host = NoticerHost(store, sink, sender)
+    key = KS.noticer_key("n1")
+    store.put(key, json.dumps({"subject": "A", "body": "old"}))
+    assert host.poll() == 0                  # A parks
+    store.put(key, json.dumps({"subject": "B", "body": "new"}))
+    host.poll()                              # B replaces parked A
+    deadline = time.time() + 5
+    while not sender.notices and time.time() < deadline:
+        time.sleep(0.05)
+        host.poll()
+    assert [n.subject for n in sender.notices] == ["B"], \
+        "stale parked notice delivered instead of the newer overwrite"
+    assert store.get(key) is None
+
+
+def test_noticer_node_reregister_during_retry_keeps_mirror_alive():
+    """If the node re-registers while its crash alert awaits retry, the
+    eventual delivery must NOT flip the mirror dead — that would swallow
+    the alert for the node's next real crash."""
+    store = MemStore()
+    sink = JobLogStore()
+    sender = FlakySender(fail_n=1)
+    host = NoticerHost(store, sink, sender)
+    sink.upsert_node("nx", '{"id": "nx"}', alived=True)
+    store.put(KS.node_key("nx"), "host:1")
+    host.poll()
+    store.delete(KS.node_key("nx"))                  # crash
+    assert host.poll() == 0                          # alert parks
+    store.put(KS.node_key("nx"), "host:2")           # node comes back
+    sink.upsert_node("nx", '{"id": "nx"}', alived=True)
+    deadline = time.time() + 5
+    while not sender.notices and time.time() < deadline:
+        time.sleep(0.05)
+        host.poll()
+    assert len(sender.notices) == 1                  # alert delivered
+    assert sink.get_node("nx")["alived"], \
+        "mirror flipped dead although the node re-registered"
+
+
+def test_noticer_node_down_mirror_marked_only_after_delivery():
+    """The alived mirror flips to dead only once the crash alert is
+    actually delivered, so an undelivered alert is recoverable by
+    resync; the pending dedupe stops double-queueing meanwhile."""
+    store = MemStore()
+    sink = JobLogStore()
+    sender = FlakySender(fail_n=1)
+    host = NoticerHost(store, sink, sender)
+    sink.upsert_node("nx", '{"id": "nx"}', alived=True)
+    store.put(KS.node_key("nx"), "host:1")
+    host.poll()
+    store.delete(KS.node_key("nx"))                  # crash
+    assert host.poll() == 0                          # delivery failed
+    assert sink.get_node("nx")["alived"], \
+        "mirror marked dead before the alert was delivered"
+    host.resync()                                    # must not double-queue
+    assert len(host._pending) == 1
+    deadline = time.time() + 5
+    while not sender.notices and time.time() < deadline:
+        time.sleep(0.05)
+        host.poll()
+    assert len(sender.notices) == 1
+    assert not sink.get_node("nx")["alived"]         # marked after delivery
+
+
 def test_node_crash_alert_not_repeated_on_resync():
     """A crash alert marks the mirror dead, so a later resync (watch
     loss) must not re-mail the same crash; a node that re-registers and

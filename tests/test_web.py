@@ -232,14 +232,68 @@ def test_ui_api_contract(world):
         # JS template params -> plausible concrete values
         concrete = re.sub(r"\$\{[^}]*\}", "x", path).split("?")[0]
         concrete = concrete.rstrip("#(")
-        if concrete.endswith("/v1/job/x"):  # ${gid}-${id} collapses to x
-            concrete = "/v1/job/g-x"
+        # ${group}-${id} (or a prejoined ${key}) collapses to x
+        concrete = re.sub(r"^/v1/job/x(?=$|/)", "/v1/job/g-x", concrete)
         # a trailing slash is a '+id' string concat: try both with a path
         # arg appended (numeric and slug) and bare (concat at boundary)
         cands = ([concrete[:-1], concrete + "1", concrete + "x"]
                  if concrete.endswith("/") else [concrete])
         ok = any(rx.match(c) for rx in patterns for c in cands)
         assert ok, f"UI calls {path} -> {cands!r}: no route matches"
+
+
+def test_ui_multi_rule_roundtrip(world):
+    """A 3-rule job survives an edit round-trip unchanged (the old editor
+    bound only rules[0] and silently deleted the rest — a data-loss bug
+    reachable from the primary UI flow; reference JobEditRule.vue:7-21
+    edits the full list)."""
+    _, _, _, c = world
+    c.login()
+    rules = [{"timer": "0 0 3 * * *", "nids": ["n1"]},
+             {"timer": "0 30 12 * * *", "gids": ["g1"],
+              "exclude_nids": ["n9"]},
+             {"timer": "15 * * * * *", "nids": ["n2", "n3"]}]
+    code, out = c.req("PUT", "/v1/job", {
+        "name": "multi", "group": "infra", "command": "echo hi",
+        "rules": rules})
+    assert code == 200
+    jid = out["id"]
+    code, job = c.req("GET", f"/v1/job/infra-{jid}")
+    assert len(job["rules"]) == 3
+    # simulate the UI save: harvest() collects EVERY rendered rule row
+    # (with server-assigned ids) and PUTs them all back
+    code, _ = c.req("PUT", "/v1/job", {
+        "id": jid, "name": "multi", "group": "infra", "oldGroup": "infra",
+        "command": "echo hi", "kind": 0, "user": "", "timeout": 0,
+        "retry": 0, "parallels": 0, "pause": False,
+        "rules": [{"id": r["id"], "timer": r["timer"],
+                   "nids": r.get("nids") or [], "gids": r.get("gids") or [],
+                   "exclude_nids": r.get("exclude_nids") or []}
+                  for r in job["rules"]]})
+    assert code == 200
+    code, job2 = c.req("GET", f"/v1/job/infra-{jid}")
+    assert len(job2["rules"]) == 3, "edit round-trip lost rules"
+    assert [r["timer"] for r in job2["rules"]] == \
+        [r["timer"] for r in job["rules"]]
+
+
+def test_ui_editor_binds_all_rules():
+    """The editor must iterate the rules list, never bind only rules[0]
+    (the exact shape of the data-loss bug), and row actions must not
+    interpolate user-controlled ids into JS-string context (stored XSS
+    via a quote in a group name)."""
+    from cronsun_tpu.web.ui import INDEX_HTML
+    assert "rules.map" in INDEX_HTML
+    assert "j.rules[0]" not in INDEX_HTML and "rules&&j.rules[0]" \
+        not in INDEX_HTML
+    # inline handlers receive row indexes / array refs, not id strings
+    assert "onclick=\"toggleJob('" not in INDEX_HTML
+    assert "onclick=\"runNow('" not in INDEX_HTML
+    assert "onclick=\"delJob('" not in INDEX_HTML
+    assert "onclick=\"delGroup('" not in INDEX_HTML
+    assert "JSON.stringify(j)" not in INDEX_HTML
+    assert "JSON.stringify(g)" not in INDEX_HTML
+    assert "JSON.stringify(a)" not in INDEX_HTML
 
 
 def test_session_me_restores_identity(world):
