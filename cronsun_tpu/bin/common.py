@@ -20,6 +20,10 @@ def base_parser(doc: str, store_required: bool = True) -> argparse.ArgumentParse
         ap.add_argument("--store", default="127.0.0.1:7070",
                         metavar="HOST:PORT",
                         help="coordination store address")
+        ap.add_argument("--logsink", default=None, metavar="HOST:PORT",
+                        help="networked result store (cronsun-logd) "
+                             "address; default: conf log_addr, else the "
+                             "local log_db SQLite file")
     return ap
 
 
@@ -36,6 +40,20 @@ def setup_common(args) -> Tuple[Config, Keyspace, Optional[ConfigWatcher]]:
     return cfg, Keyspace(cfg.prefix), watcher
 
 
-def connect_store(addr: str) -> RemoteStore:
+def connect_store(addr: str, token: str = "") -> RemoteStore:
     host, _, port = addr.rpartition(":")
-    return RemoteStore(host or "127.0.0.1", int(port))
+    return RemoteStore(host or "127.0.0.1", int(port), token=token)
+
+
+def make_sink(cfg: Config, log_addr: Optional[str] = None):
+    """Result-store handle: the networked store when an address is
+    configured (processes may live on different machines — the
+    reference's Mongo topology), else the local SQLite file."""
+    addr = log_addr if log_addr is not None else cfg.log_addr
+    if addr:
+        from ..logsink import RemoteJobLogStore
+        host, _, port = addr.rpartition(":")
+        return RemoteJobLogStore(host or "127.0.0.1", int(port),
+                                 token=cfg.log_token)
+    from ..logsink import JobLogStore
+    return JobLogStore(cfg.log_db)

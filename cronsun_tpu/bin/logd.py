@@ -1,0 +1,49 @@
+"""Result-store server — the rebuild's MongoDB.
+
+    python -m cronsun_tpu.bin.logd [--db FILE] [--host H] [--port P]
+                                   [--token T] [--conf F]
+
+Serves execution logs, latest-status, stats, the node-liveness mirror
+and accounts (reference collections in db/mgo.go, job_log.go) over TCP
+so agents, web servers and noticers on DIFFERENT machines share one
+result store.  Single-machine deployments can skip this process and
+point every entrypoint at the same ``log_db`` file instead.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import events, log
+from ..logsink import LogSinkServer
+from .common import base_parser, setup_common
+
+
+def main(argv=None) -> int:
+    ap = base_parser(__doc__, store_required=False)
+    ap.add_argument("--db", default=None, metavar="FILE",
+                    help="SQLite file (default: conf log_db)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7078)
+    ap.add_argument("--token", default=None,
+                    help="shared secret clients must present "
+                         "(default: conf log_token)")
+    args = ap.parse_args(argv)
+    cfg, ks, watcher = setup_common(args)
+
+    srv = LogSinkServer(db_path=args.db or cfg.log_db,
+                        host=args.host, port=args.port,
+                        token=cfg.log_token if args.token is None
+                        else args.token).start()
+    log.infof("cronsun-logd serving on %s:%d (db %s)", srv.host, srv.port,
+              args.db or cfg.log_db)
+    print(f"READY {srv.host}:{srv.port}", flush=True)
+    events.on(events.EXIT, srv.stop)
+    if watcher:
+        events.on(events.EXIT, watcher.stop)
+    events.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,8 @@ import sys
 
 from .. import events, log
 from ..core.errors import DuplicateNode
-from ..logsink import JobLogStore
 from ..node.agent import NodeAgent
-from .common import base_parser, connect_store, setup_common
+from .common import base_parser, connect_store, make_sink, setup_common
 
 
 def main(argv=None) -> int:
@@ -21,8 +20,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
 
-    store = connect_store(args.store)
-    sink = JobLogStore(cfg.log_db)
+    store = connect_store(args.store, token=cfg.store_token)
+    sink = make_sink(cfg, args.logsink)
     fatal: list = []
 
     def on_fatal(e):

@@ -27,6 +27,9 @@ def main(argv=None) -> int:
     ap.add_argument("--wal", default=None, metavar="FILE",
                     help="write-ahead log: state survives restarts "
                          "(requires --native)")
+    ap.add_argument("--token", default=None,
+                    help="shared secret clients must present "
+                         "(default: conf store_token)")
     args = ap.parse_args(argv)
     if args.wal and not args.native:
         # pure argv check BEFORE setup_common side effects (conf watcher)
@@ -34,11 +37,12 @@ def main(argv=None) -> int:
         return 2
     cfg, ks, watcher = setup_common(args)
 
+    token = cfg.store_token if args.token is None else args.token
     rc = [0]
     if args.native:
         from ..store.native import NativeStoreServer
         srv = NativeStoreServer(host=args.host, port=args.port,
-                                wal=args.wal).start()
+                                wal=args.wal, token=token).start()
 
         def child_died(code: int):
             # the wrapper must not sit healthy-looking in front of a dead
@@ -48,7 +52,8 @@ def main(argv=None) -> int:
             events.shutdown()
         srv.monitor(child_died)
     else:
-        srv = StoreServer(host=args.host, port=args.port).start()
+        srv = StoreServer(host=args.host, port=args.port,
+                          token=token).start()
     log.infof("cronsun-store serving on %s:%d", srv.host, srv.port)
     print(f"READY {srv.host}:{srv.port}", flush=True)
     events.on(events.EXIT, srv.stop)

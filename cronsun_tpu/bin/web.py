@@ -8,10 +8,9 @@ from __future__ import annotations
 import sys
 
 from .. import events, log
-from ..logsink import JobLogStore
 from ..noticer import HttpNoticer, MailNoticer, Notice, NoticerHost
 from ..web import ApiServer
-from .common import base_parser, connect_store, setup_common
+from .common import base_parser, connect_store, make_sink, setup_common
 
 
 class LogSender:
@@ -28,8 +27,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
 
-    store = connect_store(args.store)
-    sink = JobLogStore(cfg.log_db)
+    store = connect_store(args.store, token=cfg.store_token)
+    sink = make_sink(cfg, args.logsink)
     api = ApiServer(store, sink, ks=ks, security=cfg.security,
                     alarm=cfg.mail.enable,
                     host=args.host or cfg.web.host,

@@ -61,12 +61,20 @@ class Config:
     node_capacity: int = 1024
     default_node_cap: int = 1 << 20
     log_db: str = "cronsun.db"
+    log_addr: str = ""          # "host:port" of cronsun-logd; when set the
+                                # networked result store replaces log_db
+                                # (the reference's Mgo.Hosts, db/mgo.go:24-49)
+    log_token: str = ""         # shared secret for log_addr (Mgo credentials)
+    store_token: str = ""       # shared secret for the coordination store
+                                # (the reference's etcd username/password,
+                                # conf/conf.go:66-67)
     security: Security = dataclasses.field(default_factory=Security)
     mail: Mail = dataclasses.field(default_factory=Mail)
     web: Web = dataclasses.field(default_factory=Web)
 
     # dynamic-reload exclusions, like the reference
-    _RELOAD_EXCLUDE = ("prefix", "web", "log_db")
+    _RELOAD_EXCLUDE = ("prefix", "web", "log_db", "log_addr", "log_token",
+                       "store_token")
 
 
 def _substitute(text: str, path: str) -> str:

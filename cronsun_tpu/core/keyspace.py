@@ -81,6 +81,13 @@ class Keyspace:
         return f"{self.prefix}/hwm"
 
     @property
+    def metrics(self) -> str:    # leased per-process metric snapshots
+        return f"{self.prefix}/metrics/"
+
+    def metrics_key(self, component: str, instance: str) -> str:
+        return f"{self.metrics}{component}/{instance}"
+
+    @property
     def phase(self) -> str:      # @every phase anchors, survive failover
         return f"{self.prefix}/phase/"
 

@@ -8,3 +8,4 @@ and per-day counters (job_log.go:84-133).
 """
 
 from .joblog import JobLogStore, LogRecord  # noqa: F401
+from .serve import LogSinkError, LogSinkServer, RemoteJobLogStore  # noqa: F401

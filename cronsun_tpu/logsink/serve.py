@@ -1,0 +1,236 @@
+"""Networked result store: JobLogStore served over TCP.
+
+The reference's execution logs, latest-log, stats, node-liveness mirror
+and accounts live in MongoDB — a networked multi-host store every node
+writes and the web server reads (/root/reference/db/mgo.go:24-49,
+job_log.go:84-133).  The rebuild's equivalent: :class:`LogSinkServer`
+exposes a JobLogStore (SQLite, WAL) over the same line-JSON transport
+the coordination store uses, and :class:`RemoteJobLogStore` is a client
+with the identical Python surface — agent, web server and noticer run
+unchanged against either, and processes on different machines share one
+result store the way the reference's share one Mongo.
+
+Wire protocol (one JSON object per line, UTF-8):
+
+    client -> server   {"i": <id>, "o": <op>, "a": [args...]}
+    server -> client   {"i": <id>, "r": <result>}        (ok)
+                       {"i": <id>, "e": <msg>}           (error)
+
+LogRecord wire form: plain dict of its dataclass fields.
+
+Authentication: when the server is started with a ``token``, the first
+request on every connection must be ``{"i":0,"o":"auth","a":[token]}``;
+anything else (or a wrong token) closes the connection.  The reference
+carries Mongo credentials through config the same way
+(/root/reference/db/mgo.go:33-36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import socketserver
+import threading
+from typing import List, Optional, Tuple
+
+from .. import log
+from ..store.wire import LineJsonHandler
+from .joblog import JobLogStore, LogRecord
+
+# ops dispatched 1:1 onto the JobLogStore surface (auth + create_job_log
+# + query_logs get special marshalling)
+_PLAIN_OPS = ("get_log", "stat_overall", "stat_day", "stat_days",
+              "upsert_node", "set_node_alived", "get_nodes", "get_node",
+              "upsert_account", "get_account", "list_accounts",
+              "delete_account")
+
+
+def _rec_wire(rec: Optional[LogRecord]):
+    return None if rec is None else dataclasses.asdict(rec)
+
+
+def _rec_unwire(w) -> Optional[LogRecord]:
+    return None if w is None else LogRecord(**w)
+
+
+class _Conn(LineJsonHandler):
+    def dispatch(self, rid, op, args):
+        sink: JobLogStore = self.server.sink      # type: ignore[attr-defined]
+        try:
+            if op == "create_job_log":
+                rec = _rec_unwire(args[0])
+                sink.create_job_log(rec)
+                self._send({"i": rid, "r": rec.id})
+            elif op == "query_logs":
+                recs, total = sink.query_logs(**args[0])
+                self._send({"i": rid, "r": {
+                    "total": total,
+                    "list": [_rec_wire(r) for r in recs]}})
+            elif op in _PLAIN_OPS:
+                r = getattr(sink, op)(*args)
+                if op == "get_log":
+                    r = _rec_wire(r)
+                self._send({"i": rid, "r": r})
+            else:
+                self._send({"i": rid, "e": f"unknown op {op!r}"})
+        except Exception as e:  # noqa: BLE001 — report, keep serving
+            self._send({"i": rid, "e": f"{type(e).__name__}: {e}"})
+
+
+class LogSinkServer:
+    """Serve a JobLogStore over TCP; port 0 picks a free port."""
+
+    def __init__(self, sink: Optional[JobLogStore] = None,
+                 db_path: str = ":memory:", host: str = "127.0.0.1",
+                 port: int = 0, token: str = ""):
+        self.sink = sink or JobLogStore(db_path)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+        self._srv = _Server((host, port), _Conn)
+        self._srv.sink = self.sink                # type: ignore[attr-defined]
+        self._srv.token = token                   # type: ignore[attr-defined]
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LogSinkServer":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True, name="logsink-server")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread:
+            self._thread.join(timeout=3)
+        self.sink.close()
+
+
+class LogSinkError(RuntimeError):
+    pass
+
+
+class RemoteJobLogStore:
+    """TCP client with JobLogStore's exact surface.
+
+    Calls are synchronous request/response under one lock (the result
+    path has no server pushes to demux).  A dropped connection is healed
+    by one transparent reconnect+retry per call; if that also fails the
+    caller sees :class:`LogSinkError` and retries at its own cadence —
+    the agent's log writes tolerate this the way the reference tolerates
+    a Mongo hiccup (job_log.go:84 logs and moves on)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 token: str = ""):
+        self.host, self.port = host, port
+        self._timeout = timeout
+        self._token = token
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._next_id = 1
+        self._closed = False
+        with self._lock:
+            self._connect()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _connect(self):
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=self._timeout)
+        self._sock.settimeout(self._timeout)
+        self._rfile = self._sock.makefile("rb")
+        if self._token:
+            self._exchange("auth", self._token)
+
+    def _exchange(self, op: str, *args):
+        rid = self._next_id
+        self._next_id += 1
+        data = (json.dumps({"i": rid, "o": op, "a": list(args)},
+                           separators=(",", ":")) + "\n").encode()
+        self._sock.sendall(data)
+        line = self._rfile.readline()
+        if not line:
+            raise OSError("connection closed")
+        msg = json.loads(line)
+        if "e" in msg:
+            raise LogSinkError(msg["e"])
+        return msg.get("r")
+
+    def _call(self, op: str, *args):
+        if self._closed:
+            raise LogSinkError("logsink connection closed")
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    return self._exchange(op, *args)
+                except (OSError, json.JSONDecodeError) as e:
+                    self._drop()
+                    if attempt:
+                        raise LogSinkError(f"{op}: {e}") from e
+                    log.warnf("logsink call %s failed (%s); reconnecting",
+                              op, e)
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._rfile = None
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._drop()
+
+    # -- surface (mirrors JobLogStore) -------------------------------------
+
+    def create_job_log(self, rec: LogRecord):
+        rec.id = self._call("create_job_log", _rec_wire(rec))
+
+    def query_logs(self, **kw) -> Tuple[List[LogRecord], int]:
+        r = self._call("query_logs", kw)
+        return [_rec_unwire(w) for w in r["list"]], r["total"]
+
+    def get_log(self, log_id: int) -> Optional[LogRecord]:
+        return _rec_unwire(self._call("get_log", log_id))
+
+    def stat_overall(self) -> dict:
+        return self._call("stat_overall")
+
+    def stat_day(self, day: str) -> dict:
+        return self._call("stat_day", day)
+
+    def stat_days(self, n_days: int) -> List[dict]:
+        return self._call("stat_days", n_days)
+
+    def upsert_node(self, node_id: str, doc: str, alived: bool):
+        self._call("upsert_node", node_id, doc, alived)
+
+    def set_node_alived(self, node_id: str, alived: bool):
+        self._call("set_node_alived", node_id, alived)
+
+    def get_nodes(self) -> List[dict]:
+        return self._call("get_nodes")
+
+    def get_node(self, node_id: str) -> Optional[dict]:
+        return self._call("get_node", node_id)
+
+    def upsert_account(self, email: str, doc: str):
+        self._call("upsert_account", email, doc)
+
+    def get_account(self, email: str) -> Optional[str]:
+        return self._call("get_account", email)
+
+    def list_accounts(self) -> List[str]:
+        return self._call("list_accounts")
+
+    def delete_account(self, email: str) -> bool:
+        return self._call("delete_account", email)

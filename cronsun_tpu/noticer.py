@@ -161,16 +161,29 @@ class NoticerHost:
         try:
             if self.store.get(self.ks.node_key(nid)) is not None:
                 return
-        except Exception:  # noqa: BLE001 — can't verify: keep mirror alive
-            return
-        self.sink.set_node_alived(nid, False)
+            self.sink.set_node_alived(nid, False)
+        except Exception as e:  # noqa: BLE001 — can't verify / can't mark:
+            # keep the mirror alive; the next resync re-checks (a stale
+            # alive flag re-alerts, a wrong dead flag swallows alerts)
+            log.warnf("node-down mirror mark for %s skipped: %s", nid, e)
 
     def poll(self) -> int:
         try:
             return self._poll_once()
         except WatchLost as e:
             log.warnf("noticer watch lost (%s); resynchronizing", e)
-            return self.resync()
+            try:
+                return self.resync()
+            except Exception as e2:  # noqa: BLE001
+                log.errorf("noticer resync failed (retrying next poll): %s",
+                           e2)
+                return 0
+        except Exception as e:  # noqa: BLE001 — a transient store/sink
+            # outage (e.g. the remote result store briefly unreachable)
+            # must not kill the noticer thread: alerts stay queued/keyed
+            # and the next poll retries
+            log.errorf("noticer poll failed (retrying next poll): %s", e)
+            return 0
 
     def resync(self) -> int:
         """Re-watch and queue any pending notices from a re-list (keys
@@ -279,9 +292,12 @@ class NoticerHost:
     def start(self):
         def run():
             while not self._stop.wait(0.5):
-                self.poll()
-                if hasattr(self.sender, "idle_check"):
-                    self.sender.idle_check()
+                try:
+                    self.poll()
+                    if hasattr(self.sender, "idle_check"):
+                        self.sender.idle_check()
+                except Exception as e:  # noqa: BLE001 — never die silently
+                    log.errorf("noticer loop error: %s", e)
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="noticer")
         self._thread.start()

@@ -114,6 +114,17 @@ class SchedulerService:
         # toggles, avg_time updates) — only a changed timer re-anchors.
         self._row_phase: Dict[int, Tuple[str, int]] = {}
 
+        # watch-fed mirrors of the execution-state prefixes (proc registry,
+        # outstanding exclusive orders, Alone lifetime locks).  The hot loop
+        # must NOT re-list these every second — at planner fire rates that
+        # serializes the whole keyspace over TCP per step; deltas arrive by
+        # watch and a periodic anti-entropy re-list bounds drift.
+        self._procs: Dict[str, Tuple[str, str, str]] = {}
+        self._orders: Dict[str, Tuple[str, str, str]] = {}
+        self._alone_live: Set[str] = set()
+        self.mirror_resync_s = 30.0
+        self._mirror_resync_at = 0.0
+
         self._open_watches()
 
         self._leader_lease: Optional[int] = None
@@ -121,14 +132,35 @@ class SchedulerService:
         self._thread: Optional[threading.Thread] = None
         self._next_epoch: Optional[int] = None
         self.max_catchup_s = 120
-        self.stats = {"overflow_drops": 0, "skipped_seconds": 0}
+        self.stats = {"overflow_drops": 0, "skipped_seconds": 0,
+                      "watch_losses": 0, "dispatches_total": 0,
+                      "steps_total": 0}
+        # operator metrics: recent device-plan latencies (ring) published
+        # to the store under a lease so the web process can serve
+        # /v1/metrics for the whole fleet (a dead scheduler's snapshot
+        # expires instead of going stale)
+        self._tick_ms: List[float] = []
+        self.metrics_interval_s = 5.0
+        self._metrics_at = 0.0
+        self._metrics_lease: Optional[int] = None
 
         self._load_initial()
+
+    @property
+    def _alone_pfx(self) -> str:
+        return self.ks.lock + "alone/"
 
     def _open_watches(self):
         self._w_jobs = self.store.watch(self.ks.cmd)
         self._w_groups = self.store.watch(self.ks.group)
         self._w_nodes = self.store.watch(self.ks.node)
+        self._w_procs = self.store.watch(self.ks.proc)
+        self._w_orders = self.store.watch(self.ks.dispatch)
+        self._w_alone = self.store.watch(self._alone_pfx)
+
+    def _all_watches(self):
+        return (self._w_jobs, self._w_groups, self._w_nodes,
+                self._w_procs, self._w_orders, self._w_alone)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -144,6 +176,7 @@ class SchedulerService:
         for kv in (jobs if jobs is not None
                    else self.store.get_prefix(self.ks.cmd)):
             self._apply_job(kv.key, kv.value)
+        self._mirror_antientropy()
         self._flush_device()
 
     # ---- leadership ------------------------------------------------------
@@ -270,6 +303,7 @@ class SchedulerService:
             self._drain_watches_once()
         except WatchLost as e:
             log.warnf("scheduler watch lost (%s); resynchronizing", e)
+            self.stats["watch_losses"] += 1
             self.resync()
 
     def resync(self):
@@ -277,7 +311,7 @@ class SchedulerService:
         the store's current contents.  Run after a lost watch stream
         (overflow / compacted reconnect) — re-applying is idempotent and
         rows whose job/group vanished during the gap are dropped."""
-        for w in (self._w_jobs, self._w_groups, self._w_nodes):
+        for w in self._all_watches():
             try:
                 w.close()
             except Exception:   # noqa: BLE001 — already-dead watchers
@@ -328,6 +362,60 @@ class SchedulerService:
                     self._drop_job(group, job_id)
             else:
                 self._apply_job(ev.kv.key, ev.kv.value)
+        # execution-state mirrors: proc registry (leased keys expire ->
+        # DELETE events age dead executions out), outstanding exclusive
+        # orders, Alone lifetime locks
+        for ev in self._w_procs.drain():
+            if ev.type == DELETE:
+                self._procs.pop(ev.kv.key, None)
+            else:
+                t = self._parse_proc(ev.kv.key)
+                if t:
+                    self._procs[ev.kv.key] = t
+        for ev in self._w_orders.drain():
+            if ev.type == DELETE:
+                self._orders.pop(ev.kv.key, None)
+            else:
+                t = self._parse_order(ev.kv.key)
+                if t:
+                    self._orders[ev.kv.key] = t
+        for ev in self._w_alone.drain():
+            jid = ev.kv.key[len(self._alone_pfx):]
+            if ev.type == DELETE:
+                self._alone_live.discard(jid)
+            else:
+                self._alone_live.add(jid)
+
+    def _parse_proc(self, key: str) -> Optional[Tuple[str, str, str]]:
+        rest = key[len(self.ks.proc):].split("/")
+        if len(rest) != 4:
+            return None
+        node_id, group, job_id, _pid = rest
+        return node_id, group, job_id
+
+    def _parse_order(self, key: str) -> Optional[Tuple[str, str, str]]:
+        rest = key[len(self.ks.dispatch):].split("/")
+        if len(rest) != 4 or rest[0] == Keyspace.BROADCAST:
+            # broadcast (Common) orders reserve no exclusive capacity;
+            # their load lands via proc keys once running
+            return None
+        node_id, _epoch, group, job_id = rest
+        return node_id, group, job_id
+
+    def _mirror_antientropy(self):
+        """Ground-truth re-list of the execution-state mirrors.  Runs at
+        boot, on watch loss (via resync -> _load_initial) and every
+        ``mirror_resync_s`` — between runs the mirrors advance purely on
+        watch deltas, so steady-state step() issues O(delta) store ops
+        instead of re-serializing every outstanding key per second."""
+        self._procs = {kv.key: t for kv in self.store.get_prefix(self.ks.proc)
+                       if (t := self._parse_proc(kv.key))}
+        self._orders = {kv.key: t
+                        for kv in self.store.get_prefix(self.ks.dispatch)
+                        if (t := self._parse_order(kv.key))}
+        self._alone_live = {kv.key[len(self._alone_pfx):]
+                            for kv in self.store.get_prefix(self._alone_pfx)}
+        self._mirror_resync_at = self.clock() + self.mirror_resync_s
 
     def _flush_device(self):
         if self._table_updates:
@@ -355,7 +443,8 @@ class SchedulerService:
         exists), so a node at capacity can't be over-committed during the
         dispatch->spawn gap.  Crash-safe by construction: procs of dead
         nodes expire with their lease (reference proc.go:21-35 ProcTtl),
-        orders with the dispatch lease."""
+        orders with the dispatch lease — both expirations arrive as watch
+        DELETEs into the mirrors this reads."""
         running_excl: Dict[str, int] = {}
         running_load: Dict[str, float] = {}
 
@@ -366,19 +455,9 @@ class SchedulerService:
             if job and job.exclusive:
                 running_excl[node_id] = running_excl.get(node_id, 0) + 1
 
-        for kv in self.store.get_prefix(self.ks.proc):
-            rest = kv.key[len(self.ks.proc):].split("/")
-            if len(rest) != 4:
-                continue
-            node_id, group, job_id, _pid = rest
+        for node_id, group, job_id in self._procs.values():
             account(node_id, group, job_id)
-        for kv in self.store.get_prefix(self.ks.dispatch):
-            rest = kv.key[len(self.ks.dispatch):].split("/")
-            if len(rest) != 4 or rest[0] == Keyspace.BROADCAST:
-                # broadcast (Common) orders reserve no exclusive capacity;
-                # their load lands via proc keys once running
-                continue
-            node_id, _epoch, group, job_id = rest
+        for node_id, group, job_id in self._orders.values():
             account(node_id, group, job_id)
         cols, caps = [], []
         loads = np.zeros(self.planner.N, np.float32)
@@ -407,6 +486,8 @@ class SchedulerService:
             self._next_epoch = None
             return 0
         self.drain_watches()
+        if self.clock() >= self._mirror_resync_at:
+            self._mirror_antientropy()
         self.reconcile_capacity()
         self._flush_device()
         start = self._next_epoch
@@ -428,13 +509,15 @@ class SchedulerService:
                                               - start)
             start = now + 1 - self.max_catchup_s
         window = max(1, self.window_s)
+        t_plan = time.perf_counter()
         plans = self.planner.plan_window(start, window)
+        self._tick_ms.append((time.perf_counter() - t_plan) * 1e3)
+        del self._tick_ms[:-128]
         self._next_epoch = start + window
         # KindAlone lifetime exclusion: don't dispatch an Alone job whose
-        # running lock is still live anywhere (reference job.go:87-123)
-        alone_pfx = self.ks.lock + "alone/"
-        alone_live = {kv.key[len(alone_pfx):]
-                      for kv in self.store.get_prefix(alone_pfx)}
+        # running lock is still live anywhere (reference job.go:87-123);
+        # the watch-fed mirror replaces a per-step prefix scan
+        alone_live = self._alone_live
         col_to_node = {c: n for n, c in self.universe.index.items()}
         orders: List[Tuple[str, str]] = []
         lease = self.store.grant(self.dispatch_ttl)
@@ -480,7 +563,47 @@ class SchedulerService:
         # fire beats silently missing it), and monotonically via CAS so a
         # deposed-but-stalled leader can't regress the new leader's mark.
         self._advance_hwm(self._next_epoch)
+        self.stats["dispatches_total"] += n_dispatch
+        self.stats["steps_total"] += 1
+        if self.clock() >= self._metrics_at:
+            self.publish_metrics()
         return n_dispatch
+
+    # ---- operator metrics ------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        ticks = sorted(self._tick_ms) or [0.0]
+        q = lambda p: ticks[min(len(ticks) - 1, int(p * len(ticks)))]
+        return {
+            "tick_p50_ms": round(q(0.50), 3),
+            "tick_p99_ms": round(q(0.99), 3),
+            "overflow_drops_total": self.stats["overflow_drops"],
+            "skipped_seconds_total": self.stats["skipped_seconds"],
+            "watch_losses_total": self.stats["watch_losses"],
+            "dispatches_total": self.stats["dispatches_total"],
+            "steps_total": self.stats["steps_total"],
+            "dispatch_queue_depth": len(self._orders),
+            "procs_running": len(self._procs),
+            "jobs": len(self.jobs),
+            "is_leader": 1 if self.is_leader else 0,
+        }
+
+    def publish_metrics(self):
+        """Leased metrics snapshot -> store; the web process renders the
+        fleet's snapshots as a Prometheus text surface at /v1/metrics."""
+        try:
+            if self._metrics_lease is None or \
+                    not self.store.keepalive(self._metrics_lease):
+                self._metrics_lease = self.store.grant(
+                    self.metrics_interval_s * 3 + 5)
+            self.store.put(self.ks.metrics_key("sched", self.node_id),
+                           json.dumps(self.metrics_snapshot(),
+                                      separators=(",", ":")),
+                           lease=self._metrics_lease)
+        except Exception as e:  # noqa: BLE001 — metrics must not stall steps
+            log.warnf("metrics publish failed: %s", e)
+            self._metrics_lease = None
+        self._metrics_at = self.clock() + self.metrics_interval_s
 
     def _advance_hwm(self, value: int):
         for _ in range(8):

@@ -55,7 +55,7 @@ class NativeStoreServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  binary: Optional[str] = None, history: int = 65536,
-                 wal: Optional[str] = None,
+                 wal: Optional[str] = None, token: str = "",
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         self.binary = binary or find_binary()
@@ -68,13 +68,30 @@ class NativeStoreServer:
                 "--die-with-parent"] + (extra_args or [])
         if wal:
             argv += ["--wal", wal]
+        token_path = None
+        if token:
+            # hand the secret over in a 0600 file, not argv (argv is
+            # world-readable via /proc/<pid>/cmdline); removed once the
+            # child has read it
+            import tempfile
+            tfd, token_path = tempfile.mkstemp(prefix="cronsun-tok-")
+            os.write(tfd, token.encode())
+            os.close(tfd)
+            argv += ["--token-file", token_path]
         # stderr merged into stdout so a startup failure (bind error …)
         # surfaces in the exception instead of vanishing
-        self._proc = subprocess.Popen(
-            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True)
-        self._stopping = False
-        line = self._read_ready(ready_timeout)
+        try:
+            self._proc = subprocess.Popen(
+                argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            self._stopping = False
+            line = self._read_ready(ready_timeout)
+        finally:
+            if token_path:
+                try:
+                    os.unlink(token_path)
+                except OSError:
+                    pass
         addr = line.split(" ", 1)[1]
         self.host, port_s = addr.rsplit(":", 1)
         self.port = int(port_s)

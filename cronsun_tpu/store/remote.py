@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import log
 from .memstore import CompactedError, DELETE, LossyEventStream, PUT, \
     Event, KV, MemStore, WatchLost, Watcher
+from .wire import LineJsonHandler
 
 
 def _kv_wire(kv: Optional[KV]):
@@ -73,20 +74,10 @@ _OPS = ("put", "put_many", "get", "get_prefix", "count_prefix", "delete",
         "keepalive", "revoke", "lease_ttl_remaining")
 
 
-class _Conn(socketserver.BaseRequestHandler):
+class _Conn(LineJsonHandler):
     def setup(self):
-        self.wlock = threading.Lock()
+        super().setup()
         self.watchers: Dict[int, Tuple[Watcher, threading.Thread]] = {}
-        self.alive = True
-        self.rfile = self.request.makefile("rb")
-
-    def _send(self, obj):
-        data = (json.dumps(obj, separators=(",", ":")) + "\n").encode()
-        with self.wlock:
-            try:
-                self.request.sendall(data)
-            except OSError:
-                self.alive = False
 
     def _pump(self, wid: int, w: Watcher):
         """Forward one watcher's events to the client until closed.  A
@@ -104,53 +95,44 @@ class _Conn(socketserver.BaseRequestHandler):
                 continue
             self._send({"w": wid, "ev": _ev_wire(ev)})
 
-    def handle(self):
+    def dispatch(self, rid, op, args):
         store: MemStore = self.server.store      # type: ignore[attr-defined]
-        while self.alive:
-            line = self.rfile.readline()
-            if not line:
-                return
-            try:
-                req = json.loads(line)
-            except json.JSONDecodeError:
-                return
-            rid, op, args = req.get("i"), req.get("o"), req.get("a", [])
-            try:
-                if op == "watch":
-                    prefix, start_rev = args[0], args[1]
-                    w = store.watch(prefix, start_rev=start_rev) \
-                        if start_rev else store.watch(prefix)
-                    wid = rid
-                    t = threading.Thread(target=self._pump, args=(wid, w),
-                                         daemon=True,
-                                         name=f"store-pump-{wid}")
-                    self.watchers[wid] = (w, t)
-                    t.start()
-                    self._send({"i": rid, "r": wid})
-                elif op == "unwatch":
-                    ent = self.watchers.pop(args[0], None)
-                    if ent:
-                        ent[0].close()
-                    self._send({"i": rid, "r": True})
-                elif op in _OPS:
-                    r = getattr(store, op)(*args)
-                    if op == "get":
-                        r = _kv_wire(r)
-                    elif op == "get_prefix":
-                        r = [_kv_wire(kv) for kv in r]
-                    self._send({"i": rid, "r": r})
-                else:
-                    self._send({"i": rid, "e": f"unknown op {op!r}",
-                                "k": "ValueError"})
-            except KeyError as e:
-                self._send({"i": rid, "e": str(e), "k": "KeyError"})
-            except CompactedError as e:
-                self._send({"i": rid, "e": str(e), "k": "CompactedError"})
-            except WatchLost as e:
-                self._send({"i": rid, "e": str(e), "k": "WatchLost"})
-            except Exception as e:  # noqa: BLE001 — report, keep serving
-                self._send({"i": rid, "e": f"{type(e).__name__}: {e}",
-                            "k": "RuntimeError"})
+        try:
+            if op == "watch":
+                prefix, start_rev = args[0], args[1]
+                w = store.watch(prefix, start_rev=start_rev) \
+                    if start_rev else store.watch(prefix)
+                wid = rid
+                t = threading.Thread(target=self._pump, args=(wid, w),
+                                     daemon=True,
+                                     name=f"store-pump-{wid}")
+                self.watchers[wid] = (w, t)
+                t.start()
+                self._send({"i": rid, "r": wid})
+            elif op == "unwatch":
+                ent = self.watchers.pop(args[0], None)
+                if ent:
+                    ent[0].close()
+                self._send({"i": rid, "r": True})
+            elif op in _OPS:
+                r = getattr(store, op)(*args)
+                if op == "get":
+                    r = _kv_wire(r)
+                elif op == "get_prefix":
+                    r = [_kv_wire(kv) for kv in r]
+                self._send({"i": rid, "r": r})
+            else:
+                self._send({"i": rid, "e": f"unknown op {op!r}",
+                            "k": "ValueError"})
+        except KeyError as e:
+            self._send({"i": rid, "e": str(e), "k": "KeyError"})
+        except CompactedError as e:
+            self._send({"i": rid, "e": str(e), "k": "CompactedError"})
+        except WatchLost as e:
+            self._send({"i": rid, "e": str(e), "k": "WatchLost"})
+        except Exception as e:  # noqa: BLE001 — report, keep serving
+            self._send({"i": rid, "e": f"{type(e).__name__}: {e}",
+                        "k": "RuntimeError"})
 
     def finish(self):
         self.alive = False
@@ -164,7 +146,7 @@ class StoreServer:
     port 0 picks a free port (see :attr:`port`)."""
 
     def __init__(self, store: Optional[MemStore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, token: str = ""):
         self.store = store or MemStore()
         self.store.start_sweeper()
 
@@ -173,6 +155,7 @@ class StoreServer:
             daemon_threads = True
         self._srv = _Server((host, port), _Conn)
         self._srv.store = self.store                 # type: ignore[attr-defined]
+        self._srv.token = token                      # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -246,10 +229,11 @@ class RemoteStore:
     completeness re-list, exactly like an etcd client)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 reconnect: bool = True):
+                 reconnect: bool = True, token: str = ""):
         self.host, self.port = host, port
         self._timeout = timeout
         self._reconnect = reconnect
+        self._token = token
         self._wlock = threading.Lock()
         self._next_id = 1
         self._id_lock = threading.Lock()
@@ -264,13 +248,19 @@ class RemoteStore:
     # -- plumbing ----------------------------------------------------------
 
     def _connect(self):
-        self._sock = socket.create_connection((self.host, self.port),
-                                              timeout=30)
-        self._sock.settimeout(None)
-        self._rfile = self._sock.makefile("rb")
-        threading.Thread(target=self._read_loop,
-                         args=(self._sock, self._rfile), daemon=True,
-                         name="remote-store-reader").start()
+        sock = socket.create_connection((self.host, self.port), timeout=30)
+        sock.settimeout(None)
+        rfile = sock.makefile("rb")
+        threading.Thread(target=self._read_loop, args=(sock, rfile),
+                         daemon=True, name="remote-store-reader").start()
+        if self._token:
+            # authenticate BEFORE publishing the socket: a concurrent
+            # _call sending ahead of the handshake would hit the server's
+            # first-frame-must-auth rule and get the fresh connection
+            # closed under us (reconnect churn on every heal)
+            self._call("auth", self._token, sock_override=sock)
+        self._sock = sock
+        self._rfile = rfile
 
     def _read_loop(self, sock, rfile):
         while not self._closed:
@@ -320,7 +310,12 @@ class RemoteStore:
             try:
                 self._connect()
                 break
-            except OSError:
+            except (OSError, RemoteStoreError) as e:
+                # RemoteStoreError here is an auth refusal on the fresh
+                # connection (server restarted with a new token?) — keep
+                # retrying with backoff rather than dying silently
+                if isinstance(e, RemoteStoreError):
+                    log.errorf("store reconnect refused: %s", e)
                 time.sleep(delay)
                 delay = min(2.0, delay * 2)
         if self._closed:
@@ -347,7 +342,8 @@ class RemoteStore:
         log.infof("store connection re-established (%s:%d)",
                   self.host, self.port)
 
-    def _call(self, op: str, *args, rid: Optional[int] = None):
+    def _call(self, op: str, *args, rid: Optional[int] = None,
+              sock_override=None):
         if self._closed:
             raise RemoteStoreError("store connection closed")
         if rid is None:
@@ -359,7 +355,7 @@ class RemoteStore:
         data = (json.dumps({"i": rid, "o": op, "a": list(args)},
                            separators=(",", ":")) + "\n").encode()
         try:
-            sock = self._sock
+            sock = sock_override or self._sock
             if sock is None:
                 raise RemoteStoreError("store disconnected")
             try:

@@ -1,0 +1,77 @@
+"""Shared line-JSON TCP plumbing for the two store servers.
+
+The coordination store (store/remote.py) and the result store
+(logsink/serve.py) speak the same transport: one JSON object per line,
+``{"i", "o", "a"}`` requests, ``{"i", "r"}`` / ``{"i", "e"}`` replies,
+and an optional first-frame shared-secret handshake.  This module holds
+the pieces that must never drift apart — framing, the auth gate, and
+the constant-time token comparison — so a protocol fix lands once.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import socketserver
+import threading
+
+
+def token_matches(presented, token: str) -> bool:
+    """Constant-time token comparison over UTF-8 bytes.
+    (``hmac.compare_digest`` on ``str`` raises TypeError for non-ASCII —
+    an operator picking a token with an umlaut must not crash the auth
+    path server-side.)"""
+    return hmac.compare_digest(
+        str(presented).encode("utf-8", "surrogatepass"),
+        token.encode("utf-8", "surrogatepass"))
+
+
+class LineJsonHandler(socketserver.BaseRequestHandler):
+    """Base connection handler: line framing, locked writes, and the
+    first-frame auth gate.  Subclasses implement ``dispatch(rid, op,
+    args)`` (and may extend ``setup``/``finish``).  The server object
+    must expose a ``token`` attribute ('' = open)."""
+
+    def setup(self):
+        self.wlock = threading.Lock()
+        self.alive = True
+        self.rfile = self.request.makefile("rb")
+        self.authed = not getattr(self.server, "token", "")
+
+    def _send(self, obj):
+        data = (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+        with self.wlock:
+            try:
+                self.request.sendall(data)
+            except OSError:
+                self.alive = False
+
+    def handle(self):
+        while self.alive:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                return
+            rid, op, args = req.get("i"), req.get("o"), req.get("a", [])
+            if not self.authed:
+                # first frame must authenticate; wrong token closes the
+                # connection (the reference passes store credentials via
+                # config, conf/conf.go:66-67, db/mgo.go:33-36)
+                if op == "auth" and len(args) == 1 and \
+                        token_matches(args[0], self.server.token):
+                    self.authed = True
+                    self._send({"i": rid, "r": True})
+                    continue
+                self._send({"i": rid, "e": "unauthenticated",
+                            "k": "RuntimeError"})
+                return
+            if op == "auth":                 # no-op when unsecured
+                self._send({"i": rid, "r": True})
+                continue
+            self.dispatch(rid, op, args)
+
+    def dispatch(self, rid, op, args):  # pragma: no cover - abstract
+        raise NotImplementedError

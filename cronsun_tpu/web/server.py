@@ -47,6 +47,11 @@ class HttpError(Exception):
         self.msg = msg
 
 
+class PlainText(str):
+    """Handler return type served as text/plain instead of JSON
+    (the /v1/metrics Prometheus exposition)."""
+
+
 class ApiServer:
     def __init__(self, store: MemStore, sink: JobLogStore,
                  ks: Optional[Keyspace] = None, security=None, alarm=None,
@@ -113,6 +118,9 @@ class ApiServer:
         route("DELETE", r"/v1/node/group/(?P<id>[^/]+)", self.group_delete)
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
+        # unauthenticated like /v1/version: Prometheus scrapers don't
+        # hold sessions, and the surface carries only operational gauges
+        route("GET", r"/v1/metrics", self.metrics, auth=False)
         return R
 
     # ---- handlers: auth --------------------------------------------------
@@ -432,6 +440,39 @@ class ApiServer:
             "alarm": bool(self.alarm),
         }
 
+    # ---- handlers: metrics ----------------------------------------------
+
+    def metrics(self, ctx):
+        """Prometheus text surface for the whole fleet: every component
+        publishes a leased JSON snapshot under /metrics/<component>/<id>
+        (SchedulerService.publish_metrics), so "is the planner keeping
+        up" is one scrape away from any web server — dead publishers'
+        snapshots expire with their lease."""
+        lines = ["# HELP cronsun_web_up this web server is serving",
+                 "# TYPE cronsun_web_up gauge",
+                 "cronsun_web_up 1"]
+        seen_types: set = set()
+        for kv in self.store.get_prefix(self.ks.metrics):
+            rest = kv.key[len(self.ks.metrics):].split("/", 1)
+            if len(rest) != 2:
+                continue
+            component, instance = rest
+            try:
+                snap = json.loads(kv.value)
+            except json.JSONDecodeError:
+                continue
+            inst = instance.replace('\\', r'\\').replace('"', r'\"')
+            for field, val in sorted(snap.items()):
+                if not isinstance(val, (int, float)):
+                    continue
+                name = f"cronsun_{component}_{field}"
+                if name not in seen_types:
+                    kind = "counter" if field.endswith("_total") else "gauge"
+                    lines.append(f"# TYPE {name} {kind}")
+                    seen_types.add(name)
+                lines.append(f'{name}{{instance="{inst}"}} {val}')
+        return PlainText("\n".join(lines) + "\n")
+
     # ---- plumbing --------------------------------------------------------
 
     def handle(self, method: str, path: str, query: dict, body: bytes,
@@ -478,10 +519,15 @@ class ApiServer:
                 if self.headers.get("Cookie"):
                     c = SimpleCookie(self.headers["Cookie"])
                     cookies = {k: v.value for k, v in c.items()}
+                ctype = "application/json"
                 try:
                     result, ctx = server.handle(method, parsed.path, query,
                                                 body, cookies)
-                    payload = json.dumps(result).encode()
+                    if isinstance(result, PlainText):
+                        payload = result.encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        payload = json.dumps(result).encode()
                     self.send_response(200)
                     for k, v in ctx.out_cookies.items():
                         self.send_header(
@@ -492,7 +538,7 @@ class ApiServer:
                 except Exception as e:  # noqa: BLE001
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(500)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -820,6 +820,21 @@ class Store {
 // connections
 // ---------------------------------------------------------------------------
 
+// shared secret clients must present as their first request; empty = open
+// (the reference passes etcd credentials via clientv3.Config,
+// conf/conf.go:66-67)
+static std::string g_token;
+
+// constant-time comparison: an attacker must not learn the token byte by
+// byte from response timing
+static bool token_eq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); i++)
+    acc |= (unsigned char)(a[i] ^ b[i]);
+  return acc == 0;
+}
+
 struct Conn : std::enable_shared_from_this<Conn> {
   int fd;
   Store* store;
@@ -827,6 +842,7 @@ struct Conn : std::enable_shared_from_this<Conn> {
   std::condition_variable ocv;
   std::deque<std::string> outbox;
   bool dead = false;
+  bool authed = true;   // set false at accept time when a token is required
   // a consumer this far behind has lost the stream anyway; cut it rather
   // than grow without bound (etcd cancels slow watchers the same way)
   static constexpr size_t kMaxOutbox = 1u << 20;
@@ -967,8 +983,22 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
   std::string res;
   std::string out = "{\"i\":";
   jint(out, rid);
+  if (!c->authed) {
+    if (op == "auth" && token_eq(arg_s(args, 0), g_token)) {
+      c->authed = true;
+      out += ",\"r\":true}\n";
+      c->enqueue(std::move(out));
+      return;
+    }
+    out += ",\"e\":\"unauthenticated\",\"k\":\"RuntimeError\"}\n";
+    c->enqueue(std::move(out));
+    c->kill();
+    return;
+  }
   try {
-    if (op == "put") {
+    if (op == "auth") {  // no-op when unsecured / already authed
+      res = "true";
+    } else if (op == "put") {
       jint(res, c->store->put(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)));
     } else if (op == "put_many") {
       JV empty;
@@ -1077,6 +1107,23 @@ int main(int argc, char** argv) {
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--wal") wal_path = next();
     else if (a == "--fsync-per-commit") fsync_per_commit = true;
+    else if (a == "--token") g_token = next();
+    else if (a == "--token-file") {
+      // keeps the secret out of /proc/<pid>/cmdline
+      FILE* tf = fopen(next(), "r");
+      if (!tf) { fprintf(stderr, "cannot read token file\n"); return 1; }
+      char tbuf[4096];
+      size_t tn = fread(tbuf, 1, sizeof tbuf, tf);
+      if (tn == sizeof tbuf) {
+        // silently truncating would yield a secret no client can match
+        fprintf(stderr, "token file exceeds %zu bytes\n", sizeof tbuf - 1);
+        fclose(tf);
+        return 1;
+      }
+      fclose(tf);
+      while (tn && (tbuf[tn - 1] == '\n' || tbuf[tn - 1] == '\r')) tn--;
+      g_token.assign(tbuf, tn);
+    }
     else if (a == "--die-with-parent") {
       // supervised mode (the Python wrapper passes this): if the
       // supervisor is SIGKILLed, the server must not linger orphaned
@@ -1087,7 +1134,7 @@ int main(int argc, char** argv) {
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
              "[--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
-             "[--die-with-parent]\n");
+             "[--token T | --token-file F] [--die-with-parent]\n");
       return 0;
     }
   }
@@ -1135,6 +1182,7 @@ int main(int argc, char** argv) {
     if (fd < 0) continue;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     auto c = std::make_shared<Conn>(fd, &store);
+    c->authed = g_token.empty();
     std::thread([c] { c->writer(); }).detach();
     std::thread([c] {
       reader(c);

@@ -266,10 +266,75 @@ def test_outstanding_orders_reserve_capacity(world):
     # an outstanding order written by a (dead) leader, no agent consuming
     store.put(KS.dispatch_key("node-0", 1_753_001_100, job.group, job.id),
               "{}")
+    sched.drain_watches()        # order reaches the watch-fed mirror
     sched.reconcile_capacity()
     import numpy as np
     col = sched.universe.index["node-0"]
     assert int(np.asarray(sched.planner.rem_cap[col])) == 1
+
+
+def test_steady_state_step_issues_o_delta_store_ops():
+    """With ~10k outstanding procs, steady-state step() must NOT re-list
+    the proc/dispatch/alone prefixes — the watch-fed mirrors carry the
+    state and only the periodic anti-entropy re-lists.  Pinned by
+    counting get_prefix calls across steps inside the anti-entropy
+    window."""
+    store = MemStore()
+    calls = []
+    orig = store.get_prefix
+
+    def counting_get_prefix(prefix):
+        calls.append(prefix)
+        return orig(prefix)
+    store.get_prefix = counting_get_prefix
+
+    clock_t = [1_753_002_000.0]
+    sched = SchedulerService(store, job_capacity=256, node_capacity=64,
+                             window_s=2, clock=lambda: clock_t[0])
+    job = Job(name="busy", command="echo b", kind=KIND_ALONE,
+              rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    put_job(store, job)
+    store.put(KS.node_key("node-0"), "1")
+    # ~10k outstanding proc keys land as one bulk write
+    store.put_many([(KS.proc_key(f"n{i % 50}", job.group, job.id, str(i)),
+                     "t") for i in range(10_000)])
+    sched.step(now=int(clock_t[0]))          # absorb deltas via watch
+    assert len(sched._procs) == 10_000       # mirror caught up
+    calls.clear()
+    for _ in range(5):                       # steady state, window intact
+        clock_t[0] += 2
+        sched.step(now=int(clock_t[0]))
+    mirror_prefixes = [p for p in calls
+                       if p.startswith((KS.proc, KS.dispatch, KS.lock))]
+    assert mirror_prefixes == [], \
+        f"steady-state step re-listed execution state: {mirror_prefixes}"
+    # anti-entropy still runs once its interval elapses
+    clock_t[0] += sched.mirror_resync_s + 1
+    sched.step(now=int(clock_t[0]))
+    assert any(p.startswith(KS.proc) for p in calls)
+    sched.stop()
+    store.close()
+
+
+def test_mirror_tracks_lease_expiry():
+    """A proc key expiring server-side (dead node) must leave the mirror
+    via its watch DELETE — capacity frees without any re-list."""
+    store = MemStore()
+    store.start_sweeper(0.05)
+    clock_t = [1_753_003_000.0]
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, clock=lambda: clock_t[0])
+    lease = store.grant(0.3)
+    store.put(KS.proc_key("nx", "g", "j", "1"), "t", lease=lease)
+    sched.drain_watches()
+    assert len(sched._procs) == 1
+    deadline = time.time() + 5
+    while sched._procs and time.time() < deadline:
+        time.sleep(0.05)
+        sched.drain_watches()
+    assert not sched._procs, "expired proc never left the mirror"
+    sched.stop()
+    store.close()
 
 
 def test_every_phase_survives_job_rewrite(world):

@@ -1,0 +1,189 @@
+"""Networked result store: RemoteJobLogStore against LogSinkServer must
+behave exactly like a local JobLogStore — the same conformance for the
+result store that test_remote_store.py gives the coordination store
+(reference: every node writes Mongo, the web server reads it,
+db/mgo.go:24-49, job_log.go:84-133)."""
+
+import threading
+import time
+
+import pytest
+
+from cronsun_tpu.logsink import (JobLogStore, LogRecord, LogSinkError,
+                                 LogSinkServer, RemoteJobLogStore)
+
+
+@pytest.fixture(params=["local", "remote"])
+def sink(request):
+    if request.param == "local":
+        s = JobLogStore()
+        yield s
+        s.close()
+    else:
+        srv = LogSinkServer().start()
+        c = RemoteJobLogStore(srv.host, srv.port)
+        yield c
+        c.close()
+        srv.stop()
+
+
+def _rec(job="j1", node="n1", ok=True, begin=1000.0, **kw):
+    d = dict(job_id=job, job_group="g", name=f"name-{job}", node=node,
+             user="", command="echo hi", output="out", success=ok,
+             begin_ts=begin, end_ts=begin + 2)
+    d.update(kw)
+    return LogRecord(**d)
+
+
+def test_create_assigns_id_and_roundtrips(sink):
+    r = _rec()
+    sink.create_job_log(r)
+    assert r.id is not None
+    got = sink.get_log(r.id)
+    assert got.job_id == "j1" and got.output == "out" and got.success
+    assert sink.get_log(10**9) is None
+
+
+def test_query_filters_and_paging(sink):
+    for i in range(5):
+        sink.create_job_log(_rec(job=f"j{i}", node=f"n{i % 2}",
+                                 ok=i % 2 == 0, begin=1000.0 + i))
+    recs, total = sink.query_logs()
+    assert total == 5 and len(recs) == 5
+    recs, total = sink.query_logs(node="n1")
+    assert total == 2 and all(r.node == "n1" for r in recs)
+    recs, total = sink.query_logs(failed_only=True)
+    assert total == 2
+    recs, total = sink.query_logs(job_ids=["j1", "j3"])
+    assert total == 2
+    recs, total = sink.query_logs(name_like="name-j4")
+    assert total == 1
+    recs, total = sink.query_logs(begin=1002.0, end=1004.0)
+    assert total == 2
+    recs, total = sink.query_logs(page=2, page_size=2)
+    assert total == 5 and len(recs) == 2
+    # latest view: one row per (job, node)
+    sink.create_job_log(_rec(job="j0", node="n0", ok=False, begin=2000.0))
+    recs, total = sink.query_logs(latest=True)
+    assert total == 5
+    j0 = [r for r in recs if r.job_id == "j0"][0]
+    assert not j0.success and j0.begin_ts == 2000.0
+
+
+def test_stats(sink):
+    sink.create_job_log(_rec(ok=True, begin=time.time()))
+    sink.create_job_log(_rec(ok=False, begin=time.time()))
+    o = sink.stat_overall()
+    assert o == {"total": 2, "successed": 1, "failed": 1}
+    days = sink.stat_days(7)
+    assert len(days) == 1 and days[0]["total"] == 2
+
+
+def test_node_mirror(sink):
+    sink.upsert_node("n1", '{"id": "n1", "pid": 7}', alived=True)
+    assert sink.get_node("n1")["alived"]
+    sink.set_node_alived("n1", False)
+    assert not sink.get_node("n1")["alived"]
+    assert sink.get_node("nope") is None
+    assert [n["id"] for n in sink.get_nodes()] == ["n1"]
+
+
+def test_accounts(sink):
+    sink.upsert_account("a@b.c", '{"email": "a@b.c", "role": 1}')
+    assert "role" in sink.get_account("a@b.c")
+    assert sink.get_account("x@y.z") is None
+    assert len(sink.list_accounts()) == 1
+    assert sink.delete_account("a@b.c") is True
+    assert sink.delete_account("a@b.c") is False
+
+
+def test_remote_concurrent_writers():
+    """Many threads writing through one client: the per-call lock must
+    serialize cleanly (no interleaved frames, no lost replies)."""
+    srv = LogSinkServer().start()
+    c = RemoteJobLogStore(srv.host, srv.port)
+    errs = []
+
+    def w(k):
+        try:
+            for i in range(20):
+                c.create_job_log(_rec(job=f"j{k}-{i}"))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+    ts = [threading.Thread(target=w, args=(k,)) for k in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    _, total = c.query_logs(page_size=1)
+    assert total == 160
+    c.close()
+    srv.stop()
+
+
+def test_remote_survives_server_restart_on_same_port():
+    """A dropped connection heals transparently: one reconnect+retry per
+    call (the agent's Mongo-hiccup tolerance, job_log.go:84)."""
+    srv = LogSinkServer().start()
+    port = srv.port
+    db = srv.sink
+    c = RemoteJobLogStore(srv.host, port)
+    c.create_job_log(_rec(job="before"))
+    srv._srv.shutdown()          # drop the listener, keep the sink
+    srv._srv.server_close()
+    srv2 = LogSinkServer(sink=db, port=port).start()
+    c.create_job_log(_rec(job="after"))
+    _, total = c.query_logs()
+    assert total == 2
+    c.close()
+    srv2.stop()
+
+
+def test_remote_auth():
+    """Wrong-token clients are refused before any op; right token works
+    (the reference carries Mongo credentials in config, db/mgo.go:33-36)."""
+    srv = LogSinkServer(token="hunter2").start()
+    with pytest.raises(LogSinkError):
+        RemoteJobLogStore(srv.host, srv.port, token="wrong")
+    bad = None
+    try:
+        bad = RemoteJobLogStore(srv.host, srv.port)      # tokenless
+        with pytest.raises(LogSinkError):
+            bad.get_nodes()
+    finally:
+        if bad:
+            bad.close()
+    good = RemoteJobLogStore(srv.host, srv.port, token="hunter2")
+    good.upsert_node("n1", '{"id": "n1"}', alived=True)
+    assert good.get_node("n1")["alived"]
+    good.close()
+    srv.stop()
+
+
+def test_remote_error_propagates_without_breaking_connection():
+    """A server-side exception surfaces as LogSinkError and the
+    connection keeps serving subsequent calls."""
+    srv = LogSinkServer().start()
+    c = RemoteJobLogStore(srv.host, srv.port)
+    with pytest.raises(LogSinkError):
+        c.query_logs(bogus_kwarg=1)
+    c.upsert_node("n1", '{"id": "n1"}', alived=True)   # still works
+    assert c.get_node("n1") is not None
+    c.close()
+    srv.stop()
+
+
+def test_remote_auth_non_ascii_token():
+    """A token with non-ASCII characters must authenticate (bytes-level
+    constant-time compare), not crash the server's auth path."""
+    srv = LogSinkServer(token="pässwörd").start()
+    good = RemoteJobLogStore(srv.host, srv.port, token="pässwörd")
+    good.upsert_node("n1", '{"id": "n1"}', alived=True)
+    assert good.get_node("n1")["alived"]
+    good.close()
+    with pytest.raises(LogSinkError):
+        RemoteJobLogStore(srv.host, srv.port, token="wrongö")
+    # server still healthy after the refusal
+    again = RemoteJobLogStore(srv.host, srv.port, token="pässwörd")
+    assert again.get_node("n1") is not None
+    again.close()
+    srv.stop()

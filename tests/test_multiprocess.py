@@ -3,8 +3,10 @@ as SEPARATE OS processes (the reference's N-machines-against-etcd
 topology, bin/node/server.go:23-70, bin/web/server.go:24-88).
 
 A job is created through the REST API, planned by the scheduler process,
-executed by both agent processes, and its results land in the shared
-log database — all plumbing crossing real process boundaries over TCP.
+executed by both agent processes, and its results land in the NETWORKED
+result store (cronsun-logd — the rebuild's Mongo) — all plumbing
+crossing real process boundaries over TCP, with no shared filesystem
+between any two processes.
 """
 
 import http.cookiejar
@@ -56,11 +58,14 @@ def test_full_system_multiprocess(tmp_path, store_backend):
         from cronsun_tpu.store.native import find_binary
         if find_binary() is None:
             pytest.skip("native store binary unavailable")
-    logdb = str(tmp_path / "logs.db")
+    # every process gets a DIFFERENT local log_db path; none may be
+    # touched — results flow only through the logd process (the
+    # reference's networked Mongo, db/mgo.go:24-49)
     conf = tmp_path / "conf.json"
     conf.write_text(json.dumps({
-        "log_db": logdb, "window_s": 2, "node_ttl": 5,
-        "job_capacity": 256, "node_capacity": 64, "proc_req": 0}))
+        "log_db": str(tmp_path / "local-UNUSED.db"), "window_s": 2,
+        "node_ttl": 5, "job_capacity": 256, "node_capacity": 64,
+        "proc_req": 0}))
 
     procs = []
     try:
@@ -70,16 +75,22 @@ def test_full_system_multiprocess(tmp_path, store_backend):
         store_p = _spawn("cronsun_tpu.bin.store", *store_args)
         procs.append(store_p)
         store_addr = _await_ready(store_p)
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
+                        "--db", str(tmp_path / "logd.db"))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
 
         sched_p = _spawn("cronsun_tpu.bin.sched", "--store", store_addr,
                          "--conf", str(conf))
         procs.append(sched_p)
         node_ps = [
             _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                   "--logsink", logd_addr,
                    "--conf", str(conf), "--node-id", f"mp-node-{i}")
             for i in range(2)]
         procs += node_ps
         web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--logsink", logd_addr,
                        "--conf", str(conf), "--port", "0")
         procs.append(web_p)
 
@@ -113,8 +124,10 @@ def test_full_system_multiprocess(tmp_path, store_backend):
         connected = {n["id"] for n in nodes if n.get("connected")}
         assert {"mp-node-0", "mp-node-1"} <= connected
 
-        # -- wait for cross-process executions to land --------------------
-        sink = JobLogStore(logdb)
+        # -- wait for cross-process executions to land in logd ------------
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(lh, int(lp))
         deadline = time.time() + 60
         seen = set()
         while time.time() < deadline:
@@ -129,12 +142,119 @@ def test_full_system_multiprocess(tmp_path, store_backend):
         assert all(l.success for l in logs)
         assert all("multiproc" in l.output for l in logs)
 
-        # REST view of the same results
+        # REST view of the same results — the web process reads them over
+        # the wire, no shared file with the agents
         with op.open(f"{base}/v1/logs", timeout=10) as r:
             api_logs = json.loads(r.read())
         assert api_logs["total"] >= 4
         sink.close()
+        # nothing fell back to the local SQLite path
+        assert not os.path.exists(str(tmp_path / "local-UNUSED.db")), \
+            "a process wrote the local log_db despite --logsink"
+
+        # the operator metrics surface sees the scheduler process's
+        # published snapshot (planner ticks are non-zero)
+        with op.open(f"{base}/v1/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        import re as _re
+        m = _re.search(r'cronsun_sched_steps_total\{[^}]*\} (\d+)', metrics)
+        assert m and int(m.group(1)) > 0, \
+            f"no planner ticks visible in /v1/metrics:\n{metrics}"
+        assert "cronsun_sched_tick_p99_ms" in metrics
     finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_node_crash_alert_across_processes(tmp_path):
+    """The noticer's crash detection depends on a SHARED node mirror
+    (reference noticer.go:172-200 checks Mongo's alived flag): with the
+    mirror in logd, a SIGKILLed agent in one process tree produces a
+    node-down alert from the web process in another — no shared
+    filesystem anywhere."""
+    import http.server
+    import threading
+
+    alerts = []
+
+    class Recv(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length",
+                                                        0)))
+            alerts.append(json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    recv = http.server.HTTPServer(("127.0.0.1", 0), Recv)
+    threading.Thread(target=recv.serve_forever, daemon=True).start()
+
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": str(tmp_path / "local-UNUSED.db"), "window_s": 2,
+        "node_ttl": 3, "proc_req": 0,
+        "mail": {"enable": True,
+                 "http_api": f"http://127.0.0.1:{recv.server_port}/"}}))
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
+                        "--db", str(tmp_path / "logd.db"))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+
+        node_p = _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                        "--logsink", logd_addr, "--conf", str(conf),
+                        "--node-id", "doomed-node")
+        procs.append(node_p)
+        web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--logsink", logd_addr, "--conf", str(conf),
+                       "--port", "0")
+        procs.append(web_p)
+        _await_ready(node_p)
+        _await_ready(web_p)
+
+        # agent registered: mirror (in logd) says alive
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(lh, int(lp))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            n = sink.get_node("doomed-node")
+            if n and n.get("alived"):
+                break
+            time.sleep(0.2)
+        assert sink.get_node("doomed-node")["alived"]
+
+        node_p.send_signal(signal.SIGKILL)        # crash, not clean stop
+        node_p.wait(timeout=10)
+
+        # lease (ttl+2) expires -> web's noticer alerts via HTTP API
+        deadline = time.time() + 30
+        while time.time() < deadline and not alerts:
+            time.sleep(0.5)
+        assert alerts, "no crash alert crossed the process boundary"
+        assert "doomed-node" in alerts[0]["subject"]
+        # delivered alert flips the shared mirror to dead
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                sink.get_node("doomed-node")["alived"]:
+            time.sleep(0.2)
+        assert not sink.get_node("doomed-node")["alived"]
+        sink.close()
+    finally:
+        recv.shutdown()
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)

@@ -296,6 +296,45 @@ def test_ui_editor_binds_all_rules():
     assert "JSON.stringify(a)" not in INDEX_HTML
 
 
+def test_metrics_endpoint(world):
+    """/v1/metrics renders every component's leased store snapshot as
+    Prometheus text, without auth (scrapers hold no session)."""
+    store, _, _, c = world
+    store.put(KS.metrics_key("sched", "scheduler-1"), json.dumps({
+        "tick_p99_ms": 12.5, "overflow_drops_total": 3,
+        "dispatch_queue_depth": 7, "watch_losses_total": 0,
+        "is_leader": 1}))
+    r = urllib.request.urlopen(c.base + "/v1/metrics")
+    assert r.headers["Content-Type"].startswith("text/plain")
+    text = r.read().decode()
+    assert "cronsun_web_up 1" in text
+    assert 'cronsun_sched_tick_p99_ms{instance="scheduler-1"} 12.5' in text
+    assert 'cronsun_sched_overflow_drops_total{instance="scheduler-1"} 3' \
+        in text
+    assert "# TYPE cronsun_sched_overflow_drops_total counter" in text
+    assert "# TYPE cronsun_sched_tick_p99_ms gauge" in text
+
+
+def test_scheduler_publishes_metrics_snapshot():
+    """SchedulerService.publish_metrics puts a leased snapshot the web
+    metrics surface picks up; the lease expires with a dead scheduler."""
+    from cronsun_tpu.sched import SchedulerService
+    from cronsun_tpu.store import MemStore
+    store = MemStore()
+    clock_t = [1_753_010_000.0]
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, clock=lambda: clock_t[0])
+    sched.step(now=int(clock_t[0]))
+    kv = store.get(KS.metrics_key("sched", "scheduler-1"))
+    assert kv is not None
+    snap = json.loads(kv.value)
+    assert snap["steps_total"] >= 1 and snap["is_leader"] == 1
+    assert "tick_p99_ms" in snap and "dispatch_queue_depth" in snap
+    assert kv.lease != 0, "metrics snapshot must be leased"
+    sched.stop()
+    store.close()
+
+
 def test_session_me_restores_identity(world):
     """GET /v1/session/me returns the logged-in identity (UI reload path)
     and 401s without a session."""
