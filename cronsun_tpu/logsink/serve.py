@@ -59,8 +59,25 @@ class _Conn(LineJsonHandler):
         sink: JobLogStore = self.server.sink      # type: ignore[attr-defined]
         try:
             if op == "create_job_log":
+                # idempotency: the client's transparent reconnect+retry
+                # must not double-insert a record whose first attempt
+                # committed but whose reply was lost — the dedupe token
+                # is remembered (bounded LRU) and replays return the
+                # original row id
+                idem = args[1] if len(args) > 1 else None
+                seen = self.server.idem            # type: ignore[attr-defined]
+                with self.server.idem_lock:        # type: ignore[attr-defined]
+                    prior = seen.get(idem) if idem else None
+                if prior is not None:
+                    self._send({"i": rid, "r": prior})
+                    return
                 rec = _rec_unwire(args[0])
                 sink.create_job_log(rec)
+                if idem:
+                    with self.server.idem_lock:    # type: ignore[attr-defined]
+                        seen[idem] = rec.id
+                        while len(seen) > 8192:
+                            seen.pop(next(iter(seen)))
                 self._send({"i": rid, "r": rec.id})
             elif op == "query_logs":
                 recs, total = sink.query_logs(**args[0])
@@ -92,6 +109,8 @@ class LogSinkServer:
         self._srv = _Server((host, port), _Conn)
         self._srv.sink = self.sink                # type: ignore[attr-defined]
         self._srv.token = token                   # type: ignore[attr-defined]
+        self._srv.idem = {}                       # type: ignore[attr-defined]
+        self._srv.idem_lock = threading.Lock()    # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -193,7 +212,10 @@ class RemoteJobLogStore:
     # -- surface (mirrors JobLogStore) -------------------------------------
 
     def create_job_log(self, rec: LogRecord):
-        rec.id = self._call("create_job_log", _rec_wire(rec))
+        import uuid
+        # one token per logical record, stable across the reconnect retry
+        rec.id = self._call("create_job_log", _rec_wire(rec),
+                            uuid.uuid4().hex)
 
     def query_logs(self, **kw) -> Tuple[List[LogRecord], int]:
         r = self._call("query_logs", kw)

@@ -38,6 +38,51 @@ from .executor import ExecResult, Executor
 VERSION = "v0.1.0-tpu"
 
 
+class _ExecTask:
+    __slots__ = ("fn", "finished")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.finished = threading.Event()
+
+    def done(self) -> bool:
+        return self.finished.is_set()
+
+
+class _ExecPool:
+    """Bounded pool of DAEMON worker threads.  The reference spawns a
+    goroutine per fire (cron.go:237-244); Python needs bounding under
+    dispatch bursts, and the workers must be daemons — process exit must
+    never block behind a long-running job command (stdlib
+    ThreadPoolExecutor joins its non-daemon workers at exit)."""
+
+    def __init__(self, workers: int, prefix: str):
+        import queue
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        for i in range(workers):
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"{prefix}-{i}").start()
+
+    def _worker(self):
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            try:
+                task.fn()
+            finally:
+                task.finished.set()
+
+    def submit(self, fn) -> _ExecTask:
+        task = _ExecTask(fn)
+        self._q.put(task)
+        return task
+
+    def shutdown(self, workers: int):
+        for _ in range(workers):
+            self._q.put(None)      # idle workers exit; busy ones are daemons
+
+
 class NodeAgent:
     def __init__(self, store: MemStore, sink: JobLogStore,
                  node_id: Optional[str] = None,
@@ -68,8 +113,23 @@ class NodeAgent:
         self._open_watches()
         self.groups: Dict[str, Group] = {}
         self._load_groups()
-        self.running: Dict[str, threading.Thread] = {}
+        self.running: Dict[str, object] = {}   # name -> Future
         self._bseen: Dict[tuple, float] = {}   # broadcast (job, sec) dedup
+        # executions run on a bounded pool: the reference spawns a
+        # goroutine per fire (cron.go:237-244) but an unbounded Python
+        # thread per order collapses under a dispatch burst — the pool
+        # queues instead (orders run late, never dropped, never early)
+        self.max_inflight = 64
+        self._pool = None
+        self._fence_mu = threading.Lock()
+        self._fence_lease_id: Optional[int] = None
+        self._fence_rotate_at = 0.0
+        # watch-invalidated job cache (the reference keeps every job in
+        # memory, maintained by watchJobs, node/node.go:121-141,361-391;
+        # here bounded and filled on demand so a 1M-job fleet doesn't
+        # cost each agent a gigabyte)
+        self._job_cache: Dict[tuple, Job] = {}
+        self._job_cache_cap = 65536
 
     def _open_watches(self):
         self._w_dispatch = self.store.watch(
@@ -77,6 +137,7 @@ class NodeAgent:
         self._w_broadcast = self.store.watch(self.ks.dispatch_all)
         self._w_groups = self.store.watch(self.ks.group)
         self._w_once = self.store.watch(self.ks.once)
+        self._w_jobs = self.store.watch(self.ks.cmd)
 
     # ---- registration (node/node.go:64-119) ------------------------------
 
@@ -201,6 +262,9 @@ class NodeAgent:
     # ---- job lookup ------------------------------------------------------
 
     def _get_job(self, group: str, job_id: str) -> Optional[Job]:
+        cached = self._job_cache.get((group, job_id))
+        if cached is not None:
+            return cached
         kv = self.store.get(self.ks.job_key(group, job_id))
         if kv is None:
             return None
@@ -209,7 +273,29 @@ class NodeAgent:
         except (json.JSONDecodeError, TypeError):
             return None
         job.group, job.id = group, job_id
+        if len(self._job_cache) >= self._job_cache_cap:
+            self._job_cache.clear()        # rare full reset beats LRU math
+        self._job_cache[(group, job_id)] = job
         return job
+
+    def _poll_jobs(self):
+        """Job watch feeds cache invalidation (drained BEFORE the
+        dispatch watch, so an order never runs against a staler view of
+        its job than the store had when the order arrived)."""
+        for ev in self._w_jobs.drain():
+            rest = ev.kv.key[len(self.ks.cmd):]
+            if "/" not in rest:
+                continue
+            key = tuple(rest.split("/", 1))
+            if ev.type == DELETE:
+                self._job_cache.pop(key, None)
+            elif key in self._job_cache:
+                try:
+                    job = Job.from_json(ev.kv.value)
+                    job.group, job.id = key
+                    self._job_cache[key] = job
+                except (json.JSONDecodeError, TypeError):
+                    self._job_cache.pop(key, None)
 
     # ---- execution -------------------------------------------------------
 
@@ -263,6 +349,13 @@ class NodeAgent:
         if not self._wait_until(epoch_s):
             return
         alone = None
+        order_done = [False]
+
+        def consume_order():
+            if order_key is not None and not order_done[0]:
+                order_done[0] = True
+                self.store.delete(order_key)
+
         try:
             if fenced and job.kind == KIND_ALONE:
                 # lifetime lock FIRST: a skip because the previous run is
@@ -271,11 +364,7 @@ class NodeAgent:
                 if alone is None:
                     return  # previous Alone run still live fleet-wide
             if fenced and job.exclusive:
-                lease = self.store.grant(self.lock_ttl)
-                if not self.store.put_if_absent(
-                        self.ks.lock_key(job.id, epoch_s), self.id,
-                        lease=lease):
-                    self.store.revoke(lease)
+                if not self._fence(job.id, epoch_s):
                     return  # another node already ran this (job, second)
             proc_key = self.ks.proc_key(self.id, job.group, job.id,
                                         f"{epoch_s}-{os.getpid()}")
@@ -299,8 +388,7 @@ class NodeAgent:
                     except KeyError:
                         # proc lease expired under us — repair + re-attach
                         self._repair_proc_lease_locked()
-                if order_key is not None:
-                    self.store.delete(order_key)
+                consume_order()
 
             if self.proc_req > 0:
                 timer = threading.Timer(self.proc_req, put_proc)
@@ -326,10 +414,38 @@ class NodeAgent:
                 lease, stop = alone
                 stop.set()
                 self.store.revoke(lease)   # deletes the alone lock key
-            if order_key is not None:      # consume the order regardless
-                self.store.delete(order_key)
+            consume_order()                # consume the order regardless
         self._record(job, res)
         self._update_avg_time(job, res)
+
+    _FENCE_GRACE = 60.0
+
+    def _fence(self, job_id: str, epoch_s: int) -> bool:
+        """(job, second) create-if-absent fence.  Fence keys ride a
+        SHARED periodically re-granted lease — the reference pools its
+        proc keys on one shared lease the same way (proc.go:60-123) —
+        instead of one grant+revoke round trip pair per execution.  A
+        batch's keys live between lock_ttl/2 + grace and lock_ttl +
+        grace, comfortably beyond the scheduler's max re-dispatch
+        horizon (max_catchup_s)."""
+        with self._fence_mu:
+            now = self.clock()
+            if self._fence_lease_id is None or now >= self._fence_rotate_at:
+                self._fence_lease_id = self.store.grant(
+                    self.lock_ttl + self._FENCE_GRACE)
+                self._fence_rotate_at = now + self.lock_ttl / 2
+            lease = self._fence_lease_id
+        key = self.ks.lock_key(job_id, epoch_s)
+        try:
+            return self.store.put_if_absent(key, self.id, lease=lease)
+        except KeyError:
+            # lease expired under us (suspended VM, clock jump): rotate
+            with self._fence_mu:
+                self._fence_lease_id = self.store.grant(
+                    self.lock_ttl + self._FENCE_GRACE)
+                self._fence_rotate_at = self.clock() + self.lock_ttl / 2
+                lease = self._fence_lease_id
+            return self.store.put_if_absent(key, self.id, lease=lease)
 
     def _update_avg_time(self, job: Job, res: ExecResult):
         """Close the cost loop: fold the measured runtime into the job's
@@ -339,6 +455,13 @@ class NodeAgent:
         if res.skipped:
             return
         dur = max(0.0, res.end_ts - res.begin_ts)
+        # skip uninformative updates: a runtime within 10% of the current
+        # EWMA would move the planner's cost estimate by nothing worth a
+        # get+CAS round trip pair per execution (high-rate short jobs
+        # converge after their first few runs)
+        if job.avg_time > 0 and \
+                abs(dur - job.avg_time) <= 0.1 * max(1.0, job.avg_time):
+            return
         key = self.ks.job_key(job.group, job.id)
         for _ in range(3):
             kv = self.store.get(key)
@@ -379,6 +502,7 @@ class NodeAgent:
         while True:
             try:
                 self._poll_groups()
+                self._poll_jobs()
                 n += self._poll_dispatch()
                 n += self._poll_broadcast()
                 n += self._poll_once()
@@ -400,7 +524,7 @@ class NodeAgent:
         stream delivered them and run-now has no fence; at-most-once is
         the safe reading."""
         for w in (self._w_dispatch, self._w_broadcast, self._w_groups,
-                  self._w_once):
+                  self._w_once, self._w_jobs):
             try:
                 w.close()
             except Exception:   # noqa: BLE001 — already-dead watchers
@@ -408,6 +532,7 @@ class NodeAgent:
         self._open_watches()
         self.groups.clear()
         self._load_groups()
+        self._job_cache.clear()    # invalidations inside the gap are lost
         n = 0
         for kv in self.store.get_prefix(self.ks.dispatch + self.id + "/"):
             n += self._handle_dispatch_kv(kv.key, order_key=kv.key)
@@ -495,6 +620,11 @@ class NodeAgent:
 
     _spawn_seq = 0
 
+    def _ensure_pool(self) -> _ExecPool:
+        if self._pool is None:
+            self._pool = _ExecPool(self.max_inflight, f"exec-{self.id}")
+        return self._pool
+
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
                use_gate: bool = True, order_key: Optional[str] = None):
         NodeAgent._spawn_seq += 1
@@ -507,17 +637,16 @@ class NodeAgent:
                 log.errorf("execution %s failed: %s", name, e)
             finally:
                 # self-prune: a long-running agent must not accumulate one
-                # dead Thread per execution
+                # finished task record per execution
                 self.running.pop(name, None)
 
-        t = threading.Thread(target=run, daemon=True, name=name)
-        self.running[name] = t
-        t.start()
+        self.running[name] = self._ensure_pool().submit(run)
 
     def join_running(self, timeout: float = 10.0):
+        deadline = time.monotonic() + timeout
         for name, t in list(self.running.items()):
-            t.join(timeout=timeout)
-            if not t.is_alive():
+            t.finished.wait(timeout=max(0.0, deadline - time.monotonic()))
+            if t.done():
                 self.running.pop(name, None)
 
     # ---- background loop -------------------------------------------------
@@ -565,6 +694,9 @@ class NodeAgent:
             t.join(timeout=3)
         self._threads.clear()
         self.join_running()
+        if self._pool is not None:
+            self._pool.shutdown(self.max_inflight)
+            self._pool = None
         self.unregister()
 
 

@@ -137,11 +137,11 @@ def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
     return outs, load, rem_cap                  # [W, 3, k_local]
 
 
-def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
-                         rem_cap, k_local: int, rounds: int, impl: str):
-    """Per-device body over the (jobs, nodes) mesh.  elig is the local
-    [J/Dj, W32/Dn] block; table/exclusive/cost are jobs-sharded
-    (replicated along nodes); load/rem_cap replicated.
+def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
+                  k_local: int, rounds: int, impl: str, bid_k, fanout):
+    """One second of the (jobs x nodes) mesh plan, per device — THE
+    single definition shared by the per-tick body and the fused windowed
+    scan (same no-drift contract as the 1-D _tick_local).
 
     Collectives per tick: one all_gather of the Common fan-out block
     along nodes (O(N)), and per bid round one (best, choice) exchange
@@ -156,16 +156,13 @@ def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
     hash: still fully deterministic for a fixed mesh shape (what failover
     replay needs — replicas run the same mesh), but a different shape can
     break ties differently."""
-    from ..ops.assign import _steps, bid_block_jnp
-    bid_k, fanout = _steps(impl)
+    from ..ops.assign import bid_block_jnp
     dj = jax.lax.axis_index(AXIS)
     dn = jax.lax.axis_index(NAXIS)
     j_local = elig.shape[0]
     n_local = elig.shape[1] * 32
     col0 = dn * n_local
 
-    f = [fields[i:i + 1] for i in range(7)]
-    fire = _fire_mask_jit(table, *f)[:, 0]
     idx, valid, total = _compact(fire, k_local)
     packed_k = elig[idx]
     excl_k = exclusive[idx]
@@ -218,6 +215,40 @@ def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
     total_row = jnp.zeros_like(idx).at[0].set(total)
     out = jnp.stack([idx_global, total_row, assigned], axis=0)
     return out, load, rem_cap
+
+
+def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
+                         rem_cap, k_local: int, rounds: int, impl: str):
+    """Per-tick body over the (jobs, nodes) mesh — fire mask + one
+    _tick2d_local."""
+    bid_k, fanout = _steps(impl)
+    f = [fields[i:i + 1] for i in range(7)]
+    fire = _fire_mask_jit(table, *f)[:, 0]
+    return _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
+                         k_local, rounds, impl, bid_k, fanout)
+
+
+def _sharded2d_window_body(table, fields_w, elig, exclusive, cost, load,
+                           rem_cap, k_local: int, rounds: int, impl: str):
+    """Fused windowed plan over the 2-D mesh: W seconds under one
+    lax.scan with all collectives inside — one dispatch per window (the
+    RTT-amortizing production cadence, same as the 1-D planner's fused
+    path).  Identical semantics to W sequential plans by construction:
+    both run _tick2d_local."""
+    bid_k, fanout = _steps(impl)
+    cols = [fields_w[:, i] for i in range(7)]
+    with jax.named_scope("cronsun.fire_mask"):
+        fire_w = _fire_mask_jit(table, *cols)          # [J/Dj, W]
+
+    def body(carry, fire_col):
+        load, rem_cap = carry
+        out, load, rem_cap = _tick2d_local(
+            fire_col, elig, exclusive, cost, load, rem_cap,
+            k_local, rounds, impl, bid_k, fanout)
+        return (load, rem_cap), out
+
+    (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
+    return outs, load, rem_cap                  # [W, 3, k_local]
 
 
 class _ShardedPlannerBase:
@@ -369,13 +400,38 @@ class _ShardedPlannerBase:
         o = np.asarray(out)              # [3, Dj*k_local]
         return self._decode(o, epoch_s, k_local)
 
-    def plan_window(self, epoch_s: int, window_s: int,
-                    sla_bucket=None):
-        """Window = sequential per-second plans (load/capacity carry in
-        self) — same TickPlan-list contract as TickPlanner.plan_window,
-        one dispatch per second.  ShardedTickPlanner overrides this with
-        the fused windowed scan."""
-        return [self.plan(epoch_s + w, sla_bucket=sla_bucket)
+    def _window_step(self, k_local: int, impl: str):
+        key = ("window", k_local, impl)
+        if key not in self._step_cache:
+            from jax import shard_map
+            sm = shard_map(
+                self._window_body(k_local, impl), mesh=self.mesh,
+                in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
+                          P(), P()),
+                out_specs=(P(None, None, AXIS), P(), P()),
+                check_vma=False)
+            self._step_cache[key] = jax.jit(sm)
+        return self._step_cache[key]
+
+    def plan_window(self, epoch_s: int, window_s: int, sla_bucket=None):
+        """Fused windowed scan over the mesh: W seconds, ONE dispatch
+        (the RTT-amortizing production cadence composed with multichip) —
+        semantics identical to W sequential plans, collectives inside the
+        scan."""
+        from ..ops.schedule_table import FRAMEWORK_EPOCH as FE
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        impl = self._resolve_impl(k_local)
+        f = window_fields(epoch_s, window_s, tz=self.tz)
+        fields_w = np.stack([
+            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+            np.arange(window_s, dtype=np.int64) + (epoch_s - FE),
+        ], axis=1).astype(np.int32)
+        outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
+            self.table, jax.device_put(fields_w, self._repl), self.elig,
+            self.exclusive, self.cost, self.load, self.rem_cap)
+        o = np.asarray(outs)             # [W, 3, Dj*k_local]
+        return [self._decode(o[w], epoch_s + w, k_local)
                 for w in range(window_s)]
 
 
@@ -395,40 +451,9 @@ class ShardedTickPlanner(_ShardedPlannerBase):
         return partial(_sharded_plan_body, k_local=k_local,
                        rounds=self.rounds, impl=impl)
 
-    def _window_step(self, k_local: int, impl: str):
-        key = ("window", k_local, impl)
-        if key not in self._step_cache:
-            from jax import shard_map
-            body = partial(_sharded_window_body, k_local=k_local,
-                           rounds=self.rounds, impl=impl)
-            sm = shard_map(
-                body, mesh=self.mesh,
-                in_specs=(P(AXIS), P(), P(AXIS, None), P(AXIS), P(AXIS),
-                          P(), P()),
-                out_specs=(P(None, None, AXIS), P(), P()),
-                check_vma=False)
-            self._step_cache[key] = jax.jit(sm)
-        return self._step_cache[key]
-
-    def plan_window(self, epoch_s: int, window_s: int, sla_bucket=None):
-        """Fused windowed scan over the jobs mesh: W seconds, ONE
-        dispatch (the production cadence composed with multichip) —
-        semantics identical to W sequential plans."""
-        from ..ops.schedule_table import FRAMEWORK_EPOCH as FE
-        k = sla_bucket or self.max_fire_bucket
-        k_local = max(256, _next_pow2(k) // self.Dj)
-        impl = self._resolve_impl(k_local)
-        f = window_fields(epoch_s, window_s, tz=self.tz)
-        fields_w = np.stack([
-            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
-            np.arange(window_s, dtype=np.int64) + (epoch_s - FE),
-        ], axis=1).astype(np.int32)
-        outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
-            self.table, jax.device_put(fields_w, self._repl), self.elig,
-            self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(outs)             # [W, 3, Dj*k_local]
-        return [self._decode(o[w], epoch_s + w, k_local)
-                for w in range(window_s)]
+    def _window_body(self, k_local: int, impl: str):
+        return partial(_sharded_window_body, k_local=k_local,
+                       rounds=self.rounds, impl=impl)
 
 
 class Sharded2DTickPlanner(_ShardedPlannerBase):
@@ -455,4 +480,8 @@ class Sharded2DTickPlanner(_ShardedPlannerBase):
 
     def _body(self, k_local: int, impl: str):
         return partial(_sharded2d_plan_body, k_local=k_local,
+                       rounds=self.rounds, impl=impl)
+
+    def _window_body(self, k_local: int, impl: str):
+        return partial(_sharded2d_window_body, k_local=k_local,
                        rounds=self.rounds, impl=impl)

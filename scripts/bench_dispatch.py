@@ -1,0 +1,221 @@
+"""End-to-end dispatch-plane benchmark.
+
+Measures the path the reference actually spends its time on (SURVEY §3.2:
+up to 3 etcd round trips + 4 Mongo writes per execution, job.go:404-470):
+
+    scheduler orders --put_many--> native store --watch--> REAL NodeAgent
+    processes --> (job,second) fence --> proc registry --> order consume
+    --> execution record into the networked result store (cronsun-logd)
+
+Everything is real except the fork/exec itself (a stub executor returns
+instantly — at 50k orders/s the measurement would otherwise be of
+/bin/echo).  Orders are offered at swept rates; for each rate the bench
+records the sustained consume rate and whether the plane kept up, then
+reports the saturation point.
+
+    python scripts/bench_dispatch.py [--rates 1000,10000,50000]
+        [--agents 4] [--seconds 4] [--json out.json]
+
+Run standalone or via bench.py (which merges the result into
+bench_detail.json as dispatch_plane_*).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------- worker
+
+def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
+    """A real NodeAgent process with an instant executor."""
+    from cronsun_tpu.logsink import RemoteJobLogStore
+    from cronsun_tpu.node.agent import NodeAgent
+    from cronsun_tpu.node.executor import ExecResult
+    from cronsun_tpu.store.remote import RemoteStore
+
+    class InstantExecutor:
+        def run_job(self, job_id, command, user, timeout, retry,
+                    interval, parallels):
+            now = time.time()
+            return ExecResult(success=True, output="bench", error="",
+                              begin_ts=now, end_ts=now, skipped=False)
+
+    h, _, p = store_addr.rpartition(":")
+    store = RemoteStore(h or "127.0.0.1", int(p))
+    lh, _, lp = logd_addr.rpartition(":")
+    sink = RemoteJobLogStore(lh or "127.0.0.1", int(lp))
+    # proc_req=5: the reference sample default — sub-5s runs never touch
+    # the proc registry (proc.go:218-236), exactly the short-job regime
+    # this bench sweeps
+    agent = NodeAgent(store, sink, node_id=node_id,
+                      executor=InstantExecutor(), proc_req=5.0)
+    agent.start()
+    print("READY", flush=True)
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# ---------------------------------------------------------------- driver
+
+def run_bench(rates, n_agents, seconds, on_log=print):
+    from cronsun_tpu.core import Keyspace
+    from cronsun_tpu.core.models import Job, JobRule
+    from cronsun_tpu.logsink import LogSinkServer, RemoteJobLogStore
+    from cronsun_tpu.store.native import NativeStoreServer, find_binary
+    from cronsun_tpu.store.remote import RemoteStore, StoreServer
+
+    ks = Keyspace()
+    binary = find_binary()
+    if binary:
+        store_srv = NativeStoreServer(binary=binary)
+        backend = "native"
+    else:
+        store_srv = StoreServer().start()
+        backend = "py"
+    logd = LogSinkServer().start()
+    store = RemoteStore(store_srv.host, store_srv.port)
+    sink = RemoteJobLogStore(logd.host, logd.port)
+
+    agents = []
+    node_ids = [f"bench-agent-{i}" for i in range(n_agents)]
+    here = os.path.abspath(__file__)
+    for nid in node_ids:
+        p = subprocess.Popen(
+            [sys.executable, here, "--worker",
+             f"{store_srv.host}:{store_srv.port}",
+             f"{logd.host}:{logd.port}", nid],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        agents.append(p)
+    for p in agents:
+        line = p.stdout.readline()
+        assert "READY" in line, f"agent failed: {line}"
+
+    results = {"dispatch_plane_backend": backend,
+               "dispatch_plane_agents": n_agents,
+               # the whole plane (store server, logd, driver, agents)
+               # shares this host's cores; on 1 core the figure measures
+               # per-order CPU cost, not fleet scale-out (real agents
+               # are distributed across machines)
+               "dispatch_plane_cpu_cores": os.cpu_count()}
+    try:
+        # one exclusive job per order slot at the highest rate; the agent
+        # path then pays the real per-order costs: job fetch, fence
+        # grant+put_if_absent, proc put/delete, order consume, avg_time
+        # CAS, and the 4-write log record over the logd wire
+        max_rate = max(rates)
+        on_log(f"seeding {max_rate} jobs ({backend} store)")
+        items = []
+        for i in range(max_rate):
+            j = Job(id=f"bj{i}", name=f"bench-{i}", group="bench",
+                    command="true", kind=2,
+                    rules=[JobRule(id="r", timer="* * * * * *",
+                                   nids=[node_ids[i % n_agents]])])
+            items.append((ks.job_key("bench", j.id), j.to_json()))
+            if len(items) >= 10_000:
+                store.put_many(items); items = []
+        if items:
+            store.put_many(items)
+
+        delivered_before = 0
+        per_rate = []
+        for rate in rates:
+            on_log(f"rate {rate}/s x {seconds}s ...")
+            lease = store.grant(300.0)
+            t_start = time.time()
+            epoch0 = int(t_start) - 2      # past epochs run immediately
+            for e in range(seconds):
+                orders = []
+                for i in range(rate):
+                    nid = node_ids[i % n_agents]
+                    orders.append((
+                        ks.dispatch_key(nid, epoch0 + e, "bench", f"bj{i}"),
+                        '{"rule":"r","kind":2}'))
+                # pace the offer: one window write per second, like the
+                # scheduler's one-bulk-write-per-window cadence
+                for c in range(0, len(orders), 20_000):
+                    store.put_many(orders[c:c + 20_000], lease=lease)
+                sleep_left = (t_start + e + 1) - time.time()
+                if sleep_left > 0:
+                    time.sleep(sleep_left)
+            offered = rate * seconds
+            deadline = time.time() + max(30, seconds * 6)
+            done = delivered_before
+            while time.time() < deadline:
+                done = sink.stat_overall()["total"]
+                if done - delivered_before >= offered:
+                    break
+                time.sleep(0.2)
+            elapsed = time.time() - t_start
+            got = done - delivered_before
+            delivered_before = done
+            consume_rate = got / elapsed
+            per_rate.append({"offered_per_s": rate, "consumed": got,
+                             "offered": offered,
+                             "consume_rate_per_s": round(consume_rate, 1),
+                             "kept_up": got >= offered * 0.95
+                             and elapsed <= seconds * 1.5})
+            on_log(f"  consumed {got}/{offered} in {elapsed:.1f}s "
+                   f"-> {consume_rate:.0f}/s")
+            # drain any stragglers before the next rate
+            time.sleep(1.0)
+            delivered_before = sink.stat_overall()["total"]
+
+        sustained = max(r["consume_rate_per_s"] for r in per_rate)
+        kept = [r["offered_per_s"] for r in per_rate if r["kept_up"]]
+        saturation = max(kept) if kept else 0
+        results.update({
+            "dispatch_plane_sweep": per_rate,
+            "dispatch_plane_orders_per_sec": round(sustained, 1),
+            "dispatch_plane_saturation_offered_per_sec": saturation,
+        })
+    finally:
+        for p in agents:
+            p.terminate()
+        for p in agents:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        store.close()
+        sink.close()
+        logd.stop()
+        store_srv.stop()
+    return results
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        return worker_main(sys.argv[2], sys.argv[3], sys.argv[4])
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", default="1000,10000,50000")
+    ap.add_argument("--agents", type=int, default=0,
+                    help="0 = auto: one per core beyond the shared "
+                         "store/driver core, at least 1, at most 4")
+    ap.add_argument("--seconds", type=int, default=4)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    if args.agents <= 0:
+        args.agents = max(1, min(4, (os.cpu_count() or 1) - 1))
+    rates = [int(r) for r in args.rates.split(",")]
+    res = run_bench(rates, args.agents, args.seconds,
+                    on_log=lambda *a: print(*a, file=sys.stderr, flush=True))
+    out = json.dumps(res, indent=1)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,3 +173,41 @@ def test_duplicate_on_reregister_is_fatal():
     assert fatal, "agent did not report fatal identity loss"
     assert agent._stop.is_set()
     store.close()
+
+
+def test_order_consumed_on_fence_lost_skip():
+    """An execution skipped because another node won the (job, second)
+    fence must still consume its dispatch order key — a leaked order
+    wrongly reserves scheduler capacity for the whole dispatch lease."""
+    store = MemStore()
+    sink = JobLogStore()
+    agent = NodeAgent(store, sink, node_id="na", clock=lambda: 2_000_000.0)
+    job = Job(id="fj", name="f", group="g", command="echo x", kind=2,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["na"])])
+    store.put(KS.job_key("g", "fj"), job.to_json())
+    epoch = 1_999_999
+    # another node already holds the fence
+    store.put(KS.lock_key("fj", epoch), "other-node")
+    order_key = KS.dispatch_key("na", epoch, "g", "fj")
+    store.put(order_key, '{"rule":"r","kind":2}')
+    job2 = agent._get_job("g", "fj")
+    agent._execute(job2, epoch, fenced=True, order_key=order_key)
+    assert store.get(order_key) is None, \
+        "skipped execution leaked its dispatch order"
+    _, total = sink.query_logs()
+    assert total == 0                      # and really did not run
+    store.close()
+
+
+def test_exec_pool_workers_are_daemons():
+    """Execution workers must be daemon threads: process exit must never
+    block behind a long-running job command."""
+    import threading as _t
+    store = MemStore()
+    agent = NodeAgent(store, JobLogStore(), node_id="nd")
+    agent._ensure_pool()
+    workers = [t for t in _t.enumerate()
+               if t.name.startswith("exec-nd")]
+    assert workers, "pool spawned no workers"
+    assert all(t.daemon for t in workers)
+    store.close()

@@ -187,3 +187,25 @@ def test_remote_auth_non_ascii_token():
     assert again.get_node("n1") is not None
     again.close()
     srv.stop()
+
+
+def test_create_job_log_idempotent_on_retry():
+    """A retried create (same idempotency token — what the client's
+    transparent reconnect replays) must not double-insert; the replay
+    returns the original row id."""
+    srv = LogSinkServer().start()
+    c = RemoteJobLogStore(srv.host, srv.port)
+    wire = {"job_id": "j", "job_group": "g", "name": "n", "node": "nd",
+            "user": "", "command": "t", "output": "o", "success": True,
+            "begin_ts": 1000.0, "end_ts": 1001.0, "id": None}
+    rid1 = c._call("create_job_log", wire, "tok-1")
+    rid2 = c._call("create_job_log", wire, "tok-1")     # the retry
+    assert rid1 == rid2
+    _, total = c.query_logs()
+    assert total == 1, "retry double-inserted the record"
+    rid3 = c._call("create_job_log", wire, "tok-2")     # a NEW record
+    assert rid3 != rid1
+    _, total = c.query_logs()
+    assert total == 2
+    c.close()
+    srv.stop()
