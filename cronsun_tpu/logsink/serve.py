@@ -59,26 +59,10 @@ class _Conn(LineJsonHandler):
         sink: JobLogStore = self.server.sink      # type: ignore[attr-defined]
         try:
             if op == "create_job_log":
-                # idempotency: the client's transparent reconnect+retry
-                # must not double-insert a record whose first attempt
-                # committed but whose reply was lost — the dedupe token
-                # is remembered (bounded LRU) and replays return the
-                # original row id
-                idem = args[1] if len(args) > 1 else None
-                seen = self.server.idem            # type: ignore[attr-defined]
-                with self.server.idem_lock:        # type: ignore[attr-defined]
-                    prior = seen.get(idem) if idem else None
-                if prior is not None:
-                    self._send({"i": rid, "r": prior})
-                    return
-                rec = _rec_unwire(args[0])
-                sink.create_job_log(rec)
-                if idem:
-                    with self.server.idem_lock:    # type: ignore[attr-defined]
-                        seen[idem] = rec.id
-                        while len(seen) > 8192:
-                            seen.pop(next(iter(seen)))
-                self._send({"i": rid, "r": rec.id})
+                self._send({"i": rid,
+                            "r": self._create(sink, args[0],
+                                              args[1] if len(args) > 1
+                                              else None)})
             elif op == "query_logs":
                 recs, total = sink.query_logs(**args[0])
                 self._send({"i": rid, "r": {
@@ -93,6 +77,56 @@ class _Conn(LineJsonHandler):
                 self._send({"i": rid, "e": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 — report, keep serving
             self._send({"i": rid, "e": f"{type(e).__name__}: {e}"})
+
+    def _create(self, sink: JobLogStore, wire, idem):
+        """Idempotent insert: the client's transparent reconnect+retry
+        must not double-insert a record whose first attempt committed (or
+        is still committing) when the reply was lost.  The token is
+        RESERVED before the insert — a concurrent retry of the same token
+        latches onto the original attempt instead of racing it — and
+        replays return the original row id."""
+        if not idem:
+            rec = _rec_unwire(wire)
+            sink.create_job_log(rec)
+            return rec.id
+        seen = self.server.idem                   # type: ignore[attr-defined]
+        lock = self.server.idem_lock              # type: ignore[attr-defined]
+        with lock:
+            ent = seen.get(idem)
+            if ent is None:
+                ent = {"done": threading.Event(), "id": None}
+                seen[idem] = ent
+                # bounded LRU: evict oldest COMPLETED entries
+                if len(seen) > 8192:
+                    for k in list(seen):
+                        if len(seen) <= 8192:
+                            break
+                        if k != idem and seen[k]["done"].is_set():
+                            seen.pop(k)
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            ent["done"].wait(timeout=30)
+            if ent["id"] is not None:
+                return ent["id"]
+            # the original attempt failed (it withdrew its reservation)
+            # or is pathologically slow: re-race the reservation
+            with lock:
+                if seen.get(idem) is ent:
+                    seen.pop(idem)
+            return self._create(sink, wire, idem)
+        rec = _rec_unwire(wire)
+        try:
+            sink.create_job_log(rec)
+        except Exception:
+            with lock:
+                seen.pop(idem, None)
+            ent["done"].set()
+            raise
+        ent["id"] = rec.id
+        ent["done"].set()
+        return rec.id
 
 
 class LogSinkServer:

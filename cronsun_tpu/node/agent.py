@@ -48,6 +48,12 @@ class _ExecTask:
     def done(self) -> bool:
         return self.finished.is_set()
 
+    def run(self):
+        try:
+            self.fn()
+        finally:
+            self.finished.set()
+
 
 class _ExecPool:
     """Bounded pool of DAEMON worker threads.  The reference spawns a
@@ -68,15 +74,10 @@ class _ExecPool:
             task = self._q.get()
             if task is None:
                 return
-            try:
-                task.fn()
-            finally:
-                task.finished.set()
+            task.run()
 
-    def submit(self, fn) -> _ExecTask:
-        task = _ExecTask(fn)
+    def enqueue(self, task: _ExecTask):
         self._q.put(task)
-        return task
 
     def shutdown(self, workers: int):
         for _ in range(workers):
@@ -613,8 +614,10 @@ class NodeAgent:
             if job is None:
                 continue
             # run-now bypasses locks and the parallels gate
-            # (reference job.go:472-482)
-            self._spawn(job, int(self.clock()), fenced=False, use_gate=False)
+            # (reference job.go:472-482) — and the exec pool: it must
+            # start immediately even with a full order backlog
+            self._spawn(job, int(self.clock()), fenced=False,
+                        use_gate=False, immediate=True)
             n += 1
         return n
 
@@ -626,7 +629,8 @@ class NodeAgent:
         return self._pool
 
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
-               use_gate: bool = True, order_key: Optional[str] = None):
+               use_gate: bool = True, order_key: Optional[str] = None,
+               immediate: bool = False):
         NodeAgent._spawn_seq += 1
         name = f"exec-{job.id}-{epoch_s}-{NodeAgent._spawn_seq}"
 
@@ -640,7 +644,28 @@ class NodeAgent:
                 # finished task record per execution
                 self.running.pop(name, None)
 
-        self.running[name] = self._ensure_pool().submit(run)
+        task = _ExecTask(run)
+        self.running[name] = task
+        if immediate:
+            # run-now bypasses the pool entirely: a backlog of queued or
+            # long-running work must not delay an operator's trigger
+            # (reference go job.RunWithRecovery(), node/node.go:423-442)
+            t = threading.Thread(target=task.run, daemon=True, name=name)
+            t.start()
+            return
+        delay = epoch_s - self.clock()
+        if delay <= 0.02:
+            self._ensure_pool().enqueue(task)
+        else:
+            # future-epoch orders (the scheduler publishes whole windows
+            # ahead of wall-clock) must not occupy pool workers sleeping
+            # in _wait_until — they'd starve due work behind them; stage
+            # on a timer and enter the queue when due
+            timer = threading.Timer(
+                delay, lambda: self._ensure_pool().enqueue(task))
+            timer.daemon = True
+            timer.start()
+
 
     def join_running(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout

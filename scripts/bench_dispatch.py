@@ -86,6 +86,7 @@ def run_bench(rates, n_agents, seconds, on_log=print):
     store = RemoteStore(store_srv.host, store_srv.port)
     sink = RemoteJobLogStore(logd.host, logd.port)
 
+    import threading
     agents = []
     node_ids = [f"bench-agent-{i}" for i in range(n_agents)]
     here = os.path.abspath(__file__)
@@ -97,8 +98,16 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         agents.append(p)
     for p in agents:
-        line = p.stdout.readline()
-        assert "READY" in line, f"agent failed: {line}"
+        # log warnings may precede READY; read until it appears
+        for _ in range(200):
+            line = p.stdout.readline()
+            if not line or "READY" in line:
+                break
+        assert line and "READY" in line, f"agent failed: {line!r}"
+        # keep draining forever: an undrained 64KB pipe would block the
+        # agent mid-warning and wedge the plane being measured
+        threading.Thread(target=lambda f=p.stdout: [None for _ in f],
+                         daemon=True).start()
 
     results = {"dispatch_plane_backend": backend,
                "dispatch_plane_agents": n_agents,

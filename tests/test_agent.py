@@ -11,6 +11,7 @@ import time
 from cronsun_tpu.core import Job, JobRule, Keyspace, KIND_COMMON
 from cronsun_tpu.logsink import JobLogStore
 from cronsun_tpu.node.agent import NodeAgent
+from cronsun_tpu.node.executor import ExecResult
 from cronsun_tpu.store import MemStore
 
 KS = Keyspace()
@@ -210,4 +211,75 @@ def test_exec_pool_workers_are_daemons():
                if t.name.startswith("exec-nd")]
     assert workers, "pool spawned no workers"
     assert all(t.daemon for t in workers)
+    store.close()
+
+
+def test_run_now_not_starved_by_saturated_pool():
+    """A run-now trigger must start immediately even when every pool
+    worker is occupied by long-running executions."""
+    import threading as _t
+    store = MemStore()
+    sink = JobLogStore()
+
+    release = _t.Event()
+    calls = []
+
+    class Blocking:
+        def run_job(self, **kw):
+            calls.append(1)
+            if len(calls) <= 2:           # only the pool-saturating runs
+                release.wait(10)
+            now = time.time()
+            return ExecResult(success=True, output="x",
+                              begin_ts=now, end_ts=now)
+
+    agent = NodeAgent(store, sink, node_id="nb", executor=Blocking())
+    agent.max_inflight = 2
+    job = Job(id="bk", name="b", group="g", command="echo x", kind=0,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["nb"])])
+    store.put(KS.job_key("g", "bk"), job.to_json())
+    j = agent._get_job("g", "bk")
+    now = int(time.time())
+    # saturate both workers
+    agent._spawn(j, now, fenced=False)
+    agent._spawn(j, now, fenced=False)
+    time.sleep(0.3)
+    # run-now bypasses the pool
+    agent._spawn(j, now, fenced=False, use_gate=False, immediate=True)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        _, total = sink.query_logs()
+        if total >= 1:
+            break
+        time.sleep(0.05)
+    _, total = sink.query_logs()
+    assert total >= 1, "run-now starved behind pool backlog"
+    release.set()
+    agent.join_running()
+    store.close()
+
+
+def test_future_orders_do_not_occupy_workers():
+    """Orders for future epochs (the scheduler publishes whole windows
+    ahead) stage on timers; a due order queued after them must not wait
+    behind sleepers."""
+    store = MemStore()
+    sink = JobLogStore()
+    agent = NodeAgent(store, sink, node_id="nf")
+    agent.max_inflight = 1                 # a single worker
+    job = Job(id="fut", name="f", group="g", command="echo x", kind=0,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["nf"])])
+    store.put(KS.job_key("g", "fut"), job.to_json())
+    j = agent._get_job("g", "fut")
+    now = int(time.time())
+    agent._spawn(j, now + 4, fenced=False)   # future: staged, not queued
+    agent._spawn(j, now, fenced=False)       # due now
+    deadline = time.time() + 3
+    while time.time() < deadline:
+        _, total = sink.query_logs()
+        if total >= 1:
+            break
+        time.sleep(0.05)
+    _, total = sink.query_logs()
+    assert total >= 1, "due order starved behind a staged future order"
     store.close()

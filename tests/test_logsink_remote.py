@@ -209,3 +209,26 @@ def test_create_job_log_idempotent_on_retry():
     assert total == 2
     c.close()
     srv.stop()
+
+
+def test_create_idempotency_concurrent_retry_race():
+    """A retry racing its own original (timeout + reconnect while the
+    first attempt is still committing) must latch onto the reservation,
+    not double-insert."""
+    import threading as _t
+    srv = LogSinkServer().start()
+    cs = [RemoteJobLogStore(srv.host, srv.port) for _ in range(4)]
+    wire = {"job_id": "j", "job_group": "g", "name": "n", "node": "nd",
+            "user": "", "command": "t", "output": "o", "success": True,
+            "begin_ts": 1000.0, "end_ts": 1001.0, "id": None}
+    ids = []
+    def call(c):
+        ids.append(c._call("create_job_log", wire, "race-tok"))
+    ts = [_t.Thread(target=call, args=(c,)) for c in cs]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert len(set(ids)) == 1, f"concurrent same-token creates: {ids}"
+    _, total = cs[0].query_logs()
+    assert total == 1
+    [c.close() for c in cs]
+    srv.stop()

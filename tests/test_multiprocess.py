@@ -265,6 +265,89 @@ def test_node_crash_alert_across_processes(tmp_path):
                 p.kill()
 
 
+def test_secured_fleet_end_to_end(tmp_path):
+    """A token-secured deployment: native store and logd both require
+    their shared secrets; correctly-configured processes execute a job
+    end to end while tokenless/wrong-token clients are refused."""
+    from cronsun_tpu.store.native import find_binary
+    if find_binary() is None:
+        pytest.skip("native store binary unavailable")
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": str(tmp_path / "local-UNUSED.db"), "window_s": 2,
+        "node_ttl": 5, "proc_req": 0,
+        "store_token": "st-secret", "log_token": "lg-secret"}))
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--native", "--port", "0",
+                         "--token", "st-secret")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
+                        "--db", str(tmp_path / "logd.db"),
+                        "--token", "lg-secret")
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+
+        # wrong/missing tokens are refused before any op
+        from cronsun_tpu.logsink import LogSinkError, RemoteJobLogStore
+        from cronsun_tpu.store.remote import RemoteStore, RemoteStoreError
+        sh, _, sp = store_addr.rpartition(":")
+        bad = RemoteStore(sh, int(sp), reconnect=False)
+        with pytest.raises(RemoteStoreError):
+            bad.put("/x", "1")
+        bad.close()
+        lh, _, lp = logd_addr.rpartition(":")
+        with pytest.raises(LogSinkError):
+            RemoteJobLogStore(lh, int(lp), token="wrong")
+
+        sched_p = _spawn("cronsun_tpu.bin.sched", "--store", store_addr,
+                         "--conf", str(conf))
+        node_p = _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                        "--logsink", logd_addr, "--conf", str(conf),
+                        "--node-id", "sec-node")
+        web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--logsink", logd_addr, "--conf", str(conf),
+                       "--port", "0")
+        procs += [sched_p, node_p, web_p]
+        _await_ready(sched_p)
+        _await_ready(node_p)
+        web_addr = _await_ready(web_p)
+
+        cj = http.cookiejar.CookieJar()
+        op = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(cj))
+        base = f"http://{web_addr}"
+        q = urllib.parse.urlencode(
+            {"email": "admin@admin.com", "password": "admin"})
+        op.open(f"{base}/v1/session?{q}", timeout=10)
+        job = {"name": "sec", "command": "echo secured", "kind": 0,
+               "rules": [{"timer": "* * * * * *", "nids": ["sec-node"]}]}
+        req = urllib.request.Request(
+            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        op.open(req, timeout=10)
+
+        sink = RemoteJobLogStore(lh, int(lp), token="lg-secret")
+        deadline = time.time() + 45
+        total = 0
+        while time.time() < deadline and total < 2:
+            _, total = sink.query_logs()
+            time.sleep(0.5)
+        assert total >= 2, "secured fleet executed nothing"
+        sink.close()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def test_store_crash_restart_fleet_heals(tmp_path):
     """The deployment resilience story: the native store (with WAL) is
     killed -9 mid-flight and restarted on the same port; every client
