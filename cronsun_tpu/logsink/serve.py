@@ -85,8 +85,10 @@ class _Conn(LineJsonHandler):
         RESERVED before the insert — a concurrent retry of the same token
         latches onto the original attempt instead of racing it — and
         replays return the original row id."""
+        # parse BEFORE reserving: a bad wire dict must raise without
+        # leaking a never-completed reservation
+        rec = _rec_unwire(wire)
         if not idem:
-            rec = _rec_unwire(wire)
             sink.create_job_log(rec)
             return rec.id
         seen = self.server.idem                   # type: ignore[attr-defined]
@@ -116,7 +118,6 @@ class _Conn(LineJsonHandler):
                 if seen.get(idem) is ent:
                     seen.pop(idem)
             return self._create(sink, wire, idem)
-        rec = _rec_unwire(wire)
         try:
             sink.create_job_log(rec)
         except Exception:

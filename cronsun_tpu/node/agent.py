@@ -122,6 +122,7 @@ class NodeAgent:
         # queues instead (orders run late, never dropped, never early)
         self.max_inflight = 64
         self._pool = None
+        self._staged: Dict[str, threading.Timer] = {}
         self._fence_mu = threading.Lock()
         self._fence_lease_id: Optional[int] = None
         self._fence_rotate_at = 0.0
@@ -131,6 +132,13 @@ class NodeAgent:
         # cost each agent a gigabyte)
         self._job_cache: Dict[tuple, Job] = {}
         self._job_cache_cap = 65536
+        # operator metrics (rendered fleet-wide at /v1/metrics via the
+        # scheduler-style leased store snapshot)
+        self.stats = {"orders_consumed_total": 0, "execs_total": 0,
+                      "execs_failed_total": 0, "watch_losses_total": 0}
+        self.metrics_interval_s = 10.0
+        self._metrics_at = 0.0
+        self._metrics_lease: Optional[int] = None
 
     def _open_watches(self):
         self._w_dispatch = self.store.watch(
@@ -213,7 +221,30 @@ class NodeAgent:
             self.register()     # reference re-registers after a lapse
         else:
             self._ensure_proc_lease()
+        if self.clock() >= self._metrics_at:
+            self.publish_metrics()
         return ok
+
+    def metrics_snapshot(self) -> dict:
+        return {**self.stats, "running": len(self.running),
+                "procs_registered": len(self._procs)}
+
+    def publish_metrics(self):
+        """Leased per-agent snapshot; same surface contract as the
+        scheduler's (web renders all components at /v1/metrics)."""
+        try:
+            if self._metrics_lease is None or \
+                    not self.store.keepalive(self._metrics_lease):
+                self._metrics_lease = self.store.grant(
+                    self.metrics_interval_s * 3 + 5)
+            self.store.put(self.ks.metrics_key("node", self.id),
+                           json.dumps(self.metrics_snapshot(),
+                                      separators=(",", ":")),
+                           lease=self._metrics_lease)
+        except Exception as e:  # noqa: BLE001 — metrics must not kill
+            log.warnf("agent metrics publish failed: %s", e)
+            self._metrics_lease = None
+        self._metrics_at = self.clock() + self.metrics_interval_s
 
     def unregister(self):
         if self._lease is not None:
@@ -356,6 +387,7 @@ class NodeAgent:
             if order_key is not None and not order_done[0]:
                 order_done[0] = True
                 self.store.delete(order_key)
+                self.stats["orders_consumed_total"] += 1
 
         try:
             if fenced and job.kind == KIND_ALONE:
@@ -480,6 +512,9 @@ class NodeAgent:
     def _record(self, job: Job, res: ExecResult):
         if res.skipped:
             return
+        self.stats["execs_total"] += 1
+        if not res.success:
+            self.stats["execs_failed_total"] += 1
         self.sink.create_job_log(LogRecord(
             job_id=job.id, job_group=job.group, name=job.name, node=self.id,
             user=job.user, command=job.command,
@@ -509,6 +544,7 @@ class NodeAgent:
                 n += self._poll_once()
             except WatchLost as e:
                 log.warnf("agent watch lost (%s); resynchronizing", e)
+                self.stats["watch_losses_total"] += 1
                 n += self.resync_watches()
             if self.clock() >= deadline:
                 break
@@ -653,18 +689,31 @@ class NodeAgent:
             t = threading.Thread(target=task.run, daemon=True, name=name)
             t.start()
             return
-        delay = epoch_s - self.clock()
-        if delay <= 0.02:
+        # future-epoch orders (the scheduler publishes whole windows
+        # ahead of wall-clock) must not occupy pool workers sleeping in
+        # _wait_until — they'd starve due work behind them; stage on a
+        # timer and enter the queue when due
+        self._stage(name, task, epoch_s)
+
+    def _stage(self, name: str, task: _ExecTask, epoch_s: int):
+        """Hold a not-yet-due task out of the pool.  Bounded real-time
+        naps (like _wait_until) so injected virtual clocks still make
+        progress; a stopping agent drops staged work instead of
+        resurrecting the pool after stop()."""
+        if self._stop.is_set():
+            self._staged.pop(name, None)
+            self.running.pop(name, None)
+            task.finished.set()
+            return
+        if epoch_s - self.clock() <= 0.02:
+            self._staged.pop(name, None)
             self._ensure_pool().enqueue(task)
-        else:
-            # future-epoch orders (the scheduler publishes whole windows
-            # ahead of wall-clock) must not occupy pool workers sleeping
-            # in _wait_until — they'd starve due work behind them; stage
-            # on a timer and enter the queue when due
-            timer = threading.Timer(
-                delay, lambda: self._ensure_pool().enqueue(task))
-            timer.daemon = True
-            timer.start()
+            return
+        timer = threading.Timer(min(epoch_s - self.clock(), 0.5),
+                                self._stage, args=(name, task, epoch_s))
+        timer.daemon = True
+        self._staged[name] = timer
+        timer.start()
 
 
     def join_running(self, timeout: float = 10.0):
@@ -715,6 +764,15 @@ class NodeAgent:
 
     def stop(self):
         self._stop.set()
+        # drop staged future orders FIRST: their leases/fences belong to
+        # a node that is going away, and join_running must not wait on
+        # work that was never due
+        for name, timer in list(self._staged.items()):
+            timer.cancel()
+            self._staged.pop(name, None)
+            task = self.running.pop(name, None)
+            if task is not None:
+                task.finished.set()
         for t in self._threads:
             t.join(timeout=3)
         self._threads.clear()

@@ -484,6 +484,10 @@ class SchedulerService:
         now = int(now if now is not None else self.clock())
         if not self.try_lead():
             self._next_epoch = None
+            # standbys still publish (throttled): "is my failover target
+            # alive" is an operator question too
+            if self.clock() >= self._metrics_at:
+                self.publish_metrics()
             return 0
         self.drain_watches()
         if self.clock() >= self._mirror_resync_at:

@@ -421,12 +421,21 @@ class ApiServer:
 
     def overview(self, ctx):
         live = self.store.count_prefix(self.ks.node)
+        # planner health straight from the leased scheduler snapshots
+        # (same source as /v1/metrics), keyed by instance
+        scheds = {}
+        for kv in self.store.get_prefix(self.ks.metrics + "sched/"):
+            try:
+                scheds[kv.key.rsplit("/", 1)[1]] = json.loads(kv.value)
+            except json.JSONDecodeError:
+                pass
         return {
             "totalJobs": self.store.count_prefix(self.ks.cmd),
             "jobExecuted": self.sink.stat_overall(),
             "jobExecutedDaily": self.sink.stat_days(7),
             "nodeCount": len(self.sink.get_nodes()),
             "nodeAlived": live,
+            "schedulers": scheds,
         }
 
     def configurations(self, ctx):

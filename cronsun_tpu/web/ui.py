@@ -74,6 +74,8 @@ const L={en:{
  addTimer:'+ timer',removeTimer:'remove',timerN:'timer',
  fltName:'name contains',fltNode:'node',fltFrom:'from',fltTo:'to',
  apply:'Apply',clearF:'Clear',
+ planner:'Planner',instance:'instance',leaderCol:'leader',
+ queueDepth:'queue',overflow:'overflow',watchLoss:'watch loss',
 },zh:{
  dash:'仪表盘',jobs:'任务',nodes:'节点',groups:'节点分组',logs:'执行日志',
  exec:'正在执行',accounts:'账户',logout:'退出',signin:'登录',
@@ -104,6 +106,8 @@ const L={en:{
  addTimer:'+ 定时器',removeTimer:'删除',timerN:'定时器',
  fltName:'名称包含',fltNode:'节点',fltFrom:'开始',fltTo:'结束',
  apply:'筛选',clearF:'清除',
+ planner:'调度器',instance:'实例',leaderCol:'主节点',
+ queueDepth:'队列',overflow:'溢出',watchLoss:'监听丢失',
 }};
 let lang=localStorage.lang||'en';
 const t=k=>(L[lang]&&L[lang][k])||L.en[k]||k;
@@ -134,12 +138,20 @@ function nav(v){view=v;document.querySelectorAll('header a[data-v]').forEach(a=>
  a.classList.toggle('active',a.dataset.v===v));render[v]().catch(e=>{if(e!=='auth')$('#main').innerHTML='<p class=bad>'+esc(e)+'</p>'})}
 const render={
  async dash(){const o=await api('GET','/v1/info/overview');
+  const sch=Object.entries(o.schedulers||{});
   $('#main').innerHTML=`<div class=cards>
    <div class=card><div class=n>${o.totalJobs}</div><div class=t>${t('cJobs')}</div></div>
    <div class=card><div class=n>${o.nodeAlived}</div><div class=t>${t('cAlive')}</div></div>
    <div class=card><div class=n>${o.jobExecuted.total}</div><div class=t>${t('cExecs')}</div></div>
    <div class=card><div class=n class=ok>${o.jobExecuted.successed}</div><div class=t>${t('cOk')}</div></div>
    <div class=card><div class=n class=bad>${o.jobExecuted.failed}</div><div class=t>${t('cFail')}</div></div></div>
+  ${sch.length?`<h3>${t('planner')}</h3><table>
+   <tr><th>${t('instance')}</th><th>${t('leaderCol')}</th><th>tick p50/p99 (ms)</th><th>${t('dispatched')}</th><th>${t('queueDepth')}</th><th>${t('overflow')}</th><th>${t('watchLoss')}</th></tr>
+   ${sch.map(([id,s])=>`<tr><td>${esc(id)}</td>
+    <td>${s.is_leader?`<span class=ok>✓</span>`:`<span class=muted>standby</span>`}</td>
+    <td>${esc(s.tick_p50_ms)} / ${esc(s.tick_p99_ms)}</td><td>${esc(s.dispatches_total)}</td>
+    <td>${esc(s.dispatch_queue_depth)}</td><td>${esc(s.overflow_drops_total)}</td>
+    <td>${esc(s.watch_losses_total)}</td></tr>`).join('')}</table>`:''}
   <h3>${t('daily')}</h3><table><tr><th>${t('day')}</th><th>${t('total')}</th><th>${t('success')}</th><th>${t('failed')}</th></tr>
   ${o.jobExecutedDaily.map(d=>`<tr><td>${d.day}</td><td>${d.total}</td><td class=ok>${d.successed}</td><td class=bad>${d.failed}</td></tr>`).join('')}</table>`},
  async jobs(){const js=await api('GET','/v1/jobs');window._jobs=js;

@@ -104,10 +104,13 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             if not line or "READY" in line:
                 break
         assert line and "READY" in line, f"agent failed: {line!r}"
-        # keep draining forever: an undrained 64KB pipe would block the
-        # agent mid-warning and wedge the plane being measured
-        threading.Thread(target=lambda f=p.stdout: [None for _ in f],
-                         daemon=True).start()
+        # keep draining forever (discarding): an undrained 64KB pipe
+        # would block the agent mid-warning and wedge the plane being
+        # measured
+        def _drain(f=p.stdout):
+            for _ in f:
+                pass
+        threading.Thread(target=_drain, daemon=True).start()
 
     results = {"dispatch_plane_backend": backend,
                "dispatch_plane_agents": n_agents,

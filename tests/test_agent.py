@@ -283,3 +283,56 @@ def test_future_orders_do_not_occupy_workers():
     _, total = sink.query_logs()
     assert total >= 1, "due order starved behind a staged future order"
     store.close()
+
+
+def test_stop_drops_staged_future_orders():
+    """stop() must cancel staged future-order timers promptly (no 10s
+    join wait) and nothing may execute after stop — a stopped node's
+    order must not resurrect the pool later."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="ns")
+    job = make_job()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    j = agent._get_job(job.group, job.id)
+    agent._spawn(j, int(time.time()) + 2, fenced=False)
+    assert agent._staged, "future order was not staged"
+    t0 = time.time()
+    agent.stop()
+    assert time.time() - t0 < 5, "stop() blocked on staged work"
+    assert not agent._staged and not agent.running
+    time.sleep(2.5)                    # past the order's epoch
+    _, total = sink.query_logs()
+    assert total == 0, "staged order executed after stop()"
+    assert agent._pool is None, "pool resurrected after stop()"
+    store.close()
+
+
+def test_staged_order_honors_virtual_clock():
+    """Staging re-checks the INJECTED clock with bounded real naps (the
+    _wait_until contract): advancing a virtual clock releases a staged
+    order within ~a nap, not after its real-time delay."""
+    store, sink = MemStore(), JobLogStore()
+    t = [1_753_000_000.0]
+    agent = NodeAgent(store, sink, node_id="nv", clock=lambda: t[0])
+    agent.register()
+    job = make_job(name="vj")
+    job.rules[0].nids = ["nv"]
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    epoch = int(t[0]) + 3600           # an hour of VIRTUAL time away
+    store.put(KS.dispatch_key("nv", epoch, job.group, job.id),
+              json.dumps({"rule": job.rules[0].id, "kind": job.kind}))
+    agent.poll()
+    time.sleep(0.7)
+    _, total = sink.query_logs()
+    assert total == 0                  # virtual hour hasn't passed
+    t[0] = epoch + 0.5                 # virtual clock jumps
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        _, total = sink.query_logs()
+        if total:
+            break
+        time.sleep(0.1)
+    _, total = sink.query_logs()
+    assert total == 1, "staged order ignored the virtual clock"
+    agent.stop()
+    store.close()

@@ -419,3 +419,44 @@ def test_auth_noop_when_unsecured(backend):
         s.close()
     finally:
         srv.stop()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_malformed_frames_do_not_crash_server(backend):
+    """Garbage bytes, truncated JSON, wrong-typed fields and huge lines
+    must at worst close the offending connection — the server keeps
+    serving well-behaved clients."""
+    import socket as _s
+    srv = _make_server(backend)
+    try:
+        good = RemoteStore(srv.host, srv.port, reconnect=False)
+        good.put("/health", "1")
+        payloads = [
+            b"\x00\xff\xfe garbage\n",
+            b"{\"i\": 1, \"o\": \"put\"",          # truncated, no newline
+            b"{\"i\": 1, \"o\": \"put\"}\n" * 3,   # missing args
+            b"{\"i\": \"x\", \"o\": 42, \"a\": {}}\n",
+            b"[1,2,3]\n",
+            b"{\"i\": 1, \"o\": \"watch\", \"a\": [7, \"x\"]}\n",
+            b"{\"i\": 1, \"o\": \"put\", \"a\": [\"/k\", "
+            + b"\"" + b"v" * 300_000 + b"\"]}\n",  # huge but valid
+            b"{\"i\": 1, \"o\": \"grant\", \"a\": [\"NaN\"]}\n",
+        ]
+        for p in payloads:
+            c = _s.create_connection((srv.host, srv.port), timeout=5)
+            try:
+                c.sendall(p)
+                c.settimeout(1.0)
+                try:
+                    c.recv(4096)
+                except (TimeoutError, OSError):
+                    pass
+            finally:
+                c.close()
+        # the server survived all of it and still serves
+        assert good.get("/health").value == "1"
+        good.put("/health", "2")
+        assert good.get("/health").value == "2"
+        good.close()
+    finally:
+        srv.stop()

@@ -315,6 +315,23 @@ def test_metrics_endpoint(world):
     assert "# TYPE cronsun_sched_tick_p99_ms gauge" in text
 
 
+def test_agent_publishes_metrics_snapshot():
+    """Agents publish leased node snapshots the /v1/metrics surface
+    renders — execution counters included."""
+    from cronsun_tpu.node.agent import NodeAgent
+    from cronsun_tpu.logsink import JobLogStore
+    from cronsun_tpu.store import MemStore
+    store = MemStore()
+    agent = NodeAgent(store, JobLogStore(), node_id="ma")
+    agent.register()
+    agent.keepalive_once()
+    kv = store.get(KS.metrics_key("node", "ma"))
+    assert kv is not None and kv.lease != 0
+    snap = json.loads(kv.value)
+    assert "orders_consumed_total" in snap and "running" in snap
+    store.close()
+
+
 def test_scheduler_publishes_metrics_snapshot():
     """SchedulerService.publish_metrics puts a leased snapshot the web
     metrics surface picks up; the lease expires with a dead scheduler."""
