@@ -1,0 +1,60 @@
+"""Leased metrics snapshots — the fleet-wide observability protocol.
+
+Every component (scheduler, agent) periodically puts a JSON snapshot
+under ``/metrics/<component>/<instance>`` bound to a short lease, so a
+dead publisher's numbers expire instead of going stale; any web server
+renders the whole keyspace as Prometheus text at ``/v1/metrics``.  This
+module is THE publish protocol — one place for the
+keepalive-or-regrant lease dance, the ttl sizing and the
+failure-must-not-stall-the-caller rule.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+from . import log
+from .core import Keyspace
+
+
+class MetricsPublisher:
+    def __init__(self, store, ks: Keyspace, component: str, instance: str,
+                 snapshot_fn: Callable[[], dict], interval_s: float = 10.0,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.key = ks.metrics_key(component, instance)
+        self.snapshot_fn = snapshot_fn
+        self.interval_s = interval_s
+        self.clock = clock
+        self._lease: Optional[int] = None
+        self._next_at = 0.0
+
+    def maybe_publish(self):
+        """Publish if the interval elapsed; errors are logged, never
+        raised — metrics must not stall the caller's loop."""
+        if self.clock() < self._next_at:
+            return
+        try:
+            if self._lease is None or not self.store.keepalive(self._lease):
+                self._lease = self.store.grant(self.interval_s * 3 + 5)
+            self.store.put(self.key,
+                           json.dumps(self.snapshot_fn(),
+                                      separators=(",", ":")),
+                           lease=self._lease)
+        except Exception as e:  # noqa: BLE001
+            log.warnf("metrics publish for %s failed: %s", self.key, e)
+            self._lease = None
+        self._next_at = self.clock() + self.interval_s
+
+    def revoke(self):
+        """Withdraw the snapshot immediately (clean shutdown) — the
+        metrics surface must not keep rendering a gone component for the
+        remaining lease TTL."""
+        if self._lease is not None:
+            try:
+                self.store.revoke(self._lease)
+            except Exception:  # noqa: BLE001 — best effort on the way out
+                pass
+            self._lease = None

@@ -25,7 +25,7 @@ import os
 import socket
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from .. import log
 from ..core import Group, Job, Keyspace, Node
@@ -122,7 +122,12 @@ class NodeAgent:
         # queues instead (orders run late, never dropped, never early)
         self.max_inflight = 64
         self._pool = None
-        self._staged: Dict[str, threading.Timer] = {}
+        # staged (not yet due) orders: one monitor thread scans for due
+        # work — no per-order timers, and stop() can atomically drop the
+        # backlog under the same lock the monitor enqueues under
+        self._staged: Dict[str, Tuple[_ExecTask, int]] = {}
+        self._stage_mu = threading.Lock()
+        self._stage_monitor: Optional[threading.Thread] = None
         self._fence_mu = threading.Lock()
         self._fence_lease_id: Optional[int] = None
         self._fence_rotate_at = 0.0
@@ -132,13 +137,15 @@ class NodeAgent:
         # cost each agent a gigabyte)
         self._job_cache: Dict[tuple, Job] = {}
         self._job_cache_cap = 65536
-        # operator metrics (rendered fleet-wide at /v1/metrics via the
-        # scheduler-style leased store snapshot)
+        # operator metrics (rendered fleet-wide at /v1/metrics); counters
+        # are bumped from concurrent pool workers -> lock the increments
         self.stats = {"orders_consumed_total": 0, "execs_total": 0,
                       "execs_failed_total": 0, "watch_losses_total": 0}
-        self.metrics_interval_s = 10.0
-        self._metrics_at = 0.0
-        self._metrics_lease: Optional[int] = None
+        self._stats_mu = threading.Lock()
+        from ..metrics import MetricsPublisher
+        self.metrics = MetricsPublisher(
+            store, self.ks, "node", self.id, self.metrics_snapshot,
+            interval_s=10.0, clock=clock)
 
     def _open_watches(self):
         self._w_dispatch = self.store.watch(
@@ -221,30 +228,19 @@ class NodeAgent:
             self.register()     # reference re-registers after a lapse
         else:
             self._ensure_proc_lease()
-        if self.clock() >= self._metrics_at:
-            self.publish_metrics()
+        self.metrics.maybe_publish()
         return ok
 
-    def metrics_snapshot(self) -> dict:
-        return {**self.stats, "running": len(self.running),
-                "procs_registered": len(self._procs)}
+    def _bump(self, counter: str, n: int = 1):
+        with self._stats_mu:
+            self.stats[counter] += n
 
-    def publish_metrics(self):
-        """Leased per-agent snapshot; same surface contract as the
-        scheduler's (web renders all components at /v1/metrics)."""
-        try:
-            if self._metrics_lease is None or \
-                    not self.store.keepalive(self._metrics_lease):
-                self._metrics_lease = self.store.grant(
-                    self.metrics_interval_s * 3 + 5)
-            self.store.put(self.ks.metrics_key("node", self.id),
-                           json.dumps(self.metrics_snapshot(),
-                                      separators=(",", ":")),
-                           lease=self._metrics_lease)
-        except Exception as e:  # noqa: BLE001 — metrics must not kill
-            log.warnf("agent metrics publish failed: %s", e)
-            self._metrics_lease = None
-        self._metrics_at = self.clock() + self.metrics_interval_s
+    def metrics_snapshot(self) -> dict:
+        with self._stats_mu:
+            snap = dict(self.stats)
+        snap["running"] = len(self.running)
+        snap["procs_registered"] = len(self._procs)
+        return snap
 
     def unregister(self):
         if self._lease is not None:
@@ -253,6 +249,7 @@ class NodeAgent:
         if self._proc_lease is not None:
             self.store.revoke(self._proc_lease)
             self._proc_lease = None
+        self.metrics.revoke()   # don't render a gone node for the TTL
         self.sink.set_node_alived(self.id, False)
 
     # ---- local eligibility (reference IsRunOn, job.go:616-630) -----------
@@ -387,7 +384,7 @@ class NodeAgent:
             if order_key is not None and not order_done[0]:
                 order_done[0] = True
                 self.store.delete(order_key)
-                self.stats["orders_consumed_total"] += 1
+                self._bump("orders_consumed_total")
 
         try:
             if fenced and job.kind == KIND_ALONE:
@@ -512,9 +509,9 @@ class NodeAgent:
     def _record(self, job: Job, res: ExecResult):
         if res.skipped:
             return
-        self.stats["execs_total"] += 1
+        self._bump("execs_total")
         if not res.success:
-            self.stats["execs_failed_total"] += 1
+            self._bump("execs_failed_total")
         self.sink.create_job_log(LogRecord(
             job_id=job.id, job_group=job.group, name=job.name, node=self.id,
             user=job.user, command=job.command,
@@ -544,7 +541,7 @@ class NodeAgent:
                 n += self._poll_once()
             except WatchLost as e:
                 log.warnf("agent watch lost (%s); resynchronizing", e)
-                self.stats["watch_losses_total"] += 1
+                self._bump("watch_losses_total")
                 n += self.resync_watches()
             if self.clock() >= deadline:
                 break
@@ -691,29 +688,39 @@ class NodeAgent:
             return
         # future-epoch orders (the scheduler publishes whole windows
         # ahead of wall-clock) must not occupy pool workers sleeping in
-        # _wait_until — they'd starve due work behind them; stage on a
-        # timer and enter the queue when due
-        self._stage(name, task, epoch_s)
+        # _wait_until — they'd starve due work behind them; stage until
+        # due.  One monitor thread scans the backlog with bounded naps
+        # (injected virtual clocks still make progress, and K staged
+        # orders cost zero extra threads); the stage lock makes stop()
+        # vs due-enqueue atomic, so a stopping agent can never enqueue
+        # into (or resurrect) a shut-down pool.
+        with self._stage_mu:
+            if self._stop.is_set():
+                self.running.pop(name, None)
+                task.finished.set()
+                return
+            if epoch_s - self.clock() <= 0.02:
+                self._ensure_pool().enqueue(task)
+                return
+            self._staged[name] = (task, epoch_s)
+            if self._stage_monitor is None or \
+                    not self._stage_monitor.is_alive():
+                self._stage_monitor = threading.Thread(
+                    target=self._stage_loop, daemon=True,
+                    name=f"stage-{self.id}")
+                self._stage_monitor.start()
 
-    def _stage(self, name: str, task: _ExecTask, epoch_s: int):
-        """Hold a not-yet-due task out of the pool.  Bounded real-time
-        naps (like _wait_until) so injected virtual clocks still make
-        progress; a stopping agent drops staged work instead of
-        resurrecting the pool after stop()."""
-        if self._stop.is_set():
-            self._staged.pop(name, None)
-            self.running.pop(name, None)
-            task.finished.set()
-            return
-        if epoch_s - self.clock() <= 0.02:
-            self._staged.pop(name, None)
-            self._ensure_pool().enqueue(task)
-            return
-        timer = threading.Timer(min(epoch_s - self.clock(), 0.5),
-                                self._stage, args=(name, task, epoch_s))
-        timer.daemon = True
-        self._staged[name] = timer
-        timer.start()
+    def _stage_loop(self):
+        while True:
+            with self._stage_mu:
+                if self._stop.is_set() or not self._staged:
+                    return
+                now = self.clock()
+                for name, (task, epoch_s) in list(self._staged.items()):
+                    if epoch_s - now <= 0.02:
+                        self._staged.pop(name)
+                        self._ensure_pool().enqueue(task)
+            time.sleep(0.1)
 
 
     def join_running(self, timeout: float = 10.0):
@@ -766,12 +773,12 @@ class NodeAgent:
         self._stop.set()
         # drop staged future orders FIRST: their leases/fences belong to
         # a node that is going away, and join_running must not wait on
-        # work that was never due
-        for name, timer in list(self._staged.items()):
-            timer.cancel()
-            self._staged.pop(name, None)
-            task = self.running.pop(name, None)
-            if task is not None:
+        # work that was never due.  Under the stage lock, so the monitor
+        # cannot concurrently enqueue one of them.
+        with self._stage_mu:
+            for name, (task, _epoch) in list(self._staged.items()):
+                self._staged.pop(name, None)
+                self.running.pop(name, None)
                 task.finished.set()
         for t in self._threads:
             t.join(timeout=3)

@@ -136,13 +136,13 @@ class SchedulerService:
                       "watch_losses": 0, "dispatches_total": 0,
                       "steps_total": 0}
         # operator metrics: recent device-plan latencies (ring) published
-        # to the store under a lease so the web process can serve
-        # /v1/metrics for the whole fleet (a dead scheduler's snapshot
-        # expires instead of going stale)
+        # via the shared leased-snapshot protocol (a dead scheduler's
+        # snapshot expires instead of going stale)
         self._tick_ms: List[float] = []
-        self.metrics_interval_s = 5.0
-        self._metrics_at = 0.0
-        self._metrics_lease: Optional[int] = None
+        from ..metrics import MetricsPublisher
+        self.metrics = MetricsPublisher(
+            store, self.ks, "sched", self.node_id, self.metrics_snapshot,
+            interval_s=5.0, clock=clock)
 
         self._load_initial()
 
@@ -486,8 +486,7 @@ class SchedulerService:
             self._next_epoch = None
             # standbys still publish (throttled): "is my failover target
             # alive" is an operator question too
-            if self.clock() >= self._metrics_at:
-                self.publish_metrics()
+            self.metrics.maybe_publish()
             return 0
         self.drain_watches()
         if self.clock() >= self._mirror_resync_at:
@@ -569,8 +568,7 @@ class SchedulerService:
         self._advance_hwm(self._next_epoch)
         self.stats["dispatches_total"] += n_dispatch
         self.stats["steps_total"] += 1
-        if self.clock() >= self._metrics_at:
-            self.publish_metrics()
+        self.metrics.maybe_publish()
         return n_dispatch
 
     # ---- operator metrics ------------------------------------------------
@@ -591,23 +589,6 @@ class SchedulerService:
             "jobs": len(self.jobs),
             "is_leader": 1 if self.is_leader else 0,
         }
-
-    def publish_metrics(self):
-        """Leased metrics snapshot -> store; the web process renders the
-        fleet's snapshots as a Prometheus text surface at /v1/metrics."""
-        try:
-            if self._metrics_lease is None or \
-                    not self.store.keepalive(self._metrics_lease):
-                self._metrics_lease = self.store.grant(
-                    self.metrics_interval_s * 3 + 5)
-            self.store.put(self.ks.metrics_key("sched", self.node_id),
-                           json.dumps(self.metrics_snapshot(),
-                                      separators=(",", ":")),
-                           lease=self._metrics_lease)
-        except Exception as e:  # noqa: BLE001 — metrics must not stall steps
-            log.warnf("metrics publish failed: %s", e)
-            self._metrics_lease = None
-        self._metrics_at = self.clock() + self.metrics_interval_s
 
     def _advance_hwm(self, value: int):
         for _ in range(8):
@@ -654,3 +635,4 @@ class SchedulerService:
         if self._leader_lease is not None:
             self.store.revoke(self._leader_lease)
             self._leader_lease = None
+        self.metrics.revoke()

@@ -1,0 +1,150 @@
+"""Churn soak: the full in-process system under sustained mutation.
+
+Scheduler + two agents against a MemStore while jobs are created,
+rewritten, paused, deleted, groups mutated and run-nows fired — then
+invariants: exclusive jobs never double-execute for one scheduled
+second, executions land only on eligible nodes, the cost loop closes
+(avg_time flows back), and nothing leaks (orders consumed, procs
+empty).  The reference has no test like this (SURVEY §4: its
+distributed machinery is untested).
+"""
+
+import json
+
+from cronsun_tpu.core import (Group, Job, JobRule, Keyspace, KIND_ALONE,
+                              KIND_COMMON)
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.node.agent import NodeAgent
+from cronsun_tpu.sched import SchedulerService
+from cronsun_tpu.store import MemStore
+
+KS = Keyspace()
+
+
+def test_churn_soak():
+    store = MemStore()
+    store.start_sweeper(0.1)
+    sink = JobLogStore()
+    agents = [NodeAgent(store, sink, node_id=f"s{i}") for i in range(2)]
+    for a in agents:
+        a.register()
+    sched = SchedulerService(store, job_capacity=256, node_capacity=64,
+                             window_s=2)
+
+    def put_job(j):
+        j.check()
+        store.put(KS.job_key(j.group, j.id), j.to_json())
+        return j
+
+    # seed: one Alone job (exactly-once invariant), one Common (fan-out),
+    # one group-routed job
+    store.put(KS.group_key("grp"), Group(id="grp", name="grp",
+                                         node_ids=["s0"]).to_json())
+    alone = put_job(Job(name="alone", command="echo A", kind=KIND_ALONE,
+                        rules=[JobRule(timer="* * * * * *",
+                                       nids=["s0", "s1"])]))
+    common = put_job(Job(name="common", command="echo C", kind=KIND_COMMON,
+                         rules=[JobRule(timer="* * * * * *",
+                                        nids=["s0", "s1"])]))
+    grouped = put_job(Job(name="grouped", command="echo G", kind=KIND_COMMON,
+                          rules=[JobRule(timer="* * * * * *",
+                                         gids=["grp"])]))
+
+    t0 = 1_760_000_000
+    t = t0
+    churn_jobs = []
+    ROUNDS = 30
+    for step in range(ROUNDS):
+        # churn: every few steps create/rewrite/pause/delete something
+        r = step % 6
+        if r == 0:
+            j = put_job(Job(name=f"ch{step}", command="echo x",
+                            kind=KIND_COMMON,
+                            rules=[JobRule(timer="* * * * * *",
+                                           nids=["s1"])]))
+            churn_jobs.append(j)
+        elif r == 1 and churn_jobs:
+            j = churn_jobs[-1]
+            j.pause = True
+            put_job(j)
+        elif r == 2 and churn_jobs:
+            j = churn_jobs[-1]
+            j.pause = False
+            j.command = "echo y"
+            put_job(j)
+        elif r == 3 and len(churn_jobs) > 1:
+            j = churn_jobs.pop(0)
+            store.delete(KS.job_key(j.group, j.id))
+        elif r == 4:
+            # group membership flip re-derives eligibility
+            nid = "s1" if step % 12 == 4 else "s0"
+            store.put(KS.group_key("grp"),
+                      Group(id="grp", name="grp",
+                            node_ids=[nid]).to_json())
+        elif r == 5:
+            # run-now (no fence, immediate)
+            store.put(KS.once_key(common.group, common.id), "s0")
+        sched.step(now=t)
+        for a in agents:
+            a.poll()
+        for a in agents:
+            a.join_running()
+        t = sched._next_epoch
+    # drain the tail of the last window
+    for a in agents:
+        a.poll()
+        a.join_running()
+
+    logs, total = sink.query_logs(page_size=500)
+    assert total > ROUNDS, f"system barely executed ({total})"
+
+    # ---- invariant: Alone executes EXACTLY once per planned second -----
+    # (begin_ts is real wall-clock while the planned epochs are virtual,
+    # so the check is count equality: the planner plans each virtual
+    # second exactly once past the HWM, the (job, second) fence dedups
+    # across nodes — any double or any miss breaks the equality)
+    # In compressed time both seconds of a window execute back-to-back,
+    # so the Alone LIFETIME lock legitimately skips the second one while
+    # the first still runs (never-overlap semantics, job.go:87-123) —
+    # hence the lower bound is one per window, the upper bound one per
+    # planned second; anything above means a fence/lock violation.
+    # Upper bound is the hard exactly-once invariant (a double would
+    # exceed one-per-planned-second).  Lower bound only asserts liveness
+    # and stays slack: under load executions run longer, the lifetime
+    # lock legitimately skips more planned seconds.
+    planned_seconds = t - (t0 + 1)
+    n_alone = sum(1 for l in logs if l.job_id == alone.id)
+    assert planned_seconds // 4 <= n_alone <= planned_seconds, \
+        f"Alone ran {n_alone}x over {planned_seconds} planned seconds"
+
+    # ---- invariant: grouped job only ever ran on group members --------
+    for l in logs:
+        if l.job_id == grouped.id:
+            assert l.node in ("s0", "s1")
+    # after the final flips the group routed somewhere; it executed
+    assert any(l.job_id == grouped.id for l in logs)
+
+    # ---- invariant: Common fan-out reached both nodes ------------------
+    cnodes = {l.node for l in logs if l.job_id == common.id}
+    assert cnodes == {"s0", "s1"}
+
+    # ---- cost loop closed: measured runtime flowed back into the store -
+    kv = store.get(KS.job_key(common.group, common.id))
+    assert Job.from_json(kv.value).avg_time > 0
+
+    # ---- nothing leaked -------------------------------------------------
+    assert not store.get_prefix(KS.proc), "proc keys leaked"
+    orders = [kv.key for kv in store.get_prefix(KS.dispatch)
+              if not kv.key.startswith(KS.dispatch_all)]
+    # exclusive orders must be consumed; the final window's may still be
+    # staged (future epochs) — allow only those
+    stale = [k for k in orders
+             if int(k.split("/")[4]) < t - sched.window_s]
+    assert not stale, f"stale unconsumed orders: {stale}"
+    # deleted jobs no longer execute: the planner dropped their rows
+    assert len(sched.rows.by_cmd) < 256
+
+    for a in agents:
+        a.stop()
+    sched.stop()
+    store.close()

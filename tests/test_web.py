@@ -329,6 +329,10 @@ def test_agent_publishes_metrics_snapshot():
     assert kv is not None and kv.lease != 0
     snap = json.loads(kv.value)
     assert "orders_consumed_total" in snap and "running" in snap
+    # clean shutdown withdraws the snapshot immediately (no ghost node
+    # on the metrics surface for the remaining lease TTL)
+    agent.unregister()
+    assert store.get(KS.metrics_key("node", "ma")) is None
     store.close()
 
 
