@@ -70,11 +70,16 @@ class Keyspace:
     def lock_key(self, job_id: str, epoch_s: int) -> str:
         return f"{self.lock}{job_id}/{epoch_s}"
 
+    @property
+    def alone_lock(self) -> str:
+        """Prefix of the fleet-wide KindAlone running locks."""
+        return f"{self.lock}alone/"
+
     def alone_lock_key(self, job_id: str) -> str:
         """Fleet-wide running lock for KindAlone jobs — held with keepalive
         for the execution's whole lifetime (reference job.go:87-123), unlike
         the per-(job, second) dedup fence of :meth:`lock_key`."""
-        return f"{self.lock}alone/{job_id}"
+        return f"{self.alone_lock}{job_id}"
 
     @property
     def hwm(self) -> str:        # scheduler planning high-water mark

@@ -32,6 +32,7 @@ import json
 import socket
 import socketserver
 import threading
+import uuid
 from typing import List, Optional, Tuple
 
 from .. import log
@@ -247,7 +248,6 @@ class RemoteJobLogStore:
     # -- surface (mirrors JobLogStore) -------------------------------------
 
     def create_job_log(self, rec: LogRecord):
-        import uuid
         # one token per logical record, stable across the reconnect retry
         rec.id = self._call("create_job_log", _rec_wire(rec),
                             uuid.uuid4().hex)

@@ -65,6 +65,7 @@ class _ExecPool:
     def __init__(self, workers: int, prefix: str):
         import queue
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._workers = workers
         for i in range(workers):
             threading.Thread(target=self._worker, daemon=True,
                              name=f"{prefix}-{i}").start()
@@ -79,8 +80,8 @@ class _ExecPool:
     def enqueue(self, task: _ExecTask):
         self._q.put(task)
 
-    def shutdown(self, workers: int):
-        for _ in range(workers):
+    def shutdown(self):
+        for _ in range(self._workers):
             self._q.put(None)      # idle workers exit; busy ones are daemons
 
 
@@ -114,7 +115,7 @@ class NodeAgent:
         self._open_watches()
         self.groups: Dict[str, Group] = {}
         self._load_groups()
-        self.running: Dict[str, object] = {}   # name -> Future
+        self.running: Dict[str, _ExecTask] = {}
         self._bseen: Dict[tuple, float] = {}   # broadcast (job, sec) dedup
         # executions run on a bounded pool: the reference spawns a
         # goroutine per fire (cron.go:237-244) but an unbounded Python
@@ -714,6 +715,11 @@ class NodeAgent:
         while True:
             with self._stage_mu:
                 if self._stop.is_set() or not self._staged:
+                    # clear the handle UNDER the lock before exiting: a
+                    # concurrent _stage serialized behind us must see
+                    # "no monitor" and spawn a fresh one, not skip on an
+                    # is_alive() thread that has already decided to die
+                    self._stage_monitor = None
                     return
                 now = self.clock()
                 for name, (task, epoch_s) in list(self._staged.items()):
@@ -785,7 +791,7 @@ class NodeAgent:
         self._threads.clear()
         self.join_running()
         if self._pool is not None:
-            self._pool.shutdown(self.max_inflight)
+            self._pool.shutdown()
             self._pool = None
         self.unregister()
 

@@ -148,7 +148,7 @@ class SchedulerService:
 
     @property
     def _alone_pfx(self) -> str:
-        return self.ks.lock + "alone/"
+        return self.ks.alone_lock
 
     def _open_watches(self):
         self._w_jobs = self.store.watch(self.ks.cmd)

@@ -454,9 +454,9 @@ class ApiServer:
     def metrics(self, ctx):
         """Prometheus text surface for the whole fleet: every component
         publishes a leased JSON snapshot under /metrics/<component>/<id>
-        (SchedulerService.publish_metrics), so "is the planner keeping
-        up" is one scrape away from any web server — dead publishers'
-        snapshots expire with their lease."""
+        (cronsun_tpu.metrics.MetricsPublisher), so "is the planner
+        keeping up" is one scrape away from any web server — dead
+        publishers' snapshots expire with their lease."""
         lines = ["# HELP cronsun_web_up this web server is serving",
                  "# TYPE cronsun_web_up gauge",
                  "cronsun_web_up 1"]

@@ -337,7 +337,7 @@ def test_agent_publishes_metrics_snapshot():
 
 
 def test_scheduler_publishes_metrics_snapshot():
-    """SchedulerService.publish_metrics puts a leased snapshot the web
+    """The scheduler's MetricsPublisher puts a leased snapshot the web
     metrics surface picks up; the lease expires with a dead scheduler."""
     from cronsun_tpu.sched import SchedulerService
     from cronsun_tpu.store import MemStore
