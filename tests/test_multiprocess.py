@@ -52,6 +52,37 @@ def _await_ready(proc, timeout=90):
     raise AssertionError(f"no READY within {timeout}s:\n{''.join(lines)}")
 
 
+def _login(web_addr):
+    """Cookie-authenticated opener against a fleet's web process."""
+    cj = http.cookiejar.CookieJar()
+    op = urllib.request.build_opener(urllib.request.HTTPCookieProcessor(cj))
+    base = f"http://{web_addr}"
+    q = urllib.parse.urlencode(
+        {"email": "admin@admin.com", "password": "admin"})
+    with op.open(f"{base}/v1/session?{q}", timeout=10) as r:
+        assert r.status == 200
+    return op, base
+
+
+def _put_job(op, base, job):
+    req = urllib.request.Request(
+        f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
+        headers={"Content-Type": "application/json"})
+    with op.open(req, timeout=10) as r:
+        assert r.status == 200
+
+
+def _teardown(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 @pytest.mark.parametrize("store_backend", ["py", "native"])
 def test_full_system_multiprocess(tmp_path, store_backend):
     if store_backend == "native":
@@ -100,24 +131,13 @@ def test_full_system_multiprocess(tmp_path, store_backend):
         web_addr = _await_ready(web_p)
 
         # -- drive through the REST API (cookie session auth) -------------
-        cj = http.cookiejar.CookieJar()
-        op = urllib.request.build_opener(
-            urllib.request.HTTPCookieProcessor(cj))
-        base = f"http://{web_addr}"
-        q = urllib.parse.urlencode(
-            {"email": "admin@admin.com", "password": "admin"})
-        with op.open(f"{base}/v1/session?{q}", timeout=10) as r:
-            assert r.status == 200
+        op, base = _login(web_addr)
 
         job = {"name": "mp-hello", "command": "echo multiproc", "kind": 0,
                "group": "default",
                "rules": [{"timer": "* * * * * *",
                           "nids": ["mp-node-0", "mp-node-1"]}]}
-        req = urllib.request.Request(
-            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
-            headers={"Content-Type": "application/json"})
-        with op.open(req, timeout=10) as r:
-            assert r.status == 200
+        _put_job(op, base, job)
 
         with op.open(f"{base}/v1/nodes", timeout=10) as r:
             nodes = json.loads(r.read())
@@ -162,14 +182,7 @@ def test_full_system_multiprocess(tmp_path, store_backend):
             f"no planner ticks visible in /v1/metrics:\n{metrics}"
         assert "cronsun_sched_tick_p99_ms" in metrics
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _teardown(procs)
 
 
 def test_node_crash_alert_across_processes(tmp_path):
@@ -255,14 +268,7 @@ def test_node_crash_alert_across_processes(tmp_path):
         sink.close()
     finally:
         recv.shutdown()
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _teardown(procs)
 
 
 def test_secured_fleet_end_to_end(tmp_path):
@@ -315,19 +321,10 @@ def test_secured_fleet_end_to_end(tmp_path):
         _await_ready(node_p)
         web_addr = _await_ready(web_p)
 
-        cj = http.cookiejar.CookieJar()
-        op = urllib.request.build_opener(
-            urllib.request.HTTPCookieProcessor(cj))
-        base = f"http://{web_addr}"
-        q = urllib.parse.urlencode(
-            {"email": "admin@admin.com", "password": "admin"})
-        op.open(f"{base}/v1/session?{q}", timeout=10)
+        op, base = _login(web_addr)
         job = {"name": "sec", "command": "echo secured", "kind": 0,
                "rules": [{"timer": "* * * * * *", "nids": ["sec-node"]}]}
-        req = urllib.request.Request(
-            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
-            headers={"Content-Type": "application/json"})
-        op.open(req, timeout=10)
+        _put_job(op, base, job)
 
         sink = RemoteJobLogStore(lh, int(lp), token="lg-secret")
         deadline = time.time() + 45
@@ -338,14 +335,99 @@ def test_secured_fleet_end_to_end(tmp_path):
         assert total >= 2, "secured fleet executed nothing"
         sink.close()
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
+        _teardown(procs)
+
+
+def test_logd_crash_restart_fleet_heals(tmp_path):
+    """The result store (cronsun-logd) is SIGKILLed mid-run and
+    restarted on the same port with the same SQLite file: agents heal
+    their connections (one transparent retry + reconnect), no execution
+    record is double-counted (idempotency tokens), and history from
+    before the crash survives."""
+    import socket as _socket
+    from cronsun_tpu.logsink import RemoteJobLogStore
+
+    sock = _socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    logd_port = sock.getsockname()[1]
+    sock.close()
+    logd_db = str(tmp_path / "logd.db")
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": str(tmp_path / "local-UNUSED.db"), "window_s": 2,
+        "node_ttl": 5, "proc_req": 0}))
+
+    def spawn_logd():
+        p = _spawn("cronsun_tpu.bin.logd", "--port", str(logd_port),
+                   "--db", logd_db)
+        procs.append(p)       # registered BEFORE awaiting: a wedged
+        _await_ready(p)       # start must still be torn down
+        return p
+
+    procs = []
+    logd_p = None
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        logd_p = spawn_logd()
+        logd_addr = f"127.0.0.1:{logd_port}"
+
+        sched_p = _spawn("cronsun_tpu.bin.sched", "--store", store_addr,
+                         "--conf", str(conf))
+        node_p = _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                        "--logsink", logd_addr, "--conf", str(conf),
+                        "--node-id", "ld-node")
+        web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--logsink", logd_addr, "--conf", str(conf),
+                       "--port", "0")
+        procs += [sched_p, node_p, web_p]
+        _await_ready(sched_p)
+        _await_ready(node_p)
+        web_addr = _await_ready(web_p)
+
+        op, base = _login(web_addr)
+        job = {"name": "ld", "command": "echo heal-logd", "kind": 0,
+               "rules": [{"timer": "* * * * * *", "nids": ["ld-node"]}]}
+        _put_job(op, base, job)
+
+        def count():
+            c = RemoteJobLogStore("127.0.0.1", logd_port)
             try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+                _, n = c.query_logs()
+                return n
+            finally:
+                c.close()
+
+        deadline = time.time() + 45
+        while time.time() < deadline and count() < 3:
+            time.sleep(0.5)
+        before = count()
+        assert before >= 3, f"no executions before logd crash ({before})"
+
+        logd_p.send_signal(signal.SIGKILL)
+        logd_p.wait(timeout=10)
+        time.sleep(2)                       # agents hit the dead sink
+        logd_p = spawn_logd()
+
+        deadline = time.time() + 60
+        while time.time() < deadline and count() < before + 3:
+            time.sleep(0.5)
+        after = count()
+        assert after >= before + 3, \
+            f"executions did not resume after logd restart " \
+            f"({before} -> {after})"
+        # history from before the crash survived in the SQLite file
+        c = RemoteJobLogStore("127.0.0.1", logd_port)
+        logs, _ = c.query_logs(page_size=500)
+        assert all("heal-logd" in l.output for l in logs)
+        c.close()
+        # no fleet process died over the outage (the first logd was
+        # deliberately SIGKILLed, so it is excluded)
+        for p in (store_p, sched_p, node_p, web_p):
+            assert p.poll() is None, "a fleet process died with logd"
+    finally:
+        _teardown(procs)
 
 
 def test_store_crash_restart_fleet_heals(tmp_path):
@@ -392,19 +474,10 @@ def test_store_crash_restart_fleet_heals(tmp_path):
         _await_ready(node_p)
         web_addr = _await_ready(web_p)
 
-        cj = http.cookiejar.CookieJar()
-        op = urllib.request.build_opener(
-            urllib.request.HTTPCookieProcessor(cj))
-        base = f"http://{web_addr}"
-        q = urllib.parse.urlencode(
-            {"email": "admin@admin.com", "password": "admin"})
-        op.open(f"{base}/v1/session?{q}", timeout=10)
+        op, base = _login(web_addr)
         job = {"name": "hz", "command": "echo heal", "kind": 0,
                "rules": [{"timer": "* * * * * *", "nids": ["hz-node"]}]}
-        req = urllib.request.Request(
-            f"{base}/v1/job", data=json.dumps(job).encode(), method="PUT",
-            headers={"Content-Type": "application/json"})
-        op.open(req, timeout=10)
+        _put_job(op, base, job)
 
         sink = JobLogStore(logdb)
 
@@ -439,11 +512,4 @@ def test_store_crash_restart_fleet_heals(tmp_path):
         sink.close()
     finally:
         procs.append(store_p)
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _teardown(procs)
