@@ -1,13 +1,17 @@
 """Result-store server — the rebuild's MongoDB.
 
     python -m cronsun_tpu.bin.logd [--db FILE] [--host H] [--port P]
-                                   [--token T] [--conf F]
+                                   [--token T] [--conf F] [--native]
 
 Serves execution logs, latest-status, stats, the node-liveness mirror
 and accounts (reference collections in db/mgo.go, job_log.go) over TCP
 so agents, web servers and noticers on DIFFERENT machines share one
-result store.  Single-machine deployments can skip this process and
-point every entrypoint at the same ``log_db`` file instead.
+result store.  With --native the C++ server (native/logd.cc) serves
+instead of the Python/SQLite one: same wire protocol and semantics
+(tests/test_logsink_remote.py runs the conformance suite against both),
+in-memory tables + WAL, bounded retention.  Single-machine deployments
+can skip this process and point every entrypoint at the same ``log_db``
+file instead.
 """
 
 from __future__ import annotations
@@ -22,19 +26,41 @@ from .common import base_parser, setup_common
 def main(argv=None) -> int:
     ap = base_parser(__doc__, store_required=False)
     ap.add_argument("--db", default=None, metavar="FILE",
-                    help="SQLite file (default: conf log_db)")
+                    help="SQLite file (Python) / WAL file (--native); "
+                         "default: conf log_db")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=7078)
     ap.add_argument("--token", default=None,
                     help="shared secret clients must present "
                          "(default: conf log_token)")
+    ap.add_argument("--native", action="store_true",
+                    help="serve with the native C++ result store")
+    ap.add_argument("--retain", type=int, default=None,
+                    help="record retention cap (--native only)")
     args = ap.parse_args(argv)
+    if args.retain is not None and not args.native:
+        print("error: --retain requires --native", file=sys.stderr)
+        return 2
     cfg, ks, watcher = setup_common(args)
+    token = cfg.log_token if args.token is None else args.token
 
-    srv = LogSinkServer(db_path=args.db or cfg.log_db,
-                        host=args.host, port=args.port,
-                        token=cfg.log_token if args.token is None
-                        else args.token).start()
+    rc = [0]
+    if args.native:
+        from ..logsink.native import NativeLogSinkServer
+        srv = NativeLogSinkServer(host=args.host, port=args.port,
+                                  db=args.db or cfg.log_db,
+                                  retain=args.retain, token=token).start()
+
+        def child_died(code: int):
+            # don't sit healthy-looking in front of a dead result store
+            log.errorf("native logd exited rc=%d; shutting down", code)
+            rc[0] = code if code > 0 else 1
+            events.shutdown()
+        srv.monitor(child_died)
+    else:
+        srv = LogSinkServer(db_path=args.db or cfg.log_db,
+                            host=args.host, port=args.port,
+                            token=token).start()
     log.infof("cronsun-logd serving on %s:%d (db %s)", srv.host, srv.port,
               args.db or cfg.log_db)
     print(f"READY {srv.host}:{srv.port}", flush=True)
@@ -42,7 +68,7 @@ def main(argv=None) -> int:
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()
-    return 0
+    return rc[0]
 
 
 if __name__ == "__main__":

@@ -145,7 +145,13 @@ class JobLogStore:
             where.append(f"job_id IN ({','.join('?' * len(job_ids))})")
             args.extend(job_ids)
         if name_like:
-            where.append("name LIKE ?"); args.append(f"%{name_like}%")
+            # plain substring semantics: LIKE metacharacters in the
+            # needle are escaped so both result-store backends (this
+            # SQLite one and the native in-memory one) agree
+            esc = (name_like.replace("\\", "\\\\")
+                   .replace("%", r"\%").replace("_", r"\_"))
+            where.append(r"name LIKE ? ESCAPE '\'")
+            args.append(f"%{esc}%")
         if begin is not None:
             where.append("begin_ts >= ?"); args.append(begin)
         if end is not None:

@@ -1,0 +1,43 @@
+"""Launcher for the native (C++) result store server.
+
+``native/logd.cc`` implements the same wire protocol as
+:class:`~cronsun_tpu.logsink.serve.LogSinkServer` — in-memory tables
+with a WAL instead of SQLite, no GIL, bounded retention.
+``tests/test_logsink_remote.py`` runs the same conformance suite against
+both backends, exactly the StoreServer/stored.cc pairing on the
+coordination side.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..native_launcher import NativeProcess, find_binary as _find
+
+
+def find_binary(build: bool = True) -> Optional[str]:
+    return _find("cronsun-logd", "CRONSUN_LOGD", build)
+
+
+class NativeLogSinkServer(NativeProcess):
+    """Run cronsun-logd as a child process; same lifecycle surface as
+    the Python LogSinkServer (host/port/stop/monitor)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 binary: Optional[str] = None, db: Optional[str] = None,
+                 retain: Optional[int] = None, token: str = "",
+                 extra_args: Optional[List[str]] = None,
+                 ready_timeout: float = 10.0):
+        binary = binary or find_binary()
+        if binary is None:
+            raise FileNotFoundError(
+                "cronsun-logd not found (set $CRONSUN_LOGD or build "
+                "native/)")
+        self.binary = binary
+        argv = ["--host", host, "--port", str(port)] + (extra_args or [])
+        if db:
+            argv += ["--db", db]
+        if retain is not None:
+            argv += ["--retain", str(retain)]
+        super().__init__(binary, argv, token=token,
+                         ready_timeout=ready_timeout)

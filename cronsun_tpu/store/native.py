@@ -4,51 +4,22 @@
 :class:`~cronsun_tpu.store.remote.StoreServer` with memstore semantics —
 the production deployment runs it instead of the Python server (no GIL,
 O(log n) prefix scans, per-connection outboxes so a slow watcher can't
-stall mutations).  This module finds/builds the binary and manages it as
-a child process with the same surface as StoreServer (host, port, stop).
+stall mutations).  Spawn/READY/monitor/stop plumbing is the shared
+:mod:`cronsun_tpu.native_launcher`.
 """
 
 from __future__ import annotations
 
-import os
-import pathlib
-import select
-import shutil
-import subprocess
-import threading
-import time
-from typing import Callable, List, Optional
+from typing import List, Optional
 
-from .. import log
-
-_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
-_BINARY = "cronsun-stored"
+from ..native_launcher import NativeProcess, find_binary as _find
 
 
 def find_binary(build: bool = True) -> Optional[str]:
-    """Locate the server binary: $CRONSUN_STORED, then the repo's
-    native/ build, then $PATH.  With ``build``, compile it from source
-    when the binary is missing or older than stored.cc."""
-    env = os.environ.get("CRONSUN_STORED")
-    if env and os.access(env, os.X_OK):
-        return env
-    cand = _NATIVE_DIR / _BINARY
-    src = _NATIVE_DIR / "stored.cc"
-    if src.exists() and build:
-        stale = (not cand.exists()
-                 or cand.stat().st_mtime < src.stat().st_mtime)
-        if stale:
-            try:
-                subprocess.run(["make", "-C", str(_NATIVE_DIR)],
-                               check=True, capture_output=True, timeout=120)
-            except (subprocess.SubprocessError, OSError) as e:
-                log.warnf("native store build failed: %s", e)
-    if cand.exists() and os.access(cand, os.X_OK):
-        return str(cand)
-    return shutil.which(_BINARY)
+    return _find("cronsun-stored", "CRONSUN_STORED", build)
 
 
-class NativeStoreServer:
+class NativeStoreServer(NativeProcess):
     """Run cronsun-stored as a child process; same lifecycle surface as
     the Python StoreServer.  ``port=0`` picks a free port (the server
     prints the resolved one on its READY line)."""
@@ -58,85 +29,15 @@ class NativeStoreServer:
                  wal: Optional[str] = None, token: str = "",
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
-        self.binary = binary or find_binary()
-        if self.binary is None:
+        binary = binary or find_binary()
+        if binary is None:
             raise FileNotFoundError(
                 "cronsun-stored not found (set $CRONSUN_STORED or build "
                 "native/)")
-        argv = [self.binary, "--host", host, "--port", str(port),
-                "--history", str(history),
-                "--die-with-parent"] + (extra_args or [])
+        self.binary = binary
+        argv = ["--host", host, "--port", str(port),
+                "--history", str(history)] + (extra_args or [])
         if wal:
             argv += ["--wal", wal]
-        token_path = None
-        if token:
-            # hand the secret over in a 0600 file, not argv (argv is
-            # world-readable via /proc/<pid>/cmdline); removed once the
-            # child has read it
-            import tempfile
-            tfd, token_path = tempfile.mkstemp(prefix="cronsun-tok-")
-            os.write(tfd, token.encode())
-            os.close(tfd)
-            argv += ["--token-file", token_path]
-        # stderr merged into stdout so a startup failure (bind error …)
-        # surfaces in the exception instead of vanishing
-        try:
-            self._proc = subprocess.Popen(
-                argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True)
-            self._stopping = False
-            line = self._read_ready(ready_timeout)
-        finally:
-            if token_path:
-                try:
-                    os.unlink(token_path)
-                except OSError:
-                    pass
-        addr = line.split(" ", 1)[1]
-        self.host, port_s = addr.rsplit(":", 1)
-        self.port = int(port_s)
-
-    def _read_ready(self, timeout: float) -> str:
-        """Bounded wait for the READY line; on failure, kill the child and
-        raise with whatever it printed."""
-        fd = self._proc.stdout.fileno()
-        deadline = time.monotonic() + timeout
-        lines: List[str] = []
-        while time.monotonic() < deadline:
-            r, _, _ = select.select([fd], [], [],
-                                    max(0.0, deadline - time.monotonic()))
-            if not r:
-                break
-            line = self._proc.stdout.readline()
-            if not line:        # EOF: child exited
-                break
-            lines.append(line)
-            if line.startswith("READY "):
-                return line.strip()
-        self._proc.kill()
-        raise RuntimeError(
-            f"native store failed to start within {timeout}s: "
-            f"{''.join(lines).strip()!r}")
-
-    def monitor(self, on_exit: Callable[[int], None]):
-        """Watch the child; call ``on_exit(rc)`` if it dies without
-        :meth:`stop` — so a supervising process doesn't sit healthy-looking
-        in front of a dead store."""
-        def run():
-            rc = self._proc.wait()
-            if not self._stopping:
-                on_exit(rc)
-        threading.Thread(target=run, daemon=True,
-                         name="native-store-monitor").start()
-
-    def start(self) -> "NativeStoreServer":
-        return self     # already serving (READY consumed in __init__)
-
-    def stop(self):
-        self._stopping = True
-        if self._proc.poll() is None:
-            self._proc.terminate()
-            try:
-                self._proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                self._proc.kill()
+        super().__init__(binary, argv, token=token,
+                         ready_timeout=ready_timeout)

@@ -1,0 +1,892 @@
+// cronsun-logd: the native result-store server.
+//
+// The rebuild's MongoDB (reference db/mgo.go:24-49, job_log.go:84-133):
+// execution logs, latest-status per (job, node), success/fail counters
+// (overall + per-day), the node-liveness mirror, and accounts — served
+// over the exact line-JSON protocol of cronsun_tpu/logsink/serve.py, so
+// the Python RemoteJobLogStore client (agents, web, noticer) runs
+// unchanged against it.  tests/test_logsink_remote.py is the
+// conformance suite for both backends.
+//
+// Storage model: in-memory tables + a write-ahead log.  Every mutation
+// appends one JSON-array line (flushed to the OS immediately; fdatasync
+// rides a sweeper, --fsync-per-commit closes the window); boot replays
+// the file and rewrites it as a compacted snapshot.  Execution history
+// is bounded by --retain (default 1M records): older rows age out of
+// memory and the WAL at compaction, while the stats counters and the
+// latest-status table — which summarize all history — are snapshotted
+// explicitly and never lose counts.
+//
+// Build: make -C native   (g++ -O2 -std=c++17 -pthread)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/prctl.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "njson.h"
+
+// ---------------------------------------------------------------------------
+// records
+// ---------------------------------------------------------------------------
+
+struct Rec {
+  long long id = 0;
+  std::string job_id, group, name, node, user, command, output;
+  bool success = false;
+  double begin = 0, end = 0;
+};
+
+// LogRecord wire form: plain dict of the Python dataclass fields.
+static void rec_wire(std::string& out, const Rec& r, bool with_id) {
+  out += "{\"job_id\":";
+  jesc(out, r.job_id);
+  out += ",\"job_group\":";
+  jesc(out, r.group);
+  out += ",\"name\":";
+  jesc(out, r.name);
+  out += ",\"node\":";
+  jesc(out, r.node);
+  out += ",\"user\":";
+  jesc(out, r.user);
+  out += ",\"command\":";
+  jesc(out, r.command);
+  out += ",\"output\":";
+  jesc(out, r.output);
+  out += ",\"success\":";
+  out += r.success ? "true" : "false";
+  out += ",\"begin_ts\":";
+  jdbl(out, r.begin);
+  out += ",\"end_ts\":";
+  jdbl(out, r.end);
+  out += ",\"id\":";
+  if (with_id) jint(out, r.id);
+  else out += "null";
+  out += '}';
+}
+
+static bool rec_unwire(const JV& o, Rec& r) {
+  if (o.t != JV::OBJ) return false;
+  auto str_of = [&](const char* k, std::string& dst) {
+    const JV* v = o.get(k);
+    if (v && v->t == JV::STR) dst = v->s;
+  };
+  str_of("job_id", r.job_id);
+  str_of("job_group", r.group);
+  str_of("name", r.name);
+  str_of("node", r.node);
+  str_of("user", r.user);
+  str_of("command", r.command);
+  str_of("output", r.output);
+  if (const JV* v = o.get("success")) r.success = v->t == JV::BOOL ? v->b : v->as_int() != 0;
+  if (const JV* v = o.get("begin_ts")) r.begin = v->as_dbl();
+  if (const JV* v = o.get("end_ts")) r.end = v->as_dbl();
+  return true;
+}
+
+static std::string day_of(double ts) {
+  time_t t = (time_t)ts;
+  struct tm g;
+  gmtime_r(&t, &g);
+  char buf[40];
+  snprintf(buf, sizeof buf, "%04d-%02d-%02d", g.tm_year + 1900, g.tm_mon + 1,
+           g.tm_mday);
+  return buf;
+}
+
+// ASCII case-insensitive substring — the semantics of SQLite's
+// LIKE '%x%' that the Python JobLogStore defines the contract with.
+static bool contains_nocase(const std::string& hay, const std::string& needle) {
+  if (needle.empty()) return true;
+  auto low = [](unsigned char c) {
+    return (c >= 'A' && c <= 'Z') ? (char)(c + 32) : (char)c;
+  };
+  for (size_t i = 0; i + needle.size() <= hay.size(); i++) {
+    size_t j = 0;
+    while (j < needle.size() && low(hay[i + j]) == low(needle[j])) j++;
+    if (j == needle.size()) return true;
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// WAL (same design as stored.cc's: append + flush now, fdatasync by
+// sweeper or per-commit; boot replay then compacted snapshot rewrite)
+// ---------------------------------------------------------------------------
+
+class Wal {
+ public:
+  bool open_append(const std::string& path, bool sync_per_commit) {
+    std::lock_guard<std::mutex> g(mu_);
+    f_ = fopen(path.c_str(), "a");
+    sync_per_commit_ = sync_per_commit;
+    return f_ != nullptr;
+  }
+  void append(const std::string& line) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_) return;
+    if (fwrite(line.data(), 1, line.size(), f_) != line.size() ||
+        fputc('\n', f_) == EOF || fflush(f_) != 0) {
+      fprintf(stderr, "FATAL: wal append failed: %s\n", strerror(errno));
+      abort();
+    }
+    if (sync_per_commit_ && fdatasync(fileno(f_)) != 0) {
+      fprintf(stderr, "FATAL: wal fdatasync failed: %s\n", strerror(errno));
+      abort();
+    }
+  }
+  void sync() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (f_) fdatasync(fileno(f_));
+  }
+
+ private:
+  FILE* f_ = nullptr;
+  bool sync_per_commit_ = false;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------------------
+// the store
+// ---------------------------------------------------------------------------
+
+struct Stat {
+  long long total = 0, ok = 0, fail = 0;
+};
+
+class LogStore {
+ public:
+  explicit LogStore(size_t retain) : retain_(retain) {}
+
+  // -- mutations ---------------------------------------------------------
+
+  long long create(Rec r, const std::string& idem) {
+    std::lock_guard<std::mutex> g(mu);
+    if (!idem.empty()) {
+      auto it = idem_.find(idem);
+      if (it != idem_.end()) return it->second;  // replayed retry
+    }
+    r.id = next_id_++;
+    apply_create(r);
+    if (wal_) {
+      std::string line;
+      wal_create(line, r);
+      wal_->append(line);
+    }
+    if (!idem.empty()) {
+      idem_[idem] = r.id;
+      idem_fifo_.push_back(idem);
+      while (idem_fifo_.size() > 8192) {
+        idem_.erase(idem_fifo_.front());
+        idem_fifo_.pop_front();
+      }
+    }
+    return r.id;
+  }
+
+  void upsert_node(const std::string& id, const std::string& doc, bool alived) {
+    std::lock_guard<std::mutex> g(mu);
+    nodes_[id] = {doc, alived};
+    if (wal_) {
+      std::string line = "[\"N\",";
+      jesc(line, id);
+      line += ',';
+      jesc(line, doc);
+      line += alived ? ",true]" : ",false]";
+      wal_->append(line);
+    }
+  }
+
+  void set_node_alived(const std::string& id, bool alived) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = nodes_.find(id);
+    if (it != nodes_.end()) it->second.second = alived;
+    if (wal_) {
+      std::string line = "[\"S\",";
+      jesc(line, id);
+      line += alived ? ",true]" : ",false]";
+      wal_->append(line);
+    }
+  }
+
+  void upsert_account(const std::string& email, const std::string& doc) {
+    std::lock_guard<std::mutex> g(mu);
+    accounts_[email] = doc;
+    if (wal_) {
+      std::string line = "[\"A\",";
+      jesc(line, email);
+      line += ',';
+      jesc(line, doc);
+      line += ']';
+      wal_->append(line);
+    }
+  }
+
+  bool delete_account(const std::string& email) {
+    std::lock_guard<std::mutex> g(mu);
+    bool had = accounts_.erase(email) > 0;
+    if (had && wal_) {
+      std::string line = "[\"D\",";
+      jesc(line, email);
+      line += ']';
+      wal_->append(line);
+    }
+    return had;
+  }
+
+  // -- queries (reply JSON built under the lock: rows are snapshots) ----
+
+  // filters mirror JobLogStore.query_logs (joblog.py): node, job_ids,
+  // name substring, [begin, end), failed_only, latest view, paging
+  void query(const JV& kw, std::string& res) {
+    std::string node, name_like;
+    std::vector<std::string> job_ids;
+    bool has_begin = false, has_end = false, failed_only = false,
+         latest = false;
+    double begin = 0, end = 0;
+    long long page = 1, page_size = 50;
+    if (kw.t == JV::OBJ) {
+      if (const JV* v = kw.get("node"))
+        if (v->t == JV::STR) node = v->s;
+      if (const JV* v = kw.get("name_like"))
+        if (v->t == JV::STR) name_like = v->s;
+      if (const JV* v = kw.get("job_ids"))
+        if (v->t == JV::ARR)
+          for (const JV& e : v->arr)
+            if (e.t == JV::STR) job_ids.push_back(e.s);
+      if (const JV* v = kw.get("begin"))
+        if (v->t == JV::INT || v->t == JV::DBL) { has_begin = true; begin = v->as_dbl(); }
+      if (const JV* v = kw.get("end"))
+        if (v->t == JV::INT || v->t == JV::DBL) { has_end = true; end = v->as_dbl(); }
+      if (const JV* v = kw.get("failed_only")) failed_only = v->t == JV::BOOL && v->b;
+      if (const JV* v = kw.get("latest")) latest = v->t == JV::BOOL && v->b;
+      if (const JV* v = kw.get("page")) page = std::max(1LL, v->as_int());
+      if (const JV* v = kw.get("page_size"))
+        page_size = std::max(1LL, std::min(500LL, v->as_int()));
+    }
+    auto match = [&](const Rec& r) {
+      if (!node.empty() && r.node != node) return false;
+      if (!job_ids.empty() &&
+          std::find(job_ids.begin(), job_ids.end(), r.job_id) == job_ids.end())
+        return false;
+      if (!name_like.empty() && !contains_nocase(r.name, name_like)) return false;
+      if (has_begin && r.begin < begin) return false;
+      if (has_end && r.begin >= end) return false;
+      if (failed_only && r.success) return false;
+      return true;
+    };
+
+    std::lock_guard<std::mutex> g(mu);
+    std::vector<const Rec*> hits;
+    if (latest) {
+      for (const auto& [k, r] : latest_)
+        if (match(r)) hits.push_back(&r);
+    } else {
+      for (const Rec& r : recs_)
+        if (match(r)) hits.push_back(&r);
+    }
+    // ORDER BY begin_ts DESC (ties: newest id first — deterministic)
+    std::stable_sort(hits.begin(), hits.end(), [](const Rec* a, const Rec* b) {
+      if (a->begin != b->begin) return a->begin > b->begin;
+      return a->id > b->id;
+    });
+    size_t off = (size_t)((page - 1) * page_size);
+    res += "{\"total\":";
+    jint(res, (long long)hits.size());
+    res += ",\"list\":[";
+    for (size_t i = off; i < hits.size() && i < off + (size_t)page_size; i++) {
+      if (i != off) res += ',';
+      rec_wire(res, *hits[i], /*with_id=*/!latest);
+    }
+    res += "]}";
+  }
+
+  bool get_log(long long id, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    if (recs_.empty() || id < recs_.front().id || id > recs_.back().id)
+      return false;
+    const Rec& r = recs_[(size_t)(id - recs_.front().id)];
+    rec_wire(res, r, true);
+    return true;
+  }
+
+  void stat(const std::string& day, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    Stat s;
+    auto it = stats_.find(day);
+    if (it != stats_.end()) s = it->second;
+    stat_wire(res, s, nullptr);
+  }
+
+  void stat_days(long long n, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    res += '[';
+    long long emitted = 0;
+    for (auto it = stats_.rbegin(); it != stats_.rend() && emitted < n; ++it) {
+      if (it->first.empty()) continue;            // '' = overall
+      if (emitted) res += ',';
+      stat_wire(res, it->second, &it->first);
+      emitted++;
+    }
+    res += ']';
+  }
+
+  // node docs are stored JSON objects; alived is injected on the way out
+  // (the Python server json-decodes and re-encodes — same wire result)
+  void get_nodes(std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    res += '[';
+    bool first = true;
+    for (const auto& [id, dv] : nodes_) {
+      if (!first) res += ',';
+      first = false;
+      node_wire(res, dv.first, dv.second);
+    }
+    res += ']';
+  }
+
+  bool get_node(const std::string& id, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = nodes_.find(id);
+    if (it == nodes_.end()) return false;
+    node_wire(res, it->second.first, it->second.second);
+    return true;
+  }
+
+  bool get_account(const std::string& email, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    auto it = accounts_.find(email);
+    if (it == accounts_.end()) return false;
+    jesc(res, it->second);          // doc travels as a STRING
+    return true;
+  }
+
+  void list_accounts(std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    res += '[';
+    bool first = true;
+    for (const auto& [email, doc] : accounts_) {
+      if (!first) res += ',';
+      first = false;
+      jesc(res, doc);
+    }
+    res += ']';
+  }
+
+  // -- WAL open/replay/compact ------------------------------------------
+
+  bool open_wal(const std::string& path, std::string& err,
+                bool sync_per_commit) {
+    std::lock_guard<std::mutex> g(mu);
+    FILE* f = fopen(path.c_str(), "r");
+    if (f) {
+      char* lineptr = nullptr;
+      size_t cap = 0;
+      ssize_t n;
+      std::string line;
+      bool bad = false;
+      while ((n = getline(&lineptr, &cap, f)) != -1) {
+        line.assign(lineptr, (size_t)n);
+        while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+          line.pop_back();
+        if (!line.empty() && !replay_line(line)) {
+          bad = true;   // torn final record (crash mid-append) is fine
+          break;
+        }
+      }
+      if (bad && getline(&lineptr, &cap, f) != -1) {
+        err = "corrupt wal record: " + line.substr(0, 200);
+        free(lineptr);
+        fclose(f);
+        return false;
+      }
+      free(lineptr);
+      fclose(f);
+    }
+    // compacted snapshot -> temp file -> atomic rename.  Stats and the
+    // latest table summarize ALL history, so they snapshot explicitly;
+    // only the retained record window re-emits as "L" lines.  Lines
+    // stream one at a time (never the whole snapshot in memory) and
+    // every write is CHECKED — an ENOSPC mid-snapshot must abort before
+    // the rename, not silently truncate the only copy of history.
+    std::string tmp = path + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "w");
+    if (!out) {
+      err = "cannot write " + tmp;
+      return false;
+    }
+    std::string line;
+    bool wok = true;
+    auto emit = [&]() {
+      line += '\n';
+      wok = wok && fwrite(line.data(), 1, line.size(), out) == line.size();
+      line.clear();
+    };
+    line = "[\"v\",";
+    jint(line, next_id_);
+    line += ']';
+    emit();
+    for (const auto& [day, s] : stats_) {
+      line = "[\"C\",";
+      jesc(line, day);
+      line += ',';
+      jint(line, s.total);
+      line += ',';
+      jint(line, s.ok);
+      line += ',';
+      jint(line, s.fail);
+      line += ']';
+      emit();
+    }
+    for (const auto& [key, r] : latest_) {
+      line = "[\"T\",";
+      rec_body(line, r);
+      line += ']';
+      emit();
+    }
+    for (const auto& [id, dv] : nodes_) {
+      line = "[\"N\",";
+      jesc(line, id);
+      line += ',';
+      jesc(line, dv.first);
+      line += dv.second ? ",true]" : ",false]";
+      emit();
+    }
+    for (const auto& [email, doc] : accounts_) {
+      line = "[\"A\",";
+      jesc(line, email);
+      line += ',';
+      jesc(line, doc);
+      line += ']';
+      emit();
+    }
+    for (const Rec& r : recs_) {
+      wal_create(line, r);
+      emit();
+    }
+    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
+    fclose(out);
+    if (!wok) {
+      remove(tmp.c_str());
+      err = "snapshot write to " + tmp + " failed: " + strerror(errno);
+      return false;
+    }
+    if (rename(tmp.c_str(), path.c_str()) != 0) {
+      err = "rename failed for " + tmp;
+      return false;
+    }
+    wal_ = &wal_storage_;
+    if (!wal_->open_append(path, sync_per_commit)) {
+      err = "cannot append to " + path;
+      wal_ = nullptr;
+      return false;
+    }
+    return true;
+  }
+
+  void sweep() {
+    if (wal_) wal_->sync();
+  }
+
+ private:
+  void apply_create(const Rec& r) {
+    // the retained window stays contiguous in id: get_log indexes by
+    // id - front.id
+    recs_.push_back(r);
+    while (recs_.size() > retain_) recs_.pop_front();
+    latest_[{r.job_id, r.node}] = r;
+    for (const std::string& day : {std::string(), day_of(r.begin)}) {
+      Stat& s = stats_[day];
+      s.total++;
+      (r.success ? s.ok : s.fail)++;
+    }
+  }
+
+  static void rec_body(std::string& out, const Rec& r) {
+    jint(out, r.id);
+    out += ',';
+    jesc(out, r.job_id);
+    out += ',';
+    jesc(out, r.group);
+    out += ',';
+    jesc(out, r.name);
+    out += ',';
+    jesc(out, r.node);
+    out += ',';
+    jesc(out, r.user);
+    out += ',';
+    jesc(out, r.command);
+    out += ',';
+    jesc(out, r.output);
+    out += r.success ? ",true," : ",false,";
+    jdbl(out, r.begin);
+    out += ',';
+    jdbl(out, r.end);
+  }
+
+  static void wal_create(std::string& out, const Rec& r) {
+    out += "[\"L\",";
+    rec_body(out, r);
+    out += ']';
+  }
+
+  static void stat_wire(std::string& out, const Stat& s,
+                        const std::string* day) {
+    out += '{';
+    if (day) {
+      out += "\"day\":";
+      jesc(out, *day);
+      out += ',';
+    }
+    out += "\"total\":";
+    jint(out, s.total);
+    out += ",\"successed\":";
+    jint(out, s.ok);
+    out += ",\"failed\":";
+    jint(out, s.fail);
+    out += '}';
+  }
+
+  static void node_wire(std::string& out, const std::string& doc,
+                        bool alived) {
+    // inject "alived" into the stored JSON object text
+    size_t close = doc.rfind('}');
+    if (doc.empty() || close == std::string::npos) {
+      out += alived ? "{\"alived\":true}" : "{\"alived\":false}";
+      return;
+    }
+    bool empty_obj = doc.find_first_not_of(" \t{", doc.find('{') + 0) == close;
+    out.append(doc, 0, close);
+    if (!empty_obj) out += ',';
+    out += alived ? "\"alived\":true}" : "\"alived\":false}";
+  }
+
+  static bool parse_rec(const JV& a, size_t off, Rec& r) {
+    if (a.arr.size() < off + 11) return false;
+    auto S = [&](size_t i) { return a.arr[off + i].s; };
+    r.id = a.arr[off + 0].as_int();
+    r.job_id = S(1);
+    r.group = S(2);
+    r.name = S(3);
+    r.node = S(4);
+    r.user = S(5);
+    r.command = S(6);
+    r.output = S(7);
+    r.success = a.arr[off + 8].t == JV::BOOL && a.arr[off + 8].b;
+    r.begin = a.arr[off + 9].as_dbl();
+    r.end = a.arr[off + 10].as_dbl();
+    return true;
+  }
+
+  bool replay_line(const std::string& line) {
+    JParser jp(line);
+    JV v;
+    if (!jp.value(v) || v.t != JV::ARR || v.arr.empty() ||
+        v.arr[0].t != JV::STR)
+      return false;
+    const std::string& tag = v.arr[0].s;
+    if (tag == "v") {
+      if (v.arr.size() < 2) return false;
+      next_id_ = v.arr[1].as_int();
+    } else if (tag == "L") {
+      Rec r;
+      if (!parse_rec(v, 1, r)) return false;
+      // replayed retained records must NOT re-bump stats/latest when a
+      // "C"/"T" snapshot already accounts for them — snapshot lines
+      // always precede "L" lines in a compacted file, so replay is
+      // additive only for post-snapshot appends ... which also re-count
+      // via apply_create.  To keep one code path, compaction rewrites
+      // stats BEFORE records and replay of an L line only bumps stats
+      // when the record's id is >= the snapshot watermark (next_id_ at
+      // snapshot time is carried by the "v" line, which precedes all).
+      bool post_snapshot = r.id >= snapshot_watermark_;
+      recs_.push_back(r);
+      while (recs_.size() > retain_) recs_.pop_front();
+      // a retained pre-snapshot record must not clobber a NEWER latest
+      // entry restored from its "T" snapshot (that record may have aged
+      // out of the retention window)
+      auto lit = latest_.find({r.job_id, r.node});
+      if (lit == latest_.end() || r.id >= lit->second.id)
+        latest_[{r.job_id, r.node}] = r;
+      if (post_snapshot) {
+        for (const std::string& day : {std::string(), day_of(r.begin)}) {
+          Stat& s = stats_[day];
+          s.total++;
+          (r.success ? s.ok : s.fail)++;
+        }
+      }
+      if (r.id >= next_id_) next_id_ = r.id + 1;
+    } else if (tag == "T") {
+      Rec r;
+      if (!parse_rec(v, 1, r)) return false;
+      latest_[{r.job_id, r.node}] = r;
+    } else if (tag == "C") {
+      if (v.arr.size() < 5) return false;
+      Stat& s = stats_[v.arr[1].s];
+      s.total = v.arr[2].as_int();
+      s.ok = v.arr[3].as_int();
+      s.fail = v.arr[4].as_int();
+      snapshot_watermark_ = next_id_;
+    } else if (tag == "N") {
+      if (v.arr.size() < 4) return false;
+      nodes_[v.arr[1].s] = {v.arr[2].s, v.arr[3].t == JV::BOOL && v.arr[3].b};
+    } else if (tag == "S") {
+      if (v.arr.size() < 3) return false;
+      auto it = nodes_.find(v.arr[1].s);
+      if (it != nodes_.end())
+        it->second.second = v.arr[2].t == JV::BOOL && v.arr[2].b;
+    } else if (tag == "A") {
+      if (v.arr.size() < 3) return false;
+      accounts_[v.arr[1].s] = v.arr[2].s;
+    } else if (tag == "D") {
+      if (v.arr.size() < 2) return false;
+      accounts_.erase(v.arr[1].s);
+    } else {
+      return false;
+    }
+    return true;
+  }
+
+  std::mutex mu;
+  size_t retain_;
+  long long next_id_ = 1;
+  long long snapshot_watermark_ = 0;
+  std::deque<Rec> recs_;
+  std::map<std::pair<std::string, std::string>, Rec> latest_;
+  std::map<std::string, Stat> stats_;
+  std::map<std::string, std::pair<std::string, bool>> nodes_;
+  std::map<std::string, std::string> accounts_;
+  std::unordered_map<std::string, long long> idem_;
+  std::deque<std::string> idem_fifo_;
+  Wal wal_storage_;
+  Wal* wal_ = nullptr;
+};
+
+// ---------------------------------------------------------------------------
+// connections: request/response only (no pushes) — one thread per conn
+// ---------------------------------------------------------------------------
+
+static std::string g_token;
+
+static std::string arg_s(const JV& a, size_t i) {
+  return i < a.arr.size() && a.arr[i].t == JV::STR ? a.arr[i].s : std::string();
+}
+
+static bool arg_b(const JV& a, size_t i) {
+  return i < a.arr.size() && a.arr[i].t == JV::BOOL && a.arr[i].b;
+}
+
+static void handle(LogStore& store, const std::string& line, bool& authed,
+                   std::string& out) {
+  long long rid = 0;
+  std::string op;
+  JV args;
+  if (!parse_request(line, rid, op, args)) {
+    out.clear();               // protocol violation: caller drops the conn
+    return;
+  }
+  out = "{\"i\":";
+  jint(out, rid);
+  if (!authed) {
+    if (op == "auth" && token_eq(arg_s(args, 0), g_token)) {
+      authed = true;
+      out += ",\"r\":true}\n";
+      return;
+    }
+    out += ",\"e\":\"unauthenticated\"}\n";
+    out += '\0';               // sentinel: reply then close (see caller)
+    return;
+  }
+  std::string res;
+  if (op == "auth") {
+    res = "true";
+  } else if (op == "create_job_log") {
+    Rec r;
+    if (args.arr.empty() || !rec_unwire(args.arr[0], r)) {
+      out += ",\"e\":\"bad record\"}\n";
+      return;
+    }
+    jint(res, store.create(std::move(r), arg_s(args, 1)));
+  } else if (op == "query_logs") {
+    store.query(args.arr.empty() ? JV{} : args.arr[0], res);
+  } else if (op == "get_log") {
+    long long id = args.arr.empty() ? 0 : args.arr[0].as_int();
+    if (!store.get_log(id, res)) res = "null";
+  } else if (op == "stat_overall") {
+    store.stat("", res);
+  } else if (op == "stat_day") {
+    store.stat(arg_s(args, 0), res);
+  } else if (op == "stat_days") {
+    store.stat_days(args.arr.empty() ? 0 : args.arr[0].as_int(), res);
+  } else if (op == "upsert_node") {
+    store.upsert_node(arg_s(args, 0), arg_s(args, 1), arg_b(args, 2));
+    res = "null";
+  } else if (op == "set_node_alived") {
+    store.set_node_alived(arg_s(args, 0), arg_b(args, 1));
+    res = "null";
+  } else if (op == "get_nodes") {
+    store.get_nodes(res);
+  } else if (op == "get_node") {
+    if (!store.get_node(arg_s(args, 0), res)) res = "null";
+  } else if (op == "upsert_account") {
+    store.upsert_account(arg_s(args, 0), arg_s(args, 1));
+    res = "null";
+  } else if (op == "get_account") {
+    if (!store.get_account(arg_s(args, 0), res)) res = "null";
+  } else if (op == "list_accounts") {
+    store.list_accounts(res);
+  } else if (op == "delete_account") {
+    res = store.delete_account(arg_s(args, 0)) ? "true" : "false";
+  } else {
+    out += ",\"e\":";
+    jesc(out, "unknown op " + op);
+    out += "}\n";
+    return;
+  }
+  out += ",\"r\":";
+  out += res;
+  out += "}\n";
+}
+
+static void serve_conn(int fd, LogStore* store) {
+  bool authed = g_token.empty();
+  std::string buf;
+  char chunk[65536];
+  while (true) {
+    ssize_t n = ::recv(fd, chunk, sizeof chunk, 0);
+    if (n <= 0) break;
+    buf.append(chunk, (size_t)n);
+    size_t start = 0;
+    bool closing = false;
+    while (true) {
+      size_t nl = buf.find('\n', start);
+      if (nl == std::string::npos) break;
+      std::string out;
+      handle(*store, buf.substr(start, nl - start), authed, out);
+      start = nl + 1;
+      if (out.empty()) { closing = true; break; }   // protocol violation
+      if (!out.empty() && out.back() == '\0') {     // auth refusal
+        out.pop_back();
+        closing = true;
+      }
+      size_t off = 0;
+      while (off < out.size()) {
+        ssize_t w = ::send(fd, out.data() + off, out.size() - off,
+                           MSG_NOSIGNAL);
+        if (w <= 0) { closing = true; break; }
+        off += (size_t)w;
+      }
+      if (closing) break;
+    }
+    if (closing) break;
+    if (start) buf.erase(0, start);
+  }
+  ::close(fd);
+}
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  std::string wal_path;
+  bool fsync_per_commit = false;
+  int port = 7078;
+  size_t retain = 1u << 20;
+  double sweep_s = 0.5;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--host") host = next();
+    else if (a == "--port") port = atoi(next());
+    else if (a == "--db" || a == "--wal") wal_path = next();
+    else if (a == "--retain") retain = (size_t)atoll(next());
+    else if (a == "--sweep-interval") sweep_s = atof(next());
+    else if (a == "--fsync-per-commit") fsync_per_commit = true;
+    else if (a == "--token") g_token = next();
+    else if (a == "--token-file") {
+      FILE* tf = fopen(next(), "r");
+      if (!tf) { fprintf(stderr, "cannot read token file\n"); return 1; }
+      char tbuf[4096];
+      size_t tn = fread(tbuf, 1, sizeof tbuf, tf);
+      if (tn == sizeof tbuf) {
+        fprintf(stderr, "token file exceeds %zu bytes\n", sizeof tbuf - 1);
+        fclose(tf);
+        return 1;
+      }
+      fclose(tf);
+      while (tn && (tbuf[tn - 1] == '\n' || tbuf[tn - 1] == '\r')) tn--;
+      g_token.assign(tbuf, tn);
+    }
+    else if (a == "--die-with-parent") {
+      prctl(PR_SET_PDEATHSIG, SIGKILL);
+      if (getppid() == 1) return 1;
+    }
+    else if (a == "--help") {
+      printf("cronsun-logd --host H --port P [--db FILE] [--retain N] "
+             "[--sweep-interval S] [--fsync-per-commit] "
+             "[--token T | --token-file F] [--die-with-parent]\n");
+      return 0;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad host %s\n", host.c_str());
+    return 1;
+  }
+  if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 512) != 0) {
+    perror("listen");
+    return 1;
+  }
+  static LogStore store(retain);
+  if (!wal_path.empty()) {
+    std::string err;
+    if (!store.open_wal(wal_path, err, fsync_per_commit)) {
+      fprintf(stderr, "wal: %s\n", err.c_str());
+      return 1;
+    }
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (sockaddr*)&addr, &alen);
+  printf("READY %s:%d\n", host.c_str(), (int)ntohs(addr.sin_port));
+  fflush(stdout);
+  std::thread([&] {
+    while (true) {
+      std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));
+      store.sweep();
+    }
+  }).detach();
+
+  while (true) {
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::thread(serve_conn, fd, &store).detach();
+  }
+}

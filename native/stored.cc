@@ -44,223 +44,7 @@
 #include <unordered_map>
 #include <vector>
 
-// ---------------------------------------------------------------------------
-// minimal JSON (the protocol uses objects, arrays, strings, numbers, bools)
-// ---------------------------------------------------------------------------
-
-struct JV {
-  enum T { NUL, BOOL, INT, DBL, STR, ARR } t = NUL;
-  bool b = false;
-  long long i = 0;
-  double d = 0;
-  std::string s;
-  std::vector<JV> arr;
-
-  long long as_int() const { return t == DBL ? (long long)d : i; }
-  double as_dbl() const { return t == INT ? (double)i : d; }
-};
-
-struct JParser {
-  const char* p;
-  const char* end;
-  bool ok = true;
-
-  explicit JParser(const std::string& in) : p(in.data()), end(in.data() + in.size()) {}
-
-  void ws() { while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++; }
-  bool fail() { ok = false; return false; }
-
-  bool lit(const char* w, size_t n) {
-    if ((size_t)(end - p) < n || memcmp(p, w, n) != 0) return fail();
-    p += n;
-    return true;
-  }
-
-  // parses a value; top-level object fields are captured by the caller
-  bool value(JV& out) {
-    ws();
-    if (p >= end) return fail();
-    switch (*p) {
-      case '{': return fail();  // nested objects never occur in the protocol
-      case '[': {
-        p++;
-        out.t = JV::ARR;
-        ws();
-        if (p < end && *p == ']') { p++; return true; }
-        while (true) {
-          out.arr.emplace_back();
-          if (!value(out.arr.back())) return false;
-          ws();
-          if (p < end && *p == ',') { p++; continue; }
-          if (p < end && *p == ']') { p++; return true; }
-          return fail();
-        }
-      }
-      case '"': out.t = JV::STR; return str(out.s);
-      case 't': out.t = JV::BOOL; out.b = true; return lit("true", 4);
-      case 'f': out.t = JV::BOOL; out.b = false; return lit("false", 5);
-      case 'n': out.t = JV::NUL; return lit("null", 4);
-      default: return num(out);
-    }
-  }
-
-  bool hex4(unsigned& v) {
-    if (end - p < 4) return fail();
-    v = 0;
-    for (int k = 0; k < 4; k++) {
-      char c = *p++;
-      v <<= 4;
-      if (c >= '0' && c <= '9') v |= (unsigned)(c - '0');
-      else if (c >= 'a' && c <= 'f') v |= (unsigned)(c - 'a' + 10);
-      else if (c >= 'A' && c <= 'F') v |= (unsigned)(c - 'A' + 10);
-      else return fail();
-    }
-    return true;
-  }
-
-  void utf8(std::string& s, unsigned cp) {
-    if (cp < 0x80) s += (char)cp;
-    else if (cp < 0x800) {
-      s += (char)(0xC0 | (cp >> 6));
-      s += (char)(0x80 | (cp & 0x3F));
-    } else if (cp < 0x10000) {
-      s += (char)(0xE0 | (cp >> 12));
-      s += (char)(0x80 | ((cp >> 6) & 0x3F));
-      s += (char)(0x80 | (cp & 0x3F));
-    } else {
-      s += (char)(0xF0 | (cp >> 18));
-      s += (char)(0x80 | ((cp >> 12) & 0x3F));
-      s += (char)(0x80 | ((cp >> 6) & 0x3F));
-      s += (char)(0x80 | (cp & 0x3F));
-    }
-  }
-
-  bool str(std::string& s) {
-    if (*p != '"') return fail();
-    p++;
-    while (p < end) {
-      char c = *p++;
-      if (c == '"') return true;
-      if (c == '\\') {
-        if (p >= end) return fail();
-        char e = *p++;
-        switch (e) {
-          case '"': s += '"'; break;
-          case '\\': s += '\\'; break;
-          case '/': s += '/'; break;
-          case 'b': s += '\b'; break;
-          case 'f': s += '\f'; break;
-          case 'n': s += '\n'; break;
-          case 'r': s += '\r'; break;
-          case 't': s += '\t'; break;
-          case 'u': {
-            unsigned v;
-            if (!hex4(v)) return false;
-            if (v >= 0xD800 && v <= 0xDBFF && end - p >= 6 && p[0] == '\\' && p[1] == 'u') {
-              p += 2;
-              unsigned lo;
-              if (!hex4(lo)) return false;
-              v = 0x10000 + ((v - 0xD800) << 10) + (lo - 0xDC00);
-            }
-            utf8(s, v);
-            break;
-          }
-          default: return fail();
-        }
-      } else {
-        s += c;
-      }
-    }
-    return fail();
-  }
-
-  bool num(JV& out) {
-    const char* start = p;
-    bool isdbl = false;
-    if (p < end && (*p == '-' || *p == '+')) p++;
-    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
-      if (*p == '.' || *p == 'e' || *p == 'E') isdbl = true;
-      p++;
-    }
-    if (p == start) return fail();
-    std::string tok(start, p);
-    if (isdbl) {
-      out.t = JV::DBL;
-      out.d = strtod(tok.c_str(), nullptr);
-    } else {
-      out.t = JV::INT;
-      out.i = strtoll(tok.c_str(), nullptr, 10);
-    }
-    return true;
-  }
-};
-
-// Parse a protocol request line: {"i": <id>, "o": <op>, "a": [...]}
-// (flat object of known fields — full object parsing isn't needed).
-static bool parse_request(const std::string& line, long long& rid, std::string& op, JV& args) {
-  JParser jp(line);
-  jp.ws();
-  if (jp.p >= jp.end || *jp.p != '{') return false;
-  jp.p++;
-  bool have_i = false, have_o = false;
-  args.t = JV::ARR;
-  while (true) {
-    jp.ws();
-    if (jp.p < jp.end && *jp.p == '}') return have_i && have_o;
-    std::string k;
-    if (!jp.str(k)) return false;
-    jp.ws();
-    if (jp.p >= jp.end || *jp.p != ':') return false;
-    jp.p++;
-    JV v;
-    if (!jp.value(v)) return false;
-    if (k == "i" && v.t == JV::INT) { rid = v.i; have_i = true; }
-    else if (k == "o" && v.t == JV::STR) { op = std::move(v.s); have_o = true; }
-    else if (k == "a" && v.t == JV::ARR) { args = std::move(v); }
-    jp.ws();
-    if (jp.p < jp.end && *jp.p == ',') { jp.p++; continue; }
-    jp.ws();
-    if (jp.p < jp.end && *jp.p == '}') return have_i && have_o;
-    return false;
-  }
-}
-
-static void jesc(std::string& out, const std::string& s) {
-  out += '"';
-  for (unsigned char c : s) {
-    switch (c) {
-      case '"': out += "\\\""; break;
-      case '\\': out += "\\\\"; break;
-      case '\n': out += "\\n"; break;
-      case '\r': out += "\\r"; break;
-      case '\t': out += "\\t"; break;
-      case '\b': out += "\\b"; break;
-      case '\f': out += "\\f"; break;
-      default:
-        if (c < 0x20) {
-          char buf[8];
-          snprintf(buf, sizeof buf, "\\u%04x", c);
-          out += buf;
-        } else {
-          out += (char)c;  // raw UTF-8 passes through
-        }
-    }
-  }
-  out += '"';
-}
-
-static void jint(std::string& out, long long v) {
-  char buf[24];
-  snprintf(buf, sizeof buf, "%lld", v);
-  out += buf;
-}
-
-static void jdbl(std::string& out, double v) {
-  char buf[32];
-  snprintf(buf, sizeof buf, "%.17g", v);
-  // a bare integer-looking double is still valid JSON; keep as-is
-  out += buf;
-}
+#include "njson.h"
 
 // ---------------------------------------------------------------------------
 // store (memstore.py semantics)
@@ -575,18 +359,29 @@ class Store {
     }
     replaying_ = false;
 
-    // compacted snapshot -> temp file -> atomic rename
+    // compacted snapshot -> temp file -> atomic rename.  Lines stream
+    // one at a time and every write is CHECKED — an ENOSPC mid-snapshot
+    // must abort before the rename, not silently truncate the only
+    // copy of the state.
     std::string tmp = path + ".tmp";
     FILE* out = fopen(tmp.c_str(), "w");
     if (!out) {
       err = "cannot write " + tmp;
       return false;
     }
-    std::string rec = "[\"v\",";
+    std::string rec;
+    bool wok = true;
+    auto emit = [&]() {
+      rec += '\n';
+      wok = wok && fwrite(rec.data(), 1, rec.size(), out) == rec.size();
+      rec.clear();
+    };
+    rec = "[\"v\",";
     jint(rec, rev_);
     rec += ',';
     jint(rec, next_lease_);
-    rec += "]\n";
+    rec += ']';
+    emit();
     double steady = now(), wall = wall_now();
     for (const auto& [lid, l] : leases_) {
       rec += "[\"g\",";
@@ -595,7 +390,8 @@ class Store {
       jdbl(rec, l.ttl);
       rec += ',';
       jdbl(rec, wall + (l.deadline - steady));
-      rec += "]\n";
+      rec += ']';
+      emit();
     }
     for (const auto& [key, kv] : kv_) {
       rec += "[\"s\",";
@@ -608,12 +404,16 @@ class Store {
       jint(rec, kv.mod_rev);
       rec += ',';
       jint(rec, kv.lease);
-      rec += "]\n";
+      rec += ']';
+      emit();
     }
-    fwrite(rec.data(), 1, rec.size(), out);
-    fflush(out);
-    fdatasync(fileno(out));
+    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
     fclose(out);
+    if (!wok) {
+      remove(tmp.c_str());
+      err = "snapshot write to " + tmp + " failed: " + strerror(errno);
+      return false;
+    }
     if (rename(tmp.c_str(), path.c_str()) != 0) {
       err = "rename failed for " + tmp;
       return false;
@@ -824,16 +624,6 @@ class Store {
 // (the reference passes etcd credentials via clientv3.Config,
 // conf/conf.go:66-67)
 static std::string g_token;
-
-// constant-time comparison: an attacker must not learn the token byte by
-// byte from response timing
-static bool token_eq(const std::string& a, const std::string& b) {
-  if (a.size() != b.size()) return false;
-  unsigned char acc = 0;
-  for (size_t i = 0; i < a.size(); i++)
-    acc |= (unsigned char)(a[i] ^ b[i]);
-  return acc == 0;
-}
 
 struct Conn : std::enable_shared_from_this<Conn> {
   int fd;

@@ -1,8 +1,9 @@
-"""Networked result store: RemoteJobLogStore against LogSinkServer must
-behave exactly like a local JobLogStore — the same conformance for the
-result store that test_remote_store.py gives the coordination store
-(reference: every node writes Mongo, the web server reads it,
-db/mgo.go:24-49, job_log.go:84-133)."""
+"""Networked result store: RemoteJobLogStore against the Python
+LogSinkServer AND the native C++ cronsun-logd must behave exactly like
+a local JobLogStore — the same one-suite-many-backends conformance that
+test_remote_store.py gives the coordination store (reference: every
+node writes Mongo, the web server reads it, db/mgo.go:24-49,
+job_log.go:84-133)."""
 
 import threading
 import time
@@ -11,20 +12,29 @@ import pytest
 
 from cronsun_tpu.logsink import (JobLogStore, LogRecord, LogSinkError,
                                  LogSinkServer, RemoteJobLogStore)
+from cronsun_tpu.logsink.native import NativeLogSinkServer, find_binary
 
 
-@pytest.fixture(params=["local", "remote"])
+def _native_server(**kw):
+    binary = find_binary()
+    if binary is None:
+        pytest.skip("native logd binary unavailable")
+    return NativeLogSinkServer(binary=binary, **kw)
+
+
+@pytest.fixture(params=["local", "remote", "native"])
 def sink(request):
     if request.param == "local":
         s = JobLogStore()
         yield s
         s.close()
-    else:
-        srv = LogSinkServer().start()
-        c = RemoteJobLogStore(srv.host, srv.port)
-        yield c
-        c.close()
-        srv.stop()
+        return
+    srv = (LogSinkServer().start() if request.param == "remote"
+           else _native_server())
+    c = RemoteJobLogStore(srv.host, srv.port)
+    yield c
+    c.close()
+    srv.stop()
 
 
 def _rec(job="j1", node="n1", ok=True, begin=1000.0, **kw):
@@ -68,6 +78,23 @@ def test_query_filters_and_paging(sink):
     assert total == 5
     j0 = [r for r in recs if r.job_id == "j0"][0]
     assert not j0.success and j0.begin_ts == 2000.0
+
+
+def test_name_filter_is_plain_substring(sink):
+    """name_like is a PLAIN substring match on every backend: SQL LIKE
+    metacharacters (%, _, \\) in the needle match only themselves —
+    an operator's search must not change meaning across backends."""
+    sink.create_job_log(_rec(job="pct", name="100% done"))
+    sink.create_job_log(_rec(job="und", name="under_score"))
+    sink.create_job_log(_rec(job="pl", name="plain"))
+    _, total = sink.query_logs(name_like="%")
+    assert total == 1                      # only the literal % name
+    _, total = sink.query_logs(name_like="r_s")
+    assert total == 1                      # literal underscore, no wildcard
+    _, total = sink.query_logs(name_like="0% d")
+    assert total == 1
+    _, total = sink.query_logs(name_like="PLAIN")
+    assert total == 1                      # ASCII case-insensitive
 
 
 def test_stats(sink):
@@ -232,3 +259,83 @@ def test_create_idempotency_concurrent_retry_race():
     assert total == 1
     [c.close() for c in cs]
     srv.stop()
+
+
+def test_native_auth_and_idempotency():
+    """The native logd enforces the shared-secret handshake and the
+    create idempotency token, like the Python server."""
+    srv = _native_server(token="n4tive")
+    with pytest.raises(LogSinkError):
+        RemoteJobLogStore(srv.host, srv.port, token="wrong")
+    c = RemoteJobLogStore(srv.host, srv.port, token="n4tive")
+    wire = {"job_id": "j", "job_group": "g", "name": "n", "node": "nd",
+            "user": "", "command": "t", "output": "o", "success": True,
+            "begin_ts": 1000.0, "end_ts": 1001.0, "id": None}
+    rid1 = c._call("create_job_log", wire, "tok-n")
+    rid2 = c._call("create_job_log", wire, "tok-n")
+    assert rid1 == rid2
+    _, total = c.query_logs()
+    assert total == 1
+    c.close()
+    srv.stop()
+
+
+def test_native_wal_survives_restart(tmp_path):
+    """kill -9 the native logd; a restart on the same WAL restores
+    records, latest view, stats, nodes and accounts (and the compacted
+    snapshot keeps stats exact across the retention window)."""
+    import signal as _sig
+    db = str(tmp_path / "logd.wal")
+    srv = _native_server(db=db)
+    c = RemoteJobLogStore(srv.host, srv.port)
+    for i in range(5):
+        c.create_job_log(_rec(job=f"j{i}", ok=i % 2 == 0,
+                              begin=2000.0 + i))
+    c.upsert_node("n1", '{"id": "n1", "pid": 3}', alived=True)
+    c.upsert_account("a@b.c", '{"email": "a@b.c"}')
+    before = c.stat_overall()
+    c.close()
+    srv._proc.send_signal(_sig.SIGKILL)      # crash, not clean stop
+    srv._proc.wait(timeout=10)
+    srv2 = _native_server(db=db)
+    c2 = RemoteJobLogStore(srv2.host, srv2.port)
+    assert c2.stat_overall() == before
+    _, total = c2.query_logs()
+    assert total == 5
+    recs, lt = c2.query_logs(latest=True)
+    assert lt == 5                            # distinct (job, node) pairs
+    assert c2.get_node("n1")["alived"]
+    assert c2.get_account("a@b.c") is not None
+    # writes continue with fresh monotone ids
+    r = _rec(job="after", begin=3000.0)
+    c2.create_job_log(r)
+    assert r.id is not None and r.id > 5
+    c2.close()
+    srv2.stop()
+
+
+def test_native_retention_keeps_stats_and_latest(tmp_path):
+    """Records beyond --retain age out of memory/WAL, but the stats
+    counters and the latest view — which summarize all history —
+    survive compaction exactly."""
+    db = str(tmp_path / "logd.wal")
+    srv = _native_server(db=db, retain=10)
+    c = RemoteJobLogStore(srv.host, srv.port)
+    for i in range(25):
+        c.create_job_log(_rec(job="hot", node="n1", ok=True,
+                              begin=1000.0 + i))
+    _, total = c.query_logs()
+    assert total == 10                        # retention window
+    assert c.stat_overall()["total"] == 25    # counters keep all history
+    latest, _ = c.query_logs(latest=True)
+    assert latest[0].begin_ts == 1024.0
+    c.close()
+    srv.stop()
+    # restart compacts: history summary still exact
+    srv2 = _native_server(db=db, retain=10)
+    c2 = RemoteJobLogStore(srv2.host, srv2.port)
+    assert c2.stat_overall()["total"] == 25
+    latest, _ = c2.query_logs(latest=True)
+    assert latest[0].begin_ts == 1024.0
+    c2.close()
+    srv2.stop()

@@ -101,13 +101,16 @@ def test_full_system_multiprocess(tmp_path, store_backend):
     procs = []
     try:
         store_args = ["--port", "0"]
+        logd_args = ["--port", "0", "--db", str(tmp_path / "logd.db")]
         if store_backend == "native":
+            # the all-native fleet: C++ coordination store AND C++
+            # result store behind the same Python clients
             store_args.append("--native")
+            logd_args.append("--native")
         store_p = _spawn("cronsun_tpu.bin.store", *store_args)
         procs.append(store_p)
         store_addr = _await_ready(store_p)
-        logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
-                        "--db", str(tmp_path / "logd.db"))
+        logd_p = _spawn("cronsun_tpu.bin.logd", *logd_args)
         procs.append(logd_p)
         logd_addr = _await_ready(logd_p)
 
