@@ -159,14 +159,18 @@ class JobLogStore:
         if failed_only:
             where.append("success = 0")
         cond = (" WHERE " + " AND ".join(where)) if where else ""
-        page = max(1, page)
+        # clamp absurd page numbers (empty page, never an overflow —
+        # the native backend pins the same bound)
+        page = max(1, min(page, 1 << 40))
         page_size = max(1, min(page_size, 500))
         with self._lock:
             total = self._db.execute(
                 f"SELECT COUNT(*) c FROM {table}{cond}", args).fetchone()["c"]
+            # tie order pinned explicitly (id ASC within equal begin_ts)
+            # so the native backend can page identically
             rows = self._db.execute(
-                f"SELECT * FROM {table}{cond} ORDER BY begin_ts DESC "
-                f"LIMIT ? OFFSET ?",
+                f"SELECT * FROM {table}{cond} ORDER BY begin_ts DESC"
+                f"{', id ASC' if not latest else ''} LIMIT ? OFFSET ?",
                 args + [page_size, (page - 1) * page_size]).fetchall()
         return [self._row_to_rec(r, latest) for r in rows], total
 
@@ -206,7 +210,7 @@ class JobLogStore:
         with self._lock:
             rows = self._db.execute(
                 "SELECT * FROM stat WHERE day != '' ORDER BY day DESC "
-                "LIMIT ?", (n_days,)).fetchall()
+                "LIMIT ?", (max(0, n_days),)).fetchall()
         return [{"day": r["day"], "total": r["total"],
                  "successed": r["successed"], "failed": r["failed"]}
                 for r in rows]

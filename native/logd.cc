@@ -303,11 +303,15 @@ class LogStore {
       for (const Rec& r : recs_)
         if (match(r)) hits.push_back(&r);
     }
-    // ORDER BY begin_ts DESC (ties: newest id first — deterministic)
+    // ORDER BY begin_ts DESC, id ASC — the tie order the SQLite backend
+    // pins explicitly; both backends must page identically
     std::stable_sort(hits.begin(), hits.end(), [](const Rec* a, const Rec* b) {
       if (a->begin != b->begin) return a->begin > b->begin;
-      return a->id > b->id;
+      return a->id < b->id;
     });
+    // clamp before multiplying: a huge client-supplied page must not
+    // overflow signed arithmetic (UB), just return an empty page
+    page = std::min(page, (long long)1 << 40);
     size_t off = (size_t)((page - 1) * page_size);
     res += "{\"total\":";
     jint(res, (long long)hits.size());

@@ -71,6 +71,8 @@ def run_bench(rates, n_agents, seconds, on_log=print):
     from cronsun_tpu.core import Keyspace
     from cronsun_tpu.core.models import Job, JobRule
     from cronsun_tpu.logsink import LogSinkServer, RemoteJobLogStore
+    from cronsun_tpu.logsink.native import (NativeLogSinkServer,
+                                            find_binary as find_logd)
     from cronsun_tpu.store.native import NativeStoreServer, find_binary
     from cronsun_tpu.store.remote import RemoteStore, StoreServer
 
@@ -82,7 +84,12 @@ def run_bench(rates, n_agents, seconds, on_log=print):
     else:
         store_srv = StoreServer().start()
         backend = "py"
-    logd = LogSinkServer().start()
+    logd_bin = find_logd()
+    if logd_bin:
+        logd = NativeLogSinkServer(binary=logd_bin)
+        backend += "+native-logd"
+    else:
+        logd = LogSinkServer().start()
     store = RemoteStore(store_srv.host, store_srv.port)
     sink = RemoteJobLogStore(logd.host, logd.port)
 

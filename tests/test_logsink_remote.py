@@ -339,3 +339,19 @@ def test_native_retention_keeps_stats_and_latest(tmp_path):
     assert latest[0].begin_ts == 1024.0
     c2.close()
     srv2.stop()
+
+
+def test_paging_tie_order_and_edge_inputs(sink):
+    """Equal begin_ts records page in id-ascending order on EVERY
+    backend; absurd page numbers and negative stat_days are handled
+    identically (empty results, no errors)."""
+    for i in range(4):
+        sink.create_job_log(_rec(job=f"t{i}", node=f"n{i}", begin=5000.0))
+    recs, total = sink.query_logs()
+    assert total == 4
+    assert [r.job_id for r in recs] == ["t0", "t1", "t2", "t3"]
+    recs, _ = sink.query_logs(page=2, page_size=2)
+    assert [r.job_id for r in recs] == ["t2", "t3"]
+    recs, total = sink.query_logs(page=2**62)   # no overflow, just empty
+    assert total == 4 and recs == []
+    assert sink.stat_days(-1) == []
