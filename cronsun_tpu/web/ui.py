@@ -32,6 +32,15 @@ INDEX_HTML = r"""<!doctype html>
  .row{display:flex;gap:10px}.row>*{flex:1}
  pre{white-space:pre-wrap;background:#0e1620;color:#d7e3ef;padding:10px;border-radius:6px;max-height:300px;overflow:auto}
  .bar{display:flex;gap:8px;margin-bottom:12px;align-items:center;flex-wrap:wrap}
+ /* popover: joins the browser top layer so toasts paint above open
+    showModal() dialogs (a plain z-index never can) */
+ #toasts{position:fixed;inset:auto 14px auto auto;top:14px;margin:0;padding:0;
+  border:0;background:transparent;overflow:visible;
+  display:flex;flex-direction:column;gap:8px}
+ .toast{padding:9px 14px;border-radius:6px;color:#fff;box-shadow:0 2px 8px #0004;
+  font-size:13.5px;max-width:340px;animation:fadein .15s}
+ .toast.ok{background:#0a7d38}.toast.err{background:#c0392b}
+ @keyframes fadein{from{opacity:0;transform:translateY(-6px)}to{opacity:1}}
 </style></head><body>
 <header><b>cronsun-tpu</b>
  <a data-v=dash></a><a data-v=jobs></a><a data-v=nodes></a>
@@ -41,8 +50,15 @@ INDEX_HTML = r"""<!doctype html>
  <a id=langbtn title="language"></a><a id=logout></a>
 </header>
 <main id=main></main>
+<div id=toasts popover=manual></div>
 <script>
 const $=s=>document.querySelector(s);
+// non-blocking notifications (the reference's Messager component)
+function toast(msg,ok){const c=$('#toasts');const d=document.createElement('div');
+ d.className='toast '+(ok?'ok':'err');d.textContent=String(msg);
+ c.appendChild(d);try{c.showPopover()}catch(e){}
+ setTimeout(()=>{d.remove();if(!c.children.length){try{c.hidePopover()}catch(e){}}},
+  ok?2500:6000)}
 // ---- i18n (reference: web/ui/src/i18n/ en + zh-CN) ----
 const L={en:{
  dash:'Dashboard',jobs:'Jobs',nodes:'Nodes',groups:'Groups',logs:'Logs',
@@ -250,7 +266,7 @@ window.editAccount=(a)=>{a=a||{};
   const body={email:a.email||$('#ae').value,role:+$('#ar').value,status:+$('#as_').value};
   if($('#ap').value)body.password=$('#ap').value;
   await api(a.email?'POST':'PUT','/v1/admin/account',body);
-  dlg.close();nav('accounts')}catch(x){alert(x)}}};
+  dlg.close();nav('accounts')}catch(x){toast(x)}}};
 window.logDetail=async id=>{const l=await api('GET','/v1/log/'+id);
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg>
   <b>${esc(l.name)}</b> <span class=muted>@ ${esc(l.node)} · ${ts(l.beginTime)} · ${(l.endTime-l.beginTime).toFixed(2)}s ·
@@ -272,7 +288,7 @@ window.runNow=async i=>{const j=_jobs[i],
  </dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();try{
   await api('PUT',`/v1/job/${key}/execute?node=`+encodeURIComponent($('#xn').value));
-  dlg.close();alert(t('dispatched'))}catch(x){alert(x)}}};
+  dlg.close();toast(t('dispatched'),true)}catch(x){toast(x)}}};
 window.delJob=async i=>{const j=_jobs[i];if(confirm(t('delJobQ'))){
  await api('DELETE',`/v1/job/${encodeURIComponent(j.group)}-${encodeURIComponent(j.id)}`);nav('jobs')}};
 window.delGroup=async i=>{const g=_groups[i];if(confirm(t('delGroupQ'))){
@@ -323,7 +339,7 @@ window.editJob=(j)=>{j=j||{};
    command:$('#jc').value,kind:+$('#jk').value,user:$('#ju').value,timeout:+$('#jt').value,
    retry:+$('#jr').value,parallels:+$('#jp').value,pause:!!j.pause,
    rules:rules.map(r=>({id:r.id,timer:r.timer,nids:r.nids||[],gids:r.gids||[],
-           exclude_nids:r.exclude_nids||[]}))});dlg.close();nav('jobs')}catch(x){alert(x)}}};
+           exclude_nids:r.exclude_nids||[]}))});dlg.close();nav('jobs')}catch(x){toast(x)}}};
 window.editGroup=(g)=>{g=g||{};
  document.body.insertAdjacentHTML('beforeend',`<dialog id=dlg><form method=dialog>
   <b>${g.id?t('editT'):t('newT')} ${t('group')}</b>
@@ -333,7 +349,7 @@ window.editGroup=(g)=>{g=g||{};
  </form></dialog>`);const dlg=$('#dlg');dlg.showModal();dlg.onclose=()=>dlg.remove();
  $('#sv').onclick=async e=>{e.preventDefault();try{
   await api('PUT','/v1/node/group',{id:g.id,name:$('#gn').value,
-   nids:$('#gm').value.split(',').map(s=>s.trim()).filter(Boolean)});dlg.close();nav('groups')}catch(x){alert(x)}}};
+   nids:$('#gm').value.split(',').map(s=>s.trim()).filter(Boolean)});dlg.close();nav('groups')}catch(x){toast(x)}}};
 chrome();
 api('GET','/v1/session/me').then(d=>{me=d;$('#who').textContent=d.email;
  $('#nav-acc').style.display=d.role===1?'':'none';nav('dash')}).catch(()=>login());
