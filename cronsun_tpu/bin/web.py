@@ -31,6 +31,7 @@ def main(argv=None) -> int:
     sink = make_sink(cfg, args.logsink)
     api = ApiServer(store, sink, ks=ks, security=cfg.security,
                     alarm=cfg.mail.enable,
+                    auth_enabled=cfg.web.auth_enabled,
                     host=args.host or cfg.web.host,
                     port=cfg.web.port if args.port is None else args.port)
     api.start()

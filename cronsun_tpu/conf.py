@@ -46,6 +46,8 @@ class Web:
     host: str = "0.0.0.0"
     port: int = 7079
     session_ttl: int = 8 * 3600
+    auth_enabled: bool = True   # reference Web.Auth.Enabled (base.go:98);
+                                # False = every request is an implicit admin
 
 
 @dataclasses.dataclass

@@ -32,7 +32,7 @@ from ..core import (
 from ..core.models import hash_password
 from ..logsink import JobLogStore
 from ..store.memstore import MemStore
-from .sessions import SessionStore
+from .sessions import Session, SessionStore
 from .ui import INDEX_HTML
 
 VERSION = "v0.1.0-tpu"
@@ -55,7 +55,16 @@ class PlainText(str):
 class ApiServer:
     def __init__(self, store: MemStore, sink: JobLogStore,
                  ks: Optional[Keyspace] = None, security=None, alarm=None,
+                 auth_enabled: bool = True,
                  host: str = "127.0.0.1", port: int = 7079):
+        # auth_enabled=False replicates the reference's Web.Auth.Enabled
+        # switch (web/base.go:98: every request passes as an implicit
+        # admin; the UI skips login).  Unlike the reference — whose Go
+        # zero value DISABLES auth unless configured — the rebuild's
+        # default is enabled.
+        self.auth_enabled = auth_enabled
+        self._implicit_admin = Session(email=BOOTSTRAP_ADMIN,
+                                       role=ROLE_ADMIN)
         self.store = store
         self.sink = sink
         self.ks = ks or Keyspace()
@@ -496,11 +505,14 @@ class ApiServer:
                 continue
             ctx.path_args = match.groupdict()
             if need_auth or need_admin:
-                ctx.session = self.sessions.get(ctx.sid)
-                if ctx.session is None:
-                    raise HttpError(401, "not logged in")
-                if need_admin and ctx.session.role != ROLE_ADMIN:
-                    raise HttpError(403, "admin only")
+                if not self.auth_enabled:
+                    ctx.session = self._implicit_admin
+                else:
+                    ctx.session = self.sessions.get(ctx.sid)
+                    if ctx.session is None:
+                        raise HttpError(401, "not logged in")
+                    if need_admin and ctx.session.role != ROLE_ADMIN:
+                        raise HttpError(403, "admin only")
             return fn(ctx), ctx
         raise HttpError(404, "no such route")
 

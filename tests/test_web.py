@@ -296,6 +296,28 @@ def test_ui_editor_binds_all_rules():
     assert "JSON.stringify(a)" not in INDEX_HTML
 
 
+def test_auth_disabled_mode():
+    """web.auth_enabled=False (the reference's Web.Auth.Enabled switch,
+    base.go:98): every request passes as an implicit admin and the UI's
+    session-restore call succeeds without a login."""
+    store = MemStore()
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, auth_enabled=False, port=0).start()
+    c = Client(srv.port)
+    code, jobs = c.req("GET", "/v1/jobs")          # no login at all
+    assert code == 200 and jobs == []
+    code, me = c.req("GET", "/v1/session/me")      # UI skips login
+    assert code == 200 and me["role"] == 1
+    code, accts = c.req("GET", "/v1/admin/accounts")   # admin gate passes
+    assert code == 200
+    code, out = c.req("PUT", "/v1/job", {
+        "name": "na", "command": "echo 1",
+        "rules": [{"timer": "* * * * * *", "nids": ["n1"]}]})
+    assert code == 200
+    srv.stop()
+    store.close()
+
+
 def test_metrics_endpoint(world):
     """/v1/metrics renders every component's leased store snapshot as
     Prometheus text, without auth (scrapers hold no session)."""
