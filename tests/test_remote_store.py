@@ -460,3 +460,73 @@ def test_malformed_frames_do_not_crash_server(backend):
         good.close()
     finally:
         srv.stop()
+
+
+def test_differential_fuzz_python_vs_native():
+    """Differential fuzz: one random KV/txn op sequence applied to BOTH
+    store backends must produce identical revisions and contents
+    (leases/watches excluded — they are timing-dependent and covered by
+    the scenario tests)."""
+    import random
+    rng = random.Random(42)
+    py = _make_server("py")
+    binary = find_binary()
+    if binary is None:
+        py.stop()
+        pytest.skip("native store binary unavailable")
+    nt = NativeStoreServer(binary=binary)
+    a = RemoteStore(py.host, py.port, reconnect=False)
+    b = RemoteStore(nt.host, nt.port, reconnect=False)
+
+    def rs(n=6):
+        return "".join(rng.choice("ab/ζ%\\\"'xyz0 ") for _ in range(n))
+
+    keys = [f"/f/{i}" for i in range(8)] + ["/f/sub/x", "/g/1"]
+    try:
+        for step in range(400):
+            op = rng.randrange(10)
+            k = rng.choice(keys)
+            if op <= 3:
+                v = rs(rng.randrange(0, 30))
+                ra, rb = a.put(k, v), b.put(k, v)
+                assert ra == rb, f"step {step}: put rev {ra} != {rb}"
+            elif op == 4:
+                ra, rb = a.delete(k), b.delete(k)
+                assert ra == rb, f"step {step}: delete {ra} != {rb}"
+            elif op == 5:
+                v = rs()
+                ra, rb = (a.put_if_absent(k, v), b.put_if_absent(k, v))
+                assert ra == rb, f"step {step}: put_if_absent {ra} != {rb}"
+            elif op == 6:
+                kva, kvb = a.get(k), b.get(k)
+                mr = kva.mod_rev if kva and rng.random() < 0.7 else \
+                    rng.randrange(1, 50)
+                v = rs()
+                ra, rb = (a.put_if_mod_rev(k, v, mr),
+                          b.put_if_mod_rev(k, v, mr))
+                assert ra == rb, f"step {step}: CAS {ra} != {rb}"
+            elif op == 7:
+                pfx = rng.choice(["/f/", "/f/sub/", "/g/", "/", "/nope/"])
+                ra = [(kv.key, kv.value, kv.create_rev, kv.mod_rev)
+                      for kv in a.get_prefix(pfx)]
+                rb = [(kv.key, kv.value, kv.create_rev, kv.mod_rev)
+                      for kv in b.get_prefix(pfx)]
+                assert ra == rb, f"step {step}: prefix {pfx} differs"
+            elif op == 8:
+                pfx = rng.choice(["/f/", "/g/", "/"])
+                assert a.count_prefix(pfx) == b.count_prefix(pfx), \
+                    f"step {step}: count {pfx}"
+            else:
+                items = [(rng.choice(keys), rs()) for _ in range(3)]
+                ra, rb = a.put_many(items), b.put_many(items)
+                assert ra == rb, f"step {step}: put_many rev {ra} != {rb}"
+        fa = [(kv.key, kv.value, kv.create_rev, kv.mod_rev)
+              for kv in a.get_prefix("/")]
+        fb = [(kv.key, kv.value, kv.create_rev, kv.mod_rev)
+              for kv in b.get_prefix("/")]
+        assert fa == fb, "final keyspaces diverged"
+    finally:
+        a.close()
+        b.close()
+        py.stop()
+        nt.stop()
