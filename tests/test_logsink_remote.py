@@ -364,8 +364,8 @@ def test_differential_fuzz_python_vs_native():
     including unicode, odd floats, empty strings and random filters."""
     import random
     rng = random.Random(20260730)
+    nt = _native_server()          # skip BEFORE starting anything else
     py = LogSinkServer().start()
-    nt = _native_server()
     cp = RemoteJobLogStore(py.host, py.port)
     cn = RemoteJobLogStore(nt.host, nt.port)
 
@@ -375,70 +375,77 @@ def test_differential_fuzz_python_vs_native():
     def both(fn):
         return fn(cp), fn(cn)
 
-    jobs = [f"j{i}" for i in range(6)]
-    nodes = [f"n{i}" for i in range(3)]
-    for step in range(300):
-        op = rng.randrange(8)
-        if op <= 2:
-            r = _rec(job=rng.choice(jobs), node=rng.choice(nodes),
-                     ok=rng.random() < 0.7,
-                     begin=1000.0 + rng.randrange(0, 500_000),
-                     name=rs(), output=rs(20), command=rs(12))
-            a, b = both(lambda c: (c.create_job_log(
-                LogRecord(**{**r.__dict__, "id": None})), None)[1])
-        elif op == 3:
-            kw = {}
-            if rng.random() < 0.5:
-                kw["node"] = rng.choice(nodes + ["missing"])
-            if rng.random() < 0.4:
-                kw["name_like"] = rs(3)
-            if rng.random() < 0.4:
-                kw["job_ids"] = rng.sample(jobs, rng.randrange(1, 3))
-            if rng.random() < 0.3:
-                kw["begin"] = 1000.0 + rng.randrange(0, 500_000)
-            if rng.random() < 0.3:
-                kw["end"] = 1000.0 + rng.randrange(0, 500_000)
-            if rng.random() < 0.3:
-                kw["failed_only"] = True
-            if rng.random() < 0.3:
-                kw["latest"] = True
-            kw["page"] = rng.randrange(1, 4)
-            kw["page_size"] = rng.randrange(1, 30)
-            (ra, ta), (rb, tb) = both(lambda c: c.query_logs(**kw))
-            assert ta == tb, f"step {step}: totals {ta} != {tb} for {kw}"
-            assert [r.__dict__ for r in ra] == [r.__dict__ for r in rb], \
-                f"step {step}: rows differ for {kw}"
-        elif op == 4:
-            nid = rng.choice(nodes)
-            doc = f'{{"id": "{nid}", "pid": {rng.randrange(99)}}}'
-            alv = rng.random() < 0.5
-            both(lambda c: c.upsert_node(nid, doc, alv))
-            a, b = both(lambda c: c.get_nodes())
-            assert a == b, f"step {step}: nodes differ"
-        elif op == 5:
-            nid = rng.choice(nodes + ["ghost"])
-            alv = rng.random() < 0.5
-            both(lambda c: c.set_node_alived(nid, alv))
-            a, b = both(lambda c: c.get_node(nid))
-            assert a == b, f"step {step}: node {nid} differs"
-        elif op == 6:
-            email = f"u{rng.randrange(4)}@x"
-            if rng.random() < 0.3:
-                a, b = both(lambda c: c.delete_account(email))
+    try:
+        jobs = [f"j{i}" for i in range(6)]
+        nodes = [f"n{i}" for i in range(3)]
+        for step in range(300):
+            op = rng.randrange(8)
+            if op <= 2:
+                r = _rec(job=rng.choice(jobs), node=rng.choice(nodes),
+                         ok=rng.random() < 0.7,
+                         begin=1000.0 + rng.randrange(0, 500_000),
+                         name=rs(), output=rs(20), command=rs(12))
+
+                def create(c):
+                    rec = LogRecord(**{**r.__dict__, "id": None})
+                    c.create_job_log(rec)
+                    return rec.id
+                ia, ib = both(create)
+                assert ia == ib, f"step {step}: assigned ids {ia} != {ib}"
+            elif op == 3:
+                kw = {}
+                if rng.random() < 0.5:
+                    kw["node"] = rng.choice(nodes + ["missing"])
+                if rng.random() < 0.4:
+                    kw["name_like"] = rs(3)
+                if rng.random() < 0.4:
+                    kw["job_ids"] = rng.sample(jobs, rng.randrange(1, 3))
+                if rng.random() < 0.3:
+                    kw["begin"] = 1000.0 + rng.randrange(0, 500_000)
+                if rng.random() < 0.3:
+                    kw["end"] = 1000.0 + rng.randrange(0, 500_000)
+                if rng.random() < 0.3:
+                    kw["failed_only"] = True
+                if rng.random() < 0.3:
+                    kw["latest"] = True
+                kw["page"] = rng.randrange(1, 4)
+                kw["page_size"] = rng.randrange(1, 30)
+                (ra, ta), (rb, tb) = both(lambda c: c.query_logs(**kw))
+                assert ta == tb, f"step {step}: totals {ta} != {tb} for {kw}"
+                assert [r.__dict__ for r in ra] == [r.__dict__ for r in rb], \
+                    f"step {step}: rows differ for {kw}"
+            elif op == 4:
+                nid = rng.choice(nodes)
+                doc = f'{{"id": "{nid}", "pid": {rng.randrange(99)}}}'
+                alv = rng.random() < 0.5
+                both(lambda c: c.upsert_node(nid, doc, alv))
+                a, b = both(lambda c: c.get_nodes())
+                assert a == b, f"step {step}: nodes differ"
+            elif op == 5:
+                nid = rng.choice(nodes + ["ghost"])
+                alv = rng.random() < 0.5
+                both(lambda c: c.set_node_alived(nid, alv))
+                a, b = both(lambda c: c.get_node(nid))
+                assert a == b, f"step {step}: node {nid} differs"
+            elif op == 6:
+                email = f"u{rng.randrange(4)}@x"
+                if rng.random() < 0.3:
+                    a, b = both(lambda c: c.delete_account(email))
+                else:
+                    doc = f'{{"e": "{rs()}"}}'
+                    both(lambda c: c.upsert_account(email, doc))
+                    a, b = both(lambda c: c.get_account(email))
+                assert a == b, f"step {step}: account {email} differs"
             else:
-                doc = f'{{"e": "{rs()}"}}'
-                both(lambda c: c.upsert_account(email, doc))
-                a, b = both(lambda c: c.get_account(email))
-            assert a == b, f"step {step}: account {email} differs"
-        else:
-            a, b = both(lambda c: (c.stat_overall(), c.stat_days(3)))
-            assert a == b, f"step {step}: stats differ"
-    # final full-state comparison
-    (ra, ta), (rb, tb) = both(lambda c: c.query_logs(page_size=500))
-    assert ta == tb
-    assert [r.__dict__ for r in ra] == [r.__dict__ for r in rb]
-    a, b = both(lambda c: (c.get_nodes(), c.list_accounts(),
-                           c.stat_overall(), c.stat_days(10)))
-    assert a == b
-    cp.close(); cn.close()
-    py.stop(); nt.stop()
+                a, b = both(lambda c: (c.stat_overall(), c.stat_days(3)))
+                assert a == b, f"step {step}: stats differ"
+        # final full-state comparison
+        (ra, ta), (rb, tb) = both(lambda c: c.query_logs(page_size=500))
+        assert ta == tb
+        assert [r.__dict__ for r in ra] == [r.__dict__ for r in rb]
+        a, b = both(lambda c: (c.get_nodes(), c.list_accounts(),
+                               c.stat_overall(), c.stat_days(10)))
+        assert a == b
+    finally:
+        cp.close(); cn.close()
+        py.stop(); nt.stop()

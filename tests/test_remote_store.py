@@ -499,6 +499,7 @@ def test_differential_fuzz_python_vs_native():
                 assert ra == rb, f"step {step}: put_if_absent {ra} != {rb}"
             elif op == 6:
                 kva, kvb = a.get(k), b.get(k)
+                assert kva == kvb, f"step {step}: get({k}) differs"
                 mr = kva.mod_rev if kva and rng.random() < 0.7 else \
                     rng.randrange(1, 50)
                 v = rs()
