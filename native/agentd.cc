@@ -1,0 +1,1591 @@
+// cronsun-agentd: the native execution agent.
+//
+// The C++ twin of cronsun_tpu/node/agent.py (which mirrors the
+// reference's Go node, bin/node/server.go:23-70): registers a leased
+// node identity, watches its dispatch prefix / the Common broadcast
+// prefix / run-now triggers, fences exclusive executions with
+// (job, second) create-if-absent locks on a shared rotating lease,
+// holds the KindAlone lifetime lock under keepalive, maintains the
+// leased proc registry with ProcReq short-run suppression, fork/execs
+// commands with setuid demotion + process-group timeout kill +
+// retry/interval + a skip-not-queue Parallels gate, writes execution
+// records (with idempotency tokens) to the result store, feeds the
+// avg_time EWMA back via CAS, and posts failure notices.
+//
+// Protocol clients: the store client demuxes replies and watch pushes
+// on a reader thread (the wire format of cronsun_tpu/store/remote.py);
+// the result-store client is lock-step with one transparent
+// reconnect+retry (cronsun_tpu/logsink/serve.py).  On any store
+// reconnect every watch stream reports lost and the agent resynchronizes
+// by re-list — the same first-class recovery path the Python agent uses,
+// with fences keeping re-runs exactly-once.
+//
+// Deliberate simplifications vs the Python agent (semantics preserved):
+// no job cache (jobs are fetched per order — always the latest state),
+// and watch resume is always a full resync instead of revision replay.
+//
+// Build: make -C native   (g++ -O2 -std=c++17 -pthread)
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <grp.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pwd.h>
+#include <signal.h>
+#include <sys/prctl.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "njson.h"
+
+static double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// store client (demuxed line-JSON; watch pushes -> one event queue)
+// ---------------------------------------------------------------------------
+
+struct WatchEvent {
+  long long wid = 0;
+  bool lost = false;
+  bool is_delete = false;
+  std::string key, value;
+};
+
+struct StoreError {
+  std::string kind, msg;
+};
+
+class StoreClient {
+ public:
+  StoreClient(std::string host, int port, std::string token)
+      : host_(std::move(host)), port_(port), token_(std::move(token)) {}
+
+  bool connect_once() {
+    int fd = dial();
+    if (fd < 0) return false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fd_ = fd;
+      gen_++;
+    }
+    std::thread(&StoreClient::reader, this, fd, gen_.load()).detach();
+    if (!token_.empty()) {
+      JV r;
+      StoreError e;
+      JV args;
+      args.t = JV::ARR;
+      args.arr.emplace_back();
+      args.arr.back().t = JV::STR;
+      args.arr.back().s = token_;
+      if (!call("auth", args, r, e)) return false;
+    }
+    return true;
+  }
+
+  void close() {
+    stop_ = true;
+    int fd;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      fd = fd_;
+      fd_ = -1;
+    }
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
+
+  // one RPC; false on transport error (err.kind == "io") or server error
+  bool call(const std::string& op, const JV& args, JV& result,
+            StoreError& err) {
+    long long rid;
+    std::shared_ptr<Pending> p = std::make_shared<Pending>();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      rid = next_id_++;
+      pending_[rid] = p;
+    }
+    std::string line = "{\"i\":";
+    jint(line, rid);
+    line += ",\"o\":";
+    jesc(line, op);
+    line += ",\"a\":";
+    wire_args(line, args);
+    line += "}\n";
+    if (!send_line(line)) {
+      drop_pending(rid);
+      err = {"io", "send failed"};
+      return false;
+    }
+    std::unique_lock<std::mutex> g(p->mu);
+    if (!p->cv.wait_for(g, std::chrono::seconds(10),
+                        [&] { return p->done; })) {
+      drop_pending(rid);
+      err = {"io", "rpc timeout: " + op};
+      return false;
+    }
+    if (!p->err_kind.empty()) {
+      err = {p->err_kind, p->err_msg};
+      return false;
+    }
+    result = std::move(p->result);
+    return true;
+  }
+
+  // convenience wrappers --------------------------------------------------
+  static JV sarg(std::initializer_list<std::string> xs) {
+    JV a;
+    a.t = JV::ARR;
+    for (const auto& x : xs) {
+      a.arr.emplace_back();
+      a.arr.back().t = JV::STR;
+      a.arr.back().s = x;
+    }
+    return a;
+  }
+
+  bool put(const std::string& k, const std::string& v, long long lease = 0) {
+    JV a = sarg({k, v});
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = lease;
+    JV r;
+    StoreError e;
+    return call("put", a, r, e);
+  }
+
+  // returns true + fills value when the key exists
+  bool get(const std::string& k, std::string& value, long long* mod_rev,
+           bool& found) {
+    JV r;
+    StoreError e;
+    if (!call("get", sarg({k}), r, e)) return false;
+    if (r.t != JV::ARR || r.arr.size() < 4) {
+      found = false;
+      return true;
+    }
+    found = true;
+    value = r.arr[1].s;
+    if (mod_rev) *mod_rev = r.arr[3].as_int();
+    return true;
+  }
+
+  bool del(const std::string& k) {
+    JV r;
+    StoreError e;
+    return call("delete", sarg({k}), r, e);
+  }
+
+  bool put_if_absent(const std::string& k, const std::string& v,
+                     long long lease, bool& won) {
+    StoreError e;
+    return put_if_absent_err(k, v, lease, won, e);
+  }
+
+  bool put_if_absent_err(const std::string& k, const std::string& v,
+                         long long lease, bool& won, StoreError& err) {
+    JV a = sarg({k, v});
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = lease;
+    JV r;
+    if (!call("put_if_absent", a, r, err)) return false;
+    won = r.t == JV::BOOL && r.b;
+    return true;
+  }
+
+  void unwatch(long long wid) {
+    if (wid < 0) return;
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = wid;
+    JV r;
+    StoreError e;
+    call("unwatch", a, r, e);
+  }
+
+  bool put_if_mod_rev(const std::string& k, const std::string& v,
+                      long long mod_rev, bool& won) {
+    JV a = sarg({k, v});
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = mod_rev;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = 0;
+    JV r;
+    StoreError e;
+    if (!call("put_if_mod_rev", a, r, e)) return false;
+    won = r.t == JV::BOOL && r.b;
+    return true;
+  }
+
+  long long grant(double ttl) {
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::DBL;
+    a.arr.back().d = ttl;
+    JV r;
+    StoreError e;
+    if (!call("grant", a, r, e)) return 0;
+    return r.as_int();
+  }
+
+  bool keepalive(long long lease) {
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = lease;
+    JV r;
+    StoreError e;
+    if (!call("keepalive", a, r, e)) return false;
+    return r.t == JV::BOOL && r.b;
+  }
+
+  void revoke(long long lease) {
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = lease;
+    JV r;
+    StoreError e;
+    call("revoke", a, r, e);
+  }
+
+  // [(key, value)] for a prefix
+  bool get_prefix(const std::string& pfx,
+                  std::vector<std::pair<std::string, std::string>>& out) {
+    JV r;
+    StoreError e;
+    if (!call("get_prefix", sarg({pfx}), r, e)) return false;
+    for (const JV& kv : r.arr)
+      if (kv.t == JV::ARR && kv.arr.size() >= 2)
+        out.emplace_back(kv.arr[0].s, kv.arr[1].s);
+    return true;
+  }
+
+  long long watch(const std::string& pfx) {
+    JV a = sarg({pfx});
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = 0;
+    JV r;
+    StoreError e;
+    if (!call("watch", a, r, e)) return -1;
+    return r.as_int();
+  }
+
+  // blocking pop of the next watch event; false on timeout
+  bool next_event(WatchEvent& ev, double timeout_s) {
+    std::unique_lock<std::mutex> g(evmu_);
+    if (!evcv_.wait_for(g, std::chrono::duration<double>(timeout_s),
+                        [&] { return !events_.empty() || stop_; }))
+      return false;
+    if (events_.empty()) return false;
+    ev = std::move(events_.front());
+    events_.pop_front();
+    return true;
+  }
+
+  bool connected() {
+    std::lock_guard<std::mutex> g(mu_);
+    return fd_ >= 0;
+  }
+
+ private:
+  struct Pending {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    JV result;
+    std::string err_kind, err_msg;
+  };
+
+  int dial() {
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    char ps[16];
+    snprintf(ps, sizeof ps, "%d", port_);
+    if (getaddrinfo(host_.c_str(), ps, &hints, &res) != 0) return -1;
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+      return -1;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    return fd;
+  }
+
+  static void wire_args(std::string& out, const JV& args) {
+    out += '[';
+    bool first = true;
+    for (const JV& v : args.arr) {
+      if (!first) out += ',';
+      first = false;
+      switch (v.t) {
+        case JV::STR: jesc(out, v.s); break;
+        case JV::INT: jint(out, v.i); break;
+        case JV::DBL: jdbl(out, v.d); break;
+        case JV::BOOL: out += v.b ? "true" : "false"; break;
+        default: out += "null";
+      }
+    }
+    out += ']';
+  }
+
+  bool send_line(const std::string& line) {
+    std::lock_guard<std::mutex> g(wmu_);
+    int fd;
+    {
+      std::lock_guard<std::mutex> g2(mu_);
+      fd = fd_;
+    }
+    if (fd < 0) return false;
+    size_t off = 0;
+    while (off < line.size()) {
+      ssize_t n = ::send(fd, line.data() + off, line.size() - off,
+                         MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      off += (size_t)n;
+    }
+    return true;
+  }
+
+  void drop_pending(long long rid) {
+    std::lock_guard<std::mutex> g(mu_);
+    pending_.erase(rid);
+  }
+
+  void reader(int fd, long long gen) {
+    std::string buf;
+    char chunk[65536];
+    while (!stop_) {
+      ssize_t n = ::recv(fd, chunk, sizeof chunk, 0);
+      if (n <= 0) break;
+      buf.append(chunk, (size_t)n);
+      size_t start = 0;
+      while (true) {
+        size_t nl = buf.find('\n', start);
+        if (nl == std::string::npos) break;
+        handle_line(buf.substr(start, nl - start));
+        start = nl + 1;
+      }
+      if (start) buf.erase(0, start);
+    }
+    ::close(fd);
+    // connection gone: fail in-flight calls, surface watch loss, heal
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (gen != gen_.load()) return;  // a newer connection took over
+      fd_ = -1;
+      for (auto& [rid, p] : pending_) {
+        std::lock_guard<std::mutex> pg(p->mu);
+        p->err_kind = "io";
+        p->err_msg = "connection closed";
+        p->done = true;
+        p->cv.notify_all();
+      }
+      pending_.clear();
+    }
+    {
+      std::lock_guard<std::mutex> g(evmu_);
+      WatchEvent lost;
+      lost.wid = -1;  // -1 = ALL streams lost (consumer resyncs)
+      lost.lost = true;
+      events_.push_back(lost);
+      evcv_.notify_all();
+    }
+    if (stop_) return;
+    std::thread([this] {
+      double delay = 0.2;
+      while (!stop_) {
+        if (connect_once()) return;
+        std::this_thread::sleep_for(std::chrono::duration<double>(delay));
+        delay = std::min(2.0, delay * 2);
+      }
+    }).detach();
+  }
+
+  void handle_line(const std::string& line) {
+    JParser jp(line);
+    JV v;
+    if (!jp.value(v) || v.t != JV::OBJ) return;
+    if (const JV* w = v.get("w")) {
+      WatchEvent ev;
+      ev.wid = w->as_int();
+      if (const JV* lost = v.get("lost")) {
+        ev.lost = lost->t == JV::BOOL && lost->b;
+      } else if (const JV* e = v.get("ev")) {
+        // event wire form: [type, kv, prev_kv]; kv: [key, value, ...]
+        if (e->t != JV::ARR || e->arr.size() < 2) return;
+        ev.is_delete = e->arr[0].s == "DELETE";
+        const JV& kv = e->arr[1];
+        if (kv.t == JV::ARR && kv.arr.size() >= 2) {
+          ev.key = kv.arr[0].s;
+          ev.value = kv.arr[1].s;
+        }
+      }
+      std::lock_guard<std::mutex> g(evmu_);
+      events_.push_back(std::move(ev));
+      evcv_.notify_all();
+      return;
+    }
+    const JV* i = v.get("i");
+    if (!i) return;
+    std::shared_ptr<Pending> p;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      auto it = pending_.find(i->as_int());
+      if (it == pending_.end()) return;
+      p = it->second;
+      pending_.erase(it);
+    }
+    std::lock_guard<std::mutex> pg(p->mu);
+    if (const JV* e = v.get("e")) {
+      p->err_msg = e->s;
+      const JV* k = v.get("k");
+      p->err_kind = k ? k->s : "error";
+    } else if (const JV* r = v.get("r")) {
+      p->result = *r;
+    }
+    p->done = true;
+    p->cv.notify_all();
+  }
+
+  std::string host_;
+  int port_;
+  std::string token_;
+  std::mutex mu_, wmu_;
+  int fd_ = -1;
+  std::atomic<long long> gen_{0};
+  long long next_id_ = 1;
+  std::unordered_map<long long, std::shared_ptr<Pending>> pending_;
+  std::mutex evmu_;
+  std::condition_variable evcv_;
+  std::deque<WatchEvent> events_;
+  std::atomic<bool> stop_{false};
+};
+
+// ---------------------------------------------------------------------------
+// result-store client (lock-step; one transparent reconnect+retry)
+// ---------------------------------------------------------------------------
+
+class LogClient {
+ public:
+  LogClient(std::string host, int port, std::string token)
+      : host_(std::move(host)), port_(port), token_(std::move(token)) {}
+
+  bool call(const std::string& op, const std::string& args_json,
+            std::string& reply_line) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (int attempt = 0; attempt < 2; attempt++) {
+      if (fd_ < 0 && !connect_locked()) continue;
+      std::string line = "{\"i\":";
+      jint(line, next_id_++);
+      line += ",\"o\":";
+      jesc(line, op);
+      line += ",\"a\":";
+      line += args_json;
+      line += "}\n";
+      if (send_all(line) && read_line(reply_line)) return true;
+      drop_locked();
+    }
+    return false;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> g(mu_);
+    drop_locked();
+  }
+
+ private:
+  bool connect_locked() {
+    struct addrinfo hints {};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    char ps[16];
+    snprintf(ps, sizeof ps, "%d", port_);
+    if (getaddrinfo(host_.c_str(), ps, &hints, &res) != 0) return false;
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+      return false;
+    }
+    freeaddrinfo(res);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    struct timeval tv {10, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    fd_ = fd;
+    buf_.clear();
+    if (!token_.empty()) {
+      std::string line = "{\"i\":0,\"o\":\"auth\",\"a\":[";
+      jesc(line, token_);
+      line += "]}\n";
+      std::string rep;
+      if (!send_all(line) || !read_line(rep) ||
+          rep.find("\"e\"") != std::string::npos) {
+        drop_locked();
+        return false;
+      }
+    }
+    return true;
+  }
+
+  bool send_all(const std::string& line) {
+    size_t off = 0;
+    while (off < line.size()) {
+      ssize_t n = ::send(fd_, line.data() + off, line.size() - off,
+                         MSG_NOSIGNAL);
+      if (n <= 0) return false;
+      off += (size_t)n;
+    }
+    return true;
+  }
+
+  bool read_line(std::string& out) {
+    while (true) {
+      size_t nl = buf_.find('\n');
+      if (nl != std::string::npos) {
+        out = buf_.substr(0, nl);
+        buf_.erase(0, nl + 1);
+        return true;
+      }
+      char chunk[65536];
+      ssize_t n = ::recv(fd_, chunk, sizeof chunk, 0);
+      if (n <= 0) return false;
+      buf_.append(chunk, (size_t)n);
+    }
+  }
+
+  void drop_locked() {
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+    buf_.clear();
+  }
+
+  std::string host_;
+  int port_;
+  std::string token_;
+  std::mutex mu_;
+  int fd_ = -1;
+  long long next_id_ = 1;
+  std::string buf_;
+};
+
+// ---------------------------------------------------------------------------
+// executor (fork/exec, setuid, process-group timeout, retry, gate)
+// ---------------------------------------------------------------------------
+
+// POSIX-ish shell tokenization (the Python agent uses shlex.split):
+// whitespace separates; '...' literal; "..." with \" and \\; bare \x
+// escapes x.  Returns false on unbalanced quotes.
+static bool shlex_split(const std::string& s, std::vector<std::string>& out) {
+  std::string cur;
+  bool has = false;
+  size_t i = 0;
+  while (i < s.size()) {
+    char c = s[i];
+    if (c == ' ' || c == '\t' || c == '\n') {
+      if (has) out.push_back(cur);
+      cur.clear();
+      has = false;
+      i++;
+    } else if (c == '\'') {
+      size_t j = s.find('\'', i + 1);
+      if (j == std::string::npos) return false;
+      cur.append(s, i + 1, j - i - 1);
+      has = true;
+      i = j + 1;
+    } else if (c == '"') {
+      i++;
+      has = true;
+      while (i < s.size() && s[i] != '"') {
+        if (s[i] == '\\' && i + 1 < s.size() &&
+            (s[i + 1] == '"' || s[i + 1] == '\\')) {
+          cur += s[i + 1];
+          i += 2;
+        } else {
+          cur += s[i++];
+        }
+      }
+      if (i >= s.size()) return false;
+      i++;
+    } else if (c == '\\' && i + 1 < s.size()) {
+      cur += s[i + 1];
+      has = true;
+      i += 2;
+    } else {
+      cur += c;
+      has = true;
+      i++;
+    }
+  }
+  if (has) out.push_back(cur);
+  return true;
+}
+
+struct ExecResult {
+  bool success = false;
+  std::string output;
+  double begin = 0, end = 0;
+  int exit_code = 0;
+  std::string error;
+  bool skipped = false;
+};
+
+static constexpr size_t kMaxOutput = 1u << 20;
+
+class Executor {
+ public:
+  // on_threshold fires once after threshold_s while the child still runs
+  // (the ProcReq hook: the proc key is written only for long runs)
+  ExecResult run_once(const std::string& command, const std::string& user,
+                      int timeout, double threshold_s,
+                      const std::function<void()>& on_threshold) {
+    ExecResult r;
+    r.begin = now_s();
+    std::vector<std::string> argv;
+    if (!shlex_split(command, argv)) {
+      r.end = now_s();
+      r.error = "bad command: unbalanced quote";
+      return r;
+    }
+    if (argv.empty()) {
+      r.end = now_s();
+      r.error = "empty command";
+      return r;
+    }
+    uid_t uid = 0;
+    gid_t gid = 0;
+    bool demote = false;
+    if (!user.empty()) {
+      struct passwd* pw = getpwnam(user.c_str());
+      if (!pw) {
+        r.end = now_s();
+        r.error = "user '" + user + "' not found";
+        return r;
+      }
+      uid = pw->pw_uid;
+      gid = pw->pw_gid;
+      demote = true;
+    }
+    int pfd[2];
+    if (pipe(pfd) != 0) {
+      r.end = now_s();
+      r.error = "pipe failed";
+      return r;
+    }
+    pid_t pid = fork();
+    if (pid < 0) {
+      ::close(pfd[0]);
+      ::close(pfd[1]);
+      r.end = now_s();
+      r.error = "fork failed";
+      return r;
+    }
+    if (pid == 0) {
+      setsid();
+      if (demote) {
+        if (setgid(gid) != 0 || setuid(uid) != 0) _exit(126);
+      }
+      dup2(pfd[1], 1);
+      dup2(pfd[1], 2);
+      ::close(pfd[0]);
+      ::close(pfd[1]);
+      std::vector<char*> cargv;
+      for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+      cargv.push_back(nullptr);
+      execvp(cargv[0], cargv.data());
+      dprintf(2, "exec failed: %s\n", strerror(errno));
+      _exit(127);
+    }
+    ::close(pfd[1]);
+    // read combined output with timeout + the ProcReq threshold callback
+    std::string out;
+    bool fired_threshold = threshold_s <= 0;
+    bool timed_out = false;
+    double deadline = timeout > 0 ? r.begin + timeout : 0;
+    while (true) {
+      double nw = now_s();
+      if (!fired_threshold && nw - r.begin >= threshold_s) {
+        fired_threshold = true;
+        if (on_threshold) on_threshold();
+      }
+      double wait_s = 0.25;
+      if (!fired_threshold)
+        wait_s = std::min(wait_s, r.begin + threshold_s - nw);
+      if (deadline > 0) wait_s = std::min(wait_s, deadline - nw);
+      if (deadline > 0 && nw >= deadline) {
+        timed_out = true;
+        break;
+      }
+      struct pollfd pf {pfd[0], POLLIN, 0};
+      int pr = poll(&pf, 1, std::max(1, (int)(wait_s * 1000)));
+      if (pr > 0) {
+        char chunk[65536];
+        ssize_t n = ::read(pfd[0], chunk, sizeof chunk);
+        if (n <= 0) break;  // EOF: child closed stdout/stderr
+        if (out.size() < kMaxOutput)
+          out.append(chunk, (size_t)std::min<ssize_t>(
+                                n, (ssize_t)(kMaxOutput - out.size())));
+      }
+    }
+    if (timed_out) {
+      kill(-pid, SIGKILL);
+      // drain whatever remains so the child can die
+      char chunk[4096];
+      while (::read(pfd[0], chunk, sizeof chunk) > 0) {
+      }
+    }
+    ::close(pfd[0]);
+    int status = 0;
+    waitpid(pid, &status, 0);
+    r.end = now_s();
+    r.output = out;
+    if (timed_out) {
+      r.exit_code = -9;
+      r.error = "timeout after " + std::to_string(timeout) + "s";
+      return r;
+    }
+    r.exit_code = WIFEXITED(status) ? WEXITSTATUS(status)
+                                    : 128 + WTERMSIG(status);
+    r.success = r.exit_code == 0;
+    if (!r.success)
+      r.error = "exit status " + std::to_string(r.exit_code);
+    return r;
+  }
+
+  // Parallels gate + retry loop (job.go:134-187 semantics)
+  ExecResult run_job(const std::string& job_id, const std::string& command,
+                     const std::string& user, int timeout, int retry,
+                     int interval, int parallels, double threshold_s,
+                     const std::function<void()>& on_threshold) {
+    if (!gate_enter(job_id, parallels)) {
+      ExecResult r;
+      r.begin = r.end = now_s();
+      r.skipped = true;
+      r.error = "parallels limit reached, run skipped";
+      return r;
+    }
+    // the ProcReq threshold spans the WHOLE run including retries (the
+    // Python agent arms one timer around run_job)
+    bool fired = threshold_s <= 0;
+    auto fire_once = [&] {
+      if (!fired) {
+        fired = true;
+        if (on_threshold) on_threshold();
+      }
+    };
+    ExecResult result =
+        run_once(command, user, timeout, threshold_s, fire_once);
+    int attempts = 0;
+    while (!result.success && !result.skipped && attempts < retry) {
+      if (interval > 0)
+        std::this_thread::sleep_for(std::chrono::seconds(interval));
+      attempts++;
+      double begin0 = result.begin;
+      double remain = 0;
+      if (!fired) {
+        remain = std::max(0.01, begin0 + threshold_s - now_s());
+      }
+      result = run_once(command, user, timeout, remain,
+                        fired ? std::function<void()>() : fire_once);
+      result.begin = begin0;  // whole-run span
+      if (result.success) break;
+    }
+    gate_leave(job_id, parallels);
+    return result;
+  }
+
+ private:
+  bool gate_enter(const std::string& id, int limit) {
+    if (limit <= 0) return true;
+    std::lock_guard<std::mutex> g(gmu_);
+    int& c = gate_[id];
+    if (c >= limit) return false;
+    c++;
+    return true;
+  }
+  void gate_leave(const std::string& id, int limit) {
+    if (limit <= 0) return;
+    std::lock_guard<std::mutex> g(gmu_);
+    auto it = gate_.find(id);
+    if (it != gate_.end() && --it->second <= 0) gate_.erase(it);
+  }
+  std::mutex gmu_;
+  std::map<std::string, int> gate_;
+};
+
+// ---------------------------------------------------------------------------
+// agent
+// ---------------------------------------------------------------------------
+
+struct JobSpec {
+  std::string id, group, name, command, user;
+  int timeout = 0, retry = 0, interval = 0, parallels = 0, kind = 0;
+  bool pause = false, fail_notify = false;
+  double avg_time = 0;
+  std::vector<std::string> to;
+  // per-rule placement for IsRunOn
+  struct Rule {
+    std::vector<std::string> nids, gids, exclude_nids;
+  };
+  std::vector<Rule> rules;
+};
+
+static std::vector<std::string> str_list(const JV* v) {
+  std::vector<std::string> out;
+  if (v && v->t == JV::ARR)
+    for (const JV& e : v->arr)
+      if (e.t == JV::STR) out.push_back(e.s);
+  return out;
+}
+
+static bool parse_job(const std::string& json, JobSpec& j) {
+  JParser jp(json);
+  JV v;
+  if (!jp.value(v) || v.t != JV::OBJ) return false;
+  auto S = [&](const char* k, std::string& dst) {
+    const JV* f = v.get(k);
+    if (f && f->t == JV::STR) dst = f->s;
+  };
+  auto I = [&](const char* k, int& dst) {
+    const JV* f = v.get(k);
+    if (f && (f->t == JV::INT || f->t == JV::DBL)) dst = (int)f->as_int();
+  };
+  S("id", j.id);
+  S("group", j.group);
+  S("name", j.name);
+  S("command", j.command);
+  S("user", j.user);
+  I("timeout", j.timeout);
+  I("retry", j.retry);
+  I("interval", j.interval);
+  I("parallels", j.parallels);
+  I("kind", j.kind);
+  if (const JV* f = v.get("pause")) j.pause = f->t == JV::BOOL && f->b;
+  if (const JV* f = v.get("fail_notify"))
+    j.fail_notify = f->t == JV::BOOL && f->b;
+  if (const JV* f = v.get("avg_time")) j.avg_time = f->as_dbl();
+  j.to = str_list(v.get("to"));
+  if (const JV* rs = v.get("rules"))
+    if (rs->t == JV::ARR)
+      for (const JV& r : rs->arr) {
+        JobSpec::Rule rule;
+        rule.nids = str_list(r.get("nids"));
+        rule.gids = str_list(r.get("gids"));
+        rule.exclude_nids = str_list(r.get("exclude_nids"));
+        j.rules.push_back(std::move(rule));
+      }
+  return true;
+}
+
+class Agent {
+ public:
+  Agent(StoreClient& store, LogClient& logd, std::string node_id,
+        std::string prefix, double ttl, double proc_ttl, double lock_ttl,
+        double proc_req, int workers)
+      : store_(store), logd_(logd), id_(std::move(node_id)),
+        pfx_(std::move(prefix)), ttl_(ttl), proc_ttl_(proc_ttl),
+        lock_ttl_(lock_ttl), proc_req_(proc_req) {
+    char hn[256] = "unknown";
+    gethostname(hn, sizeof hn);
+    hostname_ = hn;
+    std::random_device rd;
+    rng_.seed(rd());
+    for (int i = 0; i < workers; i++)
+      std::thread(&Agent::worker, this).detach();
+  }
+
+  bool start() {
+    if (probe_duplicate() != ProbeResult::kOk) return false;
+    if (!register_node()) return false;
+    proc_lease_ = store_.grant(proc_ttl_);
+    load_groups();
+    open_watches();
+    std::thread(&Agent::keepalive_loop, this).detach();
+    std::thread(&Agent::event_loop, this).detach();
+    return true;
+  }
+
+  void stop() {
+    stop_ = true;
+    {
+      std::lock_guard<std::mutex> g(qmu_);
+      qcv_.notify_all();
+    }
+    if (lease_) store_.revoke(lease_);
+    if (proc_lease_) store_.revoke(proc_lease_);
+    if (fence_lease_) store_.revoke(fence_lease_);
+    std::string args = "[";
+    jesc(args, id_);
+    args += ",false]";
+    std::string rep;
+    logd_.call("set_node_alived", args, rep);
+  }
+
+ private:
+  // -- registration ------------------------------------------------------
+
+  enum class ProbeResult { kOk, kDuplicate, kUnknown };
+
+  // tri-state: a store RPC failure is "cannot check", never "duplicate"
+  // — a transient outage must not kill the fleet (the Python agent
+  // retries transients and treats only a confirmed replacement as fatal)
+  ProbeResult probe_duplicate() {
+    std::string v;
+    bool found = false;
+    if (!store_.get(pfx_ + "/node/" + id_, v, nullptr, found))
+      return ProbeResult::kUnknown;
+    if (!found) return ProbeResult::kOk;
+    size_t c = v.rfind(':');
+    if (c == std::string::npos) return ProbeResult::kOk;  // take over
+    std::string host = v.substr(0, c);
+    long pid = atol(v.c_str() + c + 1);
+    if (!host.empty() && host != hostname_) {
+      fprintf(stderr, "node '%s' already registered on host '%s'\n",
+              id_.c_str(), host.c_str());
+      return ProbeResult::kDuplicate;
+    }
+    if (pid == getpid()) return ProbeResult::kOk;
+    if (kill((pid_t)pid, 0) == 0 || errno == EPERM) {
+      fprintf(stderr, "node '%s' already registered by live pid %ld\n",
+              id_.c_str(), pid);
+      return ProbeResult::kDuplicate;
+    }
+    return ProbeResult::kOk;  // stale same-host pid: take over
+  }
+
+  // lease + node key + the ALIVE mirror (reference node.go:64-89,129-134);
+  // also the re-register path after a lease lapse — the mirror must flip
+  // back to alive or the fleet shows the node dead while it executes
+  bool register_node() {
+    lease_ = store_.grant(ttl_ + 2);
+    if (!lease_) return false;
+    store_.put(pfx_ + "/node/" + id_,
+               hostname_ + ":" + std::to_string(getpid()), lease_);
+    std::string doc = "{\"id\":";
+    jesc(doc, id_);
+    doc += ",\"pid\":";
+    jint(doc, getpid());
+    doc += ",\"ip\":";
+    jesc(doc, id_);
+    doc += ",\"hostname\":";
+    jesc(doc, hostname_);
+    doc += ",\"version\":\"v0.1.0-tpu-native\",\"up_ts\":";
+    jdbl(doc, now_s());
+    doc += ",\"alived\":true}";
+    std::string args = "[";
+    jesc(args, id_);
+    args += ',';
+    jesc(args, doc);
+    args += ",true]";
+    std::string rep;
+    logd_.call("upsert_node", args, rep);
+    return true;
+  }
+
+  void keepalive_loop() {
+    while (!stop_) {
+      std::this_thread::sleep_for(
+          std::chrono::duration<double>(std::max(1.0, ttl_ / 3)));
+      if (stop_) return;
+      if (!store_.keepalive(lease_)) {
+        switch (probe_duplicate()) {
+          case ProbeResult::kDuplicate:
+            fprintf(stderr, "identity lost to a live replacement; "
+                            "exiting\n");
+            exit(1);
+          case ProbeResult::kUnknown:
+            continue;  // store unreachable: retry next beat
+          case ProbeResult::kOk:
+            register_node();
+            break;
+        }
+      }
+      std::lock_guard<std::mutex> g(procs_mu_);
+      if (!proc_lease_ || !store_.keepalive(proc_lease_)) {
+        proc_lease_ = store_.grant(proc_ttl_);
+        for (const auto& [k, v] : procs_) store_.put(k, v, proc_lease_);
+      }
+    }
+  }
+
+  // -- groups / IsRunOn --------------------------------------------------
+
+  void load_groups() {
+    std::vector<std::pair<std::string, std::string>> kvs;
+    if (!store_.get_prefix(pfx_ + "/group/", kvs)) return;
+    std::lock_guard<std::mutex> g(groups_mu_);
+    groups_.clear();
+    for (const auto& [k, v] : kvs) apply_group(v);
+  }
+
+  void apply_group(const std::string& json) {
+    JParser jp(json);
+    JV v;
+    if (!jp.value(v) || v.t != JV::OBJ) return;
+    const JV* idf = v.get("id");
+    if (!idf || idf->t != JV::STR) return;
+    groups_[idf->s] = str_list(v.get("nids"));
+  }
+
+  bool is_run_on(const JobSpec& j) {
+    std::lock_guard<std::mutex> g(groups_mu_);
+    for (const auto& r : j.rules) {
+      if (std::find(r.exclude_nids.begin(), r.exclude_nids.end(), id_) !=
+          r.exclude_nids.end())
+        continue;
+      if (std::find(r.nids.begin(), r.nids.end(), id_) != r.nids.end())
+        return true;
+      for (const auto& gid : r.gids) {
+        auto it = groups_.find(gid);
+        if (it != groups_.end() &&
+            std::find(it->second.begin(), it->second.end(), id_) !=
+                it->second.end())
+          return true;
+      }
+    }
+    return false;
+  }
+
+  // -- watches + events --------------------------------------------------
+
+  void open_watches() {
+    w_dispatch_ = store_.watch(pfx_ + "/dispatch/" + id_ + "/");
+    w_broadcast_ = store_.watch(pfx_ + "/dispatch/_all/");
+    w_group_ = store_.watch(pfx_ + "/group/");
+    w_once_ = store_.watch(pfx_ + "/once/");
+  }
+
+  void event_loop() {
+    while (!stop_) {
+      WatchEvent ev;
+      if (!store_.next_event(ev, 0.5)) continue;
+      if (ev.lost) {
+        // stream loss (one cancelled watcher or a whole-connection
+        // drop): wait for heal, close surviving server-side watchers
+        // (a reopened set must not leave the old ones pumping), then
+        // full resync — re-listed orders re-run behind the fences
+        while (!stop_ && !store_.connected())
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        if (stop_) return;
+        for (long long w : {w_dispatch_, w_broadcast_, w_group_, w_once_})
+          store_.unwatch(w);
+        load_groups();
+        open_watches();
+        resync_orders();
+        continue;
+      }
+      if (ev.wid == w_group_) {
+        std::string gid = ev.key.substr((pfx_ + "/group/").size());
+        std::lock_guard<std::mutex> g(groups_mu_);
+        if (ev.is_delete)
+          groups_.erase(gid);
+        else
+          apply_group(ev.value);
+      } else if (ev.wid == w_dispatch_ && !ev.is_delete) {
+        handle_dispatch(ev.key, /*consume=*/true);
+      } else if (ev.wid == w_broadcast_ && !ev.is_delete) {
+        handle_broadcast(ev.key);
+      } else if (ev.wid == w_once_ && !ev.is_delete) {
+        if (ev.value.empty() || ev.value == id_) handle_once(ev.key);
+      }
+    }
+  }
+
+  void resync_orders() {
+    std::vector<std::pair<std::string, std::string>> kvs;
+    if (store_.get_prefix(pfx_ + "/dispatch/" + id_ + "/", kvs))
+      for (const auto& [k, v] : kvs) handle_dispatch(k, true);
+    kvs.clear();
+    if (store_.get_prefix(pfx_ + "/dispatch/_all/", kvs))
+      for (const auto& [k, v] : kvs) handle_broadcast(k);
+  }
+
+  // key: <pfx>/dispatch/<id>/<epoch>/<group>/<job>
+  void handle_dispatch(const std::string& key, bool consume) {
+    std::string rest = key.substr((pfx_ + "/dispatch/" + id_ + "/").size());
+    long long epoch;
+    std::string group, job_id;
+    if (!split3(rest, epoch, group, job_id)) return;
+    JobSpec j;
+    if (!fetch_job(group, job_id, j) || j.pause) {
+      store_.del(key);
+      return;
+    }
+    enqueue(j, epoch, /*fenced=*/true, /*gate=*/true,
+            consume ? key : std::string());
+  }
+
+  void handle_broadcast(const std::string& key) {
+    std::string rest = key.substr((pfx_ + "/dispatch/_all/").size());
+    long long epoch;
+    std::string group, job_id;
+    if (!split3(rest, epoch, group, job_id)) return;
+    {
+      std::lock_guard<std::mutex> g(bseen_mu_);
+      if (!bseen_.emplace(std::make_pair(job_id, epoch), now_s()).second)
+        return;
+      if (bseen_.size() > 8192) {
+        // age-based prune (agent.py keeps a half-hour window): the
+        // resync re-list depends on recent entries surviving — a full
+        // clear would double-run Common broadcasts, which have no fence
+        double cut = now_s() - 1800;
+        for (auto it = bseen_.begin(); it != bseen_.end();)
+          it = it->second < cut ? bseen_.erase(it) : std::next(it);
+      }
+    }
+    JobSpec j;
+    if (!fetch_job(group, job_id, j) || j.pause || !is_run_on(j)) return;
+    enqueue(j, epoch, true, true, "");
+  }
+
+  void handle_once(const std::string& key) {
+    std::string rest = key.substr((pfx_ + "/once/").size());
+    size_t s = rest.find('/');
+    if (s == std::string::npos) return;
+    JobSpec j;
+    if (!fetch_job(rest.substr(0, s), rest.substr(s + 1), j)) return;
+    // run-now: no fence, no gate, immediate dedicated thread
+    std::thread([this, j] { execute(j, (long long)now_s(), false, false, "");
+    }).detach();
+  }
+
+  static bool split3(const std::string& rest, long long& epoch,
+                     std::string& group, std::string& job_id) {
+    size_t a = rest.find('/');
+    if (a == std::string::npos) return false;
+    size_t b = rest.find('/', a + 1);
+    if (b == std::string::npos) return false;
+    epoch = atoll(rest.substr(0, a).c_str());
+    group = rest.substr(a + 1, b - a - 1);
+    job_id = rest.substr(b + 1);
+    return !group.empty() && !job_id.empty();
+  }
+
+  bool fetch_job(const std::string& group, const std::string& job_id,
+                 JobSpec& j) {
+    std::string v;
+    bool found = false;
+    if (!store_.get(pfx_ + "/cmd/" + group + "/" + job_id, v, nullptr,
+                    found) ||
+        !found)
+      return false;
+    if (!parse_job(v, j)) return false;
+    j.group = group;
+    j.id = job_id;
+    return true;
+  }
+
+  // -- the execution pipeline -------------------------------------------
+
+  struct Task {
+    JobSpec job;
+    long long epoch;
+    bool fenced, gate;
+    std::string order_key;
+  };
+
+  void enqueue(const JobSpec& j, long long epoch, bool fenced, bool gate,
+               const std::string& order_key) {
+    std::lock_guard<std::mutex> g(qmu_);
+    queue_.push({epoch, seq_++, std::make_shared<Task>(
+                                    Task{j, epoch, fenced, gate, order_key})});
+    qcv_.notify_one();
+  }
+
+  struct QItem {
+    long long due;
+    long long seq;
+    std::shared_ptr<Task> task;
+    bool operator>(const QItem& o) const {
+      return due != o.due ? due > o.due : seq > o.seq;
+    }
+  };
+
+  void worker() {
+    while (!stop_) {
+      std::shared_ptr<Task> task;
+      {
+        std::unique_lock<std::mutex> g(qmu_);
+        while (!stop_) {
+          if (!queue_.empty()) {
+            double wait = (double)queue_.top().due - now_s();
+            if (wait <= 0.02) {
+              task = queue_.top().task;
+              queue_.pop();
+              break;
+            }
+            qcv_.wait_for(g, std::chrono::duration<double>(
+                                 std::min(wait, 0.5)));
+          } else {
+            qcv_.wait_for(g, std::chrono::milliseconds(200));
+          }
+        }
+      }
+      if (!task) return;
+      execute(task->job, task->epoch, task->fenced, task->gate,
+              task->order_key);
+    }
+  }
+
+  void execute(const JobSpec& j, long long epoch, bool fenced, bool gate,
+               const std::string& order_key) {
+    bool order_done = false;
+    auto consume = [&] {
+      if (!order_key.empty() && !order_done) {
+        order_done = true;
+        store_.del(order_key);
+      }
+    };
+    long long alone_lease = 0;
+    std::shared_ptr<std::atomic<bool>> alone_stop;
+    if (fenced && j.kind == 1) {  // KindAlone lifetime lock FIRST
+      double attl = std::max(5.0, std::min(lock_ttl_, 2 * j.avg_time + 5));
+      alone_lease = store_.grant(attl);
+      bool won = false;
+      if (!alone_lease ||
+          !store_.put_if_absent(pfx_ + "/lock/alone/" + j.id, id_,
+                                alone_lease, won) ||
+          !won) {
+        if (alone_lease) store_.revoke(alone_lease);
+        consume();
+        return;  // previous Alone run still live fleet-wide
+      }
+      alone_stop = std::make_shared<std::atomic<bool>>(false);
+      long long lease = alone_lease;
+      StoreClient* sc = &store_;
+      std::thread([sc, lease, attl, alone_stop] {
+        while (!alone_stop->load()) {
+          std::this_thread::sleep_for(
+              std::chrono::duration<double>(std::max(0.5, attl / 3)));
+          if (alone_stop->load()) return;
+          sc->keepalive(lease);
+        }
+      }).detach();
+    }
+    if (fenced && j.kind != 0) {  // exclusive: (job, second) fence
+      if (!fence(j.id, epoch)) {
+        if (alone_lease) {
+          alone_stop->store(true);
+          store_.revoke(alone_lease);
+        }
+        consume();
+        return;  // another node already ran this (job, second)
+      }
+    }
+    // proc registry key, written only if the run outlives proc_req
+    std::string proc_key = pfx_ + "/proc/" + id_ + "/" + j.group + "/" +
+                           j.id + "/" + std::to_string(epoch) + "-" +
+                           std::to_string(getpid());
+    std::string proc_val = "{\"time\":";
+    jdbl(proc_val, now_s());
+    proc_val += "}";
+    std::atomic<bool> proc_put{false};
+    auto on_threshold = [&] {
+      std::lock_guard<std::mutex> g(procs_mu_);
+      procs_[proc_key] = proc_val;
+      store_.put(proc_key, proc_val, proc_lease_);
+      proc_put = true;
+      // consume the order in the same breath (outstanding-capacity
+      // reservation until the proc key exists)
+      if (!order_key.empty() && !order_done) {
+        order_done = true;
+        store_.del(order_key);
+      }
+    };
+    // proc_req <= 0 means register EVERY run immediately (agent.py puts
+    // the proc key before exec when no suppression threshold is set)
+    if (proc_req_ <= 0) on_threshold();
+    ExecResult res = exec_.run_job(
+        j.id, j.command, j.user, j.timeout, j.retry, j.interval,
+        gate ? j.parallels : 0, proc_req_, on_threshold);
+    if (proc_put) {
+      std::lock_guard<std::mutex> g(procs_mu_);
+      procs_.erase(proc_key);
+      store_.del(proc_key);
+    }
+    if (alone_lease) {
+      alone_stop->store(true);
+      store_.revoke(alone_lease);  // deletes the alone lock key
+    }
+    consume();
+    if (!res.skipped) {
+      record(j, res);
+      update_avg_time(j, res);
+    }
+  }
+
+  bool fence(const std::string& job_id, long long epoch) {
+    std::string key =
+        pfx_ + "/lock/" + job_id + "/" + std::to_string(epoch);
+    for (int attempt = 0; attempt < 2; attempt++) {
+      long long lease;
+      {
+        std::lock_guard<std::mutex> g(fence_mu_);
+        double nw = now_s();
+        if (!fence_lease_ || nw >= fence_rotate_at_ || attempt > 0) {
+          fence_lease_ = store_.grant(lock_ttl_ + 60);
+          fence_rotate_at_ = nw + lock_ttl_ / 2;
+        }
+        lease = fence_lease_;
+      }
+      bool won = false;
+      StoreError err;
+      if (store_.put_if_absent_err(key, id_, lease, won, err)) return won;
+      if (err.kind != "KeyError") break;
+      // shared lease expired under us (suspended VM, store restart):
+      // rotate immediately and retry — exclusive runs must not be
+      // silently skipped until the next scheduled rotation
+    }
+    return false;  // store unreachable: do NOT run unfenced
+  }
+
+  void record(const JobSpec& j, const ExecResult& res) {
+    std::string out = res.output;
+    if (!res.success && !res.error.empty()) {
+      if (!out.empty()) out += "\n";
+      out += "[error] " + res.error;
+    }
+    std::string rec = "{\"job_id\":";
+    jesc(rec, j.id);
+    rec += ",\"job_group\":";
+    jesc(rec, j.group);
+    rec += ",\"name\":";
+    jesc(rec, j.name);
+    rec += ",\"node\":";
+    jesc(rec, id_);
+    rec += ",\"user\":";
+    jesc(rec, j.user);
+    rec += ",\"command\":";
+    jesc(rec, j.command);
+    rec += ",\"output\":";
+    jesc(rec, out);
+    rec += ",\"success\":";
+    rec += res.success ? "true" : "false";
+    rec += ",\"begin_ts\":";
+    jdbl(rec, res.begin);
+    rec += ",\"end_ts\":";
+    jdbl(rec, res.end);
+    rec += ",\"id\":null}";
+    std::string args = "[" + rec + ",";
+    jesc(args, idem_token());
+    args += "]";
+    std::string rep;
+    logd_.call("create_job_log", args, rep);
+    if (!res.success && j.fail_notify) {
+      std::string body = "job: " + j.group + "/" + j.id + "\nnode: " + id_ +
+                         "\noutput: " + res.output + "\nerror: " + res.error;
+      std::string msg = "{\"subject\":";
+      jesc(msg, "[cronsun] job [" + j.name + "] fail");
+      msg += ",\"body\":";
+      jesc(msg, body);
+      msg += ",\"to\":[";
+      for (size_t i = 0; i < j.to.size(); i++) {
+        if (i) msg += ',';
+        jesc(msg, j.to[i]);
+      }
+      msg += "]}";
+      store_.put(pfx_ + "/noticer/" + id_, msg, 0);
+    }
+  }
+
+  void update_avg_time(const JobSpec& j, const ExecResult& res) {
+    double dur = std::max(0.0, res.end - res.begin);
+    if (j.avg_time > 0 &&
+        std::abs(dur - j.avg_time) <= 0.1 * std::max(1.0, j.avg_time))
+      return;  // EWMA-neutral: skip the CAS round trips
+    std::string key = pfx_ + "/cmd/" + j.group + "/" + j.id;
+    for (int i = 0; i < 3; i++) {
+      std::string v;
+      long long mr = 0;
+      bool found = false;
+      if (!store_.get(key, v, &mr, found) || !found) return;
+      // splice the new avg_time into the stored JSON (the reference
+      // folds avg of the last two, job.go:581-589)
+      JParser jp(v);
+      JV o;
+      if (!jp.value(o) || o.t != JV::OBJ) return;
+      double cur = 0;
+      if (const JV* f = o.get("avg_time")) cur = f->as_dbl();
+      double nxt = cur <= 0 ? dur : (cur + dur) / 2;
+      std::string out;
+      if (!splice_avg(v, nxt, out)) return;
+      bool won = false;
+      if (store_.put_if_mod_rev(key, out, mr, won) && won) return;
+    }
+  }
+
+  // rewrite "avg_time":<num> inside the job JSON text (field injected by
+  // Job.to_json always)
+  static bool splice_avg(const std::string& v, double nxt,
+                         std::string& out) {
+    size_t p = v.find("\"avg_time\":");
+    if (p == std::string::npos) return false;
+    size_t s = p + strlen("\"avg_time\":");
+    size_t e = s;
+    while (e < v.size() && v[e] != ',' && v[e] != '}') e++;
+    out = v.substr(0, s);
+    jdbl(out, nxt);
+    out += v.substr(e);
+    return true;
+  }
+
+  std::string idem_token() {
+    std::lock_guard<std::mutex> g(rng_mu_);
+    char buf[33];
+    for (int i = 0; i < 32; i++)
+      buf[i] = "0123456789abcdef"[rng_() & 15];
+    buf[32] = 0;
+    return buf;
+  }
+
+  StoreClient& store_;
+  LogClient& logd_;
+  Executor exec_;
+  std::string id_, pfx_, hostname_;
+  double ttl_, proc_ttl_, lock_ttl_, proc_req_;
+  long long lease_ = 0, proc_lease_ = 0;
+  std::mutex procs_mu_;
+  std::map<std::string, std::string> procs_;
+  std::mutex fence_mu_;
+  long long fence_lease_ = 0;
+  double fence_rotate_at_ = 0;
+  std::mutex groups_mu_;
+  std::map<std::string, std::vector<std::string>> groups_;
+  std::mutex bseen_mu_;
+  std::map<std::pair<std::string, long long>, double> bseen_;
+  long long w_dispatch_ = -1, w_broadcast_ = -1, w_group_ = -1,
+            w_once_ = -1;
+  std::mutex qmu_;
+  std::condition_variable qcv_;
+  std::priority_queue<QItem, std::vector<QItem>, std::greater<QItem>> queue_;
+  long long seq_ = 0;
+  std::atomic<bool> stop_{false};
+  std::mt19937 rng_;
+  std::mutex rng_mu_;
+};
+
+// ---------------------------------------------------------------------------
+// main
+// ---------------------------------------------------------------------------
+
+static std::atomic<bool> g_exit{false};
+static void on_signal(int) { g_exit = true; }
+
+int main(int argc, char** argv) {
+  std::string store_addr = "127.0.0.1:7070";
+  std::string logd_addr;
+  std::string node_id, prefix = "/cronsun";
+  std::string store_token, log_token;
+  double ttl = 10, proc_ttl = 600, lock_ttl = 300, proc_req = 5;
+  int workers = 64;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--store") store_addr = next();
+    else if (a == "--logsink") logd_addr = next();
+    else if (a == "--node-id") node_id = next();
+    else if (a == "--prefix") prefix = next();
+    else if (a == "--ttl") ttl = atof(next());
+    else if (a == "--proc-ttl") proc_ttl = atof(next());
+    else if (a == "--lock-ttl") lock_ttl = atof(next());
+    else if (a == "--proc-req") proc_req = atof(next());
+    else if (a == "--workers") workers = atoi(next());
+    else if (a == "--store-token") store_token = next();
+    else if (a == "--log-token") log_token = next();
+    else if (a == "--die-with-parent") {
+      prctl(PR_SET_PDEATHSIG, SIGKILL);
+      if (getppid() == 1) return 1;
+    }
+    else if (a == "--help") {
+      printf("cronsun-agentd --store H:P --logsink H:P --node-id ID "
+             "[--prefix /cronsun] [--ttl S] [--proc-ttl S] [--lock-ttl S] "
+             "[--proc-req S] [--workers N] [--store-token T] "
+             "[--log-token T] [--die-with-parent]\n");
+      return 0;
+    }
+  }
+  if (node_id.empty()) {
+    char hn[256] = "node";
+    gethostname(hn, sizeof hn);
+    node_id = hn;
+  }
+  if (logd_addr.empty()) {
+    fprintf(stderr, "--logsink H:P required (the networked result store)\n");
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+
+  auto split_addr = [](const std::string& a, std::string& h, int& p) {
+    size_t c = a.rfind(':');
+    h = c == std::string::npos ? "127.0.0.1" : a.substr(0, c);
+    p = atoi(a.c_str() + (c == std::string::npos ? 0 : c + 1));
+    if (h.empty()) h = "127.0.0.1";
+  };
+  std::string sh, lh;
+  int sp = 0, lp = 0;
+  split_addr(store_addr, sh, sp);
+  split_addr(logd_addr, lh, lp);
+
+  StoreClient store(sh, sp, store_token);
+  if (!store.connect_once()) {
+    fprintf(stderr, "cannot connect to store %s\n", store_addr.c_str());
+    return 1;
+  }
+  LogClient logd(lh, lp, log_token);
+  Agent agent(store, logd, node_id, prefix, ttl, proc_ttl, lock_ttl,
+              proc_req, workers);
+  if (!agent.start()) return 1;
+  printf("READY %s\n", node_id.c_str());
+  fflush(stdout);
+  while (!g_exit)
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  agent.stop();
+  store.close();
+  logd.close();
+  return 0;
+}
