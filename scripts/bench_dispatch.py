@@ -97,12 +97,26 @@ def run_bench(rates, n_agents, seconds, on_log=print):
     agents = []
     node_ids = [f"bench-agent-{i}" for i in range(n_agents)]
     here = os.path.abspath(__file__)
+    agentd = os.path.join(os.path.dirname(os.path.dirname(here)),
+                          "native", "cronsun-agentd")
+    use_native_agents = (os.environ.get("BENCH_AGENT", "py") == "native"
+                         and os.path.exists(agentd))
     for nid in node_ids:
-        p = subprocess.Popen(
-            [sys.executable, here, "--worker",
-             f"{store_srv.host}:{store_srv.port}",
-             f"{logd.host}:{logd.port}", nid],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        if use_native_agents:
+            # the native agent REALLY fork/execs each order's command
+            # (true) — the fully end-to-end number, no stub executor
+            p = subprocess.Popen(
+                [agentd, "--store",
+                 f"{store_srv.host}:{store_srv.port}",
+                 "--logsink", f"{logd.host}:{logd.port}",
+                 "--node-id", nid, "--proc-req", "5"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        else:
+            p = subprocess.Popen(
+                [sys.executable, here, "--worker",
+                 f"{store_srv.host}:{store_srv.port}",
+                 f"{logd.host}:{logd.port}", nid],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         agents.append(p)
     for p in agents:
         # log warnings may precede READY; read until it appears
@@ -119,7 +133,8 @@ def run_bench(rates, n_agents, seconds, on_log=print):
                 pass
         threading.Thread(target=_drain, daemon=True).start()
 
-    results = {"dispatch_plane_backend": backend,
+    results = {"dispatch_plane_backend": backend
+               + ("+native-agents" if use_native_agents else ""),
                "dispatch_plane_agents": n_agents,
                # the whole plane (store server, logd, driver, agents)
                # shares this host's cores; on 1 core the figure measures
