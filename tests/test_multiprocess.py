@@ -320,22 +320,38 @@ def test_secured_fleet_end_to_end(tmp_path):
                        "--logsink", logd_addr, "--conf", str(conf),
                        "--port", "0")
         procs += [sched_p, node_p, web_p]
+        # the native agent authenticates with the same shared secrets
+        import pathlib
+        agentd = pathlib.Path(REPO) / "native" / "cronsun-agentd"
+        nagent_p = None
+        if agentd.exists():
+            nagent_p = subprocess.Popen(
+                [str(agentd), "--store", store_addr, "--logsink", logd_addr,
+                 "--node-id", "sec-cxx", "--ttl", "5",
+                 "--store-token", "st-secret", "--log-token", "lg-secret"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            procs.append(nagent_p)
         _await_ready(sched_p)
         _await_ready(node_p)
+        if nagent_p is not None:
+            _await_ready(nagent_p)
         web_addr = _await_ready(web_p)
 
         op, base = _login(web_addr)
+        nids = ["sec-node"] + (["sec-cxx"] if nagent_p else [])
         job = {"name": "sec", "command": "echo secured", "kind": 0,
-               "rules": [{"timer": "* * * * * *", "nids": ["sec-node"]}]}
+               "rules": [{"timer": "* * * * * *", "nids": nids}]}
         _put_job(op, base, job)
 
         sink = RemoteJobLogStore(lh, int(lp), token="lg-secret")
         deadline = time.time() + 45
-        total = 0
-        while time.time() < deadline and total < 2:
-            _, total = sink.query_logs()
+        nodes_seen = set()
+        while time.time() < deadline and nodes_seen != set(nids):
+            logs, total = sink.query_logs(page_size=200)
+            nodes_seen = {l.node for l in logs}
             time.sleep(0.5)
-        assert total >= 2, "secured fleet executed nothing"
+        assert nodes_seen == set(nids), \
+            f"secured fleet missing executions from {set(nids) - nodes_seen}"
         sink.close()
     finally:
         _teardown(procs)
