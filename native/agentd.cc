@@ -957,6 +957,13 @@ class Agent {
     if (lease_) store_.revoke(lease_);
     if (proc_lease_) store_.revoke(proc_lease_);
     if (fence_lease_) store_.revoke(fence_lease_);
+    {
+      // under the metrics mutex so a concurrent publish cannot re-grant
+      // and resurrect the snapshot after the revoke
+      std::lock_guard<std::mutex> mg(metrics_mu_);
+      if (metrics_lease_ > 0) store_.revoke(metrics_lease_);
+      metrics_lease_ = -1;
+    }
     std::string args = "[";
     jesc(args, id_);
     args += ",false]";
@@ -1043,12 +1050,47 @@ class Agent {
             break;
         }
       }
-      std::lock_guard<std::mutex> g(procs_mu_);
-      if (!proc_lease_ || !store_.keepalive(proc_lease_)) {
-        proc_lease_ = store_.grant(proc_ttl_);
-        for (const auto& [k, v] : procs_) store_.put(k, v, proc_lease_);
+      {
+        std::lock_guard<std::mutex> g(procs_mu_);
+        if (!proc_lease_ || !store_.keepalive(proc_lease_)) {
+          proc_lease_ = store_.grant(proc_ttl_);
+          for (const auto& [k, v] : procs_) store_.put(k, v, proc_lease_);
+        }
       }
+      publish_metrics();
     }
+  }
+
+  // leased snapshot the web renders fleet-wide at /v1/metrics (the same
+  // contract as the Python MetricsPublisher — dead agents expire)
+  void publish_metrics() {
+    std::lock_guard<std::mutex> mg(metrics_mu_);
+    if (stop_ || metrics_lease_ < 0) return;  // withdrawn at shutdown
+    double nw = now_s();
+    if (nw < metrics_at_) return;
+    metrics_at_ = nw + 10.0;
+    if (!metrics_lease_ || !store_.keepalive(metrics_lease_))
+      metrics_lease_ = store_.grant(35.0);
+    if (!metrics_lease_) return;
+    size_t nprocs;
+    {
+      std::lock_guard<std::mutex> g(procs_mu_);
+      nprocs = procs_.size();
+    }
+    std::string snap = "{\"orders_consumed_total\":";
+    jint(snap, orders_consumed_.load());
+    snap += ",\"execs_total\":";
+    jint(snap, execs_.load());
+    snap += ",\"execs_failed_total\":";
+    jint(snap, execs_failed_.load());
+    snap += ",\"watch_losses_total\":";
+    jint(snap, watch_losses_.load());
+    snap += ",\"running\":";
+    jint(snap, running_.load());
+    snap += ",\"procs_registered\":";
+    jint(snap, (long long)nprocs);
+    snap += "}";
+    store_.put(pfx_ + "/metrics/node/" + id_, snap, metrics_lease_);
   }
 
   // -- groups / IsRunOn --------------------------------------------------
@@ -1103,6 +1145,7 @@ class Agent {
       WatchEvent ev;
       if (!store_.next_event(ev, 0.5)) continue;
       if (ev.lost) {
+        watch_losses_++;
         // stream loss (one cancelled watcher or a whole-connection
         // drop): wait for heal, close surviving server-side watchers
         // (a reopened set must not leave the old ones pumping), then
@@ -1272,11 +1315,17 @@ class Agent {
 
   void execute(const JobSpec& j, long long epoch, bool fenced, bool gate,
                const std::string& order_key) {
+    running_++;
+    struct Dec {
+      std::atomic<long long>& c;
+      ~Dec() { c--; }
+    } dec{running_};
     bool order_done = false;
     auto consume = [&] {
       if (!order_key.empty() && !order_done) {
         order_done = true;
         store_.del(order_key);
+        orders_consumed_++;
       }
     };
     long long alone_lease = 0;
@@ -1333,6 +1382,7 @@ class Agent {
       if (!order_key.empty() && !order_done) {
         order_done = true;
         store_.del(order_key);
+        orders_consumed_++;
       }
     };
     // proc_req <= 0 means register EVERY run immediately (agent.py puts
@@ -1383,6 +1433,8 @@ class Agent {
   }
 
   void record(const JobSpec& j, const ExecResult& res) {
+    execs_++;
+    if (!res.success) execs_failed_++;
     std::string out = res.output;
     if (!res.success && !res.error.empty()) {
       if (!out.empty()) out += "\n";
@@ -1505,6 +1557,11 @@ class Agent {
   std::atomic<bool> stop_{false};
   std::mt19937 rng_;
   std::mutex rng_mu_;
+  std::atomic<long long> orders_consumed_{0}, execs_{0}, execs_failed_{0},
+      watch_losses_{0}, running_{0};
+  std::mutex metrics_mu_;       // lease lifecycle vs shutdown revoke
+  long long metrics_lease_ = 0; // -1 = revoked at stop, never re-grant
+  double metrics_at_ = 0;
 };
 
 // ---------------------------------------------------------------------------
