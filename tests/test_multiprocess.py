@@ -603,14 +603,40 @@ def test_store_crash_restart_fleet_heals(tmp_path):
                        f"127.0.0.1:{port}", "--conf", str(conf),
                        "--port", "0")
         procs = [sched_p, node_p, web_p]
+        # a native agent heals the same crash (its own reconnect+resync
+        # path); it records via a logd since it has no local sqlite
+        import pathlib
+        agentd = pathlib.Path(REPO) / "native" / "cronsun-agentd"
+        nagent_p = logd_p = None
+        nsink = None
+        if agentd.exists():
+            logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
+                            "--db", str(tmp_path / "hz-logd.db"))
+            procs.append(logd_p)
+            logd_addr = _await_ready(logd_p)
+            nagent_p = subprocess.Popen(
+                [str(agentd), "--store", f"127.0.0.1:{port}",
+                 "--logsink", logd_addr, "--node-id", "hz-cxx",
+                 "--ttl", "5"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            procs.append(nagent_p)
         _await_ready(sched_p)
         _await_ready(node_p)
+        if nagent_p is not None:
+            _await_ready(nagent_p)
         web_addr = _await_ready(web_p)
 
         op, base = _login(web_addr)
         job = {"name": "hz", "command": "echo heal", "kind": 0,
                "rules": [{"timer": "* * * * * *", "nids": ["hz-node"]}]}
         _put_job(op, base, job)
+        if nagent_p is not None:
+            _put_job(op, base, {
+                "name": "hz-cxx", "command": "echo heal-cxx", "kind": 0,
+                "rules": [{"timer": "* * * * * *", "nids": ["hz-cxx"]}]})
+            from cronsun_tpu.logsink import RemoteJobLogStore
+            lh, _, lp = logd_addr.rpartition(":")
+            nsink = RemoteJobLogStore(lh, int(lp))
 
         sink = JobLogStore(logdb)
 
@@ -623,6 +649,20 @@ def test_store_crash_restart_fleet_heals(tmp_path):
             time.sleep(0.5)
         before = count()
         assert before >= 3, f"no executions before crash ({before})"
+
+        def ncount():
+            if nsink is None:
+                return 0
+            _, n = nsink.query_logs()
+            return n
+
+        nbefore = ncount()
+        if nsink is not None:
+            deadline = time.time() + 30
+            while time.time() < deadline and ncount() < 2:
+                time.sleep(0.5)
+            nbefore = ncount()
+            assert nbefore >= 2, "native agent executed nothing pre-crash"
 
         # kill -9: wrapper exits via its child monitor
         store_p.send_signal(signal.SIGKILL)
@@ -638,6 +678,14 @@ def test_store_crash_restart_fleet_heals(tmp_path):
         assert after >= before + 3, \
             f"executions did not resume after store restart " \
             f"({before} -> {after})"
+        # the native agent healed too: its executions resume
+        if nsink is not None:
+            deadline = time.time() + 60
+            while time.time() < deadline and ncount() < nbefore + 3:
+                time.sleep(0.5)
+            assert ncount() >= nbefore + 3, \
+                "native agent did not resume after store restart"
+            nsink.close()
         # the job survived in the restarted store
         with op.open(f"{base}/v1/jobs", timeout=10) as r:
             jobs = json.loads(r.read())
