@@ -625,7 +625,7 @@ static bool shlex_split(const std::string& s, std::vector<std::string>& out) {
   size_t i = 0;
   while (i < s.size()) {
     char c = s[i];
-    if (c == ' ' || c == '\t' || c == '\n') {
+    if (c == ' ' || c == '\t' || c == '\n' || c == '\r') {
       if (has) out.push_back(cur);
       cur.clear();
       has = false;
@@ -650,7 +650,8 @@ static bool shlex_split(const std::string& s, std::vector<std::string>& out) {
       }
       if (i >= s.size()) return false;
       i++;
-    } else if (c == '\\' && i + 1 < s.size()) {
+    } else if (c == '\\') {
+      if (i + 1 >= s.size()) return false;  // trailing escape: shlex errors
       cur += s[i + 1];
       has = true;
       i += 2;
@@ -687,7 +688,7 @@ class Executor {
     std::vector<std::string> argv;
     if (!shlex_split(command, argv)) {
       r.end = now_s();
-      r.error = "bad command: unbalanced quote";
+      r.error = "bad command: unbalanced quote or trailing escape";
       return r;
     }
     if (argv.empty()) {
@@ -1603,6 +1604,35 @@ int main(int argc, char** argv) {
              "[--log-token T] [--die-with-parent]\n");
       return 0;
     }
+  }
+  if (argc > 1 && std::string(argv[1]) == "--tokenize") {
+    // conformance hook: read command lines on stdin, print each token
+    // list as a JSON array (one per line) — the differential fuzz in
+    // tests/test_agent.py pins this tokenizer to Python's shlex.split
+    char* lineptr = nullptr;
+    size_t cap = 0;
+    ssize_t n;
+    while ((n = getline(&lineptr, &cap, stdin)) != -1) {
+      std::string s(lineptr, (size_t)n);
+      while (!s.empty() && (s.back() == '\n' || s.back() == '\r'))
+        s.pop_back();
+      std::vector<std::string> toks;
+      std::string out;
+      if (!shlex_split(s, toks)) {
+        out = "null";
+      } else {
+        out = "[";
+        for (size_t i = 0; i < toks.size(); i++) {
+          if (i) out += ',';
+          jesc(out, toks[i]);
+        }
+        out += ']';
+      }
+      printf("%s\n", out.c_str());
+      fflush(stdout);
+    }
+    free(lineptr);
+    return 0;
   }
   if (node_id.empty()) {
     char hn[256] = "node";
