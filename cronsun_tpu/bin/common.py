@@ -40,9 +40,29 @@ def setup_common(args) -> Tuple[Config, Keyspace, Optional[ConfigWatcher]]:
     return cfg, Keyspace(cfg.prefix), watcher
 
 
-def connect_store(addr: str, token: str = "") -> RemoteStore:
+def server_tls(tls, native: bool, daemon: str):
+    """Server-side TLS context from a conf section, or None (plaintext).
+    The native servers cannot terminate TLS — exits 2 with the
+    terminator hint rather than silently serving plaintext."""
+    import sys
+    from ..tlsutil import server_context
+    ctx = server_context(tls)
+    if ctx is not None and native:
+        print(f"error: {daemon} TLS requires the Python server (drop "
+              "--native or terminate TLS in front of the native daemon "
+              "-- native/README.md)", file=sys.stderr)
+        raise SystemExit(2)
+    return ctx
+
+
+def connect_store(addr: str, token: str = "", tls=None) -> RemoteStore:
+    """``tls`` is the conf ``store_tls`` section (tlsutil.Tls) or None."""
+    from ..tlsutil import client_context
     host, _, port = addr.rpartition(":")
-    return RemoteStore(host or "127.0.0.1", int(port), token=token)
+    sslctx = client_context(tls) if tls is not None else None
+    return RemoteStore(host or "127.0.0.1", int(port), token=token,
+                       sslctx=sslctx,
+                       tls_hostname=tls.hostname if tls else "")
 
 
 def make_sink(cfg: Config, log_addr: Optional[str] = None):
@@ -52,8 +72,11 @@ def make_sink(cfg: Config, log_addr: Optional[str] = None):
     addr = log_addr if log_addr is not None else cfg.log_addr
     if addr:
         from ..logsink import RemoteJobLogStore
+        from ..tlsutil import client_context
         host, _, port = addr.rpartition(":")
         return RemoteJobLogStore(host or "127.0.0.1", int(port),
-                                 token=cfg.log_token)
+                                 token=cfg.log_token,
+                                 sslctx=client_context(cfg.log_tls),
+                                 tls_hostname=cfg.log_tls.hostname)
     from ..logsink import JobLogStore
     return JobLogStore(cfg.log_db)

@@ -44,6 +44,8 @@ def main(argv=None) -> int:
     cfg, ks, watcher = setup_common(args)
     token = cfg.log_token if args.token is None else args.token
 
+    from .common import server_tls
+    sslctx = server_tls(cfg.log_tls, args.native, "cronsun-logd")
     rc = [0]
     if args.native:
         from ..logsink.native import NativeLogSinkServer
@@ -60,9 +62,10 @@ def main(argv=None) -> int:
     else:
         srv = LogSinkServer(db_path=args.db or cfg.log_db,
                             host=args.host, port=args.port,
-                            token=token).start()
-    log.infof("cronsun-logd serving on %s:%d (db %s)", srv.host, srv.port,
-              args.db or cfg.log_db)
+                            token=token, sslctx=sslctx).start()
+    log.infof("cronsun-logd serving on %s:%d (db %s)%s", srv.host, srv.port,
+              args.db or cfg.log_db,
+              " (tls)" if sslctx is not None else "")
     print(f"READY {srv.host}:{srv.port}", flush=True)
     events.on(events.EXIT, srv.stop)
     if watcher:

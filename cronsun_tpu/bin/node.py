@@ -20,7 +20,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
 
-    store = connect_store(args.store, token=cfg.store_token)
+    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
     sink = make_sink(cfg, args.logsink)
     fatal: list = []
 

@@ -33,7 +33,7 @@ def main(argv=None) -> int:
     if cfg.timezone and cfg.timezone.upper() != "UTC":
         from zoneinfo import ZoneInfo
         tz = ZoneInfo(cfg.timezone)
-    store = connect_store(args.store, token=cfg.store_token)
+    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
     planner = None
     if args.mesh > 1:
         from ..parallel.mesh import ShardedTickPlanner, make_mesh

@@ -38,6 +38,8 @@ def main(argv=None) -> int:
     cfg, ks, watcher = setup_common(args)
 
     token = cfg.store_token if args.token is None else args.token
+    from .common import server_tls
+    sslctx = server_tls(cfg.store_tls, args.native, "cronsun-store")
     rc = [0]
     if args.native:
         from ..store.native import NativeStoreServer
@@ -53,8 +55,9 @@ def main(argv=None) -> int:
         srv.monitor(child_died)
     else:
         srv = StoreServer(host=args.host, port=args.port,
-                          token=token).start()
-    log.infof("cronsun-store serving on %s:%d", srv.host, srv.port)
+                          token=token, sslctx=sslctx).start()
+    log.infof("cronsun-store serving on %s:%d%s", srv.host, srv.port,
+              " (tls)" if sslctx is not None else "")
     print(f"READY {srv.host}:{srv.port}", flush=True)
     events.on(events.EXIT, srv.stop)
     if watcher:

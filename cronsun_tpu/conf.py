@@ -19,6 +19,8 @@ import os
 import threading
 from typing import Callable, List, Optional
 
+from .tlsutil import Tls
+
 EXTEND_KEY = "@extend:"
 
 
@@ -70,13 +72,21 @@ class Config:
     store_token: str = ""       # shared secret for the coordination store
                                 # (the reference's etcd username/password,
                                 # conf/conf.go:66-67)
+    store_tls: Tls = dataclasses.field(default_factory=Tls)
+    log_tls: Tls = dataclasses.field(default_factory=Tls)
+                                # per-channel TLS material (the reference
+                                # threads etcd TLS through clientv3.Config,
+                                # conf/conf.go:66-67); empty = plaintext.
+                                # Clients use ca(+cert/key for mutual TLS);
+                                # servers use cert/key(+ca to demand client
+                                # certs).  See cronsun_tpu/tlsutil.py.
     security: Security = dataclasses.field(default_factory=Security)
     mail: Mail = dataclasses.field(default_factory=Mail)
     web: Web = dataclasses.field(default_factory=Web)
 
     # dynamic-reload exclusions, like the reference
     _RELOAD_EXCLUDE = ("prefix", "web", "log_db", "log_addr", "log_token",
-                       "store_token")
+                       "store_token", "store_tls", "log_tls")
 
 
 def _substitute(text: str, path: str) -> str:
@@ -112,6 +122,8 @@ def _merge(cfg: Config, data: dict, reload_only: bool = False) -> Config:
             v = Mail(**v)
         elif name == "web":
             v = Web(**v)
+        elif name in ("store_tls", "log_tls"):
+            v = Tls(**v)
         setattr(cfg, name, v)
     return cfg
 

@@ -136,7 +136,7 @@ class LogSinkServer:
 
     def __init__(self, sink: Optional[JobLogStore] = None,
                  db_path: str = ":memory:", host: str = "127.0.0.1",
-                 port: int = 0, token: str = ""):
+                 port: int = 0, token: str = "", sslctx=None):
         self.sink = sink or JobLogStore(db_path)
 
         class _Server(socketserver.ThreadingTCPServer):
@@ -145,6 +145,7 @@ class LogSinkServer:
         self._srv = _Server((host, port), _Conn)
         self._srv.sink = self.sink                # type: ignore[attr-defined]
         self._srv.token = token                   # type: ignore[attr-defined]
+        self._srv.sslctx = sslctx                 # type: ignore[attr-defined]
         self._srv.idem = {}                       # type: ignore[attr-defined]
         self._srv.idem_lock = threading.Lock()    # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
@@ -179,10 +180,12 @@ class RemoteJobLogStore:
     a Mongo hiccup (job_log.go:84 logs and moves on)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 token: str = ""):
+                 token: str = "", sslctx=None, tls_hostname: str = ""):
         self.host, self.port = host, port
         self._timeout = timeout
         self._token = token
+        self._sslctx = sslctx
+        self._tls_hostname = tls_hostname
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._rfile = None
@@ -194,8 +197,12 @@ class RemoteJobLogStore:
     # -- plumbing ----------------------------------------------------------
 
     def _connect(self):
-        self._sock = socket.create_connection((self.host, self.port),
-                                              timeout=self._timeout)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self._timeout)
+        if self._sslctx is not None:
+            from ..tlsutil import wrap_client
+            sock = wrap_client(sock, self._sslctx, self._tls_hostname)
+        self._sock = sock
         self._sock.settimeout(self._timeout)
         self._rfile = self._sock.makefile("rb")
         if self._token:

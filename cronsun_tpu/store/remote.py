@@ -146,7 +146,8 @@ class StoreServer:
     port 0 picks a free port (see :attr:`port`)."""
 
     def __init__(self, store: Optional[MemStore] = None,
-                 host: str = "127.0.0.1", port: int = 0, token: str = ""):
+                 host: str = "127.0.0.1", port: int = 0, token: str = "",
+                 sslctx=None):
         self.store = store or MemStore()
         self.store.start_sweeper()
 
@@ -156,6 +157,7 @@ class StoreServer:
         self._srv = _Server((host, port), _Conn)
         self._srv.store = self.store                 # type: ignore[attr-defined]
         self._srv.token = token                      # type: ignore[attr-defined]
+        self._srv.sslctx = sslctx                    # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
 
@@ -229,11 +231,14 @@ class RemoteStore:
     completeness re-list, exactly like an etcd client)."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 reconnect: bool = True, token: str = ""):
+                 reconnect: bool = True, token: str = "", sslctx=None,
+                 tls_hostname: str = ""):
         self.host, self.port = host, port
         self._timeout = timeout
         self._reconnect = reconnect
         self._token = token
+        self._sslctx = sslctx
+        self._tls_hostname = tls_hostname
         self._wlock = threading.Lock()
         self._next_id = 1
         self._id_lock = threading.Lock()
@@ -249,6 +254,9 @@ class RemoteStore:
 
     def _connect(self):
         sock = socket.create_connection((self.host, self.port), timeout=30)
+        if self._sslctx is not None:
+            from ..tlsutil import wrap_client
+            sock = wrap_client(sock, self._sslctx, self._tls_hostname)
         sock.settimeout(None)
         rfile = sock.makefile("rb")
         threading.Thread(target=self._read_loop, args=(sock, rfile),

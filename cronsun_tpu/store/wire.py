@@ -13,6 +13,7 @@ from __future__ import annotations
 import hmac
 import json
 import socketserver
+import ssl
 import threading
 
 
@@ -35,6 +36,19 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
     def setup(self):
         self.wlock = threading.Lock()
         self.alive = True
+        sslctx = getattr(self.server, "sslctx", None)
+        if sslctx is not None:
+            # handshake runs here, in the per-connection thread (never in
+            # the accept loop); a failed handshake — plaintext client,
+            # wrong CA, missing client cert under mutual TLS — drops the
+            # connection without killing the server
+            try:
+                self.request = sslctx.wrap_socket(self.request,
+                                                  server_side=True)
+            except (OSError, ssl.SSLError):
+                self.alive = False
+                self.rfile = None
+                return
         self.rfile = self.request.makefile("rb")
         self.authed = not getattr(self.server, "token", "")
 
@@ -47,8 +61,13 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
                 self.alive = False
 
     def handle(self):
+        if self.rfile is None:       # TLS handshake failed in setup
+            return
         while self.alive:
-            line = self.rfile.readline()
+            try:
+                line = self.rfile.readline()
+            except OSError:          # reset / TLS abort mid-read
+                return
             if not line:
                 return
             try:

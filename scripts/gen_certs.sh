@@ -1,0 +1,64 @@
+#!/bin/sh
+# Generate a fleet CA + server/client certs for the wire TLS
+# (cronsun_tpu/tlsutil.py).  One private CA per fleet; the server cert
+# carries SAN entries for every address agents dial, and certs carry
+# extendedKeyUsage so a client cert can never pose as the server (even
+# in hostname-unpinned IP fleets).
+#
+#   scripts/gen_certs.sh OUTDIR [EXTRA_SAN ...]
+#
+# EXTRA_SAN entries are hostnames, IPv4 or IPv6 addresses; localhost
+# and 127.0.0.1 are always included.  Produces in OUTDIR:
+#   ca.pem ca.key          fleet CA (conf: store_tls.ca / log_tls.ca)
+#   server.pem server.key  server cert (conf on the server side)
+#   client.pem client.key  client cert, only needed for mutual TLS
+set -e
+
+out=${1:?usage: gen_certs.sh OUTDIR [EXTRA_SAN ...]}
+shift
+mkdir -p "$out"
+
+run() { # run openssl, surfacing its stderr only on failure
+    if ! _out=$(openssl "$@" 2>&1); then
+        echo "gen_certs.sh: openssl $1 failed:" >&2
+        echo "$_out" >&2
+        exit 1
+    fi
+}
+
+is_ip4() {
+    echo "$1" | awk -F. 'NF==4 { for (i=1; i<=4; i++)
+        if ($i !~ /^[0-9]+$/ || $i+0 > 255) exit 1; exit 0 } { exit 1 }'
+}
+
+san="DNS:localhost,IP:127.0.0.1"
+for h in "$@"; do
+    if is_ip4 "$h"; then san="$san,IP:$h"
+    elif [ "${h#*:}" != "$h" ]; then san="$san,IP:$h"   # IPv6 (has ':')
+    else san="$san,DNS:$h"
+    fi
+done
+
+run req -x509 -newkey rsa:2048 -nodes -days 3650 \
+    -keyout "$out/ca.key" -out "$out/ca.pem" \
+    -subj "/CN=cronsun-fleet-ca"
+
+issue() { # issue NAME SUBJ EKU [SAN]
+    run req -newkey rsa:2048 -nodes \
+        -keyout "$out/$1.key" -out "$out/$1.csr" -subj "$2"
+    ext="$out/$1.ext"
+    {
+        printf 'keyUsage=digitalSignature,keyEncipherment\n'
+        printf 'extendedKeyUsage=%s\n' "$3"
+        if [ -n "$4" ]; then printf 'subjectAltName=%s\n' "$4"; fi
+    } > "$ext"
+    run x509 -req -days 825 -in "$out/$1.csr" \
+        -CA "$out/ca.pem" -CAkey "$out/ca.key" -CAcreateserial \
+        -extfile "$ext" -out "$out/$1.pem"
+    rm -f "$out/$1.csr" "$ext"
+}
+
+issue server "/CN=cronsun-store" serverAuth "$san"
+issue client "/CN=cronsun-client" clientAuth
+chmod 600 "$out"/*.key
+echo "wrote CA + server + client certs to $out (SAN: $san)"

@@ -1,0 +1,345 @@
+"""Wire TLS (tlsutil.py): both Python servers and clients, plain and
+mutual, plus the refusal paths.  Certs are generated once per module by
+scripts/gen_certs.sh — the same tool operators use — so the script is
+exercised too.
+
+The reference threads transport security through config (etcd
+clientv3.Config TLS + credentials, conf/conf.go:66-67; Mongo credentials,
+db/mgo.go:33-36); these tests pin the rebuild's equivalent."""
+
+import json
+import socket
+import ssl
+import subprocess
+import time
+
+import pytest
+
+from cronsun_tpu.conf import parse as parse_conf
+from cronsun_tpu.logsink import LogRecord
+from cronsun_tpu.logsink.serve import LogSinkServer, RemoteJobLogStore
+from cronsun_tpu.store.memstore import MemStore
+from cronsun_tpu.store.remote import RemoteStore, RemoteStoreError, \
+    StoreServer
+from cronsun_tpu.tlsutil import Tls, client_context, server_context
+
+pytestmark = pytest.mark.filterwarnings("ignore::pytest.PytestUnhandledThreadExceptionWarning")
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    subprocess.run(["sh", "scripts/gen_certs.sh", str(d)], check=True,
+                   capture_output=True)
+    # a SECOND, unrelated CA + server cert for the wrong-CA refusals
+    d2 = tmp_path_factory.mktemp("rogue")
+    subprocess.run(["sh", "scripts/gen_certs.sh", str(d2)], check=True,
+                   capture_output=True)
+    return d, d2
+
+
+def _server_tls(d, mutual=False):
+    return Tls(cert=str(d / "server.pem"), key=str(d / "server.key"),
+               ca=str(d / "ca.pem") if mutual else "")
+
+
+def _client_tls(d, cert=False, hostname=""):
+    t = Tls(ca=str(d / "ca.pem"), hostname=hostname)
+    if cert:
+        t.cert, t.key = str(d / "client.pem"), str(d / "client.key")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# coordination store
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip_over_tls(certs):
+    d, _ = certs
+    srv = StoreServer(MemStore(), sslctx=server_context(_server_tls(d)),
+                      token="s3cret").start()
+    try:
+        c = RemoteStore(srv.host, srv.port, token="s3cret",
+                        sslctx=client_context(_client_tls(d)))
+        try:
+            c.put("/a", "1")
+            assert c.get("/a").value == "1"
+            w = c.watch("/a")
+            c.put("/a", "2")
+            ev = w.get(timeout=5)
+            assert ev is not None and ev.kv.value == "2"
+            w.close()
+        finally:
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_store_tls_hostname_binding(certs):
+    d, _ = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d))).start()
+    try:
+        # matching SAN (the cert carries DNS:localhost)
+        c = RemoteStore(srv.host, srv.port,
+                        sslctx=client_context(_client_tls(
+                            d, hostname="localhost")),
+                        tls_hostname="localhost")
+        c.put("/h", "ok")
+        c.close()
+        # non-matching SAN must refuse
+        with pytest.raises((ssl.SSLCertVerificationError, OSError)):
+            RemoteStore(srv.host, srv.port, reconnect=False,
+                        sslctx=client_context(_client_tls(
+                            d, hostname="evil.example")),
+                        tls_hostname="evil.example")
+    finally:
+        srv.stop()
+
+
+def test_store_plaintext_client_refused_and_server_survives(certs):
+    d, _ = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d))).start()
+    try:
+        # a plaintext client's line-JSON is garbage to the TLS record
+        # layer: its connection dies, the server keeps serving
+        with pytest.raises((RemoteStoreError, OSError)):
+            c0 = RemoteStore(srv.host, srv.port, reconnect=False, timeout=3)
+            c0.put("/x", "1")     # TCP connect alone succeeds; the first
+            c0.close()            # RPC hits the failed handshake
+
+        c = RemoteStore(srv.host, srv.port,
+                        sslctx=client_context(_client_tls(d)))
+        c.put("/alive", "yes")
+        assert c.get("/alive").value == "yes"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_store_wrong_ca_refused(certs):
+    d, rogue = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d))).start()
+    try:
+        with pytest.raises((ssl.SSLError, OSError)):
+            RemoteStore(srv.host, srv.port, reconnect=False,
+                        sslctx=client_context(_client_tls(rogue)))
+    finally:
+        srv.stop()
+
+
+def test_store_mutual_tls(certs):
+    d, rogue = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d, mutual=True))
+                      ).start()
+    try:
+        # no client cert -> handshake refused
+        with pytest.raises((ssl.SSLError, RemoteStoreError, OSError)):
+            c = RemoteStore(srv.host, srv.port, reconnect=False, timeout=3,
+                            sslctx=client_context(_client_tls(d)))
+            # some TLS stacks surface the rejection on first use, not
+            # during connect — force a round trip
+            c.put("/x", "1")
+        # rogue-CA client cert -> refused
+        with pytest.raises((ssl.SSLError, RemoteStoreError, OSError)):
+            c = RemoteStore(srv.host, srv.port, reconnect=False, timeout=3,
+                            sslctx=client_context(_client_tls(rogue,
+                                                              cert=True)))
+            c.put("/x", "1")
+        # fleet client cert -> accepted
+        c = RemoteStore(srv.host, srv.port,
+                        sslctx=client_context(_client_tls(d, cert=True)))
+        c.put("/m", "tls")
+        assert c.get("/m").value == "tls"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_store_reconnect_heals_over_tls(certs):
+    """A severed connection heals with a fresh TLS handshake and the
+    watch replays the deltas written while the client was down (same
+    contract as the plaintext heal test in test_remote_store.py)."""
+    d, _ = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d))).start()
+    c = RemoteStore(srv.host, srv.port,
+                    sslctx=client_context(_client_tls(d)))
+    aux = RemoteStore(srv.host, srv.port,
+                      sslctx=client_context(_client_tls(d)))
+    try:
+        w = c.watch("/k/")
+        c.put("/k/a", "1")
+        ev = w.get(timeout=5)
+        assert ev is not None and ev.kv.value == "1"
+        # sever the TLS connection out from under the client
+        c._sock.close()
+        aux.put("/k/b", "2")          # written while the client is down
+        deadline = time.time() + 10
+        ev = None
+        while time.time() < deadline and ev is None:
+            ev = w.get(timeout=0.3)
+        assert ev is not None and ev.kv.key == "/k/b", \
+            "watch never resumed after the TLS re-handshake"
+        w.close()
+    finally:
+        c.close()
+        aux.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# result store
+# ---------------------------------------------------------------------------
+
+def test_logsink_roundtrip_over_tls(certs):
+    d, rogue = certs
+    srv = LogSinkServer(db_path=":memory:", token="t0k",
+                        sslctx=server_context(_server_tls(d))).start()
+    try:
+        c = RemoteJobLogStore(srv.host, srv.port, token="t0k",
+                              sslctx=client_context(_client_tls(d)))
+        rec = LogRecord(job_id="j1", job_group="g", name="n", node="nd",
+                        user="u", command="true", output="", success=True,
+                        begin_ts=1.0, end_ts=2.0)
+        c.create_job_log(rec)
+        recs, total = c.query_logs()
+        assert total == 1 and recs[0].job_id == "j1"
+        c.close()
+        with pytest.raises((ssl.SSLError, OSError)):
+            RemoteJobLogStore(srv.host, srv.port, timeout=3,
+                              sslctx=client_context(_client_tls(rogue)))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# conf plumbing
+# ---------------------------------------------------------------------------
+
+def test_conf_parses_tls_sections(tmp_path, certs):
+    d, _ = certs
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({
+        "store_token": "st",
+        "store_tls": {"ca": str(d / "ca.pem"),
+                      "hostname": "localhost"},
+        "log_tls": {"cert": str(d / "server.pem"),
+                    "key": str(d / "server.key")},
+    }))
+    cfg = parse_conf(str(p))
+    assert cfg.store_tls.client_enabled
+    assert not cfg.store_tls.server_enabled
+    assert cfg.store_tls.hostname == "localhost"
+    assert cfg.log_tls.server_enabled
+    assert client_context(cfg.store_tls) is not None
+    assert server_context(cfg.log_tls) is not None
+    # empty sections stay plaintext
+    cfg2 = parse_conf(None)
+    assert client_context(cfg2.store_tls) is None
+    assert server_context(cfg2.log_tls) is None
+
+
+def test_partial_tls_section_raises_instead_of_downgrading():
+    """cert-without-ca on a client (or key-without-cert on a server)
+    must fail fast, never silently fall back to plaintext — the
+    downgrade would put the shared token on the wire in clear."""
+    with pytest.raises(ValueError):
+        client_context(Tls(cert="/x/client.pem", key="/x/client.key"))
+    with pytest.raises(ValueError):
+        client_context(Tls(hostname="store.internal"))
+    with pytest.raises(ValueError):
+        server_context(Tls(key="/x/server.key"))
+    with pytest.raises(ValueError):
+        server_context(Tls(ca="/x/ca.pem"))
+
+
+def test_client_cert_cannot_pose_as_server(certs):
+    """gen_certs.sh issues EKU=clientAuth client certs: a compromised
+    client key must not be able to impersonate the store server, even
+    with hostname pinning off (IP fleets)."""
+    d, _ = certs
+    rogue_srv = StoreServer(MemStore(), sslctx=server_context(
+        Tls(cert=str(d / "client.pem"), key=str(d / "client.key")))).start()
+    try:
+        with pytest.raises((ssl.SSLError, RemoteStoreError, OSError)):
+            c = RemoteStore(rogue_srv.host, rogue_srv.port, reconnect=False,
+                            timeout=3,
+                            sslctx=client_context(_client_tls(d)))
+            c.put("/x", "1")
+    finally:
+        rogue_srv.stop()
+
+
+def test_gen_certs_ipv6_and_hostname_sans(certs, tmp_path):
+    out = subprocess.run(
+        ["sh", "scripts/gen_certs.sh", str(tmp_path / "c6"), "::1",
+         "fleet.internal", "10.1.2.3"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    san = subprocess.run(
+        ["openssl", "x509", "-in", str(tmp_path / "c6" / "server.pem"),
+         "-noout", "-ext", "subjectAltName"],
+        capture_output=True, text=True).stdout
+    assert "0:0:0:0:0:0:0:1" in san          # ::1 classified as IP
+    assert "DNS:fleet.internal" in san
+    assert "IP Address:10.1.2.3" in san
+
+
+def test_full_duplex_tls_under_load(certs):
+    """Single-reader + mutex-serialized writers is the concurrency
+    contract that makes full-duplex TLS sound (tlsutil docstring).
+    Hammer one TLS connection with concurrent writers while the server
+    pushes watch events back through the same socket."""
+    import threading
+    d, _ = certs
+    srv = StoreServer(MemStore(), sslctx=server_context(_server_tls(d))).start()
+    c = RemoteStore(srv.host, srv.port,
+                    sslctx=client_context(_client_tls(d)))
+    try:
+        w = c.watch("/dup/")
+        errs = []
+
+        def hammer(tid):
+            try:
+                for i in range(100):
+                    c.put(f"/dup/{tid}", str(i))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        ts = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+        for t in ts:
+            t.start()
+        got = 0
+        deadline = time.time() + 30
+        while got < 400 and time.time() < deadline:
+            if w.get(timeout=0.5) is not None:
+                got += 1
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        assert got == 400, f"only {got}/400 watch events over TLS"
+        w.close()
+    finally:
+        c.close()
+        srv.stop()
+
+
+def test_tls_server_refuses_probe_then_serves(certs):
+    """A bare TCP probe that connects and disconnects (port scanner,
+    health check) must not wedge the accept loop."""
+    d, _ = certs
+    srv = StoreServer(MemStore(),
+                      sslctx=server_context(_server_tls(d))).start()
+    try:
+        for _ in range(3):
+            s = socket.create_connection((srv.host, srv.port))
+            s.close()
+        c = RemoteStore(srv.host, srv.port,
+                        sslctx=client_context(_client_tls(d)))
+        c.put("/probe", "ok")
+        c.close()
+    finally:
+        srv.stop()
