@@ -1,0 +1,483 @@
+"""cronsun-ctl — operator command line over the REST API.
+
+The reference manages the fleet only through the Vue UI; day-2
+operations (cron edits from a terminal, scripting a job rollout,
+tailing failures) all need a browser.  This CLI drives the same
+``/v1/*`` surface (web/server.py, mirroring reference
+web/routers.go:17-114) with a persisted session, so everything the UI
+can do is scriptable:
+
+    cronsun-ctl --url http://web:7079 login admin@admin.com
+    cronsun-ctl jobs
+    cronsun-ctl job get default-8a81f3d2
+    cronsun-ctl job save job.json
+    cronsun-ctl job pause default-8a81f3d2
+    cronsun-ctl run default-8a81f3d2 --node worker-3
+    cronsun-ctl logs --failed --node worker-3
+    cronsun-ctl nodes
+    cronsun-ctl metrics
+
+Sessions persist as a cookie jar in ``~/.config/cronsun/session``
+(override with --session or CRONSUN_SESSION).  ``--json`` prints raw
+API responses for scripting; default output is aligned tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import http.cookiejar
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+DEFAULT_URL = os.environ.get("CRONSUN_URL", "http://127.0.0.1:7079")
+DEFAULT_SESSION = os.environ.get(
+    "CRONSUN_SESSION",
+    os.path.join(os.path.expanduser("~"), ".config", "cronsun", "session"))
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, msg: str):
+        super().__init__(f"HTTP {status}: {msg}")
+        self.status = status
+
+
+class Api:
+    """Thin urllib client with a persisted cookie jar."""
+
+    def __init__(self, url: str, session_file: str):
+        self.url = url.rstrip("/")
+        self.session_file = session_file
+        self.jar = http.cookiejar.LWPCookieJar(session_file)
+        if os.path.exists(session_file):
+            try:
+                self.jar.load(ignore_discard=True)
+            except (OSError, http.cookiejar.LoadError):
+                pass
+        self.opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(self.jar))
+
+    def save(self):
+        d = os.path.dirname(self.session_file)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # pre-create 0600 so the session secret is never world-readable,
+        # even for the instant between jar.save() and a chmod
+        fd = os.open(self.session_file,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.close(fd)
+        os.chmod(self.session_file, 0o600)   # pre-existing looser file
+        self.jar.save(ignore_discard=True)
+
+    def call(self, method: str, path: str, params: dict = None,
+             body=None):
+        url = self.url + path
+        if params:
+            qs = urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v not in (None, "")})
+            if qs:
+                url += "?" + qs
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
+        try:
+            with self.opener.open(req, timeout=30) as resp:
+                raw = resp.read().decode()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace").strip()
+            try:
+                detail = json.loads(detail).get("error", detail)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise ApiError(e.code, detail or e.reason)
+        except urllib.error.URLError as e:
+            raise ApiError(0, f"cannot reach {self.url}: {e.reason}")
+        if "json" in ctype:
+            return json.loads(raw) if raw else None
+        return raw
+
+
+# ---------------------------------------------------------------------------
+# output helpers
+# ---------------------------------------------------------------------------
+
+def table(rows, headers):
+    """Aligned plain-text table; rows of str-able cells."""
+    rows = [[("" if c is None else str(c)) for c in r] for r in rows]
+    widths = [len(h) for h in headers]
+    for r in rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    fmt = "  ".join("{:<%d}" % w for w in widths)
+    print(fmt.format(*headers))
+    for r in rows:
+        print(fmt.format(*r).rstrip())
+
+
+def ts(epoch) -> str:
+    if not epoch:
+        return ""
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(epoch))
+
+
+def parse_when(s: str) -> float:
+    """Epoch seconds, or local 'YYYY-MM-DD[ HH:MM[:SS]]'."""
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    for fmt in ("%Y-%m-%d %H:%M:%S", "%Y-%m-%d %H:%M", "%Y-%m-%d"):
+        try:
+            return time.mktime(time.strptime(s, fmt))
+        except ValueError:
+            continue
+    raise SystemExit(f"error: cannot parse time {s!r} "
+                     "(epoch or YYYY-MM-DD[ HH:MM[:SS]])")
+
+
+KINDS = {0: "Common", 1: "Alone", 2: "Interval"}
+
+
+def _gid(d) -> str:
+    return f"{d['group']}-{d['id']}"
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+def cmd_login(api, args):
+    pw = args.password if args.password is not None else \
+        getpass.getpass(f"password for {args.email}: ")
+    out = api.call("GET", "/v1/session",
+                   {"email": args.email, "password": pw})
+    api.save()
+    print(f"logged in as {out['email']} "
+          f"({'admin' if out.get('role') == 1 else 'developer'})")
+
+
+def cmd_logout(api, args):
+    api.call("DELETE", "/v1/session")
+    api.save()
+    print("logged out")
+
+
+def cmd_whoami(api, args):
+    out = api.call("GET", "/v1/session/me")
+    print(json.dumps(out) if args.json else
+          f"{out['email']} ({'admin' if out.get('role') == 1 else 'developer'})")
+
+
+def cmd_version(api, args):
+    print(api.call("GET", "/v1/version"))
+
+
+def cmd_overview(api, args):
+    out = api.call("GET", "/v1/info/overview")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    for k, v in out.items():
+        print(f"{k:>16}  {v}")
+
+
+def cmd_jobs(api, args):
+    jobs = api.call("GET", "/v1/jobs", {"group": args.group})
+    if args.json:
+        print(json.dumps(jobs, indent=2))
+        return
+    rows = []
+    for j in jobs:
+        st = j.get("latest_status") or {}
+        rows.append([_gid(j), j.get("name"), KINDS.get(j.get("kind"), "?"),
+                     "paused" if j.get("pause") else "",
+                     len(j.get("rules") or []),
+                     st.get("success", 0), st.get("failed", 0)])
+    table(rows, ["ID", "NAME", "KIND", "STATE", "RULES", "OK", "FAIL"])
+
+
+def cmd_job_get(api, args):
+    print(json.dumps(api.call("GET", f"/v1/job/{args.id}"), indent=2))
+
+
+def cmd_job_save(api, args):
+    if args.file == "-":
+        body = json.load(sys.stdin)
+    else:
+        with open(args.file) as f:
+            body = json.load(f)
+    out = api.call("PUT", "/v1/job", body=body)
+    print(f"saved {out['group']}-{out['id']}")
+
+
+def cmd_job_rm(api, args):
+    api.call("DELETE", f"/v1/job/{args.id}")
+    print(f"deleted {args.id}")
+
+
+def _pause(api, job_id: str, pause: bool):
+    api.call("POST", f"/v1/job/{job_id}", body={"pause": pause})
+    print(f"{'paused' if pause else 'resumed'} {job_id}")
+
+
+def cmd_job_pause(api, args):
+    _pause(api, args.id, True)
+
+
+def cmd_job_resume(api, args):
+    _pause(api, args.id, False)
+
+
+def cmd_job_nodes(api, args):
+    nodes = api.call("GET", f"/v1/job/{args.id}/nodes")
+    print(json.dumps(nodes) if args.json else "\n".join(nodes))
+
+
+def cmd_run(api, args):
+    api.call("PUT", f"/v1/job/{args.id}/execute",
+             {"node": args.node or ""})
+    print(f"run-now fired for {args.id}"
+          + (f" on {args.node}" if args.node else " on all eligible nodes"))
+
+
+def cmd_executing(api, args):
+    out = api.call("GET", "/v1/job/executing",
+                   {"node": args.node, "jobId": args.job})
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    table([[e["node"], f"{e['group']}-{e['jobId']}", e["pid"], e.get("time")]
+           for e in out], ["NODE", "JOB", "PID", "STARTED"])
+
+
+def cmd_logs(api, args):
+    params = {
+        "node": args.node,
+        "ids": args.job,
+        "names": args.names,
+        "failedOnly": "true" if args.failed else None,
+        "latest": "true" if args.latest else None,
+        "page": args.page,
+        "pageSize": args.size,
+    }
+    if args.begin:
+        params["begin"] = parse_when(args.begin)
+    if args.end:
+        params["end"] = parse_when(args.end)
+    out = api.call("GET", "/v1/logs", params)
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    rows = [[r["id"], r["name"], r["node"],
+             "ok" if r["success"] else "FAIL",
+             ts(r["beginTime"]),
+             f"{max(0.0, (r['endTime'] or 0) - (r['beginTime'] or 0)):.1f}s"]
+            for r in out["list"]]
+    table(rows, ["ID", "NAME", "NODE", "RESULT", "BEGIN", "TOOK"])
+    pages = max(1, -(-out["total"] // args.size))
+    print(f"({out['total']} records, page {args.page}/{pages})")
+
+
+def cmd_log(api, args):
+    r = api.call("GET", f"/v1/log/{args.id}")
+    if args.json:
+        print(json.dumps(r, indent=2))
+        return
+    for k in ("id", "name", "node", "user", "command", "success"):
+        print(f"{k:>8}  {r.get(k)}")
+    print(f"{'began':>8}  {ts(r['beginTime'])}")
+    print(f"{'ended':>8}  {ts(r['endTime'])}")
+    print("  output:")
+    print(r.get("output") or "(empty)")
+
+
+def cmd_nodes(api, args):
+    out = api.call("GET", "/v1/nodes")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    table([[n.get("id"), "up" if n.get("connected") else "DOWN",
+            "alive" if n.get("alived") else "dead",
+            n.get("pid"), ts(n.get("up_ts"))] for n in out],
+          ["NODE", "CONN", "MIRROR", "PID", "UP SINCE"])
+
+
+def cmd_groups(api, args):
+    out = api.call("GET", "/v1/node/groups")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    table([[g.get("id"), g.get("name"),
+            ",".join(g.get("nids") or [])] for g in out],
+          ["ID", "NAME", "NODES"])
+
+
+def cmd_group_get(api, args):
+    print(json.dumps(api.call("GET", f"/v1/node/group/{args.id}"), indent=2))
+
+
+def cmd_group_save(api, args):
+    if args.file == "-":
+        body = json.load(sys.stdin)
+    else:
+        with open(args.file) as f:
+            body = json.load(f)
+    out = api.call("PUT", "/v1/node/group", body=body)
+    print(f"saved group {out.get('id')}")
+
+
+def cmd_group_rm(api, args):
+    api.call("DELETE", f"/v1/node/group/{args.id}")
+    print(f"deleted group {args.id}")
+
+
+def cmd_accounts(api, args):
+    out = api.call("GET", "/v1/admin/accounts")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    table([[a.get("email"),
+            "admin" if a.get("role") == 1 else "developer",
+            "enabled" if a.get("status") else "disabled"] for a in out],
+          ["EMAIL", "ROLE", "STATUS"])
+
+
+def cmd_metrics(api, args):
+    sys.stdout.write(api.call("GET", "/v1/metrics"))
+
+
+def cmd_configurations(api, args):
+    print(json.dumps(api.call("GET", "/v1/configurations"), indent=2))
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="cronsun-ctl",
+        description=__doc__.split("\n\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", default=DEFAULT_URL,
+                    help=f"web server base URL (default {DEFAULT_URL}, "
+                         "env CRONSUN_URL)")
+    ap.add_argument("--session", default=DEFAULT_SESSION,
+                    help="cookie-jar file (env CRONSUN_SESSION)")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON output (scripting)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def add(name, fn, help_, **kw):
+        p = sub.add_parser(name, help=help_, **kw)
+        p.set_defaults(fn=fn)
+        return p
+
+    p = add("login", cmd_login, "create a session")
+    p.add_argument("email")
+    p.add_argument("--password", default=None,
+                   help="password (prompted when omitted)")
+    add("logout", cmd_logout, "destroy the session")
+    add("whoami", cmd_whoami, "show the logged-in account")
+    add("version", cmd_version, "server version")
+    add("overview", cmd_overview, "dashboard numbers")
+
+    p = add("jobs", cmd_jobs, "list jobs")
+    p.add_argument("--group", default=None)
+
+    job = sub.add_parser("job", help="job operations")
+    jsub = job.add_subparsers(dest="jobcmd", required=True)
+
+    def jadd(name, fn, help_):
+        p = jsub.add_parser(name, help=help_)
+        p.set_defaults(fn=fn)
+        return p
+    jadd("get", cmd_job_get, "show one job as JSON").add_argument("id")
+    jadd("save", cmd_job_save,
+         "create/update a job from a JSON file (or - for stdin)"
+         ).add_argument("file")
+    jadd("rm", cmd_job_rm, "delete a job").add_argument("id")
+    jadd("pause", cmd_job_pause, "pause a job").add_argument("id")
+    jadd("resume", cmd_job_resume, "resume a paused job").add_argument("id")
+    jadd("nodes", cmd_job_nodes,
+         "nodes a job resolves to (include ∪ groups − exclude)"
+         ).add_argument("id")
+
+    p = add("run", cmd_run, "run a job immediately (bypasses schedule)")
+    p.add_argument("id")
+    p.add_argument("--node", default=None,
+                   help="single node (default: all eligible)")
+
+    p = add("executing", cmd_executing, "what is running right now")
+    p.add_argument("--node", default=None)
+    p.add_argument("--job", default=None)
+
+    p = add("logs", cmd_logs, "execution history (filters match the UI)")
+    p.add_argument("--node", default=None)
+    p.add_argument("--job", default=None, help="job id (comma-list ok)")
+    p.add_argument("--names", default=None, help="name substring")
+    p.add_argument("--failed", action="store_true")
+    p.add_argument("--latest", action="store_true",
+                   help="latest record per (job, node)")
+    p.add_argument("--begin", default=None,
+                   help="epoch or YYYY-MM-DD[ HH:MM[:SS]] (local)")
+    p.add_argument("--end", default=None)
+    p.add_argument("--page", type=int, default=1)
+    p.add_argument("--size", type=int, default=50)
+
+    add("log", cmd_log, "one execution record with output"
+        ).add_argument("id", type=int)
+    add("nodes", cmd_nodes, "node liveness (mirror ⋈ live keys)")
+    add("groups", cmd_groups, "node groups")
+
+    grp = sub.add_parser("group", help="node-group operations")
+    gsub = grp.add_subparsers(dest="groupcmd", required=True)
+
+    def gadd(name, fn, help_):
+        p = gsub.add_parser(name, help=help_)
+        p.set_defaults(fn=fn)
+        return p
+    gadd("get", cmd_group_get, "show one group").add_argument("id")
+    gadd("save", cmd_group_save,
+         "create/update a group from a JSON file (or -)"
+         ).add_argument("file")
+    gadd("rm", cmd_group_rm,
+         "delete a group (scrubs it from job rules)").add_argument("id")
+
+    add("accounts", cmd_accounts, "list accounts (admin)")
+    add("metrics", cmd_metrics, "Prometheus metrics text")
+    add("configurations", cmd_configurations,
+        "security/alarm config exposed to the UI")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    api = Api(args.url, args.session)
+    try:
+        args.fn(api, args)
+    except ApiError as e:
+        if e.status == 401:
+            print("error: not logged in (or session expired) — "
+                  "run: cronsun-ctl login EMAIL", file=sys.stderr)
+        else:
+            print(f"error: {e}", file=sys.stderr)
+        return 1
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

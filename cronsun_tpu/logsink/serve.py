@@ -231,7 +231,9 @@ class RemoteJobLogStore:
                     if self._sock is None:
                         self._connect()
                     return self._exchange(op, *args)
-                except (OSError, json.JSONDecodeError) as e:
+                except (OSError, ValueError) as e:
+                    # ValueError covers JSONDecodeError and the
+                    # UnicodeDecodeError binary garbage raises
                     self._drop()
                     if attempt:
                         raise LogSinkError(f"{op}: {e}") from e

@@ -280,8 +280,9 @@ class RemoteStore:
                 break
             try:
                 msg = json.loads(line)
-            except json.JSONDecodeError:
-                continue
+            except ValueError:   # JSONDecodeError or UnicodeDecodeError
+                continue         # (binary garbage: TLS alert bytes from a
+                                 # mis-dialed TLS server, line noise)
             if "w" in msg:
                 w = self._watchers.get(msg["w"])
                 if w is not None:

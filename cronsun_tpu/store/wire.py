@@ -72,7 +72,10 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
                 return
             try:
                 req = json.loads(line)
-            except json.JSONDecodeError:
+            except ValueError:
+                # covers JSONDecodeError AND UnicodeDecodeError: binary
+                # garbage (a TLS ClientHello against a plaintext port, a
+                # port scanner) drops the connection, quietly
                 return
             rid, op, args = req.get("i"), req.get("o"), req.get("a", [])
             if not self.authed:

@@ -16,9 +16,14 @@ terminator (stunnel/haproxy) or on a trusted network — see
 native/README.md.
 
 Config surface (conf.py): ``store_tls`` / ``log_tls`` sections with
-``ca``, ``cert``, ``key``, ``hostname``.  Clients use ``ca`` (+
-``cert``/``key`` for mutual TLS); servers use ``cert``/``key`` (+ ``ca``
-to require client certs).  An empty section means plaintext — TLS never
+``ca``, ``cert``, ``key``, ``hostname``, ``client_ca``.  Clients use
+``ca`` to verify the server (+ ``cert``/``key`` to present under mutual
+TLS); servers use ``cert``/``key`` to serve and ``client_ca`` to demand
+client certificates.  The client trust anchor and the server's
+demand-client-certs knob are deliberately SEPARATE fields so one
+section can be shared by every process in a fleet conf without
+accidentally flipping on mutual TLS (a TLS client only sends its cert
+when the server asks).  An empty section means plaintext — TLS never
 turns on by accident — and a PARTIAL section raises at startup rather
 than silently downgrading (a client with a cert but no CA must not
 connect in clear).
@@ -47,12 +52,13 @@ from typing import Optional
 @dataclasses.dataclass
 class Tls:
     """One channel's TLS material.  All paths; "" disables that piece."""
-    ca: str = ""        # fleet CA bundle (client: verify server;
-                        # server: require + verify client certs)
-    cert: str = ""      # this endpoint's certificate chain
-    key: str = ""       # this endpoint's private key
-    hostname: str = ""  # client only: expected server SAN; "" skips
-                        # hostname binding (IP fleets with a private CA)
+    ca: str = ""         # client: fleet CA bundle the server must chain to
+    cert: str = ""       # this endpoint's certificate chain
+    key: str = ""        # this endpoint's private key
+    hostname: str = ""   # client only: expected server SAN; "" skips
+                         # hostname binding (IP fleets with a private CA)
+    client_ca: str = ""  # server only: demand client certs chaining to
+                         # this CA (mutual TLS)
 
     @property
     def client_enabled(self) -> bool:
@@ -65,20 +71,21 @@ class Tls:
 
 def server_context(tls: Tls) -> Optional[ssl.SSLContext]:
     """Server-side context, or None when the section is empty.
-    ``tls.ca`` set => mutual TLS (client certs required).  A partial
-    section (key/ca without cert) raises instead of serving plaintext."""
+    ``tls.client_ca`` set => mutual TLS (client certs required).  A
+    partial section (key/client_ca without cert) raises instead of
+    serving plaintext."""
     if not tls.server_enabled:
-        if tls.key or tls.ca:
+        if tls.key or tls.client_ca:
             raise ValueError(
-                "TLS section has key/ca but no cert: refusing to serve "
-                "plaintext on a half-configured channel")
+                "TLS section has key/client_ca but no cert: refusing to "
+                "serve plaintext on a half-configured channel")
         return None
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_2
     ctx.options |= ssl.OP_NO_RENEGOTIATION   # see module docstring
     ctx.load_cert_chain(tls.cert, tls.key or None)
-    if tls.ca:
-        ctx.load_verify_locations(tls.ca)
+    if tls.client_ca:
+        ctx.load_verify_locations(tls.client_ca)
         ctx.verify_mode = ssl.CERT_REQUIRED
     return ctx
 

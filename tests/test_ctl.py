@@ -1,0 +1,174 @@
+"""cronsun-ctl: the operator CLI drives the real /v1 surface end to
+end — session persistence across invocations, job lifecycle, run-now,
+log filters, nodes/groups, and the error paths."""
+
+import json
+
+import pytest
+
+from cronsun_tpu.bin import ctl
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.core.models import Node
+from cronsun_tpu.logsink import JobLogStore, LogRecord
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.web import ApiServer
+
+KS = Keyspace()
+
+
+@pytest.fixture
+def world(tmp_path):
+    store = MemStore()
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, port=0).start()
+    session = str(tmp_path / "session")
+
+    def run(*argv):
+        return ctl.main(["--url", f"http://127.0.0.1:{srv.port}",
+                         "--session", session, *argv])
+    yield store, sink, run
+    srv.stop()
+    store.close()
+
+
+def _login(run, capsys):
+    rc = run("login", "admin@admin.com", "--password", "admin")
+    out = capsys.readouterr().out
+    assert rc == 0 and "logged in as admin@admin.com (admin)" in out
+
+
+def test_session_persists_across_invocations(world, capsys):
+    _, _, run = world
+    assert run("version") == 0          # no auth needed
+    rc = run("jobs")
+    assert rc == 1
+    assert "not logged in" in capsys.readouterr().err
+    _login(run, capsys)
+    # a SEPARATE invocation reuses the cookie jar
+    assert run("whoami") == 0
+    assert "admin@admin.com" in capsys.readouterr().out
+    assert run("logout") == 0
+    capsys.readouterr()
+    assert run("whoami") == 1
+
+
+def test_job_lifecycle(world, capsys, tmp_path):
+    store, _, run = world
+    _login(run, capsys)
+    spec = tmp_path / "job.json"
+    spec.write_text(json.dumps({
+        "name": "backup", "group": "infra", "command": "echo hi",
+        "rules": [{"timer": "0 0 3 * * *", "nids": ["n1", "n2"]}]}))
+    assert run("job", "save", str(spec)) == 0
+    jid = capsys.readouterr().out.split()[-1]          # "saved infra-<id>"
+    assert jid.startswith("infra-")
+
+    assert run("jobs") == 0
+    out = capsys.readouterr().out
+    assert "backup" in out and "Common" in out
+
+    assert run("job", "get", jid) == 0
+    job = json.loads(capsys.readouterr().out)
+    assert job["name"] == "backup" and len(job["rules"]) == 1
+
+    assert run("job", "nodes", jid) == 0
+    assert capsys.readouterr().out.split() == ["n1", "n2"]
+
+    assert run("job", "pause", jid) == 0
+    capsys.readouterr()
+    assert run("jobs") == 0
+    assert "paused" in capsys.readouterr().out
+    assert run("job", "resume", jid) == 0
+    capsys.readouterr()
+    assert run("jobs") == 0
+    assert "paused" not in capsys.readouterr().out
+
+    # run-now writes the once key the agents watch
+    assert run("run", jid, "--node", "n2") == 0
+    capsys.readouterr()
+    group, _, raw = jid.rpartition("-")
+    kv = store.get(KS.once_key(group, raw))
+    assert kv is not None and kv.value == "n2"
+
+    assert run("job", "rm", jid) == 0
+    capsys.readouterr()
+    assert run("job", "get", jid) == 1
+    assert "no such job" in capsys.readouterr().err
+
+
+def test_logs_filters_and_detail(world, capsys):
+    _, sink, run = world
+    _login(run, capsys)
+    for i, (node, ok) in enumerate([("a", True), ("a", False), ("b", True)]):
+        sink.create_job_log(LogRecord(
+            job_id=f"j{i}", job_group="g", name=f"task{i}", node=node,
+            user="root", command="true", output="boom" if not ok else "fine",
+            success=ok, begin_ts=1000.0 + i, end_ts=1001.5 + i))
+    assert run("logs") == 0
+    out = capsys.readouterr().out
+    assert "task0" in out and "task2" in out and "3 records" in out
+
+    assert run("logs", "--failed") == 0
+    out = capsys.readouterr().out
+    assert "task1" in out and "task0" not in out and "FAIL" in out
+
+    assert run("logs", "--node", "b") == 0
+    out = capsys.readouterr().out
+    assert "task2" in out and "task1" not in out
+
+    assert run("--json", "logs", "--names", "task0") == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["total"] == 1 and data["list"][0]["name"] == "task0"
+
+    log_id = data["list"][0]["id"]
+    assert run("log", str(log_id)) == 0
+    out = capsys.readouterr().out
+    assert "fine" in out and "task0" in out
+
+
+def test_nodes_groups_executing_metrics(world, capsys, tmp_path):
+    store, sink, run = world
+    _login(run, capsys)
+    sink.upsert_node("w1", Node(id="w1", pid=42, hostname="h1",
+                                up_ts=5.0, alived=True).to_json(), True)
+    store.put(KS.node + "w1", "42")          # live key -> connected
+    assert run("nodes") == 0
+    out = capsys.readouterr().out
+    assert "w1" in out and "up" in out
+
+    gspec = tmp_path / "grp.json"
+    gspec.write_text(json.dumps({"id": "web", "name": "web tier",
+                                 "nids": ["w1"]}))
+    assert run("group", "save", str(gspec)) == 0
+    capsys.readouterr()
+    assert run("groups") == 0
+    assert "web tier" in capsys.readouterr().out
+    assert run("group", "get", "web") == 0
+    assert json.loads(capsys.readouterr().out)["nids"] == ["w1"]
+    assert run("group", "rm", "web") == 0
+    capsys.readouterr()
+    assert run("group", "get", "web") == 1
+
+    store.put(KS.proc + "w1/g/j1/123", json.dumps({"time": "t"}))
+    assert run("executing") == 0
+    out = capsys.readouterr().out
+    assert "w1" in out and "123" in out
+
+    assert run("metrics") == 0
+    assert run("overview") == 0
+    assert run("accounts") == 0
+    assert "admin@admin.com" in capsys.readouterr().out
+
+
+def test_unreachable_server(tmp_path, capsys):
+    rc = ctl.main(["--url", "http://127.0.0.1:9",   # discard port
+                   "--session", str(tmp_path / "s"), "version"])
+    assert rc == 1
+    assert "cannot reach" in capsys.readouterr().err
+
+
+def test_parse_when():
+    assert ctl.parse_when("1234.5") == 1234.5
+    assert ctl.parse_when("1970-01-02") > 0
+    with pytest.raises(SystemExit):
+        ctl.parse_when("not-a-time")

@@ -40,7 +40,7 @@ def certs(tmp_path_factory):
 
 def _server_tls(d, mutual=False):
     return Tls(cert=str(d / "server.pem"), key=str(d / "server.key"),
-               ca=str(d / "ca.pem") if mutual else "")
+               client_ca=str(d / "ca.pem") if mutual else "")
 
 
 def _client_tls(d, cert=False, hostname=""):
@@ -254,7 +254,26 @@ def test_partial_tls_section_raises_instead_of_downgrading():
     with pytest.raises(ValueError):
         server_context(Tls(key="/x/server.key"))
     with pytest.raises(ValueError):
-        server_context(Tls(ca="/x/ca.pem"))
+        server_context(Tls(client_ca="/x/ca.pem"))
+
+
+def test_one_shared_section_works_for_both_roles(certs):
+    """client trust (ca) and the server's demand-client-certs knob
+    (client_ca) are separate fields, so ONE fleet-wide conf section —
+    ca + cert + key + hostname — serves servers and clients without
+    accidentally flipping on mutual TLS."""
+    d, _ = certs
+    shared = Tls(ca=str(d / "ca.pem"), cert=str(d / "server.pem"),
+                 key=str(d / "server.key"), hostname="localhost")
+    srv = StoreServer(MemStore(), sslctx=server_context(shared)).start()
+    try:
+        c = RemoteStore(srv.host, srv.port, sslctx=client_context(shared),
+                        tls_hostname=shared.hostname)
+        c.put("/shared", "1")
+        assert c.get("/shared").value == "1"
+        c.close()
+    finally:
+        srv.stop()
 
 
 def test_client_cert_cannot_pose_as_server(certs):
