@@ -145,6 +145,13 @@ def parse_when(s: str) -> float:
                      "(epoch or YYYY-MM-DD[ HH:MM[:SS]])")
 
 
+def positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError("must be >= 1")
+    return v
+
+
 KINDS = {0: "Common", 1: "Alone", 2: "Interval"}
 
 
@@ -432,8 +439,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--begin", default=None,
                    help="epoch or YYYY-MM-DD[ HH:MM[:SS]] (local)")
     p.add_argument("--end", default=None)
-    p.add_argument("--page", type=int, default=1)
-    p.add_argument("--size", type=int, default=50)
+    p.add_argument("--page", type=positive_int, default=1)
+    p.add_argument("--size", type=positive_int, default=50)
 
     add("log", cmd_log, "one execution record with output"
         ).add_argument("id", type=int)
@@ -467,10 +474,12 @@ def main(argv=None) -> int:
     try:
         args.fn(api, args)
     except ApiError as e:
-        if e.status == 401:
+        if e.status == 401 and args.cmd != "login":
             print("error: not logged in (or session expired) — "
                   "run: cronsun-ctl login EMAIL", file=sys.stderr)
         else:
+            # login itself keeps the server detail ("invalid email or
+            # password"), not circular advice to run login
             print(f"error: {e}", file=sys.stderr)
         return 1
     except (OSError, json.JSONDecodeError) as e:

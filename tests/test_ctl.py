@@ -167,6 +167,23 @@ def test_unreachable_server(tmp_path, capsys):
     assert "cannot reach" in capsys.readouterr().err
 
 
+def test_login_bad_password_keeps_server_detail(world, capsys):
+    _, _, run = world
+    rc = run("login", "admin@admin.com", "--password", "nope")
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "invalid email or password" in err
+    assert "run: cronsun-ctl login" not in err
+
+
+def test_logs_size_zero_rejected(world, capsys):
+    _, _, run = world
+    _login(run, capsys)
+    with pytest.raises(SystemExit):
+        run("logs", "--size", "0")
+    assert "must be >= 1" in capsys.readouterr().err
+
+
 def test_parse_when():
     assert ctl.parse_when("1234.5") == 1234.5
     assert ctl.parse_when("1970-01-02") > 0
