@@ -36,10 +36,15 @@ def main(argv=None) -> int:
     ap.add_argument("--native", action="store_true",
                     help="serve with the native C++ result store")
     ap.add_argument("--retain", type=int, default=None,
-                    help="record retention cap (--native only)")
+                    help="execution-history retention cap in records, "
+                         ">= 1 (stats/latest-status stay exact); "
+                         "default: native 1M, Python unbounded")
     args = ap.parse_args(argv)
-    if args.retain is not None and not args.native:
-        print("error: --retain requires --native", file=sys.stderr)
+    if args.retain is not None and args.retain < 1:
+        # 0 would mean "unbounded" to the SQLite store but "keep
+        # nothing" to the native one — refuse the ambiguity
+        print("error: --retain must be >= 1 (omit it for the default)",
+              file=sys.stderr)
         return 2
     cfg, ks, watcher = setup_common(args)
     token = cfg.log_token if args.token is None else args.token
@@ -62,7 +67,8 @@ def main(argv=None) -> int:
     else:
         srv = LogSinkServer(db_path=args.db or cfg.log_db,
                             host=args.host, port=args.port,
-                            token=token, sslctx=sslctx).start()
+                            token=token, sslctx=sslctx,
+                            retain=args.retain or 0).start()
     log.infof("cronsun-logd serving on %s:%d (db %s)%s", srv.host, srv.port,
               args.db or cfg.log_db,
               " (tls)" if sslctx is not None else "")

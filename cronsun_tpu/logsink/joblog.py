@@ -74,8 +74,17 @@ class LogRecord:
 
 
 class JobLogStore:
-    def __init__(self, path: str = ":memory:"):
+    """``retain`` > 0 bounds execution-history rows (oldest evicted on
+    insert), mirroring the native logd's --retain: the stats counters
+    and the latest-status table — which summarize all history — are
+    never evicted, so dashboards stay exact while disk stays bounded.
+    The reference keeps Mongo job_log forever (no TTL index anywhere in
+    /root/reference/db or job_log.go) — unbounded (0) matches that, the
+    cap is the operational improvement."""
+
+    def __init__(self, path: str = ":memory:", retain: int = 0):
         self._lock = threading.RLock()
+        self._retain = max(0, int(retain))
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.row_factory = sqlite3.Row
         with self._lock:
@@ -109,6 +118,12 @@ class JobLogStore:
                 (rec.job_id, rec.job_group, rec.name, rec.node, rec.user,
                  rec.command, rec.output, ok, rec.begin_ts, rec.end_ts))
             rec.id = cur.lastrowid
+            if self._retain:
+                # ids stay monotone (only the oldest rows are ever
+                # deleted, so max rowid never frees), making the cap a
+                # single indexed range delete per insert
+                self._db.execute("DELETE FROM job_log WHERE id <= ?",
+                                 (rec.id - self._retain,))
             self._db.execute(
                 "INSERT INTO job_latest_log VALUES (?,?,?,?,?,?,?,?,?,?) "
                 "ON CONFLICT(job_id, node) DO UPDATE SET "

@@ -136,8 +136,9 @@ class LogSinkServer:
 
     def __init__(self, sink: Optional[JobLogStore] = None,
                  db_path: str = ":memory:", host: str = "127.0.0.1",
-                 port: int = 0, token: str = "", sslctx=None):
-        self.sink = sink or JobLogStore(db_path)
+                 port: int = 0, token: str = "", sslctx=None,
+                 retain: int = 0):
+        self.sink = sink or JobLogStore(db_path, retain=retain)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -166,3 +166,29 @@ def test_accounts_crud(sink):
     assert len(sink.list_accounts()) == 1
     assert sink.delete_account("a@b.c")
     assert not sink.delete_account("a@b.c")
+
+
+def test_retention_caps_history_but_not_summaries():
+    """retain=N keeps only the newest N execution records while the
+    stats counters and latest-status table keep summarizing ALL history
+    (the native logd's --retain contract, now shared by the SQLite
+    store)."""
+    sink = JobLogStore(retain=5)
+    for i in range(12):
+        sink.create_job_log(_rec(job=f"j{i % 2}", node="n1", ok=(i % 3 != 0),
+                                 t=1_753_000_000.0 + i))
+    logs, total = sink.query_logs(page_size=100)
+    assert total == 5
+    assert [r.begin_ts for r in logs] == \
+        [1_753_000_000.0 + i for i in (11, 10, 9, 8, 7)]
+    # summaries survive eviction
+    st = sink.stat_overall()
+    assert st["total"] == 12 and st["failed"] == 4
+    latest, lt = sink.query_logs(latest=True, page_size=100)
+    assert lt == 2                      # one per (job, node)
+    assert all(r.begin_ts >= 1_753_000_010.0 for r in latest)
+    # unbounded by default
+    s2 = JobLogStore()
+    for i in range(12):
+        s2.create_job_log(_rec(t=1_753_000_000.0 + i))
+    assert s2.query_logs(page_size=100)[1] == 12

@@ -314,10 +314,27 @@ def test_native_wal_survives_restart(tmp_path):
     srv2.stop()
 
 
-def test_native_retention_keeps_stats_and_latest(tmp_path):
-    """Records beyond --retain age out of memory/WAL, but the stats
-    counters and the latest view — which summarize all history —
-    survive compaction exactly."""
+@pytest.mark.parametrize("backend", ["py", "native"])
+def test_retention_keeps_stats_and_latest(tmp_path, backend):
+    """Records beyond --retain age out, but the stats counters and the
+    latest view — which summarize all history — stay exact.  Shared
+    contract: the native in-memory/WAL store and the Python SQLite
+    store enforce it identically over the wire."""
+    if backend == "py":
+        srv = LogSinkServer(db_path=str(tmp_path / "logd.db"),
+                            retain=10).start()
+        c = RemoteJobLogStore(srv.host, srv.port)
+        for i in range(25):
+            c.create_job_log(_rec(job="hot", node="n1", ok=True,
+                                  begin=1000.0 + i))
+        _, total = c.query_logs()
+        assert total == 10
+        assert c.stat_overall()["total"] == 25
+        latest, _ = c.query_logs(latest=True)
+        assert latest[0].begin_ts == 1024.0
+        c.close()
+        srv.stop()
+        return
     db = str(tmp_path / "logd.wal")
     srv = _native_server(db=db, retain=10)
     c = RemoteJobLogStore(srv.host, srv.port)
