@@ -69,6 +69,26 @@ def bench_windows(p, t0, n_windows, W, pipeline=2, sla=None):
     return (time.time() - start) / (n_windows * W) * 1000
 
 
+def window_intervals(p, t0, n_windows, W, pipeline=2, sla=None):
+    """Steady-state per-tick ms as a DISTRIBUTION: pipelined windowed
+    dispatches, timestamp each gather while the pipeline is still being
+    fed (drain-phase gathers complete instantly and are excluded), and
+    return the inter-completion intervals divided by W.  p99 over these
+    is a real tail over windows — p99 over a handful of run MEANS (the
+    old method) collapses to max-of-means and swings 2-3x on a single
+    tunnel hiccup (the 22.7 -> 60.8 ms mystery in docs/DESIGN.md)."""
+    handles = []
+    stamps = []
+    for i in range(n_windows):
+        handles.append(p.plan_window_async(t0 + i * W, W, sla_bucket=sla))
+        if len(handles) > pipeline:
+            p.gather_window(handles.pop(0))
+            stamps.append(time.time())
+    for h in handles:
+        p.gather_window(h)
+    return np.diff(stamps) / W * 1000
+
+
 def bench_ticks_sync(p, t0, n, sla=None):
     lat = []
     for i in range(n):
@@ -211,9 +231,10 @@ def main():
 
     # headline: windowed planning (the production cadence — plan W seconds
     # ahead in one dispatch; semantics identical to W sequential ticks).
-    import jax
+    # p50/p99 are taken over per-window steady-state completion intervals
+    # (see window_intervals) — a distribution over real windows, robust to
+    # a single tunnel hiccup yet still an honest tail.
     W = 8
-    p99_samples = []
     p = TickPlanner(job_capacity=1 << 20, node_capacity=10240,
                     max_fire_bucket=65536)
     p.set_table(synth_table(p.J, 35, 70))
@@ -224,23 +245,28 @@ def main():
     log(f"headline: 1M x 10k windowed (W={W})")
     SLA = (16384, 16384)
     bench_windows(p, T0, 2, W, sla=SLA)  # warm + compile
-    for rep in range(3 if quick else 6):
-        p99_samples.append(bench_windows(p, T0 + 1000 * rep, 4, W, sla=SLA))
-    headline_p99 = float(np.percentile(p99_samples, 99))
+    reps = 1 if quick else 2
+    per_win = np.concatenate([
+        window_intervals(p, T0 + 10_000 * r, 12 if quick else 28, W,
+                         sla=SLA)
+        for r in range(reps)])
+    headline_p50 = float(np.percentile(per_win, 50))
+    headline_p99 = float(np.percentile(per_win, 99))
     fired = p.gather(p.plan_async(T0 + 50000, sla_bucket=SLA)).fired
+    detail["headline_windowed_p50_ms_per_tick"] = round(headline_p50, 2)
     detail["headline_windowed_p99_ms_per_tick"] = round(headline_p99, 2)
+    detail["headline_window_samples"] = int(len(per_win))
     detail["headline_window_s"] = W
     detail["headline_fired_per_tick"] = int(len(fired))
     detail["headline_jobs_per_sec_per_chip"] = int(
         len(fired) / (headline_p99 / 1000))
     # throughput-optimal cadence: W=32 amortizes the link RTT 4x further
-    # (~16 ms/tick measured) at the cost of job updates taking effect up
-    # to 32 s later — recorded as a secondary figure, not the headline,
-    # because the deployment default keeps the shorter window
+    # at the cost of job updates taking effect up to 32 s later —
+    # recorded as a secondary figure, not the headline, because the
+    # deployment default keeps the shorter window
     if not quick:
-        bench_windows(p, T0 + 8000, 1, 32, sla=SLA)   # warm W=32
-        w32 = [bench_windows(p, T0 + 9000 + 200 * r, 2, 32, sla=SLA)
-               for r in range(3)]
+        bench_windows(p, T0 + 80_000, 1, 32, sla=SLA)   # warm W=32
+        w32 = window_intervals(p, T0 + 90_000, 8, 32, sla=SLA)
         detail["w32_windowed_p99_ms_per_tick"] = round(
             float(np.percentile(w32, 99)), 2)
 

@@ -432,7 +432,18 @@ class NodeAgent:
                     job_id=job.id, command=job.command, user=job.user,
                     timeout=job.timeout, retry=job.retry,
                     interval=job.interval,
-                    parallels=job.parallels if use_gate else 0)
+                    parallels=job.parallels if use_gate else 0,
+                    # cron-context environment: jobs learn which second
+                    # they were scheduled FOR (begin_ts in the log is
+                    # when they actually ran — under load the two can
+                    # differ, and scripts that write period-stamped
+                    # artifacts need the scheduled one)
+                    env={**os.environ,
+                         "CRONSUN_NODE": self.id,
+                         "CRONSUN_JOB_ID": job.id,
+                         "CRONSUN_JOB_GROUP": job.group,
+                         "CRONSUN_JOB_NAME": job.name,
+                         "CRONSUN_SCHEDULED_TS": str(epoch_s)})
             finally:
                 if timer is not None:
                     timer.cancel()

@@ -682,7 +682,9 @@ class Executor {
   // (the ProcReq hook: the proc key is written only for long runs)
   ExecResult run_once(const std::string& command, const std::string& user,
                       int timeout, double threshold_s,
-                      const std::function<void()>& on_threshold) {
+                      const std::function<void()>& on_threshold,
+                      const std::vector<std::pair<std::string, std::string>>&
+                          extra_env = {}) {
     ExecResult r;
     r.begin = now_s();
     std::vector<std::string> argv;
@@ -710,6 +712,27 @@ class Executor {
       gid = pw->pw_gid;
       demote = true;
     }
+    // the child environment is assembled BEFORE fork: setenv/malloc in
+    // a forked child of a multithreaded process can deadlock, so the
+    // child only does execvpe on pre-built arrays
+    std::vector<std::string> env_strings;
+    for (char** e = environ; e && *e; ++e) {
+      // a pre-existing CRONSUN_* inherited from the agent's launcher
+      // must not shadow the per-job value (getenv returns the FIRST
+      // match) — same override semantics as the Python agent's
+      // {**os.environ, ...}
+      const char* eq = strchr(*e, '=');
+      std::string key = eq ? std::string(*e, eq - *e) : std::string(*e);
+      bool overridden = false;
+      for (auto& kv : extra_env)
+        if (kv.first == key) { overridden = true; break; }
+      if (!overridden) env_strings.push_back(*e);
+    }
+    for (auto& kv : extra_env)
+      env_strings.push_back(kv.first + "=" + kv.second);
+    std::vector<char*> cenv;
+    for (auto& s : env_strings) cenv.push_back(const_cast<char*>(s.c_str()));
+    cenv.push_back(nullptr);
     int pfd[2];
     if (pipe(pfd) != 0) {
       r.end = now_s();
@@ -736,7 +759,7 @@ class Executor {
       std::vector<char*> cargv;
       for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
       cargv.push_back(nullptr);
-      execvp(cargv[0], cargv.data());
+      execvpe(cargv[0], cargv.data(), cenv.data());
       dprintf(2, "exec failed: %s\n", strerror(errno));
       _exit(127);
     }
@@ -800,7 +823,9 @@ class Executor {
   ExecResult run_job(const std::string& job_id, const std::string& command,
                      const std::string& user, int timeout, int retry,
                      int interval, int parallels, double threshold_s,
-                     const std::function<void()>& on_threshold) {
+                     const std::function<void()>& on_threshold,
+                     const std::vector<std::pair<std::string, std::string>>&
+                         extra_env = {}) {
     if (!gate_enter(job_id, parallels)) {
       ExecResult r;
       r.begin = r.end = now_s();
@@ -818,7 +843,7 @@ class Executor {
       }
     };
     ExecResult result =
-        run_once(command, user, timeout, threshold_s, fire_once);
+        run_once(command, user, timeout, threshold_s, fire_once, extra_env);
     int attempts = 0;
     while (!result.success && !result.skipped && attempts < retry) {
       if (interval > 0)
@@ -830,7 +855,8 @@ class Executor {
         remain = std::max(0.01, begin0 + threshold_s - now_s());
       }
       result = run_once(command, user, timeout, remain,
-                        fired ? std::function<void()>() : fire_once);
+                        fired ? std::function<void()>() : fire_once,
+                        extra_env);
       result.begin = begin0;  // whole-run span
       if (result.success) break;
     }
@@ -1391,7 +1417,13 @@ class Agent {
     if (proc_req_ <= 0) on_threshold();
     ExecResult res = exec_.run_job(
         j.id, j.command, j.user, j.timeout, j.retry, j.interval,
-        gate ? j.parallels : 0, proc_req_, on_threshold);
+        gate ? j.parallels : 0, proc_req_, on_threshold,
+        // cron-context env, identical to the Python agent's
+        {{"CRONSUN_NODE", id_},
+         {"CRONSUN_JOB_ID", j.id},
+         {"CRONSUN_JOB_GROUP", j.group},
+         {"CRONSUN_JOB_NAME", j.name},
+         {"CRONSUN_SCHEDULED_TS", std::to_string(epoch)}});
     if (proc_put) {
       std::lock_guard<std::mutex> g(procs_mu_);
       procs_.erase(proc_key);

@@ -42,7 +42,7 @@ def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
 
     class InstantExecutor:
         def run_job(self, job_id, command, user, timeout, retry,
-                    interval, parallels):
+                    interval, parallels, env=None, **kw):
             now = time.time()
             return ExecResult(success=True, output="bench", error="",
                               begin_ts=now, end_ts=now, skipped=False)

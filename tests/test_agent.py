@@ -376,3 +376,28 @@ def test_native_tokenizer_matches_shlex():
         actual = json.loads(line)
         assert actual == expect, \
             f"tokenizer divergence on {case!r}: {actual} != {expect}"
+
+
+def test_cron_context_env():
+    """Executed commands see the cron-context environment — most
+    importantly CRONSUN_SCHEDULED_TS, the second the run was planned
+    FOR (begin_ts records when it actually ran; under load the two
+    differ) — merged over the agent's own environment, not replacing
+    it (PATH must survive for `sh` to resolve)."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    job = make_job(command="sh -c 'echo $CRONSUN_SCHEDULED_TS "
+                           "$CRONSUN_JOB_ID $CRONSUN_JOB_GROUP "
+                           "$CRONSUN_NODE'")
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    epoch = int(time.time()) - 1
+    store.put(KS.dispatch_key("n0", epoch, job.group, job.id),
+              json.dumps({"rule": job.rules[0].id, "kind": job.kind}))
+    agent.poll()
+    agent.join_running()
+    recs, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1
+    assert recs[0].output.split() == \
+        [str(epoch), job.id, job.group, "n0"]
+    store.close()
