@@ -266,9 +266,12 @@ def main():
     # deployment default keeps the shorter window
     if not quick:
         bench_windows(p, T0 + 80_000, 1, 32, sla=SLA)   # warm W=32
-        w32 = window_intervals(p, T0 + 90_000, 8, 32, sla=SLA)
+        w32 = window_intervals(p, T0 + 90_000, 16, 32, sla=SLA)
+        detail["w32_windowed_p50_ms_per_tick"] = round(
+            float(np.percentile(w32, 50)), 2)
         detail["w32_windowed_p99_ms_per_tick"] = round(
             float(np.percentile(w32, 99)), 2)
+        detail["w32_window_samples"] = int(len(w32))
 
     # ---- dispatch plane: plan -> put_many -> agent -> fence -> log ---------
     # The path the reference spends its time on (SURVEY §3.2: etcd round

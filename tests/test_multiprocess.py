@@ -795,3 +795,88 @@ def test_sched_failover_across_processes(tmp_path):
         sink.close()
     finally:
         _teardown(procs)
+
+
+def test_tls_fleet_end_to_end(tmp_path):
+    """A TLS-secured deployment as real OS processes: Python store and
+    logd terminate TLS (certs from scripts/gen_certs.sh), every client
+    process carries the fleet CA in its conf, tokens ride inside the
+    encrypted channel, and a job executes end to end.  The refusal
+    matrix lives in tests/test_tls.py; this pins the full-fleet wiring
+    (conf sections -> entrypoints -> both wires)."""
+    import subprocess as sp
+
+    certs = tmp_path / "certs"
+    sp.run(["sh", "scripts/gen_certs.sh", str(certs)], check=True,
+           capture_output=True, cwd=REPO)
+    # one shared section per channel works for servers AND clients:
+    # servers read cert/key, clients read ca/hostname (client_ca —
+    # mutual TLS — stays a deliberate, separate server knob)
+    conf = tmp_path / "conf.json"
+    conf.write_text(json.dumps({
+        "log_db": str(tmp_path / "local-UNUSED.db"), "window_s": 2,
+        "node_ttl": 5, "store_token": "st", "log_token": "lg",
+        "store_tls": {"ca": str(certs / "ca.pem"),
+                      "cert": str(certs / "server.pem"),
+                      "key": str(certs / "server.key"),
+                      "hostname": "localhost"},
+        "log_tls": {"ca": str(certs / "ca.pem"),
+                    "cert": str(certs / "server.pem"),
+                    "key": str(certs / "server.key"),
+                    "hostname": "localhost"}}))
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--port", "0",
+                         "--conf", str(conf))
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--port", "0",
+                        "--db", str(tmp_path / "logd.db"),
+                        "--conf", str(conf))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+
+        sched_p = _spawn("cronsun_tpu.bin.sched", "--store", store_addr,
+                         "--conf", str(conf))
+        node_p = _spawn("cronsun_tpu.bin.node", "--store", store_addr,
+                        "--logsink", logd_addr, "--conf", str(conf),
+                        "--node-id", "tls-node")
+        web_p = _spawn("cronsun_tpu.bin.web", "--store", store_addr,
+                       "--logsink", logd_addr, "--conf", str(conf),
+                       "--port", "0")
+        procs += [sched_p, node_p, web_p]
+        _await_ready(sched_p)
+        _await_ready(node_p)
+        web_addr = _await_ready(web_p)
+
+        # a plaintext client cannot reach the TLS store
+        from cronsun_tpu.store.remote import RemoteStore, RemoteStoreError
+        sh_, _, sp_ = store_addr.rpartition(":")
+        with pytest.raises((RemoteStoreError, OSError)):
+            plain = RemoteStore(sh_, int(sp_), reconnect=False, timeout=3)
+            plain.put("/x", "1")
+
+        op, base = _login(web_addr)
+        _put_job(op, base, {
+            "name": "tls-fleet", "command": "echo over-tls", "kind": 0,
+            "rules": [{"timer": "* * * * * *", "nids": ["tls-node"]}]})
+
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        from cronsun_tpu.tlsutil import Tls, client_context
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(
+            lh, int(lp), token="lg",
+            sslctx=client_context(Tls(ca=str(certs / "ca.pem"),
+                                      hostname="localhost")),
+            tls_hostname="localhost")
+        deadline = time.time() + 45
+        total = 0
+        while time.time() < deadline and total < 2:
+            logs, total = sink.query_logs(page_size=50)
+            time.sleep(0.5)
+        assert total >= 2, "no executions landed through the TLS fleet"
+        assert all("over-tls" in l.output for l in logs)
+        sink.close()
+    finally:
+        _teardown(procs)
