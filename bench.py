@@ -266,7 +266,7 @@ def main():
     # deployment default keeps the shorter window
     if not quick:
         bench_windows(p, T0 + 80_000, 1, 32, sla=SLA)   # warm W=32
-        w32 = window_intervals(p, T0 + 90_000, 16, 32, sla=SLA)
+        w32 = window_intervals(p, T0 + 90_000, 52, 32, sla=SLA)
         detail["w32_windowed_p50_ms_per_tick"] = round(
             float(np.percentile(w32, 50)), 2)
         detail["w32_windowed_p99_ms_per_tick"] = round(

@@ -359,6 +359,48 @@ def cmd_accounts(api, args):
           ["EMAIL", "ROLE", "STATUS"])
 
 
+def cmd_account_add(api, args):
+    pw = args.password if args.password is not None else \
+        getpass.getpass(f"password for new account {args.email}: ")
+    api.call("PUT", "/v1/admin/account",
+             body={"email": args.email, "password": pw,
+                   "role": 1 if args.admin else 2,
+                   "status": 0 if args.disabled else 1})
+    print(f"created {args.email} "
+          f"({'admin' if args.admin else 'developer'})")
+
+
+def cmd_account_update(api, args):
+    body = {"email": args.email}
+    if args.role is not None:
+        body["role"] = {"admin": 1, "developer": 2}[args.role]
+    if args.enable:
+        body["status"] = 1
+    if args.disable:
+        body["status"] = 0
+    if args.password is not None:
+        if not args.password:
+            # the server ignores falsy passwords but still force-logs
+            # the account out — refuse the silent no-op
+            raise SystemExit("error: --password must not be empty")
+        body["password"] = args.password
+    if len(body) == 1:
+        raise SystemExit("error: nothing to update "
+                         "(--role/--enable/--disable/--password)")
+    api.call("POST", "/v1/admin/account", body=body)
+    print(f"updated {args.email} (any open sessions were logged out)")
+
+
+def cmd_passwd(api, args):
+    old = args.old if args.old is not None else \
+        getpass.getpass("current password: ")
+    new = args.new if args.new is not None else \
+        getpass.getpass("new password: ")
+    api.call("POST", "/v1/user/setpwd",
+             body={"password": old, "newPassword": new})
+    print("password changed")
+
+
 def cmd_metrics(api, args):
     sys.stdout.write(api.call("GET", "/v1/metrics"))
 
@@ -462,6 +504,31 @@ def build_parser() -> argparse.ArgumentParser:
          "delete a group (scrubs it from job rules)").add_argument("id")
 
     add("accounts", cmd_accounts, "list accounts (admin)")
+
+    acct = sub.add_parser("account", help="account administration (admin)")
+    asub = acct.add_subparsers(dest="acctcmd", required=True)
+    p = asub.add_parser("add", help="create an account")
+    p.set_defaults(fn=cmd_account_add)
+    p.add_argument("email")
+    p.add_argument("--password", default=None,
+                   help="initial password (prompted when omitted)")
+    p.add_argument("--admin", action="store_true",
+                   help="Administrator role (default: Developer)")
+    p.add_argument("--disabled", action="store_true")
+    p = asub.add_parser("update",
+                        help="change role/status/password "
+                             "(force-logs-out the account)")
+    p.set_defaults(fn=cmd_account_update)
+    p.add_argument("email")
+    p.add_argument("--role", choices=("admin", "developer"), default=None)
+    st = p.add_mutually_exclusive_group()
+    st.add_argument("--enable", action="store_true")
+    st.add_argument("--disable", action="store_true")
+    p.add_argument("--password", default=None)
+
+    p = add("passwd", cmd_passwd, "change your own password")
+    p.add_argument("--old", default=None, help="prompted when omitted")
+    p.add_argument("--new", default=None, help="prompted when omitted")
     add("metrics", cmd_metrics, "Prometheus metrics text")
     add("configurations", cmd_configurations,
         "security/alarm config exposed to the UI")

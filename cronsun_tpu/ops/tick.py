@@ -338,9 +338,15 @@ def next_fire(table: ScheduleTable, after_epoch_s: int, tz=_UTC,
     # int32 framework-relative seconds bound the scan to ~2088; 20 years
     # is already 4x the reference's give-up horizon (spec.go:70-75)
     horizon_days = min((horizon_s + 86399) // 86400, 20 * 366)
-    is_cron = ~np.asarray(table.is_every)
-    live = np.asarray(table.active & ~table.paused)
+    # the row masks live on device; fetching them costs a link round
+    # trip each, so they materialize only if the continuation loop is
+    # actually entered (at the default 5-year horizon it never is —
+    # the fused pass already scanned _DAY_PAD >= horizon days)
+    is_cron = live = None
     while days_done < horizon_days:
+        if is_cron is None:
+            is_cron = ~np.asarray(table.is_every)
+            live = np.asarray(table.active & ~table.paused)
         unresolved = (result < 0) & is_cron & live
         if not unresolved.any():
             break

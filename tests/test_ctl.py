@@ -189,3 +189,49 @@ def test_parse_when():
     assert ctl.parse_when("1970-01-02") > 0
     with pytest.raises(SystemExit):
         ctl.parse_when("not-a-time")
+
+
+def test_account_admin_and_passwd(world, capsys):
+    _, _, run = world
+    _login(run, capsys)
+    assert run("account", "add", "dev@x.io", "--password", "devpw") == 0
+    capsys.readouterr()
+    assert run("accounts") == 0
+    out = capsys.readouterr().out
+    assert "dev@x.io" in out and "developer" in out
+
+    assert run("account", "update", "dev@x.io", "--role", "admin",
+               "--disable") == 0
+    capsys.readouterr()
+    assert run("accounts") == 0
+    out = capsys.readouterr().out
+    assert "disabled" in out
+
+    # a disabled account cannot log in
+    assert run("logout") == 0
+    capsys.readouterr()
+    rc = run("login", "dev@x.io", "--password", "devpw")
+    assert rc == 1
+    capsys.readouterr()
+
+    # nothing-to-update is a clean error, not a silent no-op
+    _login(run, capsys)
+    with pytest.raises(SystemExit):
+        run("account", "update", "dev@x.io")
+
+    # self password change invalidates the session and works afresh
+    assert run("passwd", "--old", "admin", "--new", "admin2") == 0
+    capsys.readouterr()
+    assert run("login", "admin@admin.com", "--password", "admin2") == 0
+
+
+def test_account_update_guards(world, capsys):
+    _, _, run = world
+    _login(run, capsys)
+    run("account", "add", "g@x.io", "--password", "gpw")
+    capsys.readouterr()
+    with pytest.raises(SystemExit):      # contradictory flags
+        run("account", "update", "g@x.io", "--enable", "--disable")
+    capsys.readouterr()
+    with pytest.raises(SystemExit):      # empty password = silent no-op
+        run("account", "update", "g@x.io", "--password", "")

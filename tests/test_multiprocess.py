@@ -804,11 +804,9 @@ def test_tls_fleet_end_to_end(tmp_path):
     encrypted channel, and a job executes end to end.  The refusal
     matrix lives in tests/test_tls.py; this pins the full-fleet wiring
     (conf sections -> entrypoints -> both wires)."""
-    import subprocess as sp
-
     certs = tmp_path / "certs"
-    sp.run(["sh", "scripts/gen_certs.sh", str(certs)], check=True,
-           capture_output=True, cwd=REPO)
+    subprocess.run(["sh", "scripts/gen_certs.sh", str(certs)], check=True,
+                   capture_output=True, cwd=REPO)
     # one shared section per channel works for servers AND clients:
     # servers read cert/key, clients read ca/hostname (client_ca —
     # mutual TLS — stays a deliberate, separate server knob)
