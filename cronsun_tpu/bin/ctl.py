@@ -145,6 +145,18 @@ def parse_when(s: str) -> float:
                      "(epoch or YYYY-MM-DD[ HH:MM[:SS]])")
 
 
+def _role(role) -> str:
+    return "admin" if role == 1 else "developer"
+
+
+def _read_json_arg(path: str):
+    """JSON body from a file argument, with - meaning stdin."""
+    if path == "-":
+        return json.load(sys.stdin)
+    with open(path) as f:
+        return json.load(f)
+
+
 def positive_int(s: str) -> int:
     v = int(s)
     if v < 1:
@@ -169,8 +181,7 @@ def cmd_login(api, args):
     out = api.call("GET", "/v1/session",
                    {"email": args.email, "password": pw})
     api.save()
-    print(f"logged in as {out['email']} "
-          f"({'admin' if out.get('role') == 1 else 'developer'})")
+    print(f"logged in as {out['email']} ({_role(out.get('role'))})")
 
 
 def cmd_logout(api, args):
@@ -182,7 +193,7 @@ def cmd_logout(api, args):
 def cmd_whoami(api, args):
     out = api.call("GET", "/v1/session/me")
     print(json.dumps(out) if args.json else
-          f"{out['email']} ({'admin' if out.get('role') == 1 else 'developer'})")
+          f"{out['email']} ({_role(out.get('role'))})")
 
 
 def cmd_version(api, args):
@@ -218,12 +229,7 @@ def cmd_job_get(api, args):
 
 
 def cmd_job_save(api, args):
-    if args.file == "-":
-        body = json.load(sys.stdin)
-    else:
-        with open(args.file) as f:
-            body = json.load(f)
-    out = api.call("PUT", "/v1/job", body=body)
+    out = api.call("PUT", "/v1/job", body=_read_json_arg(args.file))
     print(f"saved {out['group']}-{out['id']}")
 
 
@@ -267,6 +273,43 @@ def cmd_executing(api, args):
            for e in out], ["NODE", "JOB", "PID", "STARTED"])
 
 
+def _log_line(r) -> str:
+    took = max(0.0, (r["endTime"] or 0) - (r["beginTime"] or 0))
+    status = "ok  " if r["success"] else "FAIL"
+    return (f"{ts(r['beginTime'])}  {status}  {r['name']:<20} "
+            f"{r['node']:<12} {took:5.1f}s  #{r['id']}")
+
+
+def _follow_logs(api, params, interval: float, as_json: bool):
+    """tail -f over the result store, cursor-exact: the afterId query
+    returns rows ordered by id (= insertion order), so records inserted
+    with old begin_ts — long jobs finishing late — are never missed."""
+    out = api.call("GET", "/v1/logs", dict(params, page=1, pageSize=1))
+    # the default view orders by begin_ts; one cursored probe past its
+    # newest id finds the true insertion high-water mark
+    last_id = max((r["id"] for r in out["list"]), default=0)
+    while True:
+        nxt = api.call("GET", "/v1/logs",
+                       dict(params, afterId=last_id, page=1, pageSize=500))
+        if not nxt["list"]:
+            break
+        last_id = nxt["list"][-1]["id"]
+    print(f"following (after record #{last_id}; ^C to stop)",
+          file=sys.stderr)
+    while True:
+        time.sleep(interval)
+        while True:      # drain bursts larger than one page
+            out = api.call("GET", "/v1/logs",
+                           dict(params, afterId=last_id, page=1,
+                                pageSize=500))
+            for r in out["list"]:
+                print(json.dumps(r) if as_json else _log_line(r),
+                      flush=True)
+                last_id = r["id"]
+            if len(out["list"]) < 500:
+                break
+
+
 def cmd_logs(api, args):
     params = {
         "node": args.node,
@@ -281,6 +324,17 @@ def cmd_logs(api, args):
         params["begin"] = parse_when(args.begin)
     if args.end:
         params["end"] = parse_when(args.end)
+    if args.follow:
+        if args.latest:
+            raise SystemExit("error: --follow cannot combine with "
+                             "--latest (the latest view has no cursor)")
+        params.pop("page", None)
+        params.pop("pageSize", None)
+        try:
+            _follow_logs(api, params, args.interval, args.json)
+        except KeyboardInterrupt:
+            pass
+        return
     out = api.call("GET", "/v1/logs", params)
     if args.json:
         print(json.dumps(out, indent=2))
@@ -306,6 +360,36 @@ def cmd_log(api, args):
     print(f"{'ended':>8}  {ts(r['endTime'])}")
     print("  output:")
     print(r.get("output") or "(empty)")
+
+
+def cmd_job_export(api, args):
+    """Full job definitions as a JSON array on stdout — the fleet's
+    desired state, re-loadable with `job import` (backup, migration,
+    code review of cron changes)."""
+    jobs = api.call("GET", "/v1/jobs", {"group": args.group})
+    for j in jobs:
+        j.pop("latest_status", None)     # derived, not desired state
+    json.dump(jobs, sys.stdout, indent=2)
+    print()
+
+
+def cmd_job_import(api, args):
+    jobs = _read_json_arg(args.file)
+    if not isinstance(jobs, list):
+        jobs = [jobs]
+    n = 0
+    for i, j in enumerate(jobs):
+        try:
+            out = api.call("PUT", "/v1/job", body=j)
+        except ApiError as e:
+            # job saves are idempotent upserts, so re-running the import
+            # after fixing the bad entry is safe
+            raise SystemExit(
+                f"error: entry #{i + 1} ({j.get('name', '?')!r}) refused: "
+                f"{e}\n{n} of {len(jobs)} imported before the failure")
+        n += 1
+        print(f"imported {out['group']}-{out['id']}  {j.get('name', '')}")
+    print(f"{n} job(s) imported")
 
 
 def cmd_nodes(api, args):
@@ -334,12 +418,8 @@ def cmd_group_get(api, args):
 
 
 def cmd_group_save(api, args):
-    if args.file == "-":
-        body = json.load(sys.stdin)
-    else:
-        with open(args.file) as f:
-            body = json.load(f)
-    out = api.call("PUT", "/v1/node/group", body=body)
+    out = api.call("PUT", "/v1/node/group",
+                   body=_read_json_arg(args.file))
     print(f"saved group {out.get('id')}")
 
 
@@ -353,8 +433,7 @@ def cmd_accounts(api, args):
     if args.json:
         print(json.dumps(out, indent=2))
         return
-    table([[a.get("email"),
-            "admin" if a.get("role") == 1 else "developer",
+    table([[a.get("email"), _role(a.get("role")),
             "enabled" if a.get("status") else "disabled"] for a in out],
           ["EMAIL", "ROLE", "STATUS"])
 
@@ -362,12 +441,11 @@ def cmd_accounts(api, args):
 def cmd_account_add(api, args):
     pw = args.password if args.password is not None else \
         getpass.getpass(f"password for new account {args.email}: ")
+    role = 1 if args.admin else 2
     api.call("PUT", "/v1/admin/account",
-             body={"email": args.email, "password": pw,
-                   "role": 1 if args.admin else 2,
+             body={"email": args.email, "password": pw, "role": role,
                    "status": 0 if args.disabled else 1})
-    print(f"created {args.email} "
-          f"({'admin' if args.admin else 'developer'})")
+    print(f"created {args.email} ({_role(role)})")
 
 
 def cmd_account_update(api, args):
@@ -461,6 +539,11 @@ def build_parser() -> argparse.ArgumentParser:
     jadd("nodes", cmd_job_nodes,
          "nodes a job resolves to (include ∪ groups − exclude)"
          ).add_argument("id")
+    p = jadd("export", cmd_job_export,
+             "dump all job definitions as JSON (re-loadable)")
+    p.add_argument("--group", default=None)
+    jadd("import", cmd_job_import,
+         "load jobs from a JSON array file (or -)").add_argument("file")
 
     p = add("run", cmd_run, "run a job immediately (bypasses schedule)")
     p.add_argument("id")
@@ -483,6 +566,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--end", default=None)
     p.add_argument("--page", type=positive_int, default=1)
     p.add_argument("--size", type=positive_int, default=50)
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="poll for new records and stream them (tail -f)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll interval seconds")
 
     add("log", cmd_log, "one execution record with output"
         ).add_argument("id", type=int)

@@ -20,7 +20,7 @@ import sys
 
 from .. import events, log
 from ..logsink import LogSinkServer
-from .common import base_parser, setup_common
+from .common import base_parser, server_tls, setup_common
 
 
 def main(argv=None) -> int:
@@ -49,7 +49,6 @@ def main(argv=None) -> int:
     cfg, ks, watcher = setup_common(args)
     token = cfg.log_token if args.token is None else args.token
 
-    from .common import server_tls
     sslctx = server_tls(cfg.log_tls, args.native, "cronsun-logd")
     rc = [0]
     if args.native:

@@ -15,7 +15,7 @@ import sys
 
 from .. import events, log
 from ..store.remote import StoreServer
-from .common import base_parser, setup_common
+from .common import base_parser, server_tls, setup_common
 
 
 def main(argv=None) -> int:
@@ -38,7 +38,6 @@ def main(argv=None) -> int:
     cfg, ks, watcher = setup_common(args)
 
     token = cfg.store_token if args.token is None else args.token
-    from .common import server_tls
     sslctx = server_tls(cfg.store_tls, args.native, "cronsun-store")
     rc = [0]
     if args.native:

@@ -150,10 +150,18 @@ class JobLogStore:
                    end: Optional[float] = None,
                    failed_only: bool = False,
                    latest: bool = False,
-                   page: int = 1, page_size: int = 50
+                   page: int = 1, page_size: int = 50,
+                   after_id: Optional[int] = None
                    ) -> Tuple[List[LogRecord], int]:
+        """``after_id`` switches to cursor mode: only rows with
+        ``id > after_id``, ordered by id ASCENDING — insertion order, so
+        a poller (cronsun-ctl logs --follow) never misses a record that
+        was inserted with an old begin_ts (ids are monotone; begin_ts is
+        not).  Ignored for the latest view, whose rows have no id."""
         table = "job_latest_log" if latest else "job_log"
         where, args = [], []
+        if after_id is not None and not latest:
+            where.append("id > ?"); args.append(int(after_id))
         if node:
             where.append("node = ?"); args.append(node)
         if job_ids:
@@ -183,9 +191,11 @@ class JobLogStore:
                 f"SELECT COUNT(*) c FROM {table}{cond}", args).fetchone()["c"]
             # tie order pinned explicitly (id ASC within equal begin_ts)
             # so the native backend can page identically
+            order = "id ASC" if (after_id is not None and not latest) else \
+                "begin_ts DESC" + (", id ASC" if not latest else "")
             rows = self._db.execute(
-                f"SELECT * FROM {table}{cond} ORDER BY begin_ts DESC"
-                f"{', id ASC' if not latest else ''} LIMIT ? OFFSET ?",
+                f"SELECT * FROM {table}{cond} ORDER BY {order} "
+                "LIMIT ? OFFSET ?",
                 args + [page_size, (page - 1) * page_size]).fetchall()
         return [self._row_to_rec(r, latest) for r in rows], total
 

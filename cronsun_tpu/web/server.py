@@ -356,7 +356,9 @@ class ApiServer:
             failed_only=ctx.q("failedOnly") in ("true", "1"),
             latest=ctx.q("latest") in ("true", "1"),
             page=int(ctx.q("page") or 1),
-            page_size=int(ctx.q("pageSize") or 50))
+            page_size=int(ctx.q("pageSize") or 50),
+            # cursor mode for pollers: id > afterId, ordered id ASC
+            after_id=int(ctx.q("afterId")) if ctx.q("afterId") else None)
         return {"total": total, "list": [self._log_dict(r) for r in recs]}
 
     @staticmethod

@@ -263,6 +263,7 @@ class LogStore {
          latest = false;
     double begin = 0, end = 0;
     long long page = 1, page_size = 50;
+    long long after_id = -1;   // >=0 => cursor mode: id>after_id, id ASC
     if (kw.t == JV::OBJ) {
       if (const JV* v = kw.get("node"))
         if (v->t == JV::STR) node = v->s;
@@ -281,8 +282,13 @@ class LogStore {
       if (const JV* v = kw.get("page")) page = std::max(1LL, v->as_int());
       if (const JV* v = kw.get("page_size"))
         page_size = std::max(1LL, std::min(500LL, v->as_int()));
+      if (const JV* v = kw.get("after_id"))
+        if (v->t == JV::INT || v->t == JV::DBL)
+          after_id = std::max(0LL, v->as_int());
     }
+    if (latest) after_id = -1;   // latest rows carry no id (joblog.py)
     auto match = [&](const Rec& r) {
+      if (after_id >= 0 && r.id <= after_id) return false;
       if (!node.empty() && r.node != node) return false;
       if (!job_ids.empty() &&
           std::find(job_ids.begin(), job_ids.end(), r.job_id) == job_ids.end())
@@ -304,11 +310,17 @@ class LogStore {
         if (match(r)) hits.push_back(&r);
     }
     // ORDER BY begin_ts DESC, id ASC — the tie order the SQLite backend
-    // pins explicitly; both backends must page identically
-    std::stable_sort(hits.begin(), hits.end(), [](const Rec* a, const Rec* b) {
-      if (a->begin != b->begin) return a->begin > b->begin;
-      return a->id < b->id;
-    });
+    // pins explicitly; both backends must page identically.  Cursor
+    // mode (after_id) orders by id ASC = insertion order instead.
+    if (after_id >= 0) {
+      std::stable_sort(hits.begin(), hits.end(),
+                       [](const Rec* a, const Rec* b) { return a->id < b->id; });
+    } else {
+      std::stable_sort(hits.begin(), hits.end(), [](const Rec* a, const Rec* b) {
+        if (a->begin != b->begin) return a->begin > b->begin;
+        return a->id < b->id;
+      });
+    }
     // clamp before multiplying: a huge client-supplied page must not
     // overflow signed arithmetic (UB), just return an empty page
     page = std::min(page, (long long)1 << 40);

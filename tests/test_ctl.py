@@ -235,3 +235,68 @@ def test_account_update_guards(world, capsys):
     capsys.readouterr()
     with pytest.raises(SystemExit):      # empty password = silent no-op
         run("account", "update", "g@x.io", "--password", "")
+
+
+def test_job_export_import_roundtrip(world, capsys, tmp_path):
+    """export -> wipe -> import restores the fleet's desired state,
+    including multi-rule jobs (the UI data-loss class of bug)."""
+    _, _, run = world
+    _login(run, capsys)
+    spec = tmp_path / "j.json"
+    spec.write_text(json.dumps({
+        "name": "multi", "group": "ops", "command": "echo m",
+        "rules": [{"timer": "0 0 3 * * *", "nids": ["a"]},
+                  {"timer": "0 30 14 * * *", "nids": ["b"],
+                   "exclude_nids": ["c"]}]}))
+    assert run("job", "save", str(spec)) == 0
+    jid = capsys.readouterr().out.split()[-1]
+
+    assert run("job", "export") == 0
+    dump = capsys.readouterr().out
+    jobs = json.loads(dump)
+    assert len(jobs) == 1 and len(jobs[0]["rules"]) == 2
+    assert "latest_status" not in jobs[0]
+
+    assert run("job", "rm", jid) == 0
+    capsys.readouterr()
+    exp = tmp_path / "dump.json"
+    exp.write_text(dump)
+    assert run("job", "import", str(exp)) == 0
+    out = capsys.readouterr().out
+    assert "1 job(s) imported" in out
+
+    assert run("job", "get", jid) == 0
+    restored = json.loads(capsys.readouterr().out)
+    assert [r["timer"] for r in restored["rules"]] == \
+        ["0 0 3 * * *", "0 30 14 * * *"]
+    assert restored["rules"][1]["exclude_nids"] == ["c"]
+
+
+def test_follow_logs_streams_new_records(world, capsys):
+    import threading
+    import time as _time
+    _, sink, run = world
+    _login(run, capsys)
+    sink.create_job_log(LogRecord(
+        job_id="f0", job_group="g", name="pre", node="n", user="",
+        command="true", output="", success=True,
+        begin_ts=100.0, end_ts=101.0))
+
+    def feed():
+        _time.sleep(0.4)
+        sink.create_job_log(LogRecord(
+            job_id="f1", job_group="g", name="fresh", node="n", user="",
+            command="true", output="", success=False,
+            begin_ts=200.0, end_ts=203.0))
+        _time.sleep(0.4)
+        # stop the follow loop from the outside
+        import _thread
+        _thread.interrupt_main()
+    t = threading.Thread(target=feed)
+    t.start()
+    rc = run("logs", "--follow", "--interval", "0.1")
+    t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "fresh" in out and "FAIL" in out
+    assert "pre" not in out          # only records after the HWM stream

@@ -466,3 +466,28 @@ def test_differential_fuzz_python_vs_native():
     finally:
         cp.close(); cn.close()
         py.stop(); nt.stop()
+
+
+def test_after_id_cursor(sink):
+    """Cursor mode (after_id): only rows above the id, ordered by id
+    ASCENDING (= insertion order) regardless of begin_ts — the contract
+    `cronsun-ctl logs --follow` relies on to never miss a long job's
+    record inserted with an old begin time.  All three backends."""
+    # insert out of begin_ts order: the "slow job" finishes last but
+    # STARTED first
+    ids = []
+    for begin in (500.0, 900.0, 100.0):
+        r = _rec(job=f"c{int(begin)}", begin=begin)
+        sink.create_job_log(r)
+        ids.append(r.id)
+    recs, total = sink.query_logs(after_id=ids[0])
+    assert total == 2
+    assert [r.id for r in recs] == [ids[1], ids[2]]     # id order,
+    assert [r.begin_ts for r in recs] == [900.0, 100.0]  # not begin order
+    # cursor past the end is empty; after_id=0 sees everything in order
+    assert sink.query_logs(after_id=ids[-1])[1] == 0
+    recs, _ = sink.query_logs(after_id=0)
+    assert [r.id for r in recs] == ids
+    # latest view ignores the cursor (its rows carry no id)
+    recs, lt = sink.query_logs(latest=True, after_id=10**9)
+    assert lt == 3
