@@ -164,6 +164,14 @@ def positive_int(s: str) -> int:
     return v
 
 
+def poll_interval(s: str) -> float:
+    v = float(s)
+    if v < 0.1:
+        raise argparse.ArgumentTypeError(
+            "must be >= 0.1 (don't busy-loop the result store)")
+    return v
+
+
 KINDS = {0: "Common", 1: "Alone", 2: "Interval"}
 
 
@@ -379,6 +387,11 @@ def cmd_job_import(api, args):
         jobs = [jobs]
     n = 0
     for i, j in enumerate(jobs):
+        if not isinstance(j, dict):
+            raise SystemExit(
+                f"error: entry #{i + 1} is not a job object "
+                f"({type(j).__name__})\n{n} of {len(jobs)} imported "
+                "before the failure")
         try:
             out = api.call("PUT", "/v1/job", body=j)
         except ApiError as e:
@@ -568,8 +581,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--size", type=positive_int, default=50)
     p.add_argument("--follow", "-f", action="store_true",
                    help="poll for new records and stream them (tail -f)")
-    p.add_argument("--interval", type=float, default=2.0,
-                   help="--follow poll interval seconds")
+    p.add_argument("--interval", type=poll_interval, default=2.0,
+                   help="--follow poll interval seconds (>= 0.1)")
 
     add("log", cmd_log, "one execution record with output"
         ).add_argument("id", type=int)

@@ -284,6 +284,18 @@ class _ShardedPlannerBase:
         self.load = jax.device_put(np.zeros(self.N, np.float32), self._repl)
         self.rem_cap = jax.device_put(np.zeros(self.N, np.int32), self._repl)
         self._step_cache = {}
+        # multi-host meshes (jax.distributed over DCN / Gloo): per-shard
+        # plan outputs span non-addressable devices, so fetching them
+        # needs a cross-process allgather; single-host fetches stay a
+        # plain device read
+        self._multiprocess = jax.process_count() > 1
+
+    def _fetch(self, arr) -> np.ndarray:
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(
+                arr, tiled=True))
+        return np.asarray(arr)
 
     def _step(self, k_local: int, impl: str):
         key = (k_local, impl)
@@ -397,7 +409,7 @@ class _ShardedPlannerBase:
         out, self.load, self.rem_cap = self._step(k_local, impl)(
             self.table, jax.device_put(fields, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(out)              # [3, Dj*k_local]
+        o = self._fetch(out)             # [3, Dj*k_local]
         return self._decode(o, epoch_s, k_local)
 
     def _window_step(self, k_local: int, impl: str):
@@ -430,7 +442,7 @@ class _ShardedPlannerBase:
         outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
             self.table, jax.device_put(fields_w, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
-        o = np.asarray(outs)             # [W, 3, Dj*k_local]
+        o = self._fetch(outs)            # [W, 3, Dj*k_local]
         return [self._decode(o[w], epoch_s + w, k_local)
                 for w in range(window_s)]
 

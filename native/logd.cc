@@ -301,25 +301,34 @@ class LogStore {
     };
 
     std::lock_guard<std::mutex> g(mu);
+    auto sort_begin_desc = [](std::vector<const Rec*>& v) {
+      // ORDER BY begin_ts DESC, id ASC — the tie order the SQLite
+      // backend pins explicitly; both backends must page identically
+      std::stable_sort(v.begin(), v.end(), [](const Rec* a, const Rec* b) {
+        if (a->begin != b->begin) return a->begin > b->begin;
+        return a->id < b->id;
+      });
+    };
     std::vector<const Rec*> hits;
     if (latest) {
       for (const auto& [k, r] : latest_)
         if (match(r)) hits.push_back(&r);
+      sort_begin_desc(hits);
+    } else if (after_id >= 0) {
+      // cursor mode: ids are contiguous (retention only pops the
+      // front — same invariant get_log exploits), so a poller's
+      // id > after_id is an index jump, and deque iteration order IS
+      // id ASC — a follow poll costs O(new records), not O(store)
+      size_t start = 0;
+      if (!recs_.empty() && after_id >= recs_.front().id)
+        start = (size_t)std::min<long long>(
+            after_id - recs_.front().id + 1, (long long)recs_.size());
+      for (size_t i = start; i < recs_.size(); i++)
+        if (match(recs_[i])) hits.push_back(&recs_[i]);
     } else {
       for (const Rec& r : recs_)
         if (match(r)) hits.push_back(&r);
-    }
-    // ORDER BY begin_ts DESC, id ASC — the tie order the SQLite backend
-    // pins explicitly; both backends must page identically.  Cursor
-    // mode (after_id) orders by id ASC = insertion order instead.
-    if (after_id >= 0) {
-      std::stable_sort(hits.begin(), hits.end(),
-                       [](const Rec* a, const Rec* b) { return a->id < b->id; });
-    } else {
-      std::stable_sort(hits.begin(), hits.end(), [](const Rec* a, const Rec* b) {
-        if (a->begin != b->begin) return a->begin > b->begin;
-        return a->id < b->id;
-      });
+      sort_begin_desc(hits);
     }
     // clamp before multiplying: a huge client-supplied page must not
     // overflow signed arithmetic (UB), just return an empty page

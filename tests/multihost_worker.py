@@ -1,0 +1,54 @@
+"""One process of a multi-host sharded-planner run (CPU, Gloo backend).
+
+Test helper for tests/test_multihost.py — runs the SAME ShardedTickPlanner
+the scheduler deploys, but over a GLOBAL mesh assembled by
+jax.distributed from several OS processes (the DCN topology of
+SURVEY §2.7: multi-host scale-out with cross-host collectives).
+
+Usage: multihost_worker.py PROC_ID NPROCS DEVS_PER_PROC PORT
+Builds the GLOBAL 1-D jobs mesh (nprocs x devs_per_proc devices), runs
+the fused windowed plan, prints one line per window second:
+  FIRED <sec> <comma-joined sorted fired job rows>
+With nprocs=1 this is the single-host reference for the same topology.
+"""
+import os, sys
+pid, nprocs, dpp, port = map(int, sys.argv[1:5])
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={dpp}"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+from cronsun_tpu.ops.schedule_table import ScheduleTable
+
+N, W = 64, 4
+T0 = 1_753_000_000
+mesh = make_mesh(nprocs * dpp)
+p = ShardedTickPlanner(mesh, job_capacity=512, node_capacity=N,
+                       max_fire_bucket=1024)
+J = p.J
+rng = np.random.default_rng(7)
+cols = dict(
+    sec_lo=np.zeros(J, np.uint32), sec_hi=np.zeros(J, np.uint32),
+    min_lo=np.zeros(J, np.uint32), min_hi=np.zeros(J, np.uint32),
+    hour=np.zeros(J, np.uint32), dom=np.zeros(J, np.uint32),
+    month=np.zeros(J, np.uint32), dow=np.zeros(J, np.uint32),
+    dom_star=np.zeros(J, bool), dow_star=np.zeros(J, bool),
+    is_every=np.ones(J, bool),
+    period=rng.integers(2, 9, J).astype(np.int32),
+    phase_mod=rng.integers(0, 3, J).astype(np.int32),
+    active=np.ones(J, bool), paused=np.zeros(J, bool))
+p.set_table(ScheduleTable(**{k: jnp.asarray(v) for k, v in cols.items()}))
+p.set_eligibility(np.full((J, N // 32), 0xFFFFFFFF, np.uint32))
+p.set_job_meta_full(rng.random(J) < 0.5, np.ones(J, np.float32))
+p.set_node_capacity_full(np.full(N, 1 << 20, np.int64))
+plans = p.plan_window(T0, W)
+for w, plan in enumerate(plans):
+    fired = ",".join(map(str, sorted(int(j) for j in plan.fired)))
+    print(f"FIRED {T0 + w} {fired}", flush=True)
+print("DONE", flush=True)

@@ -425,6 +425,10 @@ def test_differential_fuzz_python_vs_native():
                     kw["failed_only"] = True
                 if rng.random() < 0.3:
                     kw["latest"] = True
+                if rng.random() < 0.3:
+                    # cursor mode must agree byte for byte too (ordering
+                    # flips to id ASC; ignored under latest)
+                    kw["after_id"] = rng.randrange(0, 60)
                 kw["page"] = rng.randrange(1, 4)
                 kw["page_size"] = rng.randrange(1, 30)
                 (ra, ta), (rb, tb) = both(lambda c: c.query_logs(**kw))
