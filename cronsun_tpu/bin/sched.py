@@ -22,7 +22,31 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", type=int, default=0, metavar="D",
                     help="shard the planner over a D-device jobs mesh "
                          "(0 = single chip)")
+    ap.add_argument("--mesh-hosts", type=int, default=1, metavar="N",
+                    help="multi-host mesh: total participating processes "
+                         "(jax.distributed; see --mesh-proc-id)")
+    ap.add_argument("--mesh-proc-id", type=int, default=0, metavar="I",
+                    help="this process's rank; 0 leads (store + dispatch), "
+                         ">0 runs as a mesh worker joining the leader's "
+                         "collective plans (no store connection)")
+    ap.add_argument("--mesh-coordinator", default="127.0.0.1:8476",
+                    metavar="H:P", help="jax.distributed coordinator "
+                                        "(rank 0's address)")
     args = ap.parse_args(argv)
+    if args.mesh_hosts > 1:
+        # flag errors must surface BEFORE initialize: it blocks waiting
+        # for every rank, and a rank that errors out after connecting
+        # would leave the others wedged in the first collective
+        if args.mesh < 2:
+            print("error: --mesh-hosts requires --mesh D (global device "
+                  "count)", file=sys.stderr)
+            return 2
+        # must run before any device use; the global mesh assembles every
+        # host's local devices (ICI within a host, DCN between hosts)
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=args.mesh_coordinator,
+            num_processes=args.mesh_hosts, process_id=args.mesh_proc_id)
     cfg, ks, watcher = setup_common(args)
     if args.profile_port:
         import jax
@@ -33,7 +57,6 @@ def main(argv=None) -> int:
     if cfg.timezone and cfg.timezone.upper() != "UTC":
         from zoneinfo import ZoneInfo
         tz = ZoneInfo(cfg.timezone)
-    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
     planner = None
     if args.mesh > 1:
         from ..parallel.mesh import ShardedTickPlanner, make_mesh
@@ -41,6 +64,25 @@ def main(argv=None) -> int:
             make_mesh(args.mesh), job_capacity=cfg.job_capacity,
             node_capacity=cfg.node_capacity, tz=tz)
         log.infof("planner sharded over %d devices", args.mesh)
+    if args.mesh_hosts > 1 and args.mesh_proc_id > 0:
+        # mesh worker: no store, no leadership — replay the leader's
+        # broadcast deltas and join its collective plans until told to
+        # stop (parallel/hostsync.py documents the protocol)
+        from ..parallel.hostsync import run_worker
+        log.infof("mesh worker %d/%d up (coordinator %s)",
+                  args.mesh_proc_id, args.mesh_hosts,
+                  args.mesh_coordinator)
+        print(f"READY mesh-worker-{args.mesh_proc_id}", flush=True)
+        steps = run_worker(planner)
+        log.infof("mesh worker released after %d plan steps", steps)
+        return 0
+    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
+    sync_proxy = None
+    if args.mesh_hosts > 1:
+        from ..parallel.hostsync import PlannerSyncProxy
+        planner = sync_proxy = PlannerSyncProxy(planner)
+        log.infof("mesh leader: broadcasting plan deltas to %d workers",
+                  args.mesh_hosts - 1)
     sched = SchedulerService(
         store, ks=ks, job_capacity=cfg.job_capacity,
         node_capacity=cfg.node_capacity, window_s=cfg.window_s,
@@ -50,7 +92,13 @@ def main(argv=None) -> int:
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)
     print(f"READY {args.node_id}", flush=True)
-    events.on(events.EXIT, sched.stop, store.close)
+    if sync_proxy is not None:
+        # stop order matters: join the service loop FIRST so no plan
+        # broadcast can interleave with the workers' release
+        events.on(events.EXIT, sched.stop, sync_proxy.shutdown_workers,
+                  store.close)
+    else:
+        events.on(events.EXIT, sched.stop, store.close)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()

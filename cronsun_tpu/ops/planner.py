@@ -226,6 +226,16 @@ class TickPlanner:
             raise ValueError(f"table capacity {table.capacity} != {self.J}")
         self.table = table
 
+    def update_table_rows(self, rows: np.ndarray, vals) -> None:
+        """Scatter schedule-row updates — the planner-agnostic mutator
+        the scheduler (and the mesh-sync replay) drive; subclasses
+        re-pin sharding in their set_table."""
+        from .schedule_table import update_rows
+        self.set_table(update_rows(self.table, rows, vals))
+
+    def set_load(self, loads: np.ndarray) -> None:
+        self.load = jnp.asarray(np.asarray(loads, np.float32))
+
     def set_eligibility_rows(self, rows: np.ndarray, values: np.ndarray):
         if len(rows):
             self.elig = self.elig.at[jnp.asarray(rows)].set(jnp.asarray(values))

@@ -1,0 +1,155 @@
+"""Multi-host scheduling: one leader process drives the store; worker
+processes hold their shards of the global mesh and join the collective
+plan calls in lockstep.
+
+The consistency problem multi-host SPMD creates: every process must call
+``plan_window`` with bit-identical logical state or the collectives
+exchange garbage.  Watching the store independently on each process
+cannot guarantee that (watch delivery is asynchronous).  This module
+solves it by construction — workers have NO store connection at all:
+
+- the leader wraps its planner in :class:`PlannerSyncProxy`, which
+  records every state mutation (the five setter ops the
+  SchedulerService drives) and, at each ``plan_window``, broadcasts the
+  op log + (epoch, window) to all processes
+  (``multihost_utils.broadcast_one_to_all`` — Gloo/DCN collectives);
+- each worker replays the identical ops on its local shard of the SAME
+  sharded planner and calls ``plan_window`` with the broadcast args,
+  joining the collectives; its outputs are discarded (the leader alone
+  talks to the store and dispatches).
+
+Determinism is inherited, not negotiated: workers see exactly the
+mutations the leader applied, in order.  Leader and workers must be
+launched with the SAME planner capacities (job_capacity /
+node_capacity / window — the conf file): they shape the compiled SPMD
+program, and mismatched shapes wedge the collectives.  A worker that dies stalls the
+collective — run workers under the same supervision as the leader and
+size ``lease_ttl`` so a standby (single-host) scheduler can take over
+if the mesh wedges; this mode trades availability for capacity, the
+standard SPMD bargain.
+
+Wire format per sync point: one int64 header [n_bytes, epoch, window,
+stop, sla_bucket] then an uint8 payload (pickled op list).  Two collectives per
+planning step; payload size is churn-bound (empty fleet: ~10 bytes).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Tuple
+
+import numpy as np
+
+from .. import log
+
+_OPS = ("update_table_rows", "set_eligibility_rows", "set_job_meta",
+        "set_node_capacity", "set_load")
+
+
+def _apply(planner, ops) -> None:
+    """Replay a recorded op log — THE application point for leader and
+    workers alike.  Some planner mutations are themselves collective
+    (jax.device_put onto a multi-process sharding runs an internal
+    cross-process assert), so every process must execute the log at the
+    same protocol point, in the same order; the leader applying eagerly
+    at record time wedged exactly there."""
+    for op, args in ops:
+        if op not in _OPS:               # defense against version skew
+            raise RuntimeError(f"unknown sync op {op!r}")
+        getattr(planner, op)(*args)
+
+
+def _broadcast(header: np.ndarray, payload: np.ndarray,
+               is_leader: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Two-phase broadcast: fixed-shape header first (it carries the
+    payload length), then the payload.  Every process calls this with
+    the same shapes; non-leaders' inputs are ignored."""
+    from jax.experimental import multihost_utils as mhu
+    log.debugf("hostsync: %s header barrier enter",
+               "lead" if is_leader else "worker")
+    header = np.asarray(mhu.broadcast_one_to_all(header,
+                                                 is_source=is_leader))
+    n = int(header[0])
+    log.debugf("hostsync: header done (%d payload bytes)", n)
+    if not is_leader:
+        payload = np.zeros(n, np.uint8)
+    payload = payload[:n] if len(payload) >= n else \
+        np.concatenate([payload, np.zeros(n - len(payload), np.uint8)])
+    if n:
+        payload = np.asarray(mhu.broadcast_one_to_all(
+            payload, is_source=is_leader))
+    return header, payload
+
+
+class PlannerSyncProxy:
+    """Leader-side wrapper: records mutations (WITHOUT applying them)
+    and, at each plan, broadcasts the log then applies it locally — the
+    exact sequence workers run, so the collectives hidden inside the
+    mutations pair one-to-one across processes.  Duck-compatible with
+    the planner surface SchedulerService uses (which writes planner
+    state and plans, but never reads back between the two)."""
+
+    def __init__(self, planner):
+        self._planner = planner
+        self._log: List[tuple] = []
+
+    def __getattr__(self, name):
+        # reads (N, J, table, ...) and any un-logged method pass through
+        return getattr(self._planner, name)
+
+    def _record(self, op, *args):
+        self._log.append((op, args))
+
+    # the mutator surface (see _OPS) — explicit defs, not loops, so the
+    # proxy's API is grep-able next to the planner's
+    def update_table_rows(self, rows, vals):
+        return self._record("update_table_rows", rows, vals)
+
+    def set_eligibility_rows(self, rows, values):
+        return self._record("set_eligibility_rows", rows, values)
+
+    def set_job_meta(self, rows, exclusive, cost):
+        return self._record("set_job_meta", rows, exclusive, cost)
+
+    def set_node_capacity(self, cols, caps):
+        return self._record("set_node_capacity", list(cols), list(caps))
+
+    def set_load(self, loads):
+        return self._record("set_load", np.asarray(loads))
+
+    def plan_window(self, epoch_s: int, window_s: int, sla_bucket=None):
+        # sla_bucket shapes the compiled program (k_local) — it rides
+        # the header so every process resolves the same executable
+        ops, self._log = self._log, []
+        payload = pickle.dumps(ops, protocol=4)
+        header = np.array([len(payload), epoch_s, window_s, 0,
+                           -1 if sla_bucket is None else int(sla_bucket)],
+                          np.int64)
+        _broadcast(header, np.frombuffer(payload, np.uint8), True)
+        _apply(self._planner, ops)
+        return self._planner.plan_window(epoch_s, window_s,
+                                         sla_bucket=sla_bucket)
+
+    def shutdown_workers(self):
+        """Release the worker loops (they exit instead of waiting on a
+        collective that will never come)."""
+        header = np.array([0, 0, 0, 1, -1], np.int64)
+        _broadcast(header, np.zeros(0, np.uint8), True)
+
+
+def run_worker(planner, on_step=None) -> int:
+    """Worker loop: replay broadcast mutations, join each collective
+    plan, discard outputs.  Returns the number of plan steps joined."""
+    steps = 0
+    while True:
+        header, payload = _broadcast(np.zeros(5, np.int64),
+                                     np.zeros(0, np.uint8), False)
+        n_bytes, epoch, window, stop, sla = (int(x) for x in header)
+        if stop:
+            return steps
+        _apply(planner, pickle.loads(payload.tobytes()))
+        planner.plan_window(epoch, window,
+                            sla_bucket=None if sla < 0 else sla)
+        steps += 1
+        if on_step is not None:
+            on_step(steps, epoch)

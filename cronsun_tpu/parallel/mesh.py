@@ -318,6 +318,16 @@ class _ShardedPlannerBase:
         self.table = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._shard), table)
 
+    def update_table_rows(self, rows: np.ndarray, vals) -> None:
+        """Same contract as TickPlanner.update_table_rows; set_table
+        re-pins the canonical sharding."""
+        from ..ops.schedule_table import update_rows
+        self.set_table(update_rows(self.table, rows, vals))
+
+    def set_load(self, loads: np.ndarray) -> None:
+        self.load = jax.device_put(
+            np.asarray(loads, np.float32), self._repl)
+
     def set_eligibility(self, matrix: np.ndarray):
         self.elig = jax.device_put(matrix, self._shard2)
 

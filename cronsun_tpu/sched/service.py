@@ -36,7 +36,7 @@ from ..core.models import KIND_ALONE
 from ..cron.parser import ParseError, parse
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
-from ..ops.schedule_table import make_row, update_rows, _INACTIVE_ROW
+from ..ops.schedule_table import make_row, _INACTIVE_ROW
 from ..store.memstore import DELETE, MemStore, WatchLost
 
 
@@ -421,7 +421,7 @@ class SchedulerService:
         if self._table_updates:
             rows = np.array(sorted(self._table_updates), dtype=np.int32)
             vals = [self._table_updates[int(r)] for r in rows]
-            self.planner.set_table(update_rows(self.planner.table, rows, vals))
+            self.planner.update_table_rows(rows, vals)
             self._table_updates.clear()
         dirty, mat = self.builder.dirty_rows()
         if len(dirty):
@@ -468,8 +468,7 @@ class SchedulerService:
             loads[col] = running_load.get(node_id, 0.0)
         if cols:
             self.planner.set_node_capacity(cols, caps)
-        import jax.numpy as jnp
-        self.planner.load = jnp.asarray(loads)
+        self.planner.set_load(loads)
 
     # ---- planning + dispatch --------------------------------------------
 
