@@ -22,6 +22,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", type=int, default=0, metavar="D",
                     help="shard the planner over a D-device jobs mesh "
                          "(0 = single chip)")
+    ap.add_argument("--mesh2d", default=None, metavar="DJxDN",
+                    help="2-D (jobs x nodes) mesh instead of --mesh, "
+                         "e.g. 4x2 — for fleets whose bitpacked "
+                         "eligibility exceeds jobs-sharded HBM")
     ap.add_argument("--mesh-hosts", type=int, default=1, metavar="N",
                     help="multi-host mesh: total participating processes "
                          "(jax.distributed; see --mesh-proc-id)")
@@ -33,13 +37,27 @@ def main(argv=None) -> int:
                     metavar="H:P", help="jax.distributed coordinator "
                                         "(rank 0's address)")
     args = ap.parse_args(argv)
+    if args.mesh2d is not None:
+        try:
+            dj, dn = (int(x) for x in args.mesh2d.lower().split("x"))
+        except ValueError:
+            dj = dn = 0
+        if dj < 1 or dn < 1:
+            print("error: --mesh2d wants DJxDN with both >= 1 (e.g. 4x2)",
+                  file=sys.stderr)
+            return 2
+        if args.mesh:
+            print("error: --mesh and --mesh2d are mutually exclusive",
+                  file=sys.stderr)
+            return 2
+        args.mesh = dj * dn
     if args.mesh_hosts > 1:
         # flag errors must surface BEFORE initialize: it blocks waiting
         # for every rank, and a rank that errors out after connecting
         # would leave the others wedged in the first collective
         if args.mesh < 2:
-            print("error: --mesh-hosts requires --mesh D (global device "
-                  "count)", file=sys.stderr)
+            print("error: --mesh-hosts requires --mesh D or --mesh2d "
+                  "DJxDN (global device count)", file=sys.stderr)
             return 2
         # must run before any device use; the global mesh assembles every
         # host's local devices (ICI within a host, DCN between hosts)
@@ -58,7 +76,14 @@ def main(argv=None) -> int:
         from zoneinfo import ZoneInfo
         tz = ZoneInfo(cfg.timezone)
     planner = None
-    if args.mesh > 1:
+    if args.mesh2d is not None:
+        from ..parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+        planner = Sharded2DTickPlanner(
+            make_mesh2d(dj, dn), job_capacity=cfg.job_capacity,
+            node_capacity=cfg.node_capacity, tz=tz)
+        log.infof("planner sharded over a %dx%d (jobs x nodes) mesh",
+                  dj, dn)
+    elif args.mesh > 1:
         from ..parallel.mesh import ShardedTickPlanner, make_mesh
         planner = ShardedTickPlanner(
             make_mesh(args.mesh), job_capacity=cfg.job_capacity,
@@ -67,7 +92,15 @@ def main(argv=None) -> int:
     if args.mesh_hosts > 1 and args.mesh_proc_id > 0:
         # mesh worker: no store, no leadership — replay the leader's
         # broadcast deltas and join its collective plans until told to
-        # stop (parallel/hostsync.py documents the protocol)
+        # stop (parallel/hostsync.py documents the protocol).
+        # SIGTERM/SIGINT are IGNORED: under common supervision every
+        # rank gets the signal at once, and a worker dying mid-plan
+        # wedges the leader's shutdown collective — the worker's stop
+        # is the leader's release broadcast (and if the leader dies
+        # uncleanly, jax's coordination service terminates the workers)
+        import signal as _signal
+        _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
+        _signal.signal(_signal.SIGINT, _signal.SIG_IGN)
         from ..parallel.hostsync import run_worker
         log.infof("mesh worker %d/%d up (coordinator %s)",
                   args.mesh_proc_id, args.mesh_hosts,

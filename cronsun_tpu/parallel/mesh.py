@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.assign import _steps, waterfill_accept
-from ..ops.planner import TickPlan, _compact, _next_pow2
+from ..ops.planner import TickPlan, TickPlanner, _compact, _next_pow2
 from ..ops.schedule_table import FRAMEWORK_EPOCH, ScheduleTable
 from ..ops.tick import _fire_mask_jit
 from ..ops.timecal import window_fields
@@ -318,15 +318,13 @@ class _ShardedPlannerBase:
         self.table = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._shard), table)
 
-    def update_table_rows(self, rows: np.ndarray, vals) -> None:
-        """Same contract as TickPlanner.update_table_rows; set_table
-        re-pins the canonical sharding."""
-        from ..ops.schedule_table import update_rows
-        self.set_table(update_rows(self.table, rows, vals))
+    # one definition, two planners: set_table is the polymorphic point
+    # (it re-pins the canonical sharding here), and the hostsync op-log
+    # replay depends on both classes agreeing on this contract
+    update_table_rows = TickPlanner.update_table_rows
 
     def set_load(self, loads: np.ndarray) -> None:
-        self.load = jax.device_put(
-            np.asarray(loads, np.float32), self._repl)
+        self.load = np.asarray(loads, np.float32)   # setter re-pins
 
     def set_eligibility(self, matrix: np.ndarray):
         self.elig = jax.device_put(matrix, self._shard2)

@@ -59,13 +59,17 @@ def test_two_process_mesh_matches_single_host():
     assert mh[0] == ref, "multi-host plan diverged from single-host"
 
 
-def test_mesh_worker_mode_end_to_end():
+@pytest.mark.parametrize("mesh_flags", [("--mesh", "8"),
+                                        ("--mesh2d", "4x2")])
+def test_mesh_worker_mode_end_to_end(mesh_flags):
     """The deployable multi-host mode: cronsun-sched rank 0 leads
     (store + dispatch) while rank 1 joins its collective plans as a
     mesh worker with NO store connection (parallel/hostsync.py).  A job
     written to the store must come out as dispatch orders planned over
-    the 2-process global mesh, and SIGTERMing the leader must release
-    the worker cleanly."""
+    the 2-process global mesh — on the 1-D jobs mesh AND the 2-D
+    (jobs x nodes) mesh — live job churn must flow through the
+    broadcast delta replay, and SIGTERMing the leader must release the
+    worker cleanly."""
     import json
     import signal
     import time
@@ -120,7 +124,7 @@ def test_mesh_worker_mode_end_to_end():
         store_p = spawn(["cronsun_tpu.bin.store", "--port", "0"])
         procs.append(store_p)
         addr = await_ready(store_p)
-        common = ["cronsun_tpu.bin.sched", "--store", addr, "--mesh", "8",
+        common = ["cronsun_tpu.bin.sched", "--store", addr, *mesh_flags,
                   "--mesh-hosts", "2", "--mesh-coordinator", coord,
                   "--conf", conf.name]
         leader = spawn(common + ["--mesh-proc-id", "0",
@@ -143,7 +147,9 @@ def test_mesh_worker_mode_end_to_end():
         c.put(ks.job_key("g", "mh1"), job.to_json())
 
         # orders planned over the 2-process mesh land in the store
-        deadline = time.time() + 90
+        # (generous: on a loaded 1-core box the first SPMD compile of
+        # both ranks shares the core with everything else)
+        deadline = time.time() + 150
         n_orders = 0
         while time.time() < deadline and n_orders < 3:
             n_orders = c.count_prefix(ks.dispatch_all)
@@ -151,6 +157,32 @@ def test_mesh_worker_mode_end_to_end():
         assert n_orders >= 3, \
             "no dispatch orders from the multi-host planner"
 
+        # live churn: a job update must flow through the broadcast op
+        # log (update_table_rows/set_* replayed on the worker) without
+        # wedging the mesh — the planner keeps planning afterwards
+        job.rules[0].timer = "*/2 * * * * *"
+        job.name = "mesh-job-v2"
+        c.put(ks.job_key("g", "mh1"), job.to_json())
+        c.put(ks.job_key("g", "mh2"), Job(
+            id="mh2", group="g", name="second", command="echo 2", kind=0,
+            rules=[JobRule(id="r1", timer="*/3 * * * * *",
+                           nids=["w1", "w2"])]).to_json())
+        deadline = time.time() + 90
+        saw_mh2 = False
+        while time.time() < deadline and not saw_mh2:
+            saw_mh2 = any(kv.key.endswith("/g/mh2")
+                          for kv in c.get_prefix(ks.dispatch_all))
+            time.sleep(0.5)
+        assert saw_mh2, ("the churned-in job never got planned — the "
+                         "broadcast delta replay stalled the mesh")
+
+        # common-supervision semantics: SIGTERM hits every rank at once;
+        # the worker must IGNORE it (dying mid-plan would wedge the
+        # leader's shutdown collective) and exit via the release
+        # broadcast instead
+        worker.send_signal(signal.SIGTERM)
+        time.sleep(1.0)
+        assert worker.poll() is None, "worker died on SIGTERM"
         # clean shutdown: leader releases the worker on its way out
         leader.send_signal(signal.SIGTERM)
         assert leader.wait(timeout=30) == 0
