@@ -155,6 +155,55 @@ def main():
     detail["kernels_equal"] = kernels_equal
     log(f"kernels_equal={kernels_equal} rtt_floor={detail['rtt_floor_ms']}ms")
 
+    # Per-kernel device time, pallas vs jnp, net of the link, at BOTH
+    # sides of the impl="auto" threshold (assign.choose_impl): time a
+    # jit of n chained applications (inputs varied per iteration so XLA
+    # cannot hoist the unpack) for two n and difference out the RTT.
+    # Rounds interleave all measurements so chip/link drift cancels;
+    # min-per-quantity is the right estimator for fixed compute +
+    # one-sided noise.
+    import functools
+
+    def chained(fn, reduce_out):
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def run(packed, aux, n):
+            def body(i, acc):
+                out = fn(packed ^ jnp.uint32(i), aux)
+                return acc + reduce_out(out) * 1e-30
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+        return run
+
+    NBIG = 201
+    scales = [("", 10240)] + ([] if quick else [("_wide", 102400)])
+    runners = {}
+    for suffix, n_nodes in scales:
+        kp = jax.random.bits(jax.random.PRNGKey(7), (2048, n_nodes // 32),
+                             dtype=jnp.uint32)
+        ld = jnp.asarray(rng.integers(0, 4, n_nodes).astype(np.float32))
+        wt = jnp.asarray(rng.random(2048).astype(np.float32))
+        for impl, bid_f, fan_f in (("pallas", bid_argmin, fanout_add),
+                                   ("jnp", _bid_jnp, _fanout_jnp)):
+            runners[f"bid{suffix}_{impl}"] = (
+                chained(bid_f, lambda o: jnp.sum(o[0])), kp, ld)
+            runners[f"fanout{suffix}_{impl}"] = (
+                chained(fan_f, jnp.sum), kp, wt)
+    for r, a, b in runners.values():                    # compile both n
+        np.asarray(r(a, b, 1))
+        np.asarray(r(a, b, NBIG))
+    kbest = {(k, n): np.inf for k in runners for n in (1, NBIG)}
+    for _ in range(3 if quick else 5):
+        for k, (r, a, b) in runners.items():
+            for n in (1, NBIG):
+                s = time.time()
+                np.asarray(r(a, b, n))
+                kbest[(k, n)] = min(kbest[(k, n)], time.time() - s)
+    for name in runners:
+        detail[f"kernel_{name}_ms"] = round(
+            max(0.0, kbest[(name, NBIG)] - kbest[(name, 1)])
+            * 1000 / (NBIG - 1), 3)
+    log("kernel ms/call: " + " ".join(
+        f"{k}={detail[f'kernel_{k}_ms']}" for k in sorted(runners)))
+
     # ---- config 1: 100-job single-node tick --------------------------------
     log("config 1: 100-job single-node tick")
     p1 = TickPlanner(job_capacity=128, node_capacity=32, max_fire_bucket=128)

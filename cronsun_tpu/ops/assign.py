@@ -97,9 +97,33 @@ def _fanout_jnp(packed, w):
 def _steps(impl: str):
     if impl == "jnp":
         return _bid_jnp, _fanout_jnp
+    if impl == "mixed":
+        # the measured-on-v5e sweet spot below ~32k nodes/device: the
+        # bid rides the MXU einsum (slightly faster while the [K, N]
+        # score tile is cheap), the fanout stays on the bit-plane
+        # kernel (3-50x faster at every scale — its jnp fallback
+        # materializes the dense matrix just to weigh it once)
+        return _bid_jnp, fanout_add
     interp = impl == "interpret"
     return (functools.partial(bid_argmin, interpret=interp),
             functools.partial(fanout_add, interpret=interp))
+
+
+def choose_impl(n_per_device: int, *bucket_ks: int) -> str:
+    """THE auto heuristic, shared by assign(), TickPlanner and the mesh
+    planners (three hand-rolled copies drifted once already).  Measured
+    on v5e (bench.py kernel_*_ms): the jnp/MXU bid wins wherever its
+    [K, N] f32 score tile is affordable, while the bit-plane pallas
+    fanout wins at scale (its jnp fallback materializes the dense
+    matrix just to weigh it once) — so "mixed" is the default.  Past
+    ~2 GB of score tile the pallas bid takes over: not for speed but to
+    BOUND memory next to 1M-row schedule state.  Everything falls back
+    to jnp off-TPU or when a bucket breaks the 256-row alignment the
+    kernels require."""
+    if jax.default_backend() != "tpu" or any(k % _TJ for k in bucket_ks):
+        return "jnp"
+    tile_bytes = max(bucket_ks, default=0) * n_per_device * 4
+    return "pallas" if tile_bytes > (2 << 30) else "mixed"
 
 
 def _rank_within_choice(key: jax.Array):
@@ -231,13 +255,13 @@ def assign(fire: jax.Array, elig_packed: jax.Array, exclusive: jax.Array,
         dead columns); cost: [K] f32 per-job expected cost (the reference's
         AvgTime EWMA, job.go:581-589).
       rounds: bid/accept rounds.
-      impl: "auto" (pallas on TPU, jnp elsewhere), "pallas", "jnp", or
-        "interpret" (pallas interpreter — tests).
+      impl: "auto" (choose_impl's measured heuristic), "pallas", "jnp",
+        "mixed" (jnp bid + pallas fanout), or "interpret" (pallas
+        interpreter — tests).
 
     Returns: (assigned [K] i32 node column or -1, new load, new rem_cap).
     """
     if impl == "auto":
-        impl = ("pallas" if jax.default_backend() == "tpu"
-                and fire.shape[0] % _TJ == 0 else "jnp")
+        impl = choose_impl(elig_packed.shape[1] * 32, fire.shape[0])
     return _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
                         rounds, impl)

@@ -14,11 +14,23 @@ across lanes), the kernel iterates b = 0..31; at each step
 ``w*32+b``, so per-node operands (loads) are passed pre-transposed as
 [32, W32] planes.  All plane ops are native VPU shapes.
 
+Both kernels tile the NODE axis as well (``_TW`` words per program) and
+accumulate across node tiles in their output blocks — without this the
+whole [TJ, W32] row must fit scoped VMEM, which OOMs around N ≈ 64k
+(measured: 20.8 MB needed vs the 16 MB limit at N = 102400).  Wide-fleet
+support is the reason these kernels exist: the jnp path's [K, N] f32
+scores are outright infeasible there (16k x 102k ≈ 6.7 GB per round).
+
 Kernels:
 - :func:`bid_argmin` — per job, min/argmin of (load + tie-hash) over its
   eligible open nodes.
 - :func:`fanout_add` — per node, total cost of Common-kind fired jobs
   eligible there (an MXU [1,TJ]x[TJ,W32] matmul per bit plane).
+
+When to use which: on v5e the MXU-heavy jnp path measures ~equal or
+faster up to ~10k nodes (bench.py ``kernel_bid_*_ms`` re-measures every
+round); the bit-plane kernels win where the unpacked matrix stops
+fitting.  ``impl="auto"`` encodes that threshold (ops/planner.py).
 """
 
 from __future__ import annotations
@@ -36,7 +48,8 @@ _HASH_A = np.uint32(2654435761)
 _HASH_B = np.uint32(40503)
 _HASH_C = np.uint32(2246822519)
 _HASH_D = np.uint32(3266489917)
-_TJ = 256  # job rows per program
+_TJ = 256   # job rows per program
+_TW = 512   # node words per program (16384 nodes); bounds scoped VMEM
 
 
 def _tie(jix_u32, n_u32):
@@ -51,29 +64,55 @@ def _tie(jix_u32, n_u32):
 
 
 def _bid_kernel(packed_ref, load_t_ref, best_ref, choice_ref):
-    tj, w32 = packed_ref.shape
-    packed = packed_ref[:]                                   # [TJ, W32] u32
+    tj, tw32 = packed_ref.shape
+    packed = packed_ref[:]                                   # [TJ, TW32] u32
     base = pl.program_id(0) * tj
-    jix = (base + jax.lax.broadcasted_iota(jnp.int32, (tj, w32), 0)
+    col0 = pl.program_id(1) * tw32                           # word offset
+    jix = (base + jax.lax.broadcasted_iota(jnp.int32, (tj, tw32), 0)
            ).astype(jnp.uint32)
-    wix = jax.lax.broadcasted_iota(jnp.int32, (tj, w32), 1)
+    wix = col0 + jax.lax.broadcasted_iota(jnp.int32, (tj, tw32), 1)
 
-    best = jnp.full((tj,), jnp.inf, jnp.float32)
-    choice = jnp.zeros((tj,), jnp.int32)
+    # node tiles accumulate into the output block (resident across the
+    # inner grid axis); tile 0 initializes
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        best_ref[:] = jnp.full(best_ref.shape, jnp.inf, jnp.float32)
+        choice_ref[:] = jnp.zeros(choice_ref.shape, jnp.int32)
+
+    best = best_ref[0, :]
+    choice = choice_ref[0, :]
+
+    def prio(c):
+        # exact-score ties resolve by (bit plane, word) — the order the
+        # single-tile kernel scanned in and _bid_jnp reproduces; node id
+        # c = w*32 + b maps to comparable priority (b << 17) | w
+        # (w < 2^17 covers 4M nodes)
+        return ((c & 31) << 17) | jax.lax.shift_right_logical(c, 5)
+
     # Unrolled over the 32 bit planes: Mosaic has no dynamic_slice, so the
     # plane index must be static (constant shifts + static row reads).
     for b in range(32):
-        bits = ((packed >> np.uint32(b)) & 1) != 0           # [TJ, W32]
+        bits = ((packed >> np.uint32(b)) & 1) != 0           # [TJ, TW32]
         n_ix = (wix * 32 + b).astype(jnp.uint32)
         score = jnp.where(bits, load_t_ref[b, :][None, :] + _tie(jix, n_ix),
                           jnp.inf)
         m = jnp.min(score, axis=1)                           # [TJ]
-        a = jnp.argmin(score, axis=1).astype(jnp.int32) * 32 + b
-        better = m < best
+        a = ((col0 + jnp.argmin(score, axis=1)).astype(jnp.int32)) * 32 + b
+        better = (m < best) | ((m == best) & (prio(a) < prio(choice)))
         best = jnp.where(better, m, best)
         choice = jnp.where(better, a, choice)
     best_ref[0, :] = best
     choice_ref[0, :] = choice
+
+
+def _pad_words(arr2d, tw: int):
+    """Pad the word axis (last dim) to a multiple of tw with zeros
+    (zero words = no eligible nodes there — semantics-neutral)."""
+    w32 = arr2d.shape[-1]
+    pad = (-w32) % tw
+    if pad:
+        arr2d = jnp.pad(arr2d, ((0, 0), (0, pad)))
+    return arr2d, w32 + pad
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -89,21 +128,30 @@ def bid_argmin(packed: jax.Array, load_eff: jax.Array, interpret: bool = False):
        choice [K] int32 — argmin node column).
     """
     K, w32 = packed.shape
-    n = w32 * 32
     if K % _TJ:
         raise ValueError(f"K={K} must be a multiple of {_TJ}")
+    tw = min(_TW, w32)
+    packed, w32p = _pad_words(packed, tw)
     load_t = load_eff.reshape(w32, 32).T                     # [32, W32]
-    grid = (K // _TJ,)
+    # the load pad value (0.0) is irrelevant: padded PACKED words are
+    # zero bits, so the where() emits +inf for every padded column —
+    # eligibility, not load, is what protects the pad
+    load_t, _ = _pad_words(load_t, tw)
+    grid = (K // _TJ, w32p // tw)
     best, choice = pl.pallas_call(
         _bid_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TJ, w32), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((32, w32), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TJ, tw), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((32, tw), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, _TJ), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _TJ), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TJ), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TJ), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, K), jnp.float32),
@@ -115,20 +163,20 @@ def bid_argmin(packed: jax.Array, load_eff: jax.Array, interpret: bool = False):
 
 
 def _fanout_kernel(packed_ref, w_ref, out_ref):
-    tj, w32 = packed_ref.shape
+    tj, tw32 = packed_ref.shape
     packed = packed_ref[:]
     w = w_ref[0, :][None, :]                                 # [1, TJ]
 
-    @pl.when(pl.program_id(0) == 0)
+    @pl.when(pl.program_id(1) == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
     rows = []
     for b in range(32):
-        bits = (((packed >> np.uint32(b)) & 1) != 0).astype(jnp.float32)  # [TJ, W32]
+        bits = (((packed >> np.uint32(b)) & 1) != 0).astype(jnp.float32)  # [TJ, TW32]
         contrib = jax.lax.dot_general(
             w, bits, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [1, W32]
+            preferred_element_type=jnp.float32)               # [1, TW32]
         rows.append(contrib)
     out_ref[:] = out_ref[:] + jnp.concatenate(rows, axis=0)
 
@@ -144,17 +192,23 @@ def fanout_add(packed: jax.Array, weights: jax.Array, interpret: bool = False):
     K, w32 = packed.shape
     if K % _TJ:
         raise ValueError(f"K={K} must be a multiple of {_TJ}")
-    grid = (K // _TJ,)
+    tw = min(_TW, w32)
+    packed, w32p = _pad_words(packed, tw)
+    # grid order: node tile OUTER, job tile INNER — each out block stays
+    # resident while every job tile accumulates into it
+    grid = (w32p // tw, K // _TJ)
     out_t = pl.pallas_call(
         _fanout_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TJ, w32), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _TJ), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TJ, tw), lambda j, i: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _TJ), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((32, w32), lambda i: (0, 0),
+        out_specs=pl.BlockSpec((32, tw), lambda j, i: (0, j),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((32, w32), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((32, w32p), jnp.float32),
         interpret=interpret,
     )(packed, weights.reshape(1, K))
-    return out_t.T.reshape(w32 * 32)
+    return out_t.T.reshape(w32p * 32)[:w32 * 32]

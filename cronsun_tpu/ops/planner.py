@@ -270,8 +270,8 @@ class TickPlanner:
     def _impl(self, kx: int, kc: int) -> str:
         if self.impl != "auto":
             return self.impl
-        return ("pallas" if jax.default_backend() == "tpu"
-                and kx % 256 == 0 and kc % 256 == 0 else "jnp")
+        from .assign import choose_impl
+        return choose_impl(self.N, kx, kc)
 
     # -- the tick ----------------------------------------------------------
 

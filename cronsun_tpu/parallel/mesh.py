@@ -176,7 +176,9 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
     load = load + jax.lax.psum(full, AXIS)
 
     def bid_block(packed, load_blk):
-        if impl == "jnp":
+        if impl in ("jnp", "mixed"):
+            # mixed = jnp bid (the split-invariant tie order) + pallas
+            # fanout (fetched from _steps above)
             best, choice = bid_block_jnp(packed, load_blk, col0=col0,
                                          bitplane_ties=False)
         else:
@@ -389,8 +391,10 @@ class _ShardedPlannerBase:
     def _resolve_impl(self, k_local: int) -> str:
         if self.impl != "auto":
             return self.impl
-        return ("pallas" if jax.default_backend() == "tpu"
-                and k_local % 256 == 0 else "jnp")
+        # the 2-D mesh divides the node width by Dn before it reaches a
+        # device; choose_impl holds the shared measured heuristic
+        from ..ops.assign import choose_impl
+        return choose_impl(self.N // getattr(self, "Dn", 1), k_local)
 
     def _decode(self, o, epoch_s: int, k_local: int) -> TickPlan:
         """[3, Dj*k_local] per-shard-concatenated output -> TickPlan."""

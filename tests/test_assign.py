@@ -203,3 +203,32 @@ def test_builder_group_recreation_restores_members():
     assert rows.tolist() == [2]
     bits = np.asarray(unpack_tile(jnp.asarray(vals[0:1]), 8))[0]
     assert set(np.nonzero(bits)[0]) == {u.index["a"]}
+
+
+def test_choose_impl_heuristic():
+    """The shared auto heuristic (one definition for assign, TickPlanner
+    and the mesh planners): jnp off-TPU or misaligned, mixed (jnp bid +
+    pallas fanout) at narrow node widths, all-pallas wide."""
+    import jax
+    from cronsun_tpu.ops.assign import _steps, choose_impl
+    from cronsun_tpu.ops.assign import _bid_jnp
+    from cronsun_tpu.ops.pallas_kernels import fanout_add
+
+    # on the CPU test backend everything resolves to jnp
+    assert choose_impl(10240, 2048) == "jnp"
+    # the threshold logic itself, with the backend check bypassed
+    orig = jax.default_backend
+    try:
+        jax.default_backend = lambda: "tpu"
+        assert choose_impl(10240, 2048) == "mixed"
+        assert choose_impl(10240, 16384) == "mixed"
+        # 0.84 GB score tile: still affordable -> mixed
+        assert choose_impl(102400, 2048) == "mixed"
+        # 6.7 GB score tile: pallas bounds memory
+        assert choose_impl(102400, 16384) == "pallas"
+        assert choose_impl(102400, 2047) == "jnp"     # misaligned bucket
+    finally:
+        jax.default_backend = orig
+    bid, fan = _steps("mixed")
+    assert bid is _bid_jnp
+    assert getattr(fan, "func", fan) in (fanout_add,) or fan is fanout_add

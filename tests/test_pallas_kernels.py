@@ -74,3 +74,31 @@ def test_bid_tie_collision_order_matches_at_scale():
     b_ref, c_ref = _bid_jnp(jnp.asarray(packed), load)
     b_pal, c_pal = bid_argmin(jnp.asarray(packed), load, interpret=True)
     np.testing.assert_array_equal(np.asarray(c_ref), np.asarray(c_pal))
+
+
+def test_wide_fleet_node_tiling():
+    """N beyond one VMEM block (the _TW=512-word tile): results must be
+    identical to the jnp reference, including the non-multiple-of-tile
+    padding path — this is the wide-fleet regime the kernels exist for
+    (the jnp path's [K, N] f32 scores stop fitting HBM around 100k
+    nodes)."""
+    import numpy as np
+    import jax, jax.numpy as jnp
+    from cronsun_tpu.ops.assign import _bid_jnp, _fanout_jnp
+    from cronsun_tpu.ops.pallas_kernels import bid_argmin, fanout_add
+
+    rng = np.random.default_rng(3)
+    K = 256
+    for w32 in (544, 1024):          # 17408 and 32768 nodes; 544 % 512 != 0
+        packed = jnp.asarray(
+            rng.integers(0, 2**32, (K, w32), dtype=np.uint32))
+        load = jnp.asarray(rng.integers(0, 4, w32 * 32).astype(np.float32))
+        w = jnp.asarray(rng.random(K).astype(np.float32))
+        bp, cp = bid_argmin(packed, load, interpret=True)
+        bj, cj = _bid_jnp(packed, load)
+        assert jnp.array_equal(cp, cj), f"choices diverge at w32={w32}"
+        assert jnp.allclose(bp, bj, rtol=1e-6, atol=1e-6)
+        fp = fanout_add(packed, w, interpret=True)
+        fj = _fanout_jnp(packed, w)
+        assert fp.shape == fj.shape
+        assert jnp.allclose(fp, fj, rtol=1e-3, atol=1e-2)
