@@ -196,6 +196,11 @@ def _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
     need0 = fire & exclusive
     assigned = jnp.full(K, -1, dtype=jnp.int32)
 
+    # NOTE (measured, don't re-attempt): a lax.cond early-exit that skips
+    # later rounds "when round r settled everything" never fires in
+    # practice — the waterfill quota deliberately rejects over-level
+    # candidates on every non-final round (anti-dogpile) — and the cond
+    # itself cost ~+3 ms/solve at a 16k bucket on v5e.
     for r in range(rounds):
         load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
         best, choice = bid(elig_packed, load_eff)
