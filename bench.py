@@ -130,30 +130,37 @@ def main():
     detail["rtt_p99_ms"] = round(float(np.percentile(rtts, 99)), 2)
 
     # On-TPU kernel equivalence: compiled pallas bid/fanout vs the jnp
-    # reference path at collision scale (dense ties across 10k nodes).
+    # reference path, at collision scale (dense ties across 10k nodes)
+    # and — full runs only — at the wide scale that exercises the
+    # node-tiled kernel paths incl. cross-tile tie merging on REAL
+    # hardware, not just the CPU interpreter tests.
     from cronsun_tpu.ops.assign import _bid_jnp, _fanout_jnp
     from cronsun_tpu.ops.pallas_kernels import bid_argmin, fanout_add
-    Keq, Neq = 2048, 10240
-    packed_eq = jax.random.bits(jax.random.PRNGKey(7), (Keq, Neq // 32),
-                                dtype=jnp.uint32)
-    # heavy ties: loads quantized to 4 distinct values
-    load_eq = jnp.asarray(
-        rng.integers(0, 4, Neq).astype(np.float32))
+    Keq = 2048
     w_eq = jnp.asarray(rng.random(Keq).astype(np.float32))
-    bp, cp = bid_argmin(packed_eq, load_eq)
-    bj, cj = _bid_jnp(packed_eq, load_eq)
-    fp = fanout_add(packed_eq, w_eq)
-    fj = _fanout_jnp(packed_eq, w_eq)
-    kernels_equal = (
-        # bid choices must be BIT-identical (placement determinism);
-        # fanout is an f32 sum whose MXU accumulation order differs from
-        # einsum's — equality up to accumulation noise (~2e-4 relative
-        # at 2k terms, measured) is the correct bar for a load estimate
-        bool(jnp.array_equal(cp, cj))
-        and bool(jnp.allclose(bp, bj, rtol=1e-6, atol=1e-6))
-        and bool(jnp.allclose(fp, fj, rtol=1e-3, atol=1e-2)))
-    detail["kernels_equal"] = kernels_equal
-    log(f"kernels_equal={kernels_equal} rtt_floor={detail['rtt_floor_ms']}ms")
+    eq_scales = [("", 10240)] + ([] if quick else [("_wide", 102400)])
+    for suffix, n_eq in eq_scales:
+        packed_eq = jax.random.bits(jax.random.PRNGKey(7), (Keq, n_eq // 32),
+                                    dtype=jnp.uint32)
+        # heavy ties: loads quantized to 4 distinct values
+        load_eq = jnp.asarray(rng.integers(0, 4, n_eq).astype(np.float32))
+        bp, cp = bid_argmin(packed_eq, load_eq)
+        bj, cj = _bid_jnp(packed_eq, load_eq)
+        fp = fanout_add(packed_eq, w_eq)
+        fj = _fanout_jnp(packed_eq, w_eq)
+        detail[f"kernels_equal{suffix}"] = (
+            # bid choices must be BIT-identical (placement determinism);
+            # fanout is an f32 sum whose MXU accumulation order differs
+            # from einsum's — equality up to accumulation noise (~2e-4
+            # relative at 2k terms, measured) is the correct bar for a
+            # load estimate
+            bool(jnp.array_equal(cp, cj))
+            and bool(jnp.allclose(bp, bj, rtol=1e-6, atol=1e-6))
+            and bool(jnp.allclose(fp, fj, rtol=1e-3, atol=1e-2)))
+    kernels_equal = detail["kernels_equal"]
+    log(f"kernels_equal={kernels_equal} "
+        f"wide={detail.get('kernels_equal_wide', 'n/a')} "
+        f"rtt_floor={detail['rtt_floor_ms']}ms")
 
     # Per-kernel device time, pallas vs jnp, net of the link, at BOTH
     # sides of the impl="auto" threshold (assign.choose_impl): time a
