@@ -186,8 +186,10 @@ def _gid(d) -> str:
 def cmd_login(api, args):
     pw = args.password if args.password is not None else \
         getpass.getpass(f"password for {args.email}: ")
-    out = api.call("GET", "/v1/session",
-                   {"email": args.email, "password": pw})
+    # POST body keeps the password out of proxy/access logs (the server
+    # keeps the GET-with-query route for UI compatibility)
+    out = api.call("POST", "/v1/session",
+                   body={"email": args.email, "password": pw})
     api.save()
     print(f"logged in as {out['email']} ({_role(out.get('role'))})")
 

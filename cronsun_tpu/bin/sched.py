@@ -5,11 +5,67 @@
 
 from __future__ import annotations
 
+import os
 import sys
 
 from .. import events, log
 from ..sched import SchedulerService
 from .common import base_parser, connect_store, setup_common
+
+
+def install_worker_signal_watchdog():
+    """Mesh-worker signal policy: first SIGTERM/SIGINT is logged and
+    ignored (the worker's normal stop is the leader's release broadcast;
+    a rank dying mid-plan wedges the fleet's collectives), a second
+    signal — or a single SIGUSR1 — force-exits.
+
+    Escalation must work even while the main thread is parked inside a
+    gloo/grpc collective that never returns to the interpreter — a pure
+    Python signal handler only runs at bytecode boundaries, so it would
+    never fire there.  Instead the C-level wakeup-fd path (written by
+    CPython's signal trampoline in whichever thread receives the signal,
+    regardless of what the main thread is doing) feeds a watchdog
+    thread.  SA_RESTART is restored so the first signal can't surface
+    as EINTR mid-collective either.
+
+    SIGTERM caveat (measured, not theory): jax.distributed spawns a
+    preemption-notifier thread that sigwait()s SIGTERM and wins the
+    shared-pending dequeue race against the main thread's handler —
+    SIGTERMs can be swallowed before the wakeup fd sees them, even with
+    the signal explicitly unblocked on the main thread.  So the
+    RELIABLE force paths for a wedged worker are SIGINT twice (Ctrl-C
+    Ctrl-C) or SIGUSR1 once; both appear in the first-signal message
+    operators actually see.  Must be called from the main thread."""
+    import signal as _signal
+    import threading as _threading
+    rfd, wfd = os.pipe()
+    os.set_blocking(wfd, False)
+    _signal.set_wakeup_fd(wfd, warn_on_full_buffer=False)
+    for _sig in (_signal.SIGTERM, _signal.SIGINT, _signal.SIGUSR1):
+        _signal.signal(_sig, lambda s, f: None)
+        _signal.siginterrupt(_sig, False)
+    _signal.pthread_sigmask(_signal.SIG_UNBLOCK,
+                            {_signal.SIGTERM, _signal.SIGINT,
+                             _signal.SIGUSR1})
+
+    def _sig_watchdog():
+        seen = 0
+        while True:
+            try:
+                data = os.read(rfd, 64)
+            except OSError:
+                return
+            for b in data:
+                if b == _signal.SIGUSR1 or seen:
+                    os.write(2, b"mesh worker: force exit\n")
+                    os._exit(1)
+                seen += 1
+                os.write(2, b"mesh worker: first signal ignored "
+                            b"(normal stop is the leader's release "
+                            b"broadcast; signal again or SIGUSR1 to "
+                            b"force exit)\n")
+    _threading.Thread(target=_sig_watchdog, daemon=True,
+                      name="sig-watchdog").start()
 
 
 def main(argv=None) -> int:
@@ -92,15 +148,9 @@ def main(argv=None) -> int:
     if args.mesh_hosts > 1 and args.mesh_proc_id > 0:
         # mesh worker: no store, no leadership — replay the leader's
         # broadcast deltas and join its collective plans until told to
-        # stop (parallel/hostsync.py documents the protocol).
-        # SIGTERM/SIGINT are IGNORED: under common supervision every
-        # rank gets the signal at once, and a worker dying mid-plan
-        # wedges the leader's shutdown collective — the worker's stop
-        # is the leader's release broadcast (and if the leader dies
-        # uncleanly, jax's coordination service terminates the workers)
-        import signal as _signal
-        _signal.signal(_signal.SIGTERM, _signal.SIG_IGN)
-        _signal.signal(_signal.SIGINT, _signal.SIG_IGN)
+        # stop (parallel/hostsync.py documents the protocol).  Signal
+        # policy: see install_worker_signal_watchdog.
+        install_worker_signal_watchdog()
         from ..parallel.hostsync import run_worker
         log.infof("mesh worker %d/%d up (coordinator %s)",
                   args.mesh_proc_id, args.mesh_hosts,

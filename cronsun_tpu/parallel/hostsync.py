@@ -93,8 +93,22 @@ class PlannerSyncProxy:
         self._planner = planner
         self._log: List[tuple] = []
 
+    # Planner mutators NOT in _OPS: a leader-side call would mutate only
+    # the leader's planner — the exact divergence that deadlocks the next
+    # collective plan (workers replay the op log, nothing else).  Fail
+    # loudly instead of passing through by convention.
+    _UNLOGGED_MUTATORS = frozenset({
+        "set_table", "set_eligibility", "set_job_meta_full",
+        "set_node_capacity_full", "job_finished", "common_finished",
+        "decay_load"})
+
     def __getattr__(self, name):
-        # reads (N, J, table, ...) and any un-logged method pass through
+        if name in PlannerSyncProxy._UNLOGGED_MUTATORS:
+            raise RuntimeError(
+                f"planner.{name}() is a mutator with no op-log entry; "
+                "calling it on the multi-host leader would desync the "
+                "workers (add it to hostsync._OPS + the proxy instead)")
+        # reads (N, J, table, ...) pass through
         return getattr(self._planner, name)
 
     def _record(self, op, *args):

@@ -135,6 +135,7 @@ class _Conn(LineJsonHandler):
                         "k": "RuntimeError"})
 
     def finish(self):
+        super().finish()    # retire the handshake watchdog (wire.py)
         self.alive = False
         for w, _t in self.watchers.values():
             w.close()

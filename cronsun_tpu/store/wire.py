@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import hmac
 import json
+import socket
 import socketserver
 import ssl
 import threading
@@ -33,10 +34,33 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
     args)`` (and may extend ``setup``/``finish``).  The server object
     must expose a ``token`` attribute ('' = open)."""
 
+    # Per-connection WALL-CLOCK deadline on the TLS handshake plus (on
+    # secured servers) the first auth frame: a client that connects and
+    # stalls — or drip-feeds bytes to reset per-recv timeouts — must not
+    # pin a handler thread forever.  Enforced by a watchdog timer that
+    # shuts the raw socket down if the connection isn't authenticated by
+    # the deadline (absolute, so partial progress never extends it).
+    HANDSHAKE_TIMEOUT = 10.0
+
     def setup(self):
         self.wlock = threading.Lock()
         self.alive = True
+        self.authed = False
+        self._hs_lock = threading.Lock()
+        self._hs_timer = None
         sslctx = getattr(self.server, "sslctx", None)
+        if sslctx is not None or getattr(self.server, "token", ""):
+            # watchdog only where a handshake can actually stall (TLS
+            # and/or token servers) — open plaintext servers don't pay a
+            # timer thread per accept.  The timer holds the FD NUMBER,
+            # not the socket object: wrap_socket() detaches the raw
+            # socket before the handshake, so an object reference would
+            # go stale (EBADF) exactly when the deadline matters.
+            fd = self.request.fileno()
+            self._hs_timer = threading.Timer(self.HANDSHAKE_TIMEOUT,
+                                             self._drop_unauthed, (fd,))
+            self._hs_timer.daemon = True
+            self._hs_timer.start()
         if sslctx is not None:
             # handshake runs here, in the per-connection thread (never in
             # the accept loop); a failed handshake — plaintext client,
@@ -50,7 +74,38 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
                 self.rfile = None
                 return
         self.rfile = self.request.makefile("rb")
-        self.authed = not getattr(self.server, "token", "")
+        if not getattr(self.server, "token", ""):
+            self._auth_ok()   # open (possibly TLS) server: TLS done, no
+                              # auth frame to wait for
+
+    def _auth_ok(self):
+        with self._hs_lock:
+            self.authed = True
+            if self._hs_timer is not None:
+                self._hs_timer.cancel()
+
+    def _drop_unauthed(self, fd):
+        """Watchdog body: sever an unauthenticated connection at the
+        deadline.  Runs under the same lock as _auth_ok, and finish()
+        marks the connection authed BEFORE socketserver closes the fd —
+        so this can never shut down a recycled fd number."""
+        with self._hs_lock:
+            if self.authed:
+                return
+            self.alive = False
+            try:
+                s = socket.socket(fileno=fd)
+            except OSError:
+                return
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            finally:
+                s.detach()   # fd still belongs to the connection
+
+    def finish(self):
+        self._auth_ok()   # retire the watchdog before the fd closes
 
     def _send(self, obj):
         data = (json.dumps(obj, separators=(",", ":")) + "\n").encode()
@@ -84,7 +139,7 @@ class LineJsonHandler(socketserver.BaseRequestHandler):
                 # config, conf/conf.go:66-67, db/mgo.go:33-36)
                 if op == "auth" and len(args) == 1 and \
                         token_matches(args[0], self.server.token):
-                    self.authed = True
+                    self._auth_ok()                 # handshake complete
                     self._send({"i": rid, "r": True})
                     continue
                 self._send({"i": rid, "e": "unauthenticated",

@@ -97,6 +97,10 @@ class ApiServer:
 
         route("GET", r"/v1/version", self.get_version, auth=False)
         route("GET", r"/v1/session", self.login, auth=False)
+        # POST variant: credentials ride the JSON body, not the query
+        # string, so they can't land in proxy/access logs (the GET route
+        # stays for UI compatibility with the reference's login flow)
+        route("POST", r"/v1/session", self.login, auth=False)
         route("GET", r"/v1/session/me", self.session_me)
         route("DELETE", r"/v1/session", self.logout)
         route("POST", r"/v1/user/setpwd", self.set_password)
@@ -138,8 +142,11 @@ class ApiServer:
         return VERSION
 
     def login(self, ctx):
-        email = ctx.q("email")
-        password = ctx.q("password")
+        body = ctx.json()
+        if not isinstance(body, dict):
+            raise HttpError(400, "body must be a JSON object")
+        email = body.get("email") or ctx.q("email")
+        password = body.get("password") or ctx.q("password")
         doc = self.sink.get_account(email)
         if doc is None:
             raise HttpError(401, "invalid email or password")
@@ -351,14 +358,14 @@ class ApiServer:
             node=ctx.q("node") or None,
             job_ids=ctx.q("ids").split(",") if ctx.q("ids") else None,
             name_like=ctx.q("names") or None,
-            begin=float(ctx.q("begin")) if ctx.q("begin") else None,
-            end=float(ctx.q("end")) if ctx.q("end") else None,
+            begin=ctx.q_float("begin"),
+            end=ctx.q_float("end"),
             failed_only=ctx.q("failedOnly") in ("true", "1"),
             latest=ctx.q("latest") in ("true", "1"),
-            page=int(ctx.q("page") or 1),
-            page_size=int(ctx.q("pageSize") or 50),
+            page=ctx.q_int("page", 1),
+            page_size=ctx.q_int("pageSize", 50),
             # cursor mode for pollers: id > afterId, ordered id ASC
-            after_id=int(ctx.q("afterId")) if ctx.q("afterId") else None)
+            after_id=ctx.q_int("afterId"))
         return {"total": total, "list": [self._log_dict(r) for r in recs]}
 
     @staticmethod
@@ -607,6 +614,25 @@ class _Ctx:
 
     def q(self, name: str) -> str:
         return self.query.get(name, "")
+
+    def q_int(self, name: str, default=None):
+        """Query int with a 400 (not a 500) on malformed values."""
+        raw = self.q(name)
+        if not raw:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            raise HttpError(400, f"bad integer for {name!r}: {raw!r}")
+
+    def q_float(self, name: str, default=None):
+        raw = self.q(name)
+        if not raw:
+            return default
+        try:
+            return float(raw)
+        except ValueError:
+            raise HttpError(400, f"bad number for {name!r}: {raw!r}")
 
     def json(self) -> dict:
         if not self.body:
