@@ -339,12 +339,13 @@ def main():
     import subprocess
     try:
         here = os.path.dirname(os.path.abspath(__file__))
-        rates = "500,1000" if quick else "1000,10000,50000"
+        rates = "500,1000" if quick else "2000,5000,10000,20000"
+        sweep = "1" if quick else "1,2,4"
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "scripts",
                                           "bench_dispatch.py"),
-             "--rates", rates, "--seconds", "3"],
-            capture_output=True, text=True, timeout=900, cwd=here)
+             "--rates", rates, "--seconds", "3", "--agent-sweep", sweep],
+            capture_output=True, text=True, timeout=1800, cwd=here)
         if proc.returncode == 0:
             detail.update(json.loads(proc.stdout))
         else:

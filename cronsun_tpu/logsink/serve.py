@@ -64,6 +64,11 @@ class _Conn(LineJsonHandler):
                             "r": self._create(sink, args[0],
                                               args[1] if len(args) > 1
                                               else None)})
+            elif op == "create_job_logs":
+                self._send({"i": rid,
+                            "r": self._create_bulk(sink, args[0],
+                                                   args[1] if len(args) > 1
+                                                   else None)})
             elif op == "query_logs":
                 recs, total = sink.query_logs(**args[0])
                 self._send({"i": rid, "r": {
@@ -79,19 +84,17 @@ class _Conn(LineJsonHandler):
         except Exception as e:  # noqa: BLE001 — report, keep serving
             self._send({"i": rid, "e": f"{type(e).__name__}: {e}"})
 
-    def _create(self, sink: JobLogStore, wire, idem):
-        """Idempotent insert: the client's transparent reconnect+retry
-        must not double-insert a record whose first attempt committed (or
-        is still committing) when the reply was lost.  The token is
-        RESERVED before the insert — a concurrent retry of the same token
-        latches onto the original attempt instead of racing it — and
-        replays return the original row id."""
-        # parse BEFORE reserving: a bad wire dict must raise without
-        # leaking a never-completed reservation
-        rec = _rec_unwire(wire)
+    def _idempotent(self, idem, thunk):
+        """Run ``thunk()`` at most once per idempotency token.  The token
+        is RESERVED before the write — a concurrent retry of the same
+        token latches onto the original attempt instead of racing it —
+        and replays return the original result.  A failed attempt
+        withdraws its reservation so a later retry can re-race; a waiter
+        that times out (pathologically slow owner) re-races too.  Shared
+        by the single and bulk create paths so the reservation state
+        machine exists exactly once."""
         if not idem:
-            sink.create_job_log(rec)
-            return rec.id
+            return thunk()
         seen = self.server.idem                   # type: ignore[attr-defined]
         lock = self.server.idem_lock              # type: ignore[attr-defined]
         with lock:
@@ -113,22 +116,40 @@ class _Conn(LineJsonHandler):
             ent["done"].wait(timeout=30)
             if ent["id"] is not None:
                 return ent["id"]
-            # the original attempt failed (it withdrew its reservation)
-            # or is pathologically slow: re-race the reservation
             with lock:
                 if seen.get(idem) is ent:
                     seen.pop(idem)
-            return self._create(sink, wire, idem)
+            return self._idempotent(idem, thunk)
         try:
-            sink.create_job_log(rec)
+            result = thunk()
         except Exception:
             with lock:
                 seen.pop(idem, None)
             ent["done"].set()
             raise
-        ent["id"] = rec.id
+        ent["id"] = result
         ent["done"].set()
-        return rec.id
+        return result
+
+    def _create_bulk(self, sink: JobLogStore, wires, idem):
+        """Bulk insert (agent record flushers): one idempotency token
+        covers the whole batch — a retried batch whose first attempt
+        committed replays the original ids, never double-inserts."""
+        recs = [_rec_unwire(w) for w in wires]      # parse before reserving
+        return self._idempotent(idem, lambda: sink.create_job_logs(recs))
+
+    def _create(self, sink: JobLogStore, wire, idem):
+        """Idempotent insert: the client's transparent reconnect+retry
+        must not double-insert a record whose first attempt committed (or
+        is still committing) when the reply was lost."""
+        # parse BEFORE reserving: a bad wire dict must raise without
+        # leaking a never-completed reservation
+        rec = _rec_unwire(wire)
+
+        def write():
+            sink.create_job_log(rec)
+            return rec.id
+        return self._idempotent(idem, write)
 
 
 class LogSinkServer:
@@ -261,6 +282,17 @@ class RemoteJobLogStore:
         # one token per logical record, stable across the reconnect retry
         rec.id = self._call("create_job_log", _rec_wire(rec),
                             uuid.uuid4().hex)
+
+    def create_job_logs(self, recs: List[LogRecord]):
+        """Bulk insert in one round trip (one idempotency token per
+        batch) — the agents' record flushers use this so a 10k-order
+        burst is tens of calls, not 10k."""
+        if not recs:
+            return
+        ids = self._call("create_job_logs", [_rec_wire(r) for r in recs],
+                         uuid.uuid4().hex)
+        for r, i in zip(recs, ids):
+            r.id = i
 
     def query_logs(self, **kw) -> Tuple[List[LogRecord], int]:
         r = self._call("query_logs", kw)

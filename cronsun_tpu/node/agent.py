@@ -132,6 +132,43 @@ class NodeAgent:
         self._fence_mu = threading.Lock()
         self._fence_lease_id: Optional[int] = None
         self._fence_rotate_at = 0.0
+        # one-RPC claim support (store.claim collapses the fence +
+        # proc-registry + order-consume chain); detected once, legacy
+        # multi-RPC chain kept as the fallback for older stores
+        self._claim_supported = True
+        # claim batcher: concurrent due executions queue their claims
+        # here and ONE claim_many round trip settles the whole burst
+        # (group-commit dynamics: whatever piles up during the in-flight
+        # RPC forms the next batch)
+        self._claim_pending: list = []
+        self._claim_cv = threading.Condition()
+        self._claim_thread: Optional[threading.Thread] = None
+        import itertools
+        self._claim_seq = itertools.count(1)   # per-attempt fence nonces
+        # execution records buffer here and flush in batches over the
+        # result-store wire (one bulk call per interval, not one round
+        # trip per execution — the reference pays 4 Mongo writes per
+        # execution, job_log.go:84-133)
+        self._rec_buf: list = []
+        self._rec_mu = threading.Lock()
+        self._rec_flush_mu = threading.Lock()   # pop+write atomicity
+        self._rec_flusher: Optional[threading.Thread] = None
+        self.rec_flush_interval = 0.05
+        # delayed proc-registry puts (the ProcReq threshold) ride ONE
+        # monitor thread instead of a threading.Timer per execution —
+        # a timer thread per order was a measured top cost of the
+        # dispatch plane at >1k orders/s
+        self._pdelay: Dict[int, Tuple[float, Callable]] = {}
+        self._pdelay_mu = threading.Lock()
+        self._pdelay_thread: Optional[threading.Thread] = None
+        self._pdelay_seq = 0
+        # snapshot of the process environment taken once: rebuilding the
+        # cron-context env from the live os.environ mapping proxy costs
+        # ~70 dict-proxy lookups per execution (measured in the dispatch
+        # profile); post-start environment changes don't propagate to
+        # jobs, which matches the reference (os/exec inherits the env
+        # captured at Cmd construction)
+        self._base_env = dict(os.environ)
         # watch-invalidated job cache (the reference keeps every job in
         # memory, maintained by watchJobs, node/node.go:121-141,361-391;
         # here bounded and filled on demand so a 1M-job fleet doesn't
@@ -388,6 +425,10 @@ class NodeAgent:
                 self._bump("orders_consumed_total")
 
         try:
+            proc_key = self.ks.proc_key(self.id, job.group, job.id,
+                                        f"{epoch_s}-{os.getpid()}")
+            proc_val = json.dumps({"time": self.clock()})
+            proc_registered = False
             if fenced and job.kind == KIND_ALONE:
                 # lifetime lock FIRST: a skip because the previous run is
                 # still live must not consume the (job, second) fence
@@ -395,13 +436,29 @@ class NodeAgent:
                 if alone is None:
                     return  # previous Alone run still live fleet-wide
             if fenced and job.exclusive:
-                if not self._fence(job.id, epoch_s):
+                # one-RPC claim: fence + proc registration + order
+                # consume collapse into a single store round trip (the
+                # per-execution chain was the dispatch plane's measured
+                # bottleneck).  The proc key rides the claim only when
+                # the job is EXPECTED to outlive proc_req (cost
+                # estimate); a mispredicted long run still registers via
+                # the delay timer below, exactly the reference's ProcReq
+                # threshold semantics (proc.go:218-236).
+                with_proc = self.proc_req <= 0 or \
+                    job.avg_time >= self.proc_req
+                won = self._claim(job, epoch_s, order_key,
+                                  proc_key if with_proc else "", proc_val)
+                if order_key is not None:
+                    order_done[0] = True    # claim consumed it, win or lose
+                    self._bump("orders_consumed_total")
+                if not won:
                     return  # another node already ran this (job, second)
-            proc_key = self.ks.proc_key(self.id, job.group, job.id,
-                                        f"{epoch_s}-{os.getpid()}")
-            proc_val = json.dumps({"time": self.clock()})
+                if with_proc:
+                    proc_registered = True
+                    with self._procs_mu:
+                        self._procs[proc_key] = proc_val
             finished = [False]
-            timer = None
+            pdelay_token = None
 
             def put_proc():
                 """Register the running execution.  With proc_req > 0 this
@@ -421,10 +478,10 @@ class NodeAgent:
                         self._repair_proc_lease_locked()
                 consume_order()
 
-            if self.proc_req > 0:
-                timer = threading.Timer(self.proc_req, put_proc)
-                timer.daemon = True
-                timer.start()
+            if proc_registered:
+                pass                    # claim already wrote the proc key
+            elif self.proc_req > 0:
+                pdelay_token = self._schedule_proc_put(put_proc)
             else:
                 put_proc()
             try:
@@ -438,15 +495,15 @@ class NodeAgent:
                     # when they actually ran — under load the two can
                     # differ, and scripts that write period-stamped
                     # artifacts need the scheduled one)
-                    env={**os.environ,
+                    env={**self._base_env,
                          "CRONSUN_NODE": self.id,
                          "CRONSUN_JOB_ID": job.id,
                          "CRONSUN_JOB_GROUP": job.group,
                          "CRONSUN_JOB_NAME": job.name,
                          "CRONSUN_SCHEDULED_TS": str(epoch_s)})
             finally:
-                if timer is not None:
-                    timer.cancel()
+                if pdelay_token is not None:
+                    self._cancel_proc_put(pdelay_token)
                 with self._procs_mu:
                     finished[0] = True
                     if self._procs.pop(proc_key, None) is not None:
@@ -462,7 +519,132 @@ class NodeAgent:
 
     _FENCE_GRACE = 60.0
 
-    def _fence(self, job_id: str, epoch_s: int) -> bool:
+    def _fence_lease(self) -> int:
+        """Shared periodically-rotated fence lease (see _fence)."""
+        with self._fence_mu:
+            now = self.clock()
+            if self._fence_lease_id is None or now >= self._fence_rotate_at:
+                self._fence_lease_id = self.store.grant(
+                    self.lock_ttl + self._FENCE_GRACE)
+                self._fence_rotate_at = now + self.lock_ttl / 2
+            return self._fence_lease_id
+
+    def _rotate_fence_lease(self) -> int:
+        with self._fence_mu:
+            self._fence_lease_id = self.store.grant(
+                self.lock_ttl + self._FENCE_GRACE)
+            self._fence_rotate_at = self.clock() + self.lock_ttl / 2
+            return self._fence_lease_id
+
+    def _claim(self, job: Job, epoch_s: int, order_key: Optional[str],
+               proc_key: str, proc_val: str) -> bool:
+        """Execution claim: (job, second) fence + optional proc
+        registration + order-key consume, atomic server-side.  Claims
+        from concurrent executions funnel through a batcher so a burst
+        of due orders costs ONE claim_many round trip, not one RPC per
+        execution.  Falls back to the legacy multi-RPC chain on stores
+        that predate the ops."""
+        fence_key = self.ks.lock_key(job.id, epoch_s)
+        # Fence VALUE is a per-attempt nonce (node id + unique suffix),
+        # not the bare node id: after an INDETERMINATE claim (reply lost
+        # on reconnect, batcher timeout) the fallback must distinguish
+        # "my claim actually applied" (fence holds MY nonce -> won) from
+        # "someone else won" and from "a previous attempt of mine on
+        # this (job, second) won" — a bare-node-id owner check would
+        # misread all three and either skip a won execution fleet-wide
+        # or double-run on a re-delivered order.
+        nonce = f"{self.id}@{os.getpid()}-{next(self._claim_seq)}"
+        if self._claim_supported:
+            item = (fence_key, nonce, order_key or "", proc_key,
+                    proc_val)
+            ev = threading.Event()
+            slot = [None]
+            with self._claim_cv:
+                self._claim_pending.append((item, ev, slot))
+                if self._claim_thread is None or \
+                        not self._claim_thread.is_alive():
+                    self._claim_thread = threading.Thread(
+                        target=self._claim_flush_loop, daemon=True,
+                        name=f"claims-{self.id}")
+                    self._claim_thread.start()
+                self._claim_cv.notify()
+            ev.wait(timeout=30)
+            if slot[0] is not None:
+                return slot[0]
+            # indeterminate: the RPC may or may not have applied.  Read
+            # the fence back before falling to the legacy chain.
+            try:
+                kv = self.store.get(fence_key)
+            except Exception:  # noqa: BLE001 — store still unhealthy
+                kv = None
+            if kv is not None:
+                if kv.value == nonce:
+                    return True        # our claim DID apply (incl. its
+                                       # proc put + order consume)
+                if order_key is not None:
+                    try:               # lost to another attempt: the
+                        self.store.delete(order_key)   # claim may not
+                    except Exception:  # noqa: BLE001  # have consumed it
+                        pass
+                return False
+            # fence absent: the claim never applied — legacy chain
+        won = self._fence(job.id, epoch_s, value=nonce)
+        if order_key is not None:
+            self.store.delete(order_key)
+        if won and proc_key:
+            with self._procs_mu:
+                try:
+                    self.store.put(proc_key, proc_val,
+                                   lease=self._proc_lease or 0)
+                except KeyError:
+                    self._repair_proc_lease_locked()
+                    self.store.put(proc_key, proc_val,
+                                   lease=self._proc_lease or 0)
+        return won
+
+    def _claim_flush_loop(self):
+        """Group-commit loop: settle every pending claim in one
+        claim_many RPC; claims arriving during the in-flight RPC form
+        the next batch."""
+        while True:
+            with self._claim_cv:
+                while not self._claim_pending:
+                    if self._stop.is_set():
+                        return
+                    self._claim_cv.wait(timeout=0.5)
+                batch, self._claim_pending = self._claim_pending, []
+            results = None
+            try:
+                results = self._claim_batch_rpc([b[0] for b in batch])
+            except Exception as e:  # noqa: BLE001
+                if "unknown op" in str(e):
+                    log.warnf("store lacks claim_many; using the legacy "
+                              "fence chain")
+                    self._claim_supported = False
+                else:
+                    log.errorf("claim batch of %d failed (callers retry "
+                               "via the legacy chain): %s", len(batch), e)
+            for i, (_item, ev, slot) in enumerate(batch):
+                slot[0] = results[i] if results is not None else None
+                ev.set()
+
+    def _claim_batch_rpc(self, items):
+        fence_lease = self._fence_lease()
+        with self._procs_mu:
+            proc_lease = self._proc_lease or 0
+        try:
+            return self.store.claim_many(items, fence_lease, proc_lease)
+        except KeyError:
+            # a lease expired under us (suspended VM, clock jump):
+            # rotate/repair both, retry once
+            fence_lease = self._rotate_fence_lease()
+            with self._procs_mu:
+                self._repair_proc_lease_locked()
+                proc_lease = self._proc_lease or 0
+            return self.store.claim_many(items, fence_lease, proc_lease)
+
+    def _fence(self, job_id: str, epoch_s: int,
+               value: Optional[str] = None) -> bool:
         """(job, second) create-if-absent fence.  Fence keys ride a
         SHARED periodically re-granted lease — the reference pools its
         proc keys on one shared lease the same way (proc.go:60-123) —
@@ -470,24 +652,15 @@ class NodeAgent:
         batch's keys live between lock_ttl/2 + grace and lock_ttl +
         grace, comfortably beyond the scheduler's max re-dispatch
         horizon (max_catchup_s)."""
-        with self._fence_mu:
-            now = self.clock()
-            if self._fence_lease_id is None or now >= self._fence_rotate_at:
-                self._fence_lease_id = self.store.grant(
-                    self.lock_ttl + self._FENCE_GRACE)
-                self._fence_rotate_at = now + self.lock_ttl / 2
-            lease = self._fence_lease_id
+        lease = self._fence_lease()
         key = self.ks.lock_key(job_id, epoch_s)
+        val = value if value is not None else self.id
         try:
-            return self.store.put_if_absent(key, self.id, lease=lease)
+            return self.store.put_if_absent(key, val, lease=lease)
         except KeyError:
             # lease expired under us (suspended VM, clock jump): rotate
-            with self._fence_mu:
-                self._fence_lease_id = self.store.grant(
-                    self.lock_ttl + self._FENCE_GRACE)
-                self._fence_rotate_at = self.clock() + self.lock_ttl / 2
-                lease = self._fence_lease_id
-            return self.store.put_if_absent(key, self.id, lease=lease)
+            lease = self._rotate_fence_lease()
+            return self.store.put_if_absent(key, val, lease=lease)
 
     def _update_avg_time(self, job: Job, res: ExecResult):
         """Close the cost loop: fold the measured runtime into the job's
@@ -499,10 +672,12 @@ class NodeAgent:
         dur = max(0.0, res.end_ts - res.begin_ts)
         # skip uninformative updates: a runtime within 10% of the current
         # EWMA would move the planner's cost estimate by nothing worth a
-        # get+CAS round trip pair per execution (high-rate short jobs
-        # converge after their first few runs)
-        if job.avg_time > 0 and \
-                abs(dur - job.avg_time) <= 0.1 * max(1.0, job.avg_time):
+        # get+CAS round trip pair per execution.  Applies at avg_time==0
+        # too — an instant job (dur < 0.1 s) must NOT pay a CAS per fire
+        # forever (each CAS also churns the job watch fleet-wide: every
+        # agent invalidates its cache and the scheduler re-applies the
+        # job), and the planner floors its cost at 1.0 regardless.
+        if abs(dur - job.avg_time) <= 0.1 * max(1.0, job.avg_time):
             return
         key = self.ks.job_key(job.group, job.id)
         for _ in range(3):
@@ -524,12 +699,23 @@ class NodeAgent:
         self._bump("execs_total")
         if not res.success:
             self._bump("execs_failed_total")
-        self.sink.create_job_log(LogRecord(
+        rec = LogRecord(
             job_id=job.id, job_group=job.group, name=job.name, node=self.id,
             user=job.user, command=job.command,
             output=res.output if res.success
             else f"{res.output}\n[error] {res.error}".strip(),
-            success=res.success, begin_ts=res.begin_ts, end_ts=res.end_ts))
+            success=res.success, begin_ts=res.begin_ts, end_ts=res.end_ts)
+        # batch the result-store write: records buffer here and a
+        # flusher writes whole batches per interval (create_job_logs —
+        # one round trip and one sink transaction per batch, not per
+        # execution)
+        with self._rec_mu:
+            self._rec_buf.append(rec)
+            if self._rec_flusher is None or not self._rec_flusher.is_alive():
+                self._rec_flusher = threading.Thread(
+                    target=self._rec_flush_loop, daemon=True,
+                    name=f"recflush-{self.id}")
+                self._rec_flusher.start()
         if not res.success and job.fail_notify:
             msg = {"subject": f"[cronsun] job [{job.name}] fail",
                    "body": f"job: {job.group}/{job.id}\nnode: {self.id}\n"
@@ -537,6 +723,77 @@ class NodeAgent:
                    "to": job.to}
             self.store.put(self.ks.noticer_key(self.id),
                            json.dumps(msg, separators=(",", ":")))
+
+    def _schedule_proc_put(self, fn) -> int:
+        """Register a ProcReq-delayed proc put on the shared monitor
+        thread; returns a token for :meth:`_cancel_proc_put`.  The fn
+        itself is idempotent-safe (it checks the execution's finished
+        flag under the procs lock), so the cancel race is harmless."""
+        with self._pdelay_mu:
+            self._pdelay_seq += 1
+            token = self._pdelay_seq
+            self._pdelay[token] = (self.clock() + self.proc_req, fn)
+            if self._pdelay_thread is None or \
+                    not self._pdelay_thread.is_alive():
+                self._pdelay_thread = threading.Thread(
+                    target=self._pdelay_loop, daemon=True,
+                    name=f"procdelay-{self.id}")
+                self._pdelay_thread.start()
+        return token
+
+    def _cancel_proc_put(self, token: int):
+        with self._pdelay_mu:
+            self._pdelay.pop(token, None)
+
+    def _pdelay_loop(self):
+        while True:
+            with self._pdelay_mu:
+                if self._stop.is_set() or not self._pdelay:
+                    # clear the handle under the lock before exiting so a
+                    # concurrent _schedule_proc_put spawns a fresh one
+                    self._pdelay_thread = None
+                    return
+                now = self.clock()
+                fns = [self._pdelay.pop(t)[1]
+                       for t in [t for t, (ts, _f) in self._pdelay.items()
+                                 if ts <= now]]
+            for f in fns:
+                try:
+                    f()
+                except Exception as e:  # noqa: BLE001
+                    log.warnf("delayed proc put failed: %s", e)
+            time.sleep(0.1)
+
+    def _rec_flush_loop(self):
+        """Drain the record buffer every ``rec_flush_interval``; exits
+        once the agent is stopping and the buffer is empty (stop() does
+        a final synchronous flush)."""
+        while True:
+            if self._stop.wait(self.rec_flush_interval):
+                return
+            self._flush_records()
+
+    def _flush_records(self):
+        # pop AND write under one flush mutex: join_running()/stop() use
+        # this as a completion barrier, so a batch the background
+        # flusher popped must not still be in flight when a barrier
+        # flush returns empty-handed
+        with self._rec_flush_mu:
+            with self._rec_mu:
+                batch, self._rec_buf = self._rec_buf, []
+            if not batch:
+                return
+            try:
+                if hasattr(self.sink, "create_job_logs"):
+                    self.sink.create_job_logs(batch)
+                else:                   # minimal sink: per-record
+                    for r in batch:
+                        self.sink.create_job_log(r)
+            except Exception as e:  # noqa: BLE001 — the sink client
+                # already retried once; tolerate the loss the way the
+                # reference tolerates a Mongo hiccup (job_log.go:84)
+                log.errorf("record flush failed (%d records dropped): %s",
+                           len(batch), e)
 
     # ---- event processing (synchronous; threads call these) --------------
 
@@ -603,11 +860,49 @@ class NodeAgent:
         self._spawn(job, epoch_s, fenced=True, order_key=order_key)
         return 1
 
+    def _prefetch_jobs(self, keys):
+        """Batch-fill the job cache for a drained burst of order keys:
+        cold jobs cost ONE get_many round trip per drain, not one
+        synchronous get (plus a reply-wait thread handoff) per order —
+        a measured top cost of the dispatch plane."""
+        want = []
+        seen = set()
+        for rest in keys:
+            parts = rest.split("/")
+            if len(parts) != 3:
+                continue
+            gk = (parts[1], parts[2])
+            if gk not in seen and gk not in self._job_cache:
+                seen.add(gk)
+                want.append(gk)
+        if not want or not hasattr(self.store, "get_many"):
+            return
+        try:
+            kvs = self.store.get_many(
+                [self.ks.job_key(g, j) for g, j in want])
+        except Exception as e:  # noqa: BLE001 — per-order gets still work
+            log.warnf("job prefetch failed (%s); falling back to "
+                      "per-order fetches", e)
+            return
+        if len(self._job_cache) + len(want) > self._job_cache_cap:
+            self._job_cache.clear()
+        for (group, job_id), kv in zip(want, kvs):
+            if kv is None:
+                continue
+            try:
+                job = Job.from_json(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            job.group, job.id = group, job_id
+            self._job_cache[(group, job_id)] = job
+
     def _poll_dispatch(self) -> int:
         n = 0
-        for ev in self._w_dispatch.drain():
-            if ev.type == DELETE:
-                continue
+        evs = [ev for ev in self._w_dispatch.drain() if ev.type != DELETE]
+        if len(evs) > 1:
+            off = len(self.ks.dispatch) + len(self.id) + 1
+            self._prefetch_jobs(ev.kv.key[off:] for ev in evs)
+        for ev in evs:
             n += self._handle_dispatch_kv(ev.kv.key, order_key=ev.kv.key)
         return n
 
@@ -638,9 +933,11 @@ class NodeAgent:
         fleet; this node runs it iff it is eligible (local IsRunOn).  The
         key is shared — never deleted by a consumer; its lease GCs it."""
         n = 0
-        for ev in self._w_broadcast.drain():
-            if ev.type == DELETE:
-                continue
+        evs = [ev for ev in self._w_broadcast.drain() if ev.type != DELETE]
+        if len(evs) > 1:
+            off = len(self.ks.dispatch_all)
+            self._prefetch_jobs(ev.kv.key[off:] for ev in evs)
+        for ev in evs:
             n += self._handle_broadcast_kv(ev.kv.key)
         return n
 
@@ -746,6 +1043,9 @@ class NodeAgent:
             t.finished.wait(timeout=max(0.0, deadline - time.monotonic()))
             if t.done():
                 self.running.pop(name, None)
+        # joined executions' records must be visible in the sink once
+        # this returns (callers treat join as the completion barrier)
+        self._flush_records()
 
     # ---- background loop -------------------------------------------------
 
@@ -797,6 +1097,8 @@ class NodeAgent:
                 self._staged.pop(name, None)
                 self.running.pop(name, None)
                 task.finished.set()
+        with self._claim_cv:       # wake the claim flusher so it drains
+            self._claim_cv.notify_all()   # pending claims, then exits
         for t in self._threads:
             t.join(timeout=3)
         self._threads.clear()
@@ -804,6 +1106,7 @@ class NodeAgent:
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
+        self._flush_records()   # final synchronous drain of the buffer
         self.unregister()
 
 

@@ -109,6 +109,15 @@ class SchedulerService:
 
         self._table_updates: Dict[int, dict] = {}
         self._meta_updates: Dict[int, Tuple[bool, float]] = {}
+        # Per-row dispatch cache: (exclusive, payload-json, group, job_id,
+        # kind), maintained by the job watch handlers so the per-fire
+        # order-build loop is dict-lookup + string-concat only — no
+        # json.dumps, no Job lookup per fire (the leader's order build is
+        # on the dispatch plane's critical path).
+        self._row_dispatch: Dict[int, Tuple[bool, str, str, str, int]] = {}
+        # reverse col -> node-id map, maintained on node churn instead of
+        # being rebuilt from universe.index every step
+        self._col_node: List[Optional[str]] = [None] * self.planner.N
         # row -> (timer string, phase anchor): @every phases are anchored at
         # first registration and must survive unrelated job rewrites (pause
         # toggles, avg_time updates) — only a changed timer re-anchors.
@@ -231,6 +240,11 @@ class SchedulerService:
             self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
             self._meta_updates[row] = (job.exclusive,
                                        job.avg_time if job.avg_time > 0 else 1.0)
+            self._row_dispatch[row] = (
+                job.exclusive,
+                json.dumps({"rule": rule.id, "kind": job.kind},
+                           separators=(",", ":")),
+                group, job_id, job.kind)
         for rule_id in old_rules - new_rules:
             self._drop_rule(group, job_id, rule_id)
 
@@ -261,6 +275,7 @@ class SchedulerService:
             self.builder.del_job(row)
             self._meta_updates.pop(row, None)
             self._row_phase.pop(row, None)
+            self._row_dispatch.pop(row, None)
             self.store.delete(self.ks.phase_key(group, job_id, rule_id))
 
     def _drop_job(self, group: str, job_id: str):
@@ -288,6 +303,7 @@ class SchedulerService:
             if node_id in g.node_ids:
                 self.builder.set_group(g.id, g.node_ids)
         col = self.universe.index[node_id]
+        self._col_node[col] = node_id
         cap = self.node_caps.get(node_id, self.default_node_cap)
         self.planner.set_node_capacity([col], [cap])
 
@@ -296,6 +312,7 @@ class SchedulerService:
         if col is None:
             return
         self.builder.node_removed(node_id)
+        self._col_node[col] = None
         self.planner.set_node_capacity([col], [0])
 
     def drain_watches(self):
@@ -520,7 +537,11 @@ class SchedulerService:
         # running lock is still live anywhere (reference job.go:87-123);
         # the watch-fed mirror replaces a per-step prefix scan
         alone_live = self._alone_live
-        col_to_node = {c: n for n, c in self.universe.index.items()}
+        row_disp = self._row_dispatch
+        col_node = self._col_node
+        disp_pfx = self.ks.dispatch
+        bcast_pfx = self.ks.dispatch_all
+        n_cols = len(col_node)
         orders: List[Tuple[str, str]] = []
         lease = self.store.grant(self.dispatch_ttl)
         for plan in plans:
@@ -531,30 +552,32 @@ class SchedulerService:
                 self.stats["overflow_drops"] += plan.overflow
                 log.warnf("%d fires over the bucket SLA dropped at t=%d",
                           plan.overflow, plan.epoch_s)
+            # per-fire work is one dict lookup + string concat: payload
+            # and routing were precomputed into _row_dispatch by the job
+            # watch handlers (this loop IS the leader's share of the
+            # dispatch plane — at 20k fires/tick it must stay tight)
+            ep = str(plan.epoch_s)
             for row, node_col in zip(plan.fired.tolist(),
                                      plan.assigned.tolist()):
-                cmd = self._row_cmd(row)
-                if cmd is None:
+                ent = row_disp.get(row)
+                if ent is None:
                     continue
-                group, job_id, rule_id = cmd
-                job = self.jobs.get((group, job_id))
-                if job is None:
-                    continue
-                if job.kind == KIND_ALONE and job_id in alone_live:
+                exclusive, payload, group, job_id, kind = ent
+                if kind == KIND_ALONE and job_id in alone_live:
                     continue   # previous run still holds the fleet lock
-                payload = json.dumps({"rule": rule_id, "kind": job.kind},
-                                     separators=(",", ":"))
-                if job.exclusive:
-                    node = col_to_node.get(node_col)
-                    if node:
-                        orders.append((self.ks.dispatch_key(
-                            node, plan.epoch_s, group, job_id), payload))
+                if exclusive:
+                    if 0 <= node_col < n_cols:
+                        node = col_node[node_col]
+                        if node:
+                            orders.append((
+                                f"{disp_pfx}{node}/{ep}/{group}/{job_id}",
+                                payload))
                 else:
                     # Common fan-out: ONE broadcast order; eligible agents
                     # each pick it up via their local IsRunOn — the host
                     # never walks the [J, N] matrix per fire
-                    orders.append((self.ks.dispatch_all_key(
-                        plan.epoch_s, group, job_id), payload))
+                    orders.append((
+                        f"{bcast_pfx}{ep}/{group}/{job_id}", payload))
         if orders:
             # one bulk write for the whole window — the dispatch plane is
             # one store round trip, not one per (node, second, job)

@@ -239,6 +239,13 @@ class MemStore:
             self._expire_leases()
             return self._kv.get(key)
 
+    def get_many(self, keys: Sequence[str]) -> List[Optional[KV]]:
+        """Bulk point-get under one lock acquisition (one round trip over
+        the wire) — agents batch their job-cache fills with this."""
+        with self._lock:
+            self._expire_leases()
+            return [self._kv.get(k) for k in keys]
+
     def get_prefix(self, prefix: str) -> List[KV]:
         with self._lock:
             self._expire_leases()
@@ -274,6 +281,13 @@ class MemStore:
                 self._delete_locked(k)
             return len(keys)
 
+    def delete_many(self, keys: Sequence[str]) -> int:
+        """Bulk delete under ONE lock acquisition — completion flushers
+        retire whole batches of proc keys in one round trip."""
+        with self._lock:
+            self._expire_leases()
+            return sum(1 for k in keys if self._delete_locked(k))
+
     # ---- txns ------------------------------------------------------------
 
     def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
@@ -301,7 +315,80 @@ class MemStore:
             self._put_locked(key, value, lease)
             return True
 
+    def claim(self, fence_key: str, fence_val: str, fence_lease: int = 0,
+              order_key: str = "", proc_key: str = "", proc_val: str = "",
+              proc_lease: int = 0) -> bool:
+        """Atomic execution claim — the dispatch plane's per-order hot op.
+
+        One round trip replaces the agent's fence ``put_if_absent`` +
+        proc-registry put + order-key delete chain (the reference pays up
+        to 3 etcd RPCs per fire: lock txn job.go:243-271, proc put
+        proc.go:209-237, and its own cleanup).  Semantics:
+
+        - fence_key already exists -> the claim LOSES: the order key is
+          still consumed (another node ran this (job, second)), nothing
+          else changes, returns False;
+        - otherwise the fence is written (under fence_lease), the proc
+          key (if given) is written under proc_lease, the order key (if
+          given) is deleted, and the claim WINS: returns True.
+
+        Both leases are validated before any mutation, so an expired
+        lease raises KeyError without a half-applied claim.
+        """
+        with self._lock:
+            self._expire_leases()
+            for lz in (fence_lease, proc_lease if proc_key else 0):
+                if lz and lz not in self._leases:
+                    raise KeyError(f"lease {lz} not found")
+            if fence_key in self._kv:
+                if order_key:
+                    self._delete_locked(order_key)
+                return False
+            self._put_locked(fence_key, fence_val, fence_lease)
+            if proc_key:
+                self._put_locked(proc_key, proc_val, proc_lease)
+            if order_key:
+                self._delete_locked(order_key)
+            return True
+
     # ---- leases ----------------------------------------------------------
+
+    def claim_many(self, items: Sequence[Sequence[str]],
+                   fence_lease: int = 0,
+                   proc_lease: int = 0) -> List[bool]:
+        """Batched :meth:`claim` under ONE lock acquisition: ``items`` is
+        [(fence_key, fence_val, order_key, proc_key, proc_val), ...]; the
+        two leases are shared by the whole batch (agents pool their fence
+        and proc keys on shared leases anyway).  Returns one win/lose
+        bool per item — an agent's claim batcher turns a burst of due
+        executions into a single store round trip."""
+        with self._lock:
+            self._expire_leases()
+            # malformed items yield per-item False WITHOUT aborting the
+            # batch (never a half-applied batch + whole-batch error) —
+            # bit-for-bit the native stored's behavior
+            any_proc = any(len(it) >= 5 and it[3] for it in items)
+            for lz in (fence_lease, proc_lease if any_proc else 0):
+                if lz and lz not in self._leases:
+                    raise KeyError(f"lease {lz} not found")
+            out = []
+            for it in items:
+                if len(it) < 5:
+                    out.append(False)
+                    continue
+                fence_key, fence_val, order_key, proc_key, proc_val = it[:5]
+                if fence_key in self._kv:
+                    if order_key:
+                        self._delete_locked(order_key)
+                    out.append(False)
+                    continue
+                self._put_locked(fence_key, fence_val, fence_lease)
+                if proc_key:
+                    self._put_locked(proc_key, proc_val, proc_lease)
+                if order_key:
+                    self._delete_locked(order_key)
+                out.append(True)
+            return out
 
     def grant(self, ttl: float) -> int:
         with self._lock:

@@ -69,9 +69,11 @@ def _ev_unwire(w) -> Event:
 # server
 # ---------------------------------------------------------------------------
 
-_OPS = ("put", "put_many", "get", "get_prefix", "count_prefix", "delete",
-        "delete_prefix", "put_if_absent", "put_if_mod_rev", "grant",
-        "keepalive", "revoke", "lease_ttl_remaining")
+_OPS = ("put", "put_many", "get", "get_many", "get_prefix",
+        "count_prefix", "delete",
+        "delete_prefix", "delete_many", "put_if_absent", "put_if_mod_rev",
+        "claim", "claim_many", "grant", "keepalive", "revoke",
+        "lease_ttl_remaining")
 
 
 class _Conn(LineJsonHandler):
@@ -118,7 +120,7 @@ class _Conn(LineJsonHandler):
                 r = getattr(store, op)(*args)
                 if op == "get":
                     r = _kv_wire(r)
-                elif op == "get_prefix":
+                elif op in ("get_prefix", "get_many"):
                     r = [_kv_wire(kv) for kv in r]
                 self._send({"i": rid, "r": r})
             else:
@@ -401,6 +403,9 @@ class RemoteStore:
     def get(self, key: str) -> Optional[KV]:
         return _kv_unwire(self._call("get", key))
 
+    def get_many(self, keys) -> List[Optional[KV]]:
+        return [_kv_unwire(w) for w in self._call("get_many", list(keys))]
+
     def get_prefix(self, prefix: str) -> List[KV]:
         return [_kv_unwire(w) for w in self._call("get_prefix", prefix)]
 
@@ -413,6 +418,9 @@ class RemoteStore:
     def delete_prefix(self, prefix: str) -> int:
         return self._call("delete_prefix", prefix)
 
+    def delete_many(self, keys) -> int:
+        return self._call("delete_many", list(keys))
+
     # -- txns --------------------------------------------------------------
 
     def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
@@ -421,6 +429,21 @@ class RemoteStore:
     def put_if_mod_rev(self, key: str, value: str, mod_rev: int,
                        lease: int = 0) -> bool:
         return self._call("put_if_mod_rev", key, value, mod_rev, lease)
+
+    def claim(self, fence_key: str, fence_val: str, fence_lease: int = 0,
+              order_key: str = "", proc_key: str = "", proc_val: str = "",
+              proc_lease: int = 0) -> bool:
+        """Atomic fence+proc+order-consume (memstore.claim) in ONE round
+        trip — the dispatch plane's per-execution hot op."""
+        return self._call("claim", fence_key, fence_val, fence_lease,
+                          order_key, proc_key, proc_val, proc_lease)
+
+    def claim_many(self, items, fence_lease: int = 0,
+                   proc_lease: int = 0) -> List[bool]:
+        """Batched claim (memstore.claim_many): one round trip for a
+        whole burst of due executions."""
+        return self._call("claim_many", [list(it) for it in items],
+                          fence_lease, proc_lease)
 
     # -- leases ------------------------------------------------------------
 

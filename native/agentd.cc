@@ -220,6 +220,32 @@ class StoreClient {
     return true;
   }
 
+  // atomic execution claim (stored claim op): fence + optional proc put
+  // + order delete in ONE round trip.  Returns false on transport/store
+  // error (err filled; err.kind=="ValueError" means the server predates
+  // the op — caller falls back to the fence chain).
+  bool claim_err(const std::string& fence_key, const std::string& fence_val,
+                 long long fence_lease, const std::string& order_key,
+                 const std::string& proc_key, const std::string& proc_val,
+                 long long proc_lease, bool& won, StoreError& err) {
+    JV a = sarg({fence_key, fence_val});
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = fence_lease;
+    for (const std::string* s : {&order_key, &proc_key, &proc_val}) {
+      a.arr.emplace_back();
+      a.arr.back().t = JV::STR;
+      a.arr.back().s = *s;
+    }
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = proc_lease;
+    JV r;
+    if (!call("claim", a, r, err)) return false;
+    won = r.t == JV::BOOL && r.b;
+    return true;
+  }
+
   void unwatch(long long wid) {
     if (wid < 0) return;
     JV a;
@@ -1381,16 +1407,6 @@ class Agent {
         }
       }).detach();
     }
-    if (fenced && j.kind != 0) {  // exclusive: (job, second) fence
-      if (!fence(j.id, epoch)) {
-        if (alone_lease) {
-          alone_stop->store(true);
-          store_.revoke(alone_lease);
-        }
-        consume();
-        return;  // another node already ran this (job, second)
-      }
-    }
     // proc registry key, written only if the run outlives proc_req
     std::string proc_key = pfx_ + "/proc/" + id_ + "/" + j.group + "/" +
                            j.id + "/" + std::to_string(epoch) + "-" +
@@ -1399,8 +1415,37 @@ class Agent {
     jdbl(proc_val, now_s());
     proc_val += "}";
     std::atomic<bool> proc_put{false};
+    if (fenced && j.kind != 0) {  // exclusive: (job, second) fence
+      // one-RPC claim: fence + proc registration (when the cost
+      // estimate says the run will outlive proc_req) + order consume,
+      // atomic server-side; falls back to the legacy chain on stores
+      // that predate the op
+      bool with_proc = proc_req_ <= 0 || j.avg_time >= proc_req_;
+      bool order_consumed = false, proc_written = false;
+      bool won = claim_or_fence(j.id, epoch, order_key,
+                                with_proc ? proc_key : std::string(),
+                                proc_val, order_consumed, proc_written);
+      if (order_consumed && !order_key.empty() && !order_done) {
+        order_done = true;  // the claim consumed it, win or lose
+        orders_consumed_++;
+      }
+      if (!won) {
+        if (alone_lease) {
+          alone_stop->store(true);
+          store_.revoke(alone_lease);
+        }
+        consume();
+        return;  // another node already ran this (job, second)
+      }
+      if (proc_written) {
+        std::lock_guard<std::mutex> g(procs_mu_);
+        procs_[proc_key] = proc_val;
+        proc_put = true;
+      }
+    }
     auto on_threshold = [&] {
       std::lock_guard<std::mutex> g(procs_mu_);
+      if (proc_put) return;   // already registered via the claim
       procs_[proc_key] = proc_val;
       store_.put(proc_key, proc_val, proc_lease_);
       proc_put = true;
@@ -1440,20 +1485,69 @@ class Agent {
     }
   }
 
+  long long fence_lease_now(bool force_rotate) {
+    std::lock_guard<std::mutex> g(fence_mu_);
+    double nw = now_s();
+    if (!fence_lease_ || nw >= fence_rotate_at_ || force_rotate) {
+      fence_lease_ = store_.grant(lock_ttl_ + 60);
+      fence_rotate_at_ = nw + lock_ttl_ / 2;
+    }
+    return fence_lease_;
+  }
+
+  // One-RPC claim (fence + optional proc put + order consume).  On
+  // success sets order_consumed/proc_written to what the server
+  // applied; on an unknown-op store it falls back to the legacy fence
+  // (caller keeps its separate order/proc handling).
+  bool claim_or_fence(const std::string& job_id, long long epoch,
+                      const std::string& order_key,
+                      const std::string& proc_key,
+                      const std::string& proc_val, bool& order_consumed,
+                      bool& proc_written) {
+    std::string key =
+        pfx_ + "/lock/" + job_id + "/" + std::to_string(epoch);
+    if (claim_supported_.load()) {
+      for (int attempt = 0; attempt < 2; attempt++) {
+        long long lease = fence_lease_now(attempt > 0);
+        long long plz = 0;
+        if (!proc_key.empty()) {
+          std::lock_guard<std::mutex> g(procs_mu_);
+          if (attempt > 0) {
+            // the KeyError may have been the PROC lease (expired under
+            // a suspend/clock jump): repair it too — the Python agent
+            // repairs both (see _claim_batch_rpc) — and re-attach live
+            // proc keys exactly like the keepalive repair path
+            proc_lease_ = store_.grant(proc_ttl_);
+            for (const auto& [k, v] : procs_) store_.put(k, v, proc_lease_);
+          }
+          plz = proc_lease_;
+        }
+        bool won = false;
+        StoreError err;
+        if (store_.claim_err(key, id_, lease, order_key, proc_key,
+                             proc_val, plz, won, err)) {
+          order_consumed = !order_key.empty();
+          proc_written = won && !proc_key.empty();
+          return won;
+        }
+        if (err.kind == "ValueError") {  // server predates the op
+          claim_supported_ = false;
+          break;
+        }
+        if (err.kind != "KeyError") return false;  // store unreachable:
+                                                   // do NOT run unfenced
+        // shared lease expired under us: rotate immediately and retry
+      }
+      if (claim_supported_.load()) return false;  // two lease failures
+    }
+    return fence(job_id, epoch);
+  }
+
   bool fence(const std::string& job_id, long long epoch) {
     std::string key =
         pfx_ + "/lock/" + job_id + "/" + std::to_string(epoch);
     for (int attempt = 0; attempt < 2; attempt++) {
-      long long lease;
-      {
-        std::lock_guard<std::mutex> g(fence_mu_);
-        double nw = now_s();
-        if (!fence_lease_ || nw >= fence_rotate_at_ || attempt > 0) {
-          fence_lease_ = store_.grant(lock_ttl_ + 60);
-          fence_rotate_at_ = nw + lock_ttl_ / 2;
-        }
-        lease = fence_lease_;
-      }
+      long long lease = fence_lease_now(attempt > 0);
       bool won = false;
       StoreError err;
       if (store_.put_if_absent_err(key, id_, lease, won, err)) return won;
@@ -1518,8 +1612,9 @@ class Agent {
 
   void update_avg_time(const JobSpec& j, const ExecResult& res) {
     double dur = std::max(0.0, res.end - res.begin);
-    if (j.avg_time > 0 &&
-        std::abs(dur - j.avg_time) <= 0.1 * std::max(1.0, j.avg_time))
+    // applies at avg_time==0 too: an instant job must not pay a CAS
+    // (plus fleet-wide job-watch churn) on every fire forever
+    if (std::abs(dur - j.avg_time) <= 0.1 * std::max(1.0, j.avg_time))
       return;  // EWMA-neutral: skip the CAS round trips
     std::string key = pfx_ + "/cmd/" + j.group + "/" + j.id;
     for (int i = 0; i < 3; i++) {
@@ -1577,6 +1672,7 @@ class Agent {
   std::mutex fence_mu_;
   long long fence_lease_ = 0;
   double fence_rotate_at_ = 0;
+  std::atomic<bool> claim_supported_{true};
   std::mutex groups_mu_;
   std::map<std::string, std::vector<std::string>> groups_;
   std::mutex bseen_mu_;

@@ -202,6 +202,48 @@ class LogStore {
     return r.id;
   }
 
+  // Bulk create (agent record flushers): one idem token covers the
+  // whole batch.  Ids are allocated consecutively under the lock, so a
+  // replayed retry reconstructs the full id list from the recorded
+  // first id.  Returns false on an unparseable record (nothing
+  // applied).
+  bool create_many(const std::vector<Rec>& recs, const std::string& idem,
+                   std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    long long first = -1;
+    if (!idem.empty()) {
+      auto it = idem_.find(idem);
+      if (it != idem_.end()) first = it->second;  // replayed retry
+    }
+    if (first < 0) {
+      first = next_id_;
+      for (Rec r : recs) {
+        r.id = next_id_++;
+        apply_create(r);
+        if (wal_) {
+          std::string line;
+          wal_create(line, r);
+          wal_->append(line);
+        }
+      }
+      if (!idem.empty()) {
+        idem_[idem] = first;
+        idem_fifo_.push_back(idem);
+        while (idem_fifo_.size() > 8192) {
+          idem_.erase(idem_fifo_.front());
+          idem_fifo_.pop_front();
+        }
+      }
+    }
+    res += '[';
+    for (size_t i = 0; i < recs.size(); i++) {
+      if (i) res += ',';
+      jint(res, first + (long long)i);
+    }
+    res += ']';
+    return true;
+  }
+
   void upsert_node(const std::string& id, const std::string& doc, bool alived) {
     std::lock_guard<std::mutex> g(mu);
     nodes_[id] = {doc, alived};
@@ -750,6 +792,22 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
       return;
     }
     jint(res, store.create(std::move(r), arg_s(args, 1)));
+  } else if (op == "create_job_logs") {
+    std::vector<Rec> recs;
+    bool ok = !args.arr.empty() && args.arr[0].t == JV::ARR;
+    if (ok) {
+      recs.reserve(args.arr[0].arr.size());
+      for (const JV& w : args.arr[0].arr) {
+        Rec r;
+        if (!rec_unwire(w, r)) { ok = false; break; }
+        recs.push_back(std::move(r));
+      }
+    }
+    if (!ok) {
+      out += ",\"e\":\"bad record\"}\n";
+      return;
+    }
+    store.create_many(recs, arg_s(args, 1), res);
   } else if (op == "query_logs") {
     store.query(args.arr.empty() ? JV{} : args.arr[0], res);
   } else if (op == "get_log") {

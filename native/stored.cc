@@ -191,6 +191,21 @@ class Store {
     return true;
   }
 
+  void get_many(const JV& keys, std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    out += '[';
+    bool first = true;
+    for (const JV& k : keys.arr) {
+      if (!first) out += ',';
+      first = false;
+      auto it = k.t == JV::STR ? kv_.find(k.s) : kv_.end();
+      if (it == kv_.end()) out += "null";
+      else kv_wire(out, it->first, it->second);
+    }
+    out += ']';
+  }
+
   void get_prefix(const std::string& prefix, std::string& out) {
     std::lock_guard<std::mutex> g(mu);
     expire_locked();
@@ -247,6 +262,81 @@ class Store {
     }
     put_locked(key, value, lease);
     return true;
+  }
+
+  long long delete_many(const JV& keys) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    long long n = 0;
+    for (const JV& k : keys.arr)
+      if (k.t == JV::STR && delete_locked(k.s)) n++;
+    return n;
+  }
+
+  // Atomic execution claim (memstore.py claim): fence put_if_absent +
+  // proc put + order delete in one locked op — the dispatch plane's
+  // per-order hot path.  Losing claims still consume the order key.
+  bool claim(const std::string& fence_key, const std::string& fence_val,
+             long long fence_lease, const std::string& order_key,
+             const std::string& proc_key, const std::string& proc_val,
+             long long proc_lease) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    // validate BOTH leases before any mutation (no half-applied claims)
+    if (fence_lease && !leases_.count(fence_lease))
+      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
+    if (!proc_key.empty() && proc_lease && !leases_.count(proc_lease))
+      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
+    if (kv_.count(fence_key)) {
+      if (!order_key.empty()) delete_locked(order_key);
+      return false;
+    }
+    put_locked(fence_key, fence_val, fence_lease);
+    if (!proc_key.empty()) put_locked(proc_key, proc_val, proc_lease);
+    if (!order_key.empty()) delete_locked(order_key);
+    return true;
+  }
+
+  // Batched claim: items = [[fence_key, fence_val, order_key, proc_key,
+  // proc_val], ...]; the two leases are shared by the whole batch.
+  // Appends a JSON bool array of per-item outcomes to res.
+  void claim_many(const JV& items, long long fence_lease,
+                  long long proc_lease, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    bool any_proc = false;
+    for (const JV& it : items.arr)
+      if (it.t == JV::ARR && it.arr.size() >= 5 && !it.arr[3].s.empty())
+        any_proc = true;
+    if (fence_lease && !leases_.count(fence_lease))
+      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
+    if (any_proc && proc_lease && !leases_.count(proc_lease))
+      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
+    res += '[';
+    bool first = true;
+    for (const JV& it : items.arr) {
+      if (!first) res += ',';
+      first = false;
+      if (it.t != JV::ARR || it.arr.size() < 5) {
+        res += "false";
+        continue;
+      }
+      const std::string& fence_key = it.arr[0].s;
+      const std::string& fence_val = it.arr[1].s;
+      const std::string& order_key = it.arr[2].s;
+      const std::string& proc_key = it.arr[3].s;
+      const std::string& proc_val = it.arr[4].s;
+      if (kv_.count(fence_key)) {
+        if (!order_key.empty()) delete_locked(order_key);
+        res += "false";
+        continue;
+      }
+      put_locked(fence_key, fence_val, fence_lease);
+      if (!proc_key.empty()) put_locked(proc_key, proc_val, proc_lease);
+      if (!order_key.empty()) delete_locked(order_key);
+      res += "true";
+    }
+    res += ']';
   }
 
   long long grant(double ttl) {
@@ -797,6 +887,11 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       jint(res, c->store->put_many(items, arg_i(args, 1)));
     } else if (op == "get") {
       if (!c->store->get(arg_s(args, 0), res)) res = "null";
+    } else if (op == "get_many") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& keys = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
+      c->store->get_many(keys, res);
     } else if (op == "get_prefix") {
       c->store->get_prefix(arg_s(args, 0), res);
     } else if (op == "count_prefix") {
@@ -805,6 +900,21 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       res = c->store->del(arg_s(args, 0)) ? "true" : "false";
     } else if (op == "delete_prefix") {
       jint(res, c->store->delete_prefix(arg_s(args, 0)));
+    } else if (op == "delete_many") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& keys = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
+      jint(res, c->store->delete_many(keys));
+    } else if (op == "claim") {
+      res = c->store->claim(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2), arg_s(args, 3),
+                            arg_s(args, 4), arg_s(args, 5), arg_i(args, 6))
+                ? "true"
+                : "false";
+    } else if (op == "claim_many") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& items = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
+      c->store->claim_many(items, arg_i(args, 1), arg_i(args, 2), res);
     } else if (op == "put_if_absent") {
       res = c->store->put_if_absent(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)) ? "true" : "false";
     } else if (op == "put_if_mod_rev") {

@@ -235,14 +235,35 @@ def main():
     ap.add_argument("--agents", type=int, default=0,
                     help="0 = auto: one per core beyond the shared "
                          "store/driver core, at least 1, at most 4")
+    ap.add_argument("--agent-sweep", default="",
+                    help="comma list of agent counts; runs the full rate "
+                         "sweep once per count and reports the scaling "
+                         "curve (VERDICT r3 #1/#6)")
     ap.add_argument("--seconds", type=int, default=4)
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
     if args.agents <= 0:
         args.agents = max(1, min(4, (os.cpu_count() or 1) - 1))
     rates = [int(r) for r in args.rates.split(",")]
-    res = run_bench(rates, args.agents, args.seconds,
-                    on_log=lambda *a: print(*a, file=sys.stderr, flush=True))
+    on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    if args.agent_sweep:
+        counts = [int(c) for c in args.agent_sweep.split(",")]
+        curve = []
+        res = None
+        for n in counts:
+            on_log(f"=== agent sweep: {n} agent(s) ===")
+            r = run_bench(rates, n, args.seconds, on_log=on_log)
+            curve.append({
+                "agents": n,
+                "sweep": r["dispatch_plane_sweep"],
+                "orders_per_sec": r["dispatch_plane_orders_per_sec"],
+                "saturation_offered_per_sec":
+                    r["dispatch_plane_saturation_offered_per_sec"]})
+            if res is None:
+                res = r           # single-agent fields stay top-level
+        res["dispatch_plane_agent_curve"] = curve
+    else:
+        res = run_bench(rates, args.agents, args.seconds, on_log=on_log)
     out = json.dumps(res, indent=1)
     if args.json:
         with open(args.json, "w") as f:

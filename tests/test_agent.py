@@ -401,3 +401,70 @@ def test_cron_context_env():
     assert recs[0].output.split() == \
         [str(epoch), job.id, job.group, "n0"]
     store.close()
+
+
+def test_claim_indeterminate_reply_still_runs_once():
+    """A claim that APPLIES server-side but whose reply is lost (reply
+    dropped on reconnect / batcher timeout) must not skip the execution:
+    the fence holds this attempt's nonce, so the fallback reads it back
+    as a win and proceeds — and a second agent still loses."""
+    class LostReplyStore(MemStore):
+        def __init__(self):
+            super().__init__()
+            self.drop_replies = 0
+
+        def claim_many(self, items, fence_lease=0, proc_lease=0):
+            out = super().claim_many(items, fence_lease, proc_lease)
+            if self.drop_replies > 0:
+                self.drop_replies -= 1
+                raise RuntimeError("connection closed")   # applied, reply lost
+            return out
+
+    store, sink = LostReplyStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    job = Job(id="ix", name="ix", group="g", command="echo x", kind=2,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["n0"])])
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    epoch = int(time.time()) - 2
+    order = KS.dispatch_key("n0", epoch, job.group, job.id)
+    store.put(order, json.dumps({"rule": "r", "kind": 2}))
+    store.drop_replies = 1
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1, "indeterminate claim must not skip the execution"
+    assert store.get(order) is None, "order consumed"
+    # the fence key survives with this agent's nonce value
+    fences = store.get_prefix(KS.lock)
+    assert any(kv.value.startswith("n0@") for kv in fences)
+    # a second agent's claim for the same (job, second) still loses
+    agent2 = NodeAgent(store, sink, node_id="n1")
+    agent2.register()
+    job2 = Job(id="ix", name="ix", group="g", command="echo x", kind=2,
+               rules=[JobRule(id="r", timer="* * * * * *", nids=["n1"])])
+    order2 = KS.dispatch_key("n1", epoch, job.group, job.id)
+    store.put(order2, json.dumps({"rule": "r", "kind": 2}))
+    agent2.poll()
+    agent2.join_running()
+    _, total = sink.query_logs(job_ids=[job.id])
+    assert total == 1, "exactly-once must hold across the lost reply"
+    agent.stop()
+    agent2.stop()
+    store.close()
+
+
+def test_claim_many_malformed_item_is_per_item_false():
+    """Backend parity (stored.cc): a short item yields False without
+    aborting or half-applying the batch."""
+    store = MemStore()
+    lease = store.grant(30)
+    out = store.claim_many(
+        [("/lk/a", "v", "", "", ""),
+         ("/lk/bad",),                      # malformed: too short
+         ("/lk/c", "v", "", "", "")], fence_lease=lease)
+    assert out == [True, False, True]
+    assert store.get("/lk/a") is not None
+    assert store.get("/lk/bad") is None
+    assert store.get("/lk/c") is not None
+    store.close()

@@ -495,3 +495,20 @@ def test_after_id_cursor(sink):
     # latest view ignores the cursor (its rows carry no id)
     recs, lt = sink.query_logs(latest=True, after_id=10**9)
     assert lt == 3
+
+
+def test_create_job_logs_bulk(sink):
+    """Bulk insert must be indistinguishable from N singles: ids
+    assigned in order, stats/latest updated per record."""
+    before = sink.stat_overall()["total"]
+    recs = [_rec(job=f"bulk{i}", node="nb", ok=(i % 2 == 0),
+                 begin=2000.0 + i) for i in range(5)]
+    out = sink.create_job_logs(recs)
+    ids = out if out is not None else [r.id for r in recs]
+    assert len(ids) == 5 and ids == sorted(ids)
+    assert sink.stat_overall()["total"] == before + 5
+    got, total = sink.query_logs(job_ids=[f"bulk{i}" for i in range(5)])
+    assert total == 5
+    # latest view has one row per (job, node)
+    latest, _ = sink.query_logs(job_ids=["bulk3"], latest=True)
+    assert len(latest) == 1 and latest[0].success is False

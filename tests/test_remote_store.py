@@ -531,3 +531,80 @@ def test_differential_fuzz_python_vs_native():
         b.close()
         py.stop()
         nt.stop()
+
+
+def test_claim_semantics(remote):
+    """store.claim: atomic fence + proc put + order delete in one op —
+    both backends must agree bit-for-bit (the agents' hot path)."""
+    _, s, s2 = remote
+    fl = s.grant(30.0)
+    pl = s.grant(30.0)
+    s.put("/d/n1/100/g/j", "order")
+    # winning claim: fence written, proc written, order consumed
+    assert s.claim("/lk/j/100", "n1", fl, "/d/n1/100/g/j",
+                   "/pr/n1/g/j/100", '{"t":1}', pl) is True
+    assert s.get("/lk/j/100").value == "n1"
+    assert s.get("/pr/n1/g/j/100").value == '{"t":1}'
+    assert s.get("/d/n1/100/g/j") is None
+    # losing claim from another connection: order consumed, nothing else
+    s2.put("/d/n2/100/g/j", "order")
+    assert s2.claim("/lk/j/100", "n2", fl, "/d/n2/100/g/j",
+                    "/pr/n2/g/j/100", "{}", pl) is False
+    assert s2.get("/d/n2/100/g/j") is None
+    assert s2.get("/pr/n2/g/j/100") is None
+    assert s2.get("/lk/j/100").value == "n1"
+    # leases own their keys: revoking the proc lease kills only the proc
+    s.revoke(pl)
+    assert s.get("/pr/n1/g/j/100") is None
+    assert s.get("/lk/j/100") is not None
+    # optional keys: claim with no order/proc is a bare fence
+    assert s.claim("/lk/j/101", "n1", fl) is True
+    assert s.claim("/lk/j/101", "n2", fl) is False
+    # invalid lease raises without a half-applied claim
+    with pytest.raises(KeyError):
+        s.claim("/lk/j/102", "n1", 999999)
+    assert s.get("/lk/j/102") is None
+    with pytest.raises(KeyError):
+        s.claim("/lk/j/103", "n1", fl, "", "/pr/x", "{}", 999999)
+    assert s.get("/lk/j/103") is None           # fence not half-written
+
+
+def test_delete_many(remote):
+    _, s, _ = remote
+    s.put_many([(f"/dm/{i}", "v") for i in range(10)])
+    assert s.delete_many([f"/dm/{i}" for i in range(7)] + ["/missing"]) == 7
+    assert s.count_prefix("/dm/") == 3
+
+
+def test_claim_events_flow_to_watchers(remote):
+    """Claims are regular mutations: watch streams see the fence PUT,
+    proc PUT and order DELETE (mirrors depend on this)."""
+    _, s, s2 = remote
+    w_lock = s2.watch("/lk2/")
+    w_proc = s2.watch("/pr2/")
+    w_disp = s2.watch("/d2/")
+    s.put("/d2/n1/5/g/j", "o")
+    fl = s.grant(30.0)
+    assert s.claim("/lk2/j/5", "n1", fl, "/d2/n1/5/g/j",
+                   "/pr2/n1/g/j/5", "{}", fl) is True
+    deadline = time.time() + 5
+    evs = {"lock": [], "proc": [], "disp": []}
+    while time.time() < deadline:
+        evs["lock"] += w_lock.drain()
+        evs["proc"] += w_proc.drain()
+        evs["disp"] += w_disp.drain()
+        if evs["lock"] and evs["proc"] and len(evs["disp"]) >= 2:
+            break
+        time.sleep(0.02)
+    assert [e.type for e in evs["lock"]] == ["PUT"]
+    assert [e.type for e in evs["proc"]] == ["PUT"]
+    assert [e.type for e in evs["disp"]] == ["PUT", "DELETE"]
+
+
+def test_get_many(remote):
+    _, s, _ = remote
+    s.put("/gm/a", "1")
+    s.put("/gm/b", "2")
+    out = s.get_many(["/gm/a", "/gm/missing", "/gm/b"])
+    assert out[0].value == "1" and out[1] is None and out[2].value == "2"
+    assert out[0].mod_rev > 0
