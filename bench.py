@@ -353,6 +353,26 @@ def main():
     except Exception as e:  # noqa: BLE001 — the TPU bench must still land
         detail["dispatch_plane_error"] = str(e)
 
+    # ---- scheduler system: full step() + failover at c5 scale --------------
+    # The whole cycle a real tick pays (watch drain + reconcile + flush +
+    # plan + order build + bulk publish) against the native store, plus
+    # the failover story: cold load vs warm-standby takeover (VERDICT r3
+    # #3/#4).  Full runs only — at 1M jobs this is minutes.
+    if not quick:
+        log("scheduler system: full step + failover @ 1M jobs")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--jobs", "1000000", "--nodes", "10240", "--steps", "6"],
+                capture_output=True, text=True, timeout=3600, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["sched_bench_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["sched_bench_error"] = str(e)
+
     with open("bench_detail.json", "w") as f:
         json.dump(detail, f, indent=1)
     log(json.dumps(detail, indent=1))

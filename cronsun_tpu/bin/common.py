@@ -40,6 +40,24 @@ def setup_common(args) -> Tuple[Config, Keyspace, Optional[ConfigWatcher]]:
     return cfg, Keyspace(cfg.prefix), watcher
 
 
+def enable_compile_cache(path: str):
+    """Persistent XLA compilation cache (conf.compile_cache): restarted
+    processes — including a cold failover standby on the same host —
+    reload compiled planner programs from disk instead of recompiling.
+    Must run before the first jit dispatch; safe to call on any jax
+    version (older ones without the knobs just skip it)."""
+    import os as _os
+    try:
+        import jax
+        d = _os.path.expanduser(path)
+        _os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.3)
+    except Exception as e:  # noqa: BLE001 — a cache is an optimization
+        log.warnf("compile cache unavailable (%s): %s", path, e)
+
+
 def server_tls(tls, native: bool, daemon: str):
     """Server-side TLS context from a conf section, or None (plaintext).
     The native servers cannot terminate TLS — exits 2 with the

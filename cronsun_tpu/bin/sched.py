@@ -122,6 +122,11 @@ def main(argv=None) -> int:
             coordinator_address=args.mesh_coordinator,
             num_processes=args.mesh_hosts, process_id=args.mesh_proc_id)
     cfg, ks, watcher = setup_common(args)
+    # only the scheduler compiles planner programs — agents/web/stores
+    # must never pay a jax import for a cache they'd never use
+    if cfg.compile_cache:
+        from .common import enable_compile_cache
+        enable_compile_cache(cfg.compile_cache)
     if args.profile_port:
         import jax
         jax.profiler.start_server(args.profile_port)

@@ -589,6 +589,17 @@ class NodeAgent:
                 return False
             # fence absent: the claim never applied — legacy chain
         won = self._fence(job.id, epoch_s, value=nonce)
+        if not won:
+            # TOCTOU on the indeterminate path: an in-flight claim_many
+            # can apply BETWEEN the fence read-back above (absent) and
+            # this put_if_absent (exists) — the existing fence may be
+            # OUR OWN nonce (unique per attempt), which is a win, not a
+            # loss
+            try:
+                kv = self.store.get(fence_key)
+                won = kv is not None and kv.value == nonce
+            except Exception:  # noqa: BLE001 — stay with the loss
+                pass
         if order_key is not None:
             self.store.delete(order_key)
         if won and proc_key:

@@ -151,7 +151,13 @@ class _AdaptiveBucket:
 
     def size(self, sla: Optional[int]) -> int:
         if sla is not None:
-            return min(_next_pow2(min(sla, self.max_bucket)), self.cap)
+            # an explicit SLA is a true override, clamped only by the
+            # structural cap (J): the scheduler's overflow re-plan
+            # escalates PAST max_bucket so a burst second becomes
+            # latency, never loss — and multi-host workers, which
+            # receive the sla via the broadcast header, clamp
+            # identically without sharing max_bucket state
+            return min(_next_pow2(sla), self.cap)
         ticks = max(1, self._ticks_pending)
         self._ticks_pending = 0
         want = max(2048, self.last_total + (self.last_total >> 2)
@@ -177,7 +183,11 @@ class TickPlan:
     fired: np.ndarray        # [F] job rows that fired (valid entries)
     assigned: np.ndarray     # [F] node column for exclusive jobs, -1 for
                              #     Common (fan-out) or no-capacity skips
-    overflow: int            # fired jobs beyond the bucket SLA (dropped)
+    overflow: int            # fired jobs beyond the bucket SLA (absent
+                             #     from `fired`; the scheduler re-plans
+                             #     the second with an escalated bucket)
+    total_fired: int = 0     # TRUE fire count this second (>= len(fired);
+                             #     sizes the escalation re-plan)
 
 
 class TickPlanner:
@@ -341,7 +351,8 @@ class TickPlanner:
                 [assigned_x, np.full(nc, -1, np.int32)])
             plans.append(TickPlan(
                 epoch_s=epoch_s + w, fired=fired, assigned=assigned,
-                overflow=max(0, xt - kx) + max(0, ct - kc)))
+                overflow=max(0, xt - kx) + max(0, ct - kc),
+                total_fired=xt + ct))
         if W:
             # adaptive sizing tracks each bucket's worst second; the shrink
             # hysteresis counts *ticks*, not calls

@@ -408,7 +408,8 @@ class _ShardedPlannerBase:
         fired = np.concatenate(fired)
         assigned = np.concatenate(assigned)
         return TickPlan(epoch_s=epoch_s, fired=fired, assigned=assigned,
-                        overflow=max(0, total - len(fired)))
+                        overflow=max(0, total - len(fired)),
+                        total_fired=total)
 
     def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
         k = sla_bucket or self.max_fire_bucket

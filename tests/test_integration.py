@@ -472,3 +472,37 @@ def test_agent_resync_after_watch_loss():
     _, total = sink.query_logs(job_ids=[job.id])
     assert total >= 1, "order lost across watch overflow"
     store.close()
+
+
+def test_overflow_becomes_late_fires_never_drops():
+    """A second whose fire count exceeds the adaptive bucket is
+    re-planned with an escalated bucket inside the same step: every fire
+    dispatches (late), overflow_late_fires counts them, and nothing
+    lands in overflow_drops (VERDICT r3 #2; reference contract: fires
+    late, never never — cron.go:212-215)."""
+    from cronsun_tpu.ops.planner import TickPlanner
+
+    store = MemStore()
+    store.put(KS.node_key("n0"), "host:1")
+    n_jobs = 2600                    # > the 2048 bucket floor
+    for i in range(n_jobs):
+        job = Job(id=f"of{i:04d}", name=f"of{i}", group="g",
+                  command="true", kind=2,
+                  rules=[JobRule(id="r", timer="* * * * * *",
+                                 nids=["n0"])])
+        store.put(KS.job_key("g", job.id), job.to_json())
+    planner = TickPlanner(job_capacity=4096, node_capacity=32,
+                          max_fire_bucket=2048)
+    sched = SchedulerService(store, planner=planner, window_s=1,
+                             node_capacity=32)
+    t0 = 1_753_000_000
+    n = sched.step(now=t0)
+    # every one of the n_jobs fires dispatched for the planned second
+    assert n == n_jobs, f"dispatched {n}, wanted {n_jobs}"
+    epoch = t0 + 1
+    orders = store.get_prefix(KS.dispatch + "n0/" + str(epoch) + "/")
+    assert len(orders) == n_jobs
+    assert sched.stats["overflow_late_fires"] >= n_jobs - 2048
+    assert sched.stats["overflow_drops"] == 0
+    assert sched.metrics_snapshot()["overflow_late_fires_total"] > 0
+    store.close()
