@@ -73,13 +73,20 @@ def server_tls(tls, native: bool, daemon: str):
     return ctx
 
 
-def connect_store(addr: str, token: str = "", tls=None) -> RemoteStore:
-    """``tls`` is the conf ``store_tls`` section (tlsutil.Tls) or None."""
+def connect_store(addr: str, token: str = "", tls=None,
+                  timeout: float = 120.0) -> RemoteStore:
+    """``tls`` is the conf ``store_tls`` section (tlsutil.Tls) or None.
+
+    The default RPC timeout is generous because bulk operations scale
+    with fleet size: a scheduler cold-loading 1M jobs lists the whole
+    cmd prefix in one call (hundreds of MB of JSON — measured over 10 s
+    on a 1-core store host, which timed out the old 10 s default
+    mid-boot)."""
     from ..tlsutil import client_context
     host, _, port = addr.rpartition(":")
     sslctx = client_context(tls) if tls is not None else None
     return RemoteStore(host or "127.0.0.1", int(port), token=token,
-                       sslctx=sslctx,
+                       timeout=timeout, sslctx=sslctx,
                        tls_hostname=tls.hostname if tls else "")
 
 

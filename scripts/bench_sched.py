@@ -77,8 +77,9 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
         backend = "py"
     out = {"sched_bench_backend": backend,
            "sched_bench_jobs": n_jobs, "sched_bench_nodes": n_nodes}
-    store = RemoteStore(srv.host, srv.port)
-    store2 = RemoteStore(srv.host, srv.port)
+    # generous RPC timeout: the 1M-job cmd listing is one giant reply
+    store = RemoteStore(srv.host, srv.port, timeout=600)
+    store2 = RemoteStore(srv.host, srv.port, timeout=600)
     try:
         seed(store, ks, n_jobs, n_nodes, on_log)
 
