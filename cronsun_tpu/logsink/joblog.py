@@ -146,17 +146,29 @@ class JobLogStore:
                 (d, ok, 1 - ok, ok, 1 - ok))
         return rec.id
 
-    def create_job_logs(self, recs) -> list:
+    def create_job_logs(self, recs, idem: str = "") -> list:
         """Bulk insert: the agents' record flushers write whole batches
         in ONE transaction (one fsync) instead of one commit per
         execution — the 4-write pattern per record is unchanged.
-        Returns the assigned row ids in order."""
+        Returns the assigned row ids in order.  ``idem`` is accepted
+        for surface parity with the networked sink; in-process writes
+        have no reply to lose, so it is unused."""
         with self._lock:
-            ids = []
-            for rec in recs:
-                ids.append(self._create_locked(rec))
-            self._db.commit()
-            return ids
+            try:
+                ids = []
+                for rec in recs:
+                    ids.append(self._create_locked(rec))
+                self._db.commit()
+                return ids
+            except Exception:
+                # all-or-nothing: a mid-batch failure (SQLITE_BUSY past
+                # the busy timeout, disk full) must not leave the head
+                # rows pending in the implicit transaction — the
+                # caller's retry re-sends the WHOLE batch, and a later
+                # unrelated commit would otherwise flush the stale head
+                # alongside it (duplicated rows + double-counted stats)
+                self._db.rollback()
+                raise
 
     # ---- queries (web/job_log.go:18-113) ---------------------------------
 

@@ -283,14 +283,16 @@ class RemoteJobLogStore:
         rec.id = self._call("create_job_log", _rec_wire(rec),
                             uuid.uuid4().hex)
 
-    def create_job_logs(self, recs: List[LogRecord]):
+    def create_job_logs(self, recs: List[LogRecord], idem: str = ""):
         """Bulk insert in one round trip (one idempotency token per
         batch) — the agents' record flushers use this so a 10k-order
-        burst is tens of calls, not 10k."""
+        burst is tens of calls, not 10k.  Callers that re-flush a
+        failed batch pass a stable ``idem`` so an applied-but-reply-
+        lost write dedups server-side instead of double-inserting."""
         if not recs:
             return
         ids = self._call("create_job_logs", [_rec_wire(r) for r in recs],
-                         uuid.uuid4().hex)
+                         idem or uuid.uuid4().hex)
         for r, i in zip(recs, ids):
             r.id = i
 

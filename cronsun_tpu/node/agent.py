@@ -25,6 +25,7 @@ import os
 import socket
 import threading
 import time
+import uuid
 from typing import Callable, Dict, Optional, Tuple
 
 from .. import log
@@ -154,6 +155,22 @@ class NodeAgent:
         self._rec_flush_mu = threading.Lock()   # pop+write atomicity
         self._rec_flusher: Optional[threading.Thread] = None
         self.rec_flush_interval = 0.05
+        # a failed batch parks in the retry slot (idempotency token
+        # pinned) and retries with exponential backoff (0.5 s .. 10 s
+        # between attempts, NOT every 50 ms flush tick — fast-failing
+        # connects would otherwise burn all attempts in ~1 s) for this
+        # many attempts before it is declared lost: ~4-5 minutes of
+        # sink outage coverage
+        self.rec_flush_max_fails = 30
+        self._rec_flush_fails = 0
+        self._rec_retry: Optional[Tuple[list, str]] = None
+        self._rec_retry_at = 0.0
+        # sink-outage backstop: the live buffer stops growing here
+        # (oldest dropped, counted) instead of absorbing the outage in
+        # unbounded memory
+        self.rec_buf_max = 100_000
+        self._rec_dropped = 0
+        self._rec_drop_log_at = 0.0
         # delayed proc-registry puts (the ProcReq threshold) ride ONE
         # monitor thread instead of a threading.Timer per execution —
         # a timer thread per order was a measured top cost of the
@@ -572,11 +589,19 @@ class NodeAgent:
             if slot[0] is not None:
                 return slot[0]
             # indeterminate: the RPC may or may not have applied.  Read
-            # the fence back before falling to the legacy chain.
-            try:
-                kv = self.store.get(fence_key)
-            except Exception:  # noqa: BLE001 — store still unhealthy
-                kv = None
+            # the fence back before falling to the legacy chain —
+            # waiting out the store client's auto-heal (~0.2 s backoff):
+            # a bare get here races the reconnect and would misread
+            # "asked 50 ms too early" as "fence absent".
+            kv = None
+            for _ in range(12):
+                try:
+                    kv = self.store.get(fence_key)
+                    break
+                except Exception:  # noqa: BLE001 — still healing
+                    time.sleep(0.5)
+            else:
+                return False    # store unreachable: do NOT run unfenced
             if kv is not None:
                 if kv.value == nonce:
                     return True        # our claim DID apply (incl. its
@@ -722,6 +747,22 @@ class NodeAgent:
         # execution)
         with self._rec_mu:
             self._rec_buf.append(rec)
+            # trim in 4096-record chunks: a per-append del of the list
+            # head is an O(buffer) memmove inside _rec_mu on every
+            # record once the cap pins — chunking amortizes it away
+            if len(self._rec_buf) > self.rec_buf_max + 4096:
+                drop = len(self._rec_buf) - self.rec_buf_max
+                del self._rec_buf[:drop]
+                # rate-limited: at dispatch-plane rates a per-record
+                # error line (~8k/s measured) would make the log pipe
+                # the next bottleneck of the outage
+                self._rec_dropped += drop
+                now = self.clock()
+                if now >= self._rec_drop_log_at:
+                    self._rec_drop_log_at = now + 5.0
+                    log.errorf("record buffer over %d during sink "
+                               "outage; %d dropped so far",
+                               self.rec_buf_max, self._rec_dropped)
             if self._rec_flusher is None or not self._rec_flusher.is_alive():
                 self._rec_flusher = threading.Thread(
                     target=self._rec_flush_loop, daemon=True,
@@ -784,27 +825,84 @@ class NodeAgent:
                 return
             self._flush_records()
 
-    def _flush_records(self):
+    def _send_records(self, batch: list, idem: str) -> bool:
+        """One write attempt.  On a mid-batch failure of the per-record
+        path the already-written head is removed from ``batch`` in
+        place, so a caller that re-buffers retries only the unwritten
+        tail (re-sending the head would duplicate job-log rows)."""
+        written = 0
+        try:
+            if hasattr(self.sink, "create_job_logs"):
+                self.sink.create_job_logs(batch, idem=idem)
+            else:                   # minimal sink: per-record
+                for r in batch:
+                    self.sink.create_job_log(r)
+                    written += 1
+            return True
+        except Exception as e:  # noqa: BLE001 — sink client already
+            del batch[:written]  # retried once; caller decides the rest
+            log.warnf("record write failed (%d records unwritten): %s",
+                      len(batch), e)
+            return False
+
+    def _flush_records(self, final: bool = False, force: bool = False):
         # pop AND write under one flush mutex: join_running()/stop() use
         # this as a completion barrier, so a batch the background
         # flusher popped must not still be in flight when a barrier
         # flush returns empty-handed
         with self._rec_flush_mu:
+            # Batching widened the blast radius of a sink hiccup from one
+            # record to a whole flush interval, so a failed batch parks in
+            # a retry slot — SEPARATE from the live buffer, with its
+            # idempotency token pinned, so (a) an applied-but-reply-lost
+            # bulk write dedups server-side on the retry instead of
+            # double-inserting, and (b) records appended since never ride
+            # a token the server may already have settled.  Only after
+            # ``rec_flush_max_fails`` consecutive failures (or at
+            # shutdown, when no retry can happen) is the batch dropped,
+            # the way the reference tolerates a Mongo outage
+            # (job_log.go:84).
+            if self._rec_retry is not None:
+                # ``force`` (join_running's visibility barrier) attempts
+                # NOW even inside the backoff window — the sink may have
+                # healed, and the barrier contract says records must be
+                # visible on return whenever writing is possible at all
+                if not (final or force) and self.clock() < self._rec_retry_at:
+                    return   # between backoff attempts; fresh waits too
+                batch, idem = self._rec_retry
+                if self._send_records(batch, idem):
+                    self._rec_retry = None
+                    self._rec_flush_fails = 0
+                else:
+                    self._rec_flush_fails += 1
+                    if final or \
+                            self._rec_flush_fails >= self.rec_flush_max_fails:
+                        log.errorf(
+                            "record flush failed (%d records dropped "
+                            "after %d attempts)", len(batch),
+                            self._rec_flush_fails)
+                        self._rec_retry = None
+                        self._rec_flush_fails = 0
+                    else:
+                        self._rec_retry_at = self.clock() + min(
+                            10.0, 0.25 * (1 << self._rec_flush_fails))
+                        log.warnf("record flush failed (%d records held "
+                                  "for retry %d/%d)", len(batch),
+                                  self._rec_flush_fails,
+                                  self.rec_flush_max_fails)
+                        return   # sink still down; fresh records wait
             with self._rec_mu:
                 batch, self._rec_buf = self._rec_buf, []
             if not batch:
                 return
-            try:
-                if hasattr(self.sink, "create_job_logs"):
-                    self.sink.create_job_logs(batch)
-                else:                   # minimal sink: per-record
-                    for r in batch:
-                        self.sink.create_job_log(r)
-            except Exception as e:  # noqa: BLE001 — the sink client
-                # already retried once; tolerate the loss the way the
-                # reference tolerates a Mongo hiccup (job_log.go:84)
-                log.errorf("record flush failed (%d records dropped): %s",
-                           len(batch), e)
+            idem = uuid.uuid4().hex
+            if not self._send_records(batch, idem):
+                if final:
+                    log.errorf("record flush failed (%d records dropped "
+                               "at shutdown)", len(batch))
+                elif batch:
+                    self._rec_retry = (batch, idem)
+                    self._rec_retry_at = self.clock() + 0.5
 
     # ---- event processing (synchronous; threads call these) --------------
 
@@ -1055,8 +1153,9 @@ class NodeAgent:
             if t.done():
                 self.running.pop(name, None)
         # joined executions' records must be visible in the sink once
-        # this returns (callers treat join as the completion barrier)
-        self._flush_records()
+        # this returns (callers treat join as the completion barrier);
+        # force past any retry backoff — the sink may have healed
+        self._flush_records(force=True)
 
     # ---- background loop -------------------------------------------------
 
@@ -1117,7 +1216,9 @@ class NodeAgent:
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
-        self._flush_records()   # final synchronous drain of the buffer
+        # final synchronous drain; anything the sink won't take now is
+        # lost with the process — recorded at error level, not "retry"
+        self._flush_records(final=True)
         self.unregister()
 
 

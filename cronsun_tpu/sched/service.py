@@ -795,12 +795,23 @@ class SchedulerService:
         if self._thread:
             return
         def run():
+            last_tb = 0.0
             while not self._stop.is_set():
                 try:
                     self.step()
-                except Exception:  # noqa: BLE001 — keep the loop alive
-                    import traceback
-                    traceback.print_exc()
+                except Exception as e:  # noqa: BLE001 — keep the loop alive
+                    # rate-limited: a store outage fails EVERY retry; a
+                    # full traceback each 0.2 s floods the log transport
+                    # (an undrained pipe then blocks this very loop —
+                    # the scheduler must stay schedulable even when its
+                    # log consumer isn't keeping up)
+                    now = time.monotonic()
+                    if now - last_tb > 30.0:
+                        last_tb = now
+                        import traceback
+                        traceback.print_exc()
+                    else:
+                        log.errorf("scheduler step failed: %s", e)
                 # plan ahead: sleep until the window is nearly consumed
                 nxt = (self._next_epoch or 0) - 1.5
                 delay = max(0.2, min(self.window_s, nxt - self.clock()))

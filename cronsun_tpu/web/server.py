@@ -27,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from .. import log
 from ..core import (
     Account, Group, Job, Keyspace, ROLE_ADMIN, ValidationError, next_id)
 from ..core.models import hash_password
@@ -147,6 +148,12 @@ class ApiServer:
             raise HttpError(400, "body must be a JSON object")
         email = body.get("email") or ctx.q("email")
         password = body.get("password") or ctx.q("password")
+        if (not body.get("email") and ctx.q("email")) or \
+                (not body.get("password") and ctx.q("password")):
+            # credentials in a query string land in proxy/access logs;
+            # the GET route survives only for reference-UI compatibility
+            log.warnf("deprecated query-string credentials on "
+                      "/v1/session — use POST with a JSON body")
         doc = self.sink.get_account(email)
         if doc is None:
             raise HttpError(401, "invalid email or password")

@@ -145,7 +145,7 @@ function login(){$('#main').innerHTML=`<form id=login>
  <input id=pw type=password placeholder="${t('password')}" value="admin">
  <button>${t('loginBtn')}</button><span id=err class=bad></span></form>`;
  $('#login').onsubmit=async e=>{e.preventDefault();try{
-  const d=await api('GET','/v1/session?email='+encodeURIComponent($('#em').value)+'&password='+encodeURIComponent($('#pw').value));
+  const d=await api('POST','/v1/session',{email:$('#em').value,password:$('#pw').value});
   me=d;$('#who').textContent=d.email;$('#nav-acc').style.display=d.role===1?'':'none';
   nav(view)}catch(x){$('#err').textContent=x}}}
 $('#logout').onclick=async()=>{await api('DELETE','/v1/session');login()};

@@ -1495,6 +1495,18 @@ class Agent {
     return fence_lease_;
   }
 
+  // Bounded heal-wait get: recovery reads after a transport-failed
+  // claim race the store client's auto-reconnect (~0.2 s backoff); a
+  // bare get would report "unreachable" — and skip the execution —
+  // when the fence was one reconnect away.
+  bool get_healed(const std::string& k, std::string& v, bool& found) {
+    for (int i = 0; i < 12; i++) {
+      if (store_.get(k, v, nullptr, found)) return true;
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    }
+    return false;
+  }
+
   // One-RPC claim (fence + optional proc put + order consume).  On
   // success sets order_consumed/proc_written to what the server
   // applied; on an unknown-op store it falls back to the legacy fence
@@ -1522,9 +1534,18 @@ class Agent {
           }
           plz = proc_lease_;
         }
+        // Fence VALUE is a per-attempt nonce, not the bare node id:
+        // after an INDETERMINATE claim (reply lost mid-transport) the
+        // read-back below must distinguish "my claim actually applied"
+        // from "someone else won" and from "a previous attempt of mine
+        // won" — a bare-id owner check misreads all three and either
+        // skips a won execution fleet-wide or double-runs (mirrors
+        // agent.py _claim).
+        std::string nonce = id_ + "@" + std::to_string(getpid()) + "-" +
+                            std::to_string(++claim_seq_);
         bool won = false;
         StoreError err;
-        if (store_.claim_err(key, id_, lease, order_key, proc_key,
+        if (store_.claim_err(key, nonce, lease, order_key, proc_key,
                              proc_val, plz, won, err)) {
           order_consumed = !order_key.empty();
           proc_written = won && !proc_key.empty();
@@ -1534,8 +1555,73 @@ class Agent {
           claim_supported_ = false;
           break;
         }
-        if (err.kind != "KeyError") return false;  // store unreachable:
-                                                   // do NOT run unfenced
+        if (err.kind != "KeyError") {
+          // transport error: INDETERMINATE — the claim may have applied
+          // server-side with the reply lost.  Read the fence back:
+          // holds OUR nonce -> the claim DID apply (incl. its proc put
+          // and order consume); another value -> lost (the winner's
+          // claim did not consume OUR order key — the caller's consume()
+          // deletes it); absent -> never applied, fence below.
+          std::string v;
+          bool found = false;
+          if (!get_healed(key, v, found))
+            return false;  // store unreachable: do NOT run unfenced
+          if (found) {
+            if (v == nonce) {
+              order_consumed = !order_key.empty();
+              proc_written = !proc_key.empty();
+              return true;
+            }
+            return false;  // another owner holds the fence
+          }
+          // Fence absent: claim never applied when we looked — but an
+          // in-flight copy can STILL apply (the server draining the
+          // broken connection's buffer), so fence with the SAME nonce
+          // and treat a loss-to-our-own-nonce as the claim's win.
+          bool fwon = false, put_ok = false;
+          StoreError ferr;
+          for (int i = 0; i < 12 && !put_ok; i++) {
+            put_ok = store_.put_if_absent_err(key, nonce, lease, fwon,
+                                              ferr);
+            if (put_ok) break;
+            if (ferr.kind == "KeyError") {   // lease expired: rotate
+              lease = fence_lease_now(true);
+              continue;
+            }
+            std::this_thread::sleep_for(std::chrono::milliseconds(500));
+          }
+          std::string v2;
+          bool f2 = false;
+          if (!put_ok) {
+            // the put itself may have applied with ITS reply lost —
+            // same read-back: fence under OUR nonce is a win.  The
+            // nonce could be ours via the put (fence-only) or via the
+            // late claim (which consumed the order and wrote the
+            // proc).  Report proc_written so end-of-run cleanup
+            // deletes a claim-written proc key instead of leaving a
+            // phantom "running" entry for the agent's lifetime; if it
+            // was really the put, the caller deletes a key that never
+            // existed (idempotent) and the short-lived proc
+            // registration is merely skipped.
+            if (get_healed(key, v2, f2) && f2 && v2 == nonce) {
+              proc_written = !proc_key.empty();
+              return true;
+            }
+            return false;
+          }
+          if (!fwon) {
+            if (get_healed(key, v2, f2) && f2 && v2 == nonce) {
+              // the late-applying claim won it (put_if_absent can't
+              // have: it definitively lost) — its proc put + order
+              // consume applied with it
+              order_consumed = !order_key.empty();
+              proc_written = !proc_key.empty();
+              return true;
+            }
+            return false;
+          }
+          return true;  // fence-only win: caller handles order/proc
+        }
         // shared lease expired under us: rotate immediately and retry
       }
       if (claim_supported_.load()) return false;  // two lease failures
@@ -1673,6 +1759,7 @@ class Agent {
   long long fence_lease_ = 0;
   double fence_rotate_at_ = 0;
   std::atomic<bool> claim_supported_{true};
+  std::atomic<long long> claim_seq_{0};  // per-attempt fence nonces
   std::mutex groups_mu_;
   std::map<std::string, std::vector<std::string>> groups_;
   std::mutex bseen_mu_;

@@ -468,3 +468,85 @@ def test_claim_many_malformed_item_is_per_item_false():
     assert store.get("/lk/bad") is None
     assert store.get("/lk/c") is not None
     store.close()
+
+
+def test_record_flush_retries_without_loss_or_duplicates():
+    """A sink hiccup must not drop a whole flush batch (ADVICE r4): the
+    failed batch parks in the retry slot with its idempotency token
+    pinned and lands once the sink heals — no loss, no duplicates, and
+    records that arrive DURING the outage ride a separate batch."""
+    store, real = MemStore(), JobLogStore()
+
+    class FlakySink:
+        def __init__(self):
+            self.fail = 0
+            self.idems = []
+
+        def create_job_logs(self, recs, idem=""):
+            if self.fail > 0:
+                self.fail -= 1
+                raise OSError("sink down")
+            self.idems.append(idem)
+            return real.create_job_logs(recs, idem=idem)
+
+        def query_logs(self, **kw):
+            return real.query_logs(**kw)
+
+        def set_node_alived(self, *a, **kw):
+            pass
+
+    sink = FlakySink()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.rec_flush_interval = 3600     # flush only when the test says
+    job = make_job()
+
+    def rec(i):
+        agent._record(job, ExecResult(
+            success=True, output=f"r{i}", error="",
+            begin_ts=time.time(), end_ts=time.time(), skipped=False))
+
+    rec(0)
+    rec(1)
+    sink.fail = 2
+    agent._flush_records()              # fails -> parks in retry slot
+    rec(2)                              # arrives during the outage
+    agent._rec_retry_at = 0.0           # collapse the backoff window
+    agent._flush_records()              # retry fails again; fresh waits
+    agent._rec_retry_at = 0.0
+    agent._flush_records()              # sink healed: retry batch + fresh
+    agent._flush_records()
+    _, total = real.query_logs(job_ids=[job.id])
+    assert total == 3, "records lost or duplicated across the outage"
+    # the parked batch kept ONE token across its attempts; the fresh
+    # batch rode its own
+    assert len(sink.idems) == 2 and sink.idems[0] != sink.idems[1]
+    agent.stop()
+    store.close()
+
+
+def test_record_flush_final_drop_is_not_silent():
+    """stop()'s final flush cannot retry: a still-down sink means the
+    batch is dropped — and dropped loudly, not parked behind a 'retry'
+    log line that will never happen."""
+    store = MemStore()
+
+    class DeadSink:
+        def create_job_logs(self, recs, idem=""):
+            raise OSError("sink down")
+
+        def query_logs(self, **kw):
+            return [], 0
+
+        def set_node_alived(self, *a, **kw):
+            pass
+
+    agent = NodeAgent(store, DeadSink(), node_id="n0")
+    agent.rec_flush_interval = 3600
+    job = make_job()
+    agent._record(job, ExecResult(
+        success=True, output="x", error="",
+        begin_ts=time.time(), end_ts=time.time(), skipped=False))
+    agent._flush_records(final=True)
+    assert agent._rec_retry is None and not agent._rec_buf
+    agent.stop()
+    store.close()

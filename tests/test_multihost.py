@@ -84,31 +84,65 @@ def test_mesh_worker_mode_end_to_end(mesh_flags):
                                 cwd=REPO, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True)
 
-    def await_ready(proc, timeout=120):
-        import selectors
-        sel = selectors.DefaultSelector()
-        sel.register(proc.stdout, selectors.EVENT_READ)
+    # One reader THREAD per process, lines flowing into a queue.  NOT
+    # select()+readline(): select watches the raw fd while readline
+    # consumes into Python's buffer — when a child's log line and its
+    # READY coalesce into one pipe chunk (which load makes likely),
+    # READY sits in the buffer, the fd never signals again, and the
+    # await times out with "no READY" despite READY having arrived.
+    # The thread also keeps draining after READY (a full 64KB pipe
+    # would block the rank mid-log-line and wedge the mesh), and the
+    # captured lines serve the end-of-test "released" assertion.
+    import queue as _queue
+    import threading
+    readers = {}     # proc -> (queue, captured lines)
+
+    def reader_of(proc):
+        if proc not in readers:
+            q = _queue.Queue()
+
+            def rd():
+                for line in proc.stdout:
+                    q.put(line)
+                q.put(None)
+            threading.Thread(target=rd, daemon=True).start()
+            readers[proc] = (q, [])
+        return readers[proc]
+
+    def await_ready(proc, timeout=180):
+        q, lines = reader_of(proc)
         deadline = time.time() + timeout
-        lines = []
-        try:
-            while time.time() < deadline:
+        while time.time() < deadline:
+            try:
+                line = q.get(timeout=1.0)
+            except _queue.Empty:
                 # bounded wait: a rank wedged in jax.distributed
                 # handshake (producing no output) must FAIL the test
                 # with what it printed, not hang the run
-                if not sel.select(timeout=1.0):
-                    assert proc.poll() is None, "".join(lines)
-                    continue
-                line = proc.stdout.readline()
-                if not line:
-                    assert proc.poll() is None, "".join(lines)
-                    time.sleep(0.2)      # closed-stdout but alive: no spin
-                    continue
-                lines.append(line)
-                if line.startswith("READY"):
-                    return line.split(None, 1)[1].strip()
-        finally:
-            sel.close()
+                assert proc.poll() is None, "".join(lines)
+                continue
+            if line is None:
+                assert proc.poll() is None, "".join(lines)
+                time.sleep(0.2)      # closed-stdout but alive: no spin
+                continue
+            lines.append(line)
+            if line.startswith("READY"):
+                return line.split(None, 1)[1].strip()
         raise AssertionError("no READY:\n" + "".join(lines))
+
+    def collected_output(proc, settle_s=2.0):
+        """Everything the reader captured (plus a short settle drain)."""
+        q, lines = reader_of(proc)
+        deadline = time.time() + settle_s
+        while time.time() < deadline:
+            try:
+                line = q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            if line is None:
+                break
+            lines.append(line)
+        return "".join(lines)
 
     import tempfile
     coord = f"127.0.0.1:{_free_port()}"
@@ -187,7 +221,7 @@ def test_mesh_worker_mode_end_to_end(mesh_flags):
         leader.send_signal(signal.SIGTERM)
         assert leader.wait(timeout=30) == 0
         assert worker.wait(timeout=30) == 0
-        wout = worker.stdout.read()
+        wout = collected_output(worker)
         assert "released" in wout, wout[-300:]
         c.close()
     finally:

@@ -48,6 +48,17 @@ def _await_ready(proc, timeout=90):
             continue
         lines.append(line)
         if line.startswith("READY"):
+            # keep draining (discarding) forever: an undrained 64KB
+            # pipe blocks the process mid-log-line — a scheduler
+            # printing reconnect errors through a store outage would
+            # WEDGE on the full pipe and never resume dispatching
+            # (exactly the failure the crash tests then misreport)
+            import threading
+
+            def _drain(f=proc.stdout):
+                for _ in f:
+                    pass
+            threading.Thread(target=_drain, daemon=True).start()
             return line.split(None, 1)[1].strip()
     raise AssertionError(f"no READY within {timeout}s:\n{''.join(lines)}")
 
@@ -889,5 +900,153 @@ def test_tls_fleet_end_to_end(tmp_path):
         assert total >= 2, "no executions landed through the TLS fleet"
         assert all("over-tls" in l.output for l in logs)
         sink.close()
+    finally:
+        _teardown(procs)
+
+
+def test_native_agent_claim_indeterminate_reply(tmp_path):
+    """agentd's indeterminate-claim recovery (ADVICE r4): a claim that
+    APPLIES in the store but whose reply never reaches the agent (the
+    connection dies mid-RPC) must still execute exactly once.  A
+    reply-dropping TCP proxy sits between agentd and the native store:
+    on the first '"o":"claim"' line it forwards the request, then kills
+    the connection before the reply can cross — agentd's read-back must
+    find its own per-attempt nonce on the fence and proceed."""
+    import pathlib
+    import socket
+    import threading
+    agentd = pathlib.Path(REPO) / "native" / "cronsun-agentd"
+    from cronsun_tpu.store.native import find_binary
+    if find_binary() is None or not agentd.exists():
+        pytest.skip("native binaries unavailable")
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--native", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        sh, _, sp = store_addr.rpartition(":")
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--native", "--port", "0",
+                        "--db", str(tmp_path / "logd.wal"))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+
+        armed = threading.Event()
+        armed.set()
+        dropped = threading.Event()
+        lsock = socket.socket()
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        proxy_port = lsock.getsockname()[1]
+        stop = threading.Event()
+
+        def pipe(c, s):
+            """client->server, line-scanned for the armed claim kill."""
+            buf = b""
+            try:
+                while not stop.is_set():
+                    data = c.recv(65536)
+                    if not data:
+                        break
+                    buf += data
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        s.sendall(line + b"\n")
+                        if armed.is_set() and b'"o":"claim"' in line:
+                            # request delivered; reply must never return:
+                            # silence THIS connection's s->c pump FIRST,
+                            # then give the server time to apply
+                            armed.clear()
+                            dropped.set()
+                            time.sleep(0.3)
+                            c.close()
+                            s.close()
+                            return
+            except OSError:
+                pass
+            finally:
+                for x in (c, s):
+                    try:
+                        x.close()
+                    except OSError:
+                        pass
+
+        def pump(s, c, pre_drop):
+            """server->client; a connection alive at drop time goes
+            silent once the kill fires — connections agentd opens
+            AFTERWARDS (the heal + recovery reads) always forward."""
+            try:
+                while not stop.is_set():
+                    data = s.recv(65536)
+                    if not data:
+                        break
+                    if pre_drop and dropped.is_set():
+                        continue   # the lost reply (and any trailing
+                                   # pushes on the killed connection)
+                    c.sendall(data)
+            except OSError:
+                pass
+
+        def accept_loop():
+            while not stop.is_set():
+                try:
+                    c, _ = lsock.accept()
+                except OSError:
+                    return
+                s = socket.create_connection((sh, int(sp)))
+                pre_drop = not dropped.is_set()
+                threading.Thread(target=pipe, args=(c, s),
+                                 daemon=True).start()
+                threading.Thread(target=pump, args=(s, c, pre_drop),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+
+        p = subprocess.Popen(
+            [str(agentd), "--store", f"127.0.0.1:{proxy_port}",
+             "--logsink", logd_addr, "--node-id", "cxxI",
+             "--ttl", "5", "--proc-req", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        _await_ready(p)
+
+        from cronsun_tpu.core import Keyspace
+        from cronsun_tpu.store.remote import RemoteStore
+        ks = Keyspace()
+        direct = RemoteStore(sh, int(sp))   # unproxied control channel
+        job_doc = json.dumps({
+            "name": "indet", "command": "echo indet-ran", "kind": 2,
+            "rules": [{"id": "r", "timer": "* * * * * *",
+                       "nids": ["cxxI"]}]})
+        direct.put(ks.job_key("g", "ij"), job_doc)
+        epoch = int(time.time()) - 2        # past: runs immediately
+        order = ks.dispatch_key("cxxI", epoch, "g", "ij")
+        direct.put(order, '{"rule":"r","kind":2}')
+
+        assert dropped.wait(timeout=30), "proxy never saw the claim RPC"
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(lh, int(lp))
+        deadline = time.time() + 30
+        total = 0
+        while time.time() < deadline:
+            logs, total = sink.query_logs(page_size=50)
+            if total >= 1:
+                break
+            time.sleep(0.5)
+        assert total == 1, \
+            "indeterminate claim must not skip the execution (fleet-wide)"
+        assert logs[0].output.strip() == "indet-ran"
+        # the fence survives under this agent's per-attempt nonce, and
+        # the applied claim consumed the order key
+        fences = direct.get_prefix(ks.lock)
+        assert any(kv.value.startswith("cxxI@") for kv in fences), \
+            [kv.value for kv in fences]
+        assert direct.get(order) is None, "order key not consumed"
+        sink.close()
+        direct.close()
+        stop.set()
+        lsock.close()
     finally:
         _teardown(procs)
