@@ -135,11 +135,19 @@ class Job:
                       for r in self.rules]
         return json.dumps(d, separators=(",", ":"))
 
+    _FIELDS = None   # lazily cached field-name set (NOT annotated: an
+                     # annotation would make it a dataclass field)
+
     @classmethod
     def from_json(cls, s: str) -> "Job":
         d = json.loads(s)
         rules = [JobRule.from_dict(r) for r in d.get("rules") or []]
-        known = {f.name for f in dataclasses.fields(cls)}
+        known = cls._FIELDS
+        if known is None:
+            # cached: dataclasses.fields() introspection per document
+            # was a measured slice of the 1M-job cold load
+            known = frozenset(f.name for f in dataclasses.fields(cls))
+            cls._FIELDS = known
         kw = {k: v for k, v in d.items() if k in known and k != "rules"}
         return cls(rules=rules, **kw)
 

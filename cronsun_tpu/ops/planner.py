@@ -149,6 +149,21 @@ class _AdaptiveBucket:
         self.last_total = total
         self._ticks_pending += ticks
 
+    def _want(self) -> int:
+        """~1.3x headroom over the last observed fire count, snapped to
+        a power of two within [2048, min(max_bucket->pow2, cap)] — THE
+        sizing formula, shared by size() and peek() so a standby's
+        warm-compile always targets the executable a fresh leader's
+        first plan will actually request."""
+        want = max(2048, self.last_total + (self.last_total >> 2)
+                   + (self.last_total >> 4))
+        return min(_next_pow2(min(want, self.max_bucket)), self.cap)
+
+    def peek(self) -> int:
+        """The size the next ``size(None)`` call would return, without
+        mutating the hysteresis state (standby warm-compile)."""
+        return self.cur_k or self._want()
+
     def size(self, sla: Optional[int]) -> int:
         if sla is not None:
             # an explicit SLA is a true override, clamped only by the
@@ -160,9 +175,7 @@ class _AdaptiveBucket:
             return min(_next_pow2(sla), self.cap)
         ticks = max(1, self._ticks_pending)
         self._ticks_pending = 0
-        want = max(2048, self.last_total + (self.last_total >> 2)
-                   + (self.last_total >> 4))
-        want = min(_next_pow2(min(want, self.max_bucket)), self.cap)
+        want = self._want()
         if not self.cur_k or want > self.cur_k:
             self.cur_k = want
             self._shrink_streak = 0
@@ -188,6 +201,11 @@ class TickPlan:
                              #     the second with an escalated bucket)
     total_fired: int = 0     # TRUE fire count this second (>= len(fired);
                              #     sizes the escalation re-plan)
+    n_excl: int = 0          # fired[:n_excl] are the exclusive
+                             #     placements (assigned valid);
+                             #     fired[n_excl:] are Common fan-outs —
+                             #     dispatchers iterate each half without
+                             #     a per-fire kind branch
 
 
 class TickPlanner:
@@ -352,7 +370,7 @@ class TickPlanner:
             plans.append(TickPlan(
                 epoch_s=epoch_s + w, fired=fired, assigned=assigned,
                 overflow=max(0, xt - kx) + max(0, ct - kc),
-                total_fired=xt + ct))
+                total_fired=xt + ct, n_excl=nx))
         if W:
             # adaptive sizing tracks each bucket's worst second; the shrink
             # hysteresis counts *ticks*, not calls
@@ -364,3 +382,29 @@ class TickPlanner:
                     sla_bucket: Optional[int] = None):
         return self.gather_window(
             self.plan_window_async(epoch_s, window_s, sla_bucket))
+
+    def warm_window(self, epoch_s: int, window_s: int) -> None:
+        """Compile (and cache) the windowed plan executable WITHOUT
+        mutating carried state — warm standbys call this once so their
+        first LEADING step doesn't pay the XLA compile (measured: tens
+        of seconds of takeover outage at 1M-job shapes).  Bucket sizes
+        are derived the same way a fresh leader's first plan would
+        derive them, so the warmed executable is the one the takeover
+        actually runs."""
+        from .schedule_table import FRAMEWORK_EPOCH
+        from .timecal import window_fields
+        kx, kc = self._bx.peek(), self._bc.peek()
+        impl = self._impl(kx, kc)
+        f = window_fields(epoch_s, window_s, tz=self.tz)
+        fields_w = np.stack([
+            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+            np.arange(window_s, dtype=np.int64)
+            + (epoch_s - FRAMEWORK_EPOCH),
+        ], axis=1).astype(np.int32)
+        # + 0.0 / | 0: fresh buffers so the jit's donation can't
+        # invalidate the planner's live load/rem_cap
+        outs32, _outs16, _l, _r = _plan_window_step(
+            self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
+            self.cost, self.load + 0.0, self.rem_cap | 0, kx, kc,
+            self.rounds, impl)
+        np.asarray(outs32[0, 0])   # a data fetch truly syncs the tunnel

@@ -24,6 +24,7 @@ death the lease expires and a standby takes over within ``lease_ttl``.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -38,6 +39,9 @@ from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
 from ..ops.schedule_table import make_row, _INACTIVE_ROW
 from ..store.memstore import DELETE, MemStore, WatchLost
+
+# ids that serialize into a JSON string verbatim (no escapes needed)
+_WIRE_SAFE = re.compile(r"^[A-Za-z0-9_.:-]*$").match
 
 
 class _Rows:
@@ -86,6 +90,8 @@ class SchedulerService:
                  node_id: str = "scheduler-1",
                  planner: Optional[TickPlanner] = None,
                  tz=None,
+                 publish_lanes: int = 0,
+                 sync_publish: Optional[bool] = None,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -110,11 +116,12 @@ class SchedulerService:
         self._table_updates: Dict[int, dict] = {}
         self._meta_updates: Dict[int, Tuple[bool, float]] = {}
         # Per-row dispatch cache: (exclusive, payload-json, group, job_id,
-        # kind), maintained by the job watch handlers so the per-fire
-        # order-build loop is dict-lookup + string-concat only — no
-        # json.dumps, no Job lookup per fire (the leader's order build is
-        # on the dispatch plane's critical path).
-        self._row_dispatch: Dict[int, Tuple[bool, str, str, str, int]] = {}
+        # kind, "/group/job" key tail), maintained by the job watch
+        # handlers so the per-fire order-build loop is dict-lookup +
+        # string-concat only — no json.dumps, no Job lookup per fire (the
+        # leader's order build is on the dispatch plane's critical path).
+        self._row_dispatch: Dict[
+            int, Tuple[bool, str, str, str, int, str]] = {}
         # reverse col -> node-id map, maintained on node churn instead of
         # being rebuilt from universe.index every step
         self._col_node: List[Optional[str]] = [None] * self.planner.N
@@ -135,13 +142,48 @@ class SchedulerService:
         # must NOT re-list these every second — at planner fire rates that
         # serializes the whole keyspace over TCP per step; deltas arrive by
         # watch and a periodic anti-entropy re-list bounds drift.
-        self._procs: Dict[str, Tuple[str, str, str]] = {}
-        self._orders: Dict[str, Tuple[str, str, str]] = {}
+        # Mirror values are (node, cost, exclusive) FROZEN at entry time,
+        # and per-node counters advance incrementally with the mirrors —
+        # reconcile_capacity is O(nodes), not O(outstanding) (r4 measured
+        # 548 ms/step of re-iteration at the 1M scale).
+        self._procs: Dict[str, Tuple[str, float, bool]] = {}
+        self._orders: Dict[str, Tuple[str, float, bool]] = {}
         self._alone_live: Set[str] = set()
+        self._excl_cnt: Dict[str, int] = {}    # node -> reserved slots
+        self._load_sum: Dict[str, float] = {}  # node -> running cost
         self.mirror_resync_s = 30.0
         self._mirror_resync_at = 0.0
+        self._ae_thread: Optional[threading.Thread] = None
+        self._ae_result = None
+        self._ae_rekick = False
 
         self._open_watches()
+
+        # async publisher: lanes are extra connections when the store
+        # can clone (networked), else the shared store.  The publish
+        # rides OFF the step's critical path (r4: 2.1 s of a 4 s window
+        # inside the step); backpressure puts it back on the step —
+        # visibly — only when the plane can't keep up.
+        if publish_lanes <= 0:
+            import os as _os
+            publish_lanes = max(1, min(4, (_os.cpu_count() or 1) - 1))
+        if hasattr(store, "clone"):
+            lanes = [store.clone() for _ in range(publish_lanes)]
+            self._owned_lanes = lanes
+        else:
+            lanes = [store]
+            self._owned_lanes = []
+        from .publisher import OrderPublisher
+        self.publisher = OrderPublisher(lanes, self._advance_hwm)
+        # in-process stores (tests, demo) publish synchronously: their
+        # put_many is microseconds and callers assert store contents
+        # right after step(); the networked path keeps the overlap
+        self.sync_publish = (not hasattr(store, "clone")
+                             if sync_publish is None else sync_publish)
+        # device-plan pipelining: the NEXT window's plan is dispatched
+        # before the current one publishes; (start_epoch, handle)
+        self._pending_plan: Optional[Tuple[int, object]] = None
+        self._warmed = False
 
         self._leader_lease: Optional[int] = None
         self._stop = threading.Event()
@@ -174,7 +216,15 @@ class SchedulerService:
         self._w_groups = self.store.watch(self.ks.group)
         self._w_nodes = self.store.watch(self.ks.node)
         self._w_procs = self.store.watch(self.ks.proc)
-        self._w_orders = self.store.watch(self.ks.dispatch)
+        # delete-only: the leader WRITES this prefix by the tens of
+        # thousands per window — watching its own puts meant every
+        # publish came straight back as watch pushes to serialize,
+        # ship and re-parse (a measured majority of the r4 publish
+        # span).  Own publishes are mirrored locally at submit time;
+        # consumption/expiry arrives as DELETEs; other-leader writes
+        # are covered by anti-entropy.
+        self._w_orders = self.store.watch(self.ks.dispatch,
+                                          events="delete")
         self._w_alone = self.store.watch(self._alone_pfx)
 
     def _all_watches(self):
@@ -297,11 +347,18 @@ class SchedulerService:
             self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
             self._meta_updates[row] = (job.exclusive,
                                        job.avg_time if job.avg_time > 0 else 1.0)
+            if _WIRE_SAFE(rule.id):
+                # default ids are next_id() hex: skip the json encoder
+                # (measured at 1M-job load scale)
+                payload = '{"rule":"%s","kind":%d}' % (rule.id, job.kind)
+            else:
+                payload = json.dumps({"rule": rule.id, "kind": job.kind},
+                                     separators=(",", ":"))
             self._row_dispatch[row] = (
-                job.exclusive,
-                json.dumps({"rule": rule.id, "kind": job.kind},
-                           separators=(",", ":")),
-                group, job_id, job.kind)
+                job.exclusive, payload,
+                group, job_id, job.kind,
+                f"/{group}/{job_id}")   # precomputed key tail: the
+                                        # order-build loop is concat-only
         for rule_id in old_rules - new_rules:
             self._drop_rule(group, job_id, rule_id)
 
@@ -453,21 +510,22 @@ class SchedulerService:
                 self._apply_job(ev.kv.key, ev.kv.value)
         # execution-state mirrors: proc registry (leased keys expire ->
         # DELETE events age dead executions out), outstanding exclusive
-        # orders, Alone lifetime locks
+        # orders (delete-only watch: own puts mirrored at submit), Alone
+        # lifetime locks
         for ev in self._w_procs.drain():
             if ev.type == DELETE:
-                self._procs.pop(ev.kv.key, None)
+                self._acct_del(self._procs, ev.kv.key)
             else:
                 t = self._parse_proc(ev.kv.key)
                 if t:
-                    self._procs[ev.kv.key] = t
+                    self._acct_add(self._procs, ev.kv.key, *t)
         for ev in self._w_orders.drain():
             if ev.type == DELETE:
-                self._orders.pop(ev.kv.key, None)
-            else:
-                t = self._parse_order(ev.kv.key)
+                self._acct_del(self._orders, ev.kv.key)
+            else:       # defensive: the delete-only filter should
+                t = self._parse_order(ev.kv.key)       # suppress these
                 if t:
-                    self._orders[ev.kv.key] = t
+                    self._acct_add(self._orders, ev.kv.key, *t)
         for ev in self._w_alone.drain():
             jid = ev.kv.key[len(self._alone_pfx):]
             if ev.type == DELETE:
@@ -491,20 +549,116 @@ class SchedulerService:
         node_id, _epoch, group, job_id = rest
         return node_id, group, job_id
 
-    def _mirror_antientropy(self):
-        """Ground-truth re-list of the execution-state mirrors.  Runs at
-        boot, on watch loss (via resync -> _load_initial) and every
-        ``mirror_resync_s`` — between runs the mirrors advance purely on
-        watch deltas, so steady-state step() issues O(delta) store ops
-        instead of re-serializing every outstanding key per second."""
-        self._procs = {kv.key: t for kv in self.store.get_prefix(self.ks.proc)
-                       if (t := self._parse_proc(kv.key))}
-        self._orders = {kv.key: t
-                        for kv in self.store.get_prefix(self.ks.dispatch)
-                        if (t := self._parse_order(kv.key))}
-        self._alone_live = {kv.key[len(self._alone_pfx):]
-                            for kv in self.store.get_prefix(self._alone_pfx)}
+    # -- incremental execution-state accounting ---------------------------
+
+    def _acct_add(self, mirror: Dict[str, Tuple[str, float, bool]],
+                  key: str, node_id: str, group: str, job_id: str):
+        """Mirror + counter add.  Cost/exclusivity are FROZEN at entry
+        time (the matching delete must decrement what the add
+        incremented, not whatever the job's EWMA says later); drift from
+        later job edits washes out at the next anti-entropy."""
+        if key in mirror:
+            return
+        job = self.jobs.get((group, job_id))
+        cost = job.avg_time if job and job.avg_time > 0 else 1.0
+        excl = bool(job and job.exclusive)
+        mirror[key] = (node_id, cost, excl)
+        self._load_sum[node_id] = self._load_sum.get(node_id, 0.0) + cost
+        if excl:
+            self._excl_cnt[node_id] = self._excl_cnt.get(node_id, 0) + 1
+
+    def _acct_del(self, mirror: Dict[str, Tuple[str, float, bool]],
+                  key: str):
+        ent = mirror.pop(key, None)
+        if ent is None:
+            return
+        node_id, cost, excl = ent
+        s = self._load_sum.get(node_id, 0.0) - cost
+        if s > 1e-9:
+            self._load_sum[node_id] = s
+        else:
+            self._load_sum.pop(node_id, None)
+        if excl:
+            n = self._excl_cnt.get(node_id, 0) - 1
+            if n > 0:
+                self._excl_cnt[node_id] = n
+            else:
+                self._excl_cnt.pop(node_id, None)
+
+    def _build_mirrors(self):
+        """List the execution-state prefixes into FRESH mirror + counter
+        structures (no live state touched — safe off-thread)."""
+        procs: Dict[str, Tuple[str, float, bool]] = {}
+        orders: Dict[str, Tuple[str, float, bool]] = {}
+        excl: Dict[str, int] = {}
+        load: Dict[str, float] = {}
+
+        def add(mirror, key, node_id, group, job_id):
+            job = self.jobs.get((group, job_id))
+            cost = job.avg_time if job and job.avg_time > 0 else 1.0
+            mirror[key] = (node_id, cost, bool(job and job.exclusive))
+            load[node_id] = load.get(node_id, 0.0) + cost
+            if job and job.exclusive:
+                excl[node_id] = excl.get(node_id, 0) + 1
+
+        for kv in self.store.get_prefix(self.ks.proc):
+            t = self._parse_proc(kv.key)
+            if t:
+                add(procs, kv.key, *t)
+        for kv in self.store.get_prefix(self.ks.dispatch):
+            t = self._parse_order(kv.key)
+            if t:
+                add(orders, kv.key, *t)
+        alone = {kv.key[len(self._alone_pfx):]
+                 for kv in self.store.get_prefix(self._alone_pfx)}
+        return procs, orders, alone, excl, load
+
+    def _install_mirrors(self, built):
+        self._procs, self._orders, self._alone_live, \
+            self._excl_cnt, self._load_sum = built
         self._mirror_resync_at = self.clock() + self.mirror_resync_s
+
+    def _mirror_antientropy(self):
+        """Ground-truth re-list of the execution-state mirrors + their
+        counters.  Runs synchronously at boot and on watch loss (via
+        resync -> _load_initial) — between runs the mirrors advance
+        purely on watch deltas plus the leader's own publishes, so
+        steady-state step() issues O(delta) store ops instead of
+        re-serializing every outstanding key per second."""
+        self._install_mirrors(self._build_mirrors())
+
+    def _maybe_antientropy_bg(self):
+        """Periodic anti-entropy WITHOUT stalling the step: the listing
+        (seconds at scale when millions of leased orders are
+        outstanding) runs on a helper thread; the step installs the
+        finished snapshot on a later iteration.  Deltas that land while
+        the listing runs can be missed by the snapshot — bounded drift,
+        healed by the next round (and every key involved is leased, so
+        errors also age out by TTL)."""
+        if self._ae_result is not None:
+            built, self._ae_result = self._ae_result, None
+            self._ae_thread = None
+            self._install_mirrors(built)
+            if self._ae_rekick:
+                # the installed snapshot was listed before a takeover:
+                # schedule a fresh listing immediately, not in 30 s
+                self._ae_rekick = False
+                self._mirror_resync_at = 0.0
+            return
+        if self._ae_thread is not None or \
+                self.clock() < self._mirror_resync_at:
+            return
+
+        def run():
+            try:
+                self._ae_result = self._build_mirrors()
+            except Exception as e:  # noqa: BLE001 — retry next period
+                log.warnf("anti-entropy listing failed: %s", e)
+                self._ae_thread = None
+                self._mirror_resync_at = self.clock() + 5.0
+        self._ae_thread = threading.Thread(target=run, daemon=True,
+                                           name="sched-antientropy")
+        self._ae_thread.start()
 
     @staticmethod
     def _pad_pow2(rows: np.ndarray, *arrays):
@@ -551,28 +705,18 @@ class SchedulerService:
     # ---- capacity reconciliation ----------------------------------------
 
     def reconcile_capacity(self):
-        """Derive per-node running load from the (leased) proc registry
-        PLUS still-outstanding dispatch orders (written but not yet picked
+        """Refresh per-node capacity/load on device from the incremental
+        counters the mirrors maintain: proc registry (running) PLUS
+        still-outstanding dispatch orders (written but not yet picked
         up / started — agents keep the order key until the proc key
         exists), so a node at capacity can't be over-committed during the
         dispatch->spawn gap.  Crash-safe by construction: procs of dead
         nodes expire with their lease (reference proc.go:21-35 ProcTtl),
         orders with the dispatch lease — both expirations arrive as watch
-        DELETEs into the mirrors this reads."""
-        running_excl: Dict[str, int] = {}
-        running_load: Dict[str, float] = {}
-
-        def account(node_id: str, group: str, job_id: str):
-            job = self.jobs.get((group, job_id))
-            cost = (job.avg_time if job and job.avg_time > 0 else 1.0)
-            running_load[node_id] = running_load.get(node_id, 0.0) + cost
-            if job and job.exclusive:
-                running_excl[node_id] = running_excl.get(node_id, 0) + 1
-
-        for node_id, group, job_id in self._procs.values():
-            account(node_id, group, job_id)
-        for node_id, group, job_id in self._orders.values():
-            account(node_id, group, job_id)
+        DELETEs that decrement the counters.  O(nodes) per step; the
+        old O(outstanding) re-iteration was 548 ms/step at 1M (r4)."""
+        running_excl = self._excl_cnt
+        running_load = self._load_sum
         cols, caps = [], []
         loads = np.zeros(self.planner.N, np.float32)
         for node_id, col in self.universe.index.items():
@@ -589,13 +733,25 @@ class SchedulerService:
     # ---- planning + dispatch --------------------------------------------
 
     def step(self, now: Optional[int] = None) -> int:
-        """One full cycle; returns the number of dispatches written.
+        """One full cycle; returns the number of dispatches submitted.
 
         If planning fell behind wall-clock (leader failover, a recompile
         stall), the missed seconds are planned late rather than skipped —
         the reference fires late too, never never (cron.go:212-215) — up to
         ``max_catchup_s`` back; anything older is dropped and counted in
-        ``stats['skipped_seconds']``."""
+        ``stats['skipped_seconds']``.
+
+        Two overlaps keep the step off the plane's critical path:
+        - the bulk publish rides the async :class:`OrderPublisher`
+          (oldest-second-first, HWM advanced per landed second) and only
+          re-enters the step latency as ``publish_wait`` when the plane
+          can't keep up;
+        - the NEXT window's device plan is dispatched before this
+          window's orders are built, so the device computes while the
+          host strings and ships — job/capacity updates therefore take
+          effect one window later than they land, the same latency class
+          as the planning horizon itself.
+        """
         now = int(now if now is not None else self.clock())
         t_step = time.perf_counter()
         spans = {}
@@ -611,15 +767,40 @@ class SchedulerService:
         # within one step (VERDICT r3 #3)
         self.drain_watches()
         t = span("drain", t_step)
-        if self.clock() >= self._mirror_resync_at:
-            self._mirror_antientropy()
+        self._maybe_antientropy_bg()
+        led_before = self.is_leader
         if not self.try_lead():
             self._next_epoch = None
+            self._pending_plan = None
             self._flush_device()
+            if not self._warmed and hasattr(self.planner, "warm_window"):
+                # compile (and disk-cache) the plan program NOW: the r4
+                # takeover paid tens of seconds of XLA compile as
+                # dispatch outage before its first catch-up plan
+                try:
+                    self.planner.warm_window(now + 1, max(1, self.window_s))
+                except Exception as e:  # noqa: BLE001 — standby stays up
+                    log.warnf("standby warm compile failed: %s", e)
+                self._warmed = True
             # standbys still publish (throttled): "is my failover target
             # alive" is an operator question too
             self.metrics.maybe_publish()
             return 0
+        self._warmed = True     # leading compiles as it goes
+        if not led_before:
+            # fresh leadership: the delete-only orders watch never
+            # echoed the PREVIOUS leader's publishes, so kick an
+            # anti-entropy listing now.  Until it installs (a step or
+            # two), outstanding foreign orders may be under-counted —
+            # bounded over-commit the agent-side Parallels gate absorbs
+            # (skip-not-queue, reference job.go:165-187); exactly-once
+            # is fence-guaranteed regardless.  A listing already in
+            # flight may predate the takeover: flag a re-kick so the
+            # NEXT listing starts from post-takeover ground truth.
+            self._mirror_resync_at = 0.0
+            if self._ae_thread is not None:
+                self._ae_rekick = True
+            self._maybe_antientropy_bg()
         self.reconcile_capacity()
         t = span("reconcile", t)
         self._flush_device()
@@ -638,17 +819,37 @@ class SchedulerService:
                     start = min(int(hwm_kv.value), start + 3600)
                 except ValueError:
                     pass
+        fe = self.publisher.take_failed_epoch()
+        if fe is not None and fe < start:
+            # a window's publish failed after retries: the HWM stopped
+            # there, and so must the in-memory cursor — rewind and
+            # re-plan from the hole (late, never lost; re-published
+            # duplicates are absorbed by fences/broadcast dedup)
+            log.warnf("publish hole at epoch %d; rewinding plan cursor "
+                      "from %d", fe, start)
+            start = fe
         if start < now + 1 - self.max_catchup_s:
             self.stats["skipped_seconds"] += (now + 1 - self.max_catchup_s
                                               - start)
             start = now + 1 - self.max_catchup_s
         window = max(1, self.window_s)
         t_plan = time.perf_counter()
-        plans = self.planner.plan_window(start, window)
+        if self._pending_plan is not None and self._pending_plan[0] == start:
+            plans = self.planner.gather_window(self._pending_plan[1])
+        else:
+            plans = self.planner.plan_window(start, window)
+        self._pending_plan = None
         self._tick_ms.append((time.perf_counter() - t_plan) * 1e3)
         del self._tick_ms[:-128]
         t = span("plan", t_plan)
         self._next_epoch = start + window
+        # prefetch: next window's plan on device while THIS window's
+        # orders are built and shipped (duck-typed: the mesh planners'
+        # collective plan is a synchronized call and stays one)
+        if hasattr(self.planner, "plan_window_async"):
+            self._pending_plan = (
+                self._next_epoch,
+                self.planner.plan_window_async(self._next_epoch, window))
         # KindAlone lifetime exclusion: don't dispatch an Alone job whose
         # running lock is still live anywhere (reference job.go:87-123);
         # the watch-fed mirror replaces a per-step prefix scan
@@ -658,8 +859,10 @@ class SchedulerService:
         disp_pfx = self.ks.dispatch
         bcast_pfx = self.ks.dispatch_all
         n_cols = len(col_node)
-        orders: List[Tuple[str, str]] = []
         lease = self.store.grant(self.dispatch_ttl)
+        seconds: List[Tuple[int, list]] = []
+        excl_acct: List[Tuple[str, str, str, str]] = []
+        n_dispatch = 0
         for plan in plans:
             if plan.overflow:
                 # never drop a fire: re-plan this second with a bucket
@@ -670,44 +873,60 @@ class SchedulerService:
             # per-fire work is one dict lookup + string concat: payload
             # and routing were precomputed into _row_dispatch by the job
             # watch handlers (this loop IS the leader's share of the
-            # dispatch plane — at 20k fires/tick it must stay tight)
+            # dispatch plane — at 20k fires/tick it must stay tight).
+            # fired[:n_excl] are the exclusive placements, the rest
+            # Common fan-outs — no per-fire kind branch.
             ep = str(plan.epoch_s)
-            for row, node_col in zip(plan.fired.tolist(),
-                                     plan.assigned.tolist()):
+            fired = plan.fired.tolist()
+            assigned = plan.assigned.tolist()
+            nx = plan.n_excl
+            orders: List[Tuple[str, str]] = []
+            for row, node_col in zip(fired[:nx], assigned[:nx]):
                 ent = row_disp.get(row)
                 if ent is None:
                     continue
-                exclusive, payload, group, job_id, kind = ent
+                _, payload, group, job_id, kind, suffix = ent
                 if kind == KIND_ALONE and job_id in alone_live:
                     continue   # previous run still holds the fleet lock
-                if exclusive:
-                    if 0 <= node_col < n_cols:
-                        node = col_node[node_col]
-                        if node:
-                            orders.append((
-                                f"{disp_pfx}{node}/{ep}/{group}/{job_id}",
-                                payload))
-                else:
-                    # Common fan-out: ONE broadcast order; eligible agents
-                    # each pick it up via their local IsRunOn — the host
-                    # never walks the [J, N] matrix per fire
-                    orders.append((
-                        f"{bcast_pfx}{ep}/{group}/{job_id}", payload))
+                if 0 <= node_col < n_cols:
+                    node = col_node[node_col]
+                    if node:
+                        key = f"{disp_pfx}{node}/{ep}{suffix}"
+                        orders.append((key, payload))
+                        excl_acct.append((key, node, group, job_id))
+            for row in fired[nx:]:
+                ent = row_disp.get(row)
+                if ent is None:
+                    continue
+                _, payload, group, job_id, kind, suffix = ent
+                if kind == KIND_ALONE and job_id in alone_live:
+                    continue
+                # Common fan-out: ONE broadcast order; eligible agents
+                # each pick it up via their local IsRunOn — the host
+                # never walks the [J, N] matrix per fire
+                orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
+            n_dispatch += len(orders)
+            seconds.append((plan.epoch_s, orders))
         t = span("build", t)
-        if orders:
-            # one bulk write for the whole window — the dispatch plane is
-            # one store round trip, not one per (node, second, job)
-            self.store.put_many(orders, lease=lease)
-        n_dispatch = len(orders)
-        # Persist the high-water mark only AFTER the orders are in the
-        # store (a crash in between re-plans the window — a rare double
-        # fire beats silently missing it), and monotonically via CAS so a
-        # deposed-but-stalled leader can't regress the new leader's mark.
-        self._advance_hwm(self._next_epoch)
-        span("publish", t)
+        # hand the window to the async publisher: oldest second first,
+        # HWM advanced after each second lands (the publisher owns the
+        # write-then-mark ordering: a crash in between re-plans the
+        # unpublished tail — a rare double fire beats silently missing
+        # it; the mark itself is a monotone CAS so a deposed leader
+        # can't regress the new one's progress)
+        wait_s = self.publisher.submit(seconds, lease, self._next_epoch)
+        if self.sync_publish:
+            self.publisher.flush()
+        # mirror own publishes locally (the orders watch is delete-only:
+        # our puts are not echoed back at us)
+        for key, node, group, job_id in excl_acct:
+            self._acct_add(self._orders, key, node, group, job_id)
+        spans["publish"] = wait_s * 1e3   # backpressure only; the wire
+                                          # time is publish_window_ms in
+                                          # the metrics snapshot
         # full-cycle latency distribution: everything a real tick pays
         # (watch drain + reconcile + device flush + plan + order build +
-        # bulk publish), not just the planner call (VERDICT r3 #4)
+        # publish handoff/backpressure), not just the planner call
         spans["total"] = (time.perf_counter() - t_step) * 1e3
         self._step_spans = spans
         self._step_ms.append(spans["total"])
@@ -771,6 +990,12 @@ class SchedulerService:
             "procs_running": len(self._procs),
             "jobs": len(self.jobs),
             "is_leader": 1 if self.is_leader else 0,
+            # plane-side publish health: per-window wire time and the
+            # published/dropped totals (the step only shows backpressure)
+            "publish_window_ms": round(self.publisher.last_window_ms, 3),
+            "published_total": self.publisher.stats["published_total"],
+            "publish_failures": self.publisher.stats["publish_failures"],
+            "published_through": self.publisher.published_through,
         }
 
     def _advance_hwm(self, value: int):
@@ -826,7 +1051,19 @@ class SchedulerService:
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        # abdicate FIRST (a successor can take over while our in-flight
+        # windows drain), THEN drain: seconds the successor re-plans
+        # because our HWM advance raced it produce duplicate orders,
+        # which the (job, second) fences / broadcast dedup absorb — the
+        # same late-never-lost tradeoff as the crash path, minus the
+        # lease-TTL wait
         if self._leader_lease is not None:
             self.store.revoke(self._leader_lease)
             self._leader_lease = None
+        self.publisher.stop()
+        for lane in self._owned_lanes:
+            try:
+                lane.close()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
         self.metrics.revoke()

@@ -140,14 +140,22 @@ class Watcher(LossyEventStream):
     MAX_BACKLOG = 1 << 17
 
     def __init__(self, store: "MemStore", prefix: str, start_rev: int,
-                 max_backlog: int = MAX_BACKLOG):
+                 max_backlog: int = MAX_BACKLOG, events: str = ""):
         super().__init__(prefix)
         self._store = store
         self.start_rev = start_rev
         self._max_backlog = max_backlog
+        # "" = all event types; "delete" = DELETE only.  A writer
+        # watching its own output prefix (the scheduler mirrors
+        # outstanding orders it publishes by the tens of thousands per
+        # window) would otherwise get every one of its own puts pushed
+        # back, serialized and re-parsed, for nothing.
+        self.events = events
 
     def _emit(self, ev: Event):
         if self._closed:
+            return
+        if self.events == "delete" and ev.type != DELETE:
             return
         if self._q.qsize() >= self._max_backlog:
             self.lost = True
@@ -431,16 +439,20 @@ class MemStore:
     # ---- watch -----------------------------------------------------------
 
     def watch(self, prefix: str, start_rev: int = 0,
-              max_backlog: Optional[int] = None) -> Watcher:
+              max_backlog: Optional[int] = None,
+              events: str = "") -> Watcher:
         """Watch a prefix.  With ``start_rev`` > 0, replay retained events
         with mod_rev >= start_rev first (etcd WithRev) — a reconnecting
         watcher resumes without losing deltas.  Raises
         :class:`CompactedError` if the bounded history no longer reaches
         back that far, and :class:`WatchLost` if the replay itself
-        overflows ``max_backlog`` (re-list instead)."""
+        overflows ``max_backlog`` (re-list instead).  ``events="delete"``
+        suppresses PUT pushes server-side (etcd's WithFilterPut): the
+        filter applies to the replay too."""
         with self._lock:
             w = Watcher(self, prefix, start_rev or self._rev,
-                        max_backlog=max_backlog or Watcher.MAX_BACKLOG)
+                        max_backlog=max_backlog or Watcher.MAX_BACKLOG,
+                        events=events)
             if start_rev and start_rev <= self._rev:
                 # every revision 1..rev emitted exactly one event, so the
                 # replay is complete iff the ring still holds start_rev

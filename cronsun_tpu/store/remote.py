@@ -102,8 +102,9 @@ class _Conn(LineJsonHandler):
         try:
             if op == "watch":
                 prefix, start_rev = args[0], args[1]
-                w = store.watch(prefix, start_rev=start_rev) \
-                    if start_rev else store.watch(prefix)
+                events = args[2] if len(args) > 2 else ""
+                w = store.watch(prefix, start_rev=start_rev or 0,
+                                events=events)
                 wid = rid
                 t = threading.Thread(target=self._pump, args=(wid, w),
                                      daemon=True,
@@ -187,11 +188,12 @@ class RemoteWatcher(LossyEventStream):
     via the shared LossyEventStream base) as memstore.Watcher."""
 
     def __init__(self, store: "RemoteStore", wid: int, prefix: str,
-                 start_rev: int = 0):
+                 start_rev: int = 0, events: str = ""):
         super().__init__(prefix)
         self._store = store
         self._wid = wid
         self.start_rev = start_rev
+        self.events = events       # "" all / "delete" only (re-watch too)
         self.last_rev = 0          # highest mod_rev seen (resume point)
 
     def _emit(self, ev: Event):
@@ -299,7 +301,12 @@ class RemoteStore:
             if ev is not None:
                 self._pending[rid] = msg
                 ev.set()
-        # connection gone: fail in-flight calls, then heal or finalize
+        # connection gone: unpublish the socket FIRST (new calls fail
+        # fast instead of sendall-ing into a dead TCP buffer and waiting
+        # out the full rpc timeout with no reader left to fail them),
+        # then fail in-flight calls
+        if self._sock is sock:
+            self._sock = None
         for rid, ev in list(self._pending_ev.items()):
             self._pending.setdefault(rid, {"e": "connection closed",
                                            "k": "RemoteStoreError"})
@@ -340,7 +347,8 @@ class RemoteStore:
             resume = w.last_rev + 1 if w.last_rev else 0
             try:
                 try:
-                    self._call("watch", w.prefix, resume, rid=wid)
+                    self._call("watch", w.prefix, resume, w.events,
+                               rid=wid)
                 except (CompactedError, WatchLost):
                     # the gap is unrecoverable: deltas are gone.  Don't
                     # silently re-watch from current — surface WatchLost
@@ -375,6 +383,14 @@ class RemoteStore:
                     sock.sendall(data)
             except OSError as e:
                 raise RemoteStoreError(f"send failed: {e}")
+            if self._sock is not sock and sock_override is None \
+                    and not done.is_set():
+                # the connection died between our socket read and the
+                # send: its reader's in-flight sweep ran before this rid
+                # registered a reply could reach, so nobody will ever
+                # fail it — a sendall into the dead socket's buffer
+                # "succeeds" and would wait out the whole rpc timeout
+                raise RemoteStoreError("connection lost mid-call")
             if not done.wait(self._timeout):
                 raise RemoteStoreError(f"rpc timeout: {op}")
             msg = self._pending.pop(rid)
@@ -461,16 +477,17 @@ class RemoteStore:
 
     # -- watch -------------------------------------------------------------
 
-    def watch(self, prefix: str, start_rev: int = 0) -> RemoteWatcher:
+    def watch(self, prefix: str, start_rev: int = 0,
+              events: str = "") -> RemoteWatcher:
         with self._id_lock:
             wid = self._next_id          # reserve the id we'll rpc with
             self._next_id += 1
         # register the watcher BEFORE the rpc returns so no event races
         # past the registration (the server keys pushes by the request id)
-        w = RemoteWatcher(self, wid, prefix, start_rev)
+        w = RemoteWatcher(self, wid, prefix, start_rev, events)
         self._watchers[wid] = w
         try:
-            self._call("watch", prefix, start_rev, rid=wid)
+            self._call("watch", prefix, start_rev, events, rid=wid)
         except Exception:
             self._watchers.pop(wid, None)
             raise
@@ -484,15 +501,26 @@ class RemoteStore:
             except (RemoteStoreError, KeyError):
                 pass
 
+    def clone(self) -> "RemoteStore":
+        """A fresh connection to the same server with the same auth/TLS
+        — publisher lanes shard bulk writes over several of these."""
+        return RemoteStore(self.host, self.port, timeout=self._timeout,
+                          reconnect=self._reconnect, token=self._token,
+                          sslctx=self._sslctx,
+                          tls_hostname=self._tls_hostname)
+
     # -- lifecycle ---------------------------------------------------------
 
     def close(self):
         self._closed = True
+        sock = self._sock      # may be None mid-heal
+        if sock is None:
+            return
         try:
-            self._sock.shutdown(socket.SHUT_RDWR)
+            sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        self._sock.close()
+        sock.close()
 
     # MemStore compat no-op: the server owns the sweeper
     def start_sweeper(self, interval: float = 0.2):

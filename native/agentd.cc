@@ -704,6 +704,8 @@ static constexpr size_t kMaxOutput = 1u << 20;
 
 class Executor {
  public:
+  bool instant_ = false;   // --instant-exec: benchmarking mode
+
   // on_threshold fires once after threshold_s while the child still runs
   // (the ProcReq hook: the proc key is written only for long runs)
   ExecResult run_once(const std::string& command, const std::string& user,
@@ -868,6 +870,18 @@ class Executor {
         if (on_threshold) on_threshold();
       }
     };
+    if (instant_) {
+      // benchmarking mode (--instant-exec): the dispatch-plane sweep
+      // measures the PLANE (claims, proc registry, order consume, log
+      // records), not fork/exec of /bin/true at 10k/s
+      fire_once();
+      ExecResult r;
+      r.begin = r.end = now_s();
+      r.success = true;
+      r.output = "bench";
+      gate_leave(job_id, parallels);
+      return r;
+    }
     ExecResult result =
         run_once(command, user, timeout, threshold_s, fire_once, extra_env);
     int attempts = 0;
@@ -989,6 +1003,8 @@ class Agent {
     for (int i = 0; i < workers; i++)
       std::thread(&Agent::worker, this).detach();
   }
+
+  void set_instant_exec(bool v) { exec_.instant_ = v; }
 
   bool start() {
     if (probe_duplicate() != ProbeResult::kOk) return false;
@@ -1142,6 +1158,22 @@ class Agent {
     jint(snap, running_.load());
     snap += ",\"procs_registered\":";
     jint(snap, (long long)nprocs);
+    {
+      std::lock_guard<std::mutex> lg(lag_mu_);
+      if (!lag_ring_.empty()) {
+        std::vector<double> v(lag_ring_);
+        std::sort(v.begin(), v.end());
+        auto q = [&](double p) {
+          size_t i = (size_t)(p * v.size());
+          if (i >= v.size()) i = v.size() - 1;
+          return v[i];
+        };
+        snap += ",\"exec_start_lag_p50_s\":";
+        jdbl(snap, q(0.50));
+        snap += ",\"exec_start_lag_p99_s\":";
+        jdbl(snap, q(0.99));
+      }
+    }
     snap += "}";
     store_.put(pfx_ + "/metrics/node/" + id_, snap, metrics_lease_);
   }
@@ -1368,6 +1400,16 @@ class Agent {
 
   void execute(const JobSpec& j, long long epoch, bool fenced, bool gate,
                const std::string& order_key) {
+    {
+      // scheduled second -> exec start: the end-to-end dispatch SLA
+      // (orders arrive ahead of time and are held to their instant, so
+      // this is pure plane latency) — published as p50/p99
+      double lag = now_s() - (double)epoch;
+      if (lag < 0) lag = 0;
+      std::lock_guard<std::mutex> lg(lag_mu_);
+      lag_ring_.push_back(lag);
+      if (lag_ring_.size() > 512) lag_ring_.erase(lag_ring_.begin());
+    }
     running_++;
     struct Dec {
       std::atomic<long long>& c;
@@ -1755,6 +1797,8 @@ class Agent {
   long long lease_ = 0, proc_lease_ = 0;
   std::mutex procs_mu_;
   std::map<std::string, std::string> procs_;
+  std::mutex lag_mu_;
+  std::vector<double> lag_ring_;
   std::mutex fence_mu_;
   long long fence_lease_ = 0;
   double fence_rotate_at_ = 0;
@@ -1793,6 +1837,7 @@ int main(int argc, char** argv) {
   std::string node_id, prefix = "/cronsun";
   std::string store_token, log_token;
   double ttl = 10, proc_ttl = 600, lock_ttl = 300, proc_req = 5;
+  bool instant_exec = false;
   int workers = 64;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -1808,6 +1853,7 @@ int main(int argc, char** argv) {
     else if (a == "--workers") workers = atoi(next());
     else if (a == "--store-token") store_token = next();
     else if (a == "--log-token") log_token = next();
+    else if (a == "--instant-exec") instant_exec = true;
     else if (a == "--die-with-parent") {
       prctl(PR_SET_PDEATHSIG, SIGKILL);
       if (getppid() == 1) return 1;
@@ -1816,7 +1862,7 @@ int main(int argc, char** argv) {
       printf("cronsun-agentd --store H:P --logsink H:P --node-id ID "
              "[--prefix /cronsun] [--ttl S] [--proc-ttl S] [--lock-ttl S] "
              "[--proc-req S] [--workers N] [--store-token T] "
-             "[--log-token T] [--die-with-parent]\n");
+             "[--log-token T] [--die-with-parent] [--instant-exec]\n");
       return 0;
     }
   }
@@ -1881,6 +1927,7 @@ int main(int argc, char** argv) {
   LogClient logd(lh, lp, log_token);
   Agent agent(store, logd, node_id, prefix, ttl, proc_ttl, lock_ttl,
               proc_req, workers);
+  agent.set_instant_exec(instant_exec);
   if (!agent.start()) return 1;
   printf("READY %s\n", node_id.c_str());
   fflush(stdout);

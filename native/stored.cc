@@ -74,6 +74,9 @@ struct Sink {
   Conn* conn;
   long long wid;
   std::string prefix;
+  // "delete"-only filter (etcd WithFilterPut): a writer watching its
+  // own output prefix must not get its own bulk puts pushed back
+  bool delete_only = false;
 };
 
 static void kv_wire(std::string& out, const std::string& key, const KVRec& kv) {
@@ -720,12 +723,24 @@ struct Conn : std::enable_shared_from_this<Conn> {
   Store* store;
   std::mutex omu;
   std::condition_variable ocv;
-  std::deque<std::string> outbox;
+  // (payload, is_reply): one writer thread drains both kinds in FIFO.
+  std::deque<std::pair<std::string, bool>> outbox;
+  size_t push_bytes = 0;    // queued watch-push bytes
+  size_t reply_bytes = 0;   // queued rpc-reply bytes
   bool dead = false;
   bool authed = true;   // set false at accept time when a token is required
-  // a consumer this far behind has lost the stream anyway; cut it rather
-  // than grow without bound (etcd cancels slow watchers the same way)
-  static constexpr size_t kMaxOutbox = 1u << 20;
+  // WATCH pushes: a consumer this far behind has lost the stream anyway;
+  // cut it rather than grow without bound (etcd cancels slow watchers
+  // the same way).  BYTE-bounded, not message-bounded: a mass lease
+  // expiry legitimately bursts hundreds of thousands of small DELETE
+  // events at a healthy watcher in one sweep.
+  static constexpr size_t kMaxPushBytes = 512u << 20;
+  // RPC replies are OWED (a reply per in-flight request, never dropped);
+  // instead of killing, the handler thread BLOCKS — backpressure on the
+  // connection's own request stream — while the client is this far
+  // behind on reply bytes.  A 1M-key get_prefix reply (~hundreds of MB)
+  // passes; a client pipelining unbounded giant listings stalls itself.
+  static constexpr size_t kReplyHighWater = 1u << 30;
 
   Conn(int f, Store* s) : fd(f), store(s) {}
 
@@ -743,12 +758,32 @@ struct Conn : std::enable_shared_from_this<Conn> {
   void enqueue(std::string msg) {
     std::lock_guard<std::mutex> g(omu);
     if (dead) return;
-    if (outbox.size() >= kMaxOutbox) {
+    if (push_bytes + msg.size() > kMaxPushBytes) {
       dead = true;  // writer notices and closes
       ocv.notify_all();
       return;
     }
-    outbox.push_back(std::move(msg));
+    push_bytes += msg.size();
+    outbox.emplace_back(std::move(msg), false);
+    ocv.notify_all();
+  }
+
+  void enqueue_reply(std::string msg) {
+    std::unique_lock<std::mutex> g(omu);
+    // block (don't kill) while the client is behind on reply bytes —
+    // this is the connection's own reader thread, so the backpressure
+    // lands exactly on the stream that caused it; a push-overflow kill
+    // (dead) releases the wait
+    ocv.wait(g, [&] {
+      // reply_bytes == 0 must pass even for an over-high-water single
+      // message (a >1 GiB listing reply) — otherwise the wait can
+      // never be satisfied and the reader thread wedges forever
+      return dead || reply_bytes == 0 ||
+             reply_bytes + msg.size() <= kReplyHighWater;
+    });
+    if (dead) return;
+    reply_bytes += msg.size();
+    outbox.emplace_back(std::move(msg), true);
     ocv.notify_all();
   }
 
@@ -760,12 +795,25 @@ struct Conn : std::enable_shared_from_this<Conn> {
         ocv.wait(g, [this] { return dead || !outbox.empty(); });
         if (dead && outbox.empty()) break;
         if (dead) break;  // dropped for overflow: don't flush
-        msg = std::move(outbox.front());
+        auto take = [&] {
+          auto& [m, is_reply] = outbox.front();
+          (is_reply ? reply_bytes : push_bytes) -= m.size();
+          return std::move(m);
+        };
+        msg = take();
         outbox.pop_front();
+        // coalesce queued messages into one send: an expiry burst of
+        // 100k+ tiny DELETE pushes must not cost 100k+ syscalls
+        while (!outbox.empty() && msg.size() < (256u << 10)) {
+          msg += take();
+          outbox.pop_front();
+        }
+        ocv.notify_all();   // blocked enqueue_reply callers re-check
       }
       size_t off = 0;
       while (off < msg.size()) {
-        ssize_t n = ::send(fd, msg.data() + off, msg.size() - off, MSG_NOSIGNAL);
+        ssize_t n = ::send(fd, msg.data() + off, msg.size() - off,
+                           MSG_NOSIGNAL);
         if (n <= 0) {
           std::lock_guard<std::mutex> g(omu);
           dead = true;
@@ -793,6 +841,7 @@ void Store::notify_locked(Ev ev) {
   std::string body;
   ev_wire(body, ev);
   for (const Sink& s : sinks_) {
+    if (s.delete_only && !ev.is_delete) continue;
     if (ev.key.size() >= s.prefix.size() &&
         memcmp(ev.key.data(), s.prefix.data(), s.prefix.size()) == 0) {
       std::string msg = "{\"w\":";
@@ -817,6 +866,7 @@ void Store::watch(Sink sink, long long start_rev) {
       throw CompactedErr{"start_rev " + std::to_string(start_rev) + " compacted (oldest retained " +
                          std::to_string(oldest) + ")"};
     for (const Ev& ev : history_) {
+      if (sink.delete_only && !ev.is_delete) continue;
       if (ev.kv.mod_rev >= start_rev && ev.key.size() >= sink.prefix.size() &&
           memcmp(ev.key.data(), sink.prefix.data(), sink.prefix.size()) == 0) {
         std::string msg = "{\"w\":";
@@ -867,11 +917,11 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
     if (op == "auth" && token_eq(arg_s(args, 0), g_token)) {
       c->authed = true;
       out += ",\"r\":true}\n";
-      c->enqueue(std::move(out));
+      c->enqueue_reply(std::move(out));
       return;
     }
     out += ",\"e\":\"unauthenticated\",\"k\":\"RuntimeError\"}\n";
-    c->enqueue(std::move(out));
+    c->enqueue_reply(std::move(out));
     c->kill();
     return;
   }
@@ -932,14 +982,16 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       if (c->store->lease_ttl_remaining(arg_i(args, 0), rem)) jdbl(res, rem);
       else res = "null";
     } else if (op == "watch") {
-      c->store->watch(Sink{c.get(), rid, arg_s(args, 0)}, arg_i(args, 1));
+      c->store->watch(Sink{c.get(), rid, arg_s(args, 0),
+                           arg_s(args, 2) == "delete"},
+                      arg_i(args, 1));
       jint(res, rid);
     } else if (op == "unwatch") {
       c->store->unwatch(c.get(), arg_i(args, 0));
       res = "true";
     } else {
       out += ",\"e\":\"unknown op\",\"k\":\"ValueError\"}\n";
-      c->enqueue(std::move(out));
+      c->enqueue_reply(std::move(out));
       return;
     }
     out += ",\"r\":";
@@ -958,7 +1010,7 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
     out += ",\"k\":\"RuntimeError\"";
   }
   out += "}\n";
-  c->enqueue(std::move(out));
+  c->enqueue_reply(std::move(out));
 }
 
 static void reader(std::shared_ptr<Conn> c) {

@@ -103,13 +103,15 @@ def run_bench(rates, n_agents, seconds, on_log=print):
                          and os.path.exists(agentd))
     for nid in node_ids:
         if use_native_agents:
-            # the native agent REALLY fork/execs each order's command
-            # (true) — the fully end-to-end number, no stub executor
+            # --instant-exec: the C++ agent skips the fork/exec and
+            # returns success instantly — symmetric with the Python
+            # workers' InstantExecutor, so the two curves compare the
+            # PLANE cost per agent, not fork throughput
             p = subprocess.Popen(
                 [agentd, "--store",
                  f"{store_srv.host}:{store_srv.port}",
                  "--logsink", f"{logd.host}:{logd.port}",
-                 "--node-id", nid, "--proc-req", "5"],
+                 "--node-id", nid, "--proc-req", "5", "--instant-exec"],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         else:
             p = subprocess.Popen(
@@ -193,11 +195,14 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             got = done - delivered_before
             delivered_before = done
             consume_rate = got / elapsed
+            # kept_up is a RATE claim, not a drain claim (VERDICT r4
+            # #6): a plane that eventually drains everything late is
+            # not keeping up.  Sustained consume-rate must match the
+            # offered rate within 5%.
             per_rate.append({"offered_per_s": rate, "consumed": got,
                              "offered": offered,
                              "consume_rate_per_s": round(consume_rate, 1),
-                             "kept_up": got >= offered * 0.95
-                             and elapsed <= seconds * 1.5})
+                             "kept_up": consume_rate >= rate * 0.95})
             on_log(f"  consumed {got}/{offered} in {elapsed:.1f}s "
                    f"-> {consume_rate:.0f}/s")
             # drain any stragglers before the next rate
@@ -205,13 +210,30 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             delivered_before = sink.stat_overall()["total"]
 
         sustained = max(r["consume_rate_per_s"] for r in per_rate)
+        # saturation = the highest offered rate the plane still matched
+        # (NOT the highest it eventually drained)
         kept = [r["offered_per_s"] for r in per_rate if r["kept_up"]]
         saturation = max(kept) if kept else 0
+        # end-to-end SLA: scheduled second -> exec start, as published
+        # by the (real) agents' metrics snapshots.  The ring holds the
+        # most recent executions, i.e. the highest swept rate — the
+        # worst case, which is the honest one to quote.
+        lag_p50, lag_p99 = [], []
+        for kv in store.get_prefix(ks.metrics + "node/"):
+            m = json.loads(kv.value)
+            if "exec_start_lag_p99_s" in m:
+                lag_p50.append(m["exec_start_lag_p50_s"])
+                lag_p99.append(m["exec_start_lag_p99_s"])
         results.update({
             "dispatch_plane_sweep": per_rate,
             "dispatch_plane_orders_per_sec": round(sustained, 1),
             "dispatch_plane_saturation_offered_per_sec": saturation,
         })
+        if lag_p99:
+            results.update({
+                "dispatch_plane_exec_lag_p50_s": max(lag_p50),
+                "dispatch_plane_exec_lag_p99_s": max(lag_p99),
+            })
     finally:
         for p in agents:
             p.terminate()

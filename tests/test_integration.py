@@ -263,10 +263,14 @@ def test_outstanding_orders_reserve_capacity(world):
     sched.node_caps["node-0"] = 2
     sched.drain_watches()
     sched._flush_device()
-    # an outstanding order written by a (dead) leader, no agent consuming
+    # an outstanding order written by a (dead) leader, no agent
+    # consuming.  The orders watch is delete-only (own publishes are
+    # mirrored at submit), so FOREIGN orders reach the mirror via the
+    # anti-entropy listing — kicked at leadership takeover — not via
+    # watch; run it the way a takeover would.
     store.put(KS.dispatch_key("node-0", 1_753_001_100, job.group, job.id),
               "{}")
-    sched.drain_watches()        # order reaches the watch-fed mirror
+    sched._mirror_antientropy()
     sched.reconcile_capacity()
     import numpy as np
     col = sched.universe.index["node-0"]
@@ -505,4 +509,51 @@ def test_overflow_becomes_late_fires_never_drops():
     assert sched.stats["overflow_late_fires"] >= n_jobs - 2048
     assert sched.stats["overflow_drops"] == 0
     assert sched.metrics_snapshot()["overflow_late_fires_total"] > 0
+    store.close()
+
+
+def test_publish_hole_rewinds_plan_cursor():
+    """A window whose publish ultimately fails must NOT be skipped: the
+    publisher stops advancing the HWM at the hole and the next step
+    rewinds its cursor there and re-plans (late, never lost) — the
+    write-then-mark contract survives the async publisher."""
+    store = MemStore()
+    sink = JobLogStore()
+    sched = SchedulerService(store, job_capacity=256, node_capacity=64,
+                             window_s=2, node_id="hole-sched")
+    agent = NodeAgent(store, sink, node_id="hole-n0")
+    agent.register()
+    job = Job(name="hole", command="echo h", kind=0,
+              rules=[JobRule(id="r", timer="* * * * * *",
+                             nids=["hole-n0"])])
+    job.check()
+    store.put(KS.job_key(job.group, job.id), job.to_json())
+    t0 = 1_753_900_000
+    assert sched.step(now=t0) > 0          # plans [t0+1, t0+2]
+
+    # wedge the publisher's store path: every put_many fails
+    real_put_many = store.put_many
+    fails = {"n": 0}
+
+    def broken(items, lease=0):
+        fails["n"] += 1
+        raise RuntimeError("store down")
+    # MemStore has no clone(), so the publisher's single lane IS this
+    # store object — replacing put_many wedges the publish path
+    assert sched._owned_lanes == []
+    store.put_many = broken
+    sched.step(now=t0 + 2)                 # window [t0+3, t0+4] fails
+    sched.publisher.flush()
+    assert fails["n"] >= 4, "publisher should have retried"
+    store.put_many = real_put_many
+
+    # the cursor must rewind to the hole and republish those seconds
+    n = sched.step(now=t0 + 4)
+    sched.publisher.flush()
+    keys = [kv.key for kv in store.get_prefix(KS.dispatch_all)]
+    missed = [k for k in keys if f"/{t0 + 3}/" in k]
+    assert missed, f"epoch {t0+3} never re-published (orders: {keys})"
+    assert sched.stats["skipped_seconds"] == 0
+    agent.stop()
+    sched.stop()
     store.close()

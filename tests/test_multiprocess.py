@@ -54,10 +54,17 @@ def _await_ready(proc, timeout=90):
             # WEDGE on the full pipe and never resume dispatching
             # (exactly the failure the crash tests then misreport)
             import threading
+            keep = os.environ.get("TEST_KEEP_LOGS")
 
-            def _drain(f=proc.stdout):
-                for _ in f:
-                    pass
+            def _drain(f=proc.stdout, pid=proc.pid):
+                if keep:
+                    with open(f"{keep}/{pid}.log", "w") as out:
+                        for ln in f:
+                            out.write(ln)
+                            out.flush()
+                else:
+                    for _ in f:
+                        pass
             threading.Thread(target=_drain, daemon=True).start()
             return line.split(None, 1)[1].strip()
     raise AssertionError(f"no READY within {timeout}s:\n{''.join(lines)}")

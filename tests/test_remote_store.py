@@ -608,3 +608,38 @@ def test_get_many(remote):
     out = s.get_many(["/gm/a", "/gm/missing", "/gm/b"])
     assert out[0].value == "1" and out[1] is None and out[2].value == "2"
     assert out[0].mod_rev > 0
+
+
+def test_watch_delete_only_filter(remote):
+    """events="delete" suppresses PUT pushes server-side (both in the
+    live stream and the start_rev replay) — the scheduler watches the
+    dispatch prefix it bulk-writes itself, and must not get its tens of
+    thousands of own puts per window pushed back at it."""
+    _, s, aux = remote
+    r0 = s.put("/do/seed", "0")
+    w = s.watch("/do/", events="delete")
+    aux.put("/do/a", "1")
+    aux.put("/do/b", "2")
+    aux.delete("/do/a")
+    evs = []
+    deadline = time.time() + 3
+    while time.time() < deadline and len(evs) < 1:
+        ev = w.get(timeout=0.2)
+        if ev:
+            evs.append(ev)
+    time.sleep(0.3)
+    evs += w.drain()
+    assert [(e.kv.key, e.type) for e in evs] == [("/do/a", "DELETE")]
+    w.close()
+    # replay path: puts filtered there too
+    aux.delete("/do/b")
+    w2 = s.watch("/do/", start_rev=r0, events="delete")
+    evs2 = []
+    deadline = time.time() + 3
+    while time.time() < deadline and len(evs2) < 2:
+        ev = w2.get(timeout=0.2)
+        if ev:
+            evs2.append(ev)
+    assert [e.type for e in evs2] == ["DELETE", "DELETE"]
+    assert {e.kv.key for e in evs2} == {"/do/a", "/do/b"}
+    w2.close()
