@@ -357,10 +357,15 @@ def main():
             detail.update(json.loads(proc.stdout))
         else:
             detail["dispatch_plane_error"] = proc.stderr[-500:]
-        # the C++ agent through the same sweep (instant-exec mode):
-        # the only way to show plane headroom beyond Python's
-        # per-agent ceiling on this host (VERDICT r4 #7)
-        if not quick:
+    except Exception as e:  # noqa: BLE001 — the TPU bench must still land
+        detail["dispatch_plane_error"] = str(e)
+    # the C++ agent through the same sweep (instant-exec mode): the
+    # only way to show plane headroom beyond Python's per-agent
+    # ceiling on this host (VERDICT r4 #7).  Own error scope: a
+    # native-sweep failure must not mislabel the (already merged)
+    # Python sweep as failed.
+    if not quick:
+        try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "scripts",
                                               "bench_dispatch.py"),
@@ -384,8 +389,8 @@ def main():
                         detail[k.replace("plane_", "plane_native_")] = nd[k]
             else:
                 detail["dispatch_plane_native_error"] = proc.stderr[-500:]
-    except Exception as e:  # noqa: BLE001 — the TPU bench must still land
-        detail["dispatch_plane_error"] = str(e)
+        except Exception as e:  # noqa: BLE001
+            detail["dispatch_plane_native_error"] = str(e)
 
     # ---- scheduler system: full step() + failover at c5 scale --------------
     # The whole cycle a real tick pays (watch drain + reconcile + flush +

@@ -144,6 +144,13 @@ class _AdaptiveBucket:
         self.cur_k = 0
         self._shrink_streak = 0
         self._ticks_pending = 0
+        # sizes this bucket has already run at: shrinking BACK to one is
+        # free (its executable is cached), so the hysteresis only gates
+        # shrinks to never-seen sizes.  Without this, one cron-herd
+        # minute boundary pins the bucket at its burst size for 300
+        # planned seconds and every steady window pays the burst-sized
+        # output fetch (~10 MB/window over the tunnel — measured).
+        self.seen: set = set()
 
     def feed(self, total: int, ticks: int):
         self.last_total = total
@@ -181,11 +188,12 @@ class _AdaptiveBucket:
             self._shrink_streak = 0
         elif want < self.cur_k:
             self._shrink_streak += ticks
-            if self._shrink_streak >= 300:
+            if want in self.seen or self._shrink_streak >= 300:
                 self.cur_k = want
                 self._shrink_streak = 0
         else:
             self._shrink_streak = 0
+        self.seen.add(self.cur_k)
         return self.cur_k
 
 
@@ -246,6 +254,10 @@ class TickPlanner:
         # observed fire count so quiet tables don't pay the max-SLA solve.
         self._bx = _AdaptiveBucket(max_fire_bucket, self.J)
         self._bc = _AdaptiveBucket(max_fire_bucket, self.J)
+        # single-second bucket sizes warmed by warm_escalation: overflow
+        # replans snap UP to one of these so a herd burst hits a cached
+        # executable instead of compiling mid-step
+        self._warmed_single: set = set()
 
     # -- state maintenance (all fixed-shape scatters) ----------------------
 
@@ -408,3 +420,35 @@ class TickPlanner:
             self.cost, self.load + 0.0, self.rem_cap | 0, kx, kc,
             self.rounds, impl)
         np.asarray(outs32[0, 0])   # a data fetch truly syncs the tunnel
+
+    def warm_escalation(self, epoch_s: int, factor: int = 4) -> int:
+        """Compile the single-second overflow-replan executable at the
+        escalated bucket a cron-herd burst will request (the scheduler's
+        ``_replan_overflow`` plans W=1 at pow2(true fire count)).  The
+        first minute-boundary herd otherwise pays this compile INSIDE a
+        live step — measured as tens of seconds of p99 at 1M jobs.
+        Returns the warmed bucket size."""
+        from .schedule_table import FRAMEWORK_EPOCH
+        from .timecal import window_fields
+        k = min(_next_pow2(max(self._bx.peek(), self._bc.peek()) * factor),
+                self.J)
+        impl = self._impl(k, k)
+        f = window_fields(epoch_s, 1, tz=self.tz)
+        fields_w = np.stack([
+            f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+            np.asarray([epoch_s - FRAMEWORK_EPOCH], np.int64),
+        ], axis=1).astype(np.int32)
+        outs32, _o, _l, _r = _plan_window_step(
+            self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
+            self.cost, self.load + 0.0, self.rem_cap | 0, k, k,
+            self.rounds, impl)
+        np.asarray(outs32[0, 0])
+        self._warmed_single.add(k)
+        return k
+
+    def snap_escalation(self, want: int) -> int:
+        """Smallest warmed single-second bucket >= ``want``, else
+        ``want`` itself — an oversized-but-compiled bucket beats a
+        right-sized compile inside a live burst step."""
+        cands = [s for s in self._warmed_single if s >= want]
+        return min(cands) if cands else want

@@ -86,10 +86,15 @@ class OrderPublisher:
 
     def take_failed_epoch(self):
         """The lowest epoch whose orders were dropped after retries, or
-        None.  Reading clears it — the caller owns the re-plan."""
+        None.  NOT cleared by reading: the mark stands until a window
+        COVERING the hole is dequeued for publishing (see _run), so
+        stale post-hole windows already in the queue can't slip past
+        the check and advance the HWM over unpublished seconds.  The
+        caller may observe (and rewind for) the same hole on several
+        consecutive steps — the re-planned duplicates are absorbed by
+        fences/broadcast dedup."""
         with self._mu:
-            fe, self._failed_epoch = self._failed_epoch, None
-            return fe
+            return self._failed_epoch
 
     def flush(self, timeout: float = 120.0) -> bool:
         """Block until every submitted window has been published."""
@@ -144,6 +149,12 @@ class OrderPublisher:
             t0 = time.perf_counter()
             with self._mu:
                 holed = self._failed_epoch is not None
+                if holed and seconds and \
+                        seconds[0][0] <= self._failed_epoch:
+                    # this window is the scheduler's REWOUND re-plan
+                    # covering the hole: clear the mark and publish it
+                    self._failed_epoch = None
+                    holed = False
             if holed:
                 # a hole is outstanding: publishing the already-queued
                 # LATER windows would advance the monotone HWM past it,

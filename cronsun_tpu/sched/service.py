@@ -156,6 +156,7 @@ class SchedulerService:
         self._ae_thread: Optional[threading.Thread] = None
         self._ae_result = None
         self._ae_rekick = False
+        self._ae_store = None   # lazy clone for background listings
 
         self._open_watches()
 
@@ -183,6 +184,7 @@ class SchedulerService:
         # device-plan pipelining: the NEXT window's plan is dispatched
         # before the current one publishes; (start_epoch, handle)
         self._pending_plan: Optional[Tuple[int, object]] = None
+        self._warm_thread: Optional[threading.Thread] = None
         self._warmed = False
 
         self._leader_lease: Optional[int] = None
@@ -298,7 +300,15 @@ class SchedulerService:
                 return True
             self._leader_lease = None
         lease = self.store.grant(self.lease_ttl)
-        if self.store.put_if_absent(self.ks.leader, self.node_id, lease=lease):
+        try:
+            won = self.store.put_if_absent(self.ks.leader, self.node_id,
+                                           lease=lease)
+        except KeyError:
+            # the fresh lease expired before the put landed (pegged
+            # host, link stall longer than lease_ttl): not leading this
+            # step; the next attempt grants anew
+            return False
+        if won:
             self._leader_lease = lease
             return True
         self.store.revoke(lease)
@@ -585,9 +595,21 @@ class SchedulerService:
             else:
                 self._excl_cnt.pop(node_id, None)
 
-    def _build_mirrors(self):
+    def _ae_conn(self):
+        """Connection for background anti-entropy listings: a dedicated
+        clone when the store supports it — a multi-hundred-MB get_prefix
+        reply on the MAIN connection would serialize ahead of every live
+        step RPC on that socket."""
+        if self._ae_store is None:
+            self._ae_store = (self.store.clone()
+                              if hasattr(self.store, "clone")
+                              else self.store)
+        return self._ae_store
+
+    def _build_mirrors(self, store=None):
         """List the execution-state prefixes into FRESH mirror + counter
         structures (no live state touched — safe off-thread)."""
+        store = store or self.store
         procs: Dict[str, Tuple[str, float, bool]] = {}
         orders: Dict[str, Tuple[str, float, bool]] = {}
         excl: Dict[str, int] = {}
@@ -601,16 +623,16 @@ class SchedulerService:
             if job and job.exclusive:
                 excl[node_id] = excl.get(node_id, 0) + 1
 
-        for kv in self.store.get_prefix(self.ks.proc):
+        for kv in store.get_prefix(self.ks.proc):
             t = self._parse_proc(kv.key)
             if t:
                 add(procs, kv.key, *t)
-        for kv in self.store.get_prefix(self.ks.dispatch):
+        for kv in store.get_prefix(self.ks.dispatch):
             t = self._parse_order(kv.key)
             if t:
                 add(orders, kv.key, *t)
         alone = {kv.key[len(self._alone_pfx):]
-                 for kv in self.store.get_prefix(self._alone_pfx)}
+                 for kv in store.get_prefix(self._alone_pfx)}
         return procs, orders, alone, excl, load
 
     def _install_mirrors(self, built):
@@ -651,7 +673,7 @@ class SchedulerService:
 
         def run():
             try:
-                self._ae_result = self._build_mirrors()
+                self._ae_result = self._build_mirrors(self._ae_conn())
             except Exception as e:  # noqa: BLE001 — retry next period
                 log.warnf("anti-entropy listing failed: %s", e)
                 self._ae_thread = None
@@ -701,6 +723,37 @@ class SchedulerService:
             rows, excl, cost = self._pad_pow2(rows, excl, cost)
             self.planner.set_job_meta(rows, excl, cost)
             self._meta_updates.clear()
+
+    def _start_warm(self):
+        """Background compile of the plan executables this process will
+        need under pressure: the windowed plan (a standby's takeover
+        must not pay XLA compilation as dispatch outage — r4 measured
+        34 s) and the single-second escalation bucket a cron-herd
+        minute boundary requests (r5 measured ~20 s p99 inside the
+        first burst step).  Runs once; leaders warm while leading, the
+        step loop never blocks on it."""
+        if self._warmed or self._warm_thread is not None:
+            return
+        if not (hasattr(self.planner, "warm_window")
+                and hasattr(self.planner, "warm_escalation")):
+            self._warmed = True
+            return
+
+        def run():
+            try:
+                now = int(self.clock())
+                self.planner.warm_window(now + 1, max(1, self.window_s))
+                k = self.planner.warm_escalation(now + 1)
+                log.infof("plan executables warmed (window + "
+                          "escalation bucket %d)", k)
+            except Exception as e:  # noqa: BLE001 — degraded, not down
+                log.warnf("background plan warm failed: %s", e)
+            finally:
+                self._warmed = True
+                self._warm_thread = None
+        self._warm_thread = threading.Thread(
+            target=run, daemon=True, name="sched-plan-warm")
+        self._warm_thread.start()
 
     # ---- capacity reconciliation ----------------------------------------
 
@@ -773,20 +826,12 @@ class SchedulerService:
             self._next_epoch = None
             self._pending_plan = None
             self._flush_device()
-            if not self._warmed and hasattr(self.planner, "warm_window"):
-                # compile (and disk-cache) the plan program NOW: the r4
-                # takeover paid tens of seconds of XLA compile as
-                # dispatch outage before its first catch-up plan
-                try:
-                    self.planner.warm_window(now + 1, max(1, self.window_s))
-                except Exception as e:  # noqa: BLE001 — standby stays up
-                    log.warnf("standby warm compile failed: %s", e)
-                self._warmed = True
+            self._start_warm()   # standby warms in the background
             # standbys still publish (throttled): "is my failover target
             # alive" is an operator question too
             self.metrics.maybe_publish()
             return 0
-        self._warmed = True     # leading compiles as it goes
+        self._start_warm()      # escalation sizes warm even while leading
         if not led_before:
             # fresh leadership: the delete-only orders watch never
             # echoed the PREVIOUS leader's publishes, so kick an
@@ -874,37 +919,32 @@ class SchedulerService:
             # and routing were precomputed into _row_dispatch by the job
             # watch handlers (this loop IS the leader's share of the
             # dispatch plane — at 20k fires/tick it must stay tight).
-            # fired[:n_excl] are the exclusive placements, the rest
-            # Common fan-outs — no per-fire kind branch.
+            # Routing branches on the ROW's exclusive flag, not on the
+            # plan's bucket split: mesh planners don't populate n_excl,
+            # and a flag mismatch must never turn a placed exclusive
+            # fire into a broadcast.
             ep = str(plan.epoch_s)
-            fired = plan.fired.tolist()
-            assigned = plan.assigned.tolist()
-            nx = plan.n_excl
             orders: List[Tuple[str, str]] = []
-            for row, node_col in zip(fired[:nx], assigned[:nx]):
+            for row, node_col in zip(plan.fired.tolist(),
+                                     plan.assigned.tolist()):
                 ent = row_disp.get(row)
                 if ent is None:
                     continue
-                _, payload, group, job_id, kind, suffix = ent
+                exclusive, payload, group, job_id, kind, suffix = ent
                 if kind == KIND_ALONE and job_id in alone_live:
                     continue   # previous run still holds the fleet lock
-                if 0 <= node_col < n_cols:
-                    node = col_node[node_col]
-                    if node:
-                        key = f"{disp_pfx}{node}/{ep}{suffix}"
-                        orders.append((key, payload))
-                        excl_acct.append((key, node, group, job_id))
-            for row in fired[nx:]:
-                ent = row_disp.get(row)
-                if ent is None:
-                    continue
-                _, payload, group, job_id, kind, suffix = ent
-                if kind == KIND_ALONE and job_id in alone_live:
-                    continue
-                # Common fan-out: ONE broadcast order; eligible agents
-                # each pick it up via their local IsRunOn — the host
-                # never walks the [J, N] matrix per fire
-                orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
+                if exclusive:
+                    if 0 <= node_col < n_cols:
+                        node = col_node[node_col]
+                        if node:
+                            key = f"{disp_pfx}{node}/{ep}{suffix}"
+                            orders.append((key, payload))
+                            excl_acct.append((key, node, group, job_id))
+                else:
+                    # Common fan-out: ONE broadcast order; eligible
+                    # agents each pick it up via their local IsRunOn —
+                    # the host never walks the [J, N] matrix per fire
+                    orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
             n_dispatch += len(orders)
             seconds.append((plan.epoch_s, orders))
         t = span("build", t)
@@ -951,6 +991,8 @@ class SchedulerService:
         for real fires."""
         from ..ops.planner import _next_pow2
         want = min(_next_pow2(max(2048, plan.total_fired)), self.planner.J)
+        if hasattr(self.planner, "snap_escalation"):
+            want = self.planner.snap_escalation(want)
         self.stats["overflow_late_fires"] += plan.overflow
         log.warnf("%d fires over the bucket SLA at t=%d; re-planning "
                   "with bucket %d (late, never lost)",
@@ -1061,6 +1103,11 @@ class SchedulerService:
             self.store.revoke(self._leader_lease)
             self._leader_lease = None
         self.publisher.stop()
+        if self._ae_store is not None and self._ae_store is not self.store:
+            try:
+                self._ae_store.close()
+            except Exception:  # noqa: BLE001 — already dead
+                pass
         for lane in self._owned_lanes:
             try:
                 lane.close()

@@ -817,6 +817,9 @@ struct Conn : std::enable_shared_from_this<Conn> {
         if (n <= 0) {
           std::lock_guard<std::mutex> g(omu);
           dead = true;
+          // a reader blocked in enqueue_reply's backpressure wait must
+          // re-check and bail, or its thread leaks with the connection
+          ocv.notify_all();
           break;
         }
         off += (size_t)n;

@@ -104,3 +104,37 @@ def test_plan_window_equals_sequential_ticks():
         assert a.overflow == b_.overflow
     np.testing.assert_allclose(np.asarray(pw.load), np.asarray(ps.load))
     assert np.asarray(pw.rem_cap).tolist() == np.asarray(ps.rem_cap).tolist()
+
+
+def test_escalation_warm_and_bucket_seen():
+    """Cron-herd burst machinery: warm_escalation pre-compiles the
+    single-second replan executable and snap_escalation routes overflow
+    replans to warmed sizes; the adaptive bucket shrinks back to an
+    already-seen size immediately (no 300-tick hysteresis) so one burst
+    doesn't pin burst-sized output fetches on steady windows."""
+    from cronsun_tpu.ops.planner import TickPlanner, _AdaptiveBucket
+
+    p = TickPlanner(job_capacity=4096, node_capacity=64,
+                    max_fire_bucket=2048)
+    k = p.warm_escalation(1_753_000_000, factor=4)
+    assert k in p._warmed_single and k >= 4096 // 2
+    # snap: an awkward want routes UP to the warmed size; bigger wants
+    # pass through
+    assert p.snap_escalation(k // 2 + 1) == k
+    assert p.snap_escalation(p.J) == p.J
+
+    b = _AdaptiveBucket(max_bucket=65536, cap=1 << 20)
+    s1 = b.size(None)          # initial (max_bucket-derived)
+    b.feed(100, 1)
+    s2 = b.size(None)          # shrinks? no: never seen the small size
+    assert s2 == s1, "unseen shrink must wait out the hysteresis"
+    for _ in range(300):
+        b.feed(100, 1)
+    s3 = b.size(None)
+    assert s3 < s1             # hysteresis satisfied -> small size seen
+    b.feed(100_000, 1)
+    s4 = b.size(None)          # burst: grows immediately
+    assert s4 > s3
+    b.feed(100, 1)
+    s5 = b.size(None)          # back to a SEEN size: immediate
+    assert s5 == s3
