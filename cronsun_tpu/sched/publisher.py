@@ -72,16 +72,22 @@ class OrderPublisher:
     # -- producer side -----------------------------------------------------
 
     def submit(self, seconds: List[Tuple[int, list]], lease: int,
-               hwm: int) -> float:
-        """Queue one window: ``seconds`` = [(epoch, [(key, val), ...])]
-        in ascending epoch order; ``hwm`` is the mark to advance to once
-        the whole window has landed.  Returns seconds spent blocked on
-        backpressure (0.0 when the plane is keeping up)."""
+               hwm: int, covers_from=None) -> float:
+        """Queue one window: ``seconds`` = [(epoch, [(key, val), ...])],
+        oldest first; ``hwm`` is the mark to advance to once the whole
+        window has landed.  ``covers_from`` is the CONTIGUOUS start of
+        the planned window (excluding any prepended out-of-band replan
+        seconds): a submission whose covers_from is at or before an
+        outstanding publish hole is the scheduler's rewound re-plan and
+        clears the hole; anything else queued behind a hole is
+        abandoned (and extends the hole to its own oldest second) so
+        the monotone HWM can never pass unpublished fires.  Returns
+        seconds spent blocked on backpressure."""
         t0 = time.perf_counter()
         self._sem.acquire()
         with self._mu:
             self._inflight += 1
-        self._q.put((seconds, lease, hwm))
+        self._q.put((seconds, lease, hwm, covers_from))
         return time.perf_counter() - t0
 
     def take_failed_epoch(self):
@@ -145,14 +151,15 @@ class OrderPublisher:
             item = self._q.get()
             if item is None:
                 return
-            seconds, lease, hwm = item
+            seconds, lease, hwm, covers_from = item
             t0 = time.perf_counter()
             with self._mu:
                 holed = self._failed_epoch is not None
-                if holed and seconds and \
-                        seconds[0][0] <= self._failed_epoch:
-                    # this window is the scheduler's REWOUND re-plan
-                    # covering the hole: clear the mark and publish it
+                if holed and covers_from is not None and \
+                        covers_from <= self._failed_epoch:
+                    # the scheduler's REWOUND re-plan: its contiguous
+                    # window starts at/before the hole, so publishing
+                    # it re-covers every second the hole shadowed
                     self._failed_epoch = None
                     holed = False
             if holed:
@@ -160,8 +167,12 @@ class OrderPublisher:
                 # LATER windows would advance the monotone HWM past it,
                 # and a crash before the rewound re-publish landed
                 # would lose the hole's fires forever.  Abandon them —
-                # the rewind re-plans everything from the hole forward,
-                # these windows included.
+                # extending the hole to this window's own oldest second
+                # (it may carry matured replan fires older than the
+                # hole) — and let the rewind re-plan everything from
+                # there forward.
+                if seconds:
+                    self._mark_failed(min(ep for ep, _ in seconds))
                 log.warnf("publish hole outstanding; abandoning queued "
                           "window of %d seconds for the re-plan",
                           len(seconds))

@@ -184,6 +184,8 @@ class SchedulerService:
         # device-plan pipelining: the NEXT window's plan is dispatched
         # before the current one publishes; (start_epoch, handle)
         self._pending_plan: Optional[Tuple[int, object]] = None
+        # async overflow replans awaiting their gather: (epoch, handle)
+        self._pending_replans: List[Tuple[int, object]] = []
         self._warm_thread: Optional[threading.Thread] = None
         self._warmed = False
 
@@ -825,6 +827,7 @@ class SchedulerService:
         if not self.try_lead():
             self._next_epoch = None
             self._pending_plan = None
+            self._drain_replans()
             self._flush_device()
             self._start_warm()   # standby warms in the background
             # standbys still publish (throttled): "is my failover target
@@ -895,58 +898,49 @@ class SchedulerService:
             self._pending_plan = (
                 self._next_epoch,
                 self.planner.plan_window_async(self._next_epoch, window))
-        # KindAlone lifetime exclusion: don't dispatch an Alone job whose
-        # running lock is still live anywhere (reference job.go:87-123);
-        # the watch-fed mirror replaces a per-step prefix scan
-        alone_live = self._alone_live
-        row_disp = self._row_dispatch
-        col_node = self._col_node
-        disp_pfx = self.ks.dispatch
-        bcast_pfx = self.ks.dispatch_all
-        n_cols = len(col_node)
         lease = self.store.grant(self.dispatch_ttl)
         seconds: List[Tuple[int, list]] = []
         excl_acct: List[Tuple[str, str, str, str]] = []
         n_dispatch = 0
-        for plan in plans:
+        # matured ASYNC overflow replans from the previous step publish
+        # first (they are the oldest epochs); their full fire sets were
+        # computed while the last window built and shipped
+        build_list: List[Tuple[object, bool]] = []
+        if self._pending_replans:
+            pending, self._pending_replans = self._pending_replans, []
+            for _ep, handle in pending:
+                build_list.append(
+                    (self.planner.gather_window(handle)[0], False))
+        build_list += [(p, True) for p in plans]
+        for plan, may_replan in build_list:
             if plan.overflow:
                 # never drop a fire: re-plan this second with a bucket
                 # sized for the TRUE fire count — overflow becomes
                 # latency, not loss (the reference fires late, never
-                # never, cron.go:212-215)
-                plan = self._replan_overflow(plan)
-            # per-fire work is one dict lookup + string concat: payload
-            # and routing were precomputed into _row_dispatch by the job
-            # watch handlers (this loop IS the leader's share of the
-            # dispatch plane — at 20k fires/tick it must stay tight).
-            # Routing branches on the ROW's exclusive flag, not on the
-            # plan's bucket split: mesh planners don't populate n_excl,
-            # and a flag mismatch must never turn a placed exclusive
-            # fire into a broadcast.
-            ep = str(plan.epoch_s)
-            orders: List[Tuple[str, str]] = []
-            for row, node_col in zip(plan.fired.tolist(),
-                                     plan.assigned.tolist()):
-                ent = row_disp.get(row)
-                if ent is None:
-                    continue
-                exclusive, payload, group, job_id, kind, suffix = ent
-                if kind == KIND_ALONE and job_id in alone_live:
-                    continue   # previous run still holds the fleet lock
-                if exclusive:
-                    if 0 <= node_col < n_cols:
-                        node = col_node[node_col]
-                        if node:
-                            key = f"{disp_pfx}{node}/{ep}{suffix}"
-                            orders.append((key, payload))
-                            excl_acct.append((key, node, group, job_id))
+                # never, cron.go:212-215).  The replan runs ASYNC on
+                # the device while this window's orders build and ship
+                # (one step of added latency for the over-bucket tail;
+                # a synchronous replan was the last device wait inside
+                # burst steps — measured seconds of p99 at cron-herd
+                # scale); the truncated head publishes NOW and its
+                # re-dispatch next step is deduplicated downstream
+                # (fences / broadcast dedup), exactly as the sync
+                # replan's head re-fire was.  Mesh planners (no async
+                # surface) keep the in-step replan.
+                if may_replan and hasattr(self.planner,
+                                          "plan_window_async"):
+                    self._queue_replan(plan)
+                elif may_replan:
+                    plan = self._replan_overflow(plan)
                 else:
-                    # Common fan-out: ONE broadcast order; eligible
-                    # agents each pick it up via their local IsRunOn —
-                    # the host never walks the [J, N] matrix per fire
-                    orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
-            n_dispatch += len(orders)
-            seconds.append((plan.epoch_s, orders))
+                    # a replan STILL over its escalated bucket: only
+                    # possible past the structural cap J
+                    self.stats["overflow_drops"] += plan.overflow
+                    log.errorf("%d fires over the escalated bucket at "
+                               "t=%d — dropped", plan.overflow,
+                               plan.epoch_s)
+            n_dispatch += self._build_plan_orders(plan, seconds,
+                                                  excl_acct)
         t = span("build", t)
         # hand the window to the async publisher: oldest second first,
         # HWM advanced after each second lands (the publisher owns the
@@ -954,7 +948,8 @@ class SchedulerService:
         # unpublished tail — a rare double fire beats silently missing
         # it; the mark itself is a monotone CAS so a deposed leader
         # can't regress the new one's progress)
-        wait_s = self.publisher.submit(seconds, lease, self._next_epoch)
+        wait_s = self.publisher.submit(seconds, lease, self._next_epoch,
+                                       covers_from=start)
         if self.sync_publish:
             self.publisher.flush()
         # mirror own publishes locally (the orders watch is delete-only:
@@ -976,6 +971,101 @@ class SchedulerService:
         self.metrics.maybe_publish()
         return n_dispatch
 
+    def _build_plan_orders(self, plan, seconds: List[Tuple[int, list]],
+                           excl_acct: List[Tuple[str, str, str, str]]
+                           ) -> int:
+        """Build one TickPlan's dispatch orders into ``seconds`` (and
+        the exclusive-accounting list) — the leader's share of the
+        dispatch plane.  Per-fire work is one dict lookup + string
+        concat: payload and routing were precomputed into _row_dispatch
+        by the job watch handlers.  Routing branches on the ROW's
+        exclusive flag, not the plan's bucket split: mesh planners
+        don't populate n_excl, and a flag mismatch must never turn a
+        placed exclusive fire into a broadcast.  KindAlone fires whose
+        lifetime lock is live anywhere are skipped (reference
+        job.go:87-123) via the watch-fed mirror."""
+        alone_live = self._alone_live
+        row_disp = self._row_dispatch
+        col_node = self._col_node
+        disp_pfx = self.ks.dispatch
+        bcast_pfx = self.ks.dispatch_all
+        n_cols = len(col_node)
+        ep = str(plan.epoch_s)
+        orders: List[Tuple[str, str]] = []
+        for row, node_col in zip(plan.fired.tolist(),
+                                 plan.assigned.tolist()):
+            ent = row_disp.get(row)
+            if ent is None:
+                continue
+            exclusive, payload, group, job_id, kind, suffix = ent
+            if kind == KIND_ALONE and job_id in alone_live:
+                continue   # previous run still holds the fleet lock
+            if exclusive:
+                if 0 <= node_col < n_cols:
+                    node = col_node[node_col]
+                    if node:
+                        key = f"{disp_pfx}{node}/{ep}{suffix}"
+                        orders.append((key, payload))
+                        excl_acct.append((key, node, group, job_id))
+            else:
+                # Common fan-out: ONE broadcast order; eligible agents
+                # each pick it up via their local IsRunOn — the host
+                # never walks the [J, N] matrix per fire
+                orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
+        seconds.append((plan.epoch_s, orders))
+        return len(orders)
+
+    def _escalation_want(self, plan) -> int:
+        """Escalated bucket size for an over-bucket second, snapped to
+        a warmed executable when one covers it — shared by the async
+        and the sync (mesh) replan paths."""
+        from ..ops.planner import _next_pow2
+        want = min(_next_pow2(max(2048, plan.total_fired)),
+                   self.planner.J)
+        if hasattr(self.planner, "snap_escalation"):
+            want = self.planner.snap_escalation(want)
+        return want
+
+    def _drain_replans(self):
+        """Gather and publish pending async replans NOW (leadership
+        loss, shutdown): their over-bucket tails were already counted
+        as late fires — abandoning the handles would turn late into
+        LOST."""
+        if not self._pending_replans:
+            return
+        pending, self._pending_replans = self._pending_replans, []
+        try:
+            lease = self.store.grant(self.dispatch_ttl)
+            seconds: List[Tuple[int, list]] = []
+            excl_acct: List[Tuple[str, str, str, str]] = []
+            n = 0
+            for _ep, handle in pending:
+                n += self._build_plan_orders(
+                    self.planner.gather_window(handle)[0], seconds,
+                    excl_acct)
+            self.publisher.submit(seconds, lease, 0)
+            for key, node, group, job_id in excl_acct:
+                self._acct_add(self._orders, key, node, group, job_id)
+            log.infof("drained %d pending replan fires on hand-off", n)
+        except Exception as e:  # noqa: BLE001 — store down: the fires
+            # are genuinely lost; say so loudly
+            self.stats["overflow_drops"] += len(pending)
+            log.errorf("pending replans LOST on hand-off: %s", e)
+
+    def _queue_replan(self, plan):
+        """Dispatch the escalated re-plan of an over-bucket second on
+        the device WITHOUT waiting; the next step gathers and publishes
+        the full fire set (late by ~one step, never lost)."""
+        want = self._escalation_want(plan)
+        self.stats["overflow_late_fires"] += plan.overflow
+        log.warnf("%d fires over the bucket SLA at t=%d; re-planning "
+                  "async with bucket %d (late, never lost)",
+                  plan.overflow, plan.epoch_s, want)
+        self._pending_replans.append(
+            (plan.epoch_s,
+             self.planner.plan_window_async(plan.epoch_s, 1,
+                                            sla_bucket=want)))
+
     def _replan_overflow(self, plan):
         """A second whose fires exceeded the adaptive bucket is
         immediately re-planned with a bucket sized for its TRUE fire
@@ -989,10 +1079,7 @@ class SchedulerService:
         reconcile_capacity.  Residual drops are only possible if the
         fire count exceeds the job capacity J — structurally impossible
         for real fires."""
-        from ..ops.planner import _next_pow2
-        want = min(_next_pow2(max(2048, plan.total_fired)), self.planner.J)
-        if hasattr(self.planner, "snap_escalation"):
-            want = self.planner.snap_escalation(want)
+        want = self._escalation_want(plan)
         self.stats["overflow_late_fires"] += plan.overflow
         log.warnf("%d fires over the bucket SLA at t=%d; re-planning "
                   "with bucket %d (late, never lost)",
@@ -1102,6 +1189,7 @@ class SchedulerService:
         if self._leader_lease is not None:
             self.store.revoke(self._leader_lease)
             self._leader_lease = None
+        self._drain_replans()
         self.publisher.stop()
         if self._ae_store is not None and self._ae_store is not self.store:
             try:

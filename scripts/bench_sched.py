@@ -219,6 +219,8 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
         # windows into the store, and counting before it would credit
         # A's drained orders as B's "resumed dispatching"
         base_orders = store3.count_prefix(ks.dispatch)
+        hwm_kv = store3.get(ks.hwm)
+        hwm0 = int(hwm_kv.value) if hwm_kv else int(time.time())
         t0 = time.time()
         first_s = None
         caught_s = None
@@ -238,6 +240,13 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
         out["failover_resume_s"] = round(first_s, 2)
         out["failover_caught_up_s"] = round(caught_s, 2) \
             if caught_s is not None else None
+        # when the missed span outruns the 300 s observation window,
+        # the RATE tells the story instead of a null: planned-and-
+        # published virtual seconds per real second of catch-up
+        elapsed = time.time() - t0
+        if elapsed > 0 and b.publisher.published_through > hwm0:
+            out["failover_catchup_rate"] = round(
+                (b.publisher.published_through - hwm0) / elapsed, 2)
         out["failover_resume_dispatches"] = \
             store3.count_prefix(ks.dispatch) - base_orders
         on_log(f"warm standby: first catch-up orders in store after "
