@@ -478,19 +478,17 @@ def test_agent_resync_after_watch_loss():
     store.close()
 
 
-def test_overflow_becomes_late_fires_never_drops():
-    """A second whose fire count exceeds the adaptive bucket is
-    re-planned with an escalated bucket inside the same step: every fire
-    dispatches (late), overflow_late_fires counts them, and nothing
-    lands in overflow_drops (VERDICT r3 #2; reference contract: fires
-    late, never never — cron.go:212-215)."""
+
+def _overflow_world(prefix, n_jobs=2600):
+    """Store + planner + scheduler with more same-second exclusive fires
+    than the 2048 bucket floor — shared by the overflow tests so the
+    burst configuration can't silently diverge between them."""
     from cronsun_tpu.ops.planner import TickPlanner
 
     store = MemStore()
     store.put(KS.node_key("n0"), "host:1")
-    n_jobs = 2600                    # > the 2048 bucket floor
     for i in range(n_jobs):
-        job = Job(id=f"of{i:04d}", name=f"of{i}", group="g",
+        job = Job(id=f"{prefix}{i:04d}", name=f"{prefix}{i}", group="g",
                   command="true", kind=2,
                   rules=[JobRule(id="r", timer="* * * * * *",
                                  nids=["n0"])])
@@ -499,6 +497,16 @@ def test_overflow_becomes_late_fires_never_drops():
                           max_fire_bucket=2048)
     sched = SchedulerService(store, planner=planner, window_s=1,
                              node_capacity=32)
+    return store, sched, n_jobs
+
+
+def test_overflow_becomes_late_fires_never_drops():
+    """A second whose fire count exceeds the adaptive bucket is
+    re-planned with an escalated bucket: every fire dispatches (late),
+    overflow_late_fires counts them, and nothing lands in
+    overflow_drops (VERDICT r3 #2; reference contract: fires late,
+    never never — cron.go:212-215)."""
+    store, sched, n_jobs = _overflow_world("of")
     t0 = 1_753_000_000
     sched.step(now=t0)       # burst second truncated to the bucket; the
                              # full set re-plans ASYNC on the device
@@ -558,4 +566,22 @@ def test_publish_hole_rewinds_plan_cursor():
     assert sched.stats["skipped_seconds"] == 0
     agent.stop()
     sched.stop()
+    store.close()
+
+
+def test_pending_replans_drain_on_stop():
+    """An async overflow replan still in flight when the leader stops
+    must be gathered and PUBLISHED on the way out — its tail fires were
+    already counted as late, and abandoning the handle would turn late
+    into lost."""
+    store, sched, n_jobs = _overflow_world("dr")
+    t0 = 1_753_910_000
+    sched.step(now=t0)       # truncated head published; replan pending
+    assert sched._pending_replans, "overflow replan should be pending"
+    sched.stop()             # drains the replan, then the publisher
+    epoch = t0 + 1
+    orders = store.get_prefix(KS.dispatch + "n0/" + str(epoch) + "/")
+    assert len(orders) == n_jobs, \
+        f"stop() dropped replan fires ({len(orders)}/{n_jobs})"
+    assert sched.stats["overflow_drops"] == 0
     store.close()
