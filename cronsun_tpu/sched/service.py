@@ -44,6 +44,17 @@ from ..store.memstore import DELETE, MemStore, WatchLost
 _WIRE_SAFE = re.compile(r"^[A-Za-z0-9_.:-]*$").match
 
 
+def _list_prefix(store, prefix):
+    """Iterate a prefix listing in bounded pages when the store supports
+    it (remote stores): a 1M-key prefix as one reply is hundreds of MB
+    whose json parse holds the GIL for seconds, starving every other
+    thread in the process (measured: the background anti-entropy
+    listing stretched a standby's step to ~30 s)."""
+    if hasattr(store, "get_prefix_paged"):
+        return store.get_prefix_paged(prefix)
+    return store.get_prefix(prefix)
+
+
 class _Rows:
     """Row allocator: (group, job_id, rule_id) -> schedule-table row."""
 
@@ -250,14 +261,14 @@ class SchedulerService:
         seconds between their boots, which only matters for @every rules
         never anchored before (existing anchors are honored)."""
         for kv in (groups if groups is not None
-                   else self.store.get_prefix(self.ks.group)):
+                   else _list_prefix(self.store, self.ks.group)):
             self._apply_group(kv.value)
         # nodes are batched: _node_up issues one device capacity scatter
         # per node, which at 10k nodes is 10k dispatches (each paying the
         # host<->device round trip on a tunneled chip) — here it is ONE
         fresh = []
         for kv in (nodes if nodes is not None
-                   else self.store.get_prefix(self.ks.node)):
+                   else _list_prefix(self.store, self.ks.node)):
             node_id = kv.key[len(self.ks.node):]
             if node_id in self.universe.index:
                 continue
@@ -280,11 +291,11 @@ class SchedulerService:
             self.planner.set_node_capacity(cols, caps)
         self._phase_prefetch = {
             kv.key: kv.value
-            for kv in self.store.get_prefix(self.ks.phase)}
+            for kv in _list_prefix(self.store, self.ks.phase)}
         self._phase_puts = []
         try:
             for kv in (jobs if jobs is not None
-                       else self.store.get_prefix(self.ks.cmd)):
+                       else _list_prefix(self.store, self.ks.cmd)):
                 self._apply_job(kv.key, kv.value)
         finally:
             for i in range(0, len(self._phase_puts), 50_000):
@@ -625,16 +636,16 @@ class SchedulerService:
             if job and job.exclusive:
                 excl[node_id] = excl.get(node_id, 0) + 1
 
-        for kv in store.get_prefix(self.ks.proc):
+        for kv in _list_prefix(store, self.ks.proc):
             t = self._parse_proc(kv.key)
             if t:
                 add(procs, kv.key, *t)
-        for kv in store.get_prefix(self.ks.dispatch):
+        for kv in _list_prefix(store, self.ks.dispatch):
             t = self._parse_order(kv.key)
             if t:
                 add(orders, kv.key, *t)
         alone = {kv.key[len(self._alone_pfx):]
-                 for kv in store.get_prefix(self._alone_pfx)}
+                 for kv in _list_prefix(store, self._alone_pfx)}
         return procs, orders, alone, excl, load
 
     def _install_mirrors(self, built):
@@ -834,7 +845,12 @@ class SchedulerService:
             # alive" is an operator question too
             self.metrics.maybe_publish()
             return 0
-        self._start_warm()      # escalation sizes warm even while leading
+        if self.stats["steps_total"]:
+            # escalation sizes warm while leading — but only after the
+            # first window is out the door: on a small host the warm
+            # compiles race the first plan's own compile for the same
+            # cores and stretch the cold start past the catch-up budget
+            self._start_warm()
         if not led_before:
             # fresh leadership: the delete-only orders watch never
             # echoed the PREVIOUS leader's publishes, so kick an

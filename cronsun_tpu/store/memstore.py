@@ -260,6 +260,29 @@ class MemStore:
             return sorted((kv for k, kv in self._kv.items()
                            if k.startswith(prefix)), key=lambda kv: kv.key)
 
+    def get_prefix_page(self, prefix: str, start_after: str = "",
+                        limit: int = 50_000) -> List[KV]:
+        """One PAGE of a prefix listing: up to ``limit`` keys strictly
+        after ``start_after``, in key order.  A million-key prefix as
+        one reply is hundreds of MB serialized and a seconds-long GIL
+        hold to parse client-side; pagination turns both into bounded
+        slices (etcd's WithRange+WithLimit).  The page is a consistent
+        snapshot; the WHOLE iteration is not — callers that page
+        through a live keyspace get the same read-skew any etcd range
+        pagination has, which every consumer here already tolerates
+        (anti-entropy re-lists, leases expire)."""
+        import heapq
+        with self._lock:
+            self._expire_leases()
+            # nsmallest keeps each page O(n log limit), not a full sort
+            # of every matching key per page (O(pages x n log n) across
+            # an iteration)
+            hits = heapq.nsmallest(
+                max(1, limit),
+                (k for k in self._kv
+                 if k.startswith(prefix) and k > start_after))
+            return [self._kv[k] for k in hits]
+
     def count_prefix(self, prefix: str) -> int:
         with self._lock:
             self._expire_leases()

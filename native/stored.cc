@@ -222,6 +222,32 @@ class Store {
     out += ']';
   }
 
+  // one bounded page of a prefix listing: up to `limit` keys strictly
+  // after `start_after` — a 1M-key prefix as ONE reply is hundreds of
+  // MB and a seconds-long GIL hold for the Python client to parse;
+  // pages bound the reply, the parse slice, and peak memory (etcd
+  // WithRange+WithLimit semantics)
+  void get_prefix_page(const std::string& prefix,
+                       const std::string& start_after, long long limit,
+                       std::string& out) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    if (limit < 1) limit = 1;
+    auto it = start_after.empty() || start_after < prefix
+                  ? kv_.lower_bound(prefix)
+                  : kv_.upper_bound(start_after);
+    out += '[';
+    bool first = true;
+    long long n = 0;
+    for (; it != kv_.end() && starts_with(it->first, prefix) && n < limit;
+         ++it, ++n) {
+      if (!first) out += ',';
+      first = false;
+      kv_wire(out, it->first, it->second);
+    }
+    out += ']';
+  }
+
   long long count_prefix(const std::string& prefix) {
     std::lock_guard<std::mutex> g(mu);
     expire_locked();
@@ -947,6 +973,9 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       c->store->get_many(keys, res);
     } else if (op == "get_prefix") {
       c->store->get_prefix(arg_s(args, 0), res);
+    } else if (op == "get_prefix_page") {
+      c->store->get_prefix_page(arg_s(args, 0), arg_s(args, 1),
+                                arg_i(args, 2, 50000), res);
     } else if (op == "count_prefix") {
       jint(res, c->store->count_prefix(arg_s(args, 0)));
     } else if (op == "delete") {

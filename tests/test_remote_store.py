@@ -643,3 +643,39 @@ def test_watch_delete_only_filter(remote):
     assert [e.type for e in evs2] == ["DELETE", "DELETE"]
     assert {e.kv.key for e in evs2} == {"/do/a", "/do/b"}
     w2.close()
+
+
+def test_get_prefix_paged(remote):
+    """Paged prefix listing (both backends): bounded pages, key order,
+    exact coverage, and resumption strictly after the cursor."""
+    _, s, _ = remote
+    items = [(f"/pg/{i:04d}", str(i)) for i in range(257)]
+    s.put_many(items)
+    s.put("/pgx", "outside")
+    page = s.get_prefix_page("/pg/", "", 100)
+    assert [kv.key for kv in page] == [k for k, _ in items[:100]]
+    page2 = s.get_prefix_page("/pg/", page[-1].key, 100)
+    assert page2[0].key == "/pg/0100"
+    everything = list(s.get_prefix_paged("/pg/", page=64))
+    assert [kv.key for kv in everything] == [k for k, _ in items]
+    assert all(kv.value == kv.key[-4:].lstrip("0") or kv.value == "0"
+               for kv in everything)
+
+
+def test_get_prefix_paged_falls_back_on_old_server(monkeypatch):
+    """Rolling-upgrade compatibility: against a server predating
+    get_prefix_page, the paged iterator silently degrades to the
+    one-shot listing instead of erroring."""
+    import cronsun_tpu.store.remote as remote_mod
+    monkeypatch.setattr(
+        remote_mod, "_OPS",
+        tuple(o for o in remote_mod._OPS if o != "get_prefix_page"))
+    srv = StoreServer(MemStore()).start()
+    s = RemoteStore(srv.host, srv.port)
+    try:
+        s.put_many([(f"/old/{i:03d}", str(i)) for i in range(120)])
+        keys = [kv.key for kv in s.get_prefix_paged("/old/", page=50)]
+        assert keys == [f"/old/{i:03d}" for i in range(120)]
+    finally:
+        s.close()
+        srv.stop()
