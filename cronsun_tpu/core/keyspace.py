@@ -107,7 +107,19 @@ class Keyspace:
 
     def dispatch_key(self, node_id: str, epoch_s: int, group: str,
                      job_id: str) -> str:
+        """Legacy per-(node, second, job) exclusive order key — still
+        consumed by both agents for rollout tolerance, but the scheduler
+        now publishes :meth:`dispatch_bundle_key` instead."""
         return f"{self.dispatch}{node_id}/{epoch_s}/{group}/{job_id}"
+
+    def dispatch_bundle_key(self, node_id: str, epoch_s: int) -> str:
+        """Coalesced exclusive order: ONE key per (node, second), value =
+        JSON array of "group/job_id" strings.  A minute-boundary cron
+        herd publishes at most one key per active node instead of one
+        per fire (~20x fewer keys at the 1M x 10k scale); the key doubles
+        as the scheduler's outstanding-capacity reservation for
+        len(value) exclusive slots until the per-job proc keys exist."""
+        return f"{self.dispatch}{node_id}/{epoch_s}"
 
     # Common-kind fan-out: ONE broadcast order per (second, job); each
     # agent decides eligibility locally (the reference's IsRunOn,

@@ -437,7 +437,13 @@ class NodeAgent:
         return lease, stop
 
     def _execute(self, job: Job, epoch_s: int, fenced: bool,
-                 use_gate: bool = True, order_key: Optional[str] = None):
+                 use_gate: bool = True, order_key: Optional[str] = None,
+                 pre: Optional[tuple] = None):
+        """Run one fire.  ``pre`` = (proc_registered, alone) marks an
+        execution whose (job, second) fence — and KindAlone lifetime
+        lock — were already settled by a bundle claim (_run_bundle): the
+        fence/claim section is skipped, the rest (proc lifecycle,
+        executor, record) is identical."""
         if not self._wait_until(epoch_s):
             return
         # the user-visible SLA: scheduled second -> execution start.
@@ -464,13 +470,18 @@ class NodeAgent:
                                         f"{epoch_s}-{os.getpid()}")
             proc_val = json.dumps({"time": self.clock()})
             proc_registered = False
-            if fenced and job.kind == KIND_ALONE:
+            if pre is not None:
+                # bundle claim already won the fence (and holds any
+                # Alone lock); adopt its proc/alone state and skip
+                # straight to the proc lifecycle + run
+                proc_registered, alone = pre
+            if pre is None and fenced and job.kind == KIND_ALONE:
                 # lifetime lock FIRST: a skip because the previous run is
                 # still live must not consume the (job, second) fence
                 alone = self._acquire_alone_lock(job)
                 if alone is None:
                     return  # previous Alone run still live fleet-wide
-            if fenced and job.exclusive:
+            if pre is None and fenced and job.exclusive:
                 # one-RPC claim: fence + proc registration + order
                 # consume collapse into a single store round trip (the
                 # per-execution chain was the dispatch plane's measured
@@ -885,12 +896,21 @@ class NodeAgent:
                 # NOW even inside the backoff window — the sink may have
                 # healed, and the barrier contract says records must be
                 # visible on return whenever writing is possible at all
-                if not (final or force) and self.clock() < self._rec_retry_at:
+                early = self.clock() < self._rec_retry_at
+                if not (final or force) and early:
                     return   # between backoff attempts; fresh waits too
                 batch, idem = self._rec_retry
                 if self._send_records(batch, idem):
                     self._rec_retry = None
                     self._rec_flush_fails = 0
+                elif force and not final and early:
+                    # a forced barrier attempt INSIDE the backoff window
+                    # is extra-schedule: it must not burn the retry
+                    # budget (a caller polling join_running during a
+                    # sink outage would otherwise exhaust
+                    # rec_flush_max_fails in seconds and drop the batch
+                    # far earlier than the backoff intends)
+                    return
                 else:
                     self._rec_flush_fails += 1
                     if final or \
@@ -965,17 +985,25 @@ class NodeAgent:
         self._job_cache.clear()    # invalidations inside the gap are lost
         n = 0
         for kv in self.store.get_prefix(self.ks.dispatch + self.id + "/"):
-            n += self._handle_dispatch_kv(kv.key, order_key=kv.key)
+            n += self._handle_dispatch_kv(kv.key, kv.value,
+                                          order_key=kv.key)
         for kv in self.store.get_prefix(self.ks.dispatch_all):
             n += self._handle_broadcast_kv(kv.key)
         return n
 
-    def _handle_dispatch_kv(self, key: str,
+    def _handle_dispatch_kv(self, key: str, value: str,
                             order_key: Optional[str] = None) -> int:
         rest = key[len(self.ks.dispatch) + len(self.id) + 1:]
         parts = rest.split("/")
+        if len(parts) == 1 and parts[0].isdigit():
+            # coalesced (node, second) bundle: value = the job list.
+            # A re-delivery (hole-rewind overwrite, resync re-list) is
+            # absorbed by the per-(job, second) fences at claim time.
+            return self._handle_bundle(key, int(parts[0]), value)
         if len(parts) != 3:
             return 0
+        # legacy per-(node, second, job) order — rollout tolerance for
+        # windows published by a pre-coalescing scheduler
         epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
         job = self._get_job(group, job_id)
         if job is None or job.pause:
@@ -987,18 +1015,245 @@ class NodeAgent:
         self._spawn(job, epoch_s, fenced=True, order_key=order_key)
         return 1
 
+    def _handle_bundle(self, key: str, epoch_s: int, value: str) -> int:
+        """Stage one coalesced (node, second) order for its instant.
+        The bundle rides ONE staged task; at due time it settles every
+        member's fence in one claim_bundle RPC and fans the winners out
+        to the exec pool (_run_bundle)."""
+        try:
+            entries = json.loads(value)
+        except (json.JSONDecodeError, TypeError):
+            entries = None
+        pairs = []
+        if isinstance(entries, list):
+            for e in entries:
+                if isinstance(e, str) and "/" in e:
+                    group, _, job_id = e.partition("/")
+                    pairs.append((group, job_id))
+        if not pairs:
+            self.store.delete(key)   # malformed/empty: release the
+            return 0                 # capacity reservation
+        NodeAgent._spawn_seq += 1
+        name = f"bundle-{epoch_s}-{NodeAgent._spawn_seq}"
+
+        def run():
+            try:
+                self._run_bundle(key, epoch_s, pairs)
+            except Exception as e:  # noqa: BLE001 — log, don't die silent
+                log.errorf("bundle %s failed: %s", name, e)
+            finally:
+                self.running.pop(name, None)
+
+        task = _ExecTask(run)
+        self.running[name] = task
+        self._stage_task(name, task, epoch_s)
+        return len(pairs)
+
+    def _run_bundle(self, order_key: str, epoch_s: int, pairs: list):
+        """Consume one coalesced order: resolve the bundle's jobs (one
+        get_many), settle KindAlone lifetime locks per job (lock FIRST —
+        a skip because the previous run is still live must not consume
+        the (job, second) fence), then claim every member's fence + the
+        winners' proc keys + the bundle key's capacity reservation in
+        ONE claim_bundle RPC, and hand the winners to the exec pool.
+        Per-job exactly-once is unchanged: it still rests on the
+        (job, second) create-if-absent fence, so a duplicate bundle
+        delivery (hole-rewind overwrite, resync re-list, leader
+        failover) re-claims and loses."""
+        if not self._wait_until(epoch_s):
+            return
+        self._prefetch_pairs(pairs)
+        runnable = []   # [job, alone, with_proc, proc_key, proc_val]
+        items = []      # parallel (fence_key, nonce, proc_key, proc_val)
+        try:
+            for group, job_id in pairs:
+                job = self._get_job(group, job_id)
+                if job is None or job.pause:
+                    continue
+                alone = None
+                if job.kind == KIND_ALONE:
+                    alone = self._acquire_alone_lock(job)
+                    if alone is None:
+                        continue    # previous Alone run still live
+                nonce = f"{self.id}@{os.getpid()}-{next(self._claim_seq)}"
+                with_proc = self.proc_req <= 0 or \
+                    job.avg_time >= self.proc_req
+                proc_key = self.ks.proc_key(self.id, job.group, job.id,
+                                            f"{epoch_s}-{os.getpid()}")
+                proc_val = json.dumps({"time": self.clock()})
+                items.append((self.ks.lock_key(job.id, epoch_s), nonce,
+                              proc_key if with_proc else "", proc_val))
+                runnable.append([job, alone, with_proc, proc_key,
+                                 proc_val])
+            if not items:
+                # nothing claimable (paused/missing/Alone-skipped):
+                # release the capacity reservation directly
+                try:
+                    self.store.delete(order_key)
+                except Exception:  # noqa: BLE001 — leased key ages out
+                    pass
+                return
+            wins = self._claim_bundle(order_key, items)
+            if wins is None:
+                # store unreachable: do NOT run unfenced.  Stop the
+                # Alone keepalives so the locks expire server-side; the
+                # leased bundle key ages out and a resync re-delivers.
+                for ent in runnable:
+                    if ent[1] is not None:
+                        ent[1][1].set()
+                        ent[1] = None
+                return
+            self._bump("orders_consumed_total", len(items))
+            for won, ent in zip(wins, runnable):
+                job, alone, with_proc, proc_key, proc_val = ent
+                if not won:
+                    # another node (or an earlier duplicate) ran this
+                    # (job, second)
+                    if alone is not None:
+                        lease, stop = alone
+                        stop.set()
+                        ent[1] = None
+                        self.store.revoke(lease)
+                    continue
+                if with_proc:
+                    with self._procs_mu:
+                        self._procs[proc_key] = proc_val
+                ent[1] = None   # the execution owns the lock from here
+                self._spawn(job, epoch_s, fenced=True,
+                            pre=(with_proc, alone))
+        except BaseException:
+            # an escaping error (a transport hiccup mid-acquire, a
+            # degraded-path claim failure) must not leak a live Alone
+            # keepalive — the lock would outlive this bundle and block
+            # the job fleet-wide until the agent restarts.  Release
+            # every lock not yet handed to an execution; revoke may
+            # fail (store down) but the stopped keepalive lets the
+            # lease expire.
+            for ent in runnable:
+                if ent[1] is not None:
+                    lease, stop = ent[1]
+                    stop.set()
+                    try:
+                        self.store.revoke(lease)
+                    except Exception:  # noqa: BLE001 — TTL cleans up
+                        pass
+            raise
+
+    def _claim_bundle(self, order_key: str, items: list):
+        """One-RPC bundle consume with the degraded-store ladder:
+
+        - ``claim_bundle`` op (normal path; expired shared leases are
+          rotated/repaired and retried once);
+        - unknown op (a store predating the format): per-item legacy
+          fences, then the reservation delete — N+1 RPCs, correct;
+        - transport error (INDETERMINATE — the claim may have applied
+          with the reply lost): read the fences back by nonce exactly
+          like _claim's recovery — our nonce means the claim DID apply
+          (incl. its proc puts and the order delete); another value is
+          a loss; absent falls to a legacy fence with the SAME nonce.
+
+        Returns per-item wins, or None when the store is unreachable
+        (callers must not run unfenced)."""
+        try:
+            fence_lease = self._fence_lease()
+            with self._procs_mu:
+                proc_lease = self._proc_lease or 0
+            try:
+                return self.store.claim_bundle(order_key, items,
+                                               fence_lease, proc_lease)
+            except KeyError:
+                fence_lease = self._rotate_fence_lease()
+                with self._procs_mu:
+                    self._repair_proc_lease_locked()
+                    proc_lease = self._proc_lease or 0
+                return self.store.claim_bundle(order_key, items,
+                                               fence_lease, proc_lease)
+        except Exception as e:  # noqa: BLE001 — degrade, never unfenced
+            unsupported = isinstance(e, AttributeError) or \
+                "unknown op" in str(e)
+            if unsupported:
+                log.warnf("store lacks claim_bundle; using per-item "
+                          "fences")
+                wins = [self._fence_item(it) for it in items]
+                try:
+                    self.store.delete(order_key)
+                except Exception:  # noqa: BLE001 — leased key ages out
+                    pass
+                return wins
+        # indeterminate: read back, waiting out the client's auto-heal
+        kvs = None
+        for _ in range(12):
+            try:
+                if hasattr(self.store, "get_many"):
+                    kvs = self.store.get_many([it[0] for it in items])
+                else:
+                    kvs = [self.store.get(it[0]) for it in items]
+                break
+            except Exception:  # noqa: BLE001 — still healing
+                time.sleep(0.5)
+        if kvs is None:
+            return None     # store unreachable
+        wins = []
+        for it, kv in zip(items, kvs):
+            if kv is not None:
+                wins.append(kv.value == it[1])
+            elif self._fence_item(it):
+                wins.append(True)
+            else:
+                # the in-flight claim can still apply between the
+                # read-back and the fence put: a loss to OUR OWN nonce
+                # is the claim's win
+                try:
+                    kv2 = self.store.get(it[0])
+                    wins.append(kv2 is not None and kv2.value == it[1])
+                except Exception:  # noqa: BLE001 — stay with the loss
+                    wins.append(False)
+        try:
+            self.store.delete(order_key)
+        except Exception:  # noqa: BLE001 — leased key ages out
+            pass
+        return wins
+
+    def _fence_item(self, item) -> bool:
+        """Legacy per-item settle for a bundle member: fence
+        put_if_absent under the shared rotating lease, plus the winner's
+        proc put — the degraded path when claim_bundle is unavailable."""
+        fence_key, nonce, proc_key, proc_val = item
+        try:
+            won = self.store.put_if_absent(fence_key, nonce,
+                                           lease=self._fence_lease())
+        except KeyError:
+            won = self.store.put_if_absent(fence_key, nonce,
+                                           lease=self._rotate_fence_lease())
+        if won and proc_key:
+            with self._procs_mu:
+                try:
+                    self.store.put(proc_key, proc_val,
+                                   lease=self._proc_lease or 0)
+                except KeyError:
+                    self._repair_proc_lease_locked()
+                    self.store.put(proc_key, proc_val,
+                                   lease=self._proc_lease or 0)
+        return won
+
     def _prefetch_jobs(self, keys):
         """Batch-fill the job cache for a drained burst of order keys:
         cold jobs cost ONE get_many round trip per drain, not one
         synchronous get (plus a reply-wait thread handoff) per order —
         a measured top cost of the dispatch plane."""
-        want = []
-        seen = set()
+        pairs = []
         for rest in keys:
             parts = rest.split("/")
-            if len(parts) != 3:
-                continue
-            gk = (parts[1], parts[2])
+            if len(parts) == 3:
+                pairs.append((parts[1], parts[2]))
+        self._prefetch_pairs(pairs)
+
+    def _prefetch_pairs(self, pairs):
+        """Batch-fill the job cache for explicit (group, job_id) pairs —
+        the bundle consumer's one-get_many-per-bundle fill."""
+        want = []
+        seen = set()
+        for gk in pairs:
             if gk not in seen and gk not in self._job_cache:
                 seen.add(gk)
                 want.append(gk)
@@ -1030,7 +1285,8 @@ class NodeAgent:
             off = len(self.ks.dispatch) + len(self.id) + 1
             self._prefetch_jobs(ev.kv.key[off:] for ev in evs)
         for ev in evs:
-            n += self._handle_dispatch_kv(ev.kv.key, order_key=ev.kv.key)
+            n += self._handle_dispatch_kv(ev.kv.key, ev.kv.value,
+                                          order_key=ev.kv.key)
         return n
 
     def _handle_broadcast_kv(self, key: str) -> int:
@@ -1099,13 +1355,14 @@ class NodeAgent:
 
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
                use_gate: bool = True, order_key: Optional[str] = None,
-               immediate: bool = False):
+               immediate: bool = False, pre: Optional[tuple] = None):
         NodeAgent._spawn_seq += 1
         name = f"exec-{job.id}-{epoch_s}-{NodeAgent._spawn_seq}"
 
         def run():
             try:
-                self._execute(job, epoch_s, fenced, use_gate, order_key)
+                self._execute(job, epoch_s, fenced, use_gate, order_key,
+                              pre=pre)
             except Exception as e:  # noqa: BLE001 — log, don't die silent
                 log.errorf("execution %s failed: %s", name, e)
             finally:
@@ -1122,6 +1379,9 @@ class NodeAgent:
             t = threading.Thread(target=task.run, daemon=True, name=name)
             t.start()
             return
+        self._stage_task(name, task, epoch_s)
+
+    def _stage_task(self, name: str, task: _ExecTask, epoch_s: int):
         # future-epoch orders (the scheduler publishes whole windows
         # ahead of wall-clock) must not occupy pool workers sleeping in
         # _wait_until — they'd starve due work behind them; stage until
@@ -1166,10 +1426,20 @@ class NodeAgent:
 
     def join_running(self, timeout: float = 10.0):
         deadline = time.monotonic() + timeout
-        for name, t in list(self.running.items()):
-            t.finished.wait(timeout=max(0.0, deadline - time.monotonic()))
-            if t.done():
-                self.running.pop(name, None)
+        while True:
+            tasks = list(self.running.items())
+            if not tasks:
+                break
+            for name, t in tasks:
+                t.finished.wait(timeout=max(0.0,
+                                            deadline - time.monotonic()))
+                if t.done():
+                    self.running.pop(name, None)
+            if time.monotonic() >= deadline:
+                break
+            # re-snapshot: a bundle task that just finished fans its
+            # member executions out to the pool — the barrier must cover
+            # work spawned while it waited, not just the first snapshot
         # joined executions' records must be visible in the sink once
         # this returns (callers treat join as the completion barrier);
         # force past any retry backoff — the sink may have healed

@@ -53,9 +53,14 @@ class OrderPublisher:
         self._sem = threading.Semaphore(max_backlog)
         self._q: "queue.Queue" = queue.Queue()
         self.stats = {"published_total": 0, "publish_failures": 0,
-                      "publish_windows": 0}
+                      "publish_windows": 0, "publish_abandoned": 0}
         self.last_window_ms = 0.0
         self.published_through = 0   # every second < this is in the store
+        # largest key count any single second published — the herd-burst
+        # gauge: with coalesced orders a minute-boundary herd stays at
+        # <= one key per active node (~10k at 1M x 10k) instead of one
+        # per fire (~110k)
+        self.max_second_keys = 0
         self._mu = threading.Lock()
         self._idle = threading.Condition(self._mu)
         self._inflight = 0
@@ -89,6 +94,21 @@ class OrderPublisher:
             self._inflight += 1
         self._q.put((seconds, lease, hwm, covers_from))
         return time.perf_counter() - t0
+
+    def clear_failed_epoch_below(self, epoch: int) -> bool:
+        """Clear an outstanding publish hole strictly OLDER than
+        ``epoch``.  Called by the scheduler when its catch-up clamp has
+        moved the planning cursor past the hole: those seconds are now
+        SKIPPED (counted), not re-planned, so no future window can ever
+        satisfy ``covers_from <= failed_epoch`` — without this the hole
+        abandons every subsequent window forever (a silent, permanent
+        dispatch stall only a restart would fix).  Returns True if a
+        hole was cleared."""
+        with self._mu:
+            if self._failed_epoch is not None and self._failed_epoch < epoch:
+                self._failed_epoch = None
+                return True
+            return False
 
     def take_failed_epoch(self):
         """The lowest epoch whose orders were dropped after retries, or
@@ -176,6 +196,11 @@ class OrderPublisher:
                 log.warnf("publish hole outstanding; abandoning queued "
                           "window of %d seconds for the re-plan",
                           len(seconds))
+                with self._mu:
+                    # a hole episode must be visible from metrics alone:
+                    # abandoned windows count as windows AND separately
+                    self.stats["publish_abandoned"] += 1
+                    self.stats["publish_windows"] += 1
                 self.last_window_ms = 0.0
                 self._sem.release()
                 with self._idle:
@@ -185,6 +210,8 @@ class OrderPublisher:
             try:
                 for si, (epoch, orders) in enumerate(seconds):
                     ok = True
+                    if len(orders) > self.max_second_keys:
+                        self.max_second_keys = len(orders)
                     if orders:
                         futs = []
                         for ci, i in enumerate(range(0, len(orders),

@@ -10,11 +10,12 @@ Data flow per cycle (:meth:`step`):
    from leased keys, so dead executions age out);
 3. push dirty rows to the device (fixed-shape scatters);
 4. plan the next window of seconds on device;
-5. publish leased execution orders in one bulk write: exclusive jobs get a
-   per-(node, second, job) key on their assigned node; Common jobs get ONE
-   broadcast key per (second, job) that every eligible agent picks up via
-   its local IsRunOn (reference job kinds job.go:30-34, IsRunOn
-   job.go:616-630).
+5. publish leased execution orders in one bulk write: exclusive jobs
+   COALESCE into one key per (node, second) whose value is the node's
+   job list (the key doubles as an outstanding-capacity reservation for
+   len(jobs) slots); Common jobs get ONE broadcast key per (second, job)
+   that every eligible agent picks up via its local IsRunOn (reference
+   job kinds job.go:30-34, IsRunOn job.go:616-630).
 
 Leadership: create-if-absent on the leader key under a lease
 (client.go:95-109 pattern).  Standby instances keep retrying; on leader
@@ -127,12 +128,13 @@ class SchedulerService:
         self._table_updates: Dict[int, dict] = {}
         self._meta_updates: Dict[int, Tuple[bool, float]] = {}
         # Per-row dispatch cache: (exclusive, payload-json, group, job_id,
-        # kind, "/group/job" key tail), maintained by the job watch
-        # handlers so the per-fire order-build loop is dict-lookup +
-        # string-concat only — no json.dumps, no Job lookup per fire (the
-        # leader's order build is on the dispatch plane's critical path).
+        # kind, "/group/job" key tail, json-quoted "group/job" bundle
+        # entry), maintained by the job watch handlers so the per-fire
+        # order-build loop is dict-lookup + list-append only — no
+        # json.dumps, no Job lookup per fire (the leader's order build is
+        # on the dispatch plane's critical path).
         self._row_dispatch: Dict[
-            int, Tuple[bool, str, str, str, int, str]] = {}
+            int, Tuple[bool, str, str, str, int, str, str]] = {}
         # reverse col -> node-id map, maintained on node churn instead of
         # being rebuilt from universe.index every step
         self._col_node: List[Optional[str]] = [None] * self.planner.N
@@ -209,6 +211,12 @@ class SchedulerService:
                       "skipped_seconds": 0,
                       "watch_losses": 0, "dispatches_total": 0,
                       "steps_total": 0}
+        # herd gauges, tracked where orders are built: the most
+        # EXCLUSIVE (per-node) keys any one second published — bounded
+        # by active nodes under coalescing, it was one per fire before —
+        # and the most exclusive fires those keys carried
+        self.max_second_node_keys = 0
+        self.max_second_excl_fires = 0
         # operator metrics: recent device-plan latencies (ring) published
         # via the shared leased-snapshot protocol (a dead scheduler's
         # snapshot expires instead of going stale)
@@ -380,8 +388,11 @@ class SchedulerService:
             self._row_dispatch[row] = (
                 job.exclusive, payload,
                 group, job_id, job.kind,
-                f"/{group}/{job_id}")   # precomputed key tail: the
+                f"/{group}/{job_id}",   # precomputed key tail: the
                                         # order-build loop is concat-only
+                # pre-escaped bundle entry: coalesced (node, second)
+                # values are "[" + ",".join(entries) + "]" at build time
+                json.dumps(f"{group}/{job_id}"))
         for rule_id in old_rules - new_rules:
             self._drop_rule(group, job_id, rule_id)
 
@@ -564,10 +575,13 @@ class SchedulerService:
         return node_id, group, job_id
 
     def _parse_order(self, key: str) -> Optional[Tuple[str, str, str]]:
+        """Legacy per-(node, second, job) order keys only.  Coalesced
+        (node, second) bundle keys need their VALUE for accounting and
+        are handled by _acct_add_order / _build_mirrors; broadcast
+        (Common) orders reserve no exclusive capacity — their load lands
+        via proc keys once running."""
         rest = key[len(self.ks.dispatch):].split("/")
         if len(rest) != 4 or rest[0] == Keyspace.BROADCAST:
-            # broadcast (Common) orders reserve no exclusive capacity;
-            # their load lands via proc keys once running
             return None
         node_id, _epoch, group, job_id = rest
         return node_id, group, job_id
@@ -590,6 +604,27 @@ class SchedulerService:
         if excl:
             self._excl_cnt[node_id] = self._excl_cnt.get(node_id, 0) + 1
 
+    def _acct_add_order(self, key: str, node_id: str, jobs: list):
+        """Mirror + counter add for one COALESCED order key: the bundle
+        reserves len(jobs) exclusive slots and the summed cost until its
+        per-job proc keys exist (the agent's claim_bundle converts the
+        reservation to proc accounting atomically).  The mirror's third
+        element is the slot COUNT — _acct_del decrements exactly what
+        this added, so partial drift from later job edits washes out at
+        anti-entropy like every other mirror entry."""
+        if key in self._orders:
+            return
+        cost = 0.0
+        for group, job_id in jobs:
+            job = self.jobs.get((group, job_id))
+            cost += job.avg_time if job and job.avg_time > 0 else 1.0
+        slots = len(jobs)
+        self._orders[key] = (node_id, cost, slots)
+        self._load_sum[node_id] = self._load_sum.get(node_id, 0.0) + cost
+        if slots:
+            self._excl_cnt[node_id] = \
+                self._excl_cnt.get(node_id, 0) + slots
+
     def _acct_del(self, mirror: Dict[str, Tuple[str, float, bool]],
                   key: str):
         ent = mirror.pop(key, None)
@@ -602,7 +637,9 @@ class SchedulerService:
         else:
             self._load_sum.pop(node_id, None)
         if excl:
-            n = self._excl_cnt.get(node_id, 0) - 1
+            # excl is a slot COUNT for coalesced order keys (bool for
+            # proc entries and legacy per-job orders; bool is int)
+            n = self._excl_cnt.get(node_id, 0) - excl
             if n > 0:
                 self._excl_cnt[node_id] = n
             else:
@@ -641,6 +678,36 @@ class SchedulerService:
             if t:
                 add(procs, kv.key, *t)
         for kv in _list_prefix(store, self.ks.dispatch):
+            rest = kv.key[len(self.ks.dispatch):].split("/")
+            if rest[0] == Keyspace.BROADCAST:
+                # broadcast (Common) orders reserve no exclusive
+                # capacity; their load lands via proc keys once running
+                continue
+            if len(rest) == 2:
+                # coalesced (node, second) bundle: value is the node's
+                # job list; the key reserves len(jobs) exclusive slots
+                try:
+                    entries = json.loads(kv.value)
+                except (json.JSONDecodeError, TypeError):
+                    continue
+                if not isinstance(entries, list):
+                    continue
+                node_id = rest[0]
+                cost = 0.0
+                slots = 0
+                for e in entries:
+                    if not isinstance(e, str) or "/" not in e:
+                        continue
+                    group, _, job_id = e.partition("/")
+                    job = self.jobs.get((group, job_id))
+                    cost += job.avg_time if job and job.avg_time > 0 \
+                        else 1.0
+                    slots += 1
+                orders[kv.key] = (node_id, cost, slots)
+                load[node_id] = load.get(node_id, 0.0) + cost
+                if slots:
+                    excl[node_id] = excl.get(node_id, 0) + slots
+                continue
             t = self._parse_order(kv.key)
             if t:
                 add(orders, kv.key, *t)
@@ -896,6 +963,15 @@ class SchedulerService:
             self.stats["skipped_seconds"] += (now + 1 - self.max_catchup_s
                                               - start)
             start = now + 1 - self.max_catchup_s
+            # if the clamp just moved the cursor PAST an outstanding
+            # publish hole, that hole's seconds are now skipped-and-
+            # counted, not re-planned — clear it, or no future window
+            # ever satisfies covers_from <= failed_epoch and the
+            # publisher abandons every window forever (a silent
+            # permanent dispatch stall; ADVICE r5 high)
+            if self.publisher.clear_failed_epoch_below(start):
+                log.warnf("publish hole aged past max_catchup_s; its "
+                          "seconds were skipped and the hole cleared")
         window = max(1, self.window_s)
         t_plan = time.perf_counter()
         if self._pending_plan is not None and self._pending_plan[0] == start:
@@ -916,7 +992,7 @@ class SchedulerService:
                 self.planner.plan_window_async(self._next_epoch, window))
         lease = self.store.grant(self.dispatch_ttl)
         seconds: List[Tuple[int, list]] = []
-        excl_acct: List[Tuple[str, str, str, str]] = []
+        excl_acct: List[Tuple[str, str, list]] = []
         n_dispatch = 0
         # matured ASYNC overflow replans from the previous step publish
         # first (they are the oldest epochs); their full fire sets were
@@ -924,7 +1000,7 @@ class SchedulerService:
         build_list: List[Tuple[object, bool]] = []
         if self._pending_replans:
             pending, self._pending_replans = self._pending_replans, []
-            for _ep, handle in pending:
+            for _ep, handle, _fires in pending:
                 build_list.append(
                     (self.planner.gather_window(handle)[0], False))
         build_list += [(p, True) for p in plans]
@@ -970,8 +1046,8 @@ class SchedulerService:
             self.publisher.flush()
         # mirror own publishes locally (the orders watch is delete-only:
         # our puts are not echoed back at us)
-        for key, node, group, job_id in excl_acct:
-            self._acct_add(self._orders, key, node, group, job_id)
+        for key, node, jobs in excl_acct:
+            self._acct_add_order(key, node, jobs)
         spans["publish"] = wait_s * 1e3   # backpressure only; the wire
                                           # time is publish_window_ms in
                                           # the metrics snapshot
@@ -988,18 +1064,31 @@ class SchedulerService:
         return n_dispatch
 
     def _build_plan_orders(self, plan, seconds: List[Tuple[int, list]],
-                           excl_acct: List[Tuple[str, str, str, str]]
+                           excl_acct: List[Tuple[str, str, list]]
                            ) -> int:
         """Build one TickPlan's dispatch orders into ``seconds`` (and
         the exclusive-accounting list) — the leader's share of the
-        dispatch plane.  Per-fire work is one dict lookup + string
-        concat: payload and routing were precomputed into _row_dispatch
+        dispatch plane.  Per-fire work is one dict lookup + list
+        append: payload and routing were precomputed into _row_dispatch
         by the job watch handlers.  Routing branches on the ROW's
         exclusive flag, not the plan's bucket split: mesh planners
         don't populate n_excl, and a flag mismatch must never turn a
         placed exclusive fire into a broadcast.  KindAlone fires whose
         lifetime lock is live anywhere are skipped (reference
-        job.go:87-123) via the watch-fed mirror."""
+        job.go:87-123) via the watch-fed mirror.
+
+        Exclusive fires COALESCE into one order key per (node, second)
+        whose value is the node's job list (Common fires were already
+        one broadcast key per (job, second)): a minute-boundary cron
+        herd then publishes <= one key per active node (~10k at the
+        north-star scale) instead of one per fire (~110k), which is what
+        lets the burst publish fit inside the window.  A re-publish of
+        the same (node, second) — overflow replan, hole rewind —
+        OVERWRITES the bundle rather than duplicating keys; agents that
+        consumed the earlier bundle re-claim and the (job, second)
+        fences absorb the dup.  Returns the number of FIRES built (not
+        keys), keeping dispatches_total comparable across the format
+        change."""
         alone_live = self._alone_live
         row_disp = self._row_dispatch
         col_node = self._col_node
@@ -1008,28 +1097,43 @@ class SchedulerService:
         n_cols = len(col_node)
         ep = str(plan.epoch_s)
         orders: List[Tuple[str, str]] = []
+        bundles: Dict[str, list] = {}       # node -> [bundle entry json]
+        bundle_jobs: Dict[str, list] = {}   # node -> [(group, job_id)]
+        n_fires = 0
         for row, node_col in zip(plan.fired.tolist(),
                                  plan.assigned.tolist()):
             ent = row_disp.get(row)
             if ent is None:
                 continue
-            exclusive, payload, group, job_id, kind, suffix = ent
+            exclusive, payload, group, job_id, kind, suffix, bentry = ent
             if kind == KIND_ALONE and job_id in alone_live:
                 continue   # previous run still holds the fleet lock
             if exclusive:
                 if 0 <= node_col < n_cols:
                     node = col_node[node_col]
                     if node:
-                        key = f"{disp_pfx}{node}/{ep}{suffix}"
-                        orders.append((key, payload))
-                        excl_acct.append((key, node, group, job_id))
+                        bundles.setdefault(node, []).append(bentry)
+                        bundle_jobs.setdefault(node, []).append(
+                            (group, job_id))
+                        n_fires += 1
             else:
                 # Common fan-out: ONE broadcast order; eligible agents
                 # each pick it up via their local IsRunOn — the host
                 # never walks the [J, N] matrix per fire
                 orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
+                n_fires += 1
+        n_excl = 0
+        for node, entries in bundles.items():
+            key = f"{disp_pfx}{node}/{ep}"
+            orders.append((key, "[" + ",".join(entries) + "]"))
+            excl_acct.append((key, node, bundle_jobs[node]))
+            n_excl += len(entries)
+        if len(bundles) > self.max_second_node_keys:
+            self.max_second_node_keys = len(bundles)
+        if n_excl > self.max_second_excl_fires:
+            self.max_second_excl_fires = n_excl
         seconds.append((plan.epoch_s, orders))
-        return len(orders)
+        return n_fires
 
     def _escalation_want(self, plan) -> int:
         """Escalated bucket size for an over-bucket second, snapped to
@@ -1053,19 +1157,21 @@ class SchedulerService:
         try:
             lease = self.store.grant(self.dispatch_ttl)
             seconds: List[Tuple[int, list]] = []
-            excl_acct: List[Tuple[str, str, str, str]] = []
+            excl_acct: List[Tuple[str, str, list]] = []
             n = 0
-            for _ep, handle in pending:
+            for _ep, handle, _fires in pending:
                 n += self._build_plan_orders(
                     self.planner.gather_window(handle)[0], seconds,
                     excl_acct)
             self.publisher.submit(seconds, lease, 0)
-            for key, node, group, job_id in excl_acct:
-                self._acct_add(self._orders, key, node, group, job_id)
+            for key, node, jobs in excl_acct:
+                self._acct_add_order(key, node, jobs)
             log.infof("drained %d pending replan fires on hand-off", n)
         except Exception as e:  # noqa: BLE001 — store down: the fires
-            # are genuinely lost; say so loudly
-            self.stats["overflow_drops"] += len(pending)
+            # are genuinely lost; count the FIRES recorded at queue time
+            # (a handle count would understate the loss and skew the
+            # late-vs-lost accounting the docs quote)
+            self.stats["overflow_drops"] += sum(f for _, _, f in pending)
             log.errorf("pending replans LOST on hand-off: %s", e)
 
     def _queue_replan(self, plan):
@@ -1080,7 +1186,9 @@ class SchedulerService:
         self._pending_replans.append(
             (plan.epoch_s,
              self.planner.plan_window_async(plan.epoch_s, 1,
-                                            sla_bucket=want)))
+                                            sla_bucket=want),
+             plan.overflow))   # fire count, for honest loss accounting
+                               # if the handle can't be drained
 
     def _replan_overflow(self, plan):
         """A second whose fires exceeded the adaptive bucket is
@@ -1131,7 +1239,12 @@ class SchedulerService:
             "watch_losses_total": self.stats["watch_losses"],
             "dispatches_total": self.stats["dispatches_total"],
             "steps_total": self.stats["steps_total"],
-            "dispatch_queue_depth": len(self._orders),
+            # outstanding exclusive-slot reservations: slot counts over
+            # the ORDERS mirror only (coalesced keys reserve len(jobs)
+            # each, so key count would understate it; _excl_cnt would
+            # OVERstate it — it also counts running exclusive procs)
+            "dispatch_queue_depth": sum(
+                int(excl) for _n, _c, excl in self._orders.values()),
             "procs_running": len(self._procs),
             "jobs": len(self.jobs),
             "is_leader": 1 if self.is_leader else 0,
@@ -1140,7 +1253,15 @@ class SchedulerService:
             "publish_window_ms": round(self.publisher.last_window_ms, 3),
             "published_total": self.publisher.stats["published_total"],
             "publish_failures": self.publisher.stats["publish_failures"],
+            "publish_abandoned": self.publisher.stats["publish_abandoned"],
             "published_through": self.publisher.published_through,
+            # herd-burst gauges: the largest key count one second ever
+            # published (all kinds), and the exclusive slice — node_keys
+            # is bounded by active nodes under coalescing where
+            # excl_fires used to be its key count
+            "publish_max_second_keys": self.publisher.max_second_keys,
+            "publish_max_second_node_keys": self.max_second_node_keys,
+            "publish_max_second_excl_fires": self.max_second_excl_fires,
         }
 
     def _advance_hwm(self, value: int):

@@ -183,6 +183,29 @@ class MemStore:
             collections.deque(maxlen=history)
         self._sweeper: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # per-op server-side timing for the dispatch plane's hot ops
+        # (claim paths, bulk writes, watch fan-out): op -> [count,
+        # total_ns, max_ns].  Lets a bench attribute the plane's ceiling
+        # to a NAMED component instead of "the store" (VERDICT #2).
+        self._op_ns: Dict[str, list] = {}
+
+    def _op_record(self, op: str, t0_ns: int):
+        dt = time.perf_counter_ns() - t0_ns
+        ent = self._op_ns.get(op)
+        if ent is None:
+            self._op_ns[op] = [1, dt, dt]
+        else:
+            ent[0] += 1
+            ent[1] += dt
+            if dt > ent[2]:
+                ent[2] = dt
+
+    def op_stats(self) -> dict:
+        """Per-op timing snapshot: {op: {count, total_ms, max_ms}}."""
+        with self._lock:
+            return {op: {"count": c, "total_ms": round(t / 1e6, 3),
+                         "max_ms": round(m / 1e6, 3)}
+                    for op, (c, t, m) in self._op_ns.items()}
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -214,10 +237,12 @@ class MemStore:
         whole planned windows at once.  ``items`` is [(key, value), ...];
         the lease (if any) applies to every key."""
         with self._lock:
+            t0 = time.perf_counter_ns()
             self._expire_leases()
             rev = self._rev
             for key, value in items:
                 rev = self._put_locked(key, value, lease)
+            self._op_record("put_many", t0)
             return rev
 
     def _put_locked(self, key: str, value: str, lease: int) -> int:
@@ -367,6 +392,7 @@ class MemStore:
         lease raises KeyError without a half-applied claim.
         """
         with self._lock:
+            t0 = time.perf_counter_ns()
             self._expire_leases()
             for lz in (fence_lease, proc_lease if proc_key else 0):
                 if lz and lz not in self._leases:
@@ -374,12 +400,14 @@ class MemStore:
             if fence_key in self._kv:
                 if order_key:
                     self._delete_locked(order_key)
+                self._op_record("claim", t0)
                 return False
             self._put_locked(fence_key, fence_val, fence_lease)
             if proc_key:
                 self._put_locked(proc_key, proc_val, proc_lease)
             if order_key:
                 self._delete_locked(order_key)
+            self._op_record("claim", t0)
             return True
 
     # ---- leases ----------------------------------------------------------
@@ -394,6 +422,7 @@ class MemStore:
         bool per item — an agent's claim batcher turns a burst of due
         executions into a single store round trip."""
         with self._lock:
+            t0 = time.perf_counter_ns()
             self._expire_leases()
             # malformed items yield per-item False WITHOUT aborting the
             # batch (never a half-applied batch + whole-batch error) —
@@ -419,6 +448,51 @@ class MemStore:
                 if order_key:
                     self._delete_locked(order_key)
                 out.append(True)
+            self._op_record("claim_many", t0)
+            return out
+
+    def claim_bundle(self, order_key: str,
+                     items: Sequence[Sequence[str]],
+                     fence_lease: int = 0,
+                     proc_lease: int = 0) -> List[bool]:
+        """Consume one coalesced (node, second) dispatch bundle in a
+        single atomic op: per-job fence claims + proc registrations for
+        the winners, then ONE delete of the bundle order key.  ``items``
+        is [(fence_key, fence_val, proc_key, proc_val), ...] — proc_key
+        may be "" (short-run suppression registers later via the delay
+        monitor).  The bundle key is the scheduler's outstanding-capacity
+        reservation for the whole bundle; deleting it here — in the same
+        locked op that writes the winners' proc keys — means the
+        reservation converts to proc-key accounting with no window in
+        which capacity is either double-counted or leaked.  Losing items
+        (fence already held: another node ran that (job, second)) change
+        nothing but still count toward the bundle's consumption; the key
+        is deleted regardless of the win/lose mix, exactly once.
+        Malformed items yield per-item False without aborting the
+        bundle.  Leases are validated before any mutation."""
+        with self._lock:
+            t0 = time.perf_counter_ns()
+            self._expire_leases()
+            any_proc = any(len(it) >= 4 and it[2] for it in items)
+            for lz in (fence_lease, proc_lease if any_proc else 0):
+                if lz and lz not in self._leases:
+                    raise KeyError(f"lease {lz} not found")
+            out = []
+            for it in items:
+                if len(it) < 4:
+                    out.append(False)
+                    continue
+                fence_key, fence_val, proc_key, proc_val = it[:4]
+                if fence_key in self._kv:
+                    out.append(False)
+                    continue
+                self._put_locked(fence_key, fence_val, fence_lease)
+                if proc_key:
+                    self._put_locked(proc_key, proc_val, proc_lease)
+                out.append(True)
+            if order_key:
+                self._delete_locked(order_key)
+            self._op_record("claim_bundle", t0)
             return out
 
     def grant(self, ttl: float) -> int:
@@ -501,9 +575,11 @@ class MemStore:
                 self._watchers.remove(w)
 
     def _notify(self, ev: Event):
+        t0 = time.perf_counter_ns()
         self._history.append(ev)
         # copy: an overflowing watcher cancels itself (removes from the
         # list) from inside _emit
         for w in list(self._watchers):
             if ev.kv.key.startswith(w.prefix):
                 w._emit(ev)
+        self._op_record("watch_fanout", t0)

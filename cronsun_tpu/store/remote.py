@@ -72,8 +72,8 @@ def _ev_unwire(w) -> Event:
 _OPS = ("put", "put_many", "get", "get_many", "get_prefix",
         "get_prefix_page", "count_prefix", "delete",
         "delete_prefix", "delete_many", "put_if_absent", "put_if_mod_rev",
-        "claim", "claim_many", "grant", "keepalive", "revoke",
-        "lease_ttl_remaining")
+        "claim", "claim_many", "claim_bundle", "grant", "keepalive",
+        "revoke", "lease_ttl_remaining", "op_stats")
 
 
 class _Conn(LineJsonHandler):
@@ -489,6 +489,19 @@ class RemoteStore:
         whole burst of due executions."""
         return self._call("claim_many", [list(it) for it in items],
                           fence_lease, proc_lease)
+
+    def claim_bundle(self, order_key: str, items, fence_lease: int = 0,
+                     proc_lease: int = 0) -> List[bool]:
+        """Coalesced-order consume (memstore.claim_bundle): the whole
+        (node, second) bundle — per-job fences, winners' proc keys, and
+        the single reservation-key delete — in ONE round trip."""
+        return self._call("claim_bundle", order_key,
+                          [list(it) for it in items],
+                          fence_lease, proc_lease)
+
+    def op_stats(self) -> dict:
+        """Server-side per-op timing snapshot (memstore.op_stats)."""
+        return self._call("op_stats")
 
     # -- leases ------------------------------------------------------------
 

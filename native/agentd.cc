@@ -246,6 +246,30 @@ class StoreClient {
     return true;
   }
 
+  // coalesced-order consume (stored claim_bundle op): the whole
+  // (node, second) bundle — per-job fences, winners' proc puts, and the
+  // single reservation-key delete — in ONE round trip.  items is a
+  // JV::ARR of [fence_key, fence_val, proc_key, proc_val] arrays;
+  // wins gets one bool per item.
+  bool claim_bundle_err(const std::string& order_key, const JV& items,
+                        long long fence_lease, long long proc_lease,
+                        std::vector<bool>& wins, StoreError& err) {
+    JV a = sarg({order_key});
+    a.arr.push_back(items);
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = fence_lease;
+    a.arr.emplace_back();
+    a.arr.back().t = JV::INT;
+    a.arr.back().i = proc_lease;
+    JV r;
+    if (!call("claim_bundle", a, r, err)) return false;
+    wins.clear();
+    if (r.t == JV::ARR)
+      for (const JV& b : r.arr) wins.push_back(b.t == JV::BOOL && b.b);
+    return true;
+  }
+
   void unwatch(long long wid) {
     if (wid < 0) return;
     JV a;
@@ -307,6 +331,33 @@ class StoreClient {
     JV r;
     StoreError e;
     call("revoke", a, r, e);
+  }
+
+  // bulk point-get: one round trip for a bundle's job docs; out gets
+  // one (found, value) per key, in order
+  bool get_many(const std::vector<std::string>& keys,
+                std::vector<std::pair<bool, std::string>>& out) {
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    JV& list = a.arr.back();
+    list.t = JV::ARR;
+    for (const auto& k : keys) {
+      list.arr.emplace_back();
+      list.arr.back().t = JV::STR;
+      list.arr.back().s = k;
+    }
+    JV r;
+    StoreError e;
+    if (!call("get_many", a, r, e) || r.t != JV::ARR) return false;
+    out.clear();
+    for (const JV& kv : r.arr) {
+      if (kv.t == JV::ARR && kv.arr.size() >= 2)
+        out.emplace_back(true, kv.arr[1].s);
+      else
+        out.emplace_back(false, std::string());
+    }
+    return out.size() == keys.size();
   }
 
   // [(key, value)] for a prefix
@@ -389,6 +440,8 @@ class StoreClient {
         case JV::INT: jint(out, v.i); break;
         case JV::DBL: jdbl(out, v.d); break;
         case JV::BOOL: out += v.b ? "true" : "false"; break;
+        case JV::ARR: wire_args(out, v); break;  // nested (claim_bundle
+                                                 // item lists)
         default: out += "null";
       }
     }
@@ -1253,7 +1306,7 @@ class Agent {
         else
           apply_group(ev.value);
       } else if (ev.wid == w_dispatch_ && !ev.is_delete) {
-        handle_dispatch(ev.key, /*consume=*/true);
+        handle_dispatch(ev.key, ev.value, /*consume=*/true);
       } else if (ev.wid == w_broadcast_ && !ev.is_delete) {
         handle_broadcast(ev.key);
       } else if (ev.wid == w_once_ && !ev.is_delete) {
@@ -1265,15 +1318,25 @@ class Agent {
   void resync_orders() {
     std::vector<std::pair<std::string, std::string>> kvs;
     if (store_.get_prefix(pfx_ + "/dispatch/" + id_ + "/", kvs))
-      for (const auto& [k, v] : kvs) handle_dispatch(k, true);
+      for (const auto& [k, v] : kvs) handle_dispatch(k, v, true);
     kvs.clear();
     if (store_.get_prefix(pfx_ + "/dispatch/_all/", kvs))
       for (const auto& [k, v] : kvs) handle_broadcast(k);
   }
 
-  // key: <pfx>/dispatch/<id>/<epoch>/<group>/<job>
-  void handle_dispatch(const std::string& key, bool consume) {
+  // key: <pfx>/dispatch/<id>/<epoch>/<group>/<job>  (legacy per-job) or
+  //      <pfx>/dispatch/<id>/<epoch>                (coalesced bundle,
+  //      value = JSON array of "group/job" strings)
+  void handle_dispatch(const std::string& key, const std::string& value,
+                       bool consume) {
     std::string rest = key.substr((pfx_ + "/dispatch/" + id_ + "/").size());
+    if (rest.find('/') == std::string::npos) {
+      if (rest.empty() || rest.find_first_not_of("0123456789") !=
+                              std::string::npos)
+        return;
+      handle_bundle(key, atoll(rest.c_str()), value);
+      return;
+    }
     long long epoch;
     std::string group, job_id;
     if (!split3(rest, epoch, group, job_id)) return;
@@ -1284,6 +1347,27 @@ class Agent {
     }
     enqueue(j, epoch, /*fenced=*/true, /*gate=*/true,
             consume ? key : std::string());
+  }
+
+  void handle_bundle(const std::string& key, long long epoch,
+                     const std::string& value) {
+    JParser jp(value);
+    JV v;
+    std::vector<std::string> entries;
+    if (jp.value(v) && v.t == JV::ARR)
+      for (const JV& e : v.arr)
+        if (e.t == JV::STR && e.s.find('/') != std::string::npos)
+          entries.push_back(e.s);
+    if (entries.empty()) {
+      store_.del(key);  // malformed/empty: release the reservation
+      return;
+    }
+    auto t = std::make_shared<Task>();
+    t->epoch = epoch;
+    t->bundle = true;
+    t->order_key = key;
+    t->entries = std::move(entries);
+    enqueue_task(std::move(t), epoch);
   }
 
   void handle_broadcast(const std::string& key) {
@@ -1350,16 +1434,35 @@ class Agent {
 
   struct Task {
     JobSpec job;
-    long long epoch;
-    bool fenced, gate;
+    long long epoch = 0;
+    bool fenced = false, gate = false;
     std::string order_key;
+    // coalesced (node, second) bundle: entries are "group/job" strings
+    // and order_key is the bundle key (the capacity reservation)
+    bool bundle = false;
+    std::vector<std::string> entries;
+    // member execution whose fence (and Alone lock) a bundle claim
+    // already settled — execute() skips the claim section
+    bool preclaimed = false;
+    bool proc_written = false;
+    long long alone_lease = 0;
+    std::shared_ptr<std::atomic<bool>> alone_stop;
   };
 
   void enqueue(const JobSpec& j, long long epoch, bool fenced, bool gate,
                const std::string& order_key) {
+    auto t = std::make_shared<Task>();
+    t->job = j;
+    t->epoch = epoch;
+    t->fenced = fenced;
+    t->gate = gate;
+    t->order_key = order_key;
+    enqueue_task(std::move(t), epoch);
+  }
+
+  void enqueue_task(std::shared_ptr<Task> t, long long due) {
     std::lock_guard<std::mutex> g(qmu_);
-    queue_.push({epoch, seq_++, std::make_shared<Task>(
-                                    Task{j, epoch, fenced, gate, order_key})});
+    queue_.push({due, seq_++, std::move(t)});
     qcv_.notify_one();
   }
 
@@ -1393,13 +1496,51 @@ class Agent {
         }
       }
       if (!task) return;
+      if (task->bundle) {
+        run_bundle(*task);
+        continue;
+      }
       execute(task->job, task->epoch, task->fenced, task->gate,
-              task->order_key);
+              task->order_key, task->preclaimed, task->proc_written,
+              task->alone_lease, task->alone_stop);
     }
   }
 
+  // KindAlone lifetime lock: grant + put_if_absent + keepalive thread
+  // for the execution's lifetime (reference job.go:87-123).  False when
+  // the lock is live elsewhere fleet-wide.
+  bool acquire_alone(const JobSpec& j, long long& lease_out,
+                     std::shared_ptr<std::atomic<bool>>& stop_out) {
+    double attl = std::max(5.0, std::min(lock_ttl_, 2 * j.avg_time + 5));
+    long long lease = store_.grant(attl);
+    bool won = false;
+    if (!lease ||
+        !store_.put_if_absent(pfx_ + "/lock/alone/" + j.id, id_, lease,
+                              won) ||
+        !won) {
+      if (lease) store_.revoke(lease);
+      return false;
+    }
+    auto stop = std::make_shared<std::atomic<bool>>(false);
+    StoreClient* sc = &store_;
+    std::thread([sc, lease, attl, stop] {
+      while (!stop->load()) {
+        std::this_thread::sleep_for(
+            std::chrono::duration<double>(std::max(0.5, attl / 3)));
+        if (stop->load()) return;
+        sc->keepalive(lease);
+      }
+    }).detach();
+    lease_out = lease;
+    stop_out = stop;
+    return true;
+  }
+
   void execute(const JobSpec& j, long long epoch, bool fenced, bool gate,
-               const std::string& order_key) {
+               const std::string& order_key, bool preclaimed = false,
+               bool proc_written = false, long long alone_lease_in = 0,
+               std::shared_ptr<std::atomic<bool>> alone_stop_in =
+                   nullptr) {
     {
       // scheduled second -> exec start: the end-to-end dispatch SLA
       // (orders arrive ahead of time and are held to their instant, so
@@ -1425,29 +1566,15 @@ class Agent {
     };
     long long alone_lease = 0;
     std::shared_ptr<std::atomic<bool>> alone_stop;
-    if (fenced && j.kind == 1) {  // KindAlone lifetime lock FIRST
-      double attl = std::max(5.0, std::min(lock_ttl_, 2 * j.avg_time + 5));
-      alone_lease = store_.grant(attl);
-      bool won = false;
-      if (!alone_lease ||
-          !store_.put_if_absent(pfx_ + "/lock/alone/" + j.id, id_,
-                                alone_lease, won) ||
-          !won) {
-        if (alone_lease) store_.revoke(alone_lease);
+    if (preclaimed) {
+      // a bundle claim already holds any Alone lock for this run
+      alone_lease = alone_lease_in;
+      alone_stop = alone_stop_in;
+    } else if (fenced && j.kind == 1) {  // KindAlone lifetime lock FIRST
+      if (!acquire_alone(j, alone_lease, alone_stop)) {
         consume();
         return;  // previous Alone run still live fleet-wide
       }
-      alone_stop = std::make_shared<std::atomic<bool>>(false);
-      long long lease = alone_lease;
-      StoreClient* sc = &store_;
-      std::thread([sc, lease, attl, alone_stop] {
-        while (!alone_stop->load()) {
-          std::this_thread::sleep_for(
-              std::chrono::duration<double>(std::max(0.5, attl / 3)));
-          if (alone_stop->load()) return;
-          sc->keepalive(lease);
-        }
-      }).detach();
     }
     // proc registry key, written only if the run outlives proc_req
     std::string proc_key = pfx_ + "/proc/" + id_ + "/" + j.group + "/" +
@@ -1457,7 +1584,11 @@ class Agent {
     jdbl(proc_val, now_s());
     proc_val += "}";
     std::atomic<bool> proc_put{false};
-    if (fenced && j.kind != 0) {  // exclusive: (job, second) fence
+    if (preclaimed) {
+      // the bundle claim settled the fence; it registered the proc key
+      // (under the proc lease, mirrored in procs_) iff proc_written
+      proc_put = proc_written;
+    } else if (fenced && j.kind != 0) {  // exclusive: (job, second) fence
       // one-RPC claim: fence + proc registration (when the cost
       // estimate says the run will outlive proc_req) + order consume,
       // atomic server-side; falls back to the legacy chain on stores
@@ -1525,6 +1656,205 @@ class Agent {
       record(j, res);
       update_avg_time(j, res);
     }
+  }
+
+  struct BundleMember {
+    JobSpec job;
+    long long alone_lease = 0;
+    std::shared_ptr<std::atomic<bool>> alone_stop;
+    bool with_proc = false;
+    std::string fence_key, nonce, proc_key, proc_val;
+  };
+
+  // Consume one coalesced (node, second) order: resolve the bundle's
+  // jobs, settle KindAlone lifetime locks per member (lock FIRST — a
+  // skip because the previous run is still live must not consume the
+  // (job, second) fence), then one claim_bundle RPC settles every
+  // member's fence + the winners' proc keys + the reservation key, and
+  // the winners re-enter the queue as preclaimed tasks for the worker
+  // pool.  Per-job exactly-once is unchanged: a duplicate bundle
+  // delivery re-claims and loses on the fences.
+  void run_bundle(const Task& task) {
+    // resolve every member's job doc in ONE get_many round trip — a
+    // per-member get would put bundle-size sequential RTTs on the
+    // scheduled-second -> exec-start SLA path (the Python agent batches
+    // the same way); transport failure falls back to per-job fetches
+    std::vector<std::string> keys;
+    for (const std::string& e : task.entries)
+      keys.push_back(pfx_ + "/cmd/" + e);
+    std::vector<std::pair<bool, std::string>> docs;
+    bool bulk = store_.get_many(keys, docs);
+    std::vector<BundleMember> members;
+    JV items;
+    items.t = JV::ARR;
+    for (size_t ei = 0; ei < task.entries.size(); ei++) {
+      const std::string& e = task.entries[ei];
+      size_t s = e.find('/');
+      BundleMember m;
+      bool ok;
+      if (bulk) {
+        ok = docs[ei].first && parse_job(docs[ei].second, m.job);
+        if (ok) {
+          m.job.group = e.substr(0, s);
+          m.job.id = e.substr(s + 1);
+        }
+      } else {
+        ok = fetch_job(e.substr(0, s), e.substr(s + 1), m.job);
+      }
+      if (!ok || m.job.pause) continue;
+      if (m.job.kind == 1 &&
+          !acquire_alone(m.job, m.alone_lease, m.alone_stop))
+        continue;  // previous Alone run still live fleet-wide
+      m.with_proc = proc_req_ <= 0 || m.job.avg_time >= proc_req_;
+      m.fence_key = pfx_ + "/lock/" + m.job.id + "/" +
+                    std::to_string(task.epoch);
+      m.nonce = id_ + "@" + std::to_string(getpid()) + "-" +
+                std::to_string(++claim_seq_);
+      m.proc_key = pfx_ + "/proc/" + id_ + "/" + m.job.group + "/" +
+                   m.job.id + "/" + std::to_string(task.epoch) + "-" +
+                   std::to_string(getpid());
+      m.proc_val = "{\"time\":";
+      jdbl(m.proc_val, now_s());
+      m.proc_val += "}";
+      JV item;
+      item.t = JV::ARR;
+      for (const std::string* f :
+           {&m.fence_key, &m.nonce, &m.proc_key, &m.proc_val}) {
+        item.arr.emplace_back();
+        item.arr.back().t = JV::STR;
+        item.arr.back().s = (f == &m.proc_key && !m.with_proc)
+                                ? std::string()
+                                : *f;
+      }
+      items.arr.push_back(std::move(item));
+      members.push_back(std::move(m));
+    }
+    if (members.empty()) {
+      store_.del(task.order_key);  // nothing claimable: release the
+      return;                      // capacity reservation
+    }
+    std::vector<bool> wins;
+    if (!bundle_claim(task.order_key, items, members, wins)) {
+      // store unreachable: do NOT run unfenced — stop the Alone
+      // keepalives so those locks expire; the leased bundle key ages
+      // out and a resync re-delivers
+      for (auto& m : members)
+        if (m.alone_stop) m.alone_stop->store(true);
+      return;
+    }
+    orders_consumed_ += (long long)members.size();
+    for (size_t i = 0; i < members.size(); i++) {
+      BundleMember& m = members[i];
+      if (i >= wins.size() || !wins[i]) {
+        if (m.alone_lease) {
+          m.alone_stop->store(true);
+          store_.revoke(m.alone_lease);
+        }
+        continue;
+      }
+      if (m.with_proc) {
+        std::lock_guard<std::mutex> g(procs_mu_);
+        procs_[m.proc_key] = m.proc_val;
+      }
+      auto t = std::make_shared<Task>();
+      t->job = m.job;
+      t->epoch = task.epoch;
+      t->fenced = true;
+      t->gate = true;
+      t->preclaimed = true;
+      t->proc_written = m.with_proc;
+      t->alone_lease = m.alone_lease;
+      t->alone_stop = m.alone_stop;
+      enqueue_task(std::move(t), task.epoch);
+    }
+  }
+
+  // One-RPC bundle consume with the degraded-store ladder (mirrors
+  // agent.py _claim_bundle): claim_bundle; unknown op -> per-member
+  // legacy fences + reservation delete; transport error -> fence
+  // read-back by nonce (ours = the claim DID apply server-side).
+  // False = store unreachable: the caller must not run unfenced.
+  bool bundle_claim(const std::string& order_key, const JV& items,
+                    std::vector<BundleMember>& members,
+                    std::vector<bool>& wins) {
+    if (claim_bundle_supported_.load()) {
+      StoreError err;
+      for (int attempt = 0; attempt < 2; attempt++) {
+        long long lease = fence_lease_now(attempt > 0);
+        long long plz;
+        {
+          std::lock_guard<std::mutex> g(procs_mu_);
+          if (attempt > 0) {
+            proc_lease_ = store_.grant(proc_ttl_);
+            for (const auto& [k, v] : procs_)
+              store_.put(k, v, proc_lease_);
+          }
+          plz = proc_lease_;
+        }
+        if (store_.claim_bundle_err(order_key, items, lease, plz, wins,
+                                    err))
+          return true;
+        if (err.kind == "ValueError") {  // server predates the op
+          claim_bundle_supported_ = false;
+          break;
+        }
+        if (err.kind != "KeyError") break;  // transport: read back below
+        // shared lease expired under us: rotate and retry once
+      }
+      if (claim_bundle_supported_.load() && err.kind == "KeyError")
+        return false;  // two lease failures
+      if (claim_bundle_supported_.load()) {
+        // INDETERMINATE: the claim may have applied with the reply
+        // lost.  Fence holds OUR nonce -> it did (incl. proc put and
+        // the order delete); another value -> loss; absent -> legacy
+        // fence with the SAME nonce (a loss to our own nonce is the
+        // late-applying claim's win).
+        wins.clear();
+        for (auto& m : members) {
+          std::string v;
+          bool found = false;
+          if (!get_healed(m.fence_key, v, found)) return false;
+          if (found) {
+            wins.push_back(v == m.nonce);
+            continue;
+          }
+          bool fwon = legacy_fence_member(m);
+          if (!fwon) {
+            std::string v2;
+            bool f2 = false;
+            if (get_healed(m.fence_key, v2, f2) && f2 && v2 == m.nonce)
+              fwon = true;
+          }
+          wins.push_back(fwon);
+        }
+        store_.del(order_key);
+        return true;
+      }
+    }
+    // legacy store: per-member fences, then release the reservation
+    wins.clear();
+    for (auto& m : members) wins.push_back(legacy_fence_member(m));
+    store_.del(order_key);
+    return true;
+  }
+
+  // fence put_if_absent under the shared rotating lease + the winner's
+  // proc put — the per-member degraded path
+  bool legacy_fence_member(BundleMember& m) {
+    bool won = false;
+    for (int attempt = 0; attempt < 2; attempt++) {
+      long long lease = fence_lease_now(attempt > 0);
+      StoreError err;
+      if (store_.put_if_absent_err(m.fence_key, m.nonce, lease, won,
+                                   err))
+        break;
+      if (err.kind != "KeyError") return false;
+    }
+    if (won && m.with_proc) {
+      std::lock_guard<std::mutex> g(procs_mu_);
+      store_.put(m.proc_key, m.proc_val, proc_lease_);
+    }
+    return won;
   }
 
   long long fence_lease_now(bool force_rotate) {
@@ -1803,6 +2133,7 @@ class Agent {
   long long fence_lease_ = 0;
   double fence_rotate_at_ = 0;
   std::atomic<bool> claim_supported_{true};
+  std::atomic<bool> claim_bundle_supported_{true};
   std::atomic<long long> claim_seq_{0};  // per-attempt fence nonces
   std::mutex groups_mu_;
   std::map<std::string, std::vector<std::string>> groups_;

@@ -161,6 +161,52 @@ static double wall_now() {
       .count();
 }
 
+// ---------------------------------------------------------------------------
+// per-op server-side timing (memstore.py op_stats parity): lets a bench
+// attribute the dispatch plane's ceiling to a NAMED component — claim
+// paths, bulk writes, watch fan-out — instead of "the store".
+// ---------------------------------------------------------------------------
+
+struct OpStat {
+  long long count = 0, total_ns = 0, max_ns = 0;
+};
+static std::mutex g_op_mu;
+static std::map<std::string, OpStat> g_op_stats;
+
+static long long mono_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static void op_record(const std::string& op, long long t0_ns) {
+  long long dt = mono_ns() - t0_ns;
+  std::lock_guard<std::mutex> g(g_op_mu);
+  OpStat& s = g_op_stats[op];
+  s.count++;
+  s.total_ns += dt;
+  if (dt > s.max_ns) s.max_ns = dt;
+}
+
+static void op_stats_json(std::string& out) {
+  std::lock_guard<std::mutex> g(g_op_mu);
+  out += '{';
+  bool first = true;
+  for (const auto& [op, s] : g_op_stats) {
+    if (!first) out += ',';
+    first = false;
+    jesc(out, op);
+    out += ":{\"count\":";
+    jint(out, s.count);
+    out += ",\"total_ms\":";
+    jdbl(out, (double)s.total_ns / 1e6);
+    out += ",\"max_ms\":";
+    jdbl(out, (double)s.max_ns / 1e6);
+    out += '}';
+  }
+  out += '}';
+}
+
 class Store {
  public:
   explicit Store(size_t history_cap) : history_cap_(history_cap) {}
@@ -366,6 +412,50 @@ class Store {
       res += "true";
     }
     res += ']';
+  }
+
+  // Coalesced-order consume (memstore.py claim_bundle): per-job fence
+  // claims + winners' proc puts, then ONE delete of the bundle order
+  // key, all under one lock — the (node, second) reservation converts
+  // to proc accounting with no leak/double-count window.  items =
+  // [[fence_key, fence_val, proc_key, proc_val], ...]; malformed items
+  // yield per-item false without aborting the bundle.
+  void claim_bundle(const std::string& order_key, const JV& items,
+                    long long fence_lease, long long proc_lease,
+                    std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    expire_locked();
+    bool any_proc = false;
+    for (const JV& it : items.arr)
+      if (it.t == JV::ARR && it.arr.size() >= 4 && !it.arr[2].s.empty())
+        any_proc = true;
+    if (fence_lease && !leases_.count(fence_lease))
+      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
+    if (any_proc && proc_lease && !leases_.count(proc_lease))
+      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
+    res += '[';
+    bool first = true;
+    for (const JV& it : items.arr) {
+      if (!first) res += ',';
+      first = false;
+      if (it.t != JV::ARR || it.arr.size() < 4) {
+        res += "false";
+        continue;
+      }
+      const std::string& fence_key = it.arr[0].s;
+      const std::string& fence_val = it.arr[1].s;
+      const std::string& proc_key = it.arr[2].s;
+      const std::string& proc_val = it.arr[3].s;
+      if (kv_.count(fence_key)) {
+        res += "false";
+        continue;
+      }
+      put_locked(fence_key, fence_val, fence_lease);
+      if (!proc_key.empty()) put_locked(proc_key, proc_val, proc_lease);
+      res += "true";
+    }
+    res += ']';
+    if (!order_key.empty()) delete_locked(order_key);
   }
 
   long long grant(double ttl) {
@@ -866,6 +956,7 @@ struct Conn : std::enable_shared_from_this<Conn> {
 };
 
 void Store::notify_locked(Ev ev) {
+  long long t0 = mono_ns();
   // shared event body; per-sink envelope
   std::string body;
   ev_wire(body, ev);
@@ -883,6 +974,7 @@ void Store::notify_locked(Ev ev) {
   }
   history_.push_back(std::move(ev));
   if (history_.size() > history_cap_) history_.pop_front();
+  op_record("watch_fanout", t0);
 }
 
 void Store::watch(Sink sink, long long start_rev) {
@@ -954,6 +1046,7 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
     c->kill();
     return;
   }
+  long long t0 = mono_ns();
   try {
     if (op == "auth") {  // no-op when unsecured / already authed
       res = "true";
@@ -997,6 +1090,14 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       empty.t = JV::ARR;
       const JV& items = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
       c->store->claim_many(items, arg_i(args, 1), arg_i(args, 2), res);
+    } else if (op == "claim_bundle") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& items = (args.arr.size() > 1 && args.arr[1].t == JV::ARR) ? args.arr[1] : empty;
+      c->store->claim_bundle(arg_s(args, 0), items, arg_i(args, 2),
+                             arg_i(args, 3), res);
+    } else if (op == "op_stats") {
+      op_stats_json(res);
     } else if (op == "put_if_absent") {
       res = c->store->put_if_absent(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)) ? "true" : "false";
     } else if (op == "put_if_mod_rev") {
@@ -1028,6 +1129,7 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
     }
     out += ",\"r\":";
     out += res;
+    op_record(op, t0);
   } catch (const KeyErr& e) {
     out += ",\"e\":";
     jesc(out, e.msg);

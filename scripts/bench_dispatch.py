@@ -164,6 +164,7 @@ def run_bench(rates, n_agents, seconds, on_log=print):
 
         delivered_before = 0
         per_rate = []
+        legacy_orders = os.environ.get("BENCH_ORDER_FORMAT") == "legacy"
         for rate in rates:
             on_log(f"rate {rate}/s x {seconds}s ...")
             lease = store.grant(300.0)
@@ -171,11 +172,29 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             epoch0 = int(t_start) - 2      # past epochs run immediately
             for e in range(seconds):
                 orders = []
-                for i in range(rate):
-                    nid = node_ids[i % n_agents]
-                    orders.append((
-                        ks.dispatch_key(nid, epoch0 + e, "bench", f"bj{i}"),
-                        '{"rule":"r","kind":2}'))
+                if legacy_orders:
+                    # pre-coalescing wire format (BENCH_ORDER_FORMAT=
+                    # legacy): one key per fire — kept for comparison
+                    for i in range(rate):
+                        nid = node_ids[i % n_agents]
+                        orders.append((
+                            ks.dispatch_key(nid, epoch0 + e, "bench",
+                                            f"bj{i}"),
+                            '{"rule":"r","kind":2}'))
+                else:
+                    # the production wire format: ONE coalesced key per
+                    # (node, second) whose value is the node's job list
+                    # — what the scheduler publishes since the
+                    # per-(node, second) coalescing change
+                    per_node = {}
+                    for i in range(rate):
+                        per_node.setdefault(
+                            node_ids[i % n_agents], []).append(
+                                f"bench/bj{i}")
+                    for nid, jobs in per_node.items():
+                        orders.append((
+                            ks.dispatch_bundle_key(nid, epoch0 + e),
+                            json.dumps(jobs)))
                 # pace the offer: one window write per second, like the
                 # scheduler's one-bulk-write-per-window cadence
                 for c in range(0, len(orders), 20_000):
@@ -231,7 +250,15 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             "dispatch_plane_sweep": per_rate,
             "dispatch_plane_orders_per_sec": round(sustained, 1),
             "dispatch_plane_saturation_offered_per_sec": saturation,
+            "dispatch_plane_order_format":
+                "legacy" if legacy_orders else "coalesced",
         })
+        # per-op server-side timing (claim_bundle/claim_many/put_many/
+        # watch fan-out): names the component that owns the ceiling
+        try:
+            results["dispatch_plane_store_op_stats"] = store.op_stats()
+        except Exception as e:  # noqa: BLE001 — older server
+            on_log(f"op_stats unavailable: {e}")
         if lag_p99:
             results.update({
                 "dispatch_plane_exec_lag_p50_s": max(lag_p50),

@@ -190,11 +190,35 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
             a.publisher.stats["publish_failures"]
         out["sched_steps_measured"] = steps
         out["sched_dispatches_per_step"] = round(dispatched / steps, 1)
+        # the coalescing evidence: fires vs published KEYS, and the
+        # largest key count any single second (the minute-boundary herd)
+        # ever published — the acceptance bar is <= ~1 key per active
+        # node, not one per fire
+        out["sched_order_keys_published"] = \
+            a.publisher.stats["published_total"]
+        out["sched_publish_max_second_keys"] = a.publisher.max_second_keys
+        # the exclusive slice is the coalescing claim: node_keys is
+        # bounded by active nodes; excl_fires is what its key count
+        # used to be before coalescing
+        out["sched_publish_max_second_node_keys"] = a.max_second_node_keys
+        out["sched_publish_max_second_excl_fires"] = \
+            a.max_second_excl_fires
+        if a.publisher.stats["published_total"]:
+            out["sched_coalesce_fires_per_key"] = round(
+                dispatched / a.publisher.stats["published_total"], 2)
+        # per-op server-side timing: attributes the dispatch-plane
+        # ceiling to a named store component (claim paths, bulk writes,
+        # watch fan-out) instead of "the store"
+        try:
+            out["sched_store_op_stats"] = store.op_stats()
+        except Exception as e:  # noqa: BLE001 — older server
+            on_log(f"op_stats unavailable: {e}")
         on_log(f"step p50={out['sched_step_p50_ms']}ms "
                f"p99={out['sched_step_p99_ms']}ms "
                f"publish_window p99={out['sched_publish_window_p99_ms']}ms "
                f"spans={out['sched_step_spans_ms']} "
-               f"dispatch/step={out['sched_dispatches_per_step']}")
+               f"dispatch/step={out['sched_dispatches_per_step']} "
+               f"max_second_keys={out['sched_publish_max_second_keys']}")
 
         # warm standby: loads now, then keeps syncing while A leads.
         # Its first non-leading step warm-compiles the plan program

@@ -550,3 +550,305 @@ def test_record_flush_final_drop_is_not_silent():
     assert agent._rec_retry is None and not agent._rec_buf
     agent.stop()
     store.close()
+
+
+# ---- coalesced (node, second) order bundles -----------------------------
+
+def _bundle(jobs, epoch):
+    """Coalesced order value for [(group, id), ...] — the wire format the
+    scheduler publishes (one key per (node, second))."""
+    return json.dumps([f"{g}/{j}" for g, j in jobs])
+
+
+def _seed_excl(store, n, prefix="bz", nid="n0"):
+    jobs = []
+    for i in range(n):
+        job = Job(id=f"{prefix}{i}", name=f"{prefix}{i}", group="g",
+                  command="echo b", kind=2,
+                  rules=[JobRule(id="r", timer="* * * * * *", nids=[nid])])
+        store.put(KS.job_key("g", job.id), job.to_json())
+        jobs.append(("g", job.id))
+    return jobs
+
+
+def test_bundle_consumed_with_exactly_once_fences():
+    """A coalesced bundle runs every member once; a DUPLICATE delivery
+    of the same (node, second) bundle (hole-rewind overwrite, resync
+    re-list) loses every fence and runs nothing — per-job exactly-once
+    rests on the (job, second) fences exactly as before coalescing."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 3)
+    epoch = int(time.time()) - 1
+    key = KS.dispatch_bundle_key("n0", epoch)
+    store.put(key, _bundle(jobs, epoch))
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 3
+    assert store.get(key) is None, "reservation key not consumed"
+    # every member holds this agent's nonce fence
+    fences = store.get_prefix(KS.lock)
+    assert len(fences) == 3
+    assert all(kv.value.startswith("n0@") for kv in fences)
+    # duplicate delivery: re-claim loses on every fence, zero re-runs
+    store.put(key, _bundle(jobs, epoch))
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 3, "duplicate bundle re-ran a member"
+    assert store.get(key) is None
+    agent.stop()
+    store.close()
+
+
+def test_partial_bundle_releases_reservation_without_double_fire():
+    """One member's fence is already held (another node ran it): the
+    others run, the pre-fenced one does not, and the bundle key — the
+    capacity reservation — is consumed exactly once in the same atomic
+    op that writes the winners' fences (no leak, no double-fire)."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 3, prefix="pz")
+    epoch = int(time.time()) - 1
+    # (pz1, epoch) already ran elsewhere
+    store.put(KS.lock_key("pz1", epoch), "other-node")
+    key = KS.dispatch_bundle_key("n0", epoch)
+    store.put(key, _bundle(jobs, epoch))
+    agent.poll()
+    agent.join_running()
+    recs, total = sink.query_logs()
+    assert total == 2
+    assert {r.job_id for r in recs} == {"pz0", "pz2"}
+    assert store.get(key) is None, "partial consumption leaked the key"
+    assert store.get(KS.lock_key("pz1", epoch)).value == "other-node"
+    agent.stop()
+    store.close()
+
+
+def test_bundle_tolerates_legacy_keys_side_by_side():
+    """Rollout tolerance: a legacy per-(node, second, job) order and a
+    coalesced bundle drain in the same poll, each exactly once."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 2, prefix="mx")
+    legacy = Job(id="lg", name="lg", group="g", command="echo l", kind=2,
+                 rules=[JobRule(id="r", timer="* * * * * *", nids=["n0"])])
+    store.put(KS.job_key("g", "lg"), legacy.to_json())
+    epoch = int(time.time()) - 1
+    store.put(KS.dispatch_bundle_key("n0", epoch), _bundle(jobs, epoch))
+    store.put(KS.dispatch_key("n0", epoch, "g", "lg"),
+              '{"rule":"r","kind":2}')
+    agent.poll()
+    agent.join_running()
+    recs, total = sink.query_logs()
+    assert total == 3
+    assert {r.job_id for r in recs} == {"mx0", "mx1", "lg"}
+    assert not [kv for kv in store.get_prefix(KS.dispatch)], \
+        "orders left unconsumed"
+    agent.stop()
+    store.close()
+
+
+def test_bundle_alone_skip_does_not_consume_fence():
+    """A KindAlone member whose previous run still holds the lifetime
+    lock is skipped WITHOUT consuming its (job, second) fence — the
+    lock-first ordering survives coalescing — while the rest of the
+    bundle runs and the reservation is still released."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 1, prefix="az")
+    alone = Job(id="alz", name="alz", group="g", command="echo a", kind=1,
+                rules=[JobRule(id="r", timer="* * * * * *", nids=["n0"])])
+    store.put(KS.job_key("g", "alz"), alone.to_json())
+    store.put(KS.alone_lock_key("alz"), "other")   # previous run live
+    epoch = int(time.time()) - 1
+    key = KS.dispatch_bundle_key("n0", epoch)
+    store.put(key, _bundle(jobs + [("g", "alz")], epoch))
+    agent.poll()
+    agent.join_running()
+    recs, total = sink.query_logs()
+    assert total == 1 and recs[0].job_id == "az0"
+    assert store.get(KS.lock_key("alz", epoch)) is None, \
+        "Alone skip consumed the fence"
+    assert store.get(key) is None, "reservation not released"
+    agent.stop()
+    store.close()
+
+
+def test_bundle_falls_back_when_store_lacks_claim_bundle():
+    """Degraded-store ladder: a store predating claim_bundle still
+    consumes the bundle exactly once via per-item fences (N+1 RPCs,
+    correct), and a second agent re-delivered the same bundle loses."""
+    class OldStore(MemStore):
+        def claim_bundle(self, *a, **kw):
+            raise RuntimeError("unknown op 'claim_bundle'")
+
+    store, sink = OldStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 3, prefix="fz")
+    epoch = int(time.time()) - 1
+    key = KS.dispatch_bundle_key("n0", epoch)
+    store.put(key, _bundle(jobs, epoch))
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 3
+    assert store.get(key) is None
+    store.put(key, _bundle(jobs, epoch))   # duplicate delivery
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 3, "fallback path broke exactly-once"
+    agent.stop()
+    store.close()
+
+
+def test_bundle_indeterminate_reply_still_runs_once():
+    """claim_bundle APPLIES server-side but the reply is lost: the
+    read-back finds this agent's nonces on every fence and proceeds —
+    no member is skipped, none runs twice, the reservation is gone."""
+    class LostBundleReplyStore(MemStore):
+        drop_replies = 0
+
+        def claim_bundle(self, *a, **kw):
+            out = super().claim_bundle(*a, **kw)
+            if LostBundleReplyStore.drop_replies > 0:
+                LostBundleReplyStore.drop_replies -= 1
+                raise RuntimeError("connection closed")
+            return out
+
+    store, sink = LostBundleReplyStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    jobs = _seed_excl(store, 2, prefix="iz")
+    epoch = int(time.time()) - 1
+    key = KS.dispatch_bundle_key("n0", epoch)
+    store.put(key, _bundle(jobs, epoch))
+    LostBundleReplyStore.drop_replies = 1
+    agent.poll()
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 2, "indeterminate bundle claim skipped executions"
+    assert store.get(key) is None
+    fences = store.get_prefix(KS.lock)
+    assert len(fences) == 2
+    assert all(kv.value.startswith("n0@") for kv in fences)
+    agent.stop()
+    store.close()
+
+
+def test_bundle_waits_for_scheduled_second():
+    """Bundles are staged like per-job orders: nothing in the bundle
+    runs before its cron instant."""
+    store, sink = MemStore(), JobLogStore()
+    t = [1_753_000_000.0]
+    agent = NodeAgent(store, sink, node_id="n0", clock=lambda: t[0])
+    agent.register()
+    jobs = _seed_excl(store, 2, prefix="wz")
+    epoch = int(t[0]) + 3
+    store.put(KS.dispatch_bundle_key("n0", epoch), _bundle(jobs, epoch))
+    agent.poll()
+    time.sleep(0.3)
+    _, total = sink.query_logs()
+    assert total == 0, "bundle ran before its scheduled second"
+    t[0] = epoch + 0.5
+    agent.join_running()
+    _, total = sink.query_logs()
+    assert total == 2
+    agent.stop()
+    store.close()
+
+
+def test_forced_flush_does_not_burn_retry_budget():
+    """ADVICE r5 medium: join_running()'s force=True flush attempts even
+    inside the retry backoff window (the sink may have healed), but a
+    FAILED forced attempt must not count toward rec_flush_max_fails — a
+    caller polling join_running during a sink outage must not exhaust
+    the ~minutes-long retry budget in seconds."""
+    class DownSink(JobLogStore):
+        def __init__(self):
+            super().__init__()
+            self.down = False
+
+        def create_job_logs(self, recs, idem=None):
+            if self.down:
+                raise RuntimeError("sink down")
+            return super().create_job_logs(recs, idem=idem)
+
+    store, sink = MemStore(), DownSink()
+    t = [1_753_000_000.0]
+    agent = NodeAgent(store, sink, node_id="n0", clock=lambda: t[0])
+    agent.register()
+    from cronsun_tpu.logsink import LogRecord
+    agent._rec_buf.append(LogRecord(
+        job_id="j", job_group="g", name="j", node="n0", user="",
+        command="true", output="", success=True, begin_ts=1.0, end_ts=2.0))
+    sink.down = True
+    agent._flush_records()              # parks the batch in the retry slot
+    assert agent._rec_retry is not None
+    fails_after_first = agent._rec_flush_fails
+    # hammer the barrier INSIDE the backoff window: attempts happen but
+    # the budget must not move
+    for _ in range(20):
+        agent.join_running(timeout=0.1)
+    assert agent._rec_flush_fails == fails_after_first, \
+        "forced barrier attempts burned the retry budget"
+    assert agent._rec_retry is not None, "batch dropped early"
+    # scheduled (non-forced) attempts past the backoff still count
+    t[0] += 60.0
+    agent._flush_records()
+    assert agent._rec_flush_fails == fails_after_first + 1
+    # and once the sink heals, a forced barrier flush delivers
+    sink.down = False
+    agent.join_running(timeout=1.0)
+    assert agent._rec_retry is None
+    _, total = sink.query_logs()
+    assert total == 1
+    store.close()
+
+
+def test_bundle_failure_releases_alone_locks():
+    """An error escaping mid-bundle (degraded-path fence raising on a
+    transport failure) must not leak a live Alone keepalive: the
+    lifetime lock the bundle acquired is released, so the job is not
+    blocked fleet-wide until this agent restarts."""
+    class BrokenStore(MemStore):
+        broken = False
+
+        def claim_bundle(self, *a, **kw):
+            if BrokenStore.broken:
+                raise RuntimeError("unknown op 'claim_bundle'")
+            return super().claim_bundle(*a, **kw)
+
+        def put_if_absent(self, key, value, lease=0):
+            # fences fail; the alone LOCK acquire itself succeeds
+            if BrokenStore.broken and key.startswith(KS.lock) \
+                    and not key.startswith(KS.alone_lock):
+                raise RuntimeError("transport down")
+            return super().put_if_absent(key, value, lease=lease)
+
+    store, sink = BrokenStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.register()
+    alone = Job(id="lk", name="lk", group="g", command="echo a", kind=1,
+                rules=[JobRule(id="r", timer="* * * * * *", nids=["n0"])])
+    store.put(KS.job_key("g", "lk"), alone.to_json())
+    epoch = int(time.time()) - 1
+    key = KS.dispatch_bundle_key("n0", epoch)
+    BrokenStore.broken = True
+    store.put(key, json.dumps(["g/lk"]))
+    agent.poll()
+    agent.join_running()
+    BrokenStore.broken = False
+    assert store.get(KS.alone_lock_key("lk")) is None, \
+        "bundle failure leaked the Alone lifetime lock"
+    _, total = sink.query_logs()
+    assert total == 0
+    agent.stop()
+    store.close()

@@ -512,10 +512,12 @@ def test_overflow_becomes_late_fires_never_drops():
                              # full set re-plans ASYNC on the device
     sched.step(now=t0 + 1)   # matured replan publishes every fire
     epoch = t0 + 1
-    orders = store.get_prefix(KS.dispatch + "n0/" + str(epoch) + "/")
-    # distinct (node, second, job) keys: the truncated head's re-publish
-    # overwrites, never duplicates
-    assert len(orders) == n_jobs
+    # coalesced format: ONE (node, second) key whose value is the job
+    # list; the truncated head's re-publish OVERWRITES the bundle, so
+    # the full fire set is what agents see — never duplicate keys
+    kv = store.get(KS.dispatch_bundle_key("n0", epoch))
+    assert kv is not None, "coalesced order bundle missing"
+    assert len(json.loads(kv.value)) == n_jobs
     assert sched.stats["overflow_late_fires"] >= n_jobs - 2048
     assert sched.stats["overflow_drops"] == 0
     assert sched.metrics_snapshot()["overflow_late_fires_total"] > 0
@@ -580,8 +582,174 @@ def test_pending_replans_drain_on_stop():
     assert sched._pending_replans, "overflow replan should be pending"
     sched.stop()             # drains the replan, then the publisher
     epoch = t0 + 1
-    orders = store.get_prefix(KS.dispatch + "n0/" + str(epoch) + "/")
-    assert len(orders) == n_jobs, \
-        f"stop() dropped replan fires ({len(orders)}/{n_jobs})"
+    kv = store.get(KS.dispatch_bundle_key("n0", epoch))
+    n_fires = len(json.loads(kv.value)) if kv is not None else 0
+    assert n_fires == n_jobs, \
+        f"stop() dropped replan fires ({n_fires}/{n_jobs})"
     assert sched.stats["overflow_drops"] == 0
+    store.close()
+
+
+def test_exclusive_orders_coalesce_per_node_second():
+    """The wire-format contract: N exclusive fires targeting one node in
+    one second publish ONE (node, second) key whose value lists every
+    job — and the leader's own mirror reserves len(jobs) slots against
+    that node until the key is consumed."""
+    store = MemStore()
+    store.put(KS.node_key("cz0"), "host:1")
+    n = 5
+    for i in range(n):
+        job = Job(id=f"cz{i:02d}", name=f"cz{i}", group="g",
+                  command="true", kind=2,
+                  rules=[JobRule(id="r", timer="* * * * * *",
+                                 nids=["cz0"])])
+        store.put(KS.job_key("g", job.id), job.to_json())
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, node_id="cz-sched")
+    t0 = 1_753_700_000
+    sched.step(now=t0)
+    keys = [kv for kv in store.get_prefix(KS.dispatch)
+            if not kv.key.startswith(KS.dispatch_all)]
+    # one key per (node, second) — the window is 2 s, so exactly 2 keys
+    assert len(keys) == 2, [kv.key for kv in keys]
+    for kv in keys:
+        entries = json.loads(kv.value)
+        assert sorted(entries) == sorted(f"g/cz{i:02d}" for i in range(n))
+    # capacity reservation: the mirror holds len(jobs) slots per key
+    assert sched._excl_cnt.get("cz0") == 2 * n
+    # herd gauges: exclusive keys per second bounded by nodes (1), while
+    # the fires they carry count separately
+    assert sched.max_second_node_keys == 1
+    assert sched.max_second_excl_fires == n
+    # consuming one bundle releases its whole reservation via the
+    # delete-only orders watch
+    store.delete(keys[0].key)
+    sched.drain_watches()
+    assert sched._excl_cnt.get("cz0") == n
+    sched.stop()
+    store.close()
+
+
+def test_coalesced_bundle_reserves_capacity_via_antientropy():
+    """A FOREIGN coalesced order (written by a dead leader) reaches the
+    mirror via the anti-entropy listing and reserves len(jobs) slots —
+    reconcile_capacity subtracts them from the node's device capacity
+    exactly as the legacy per-job keys did."""
+    store = MemStore()
+    sink = JobLogStore()
+    agent = NodeAgent(store, sink, node_id="rv0")
+    agent.register()
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, node_id="rv-sched")
+    for i in range(2):
+        job = Job(id=f"rv{i}", name=f"rv{i}", group="g", command="true",
+                  kind=2,
+                  rules=[JobRule(id="r", timer="0 0 0 1 1 *",
+                                 nids=["rv0"])])
+        job.check()
+        store.put(KS.job_key("g", job.id), job.to_json())
+    sched.node_caps["rv0"] = 3
+    sched.drain_watches()
+    sched._flush_device()
+    store.put(KS.dispatch_bundle_key("rv0", 1_753_800_000),
+              json.dumps(["g/rv0", "g/rv1"]))
+    sched._mirror_antientropy()
+    sched.reconcile_capacity()
+    import numpy as np
+    col = sched.universe.index["rv0"]
+    assert int(np.asarray(sched.planner.rem_cap[col])) == 1
+    agent.stop()
+    sched.stop()
+    store.close()
+
+
+def test_publish_hole_rewind_republishes_coalesced_bundles():
+    """The hole-rewind contract over the NEW wire format: a window whose
+    publish fails is re-planned after the store heals, and the missed
+    second's EXCLUSIVE fires come back as a coalesced (node, second)
+    bundle (late, never lost)."""
+    store = MemStore()
+    store.put(KS.node_key("hb0"), "host:1")
+    job = Job(id="hb", name="hb", group="g", command="true", kind=2,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["hb0"])])
+    store.put(KS.job_key("g", "hb"), job.to_json())
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, node_id="hb-sched")
+    t0 = 1_753_910_000
+    assert sched.step(now=t0) > 0
+    real_put_many = store.put_many
+
+    def broken(items, lease=0):
+        raise RuntimeError("store down")
+    assert sched._owned_lanes == []
+    store.put_many = broken
+    sched.step(now=t0 + 2)                 # window [t0+3, t0+4] fails
+    sched.publisher.flush()
+    store.put_many = real_put_many
+    sched.step(now=t0 + 4)                 # rewinds to the hole
+    sched.publisher.flush()
+    kv = store.get(KS.dispatch_bundle_key("hb0", t0 + 3))
+    assert kv is not None, "missed second's bundle never re-published"
+    assert json.loads(kv.value) == ["g/hb"]
+    assert sched.stats["skipped_seconds"] == 0
+    assert sched.metrics_snapshot()["publish_abandoned"] >= 0
+    sched.stop()
+    store.close()
+
+
+def test_publish_hole_older_than_catchup_clears_not_livelocks():
+    """ADVICE r5 high — the publish-hole livelock: when the hole epoch
+    ages past max_catchup_s, the catch-up clamp moves the cursor PAST
+    the hole; the hole must then be CLEARED (its seconds counted as
+    skipped) or every later window is abandoned forever.  After the
+    clamp, publishing must resume and the abandoned windows must be
+    visible in the metrics snapshot."""
+    store = MemStore()
+    store.put(KS.node_key("lv0"), "host:1")
+    job = Job(id="lv", name="lv", group="g", command="true", kind=2,
+              rules=[JobRule(id="r", timer="* * * * * *", nids=["lv0"])])
+    store.put(KS.job_key("g", "lv"), job.to_json())
+    sched = SchedulerService(store, job_capacity=64, node_capacity=8,
+                             window_s=2, node_id="lv-sched")
+    sched.max_catchup_s = 10
+    t0 = 1_753_920_000
+    assert sched.step(now=t0) > 0
+    real_put_many = store.put_many
+
+    def broken(items, lease=0):
+        raise RuntimeError("store down")
+    assert sched._owned_lanes == []
+    store.put_many = broken
+    sched.step(now=t0 + 2)                 # hole at t0+3
+    sched.publisher.flush()
+    assert sched.publisher.take_failed_epoch() is not None
+    # store heals only AFTER the hole aged past the catch-up horizon;
+    # meanwhile a window queued BEHIND the hole (the async-publisher
+    # race: submitted before the step observed the failure) is abandoned
+    # — and that abandonment must be countable from metrics alone
+    sched.publisher.submit([(t0 + 7, [("k", "v")])], 0, 0,
+                           covers_from=t0 + 7)
+    sched.publisher.flush()
+    assert sched.publisher.stats["publish_abandoned"] >= 1
+    sched.step(now=t0 + 6)
+    sched.publisher.flush()
+    store.put_many = real_put_many
+    t_late = t0 + 3 + sched.max_catchup_s + 5
+    sched.step(now=t_late)                 # clamp passes the hole
+    sched.publisher.flush()
+    assert sched.publisher.take_failed_epoch() is None, \
+        "aged-out hole never cleared (livelock)"
+    assert sched.stats["skipped_seconds"] > 0, \
+        "the hole's seconds must be counted as skipped"
+    # dispatch RESUMES: the clamped window re-plans from the catch-up
+    # horizon (now+1-max_catchup_s), so bundles for seconds BEYOND the
+    # failed window land in the store again
+    fresh = [kv.key for kv in store.get_prefix(KS.dispatch)
+             if not kv.key.startswith(KS.dispatch_all)
+             and int(kv.key.split("/")[4]) > t0 + 4]
+    assert fresh, "dispatch never resumed after the hole aged out"
+    snap = sched.metrics_snapshot()
+    assert snap["publish_abandoned"] >= 1, \
+        "hole episode invisible in metrics"
+    sched.stop()
     store.close()

@@ -1057,3 +1057,86 @@ def test_native_agent_claim_indeterminate_reply(tmp_path):
         lsock.close()
     finally:
         _teardown(procs)
+
+
+def test_native_agent_consumes_coalesced_bundle(tmp_path):
+    """agentd's coalesced-order path against the native store: one
+    (node, second) bundle key fans out to per-job executions, the
+    per-job fences land under this agent's nonces, the reservation key
+    is consumed, and a DUPLICATE bundle delivery re-claims and loses
+    (exactly-once).  A legacy per-job key drains side by side (rollout
+    tolerance)."""
+    import pathlib
+    agentd = pathlib.Path(REPO) / "native" / "cronsun-agentd"
+    from cronsun_tpu.store.native import find_binary
+    if find_binary() is None or not agentd.exists():
+        pytest.skip("native binaries unavailable")
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--native", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        sh, _, sp = store_addr.rpartition(":")
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--native", "--port", "0",
+                        "--db", str(tmp_path / "logd.wal"))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+        p = subprocess.Popen(
+            [str(agentd), "--store", store_addr, "--logsink", logd_addr,
+             "--node-id", "cxB", "--ttl", "5", "--proc-req", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        _await_ready(p)
+
+        from cronsun_tpu.core import Keyspace
+        from cronsun_tpu.store.remote import RemoteStore
+        ks = Keyspace()
+        direct = RemoteStore(sh, int(sp))
+        for i in range(3):
+            direct.put(ks.job_key("g", f"bj{i}"), json.dumps({
+                "name": f"bj{i}", "command": f"echo bundle-ran-{i}",
+                "kind": 2,
+                "rules": [{"id": "r", "timer": "* * * * * *",
+                           "nids": ["cxB"]}]}))
+        epoch = int(time.time()) - 2        # past: runs immediately
+        bundle = ks.dispatch_bundle_key("cxB", epoch)
+        direct.put(bundle, json.dumps(["g/bj0", "g/bj1", "g/bj2"]))
+        legacy = ks.dispatch_key("cxB", epoch, "g", "bj0")
+        # legacy key for a DIFFERENT second: exercises both formats
+        legacy = ks.dispatch_key("cxB", epoch - 1, "g", "bj0")
+        direct.put(legacy, '{"rule":"r","kind":2}')
+
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(lh, int(lp))
+        deadline = time.time() + 30
+        total = 0
+        while time.time() < deadline:
+            logs, total = sink.query_logs(page_size=50)
+            if total >= 4:
+                break
+            time.sleep(0.5)
+        assert total == 4, f"expected 3 bundle + 1 legacy runs, got {total}"
+        assert direct.get(bundle) is None, "bundle key not consumed"
+        assert direct.get(legacy) is None, "legacy key not consumed"
+        fences = direct.get_prefix(ks.lock)
+        bundle_fences = [kv for kv in fences
+                         if kv.key.endswith(f"/{epoch}")]
+        assert len(bundle_fences) == 3
+        assert all(kv.value.startswith("cxB@") for kv in bundle_fences), \
+            [kv.value for kv in bundle_fences]
+
+        # duplicate bundle: every fence loses, nothing re-runs
+        direct.put(bundle, json.dumps(["g/bj0", "g/bj1", "g/bj2"]))
+        deadline = time.time() + 10
+        while time.time() < deadline and direct.get(bundle) is not None:
+            time.sleep(0.3)
+        assert direct.get(bundle) is None, "duplicate bundle not consumed"
+        time.sleep(1.0)
+        _, total = sink.query_logs(page_size=50)
+        assert total == 4, "duplicate bundle re-ran a member"
+        sink.close()
+        direct.close()
+    finally:
+        _teardown(procs)

@@ -569,6 +569,62 @@ def test_claim_semantics(remote):
     assert s.get("/lk/j/103") is None           # fence not half-written
 
 
+def test_claim_bundle_semantics(remote):
+    """store.claim_bundle: one atomic op consumes a whole coalesced
+    (node, second) order — per-job fences + winners' proc keys + ONE
+    delete of the bundle key.  Both backends must agree bit-for-bit
+    (the coalesced dispatch format's hot path)."""
+    _, s, s2 = remote
+    fl = s.grant(30.0)
+    pl = s.grant(30.0)
+    bundle = "/d/n1/200"
+    s.put(bundle, '["g/a","g/b","g/c"]')
+    # pre-take one fence: another node already ran (b, 200)
+    assert s2.put_if_absent("/lk/b/200", "other") is True
+    wins = s.claim_bundle(bundle, [
+        ("/lk/a/200", "n1@1-1", "/pr/n1/g/a/200", '{"t":1}'),
+        ("/lk/b/200", "n1@1-2", "/pr/n1/g/b/200", '{"t":2}'),
+        ("/lk/c/200", "n1@1-3", "", ""),        # short-run suppression
+        ("bad",),                               # malformed: per-item False
+    ], fl, pl)
+    assert wins == [True, False, True, False]
+    # winners: fence + proc; loser: nothing beyond the existing fence
+    assert s.get("/lk/a/200").value == "n1@1-1"
+    assert s.get("/pr/n1/g/a/200").value == '{"t":1}'
+    assert s.get("/lk/b/200").value == "other"
+    assert s.get("/pr/n1/g/b/200") is None
+    assert s.get("/lk/c/200").value == "n1@1-3"
+    # the reservation key is consumed exactly once, win/lose mix or not
+    assert s.get(bundle) is None
+    # an invalid lease raises with NO half-applied bundle
+    s.put("/d/n1/201", '["g/a"]')
+    with pytest.raises(KeyError):
+        s.claim_bundle("/d/n1/201",
+                       [("/lk/a/201", "n1", "/pr/x", "{}")], fl, 999999)
+    assert s.get("/lk/a/201") is None
+    assert s.get("/d/n1/201") is not None
+    # empty items still release the reservation
+    assert s.claim_bundle("/d/n1/201", [], fl, pl) == []
+    assert s.get("/d/n1/201") is None
+
+
+def test_op_stats_counts_hot_ops(remote):
+    """Per-op server-side timing (claim paths, bulk writes, watch
+    fan-out) is queryable over the wire on both backends — the bench
+    uses it to attribute the dispatch-plane ceiling."""
+    _, s, _ = remote
+    s.put_many([(f"/os/{i}", "v") for i in range(5)])
+    fl = s.grant(30.0)
+    s.claim("/os-lk/1", "n", fl)
+    s.claim_bundle("", [("/os-lk/2", "n", "", "")], fl, 0)
+    stats = s.op_stats()
+    for op in ("put_many", "claim", "claim_bundle"):
+        assert stats[op]["count"] >= 1, (op, stats)
+        assert stats[op]["total_ms"] >= 0
+        assert stats[op]["max_ms"] >= 0
+    assert stats["watch_fanout"]["count"] >= 1
+
+
 def test_delete_many(remote):
     _, s, _ = remote
     s.put_many([(f"/dm/{i}", "v") for i in range(10)])
