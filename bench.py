@@ -346,7 +346,9 @@ def main():
     import subprocess
     try:
         here = os.path.dirname(os.path.abspath(__file__))
-        rates = "500,1000" if quick else "2000,5000,10000,20000"
+        # past 40k offered/s in bundle mode: the per-agent bundle-mode
+        # drain ceiling is read off the at/past-saturation rates
+        rates = "500,1000" if quick else "2000,10000,40000,80000"
         sweep = "1" if quick else "1,2,4"
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "scripts",
@@ -369,7 +371,7 @@ def main():
             proc = subprocess.run(
                 [sys.executable, os.path.join(here, "scripts",
                                               "bench_dispatch.py"),
-                 "--rates", "5000,10000,20000,40000", "--seconds", "3",
+                 "--rates", "5000,20000,40000,80000", "--seconds", "3",
                  "--agent-sweep", "1,2"],
                 capture_output=True, text=True, timeout=1800, cwd=here,
                 env={**os.environ, "BENCH_AGENT": "native"})

@@ -175,7 +175,8 @@ def main(argv=None) -> int:
         store, ks=ks, job_capacity=cfg.job_capacity,
         node_capacity=cfg.node_capacity, window_s=cfg.window_s,
         default_node_cap=cfg.default_node_cap, node_id=args.node_id,
-        dispatch_ttl=cfg.lock_ttl, tz=tz, planner=planner)
+        dispatch_ttl=cfg.lock_ttl, tz=tz, planner=planner,
+        pipelined=None if cfg.pipelined_step else False)
     sched.start()
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)

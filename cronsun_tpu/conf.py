@@ -61,6 +61,10 @@ class Config:
     proc_req: int = 5           # short-run suppression threshold, seconds
     timezone: str = "UTC"
     window_s: int = 4           # planner window per dispatch
+    pipelined_step: bool = True  # two-stage scheduler step (plan ∥
+                                # build+publish); False = serial path
+                                # (rollback switch; mesh planners are
+                                # always serial)
     job_capacity: int = 65536
     node_capacity: int = 1024
     default_node_cap: int = 1 << 20

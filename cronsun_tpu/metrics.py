@@ -19,6 +19,38 @@ from . import log
 from .core import Keyspace
 
 
+class LatencyRing:
+    """Bounded ring of recent latency samples with percentile reads —
+    the shared primitive behind every ``*_p50_ms``/``*_p99_ms`` gauge
+    (step cycle, device plan, per-phase spans, pipeline stage times).
+    Appends are GIL-atomic list ops, so a producer thread (the step
+    loop or the pipeline's build worker) never contends with the
+    metrics snapshot reader."""
+
+    __slots__ = ("cap", "_v")
+
+    def __init__(self, cap: int = 128):
+        self.cap = cap
+        self._v: list = []
+
+    def add(self, v: float) -> None:
+        self._v.append(float(v))
+        if len(self._v) > self.cap:
+            del self._v[:-self.cap]
+
+    def clear(self) -> None:
+        self._v = []
+
+    def __len__(self) -> int:
+        return len(self._v)
+
+    def percentile(self, p: float) -> float:
+        vals = sorted(self._v)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+
 class MetricsPublisher:
     def __init__(self, store, ks: Keyspace, component: str, instance: str,
                  snapshot_fn: Callable[[], dict], interval_s: float = 10.0,

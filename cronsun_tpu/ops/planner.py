@@ -22,6 +22,7 @@ in-place scatters at fixed shapes — no recompiles.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from datetime import timezone
 from functools import partial
 from typing import Optional, Sequence
@@ -254,6 +255,14 @@ class TickPlanner:
         # observed fire count so quiet tables don't pay the max-SLA solve.
         self._bx = _AdaptiveBucket(max_fire_bucket, self.J)
         self._bc = _AdaptiveBucket(max_fire_bucket, self.J)
+        # Double-buffered handles: the scheduler DISPATCHES window N+1
+        # (plan_window_async, step thread) while window N is still being
+        # GATHERED on the pipeline's build worker.  Each handle freezes
+        # its own (kx, kc), so a later bucket resize never corrupts an
+        # in-flight gather; this lock is only for the adaptive buckets'
+        # hysteresis counters, which the two threads would otherwise
+        # read-modify-write concurrently.
+        self._bucket_mu = threading.Lock()
         # single-second bucket sizes warmed by warm_escalation: overflow
         # replans snap UP to one of these so a herd burst hits a cached
         # executable instead of compiling mid-step
@@ -336,15 +345,22 @@ class TickPlanner:
         """Dispatch one window of ``window_s`` consecutive seconds.
 
         ``sla_bucket`` pins both buckets: an int pins each to it, a
-        (kx, kc) tuple pins them separately."""
+        (kx, kc) tuple pins them separately.
+
+        Handles may be double-buffered: a second window may be
+        dispatched before the first is gathered (the returned handle
+        carries its own kx/kc and output futures; carried load/capacity
+        state chains in dispatch order on device).  Dispatch must stay
+        on ONE thread; gather may run on another."""
         from .schedule_table import FRAMEWORK_EPOCH
         from .timecal import window_fields
         if isinstance(sla_bucket, tuple):
             sla_x, sla_c = sla_bucket
         else:
             sla_x = sla_c = sla_bucket
-        kx = self._bx.size(sla_x)
-        kc = self._bc.size(sla_c)
+        with self._bucket_mu:
+            kx = self._bx.size(sla_x)
+            kc = self._bc.size(sla_c)
         impl = self._impl(kx, kc)
         f = window_fields(epoch_s, window_s, tz=self.tz)
         fields_w = np.stack([
@@ -352,10 +368,18 @@ class TickPlanner:
             np.arange(window_s, dtype=np.int64) + (epoch_s - FRAMEWORK_EPOCH),
         ], axis=1).astype(np.int32)                     # [W, 7]
         with jax.profiler.TraceAnnotation("cronsun.plan.dispatch"):
+            # + 0.0 / | 0: the jit donates its load/rem_cap args, and
+            # the dispatch may run on the scheduler's dispatch thread
+            # while the step thread scatters capacity/load updates onto
+            # the SAME buffers — donating the live buffer would leave
+            # the step holding a deleted one.  Donating a fresh copy
+            # costs two [N] ops; a concurrently-landing scatter can at
+            # worst be lost for one window, and the scheduler's
+            # reconcile rewrites load/capacity absolutely every step.
             outs32, outs16, self.load, self.rem_cap = _plan_window_step(
                 self.table, jnp.asarray(fields_w),
-                self.elig, self.exclusive, self.cost, self.load,
-                self.rem_cap, kx, kc, self.rounds, impl)
+                self.elig, self.exclusive, self.cost, self.load + 0.0,
+                self.rem_cap | 0, kx, kc, self.rounds, impl)
         return epoch_s, kx, kc, outs32, outs16
 
     def gather_window(self, handle):
@@ -385,9 +409,12 @@ class TickPlanner:
                 total_fired=xt + ct, n_excl=nx))
         if W:
             # adaptive sizing tracks each bucket's worst second; the shrink
-            # hysteresis counts *ticks*, not calls
-            self._bx.feed(int(o[:, 0].max()), W)
-            self._bc.feed(int(o[:, 1].max()), W)
+            # hysteresis counts *ticks*, not calls.  Gather may run on the
+            # pipeline's build worker while the step thread sizes the next
+            # dispatch — the bucket lock keeps the counters coherent.
+            with self._bucket_mu:
+                self._bx.feed(int(o[:, 0].max()), W)
+                self._bc.feed(int(o[:, 1].max()), W)
         return plans
 
     def plan_window(self, epoch_s: int, window_s: int,
@@ -405,7 +432,8 @@ class TickPlanner:
         actually runs."""
         from .schedule_table import FRAMEWORK_EPOCH
         from .timecal import window_fields
-        kx, kc = self._bx.peek(), self._bc.peek()
+        with self._bucket_mu:
+            kx, kc = self._bx.peek(), self._bc.peek()
         impl = self._impl(kx, kc)
         f = window_fields(epoch_s, window_s, tz=self.tz)
         fields_w = np.stack([
@@ -430,8 +458,10 @@ class TickPlanner:
         Returns the warmed bucket size."""
         from .schedule_table import FRAMEWORK_EPOCH
         from .timecal import window_fields
-        k = min(_next_pow2(max(self._bx.peek(), self._bc.peek()) * factor),
-                self.J)
+        with self._bucket_mu:
+            k = min(_next_pow2(max(self._bx.peek(),
+                                   self._bc.peek()) * factor),
+                    self.J)
         impl = self._impl(k, k)
         f = window_fields(epoch_s, 1, tz=self.tz)
         fields_w = np.stack([

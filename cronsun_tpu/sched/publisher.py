@@ -29,6 +29,11 @@ Failure policy: a chunk retries with backoff a bounded number of times,
 then its orders are dropped and counted (``publish_failures``) — the
 orders are leased, so nothing the store never saw can leak; the
 scheduler's next anti-entropy reconciles capacity.
+
+:class:`WindowBuilder` (below) is the pipeline stage FEEDING this
+publisher: it gathers a dispatched plan handle and builds the window's
+orders off the step's critical path, so the device plans window N+1
+while window N is strung and shipped (see ``SchedulerService.step``).
 """
 
 from __future__ import annotations
@@ -109,6 +114,19 @@ class OrderPublisher:
                 self._failed_epoch = None
                 return True
             return False
+
+    def record_hole(self, epoch: int):
+        """Mark a publish hole for a window that never REACHED submit —
+        the pipeline's build stage calls this when a gather/build dies
+        so the scheduler's next step rewinds its cursor and re-plans
+        the window (late, never lost), exactly as for a failed
+        publish."""
+        self._mark_failed(epoch)
+
+    @property
+    def inflight(self) -> int:
+        """Windows submitted but not yet fully published/abandoned."""
+        return self._inflight
 
     def take_failed_epoch(self):
         """The lowest epoch whose orders were dropped after retries, or
@@ -257,6 +275,97 @@ class OrderPublisher:
             finally:
                 self.last_window_ms = (time.perf_counter() - t0) * 1e3
                 self.stats["publish_windows"] += 1
+                self._sem.release()
+                with self._idle:
+                    self._inflight -= 1
+                    self._idle.notify_all()
+
+
+class WindowBuilder:
+    """The pipelined step's BUILD stage: one worker thread that turns a
+    dispatched plan handle into published dispatch orders.
+
+    ``step()`` hands each window over as a handle (gather deferred) and
+    returns; the worker gathers the device result, builds the window's
+    orders (the vectorized group-by-node build) and submits them to the
+    :class:`OrderPublisher` — so the device plans window N+1 while this
+    thread strings and ships window N, and the step's critical path is
+    watch drain + reconcile + device flush + two async dispatches.
+
+    Ordering: ONE worker, FIFO queue, feeding the publisher's FIFO —
+    windows (and the seconds inside them) can never reorder.
+
+    Backpressure: at most ``max_depth`` windows may be queued/in-flight
+    in this stage; ``submit`` then blocks the step (counted in
+    ``stats``) instead of queueing plans unboundedly — a publisher that
+    can't keep up therefore stalls the NEXT plan, visibly, rather than
+    racing it."""
+
+    def __init__(self, build_fn: Callable[[object], None],
+                 max_depth: int = 2):
+        self._build_fn = build_fn
+        self.max_depth = max_depth
+        self._sem = threading.Semaphore(max_depth)
+        self._q: "queue.Queue" = queue.Queue()
+        self.stats = {"stalls_total": 0, "stall_ms_total": 0.0}
+        self._mu = threading.Lock()
+        self._idle = threading.Condition(self._mu)
+        self._inflight = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="window-builder")
+        self._thread.start()
+
+    @property
+    def depth(self) -> int:
+        """Windows queued or being built in this stage right now."""
+        return self._inflight
+
+    def submit(self, item) -> float:
+        """Queue one window for build+publish; returns seconds spent
+        blocked on this stage's depth cap (0.0 when the pipeline kept
+        up)."""
+        stall = 0.0
+        if not self._sem.acquire(blocking=False):
+            t0 = time.perf_counter()
+            self._sem.acquire()
+            stall = time.perf_counter() - t0
+            with self._mu:
+                self.stats["stalls_total"] += 1
+                self.stats["stall_ms_total"] += stall * 1e3
+        with self._mu:
+            self._inflight += 1
+        self._q.put(item)
+        return stall
+
+    def flush(self, timeout: float = 120.0) -> bool:
+        """Block until every submitted window has been built and handed
+        to the publisher (NOT until published — flush the publisher for
+        that)."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(left)
+        return True
+
+    def stop(self, timeout: float = 120.0):
+        self.flush(timeout)
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                self._build_fn(item)
+            except Exception as e:  # noqa: BLE001 — the build_fn owns
+                # hole recording; this is the never-die backstop
+                log.errorf("window build stage failed: %s", e)
+            finally:
                 self._sem.release()
                 with self._idle:
                     self._inflight -= 1

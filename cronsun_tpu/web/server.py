@@ -505,6 +505,28 @@ class ApiServer:
                     lines.append(f"# TYPE {name} {kind}")
                     seen_types.add(name)
                 lines.append(f'{name}{{instance="{inst}"}} {val}')
+        # server-side store op timings (the store's own op_stats op):
+        # names the component that owns a dispatch-plane ceiling —
+        # claim paths, bulk writes, watch fan-out — and, next to the
+        # scheduler's pipeline_stall_* gauges, shows operators
+        # publisher backpressure without running a bench
+        op_stats = getattr(self.store, "op_stats", None)
+        if op_stats is not None:
+            try:
+                stats = op_stats()
+            except Exception:  # noqa: BLE001 — older store server
+                stats = {}
+            if stats:
+                for field, kind in (("count", "counter"),
+                                    ("total_ms", "counter"),
+                                    ("max_ms", "gauge")):
+                    name = f"cronsun_store_op_{field}"
+                    lines.append(f"# TYPE {name} {kind}")
+                    for op, ent in sorted(stats.items()):
+                        if field not in ent:
+                            continue
+                        o = op.replace('\\', r'\\').replace('"', r'\"')
+                        lines.append(f'{name}{{op="{o}"}} {ent[field]}')
         return PlainText("\n".join(lines) + "\n")
 
     # ---- plumbing --------------------------------------------------------

@@ -337,6 +337,27 @@ def test_metrics_endpoint(world):
     assert "# TYPE cronsun_sched_tick_p99_ms gauge" in text
 
 
+def test_metrics_endpoint_surfaces_store_op_stats(world):
+    """/v1/metrics renders the store's server-side per-op timings
+    (cronsun_store_op_*) so an operator can attribute a dispatch-plane
+    ceiling — and see publisher pressure next to the scheduler's
+    pipeline stall gauges — without running a bench."""
+    store, _, _, c = world
+    store.put_many([("/warm/key", "v")])   # a TIMED op (op_stats only
+                                           # times the plane-critical
+                                           # ops: claim*/put_many/watch)
+    # pipeline gauges ride the ordinary sched snapshot rendering
+    store.put(KS.metrics_key("sched", "s1"), json.dumps({
+        "pipeline_stalls_total": 2, "pipeline_overlap_ratio": 0.41}))
+    text = urllib.request.urlopen(c.base + "/v1/metrics").read().decode()
+    assert "# TYPE cronsun_store_op_count counter" in text
+    assert 'cronsun_store_op_count{op="put_many"}' in text
+    assert 'cronsun_store_op_total_ms{op="put_many"}' in text
+    assert 'cronsun_sched_pipeline_stalls_total{instance="s1"} 2' in text
+    assert 'cronsun_sched_pipeline_overlap_ratio{instance="s1"} 0.41' \
+        in text
+
+
 def test_agent_publishes_metrics_snapshot():
     """Agents publish leased node snapshots the /v1/metrics surface
     renders — execution counters included."""
