@@ -349,7 +349,7 @@ def main():
         # past 40k offered/s in bundle mode: the per-agent bundle-mode
         # drain ceiling is read off the at/past-saturation rates
         rates = "500,1000" if quick else "2000,10000,40000,80000"
-        sweep = "1" if quick else "1,2,4"
+        sweep = "1" if quick else "1,2,4,8"
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "scripts",
                                           "bench_dispatch.py"),

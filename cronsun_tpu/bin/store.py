@@ -30,6 +30,10 @@ def main(argv=None) -> int:
     ap.add_argument("--token", default=None,
                     help="shared secret clients must present "
                          "(default: conf store_token)")
+    ap.add_argument("--stripes", type=int, default=0,
+                    help="keyspace lock stripes (0 = backend default, "
+                         "16); more stripes = more concurrent writers "
+                         "before lock contention")
     args = ap.parse_args(argv)
     if args.wal and not args.native:
         # pure argv check BEFORE setup_common side effects (conf watcher)
@@ -43,7 +47,8 @@ def main(argv=None) -> int:
     if args.native:
         from ..store.native import NativeStoreServer
         srv = NativeStoreServer(host=args.host, port=args.port,
-                                wal=args.wal, token=token).start()
+                                wal=args.wal, token=token,
+                                stripes=args.stripes).start()
 
         def child_died(code: int):
             # the wrapper must not sit healthy-looking in front of a dead
@@ -53,7 +58,10 @@ def main(argv=None) -> int:
             events.shutdown()
         srv.monitor(child_died)
     else:
-        srv = StoreServer(host=args.host, port=args.port,
+        from ..store.memstore import MemStore
+        store = MemStore(stripes=args.stripes) if args.stripes > 0 \
+            else None
+        srv = StoreServer(store=store, host=args.host, port=args.port,
                           token=token, sslctx=sslctx).start()
     log.infof("cronsun-store serving on %s:%d%s", srv.host, srv.port,
               " (tls)" if sslctx is not None else "")

@@ -146,6 +146,29 @@ class NodeAgent:
         self._claim_thread: Optional[threading.Thread] = None
         import itertools
         self._claim_seq = itertools.count(1)   # per-attempt fence nonces
+        # bundle-claim batcher: concurrent due (node, second) bundles —
+        # a catch-up drain surfacing a whole backlog at once, the herd
+        # case — group-commit into ONE claim_bundle_many round trip; a
+        # lone bundle goes through the plain claim_bundle op (equally
+        # one RPC, and the degraded-store ladder stays byte-identical)
+        self._bundle_pending: list = []
+        self._bundle_cv = threading.Condition()
+        self._bundle_thread: Optional[threading.Thread] = None
+        self._bundle_many_supported = True
+        # consumed-order ACKS buffer here and flush in periodic
+        # delete_many batches: order deletion is capacity bookkeeping,
+        # not correctness (exactly-once rests on the (job, second)
+        # fences), so a slow store must never stall an executor thread
+        # on a per-fire delete RPC
+        self._ack_buf: list = []
+        self._ack_mu = threading.Lock()
+        # pop+delete ride one flush mutex (the record flusher's pattern):
+        # join_running/stop use _flush_acks as a completion barrier, so a
+        # batch the background flusher already popped must not still be
+        # in flight when a barrier flush returns empty-handed
+        self._ack_flush_mu = threading.Lock()
+        self._ack_thread: Optional[threading.Thread] = None
+        self.ack_flush_interval = 0.05
         # execution records buffer here and flush in batches over the
         # result-store wire (one bulk call per interval, not one round
         # trip per execution — the reference pays 4 Mongo writes per
@@ -195,7 +218,8 @@ class NodeAgent:
         # operator metrics (rendered fleet-wide at /v1/metrics); counters
         # are bumped from concurrent pool workers -> lock the increments
         self.stats = {"orders_consumed_total": 0, "execs_total": 0,
-                      "execs_failed_total": 0, "watch_losses_total": 0}
+                      "execs_failed_total": 0, "watch_losses_total": 0,
+                      "ack_flush_total": 0, "ack_flush_orders_total": 0}
         self._stats_mu = threading.Lock()
         # scheduled-second -> exec-start lag samples (the end-to-end
         # dispatch SLA), published as p50/p99 in the metrics snapshot
@@ -462,7 +486,9 @@ class NodeAgent:
         def consume_order():
             if order_key is not None and not order_done[0]:
                 order_done[0] = True
-                self.store.delete(order_key)
+                # buffered ack: a slow store must not stall this
+                # executor thread on a per-fire delete RPC
+                self._ack(order_key)
                 self._bump("orders_consumed_total")
 
         try:
@@ -707,6 +733,51 @@ class NodeAgent:
                 self._repair_proc_lease_locked()
                 proc_lease = self._proc_lease or 0
             return self.store.claim_many(items, fence_lease, proc_lease)
+
+    # ---- buffered order acks --------------------------------------------
+
+    def _ack(self, key: str):
+        """Queue a consumed order key for the periodic delete_many
+        flush.  The order key is the scheduler's outstanding-capacity
+        reservation — deleting it is bookkeeping the plane can do
+        lazily; a run's exactly-once never depends on it."""
+        with self._ack_mu:
+            self._ack_buf.append(key)
+            if self._ack_thread is None or not self._ack_thread.is_alive():
+                self._ack_thread = threading.Thread(
+                    target=self._ack_flush_loop, daemon=True,
+                    name=f"ackflush-{self.id}")
+                self._ack_thread.start()
+
+    def _ack_flush_loop(self):
+        while not self._stop.wait(self.ack_flush_interval):
+            self._flush_acks()
+
+    def _flush_acks(self):
+        with self._ack_flush_mu:
+            self._flush_acks_locked()
+
+    def _flush_acks_locked(self):
+        with self._ack_mu:
+            batch, self._ack_buf = self._ack_buf, []
+        if not batch:
+            return
+        try:
+            if hasattr(self.store, "delete_many"):
+                self.store.delete_many(batch)
+            else:                       # minimal store: per-key deletes,
+                for k in batch:         # still off the exec path
+                    self.store.delete(k)
+        except Exception as e:  # noqa: BLE001
+            # order keys are leased: on a store hiccup they age out
+            # server-side, so a failed ack batch is dropped, not
+            # retried into a backlog that outlives its usefulness
+            log.warnf("order-ack flush of %d failed (keys age out): %s",
+                      len(batch), e)
+            return
+        with self._stats_mu:
+            self.stats["ack_flush_total"] += 1
+            self.stats["ack_flush_orders_total"] += len(batch)
 
     def _fence(self, job_id: str, epoch_s: int,
                value: Optional[str] = None) -> bool:
@@ -1007,7 +1078,7 @@ class NodeAgent:
         epoch_s, group, job_id = int(parts[0]), parts[1], parts[2]
         job = self._get_job(group, job_id)
         if job is None or job.pause:
-            self.store.delete(key)
+            self._ack(key)
             return 0
         # the order key stays in the store until the execution's proc
         # key exists — the scheduler counts it as an outstanding
@@ -1031,7 +1102,7 @@ class NodeAgent:
                     group, _, job_id = e.partition("/")
                     pairs.append((group, job_id))
         if not pairs:
-            self.store.delete(key)   # malformed/empty: release the
+            self._ack(key)           # malformed/empty: release the
             return 0                 # capacity reservation
         NodeAgent._spawn_seq += 1
         name = f"bundle-{epoch_s}-{NodeAgent._spawn_seq}"
@@ -1087,11 +1158,8 @@ class NodeAgent:
                                  proc_val])
             if not items:
                 # nothing claimable (paused/missing/Alone-skipped):
-                # release the capacity reservation directly
-                try:
-                    self.store.delete(order_key)
-                except Exception:  # noqa: BLE001 — leased key ages out
-                    pass
+                # release the capacity reservation via the ack flusher
+                self._ack(order_key)
                 return
             wins = self._claim_bundle(order_key, items)
             if wins is None:
@@ -1143,7 +1211,9 @@ class NodeAgent:
         """One-RPC bundle consume with the degraded-store ladder:
 
         - ``claim_bundle`` op (normal path; expired shared leases are
-          rotated/repaired and retried once);
+          rotated/repaired and retried once), group-committed: several
+          bundles due at once — a catch-up backlog — ride ONE
+          ``claim_bundle_many`` round trip (``_claim_bundle_rpc``);
         - unknown op (a store predating the format): per-item legacy
           fences, then the reservation delete — N+1 RPCs, correct;
         - transport error (INDETERMINATE — the claim may have applied
@@ -1155,19 +1225,7 @@ class NodeAgent:
         Returns per-item wins, or None when the store is unreachable
         (callers must not run unfenced)."""
         try:
-            fence_lease = self._fence_lease()
-            with self._procs_mu:
-                proc_lease = self._proc_lease or 0
-            try:
-                return self.store.claim_bundle(order_key, items,
-                                               fence_lease, proc_lease)
-            except KeyError:
-                fence_lease = self._rotate_fence_lease()
-                with self._procs_mu:
-                    self._repair_proc_lease_locked()
-                    proc_lease = self._proc_lease or 0
-                return self.store.claim_bundle(order_key, items,
-                                               fence_lease, proc_lease)
+            return self._claim_bundle_rpc(order_key, items)
         except Exception as e:  # noqa: BLE001 — degrade, never unfenced
             unsupported = isinstance(e, AttributeError) or \
                 "unknown op" in str(e)
@@ -1213,6 +1271,111 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — leased key ages out
             pass
         return wins
+
+    def _claim_bundle_rpc(self, order_key: str, items: list):
+        """One LOGICAL claim_bundle round trip.  Concurrent callers
+        (pool workers draining a backlog of due bundles) group-commit:
+        whatever piles up during the in-flight RPC settles in one
+        ``claim_bundle_many`` call.  A lone bundle uses the plain
+        ``claim_bundle`` op — equally one RPC, and single-bundle error
+        behavior (the degraded ladder's contract) stays byte-identical.
+        Wire errors propagate to the caller's ladder."""
+        if not (self._bundle_many_supported
+                and hasattr(self.store, "claim_bundle_many")):
+            return self._claim_bundle_direct(order_key, items)
+        done = threading.Event()
+        slot = [None, None]             # [wins, exception]
+        with self._bundle_cv:
+            self._bundle_pending.append((order_key, items, done, slot))
+            if self._bundle_thread is None or \
+                    not self._bundle_thread.is_alive():
+                self._bundle_thread = threading.Thread(
+                    target=self._bundle_flush_loop, daemon=True,
+                    name=f"bundles-{self.id}")
+                self._bundle_thread.start()
+            self._bundle_cv.notify()
+        if not done.wait(timeout=30):
+            # indeterminate: the caller's read-back recovery decides
+            raise RuntimeError("bundle claim batch timed out")
+        if slot[1] is not None:
+            raise slot[1]
+        return slot[0]
+
+    def _claim_bundle_direct(self, order_key: str, items: list):
+        fence_lease = self._fence_lease()
+        with self._procs_mu:
+            proc_lease = self._proc_lease or 0
+        try:
+            return self.store.claim_bundle(order_key, items,
+                                           fence_lease, proc_lease)
+        except KeyError:
+            fence_lease = self._rotate_fence_lease()
+            with self._procs_mu:
+                self._repair_proc_lease_locked()
+                proc_lease = self._proc_lease or 0
+            return self.store.claim_bundle(order_key, items,
+                                           fence_lease, proc_lease)
+
+    def _bundle_flush_loop(self):
+        """Group-commit loop for bundle claims: every pending bundle
+        settles in one claim_bundle_many RPC; bundles arriving during
+        the in-flight RPC form the next batch."""
+        while True:
+            with self._bundle_cv:
+                while not self._bundle_pending:
+                    if self._stop.is_set():
+                        return
+                    self._bundle_cv.wait(timeout=0.5)
+                batch, self._bundle_pending = self._bundle_pending, []
+            if len(batch) == 1:
+                order_key, items, done, slot = batch[0]
+                try:
+                    slot[0] = self._claim_bundle_direct(order_key, items)
+                except Exception as e:  # noqa: BLE001 — caller's ladder
+                    slot[1] = e
+                done.set()
+                continue
+            try:
+                results = self._bundle_many_rpc(
+                    [(ok, its) for ok, its, _d, _s in batch])
+                for res, (_ok, _its, done, slot) in zip(results, batch):
+                    slot[0] = res
+                    done.set()
+            except Exception as e:  # noqa: BLE001
+                if "unknown op" in str(e):
+                    # server predates claim_bundle_many: settle this
+                    # batch one RPC each and stop batching
+                    log.warnf("store lacks claim_bundle_many; settling "
+                              "bundles one RPC each")
+                    self._bundle_many_supported = False
+                    for order_key, its, done, slot in batch:
+                        try:
+                            slot[0] = self._claim_bundle_direct(order_key,
+                                                                its)
+                        except Exception as e2:  # noqa: BLE001
+                            slot[1] = e2
+                        done.set()
+                else:
+                    for _ok, _its, done, slot in batch:
+                        slot[1] = e     # each caller's ladder recovers
+                        done.set()
+
+    def _bundle_many_rpc(self, bundles: list):
+        fence_lease = self._fence_lease()
+        with self._procs_mu:
+            proc_lease = self._proc_lease or 0
+        try:
+            return self.store.claim_bundle_many(bundles, fence_lease,
+                                                proc_lease)
+        except KeyError:
+            # a shared lease expired under us (suspended VM, clock
+            # jump): rotate/repair both, retry once
+            fence_lease = self._rotate_fence_lease()
+            with self._procs_mu:
+                self._repair_proc_lease_locked()
+                proc_lease = self._proc_lease or 0
+            return self.store.claim_bundle_many(bundles, fence_lease,
+                                                proc_lease)
 
     def _fence_item(self, item) -> bool:
         """Legacy per-item settle for a bundle member: fence
@@ -1440,9 +1603,11 @@ class NodeAgent:
             # re-snapshot: a bundle task that just finished fans its
             # member executions out to the pool — the barrier must cover
             # work spawned while it waited, not just the first snapshot
-        # joined executions' records must be visible in the sink once
-        # this returns (callers treat join as the completion barrier);
-        # force past any retry backoff — the sink may have healed
+        # joined executions' records must be visible in the sink — and
+        # their consumed order keys gone from the store — once this
+        # returns (callers treat join as the completion barrier); force
+        # past any retry backoff — the sink may have healed
+        self._flush_acks()
         self._flush_records(force=True)
 
     # ---- background loop -------------------------------------------------
@@ -1497,6 +1662,8 @@ class NodeAgent:
                 task.finished.set()
         with self._claim_cv:       # wake the claim flusher so it drains
             self._claim_cv.notify_all()   # pending claims, then exits
+        with self._bundle_cv:      # likewise the bundle-claim flusher
+            self._bundle_cv.notify_all()
         for t in self._threads:
             t.join(timeout=3)
         self._threads.clear()
@@ -1504,8 +1671,10 @@ class NodeAgent:
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
-        # final synchronous drain; anything the sink won't take now is
-        # lost with the process — recorded at error level, not "retry"
+        # final synchronous drains; anything the store/sink won't take
+        # now is lost with the process — order keys age out by lease,
+        # records are logged at error level, not "retry"
+        self._flush_acks()
         self._flush_records(final=True)
         self.unregister()
 

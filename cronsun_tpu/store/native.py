@@ -27,6 +27,7 @@ class NativeStoreServer(NativeProcess):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  binary: Optional[str] = None, history: int = 65536,
                  wal: Optional[str] = None, token: str = "",
+                 stripes: int = 0,
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         binary = binary or find_binary()
@@ -37,6 +38,8 @@ class NativeStoreServer(NativeProcess):
         self.binary = binary
         argv = ["--host", host, "--port", str(port),
                 "--history", str(history)] + (extra_args or [])
+        if stripes > 0:
+            argv += ["--stripes", str(stripes)]
         if wal:
             argv += ["--wal", wal]
         super().__init__(binary, argv, token=token,

@@ -13,7 +13,10 @@ Wire protocol (one JSON object per line, UTF-8):
     client -> server   {"i": <id>, "o": <op>, "a": [args...]}
     server -> client   {"i": <id>, "r": <result>}            (ok)
                        {"i": <id>, "e": <msg>, "k": <kind>}  (error)
-                       {"w": <wid>, "ev": <event>}           (watch push)
+                       {"w": <wid>, "evs": [<event>...]}     (watch push,
+                                                              batched)
+                       {"w": <wid>, "ev": <event>}           (legacy
+                                                              single push)
 
 KV wire form: [key, value, create_rev, mod_rev, lease]
 Event wire form: [type, kv, prev_kv-or-null]
@@ -21,6 +24,11 @@ Event wire form: [type, kv, prev_kv-or-null]
 Design notes:
 - One reader thread per client demuxes RPC replies (by id) and watch
   events (by wid).  Calls are synchronous RPCs; any thread may call.
+- Watch pushes are BATCHED: one pump thread per connection drains every
+  ready watcher per wakeup and ships one {"w", "evs"} frame per watcher
+  (one sendall for the whole wakeup) — a dispatch burst of K events
+  costs a handful of wire frames, not K serialized lines.  Clients
+  accept both the batched and the legacy single-event form.
 - Leases live server-side and expire by TTL whether or not the client is
   connected — exactly etcd's behaviour, and what node-death detection
   relies on (noticer.go:172-200).  A dropped connection closes its
@@ -32,6 +40,7 @@ Design notes:
 from __future__ import annotations
 
 import json
+import queue
 import socket
 import socketserver
 import threading
@@ -72,30 +81,92 @@ def _ev_unwire(w) -> Event:
 _OPS = ("put", "put_many", "get", "get_many", "get_prefix",
         "get_prefix_page", "count_prefix", "delete",
         "delete_prefix", "delete_many", "put_if_absent", "put_if_mod_rev",
-        "claim", "claim_many", "claim_bundle", "grant", "keepalive",
-        "revoke", "lease_ttl_remaining", "op_stats")
+        "claim", "claim_many", "claim_bundle", "claim_bundle_many",
+        "grant", "keepalive", "revoke", "lease_ttl_remaining", "op_stats")
 
 
 class _Conn(LineJsonHandler):
     def setup(self):
         super().setup()
-        self.watchers: Dict[int, Tuple[Watcher, threading.Thread]] = {}
+        self.watchers: Dict[int, Watcher] = {}
+        # one BATCHING pump per connection (not a thread per watcher):
+        # watchers signal readiness here; the pump drains every ready
+        # stream per wakeup and ships one {"w", "evs"} frame per watcher
+        # in a single send
+        self._ready: "queue.Queue[int]" = queue.Queue()
+        self._pump_thread: Optional[threading.Thread] = None
 
-    def _pump(self, wid: int, w: Watcher):
-        """Forward one watcher's events to the client until closed.  A
-        slow-consumer cancellation propagates as a lost notification so
-        the client can re-list + re-watch instead of starving silently."""
+    # per-send coalescing cap (the native writer uses the same bound): a
+    # catch-up replay or expiry burst of 100k events must not serialize
+    # into one multi-MB buffer while holding the write lock — RPC
+    # replies on this connection would stall behind the whole send
+    SEND_CHUNK = 256 << 10
+
+    def _send_batch(self, objs):
+        buf = bytearray()
+        for o in objs:
+            buf += (json.dumps(o, separators=(",", ":")) + "\n").encode()
+            if len(buf) >= self.SEND_CHUNK:
+                self._send_bytes(bytes(buf))
+                buf.clear()
+        if buf:
+            self._send_bytes(bytes(buf))
+
+    def _send_bytes(self, data: bytes):
+        with self.wlock:
+            try:
+                self.request.sendall(data)
+            except OSError:
+                self.alive = False
+
+    def _pump(self):
+        """Forward every watcher's events to the client until the
+        connection dies: per wakeup, drain ALL ready watchers and ship
+        one batched frame per watcher.  A slow-consumer cancellation
+        propagates as a lost notification so the client can re-list +
+        re-watch instead of starving silently."""
+        store: MemStore = self.server.store      # type: ignore[attr-defined]
         while self.alive:
             try:
-                ev = w.get(timeout=0.25)
-            except WatchLost:
-                self._send({"w": wid, "lost": True})
-                return
-            if ev is None:
-                if w._closed:
-                    return
+                wids = {self._ready.get(timeout=0.25)}
+            except queue.Empty:
                 continue
-            self._send({"w": wid, "ev": _ev_wire(ev)})
+            while True:                     # coalesce the whole wakeup
+                try:
+                    wids.add(self._ready.get_nowait())
+                except queue.Empty:
+                    break
+            frames = []
+            nev = 0
+            for wid in wids:
+                w = self.watchers.get(wid)
+                if w is None:
+                    continue
+                try:
+                    evs = w.drain()
+                except WatchLost:
+                    frames.append({"w": wid, "lost": True})
+                    self.watchers.pop(wid, None)
+                    continue
+                if evs:
+                    # bounded frames: a catch-up replay can drain tens
+                    # of thousands of events in one wakeup — ship them
+                    # as a few capped frames, not one giant line
+                    for i in range(0, len(evs), 2048):
+                        chunk = evs[i:i + 2048]
+                        frames.append(
+                            {"w": wid,
+                             "evs": [_ev_wire(e) for e in chunk]})
+                    nev += len(evs)
+                if w.lost:
+                    # the buffered tail is out; come back for the
+                    # WatchLost -> lost frame on the next wakeup
+                    self._ready.put(wid)
+            if frames:
+                self._send_batch(frames)
+                store.op_count("watch_frames", len(frames))
+                if nev:
+                    store.op_count("watch_events", nev)
 
     def dispatch(self, rid, op, args):
         store: MemStore = self.server.store      # type: ignore[attr-defined]
@@ -106,16 +177,21 @@ class _Conn(LineJsonHandler):
                 w = store.watch(prefix, start_rev=start_rev or 0,
                                 events=events)
                 wid = rid
-                t = threading.Thread(target=self._pump, args=(wid, w),
-                                     daemon=True,
-                                     name=f"store-pump-{wid}")
-                self.watchers[wid] = (w, t)
-                t.start()
+                self.watchers[wid] = w
+                w.on_ready = lambda _w, q=self._ready, i=wid: q.put(i)
+                if self._pump_thread is None:
+                    self._pump_thread = threading.Thread(
+                        target=self._pump, daemon=True,
+                        name="store-pump")
+                    self._pump_thread.start()
+                # the start_rev replay filled the queue BEFORE on_ready
+                # was attached: nudge the pump once unconditionally
+                self._ready.put(wid)
                 self._send({"i": rid, "r": wid})
             elif op == "unwatch":
-                ent = self.watchers.pop(args[0], None)
-                if ent:
-                    ent[0].close()
+                w = self.watchers.pop(args[0], None)
+                if w:
+                    w.close()
                 self._send({"i": rid, "r": True})
             elif op in _OPS:
                 r = getattr(store, op)(*args)
@@ -140,7 +216,8 @@ class _Conn(LineJsonHandler):
     def finish(self):
         super().finish()    # retire the handshake watchdog (wire.py)
         self.alive = False
-        for w, _t in self.watchers.values():
+        # snapshot: the pump thread pops lost watchers concurrently
+        for w in list(self.watchers.values()):
             w.close()
         self.watchers.clear()
 
@@ -293,7 +370,10 @@ class RemoteStore:
                 if w is not None:
                     if msg.get("lost"):
                         w._mark_lost()
-                    else:
+                    elif "evs" in msg:       # batched push (one frame,
+                        for e in msg["evs"]:  # many events)
+                            w._emit(_ev_unwire(e))
+                    else:                    # legacy single-event push
                         w._emit(_ev_unwire(msg["ev"]))
                 continue
             rid = msg.get("i")
@@ -498,6 +578,17 @@ class RemoteStore:
         return self._call("claim_bundle", order_key,
                           [list(it) for it in items],
                           fence_lease, proc_lease)
+
+    def claim_bundle_many(self, bundles, fence_lease: int = 0,
+                          proc_lease: int = 0) -> List[List[bool]]:
+        """Batched claim_bundle (memstore.claim_bundle_many): a whole
+        backlog of due (node, second) bundles — the herd catch-up case —
+        settled in ONE round trip.  ``bundles`` is
+        [(order_key, items), ...]."""
+        return self._call(
+            "claim_bundle_many",
+            [[ok, [list(it) for it in items]] for ok, items in bundles],
+            fence_lease, proc_lease)
 
     def op_stats(self) -> dict:
         """Server-side per-op timing snapshot (memstore.op_stats)."""

@@ -202,6 +202,24 @@ class StoreClient {
     return call("delete", sarg({k}), r, e);
   }
 
+  // bulk delete: the agents' buffered order-ack flush retires a whole
+  // batch of consumed order keys in one round trip
+  bool delete_many(const std::vector<std::string>& keys) {
+    JV a;
+    a.t = JV::ARR;
+    a.arr.emplace_back();
+    JV& list = a.arr.back();
+    list.t = JV::ARR;
+    for (const auto& k : keys) {
+      list.arr.emplace_back();
+      list.arr.back().t = JV::STR;
+      list.arr.back().s = k;
+    }
+    JV r;
+    StoreError e;
+    return call("delete_many", a, r, e);
+  }
+
   bool put_if_absent(const std::string& k, const std::string& v,
                      long long lease, bool& won) {
     StoreError e;
@@ -526,22 +544,37 @@ class StoreClient {
     JV v;
     if (!jp.value(v) || v.t != JV::OBJ) return;
     if (const JV* w = v.get("w")) {
-      WatchEvent ev;
-      ev.wid = w->as_int();
-      if (const JV* lost = v.get("lost")) {
-        ev.lost = lost->t == JV::BOOL && lost->b;
-      } else if (const JV* e = v.get("ev")) {
-        // event wire form: [type, kv, prev_kv]; kv: [key, value, ...]
-        if (e->t != JV::ARR || e->arr.size() < 2) return;
-        ev.is_delete = e->arr[0].s == "DELETE";
-        const JV& kv = e->arr[1];
+      long long wid = w->as_int();
+      // event wire form: [type, kv, prev_kv]; kv: [key, value, ...]
+      auto parse_ev = [&](const JV& e, WatchEvent& ev) {
+        ev.wid = wid;
+        if (e.t != JV::ARR || e.arr.size() < 2) return false;
+        ev.is_delete = e.arr[0].s == "DELETE";
+        const JV& kv = e.arr[1];
         if (kv.t == JV::ARR && kv.arr.size() >= 2) {
           ev.key = kv.arr[0].s;
           ev.value = kv.arr[1].s;
         }
-      }
+        return true;
+      };
       std::lock_guard<std::mutex> g(evmu_);
-      events_.push_back(std::move(ev));
+      if (const JV* lost = v.get("lost")) {
+        WatchEvent ev;
+        ev.wid = wid;
+        ev.lost = lost->t == JV::BOOL && lost->b;
+        events_.push_back(std::move(ev));
+      } else if (const JV* evs = v.get("evs")) {
+        // batched push: one frame, many events
+        if (evs->t == JV::ARR)
+          for (const JV& e : evs->arr) {
+            WatchEvent ev;
+            if (parse_ev(e, ev)) events_.push_back(std::move(ev));
+          }
+      } else if (const JV* e = v.get("ev")) {  // legacy single push
+        WatchEvent ev;
+        if (!parse_ev(*e, ev)) return;
+        events_.push_back(std::move(ev));
+      }
       evcv_.notify_all();
       return;
     }
@@ -1067,6 +1100,7 @@ class Agent {
     open_watches();
     std::thread(&Agent::keepalive_loop, this).detach();
     std::thread(&Agent::event_loop, this).detach();
+    std::thread(&Agent::ack_flush_loop, this).detach();
     return true;
   }
 
@@ -1076,6 +1110,7 @@ class Agent {
       std::lock_guard<std::mutex> g(qmu_);
       qcv_.notify_all();
     }
+    flush_acks();   // final synchronous drain of buffered order acks
     if (lease_) store_.revoke(lease_);
     if (proc_lease_) store_.revoke(proc_lease_);
     if (fence_lease_) store_.revoke(fence_lease_);
@@ -1094,6 +1129,40 @@ class Agent {
   }
 
  private:
+  // -- buffered order acks -----------------------------------------------
+  // Consumed-order deletes are capacity bookkeeping, not correctness
+  // (exactly-once rests on the (job, second) fences), so they buffer
+  // here and flush as periodic delete_many batches — a slow store can
+  // no longer stall a worker thread on a per-fire delete RPC.
+
+  void ack_order(const std::string& key) {
+    if (key.empty()) return;
+    std::lock_guard<std::mutex> g(ack_mu_);
+    ack_buf_.push_back(key);
+  }
+
+  void ack_flush_loop() {
+    while (!stop_) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      flush_acks();
+    }
+  }
+
+  void flush_acks() {
+    std::vector<std::string> batch;
+    {
+      std::lock_guard<std::mutex> g(ack_mu_);
+      batch.swap(ack_buf_);
+    }
+    if (batch.empty()) return;
+    // a failed batch drops: order keys are leased and age out
+    // server-side — retrying here could hold keys past their usefulness
+    if (store_.delete_many(batch)) {
+      ack_flushes_++;
+      ack_orders_ += (long long)batch.size();
+    }
+  }
+
   // -- registration ------------------------------------------------------
 
   enum class ProbeResult { kOk, kDuplicate, kUnknown };
@@ -1207,6 +1276,10 @@ class Agent {
     jint(snap, execs_failed_.load());
     snap += ",\"watch_losses_total\":";
     jint(snap, watch_losses_.load());
+    snap += ",\"ack_flush_total\":";
+    jint(snap, ack_flushes_.load());
+    snap += ",\"ack_flush_orders_total\":";
+    jint(snap, ack_orders_.load());
     snap += ",\"running\":";
     jint(snap, running_.load());
     snap += ",\"procs_registered\":";
@@ -1342,7 +1415,7 @@ class Agent {
     if (!split3(rest, epoch, group, job_id)) return;
     JobSpec j;
     if (!fetch_job(group, job_id, j) || j.pause) {
-      store_.del(key);
+      ack_order(key);
       return;
     }
     enqueue(j, epoch, /*fenced=*/true, /*gate=*/true,
@@ -1359,7 +1432,7 @@ class Agent {
         if (e.t == JV::STR && e.s.find('/') != std::string::npos)
           entries.push_back(e.s);
     if (entries.empty()) {
-      store_.del(key);  // malformed/empty: release the reservation
+      ack_order(key);   // malformed/empty: release the reservation
       return;
     }
     auto t = std::make_shared<Task>();
@@ -1560,7 +1633,7 @@ class Agent {
     auto consume = [&] {
       if (!order_key.empty() && !order_done) {
         order_done = true;
-        store_.del(order_key);
+        ack_order(order_key);   // buffered: never a per-fire delete RPC
         orders_consumed_++;
       }
     };
@@ -1626,7 +1699,7 @@ class Agent {
       // reservation until the proc key exists)
       if (!order_key.empty() && !order_done) {
         order_done = true;
-        store_.del(order_key);
+        ack_order(order_key);
         orders_consumed_++;
       }
     };
@@ -1730,7 +1803,7 @@ class Agent {
       members.push_back(std::move(m));
     }
     if (members.empty()) {
-      store_.del(task.order_key);  // nothing claimable: release the
+      ack_order(task.order_key);   // nothing claimable: release the
       return;                      // capacity reservation
     }
     std::vector<bool> wins;
@@ -2150,6 +2223,9 @@ class Agent {
   std::mutex rng_mu_;
   std::atomic<long long> orders_consumed_{0}, execs_{0}, execs_failed_{0},
       watch_losses_{0}, running_{0};
+  std::mutex ack_mu_;                    // buffered consumed-order acks
+  std::vector<std::string> ack_buf_;
+  std::atomic<long long> ack_flushes_{0}, ack_orders_{0};
   std::mutex metrics_mu_;       // lease lifecycle vs shutdown revoke
   long long metrics_lease_ = 0; // -1 = revoked at stop, never re-grant
   double metrics_at_ = 0;

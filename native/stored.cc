@@ -9,12 +9,29 @@
 // Semantics are bit-for-bit those of cronsun_tpu/store/memstore.py —
 // tests/test_remote_store.py runs against both backends as the
 // conformance suite.  Differences are operational only:
-//   - std::map keyspace: prefix scans are O(log n + k), not O(n);
+//   - std::map keyspace per stripe: prefix scans are O(log n + k) per
+//     stripe (merged across stripes), not O(n);
 //   - per-connection bounded outbox + writer thread: a slow watch
 //     consumer stalls (and eventually loses) only its own connection,
-//     never a mutation (memstore notifies under the store lock);
+//     never a mutation;
 //   - no GIL: concurrent clients execute ops in parallel up to the
-//     store mutex.
+//     stripe locks.
+//
+// LOCKING mirrors the striped memstore: the keyspace is hash-sharded
+// across kStripes mutex domains; multi-key ops (txns, claims, bulk
+// writes, prefix scans) lock every stripe they touch in ascending index
+// order.  Three small shared domains remain: sync_mu_ (revision counter
+// + history ring + sink fan-out + WAL append ordering — held per
+// mutation so watch streams stay revision-ordered and the WAL replays
+// in revision order), lease_mu_ (recursive; claim ops hold it across
+// their item loop so a validated lease cannot expire mid-batch), and
+// the op-stats mutex.  Order: stripes (ascending) -> lease -> sync.
+//
+// Watch pushes are BATCHED on the wire: mutations enqueue bare event
+// bodies tagged with their watch id; the per-connection writer groups
+// consecutive same-watch events into one {"w": wid, "evs": [...]} frame
+// per send — a dispatch burst of K events costs a handful of frames,
+// not K lines.
 //
 // Build: make -C native   (g++ -O2 -std=c++17 -pthread)
 
@@ -188,6 +205,13 @@ static void op_record(const std::string& op, long long t0_ns) {
   if (dt > s.max_ns) s.max_ns = dt;
 }
 
+// count-only stat (no timing): stripe-contention ticks, watch-batch
+// frame/event tallies — same op_stats surface as memstore.op_count
+static void op_count(const std::string& op, long long n) {
+  std::lock_guard<std::mutex> g(g_op_mu);
+  g_op_stats[op].count += n;
+}
+
 static void op_stats_json(std::string& out) {
   std::lock_guard<std::mutex> g(g_op_mu);
   out += '{';
@@ -209,61 +233,158 @@ static void op_stats_json(std::string& out) {
 
 class Store {
  public:
-  explicit Store(size_t history_cap) : history_cap_(history_cap) {}
+  static constexpr size_t kDefaultStripes = 16;
 
-  // every public op locks; *_locked helpers assume the lock is held
-  std::mutex mu;
+  Store(size_t history_cap, size_t stripes = kDefaultStripes)
+      : nstripes_(stripes < 1 ? 1 : stripes),
+        stripes_(nstripes_),
+        history_cap_(history_cap) {}
+
+  struct Stripe {
+    std::mutex mu;
+    std::map<std::string, KVRec> kv;
+  };
+
+  size_t sidx(const std::string& key) const {
+    return std::hash<std::string>{}(key) % nstripes_;
+  }
+
+  void lock_stripe(size_t i) {
+    if (stripes_[i].mu.try_lock()) return;
+    // blocked acquisition = real cross-writer contention; counted so a
+    // bench can see whether the stripe count is the ceiling
+    op_count("stripe_contention", 1);
+    stripes_[i].mu.lock();
+  }
+
+  // single-stripe RAII fast path: the hot single-key ops must not pay
+  // a vector + sort per op
+  struct OneStripe {
+    Store& s;
+    size_t i;
+    OneStripe(Store& st, size_t idx) : s(st), i(idx) { s.lock_stripe(idx); }
+    ~OneStripe() { s.stripes_[i].mu.unlock(); }
+  };
+
+  // RAII multi-stripe acquisition in ascending index order — the
+  // deadlock-free order every multi-key op uses
+  struct StripeLock {
+    Store& s;
+    std::vector<size_t> idxs;
+    StripeLock(Store& st, std::vector<size_t> v) : s(st), idxs(std::move(v)) {
+      std::sort(idxs.begin(), idxs.end());
+      idxs.erase(std::unique(idxs.begin(), idxs.end()), idxs.end());
+      for (size_t i : idxs) s.lock_stripe(i);
+    }
+    ~StripeLock() {
+      for (auto it = idxs.rbegin(); it != idxs.rend(); ++it)
+        s.stripes_[*it].mu.unlock();
+    }
+  };
+
+  std::vector<size_t> all_idxs() const {
+    std::vector<size_t> v(nstripes_);
+    for (size_t i = 0; i < nstripes_; i++) v[i] = i;
+    return v;
+  }
+
+  void set_has_sweeper() { has_sweeper_ = true; }
+
+  // per-op lease expiry: leave expiry to the sweeper when one runs —
+  // an unconditional whole-table scan per op (under the shared lease
+  // mutex) was a measured hot-path cost and re-serialized the striped
+  // ops.  Writes still reject expired-but-unswept leases via the O(1)
+  // deadline check at validation (check_lease_locked).
+  void lazy_expire() {
+    if (!has_sweeper_.load(std::memory_order_relaxed)) expire();
+  }
+
+  // caller holds lease_mu_.  Deadline counts: an expired-but-unswept
+  // lease is as dead as a revoked one — without the per-op expiry scan
+  // this O(1) check is what keeps a write from silently attaching to a
+  // lease the next sweep will kill.
+  void check_lease_locked(long long lz) {
+    auto it = leases_.find(lz);
+    if (it == leases_.end() || it->second.deadline <= now())
+      throw KeyErr{"lease " + std::to_string(lz) + " not found"};
+  }
+
+  void validate_lease_arg(long long lz) {
+    if (!lz) return;
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    check_lease_locked(lz);
+  }
 
   long long put(const std::string& key, const std::string& value, long long lease) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    validate_lease_arg(lease);
+    OneStripe g(*this, sidx(key));
     return put_locked(key, value, lease);
   }
 
   long long put_many(const JV& items, long long lease) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
-    long long rev = rev_;
+    lazy_expire();
+    std::vector<size_t> idxs;
     for (const JV& it : items.arr) {
       if (it.t != JV::ARR || it.arr.size() < 2) throw KeyErr{"bad put_many item"};
-      rev = put_locked(it.arr[0].s, it.arr[1].s, lease);
+      idxs.push_back(sidx(it.arr[0].s));
     }
+    validate_lease_arg(lease);
+    StripeLock g(*this, std::move(idxs));
+    long long rev;
+    {
+      std::lock_guard<std::mutex> sg(sync_mu_);
+      rev = rev_;
+    }
+    for (const JV& it : items.arr)
+      rev = put_locked(it.arr[0].s, it.arr[1].s, lease);
     return rev;
   }
 
   bool get(const std::string& key, std::string& out) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
-    auto it = kv_.find(key);
-    if (it == kv_.end()) return false;
+    lazy_expire();
+    size_t i = sidx(key);
+    OneStripe g(*this, i);
+    auto& kv = stripes_[i].kv;
+    auto it = kv.find(key);
+    if (it == kv.end()) return false;
     kv_wire(out, it->first, it->second);
     return true;
   }
 
   void get_many(const JV& keys, std::string& out) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    std::vector<size_t> idxs;
+    for (const JV& k : keys.arr)
+      if (k.t == JV::STR) idxs.push_back(sidx(k.s));
+    StripeLock g(*this, std::move(idxs));
     out += '[';
     bool first = true;
     for (const JV& k : keys.arr) {
       if (!first) out += ',';
       first = false;
-      auto it = k.t == JV::STR ? kv_.find(k.s) : kv_.end();
-      if (it == kv_.end()) out += "null";
+      if (k.t != JV::STR) {
+        out += "null";
+        continue;
+      }
+      auto& kv = stripes_[sidx(k.s)].kv;
+      auto it = kv.find(k.s);
+      if (it == kv.end()) out += "null";
       else kv_wire(out, it->first, it->second);
     }
     out += ']';
   }
 
   void get_prefix(const std::string& prefix, std::string& out) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    StripeLock g(*this, all_idxs());
+    auto hits = prefix_hits_locked(prefix);
     out += '[';
     bool first = true;
-    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it) {
+    for (auto& [k, rec] : hits) {
       if (!first) out += ',';
       first = false;
-      kv_wire(out, it->first, it->second);
+      kv_wire(out, *k, *rec);
     }
     out += ']';
   }
@@ -272,67 +393,88 @@ class Store {
   // after `start_after` — a 1M-key prefix as ONE reply is hundreds of
   // MB and a seconds-long GIL hold for the Python client to parse;
   // pages bound the reply, the parse slice, and peak memory (etcd
-  // WithRange+WithLimit semantics)
+  // WithRange+WithLimit semantics).  Per stripe the scan is bounded to
+  // `limit` matches, then the merged candidates are truncated.
   void get_prefix_page(const std::string& prefix,
                        const std::string& start_after, long long limit,
                        std::string& out) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
     if (limit < 1) limit = 1;
-    auto it = start_after.empty() || start_after < prefix
-                  ? kv_.lower_bound(prefix)
-                  : kv_.upper_bound(start_after);
+    StripeLock g(*this, all_idxs());
+    std::vector<std::pair<const std::string*, const KVRec*>> hits;
+    for (Stripe& st : stripes_) {
+      auto it = start_after.empty() || start_after < prefix
+                    ? st.kv.lower_bound(prefix)
+                    : st.kv.upper_bound(start_after);
+      long long n = 0;
+      for (; it != st.kv.end() && starts_with(it->first, prefix) &&
+             n < limit;
+           ++it, ++n)
+        hits.emplace_back(&it->first, &it->second);
+    }
+    std::sort(hits.begin(), hits.end(),
+              [](const auto& a, const auto& b) { return *a.first < *b.first; });
+    if ((long long)hits.size() > limit) hits.resize((size_t)limit);
     out += '[';
     bool first = true;
-    long long n = 0;
-    for (; it != kv_.end() && starts_with(it->first, prefix) && n < limit;
-         ++it, ++n) {
+    for (auto& [k, rec] : hits) {
       if (!first) out += ',';
       first = false;
-      kv_wire(out, it->first, it->second);
+      kv_wire(out, *k, *rec);
     }
     out += ']';
   }
 
   long long count_prefix(const std::string& prefix) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    StripeLock g(*this, all_idxs());
     long long n = 0;
-    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it) n++;
+    for (Stripe& st : stripes_)
+      for (auto it = st.kv.lower_bound(prefix);
+           it != st.kv.end() && starts_with(it->first, prefix); ++it)
+        n++;
     return n;
   }
 
   bool del(const std::string& key) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    OneStripe g(*this, sidx(key));
     return delete_locked(key);
   }
 
   long long delete_prefix(const std::string& prefix) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    StripeLock g(*this, all_idxs());
     std::vector<std::string> keys;
-    for (auto it = kv_.lower_bound(prefix); it != kv_.end() && starts_with(it->first, prefix); ++it)
-      keys.push_back(it->first);
+    for (Stripe& st : stripes_)
+      for (auto it = st.kv.lower_bound(prefix);
+           it != st.kv.end() && starts_with(it->first, prefix); ++it)
+        keys.push_back(it->first);
+    std::sort(keys.begin(), keys.end());
     for (const auto& k : keys) delete_locked(k);
     return (long long)keys.size();
   }
 
   bool put_if_absent(const std::string& key, const std::string& value, long long lease) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
-    if (kv_.count(key)) return false;
+    lazy_expire();
+    validate_lease_arg(lease);
+    size_t i = sidx(key);
+    OneStripe g(*this, i);
+    if (stripes_[i].kv.count(key)) return false;
     put_locked(key, value, lease);
     return true;
   }
 
   bool put_if_mod_rev(const std::string& key, const std::string& value, long long mod_rev, long long lease) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
-    auto it = kv_.find(key);
+    lazy_expire();
+    validate_lease_arg(lease);
+    size_t i = sidx(key);
+    OneStripe g(*this, i);
+    auto& kv = stripes_[i].kv;
+    auto it = kv.find(key);
     if (mod_rev == 0) {
-      if (it != kv_.end()) return false;
-    } else if (it == kv_.end() || it->second.mod_rev != mod_rev) {
+      if (it != kv.end()) return false;
+    } else if (it == kv.end() || it->second.mod_rev != mod_rev) {
       return false;
     }
     put_locked(key, value, lease);
@@ -340,8 +482,11 @@ class Store {
   }
 
   long long delete_many(const JV& keys) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
+    std::vector<size_t> idxs;
+    for (const JV& k : keys.arr)
+      if (k.t == JV::STR) idxs.push_back(sidx(k.s));
+    StripeLock g(*this, std::move(idxs));
     long long n = 0;
     for (const JV& k : keys.arr)
       if (k.t == JV::STR && delete_locked(k.s)) n++;
@@ -355,14 +500,17 @@ class Store {
              long long fence_lease, const std::string& order_key,
              const std::string& proc_key, const std::string& proc_val,
              long long proc_lease) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
-    // validate BOTH leases before any mutation (no half-applied claims)
-    if (fence_lease && !leases_.count(fence_lease))
-      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
-    if (!proc_key.empty() && proc_lease && !leases_.count(proc_lease))
-      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
-    if (kv_.count(fence_key)) {
+    lazy_expire();
+    std::vector<size_t> idxs{sidx(fence_key)};
+    if (!order_key.empty()) idxs.push_back(sidx(order_key));
+    if (!proc_key.empty()) idxs.push_back(sidx(proc_key));
+    StripeLock g(*this, std::move(idxs));
+    // the lease lock is held across the whole claim so a lease
+    // validated here cannot expire between validation and use
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    if (fence_lease) check_lease_locked(fence_lease);
+    if (!proc_key.empty() && proc_lease) check_lease_locked(proc_lease);
+    if (stripes_[sidx(fence_key)].kv.count(fence_key)) {
       if (!order_key.empty()) delete_locked(order_key);
       return false;
     }
@@ -377,16 +525,22 @@ class Store {
   // Appends a JSON bool array of per-item outcomes to res.
   void claim_many(const JV& items, long long fence_lease,
                   long long proc_lease, std::string& res) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
     bool any_proc = false;
-    for (const JV& it : items.arr)
-      if (it.t == JV::ARR && it.arr.size() >= 5 && !it.arr[3].s.empty())
+    std::vector<size_t> idxs;
+    for (const JV& it : items.arr) {
+      if (it.t != JV::ARR || it.arr.size() < 5) continue;
+      idxs.push_back(sidx(it.arr[0].s));
+      if (!it.arr[2].s.empty()) idxs.push_back(sidx(it.arr[2].s));
+      if (!it.arr[3].s.empty()) {
+        idxs.push_back(sidx(it.arr[3].s));
         any_proc = true;
-    if (fence_lease && !leases_.count(fence_lease))
-      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
-    if (any_proc && proc_lease && !leases_.count(proc_lease))
-      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
+      }
+    }
+    StripeLock g(*this, std::move(idxs));
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    if (fence_lease) check_lease_locked(fence_lease);
+    if (any_proc && proc_lease) check_lease_locked(proc_lease);
     res += '[';
     bool first = true;
     for (const JV& it : items.arr) {
@@ -401,7 +555,7 @@ class Store {
       const std::string& order_key = it.arr[2].s;
       const std::string& proc_key = it.arr[3].s;
       const std::string& proc_val = it.arr[4].s;
-      if (kv_.count(fence_key)) {
+      if (stripes_[sidx(fence_key)].kv.count(fence_key)) {
         if (!order_key.empty()) delete_locked(order_key);
         res += "false";
         continue;
@@ -416,50 +570,61 @@ class Store {
 
   // Coalesced-order consume (memstore.py claim_bundle): per-job fence
   // claims + winners' proc puts, then ONE delete of the bundle order
-  // key, all under one lock — the (node, second) reservation converts
-  // to proc accounting with no leak/double-count window.  items =
-  // [[fence_key, fence_val, proc_key, proc_val], ...]; malformed items
-  // yield per-item false without aborting the bundle.
+  // key, all under the involved stripes' locks — the (node, second)
+  // reservation converts to proc accounting with no leak/double-count
+  // window.  items = [[fence_key, fence_val, proc_key, proc_val], ...];
+  // malformed items yield per-item false without aborting the bundle.
   void claim_bundle(const std::string& order_key, const JV& items,
                     long long fence_lease, long long proc_lease,
                     std::string& res) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    lazy_expire();
     bool any_proc = false;
-    for (const JV& it : items.arr)
-      if (it.t == JV::ARR && it.arr.size() >= 4 && !it.arr[2].s.empty())
-        any_proc = true;
-    if (fence_lease && !leases_.count(fence_lease))
-      throw KeyErr{"lease " + std::to_string(fence_lease) + " not found"};
-    if (any_proc && proc_lease && !leases_.count(proc_lease))
-      throw KeyErr{"lease " + std::to_string(proc_lease) + " not found"};
+    std::vector<size_t> idxs;
+    bundle_idxs(order_key, items, idxs, any_proc);
+    StripeLock g(*this, std::move(idxs));
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    if (fence_lease) check_lease_locked(fence_lease);
+    if (any_proc && proc_lease) check_lease_locked(proc_lease);
+    claim_bundle_items_locked(order_key, items, fence_lease, proc_lease,
+                              res);
+  }
+
+  // Batched claim_bundle (memstore.py claim_bundle_many): a whole
+  // backlog of due (node, second) bundles — the herd catch-up case —
+  // settled in ONE locked op.  bundles = [[order_key, items], ...];
+  // res gets one claim_bundle win array per bundle (malformed bundles
+  // yield []).  Leases are shared and validated before any mutation.
+  void claim_bundle_many(const JV& bundles, long long fence_lease,
+                         long long proc_lease, std::string& res) {
+    lazy_expire();
+    bool any_proc = false;
+    std::vector<size_t> idxs;
+    for (const JV& b : bundles.arr) {
+      if (b.t != JV::ARR || b.arr.size() < 2 || b.arr[1].t != JV::ARR)
+        continue;
+      bundle_idxs(b.arr[0].s, b.arr[1], idxs, any_proc);
+    }
+    StripeLock g(*this, std::move(idxs));
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    if (fence_lease) check_lease_locked(fence_lease);
+    if (any_proc && proc_lease) check_lease_locked(proc_lease);
     res += '[';
     bool first = true;
-    for (const JV& it : items.arr) {
+    for (const JV& b : bundles.arr) {
       if (!first) res += ',';
       first = false;
-      if (it.t != JV::ARR || it.arr.size() < 4) {
-        res += "false";
+      if (b.t != JV::ARR || b.arr.size() < 2 || b.arr[1].t != JV::ARR) {
+        res += "[]";
         continue;
       }
-      const std::string& fence_key = it.arr[0].s;
-      const std::string& fence_val = it.arr[1].s;
-      const std::string& proc_key = it.arr[2].s;
-      const std::string& proc_val = it.arr[3].s;
-      if (kv_.count(fence_key)) {
-        res += "false";
-        continue;
-      }
-      put_locked(fence_key, fence_val, fence_lease);
-      if (!proc_key.empty()) put_locked(proc_key, proc_val, proc_lease);
-      res += "true";
+      claim_bundle_items_locked(b.arr[0].s, b.arr[1], fence_lease,
+                                proc_lease, res);
     }
     res += ']';
-    if (!order_key.empty()) delete_locked(order_key);
   }
 
   long long grant(double ttl) {
-    std::lock_guard<std::mutex> g(mu);
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
     long long lid = next_lease_++;
     leases_[lid] = LeaseRec{ttl, now() + ttl, {}};
     if (wal_ && !replaying_) {
@@ -476,10 +641,11 @@ class Store {
   }
 
   bool keepalive(long long lid) {
-    std::lock_guard<std::mutex> g(mu);
-    expire_locked();
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
     auto it = leases_.find(lid);
-    if (it == leases_.end()) return false;
+    // an expired-but-unswept lease must not be revivable: its keys are
+    // already doomed
+    if (it == leases_.end() || it->second.deadline <= now()) return false;
     it->second.deadline = now() + it->second.ttl;
     if (wal_ && !replaying_) {
       std::string rec = "[\"k\",";
@@ -493,25 +659,28 @@ class Store {
   }
 
   bool revoke(long long lid) {
-    std::lock_guard<std::mutex> g(mu);
-    auto it = leases_.find(lid);
-    if (it == leases_.end()) return false;
-    std::set<std::string> keys = std::move(it->second.keys);  // already sorted
-    leases_.erase(it);
-    // lease removal logs as "x" (no key side effects); the deletions it
-    // causes log themselves — replay is then purely mechanical
-    if (wal_ && !replaying_) {
-      std::string rec = "[\"x\",";
-      jint(rec, lid);
-      rec += ']';
-      wal_->append(rec);
+    std::set<std::string> keys;
+    {
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+      auto it = leases_.find(lid);
+      if (it == leases_.end()) return false;
+      keys = std::move(it->second.keys);  // already sorted
+      leases_.erase(it);
+      // lease removal logs as "x" (no key side effects); the deletions
+      // it causes log themselves — replay is then purely mechanical
+      if (wal_ && !replaying_) {
+        std::string rec = "[\"x\",";
+        jint(rec, lid);
+        rec += ']';
+        wal_->append(rec);
+      }
     }
-    for (const auto& k : keys) delete_locked(k);
+    delete_keys(keys, lid);
     return true;
   }
 
   bool lease_ttl_remaining(long long lid, double& out) {
-    std::lock_guard<std::mutex> g(mu);
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
     auto it = leases_.find(lid);
     if (it == leases_.end()) return false;
     out = it->second.deadline - now();
@@ -519,11 +688,8 @@ class Store {
   }
 
   void sweep() {
-    {
-      std::lock_guard<std::mutex> g(mu);
-      expire_locked();
-    }
-    // fdatasync outside the store mutex: a slow disk must not stall
+    expire();
+    // fdatasync outside the store locks: a slow disk must not stall
     // every client op for the sync duration (wal_ is set once at boot;
     // Wal serializes internally)
     if (wal_) wal_->sync();
@@ -537,7 +703,9 @@ class Store {
   // etcd's compaction contract.
   bool open_wal(const std::string& path, std::string& err,
                 bool sync_per_commit = false) {
-    std::lock_guard<std::mutex> g(mu);
+    // boot-time only: no concurrent clients exist yet (the listener
+    // starts after open_wal returns), so no stripe locks are needed
+    // beyond the ones replay's mutation helpers take themselves
     replaying_ = true;
     FILE* f = fopen(path.c_str(), "r");
     if (f) {
@@ -602,19 +770,21 @@ class Store {
       rec += ']';
       emit();
     }
-    for (const auto& [key, kv] : kv_) {
-      rec += "[\"s\",";
-      jesc(rec, key);
-      rec += ',';
-      jesc(rec, kv.value);
-      rec += ',';
-      jint(rec, kv.create_rev);
-      rec += ',';
-      jint(rec, kv.mod_rev);
-      rec += ',';
-      jint(rec, kv.lease);
-      rec += ']';
-      emit();
+    for (const Stripe& st : stripes_) {
+      for (const auto& [key, kv] : st.kv) {
+        rec += "[\"s\",";
+        jesc(rec, key);
+        rec += ',';
+        jesc(rec, kv.value);
+        rec += ',';
+        jint(rec, kv.create_rev);
+        rec += ',';
+        jint(rec, kv.mod_rev);
+        rec += ',';
+        jint(rec, kv.lease);
+        rec += ']';
+        emit();
+      }
     }
     wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
     fclose(out);
@@ -637,12 +807,13 @@ class Store {
   }
 
   // watch: registers the sink and (with start_rev) replays retained
-  // events — registration AND replay delivery happen under the lock, so
-  // no concurrent mutation can be enqueued ahead of (or between) the
-  // replayed events: the client sees a strictly ordered stream.
+  // events — registration AND replay delivery happen under every stripe
+  // lock plus the event plane, so no concurrent mutation can be
+  // enqueued ahead of (or between) the replayed events: the client sees
+  // a strictly ordered stream.
   void watch(Sink sink, long long start_rev);
   void unwatch(Conn* conn, long long wid) {
-    std::lock_guard<std::mutex> g(mu);
+    std::lock_guard<std::mutex> g(sync_mu_);
     for (size_t i = 0; i < sinks_.size(); i++) {
       if (sinks_[i].conn == conn && sinks_[i].wid == wid) {
         sinks_.erase(sinks_.begin() + i);
@@ -651,7 +822,7 @@ class Store {
     }
   }
   void drop_conn(Conn* conn) {
-    std::lock_guard<std::mutex> g(mu);
+    std::lock_guard<std::mutex> g(sync_mu_);
     sinks_.erase(std::remove_if(sinks_.begin(), sinks_.end(),
                                 [conn](const Sink& s) { return s.conn == conn; }),
                  sinks_.end());
@@ -666,30 +837,100 @@ class Store {
     return std::chrono::duration<double>(std::chrono::steady_clock::now().time_since_epoch()).count();
   }
 
-  long long put_locked(const std::string& key, const std::string& value, long long lease) {
-    auto prev_it = kv_.find(key);
-    LeaseRec* nl = nullptr;
-    if (lease) {
-      auto lit = leases_.find(lease);
-      if (lit == leases_.end())  // validate BEFORE any mutation
-        throw KeyErr{"lease " + std::to_string(lease) + " not found"};
-      nl = &lit->second;
+  // merged prefix scan: per-stripe lower_bound runs, sorted globally.
+  // Caller holds every stripe lock.
+  std::vector<std::pair<const std::string*, const KVRec*>>
+  prefix_hits_locked(const std::string& prefix) {
+    std::vector<std::pair<const std::string*, const KVRec*>> hits;
+    for (Stripe& st : stripes_)
+      for (auto it = st.kv.lower_bound(prefix);
+           it != st.kv.end() && starts_with(it->first, prefix); ++it)
+        hits.emplace_back(&it->first, &it->second);
+    std::sort(hits.begin(), hits.end(),
+              [](const auto& a, const auto& b) { return *a.first < *b.first; });
+    return hits;
+  }
+
+  // collect stripe indexes a bundle touches (order key + fences + procs)
+  void bundle_idxs(const std::string& order_key, const JV& items,
+                   std::vector<size_t>& idxs, bool& any_proc) {
+    if (!order_key.empty()) idxs.push_back(sidx(order_key));
+    for (const JV& it : items.arr) {
+      if (it.t != JV::ARR || it.arr.size() < 4) continue;
+      idxs.push_back(sidx(it.arr[0].s));
+      if (!it.arr[2].s.empty()) {
+        idxs.push_back(sidx(it.arr[2].s));
+        any_proc = true;
+      }
     }
+  }
+
+  // claim_bundle's item loop; caller holds the involved stripe locks
+  // AND lease_mu_ (leases already validated).  Appends one win array.
+  void claim_bundle_items_locked(const std::string& order_key,
+                                 const JV& items, long long fence_lease,
+                                 long long proc_lease, std::string& res) {
+    res += '[';
+    bool first = true;
+    for (const JV& it : items.arr) {
+      if (!first) res += ',';
+      first = false;
+      if (it.t != JV::ARR || it.arr.size() < 4) {
+        res += "false";
+        continue;
+      }
+      const std::string& fence_key = it.arr[0].s;
+      const std::string& fence_val = it.arr[1].s;
+      const std::string& proc_key = it.arr[2].s;
+      const std::string& proc_val = it.arr[3].s;
+      if (stripes_[sidx(fence_key)].kv.count(fence_key)) {
+        res += "false";
+        continue;
+      }
+      put_locked(fence_key, fence_val, fence_lease);
+      if (!proc_key.empty()) put_locked(proc_key, proc_val, proc_lease);
+      res += "true";
+    }
+    res += ']';
+    if (!order_key.empty()) delete_locked(order_key);
+  }
+
+  // caller holds the key's stripe lock
+  long long put_locked(const std::string& key, const std::string& value, long long lease) {
+    auto& kvmap = stripes_[sidx(key)].kv;
+    auto prev_it = kvmap.find(key);
     Ev ev;
     ev.key = key;
-    if (prev_it != kv_.end()) {
+    if (prev_it != kvmap.end()) {
       ev.has_prev = true;
       ev.prev = prev_it->second;
-      if (ev.prev.lease && ev.prev.lease != lease) {
+    }
+    if (lease || (ev.has_prev && ev.prev.lease)) {
+      // only lease-touching puts pay the shared lease mutex — an
+      // unleased put over an unleased key must not serialize behind a
+      // claim batch holding it
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+      LeaseRec* nl = nullptr;
+      if (lease) {
+        auto lit = leases_.find(lease);
+        if (lit == leases_.end())  // validate BEFORE any mutation
+          throw KeyErr{"lease " + std::to_string(lease) + " not found"};
+        nl = &lit->second;
+      }
+      if (ev.has_prev && ev.prev.lease && ev.prev.lease != lease) {
         // a put re-binds the key's lease attachment
         auto old = leases_.find(ev.prev.lease);
         if (old != leases_.end()) old->second.keys.erase(key);
       }
+      if (nl) nl->keys.insert(key);
     }
-    if (nl) nl->keys.insert(key);
+    // event plane: revision assignment, WAL append, history and sink
+    // fan-out ride one small lock so streams (and the WAL) stay
+    // revision-ordered across stripes
+    std::lock_guard<std::mutex> sg(sync_mu_);
     rev_++;
     KVRec rec{value, ev.has_prev ? ev.prev.create_rev : rev_, rev_, lease};
-    kv_[key] = rec;
+    kvmap[key] = rec;
     ev.kv = rec;
     if (wal_ && !replaying_) {
       std::string w = "[\"p\",";
@@ -705,19 +946,23 @@ class Store {
     return rev_;
   }
 
+  // caller holds the key's stripe lock
   bool delete_locked(const std::string& key) {
-    auto it = kv_.find(key);
-    if (it == kv_.end()) return false;
+    auto& kvmap = stripes_[sidx(key)].kv;
+    auto it = kvmap.find(key);
+    if (it == kvmap.end()) return false;
     Ev ev;
     ev.key = key;
     ev.is_delete = true;
     ev.has_prev = true;
     ev.prev = it->second;
     if (ev.prev.lease) {
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
       auto lit = leases_.find(ev.prev.lease);
       if (lit != leases_.end()) lit->second.keys.erase(key);
     }
-    kv_.erase(it);
+    kvmap.erase(it);
+    std::lock_guard<std::mutex> sg(sync_mu_);
     rev_++;
     ev.kv = KVRec{"", ev.prev.create_rev, rev_, 0};  // tombstone
     if (wal_ && !replaying_) {
@@ -730,21 +975,50 @@ class Store {
     return true;
   }
 
-  void expire_locked() {
-    double t = now();
-    std::vector<long long> dead;
-    for (auto& [lid, l] : leases_)
-      if (l.deadline <= t) dead.push_back(lid);
-    for (long long lid : dead) {
-      std::set<std::string> keys = std::move(leases_[lid].keys);
-      leases_.erase(lid);
-      if (wal_ && !replaying_) {
-        std::string rec = "[\"x\",";
-        jint(rec, lid);
-        rec += ']';
-        wal_->append(rec);
+  // lease expiry: doomed leases pop under the lease lock alone; their
+  // keys then die through the normal striped delete path (lock order:
+  // stripes before lease — so the collection must not hold stripes)
+  void expire() {
+    std::vector<std::pair<long long, std::set<std::string>>> doomed;
+    {
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+      if (leases_.empty()) return;
+      double t = now();
+      std::vector<long long> dead;
+      for (auto& [lid, l] : leases_)
+        if (l.deadline <= t) dead.push_back(lid);
+      for (long long lid : dead) {
+        doomed.emplace_back(lid, std::move(leases_[lid].keys));
+        leases_.erase(lid);
+        if (wal_ && !replaying_) {
+          std::string rec = "[\"x\",";
+          jint(rec, lid);
+          rec += ']';
+          wal_->append(rec);
+        }
       }
-      for (const auto& k : keys) delete_locked(k);
+    }
+    for (const auto& [lid, keys] : doomed) delete_keys(keys, lid);
+  }
+
+  // ``only_lease`` guards the expiry/revoke window: between popping a
+  // lease and reaching here, a writer can have re-created or re-bound
+  // one of its keys under a NEW lease — that key belongs to the new
+  // owner and must survive (the old single store mutex made this
+  // interleaving impossible; the check restores its semantics).
+  void delete_keys(const std::set<std::string>& keys,
+                   long long only_lease = 0) {
+    if (keys.empty()) return;
+    std::vector<size_t> idxs;
+    for (const auto& k : keys) idxs.push_back(sidx(k));
+    StripeLock g(*this, std::move(idxs));
+    for (const auto& k : keys) {
+      if (only_lease) {
+        auto& kv = stripes_[sidx(k)].kv;
+        auto it = kv.find(k);
+        if (it == kv.end() || it->second.lease != only_lease) continue;
+      }
+      delete_locked(k);
     }
   }
 
@@ -773,8 +1047,10 @@ class Store {
       // a put whose lease already expired+vanished during downtime would
       // throw; recreate-then-expire is indistinguishable, so drop it
       if (inum(3) && !leases_.count(inum(3))) return true;
+      StripeLock g(*this, {sidx(s(1))});
       put_locked(s(1), s(2), inum(3));
     } else if (op == "d") {
+      StripeLock g(*this, {sidx(s(1))});
       delete_locked(s(1));
     } else if (op == "g") {
       long long lid = inum(1);
@@ -793,8 +1069,9 @@ class Store {
       auto it = leases_.find(inum(1));
       if (it != leases_.end()) {
         std::set<std::string> keys = std::move(it->second.keys);
+        long long lid = inum(1);
         leases_.erase(it);
-        for (const auto& k : keys) delete_locked(k);
+        delete_keys(keys, lid);
       }
     } else if (op == "v") {
       rev_ = inum(1);
@@ -802,7 +1079,7 @@ class Store {
     } else if (op == "s") {
       if (v.arr.size() < 6) return false;
       KVRec rec{s(2), inum(3), inum(4), inum(5)};
-      kv_[s(1)] = rec;
+      stripes_[sidx(s(1))].kv[s(1)] = rec;
       if (rec.lease) {
         auto it = leases_.find(rec.lease);
         if (it != leases_.end()) it->second.keys.insert(s(1));
@@ -813,8 +1090,17 @@ class Store {
     return true;
   }
 
-  std::map<std::string, KVRec> kv_;
+  const size_t nstripes_;
+  // vector sized once at construction (Stripe holds a mutex: never
+  // resized, only constructed in place)
+  std::vector<Stripe> stripes_;
+  // event plane: revision counter + history ring + sink registry/fan-out
+  // (+ WAL append ordering) — held per mutation, after the stripes
+  std::mutex sync_mu_;
   long long rev_ = 0;
+  // lease table; recursive so claim ops can hold it across their item
+  // loop while the inner put/delete re-takes it for attachment
+  std::recursive_mutex lease_mu_;
   std::unordered_map<long long, LeaseRec> leases_;
   long long next_lease_ = 1;
   std::vector<Sink> sinks_;
@@ -823,6 +1109,7 @@ class Store {
   Wal wal_storage_;
   Wal* wal_ = nullptr;
   bool replaying_ = false;
+  std::atomic<bool> has_sweeper_{false};
 };
 
 // ---------------------------------------------------------------------------
@@ -839,8 +1126,17 @@ struct Conn : std::enable_shared_from_this<Conn> {
   Store* store;
   std::mutex omu;
   std::condition_variable ocv;
-  // (payload, is_reply): one writer thread drains both kinds in FIFO.
-  std::deque<std::pair<std::string, bool>> outbox;
+  // One writer thread drains replies and watch pushes in FIFO.  A reply
+  // (wid < 0) is a complete wire line; a watch push (wid >= 0) is a bare
+  // event body — the writer groups CONSECUTIVE same-watch pushes into
+  // one {"w": wid, "evs": [...]} frame per send, so a dispatch burst of
+  // K events costs a handful of frames instead of K serialized lines.
+  struct OutMsg {
+    std::string payload;
+    long long wid = -1;    // >= 0: watch-event body to batch
+    bool is_reply = false;
+  };
+  std::deque<OutMsg> outbox;
   size_t push_bytes = 0;    // queued watch-push bytes
   size_t reply_bytes = 0;   // queued rpc-reply bytes
   bool dead = false;
@@ -871,16 +1167,17 @@ struct Conn : std::enable_shared_from_this<Conn> {
     if (fd >= 0) ::close(fd);
   }
 
-  void enqueue(std::string msg) {
+  // watch push: `body` is the bare event wire form (ev_wire output)
+  void enqueue_event(long long wid, std::string body) {
     std::lock_guard<std::mutex> g(omu);
     if (dead) return;
-    if (push_bytes + msg.size() > kMaxPushBytes) {
+    if (push_bytes + body.size() > kMaxPushBytes) {
       dead = true;  // writer notices and closes
       ocv.notify_all();
       return;
     }
-    push_bytes += msg.size();
-    outbox.emplace_back(std::move(msg), false);
+    push_bytes += body.size();
+    outbox.push_back(OutMsg{std::move(body), wid, false});
     ocv.notify_all();
   }
 
@@ -899,36 +1196,61 @@ struct Conn : std::enable_shared_from_this<Conn> {
     });
     if (dead) return;
     reply_bytes += msg.size();
-    outbox.emplace_back(std::move(msg), true);
+    outbox.push_back(OutMsg{std::move(msg), -1, true});
     ocv.notify_all();
   }
 
   void writer() {
     while (true) {
-      std::string msg;
+      std::string wire;
+      long long frames = 0, events = 0;
       {
         std::unique_lock<std::mutex> g(omu);
         ocv.wait(g, [this] { return dead || !outbox.empty(); });
-        if (dead && outbox.empty()) break;
         if (dead) break;  // dropped for overflow: don't flush
-        auto take = [&] {
-          auto& [m, is_reply] = outbox.front();
-          (is_reply ? reply_bytes : push_bytes) -= m.size();
-          return std::move(m);
-        };
-        msg = take();
-        outbox.pop_front();
         // coalesce queued messages into one send: an expiry burst of
-        // 100k+ tiny DELETE pushes must not cost 100k+ syscalls
-        while (!outbox.empty() && msg.size() < (256u << 10)) {
-          msg += take();
+        // 100k+ tiny DELETE pushes must not cost 100k+ syscalls —
+        // and consecutive same-watch event pushes merge into ONE
+        // {"w", "evs"} frame
+        long long open_wid = -1;
+        auto close_group = [&] {
+          if (open_wid >= 0) {
+            wire += "]}\n";
+            open_wid = -1;
+          }
+        };
+        while (!outbox.empty() && wire.size() < (256u << 10)) {
+          OutMsg& m = outbox.front();
+          (m.is_reply ? reply_bytes : push_bytes) -= m.payload.size();
+          if (m.wid < 0) {
+            close_group();
+            wire += m.payload;
+          } else {
+            if (open_wid != m.wid) {
+              close_group();
+              wire += "{\"w\":";
+              jint(wire, m.wid);
+              wire += ",\"evs\":[";
+              open_wid = m.wid;
+              frames++;
+            } else {
+              wire += ',';
+            }
+            wire += m.payload;
+            events++;
+          }
           outbox.pop_front();
         }
+        close_group();
         ocv.notify_all();   // blocked enqueue_reply callers re-check
       }
+      if (frames) {
+        op_count("watch_frames", frames);
+        op_count("watch_events", events);
+      }
       size_t off = 0;
-      while (off < msg.size()) {
-        ssize_t n = ::send(fd, msg.data() + off, msg.size() - off,
+      while (off < wire.size()) {
+        ssize_t n = ::send(fd, wire.data() + off, wire.size() - off,
                            MSG_NOSIGNAL);
         if (n <= 0) {
           std::lock_guard<std::mutex> g(omu);
@@ -955,21 +1277,19 @@ struct Conn : std::enable_shared_from_this<Conn> {
   }
 };
 
+// caller holds sync_mu_ (the event plane): fan-out order is revision
+// order.  Sinks get the bare event body; the connection writer batches
+// consecutive same-watch bodies into one {"w", "evs"} frame.
 void Store::notify_locked(Ev ev) {
   long long t0 = mono_ns();
-  // shared event body; per-sink envelope
+  // shared event body; per-sink envelope added by the writer
   std::string body;
   ev_wire(body, ev);
   for (const Sink& s : sinks_) {
     if (s.delete_only && !ev.is_delete) continue;
     if (ev.key.size() >= s.prefix.size() &&
         memcmp(ev.key.data(), s.prefix.data(), s.prefix.size()) == 0) {
-      std::string msg = "{\"w\":";
-      jint(msg, s.wid);
-      msg += ",\"ev\":";
-      msg += body;
-      msg += "}\n";
-      s.conn->enqueue(std::move(msg));
+      s.conn->enqueue_event(s.wid, body);
     }
   }
   history_.push_back(std::move(ev));
@@ -978,7 +1298,10 @@ void Store::notify_locked(Ev ev) {
 }
 
 void Store::watch(Sink sink, long long start_rev) {
-  std::lock_guard<std::mutex> g(mu);
+  // every stripe + the event plane: no mutation can land between the
+  // replayed history and the live stream
+  StripeLock g(*this, all_idxs());
+  std::lock_guard<std::mutex> sg(sync_mu_);
   if (start_rev && start_rev <= rev_) {
     // every revision 1..rev emitted exactly one event, so the replay is
     // complete iff the ring still holds start_rev
@@ -990,12 +1313,9 @@ void Store::watch(Sink sink, long long start_rev) {
       if (sink.delete_only && !ev.is_delete) continue;
       if (ev.kv.mod_rev >= start_rev && ev.key.size() >= sink.prefix.size() &&
           memcmp(ev.key.data(), sink.prefix.data(), sink.prefix.size()) == 0) {
-        std::string msg = "{\"w\":";
-        jint(msg, sink.wid);
-        msg += ",\"ev\":";
-        ev_wire(msg, ev);
-        msg += "}\n";
-        sink.conn->enqueue(std::move(msg));
+        std::string body;
+        ev_wire(body, ev);
+        sink.conn->enqueue_event(sink.wid, std::move(body));
       }
     }
   }
@@ -1096,6 +1416,12 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
       const JV& items = (args.arr.size() > 1 && args.arr[1].t == JV::ARR) ? args.arr[1] : empty;
       c->store->claim_bundle(arg_s(args, 0), items, arg_i(args, 2),
                              arg_i(args, 3), res);
+    } else if (op == "claim_bundle_many") {
+      JV empty;
+      empty.t = JV::ARR;
+      const JV& bundles = (!args.arr.empty() && args.arr[0].t == JV::ARR) ? args.arr[0] : empty;
+      c->store->claim_bundle_many(bundles, arg_i(args, 1), arg_i(args, 2),
+                                  res);
     } else if (op == "op_stats") {
       op_stats_json(res);
     } else if (op == "put_if_absent") {
@@ -1183,6 +1509,7 @@ int main(int argc, char** argv) {
   bool fsync_per_commit = false;
   int port = 7070;
   size_t history = 65536;
+  size_t stripes = Store::kDefaultStripes;
   double sweep_s = 0.2;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -1190,6 +1517,7 @@ int main(int argc, char** argv) {
     if (a == "--host") host = next();
     else if (a == "--port") port = atoi(next());
     else if (a == "--history") history = (size_t)atoll(next());
+    else if (a == "--stripes") stripes = (size_t)atoll(next());
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--wal") wal_path = next();
     else if (a == "--fsync-per-commit") fsync_per_commit = true;
@@ -1219,7 +1547,7 @@ int main(int argc, char** argv) {
     }
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
-             "[--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
+             "[--stripes N] [--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
              "[--token T | --token-file F] [--die-with-parent]\n");
       return 0;
     }
@@ -1244,7 +1572,7 @@ int main(int argc, char** argv) {
     perror("listen");
     return 1;
   }
-  static Store store(history);
+  static Store store(history, stripes);
   if (!wal_path.empty()) {
     std::string err;
     if (!store.open_wal(wal_path, err, fsync_per_commit)) {
@@ -1256,6 +1584,7 @@ int main(int argc, char** argv) {
   getsockname(lfd, (sockaddr*)&addr, &alen);  // resolve port 0
   printf("READY %s:%d\n", host.c_str(), (int)ntohs(addr.sin_port));
   fflush(stdout);
+  store.set_has_sweeper();   // write paths leave lease expiry to it
   std::thread([&] {
     while (true) {
       std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));

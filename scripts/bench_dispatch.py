@@ -56,6 +56,9 @@ def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
     # this bench sweeps
     agent = NodeAgent(store, sink, node_id=node_id,
                       executor=InstantExecutor(), proc_req=5.0)
+    # publish metrics snapshots fast enough for short sweeps to read
+    # per-agent consumed counts (the fairness signal) and exec lag
+    agent.metrics.interval_s = 2.0
     agent.start()
     print("READY", flush=True)
     try:
@@ -246,12 +249,17 @@ def run_bench(rates, n_agents, seconds, on_log=print):
         # (seconds of queueing), not the healthy-load figure; the
         # healthy-load bound lives in the scale soak's assertion
         # (tests/test_soak.py: p99 within window_s + publish slack).
-        lag_p50, lag_p99 = [], []
+        # Per-agent orders_consumed doubles as the FAIRNESS signal: a
+        # plane that scales only because one agent hogs the drain shows
+        # a min/max ratio far below 1.
+        lag_p50, lag_p99, consumed_per_agent = [], [], []
         for kv in store.get_prefix(ks.metrics + "node/"):
             m = json.loads(kv.value)
             if "exec_start_lag_p99_s" in m:
                 lag_p50.append(m["exec_start_lag_p50_s"])
                 lag_p99.append(m["exec_start_lag_p99_s"])
+            if "orders_consumed_total" in m:
+                consumed_per_agent.append(m["orders_consumed_total"])
         results.update({
             "dispatch_plane_sweep": per_rate,
             "dispatch_plane_orders_per_sec": round(sustained, 1),
@@ -260,10 +268,23 @@ def run_bench(rates, n_agents, seconds, on_log=print):
             "dispatch_plane_order_format":
                 "legacy" if legacy_orders else "coalesced",
         })
+        if consumed_per_agent and max(consumed_per_agent) > 0:
+            results["dispatch_plane_fairness_min_over_max"] = round(
+                min(consumed_per_agent) / max(consumed_per_agent), 3)
         # per-op server-side timing (claim_bundle/claim_many/put_many/
-        # watch fan-out): names the component that owns the ceiling
+        # watch fan-out): names the component that owns the ceiling —
+        # plus the striped store's contention ticks and the watch-wire
+        # frames/event ratio (the batching win: << 1 under burst)
         try:
-            results["dispatch_plane_store_op_stats"] = store.op_stats()
+            op_stats = store.op_stats()
+            results["dispatch_plane_store_op_stats"] = op_stats
+            frames = op_stats.get("watch_frames", {}).get("count", 0)
+            events = op_stats.get("watch_events", {}).get("count", 0)
+            if events:
+                results["dispatch_plane_watch_frames_per_event"] = round(
+                    frames / events, 4)
+            results["dispatch_plane_store_stripe_contention"] = \
+                op_stats.get("stripe_contention", {}).get("count", 0)
         except Exception as e:  # noqa: BLE001 — older server
             on_log(f"op_stats unavailable: {e}")
         if lag_p99:
@@ -286,6 +307,30 @@ def run_bench(rates, n_agents, seconds, on_log=print):
     return results
 
 
+def run_quick(seconds=3, rate=24000, on_log=print):
+    """The agent-scaling smoke: one offered rate past a single agent's
+    drain ceiling, swept at 1 then 2 agents.  Returns the two aggregate
+    drain rates and their ratio — the r05 negative-scaling regression
+    gate (2 agents must drain >= 1.5x of 1) without the cost of the full
+    sweep.  Meaningful only with >= 4 host cores (agents + store +
+    driver each need one)."""
+    r1 = run_bench([rate], 1, seconds, on_log=on_log)
+    r2 = run_bench([rate], 2, seconds, on_log=on_log)
+    agg1 = r1["dispatch_plane_orders_per_sec"]
+    agg2 = r2["dispatch_plane_orders_per_sec"]
+    return {
+        "quick_rate_offered_per_s": rate,
+        "agg_1_agent_per_s": agg1,
+        "agg_2_agents_per_s": agg2,
+        "scaling_2_over_1": round(agg2 / max(1.0, agg1), 3),
+        "fairness_min_over_max_2_agents":
+            r2.get("dispatch_plane_fairness_min_over_max"),
+        "watch_frames_per_event":
+            r2.get("dispatch_plane_watch_frames_per_event"),
+        "backend": r2["dispatch_plane_backend"],
+    }
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         return worker_main(sys.argv[2], sys.argv[3], sys.argv[4])
@@ -299,9 +344,14 @@ def main():
                     help="0 = auto: one per core beyond the shared "
                          "store/driver core, at least 1, at most 4")
     ap.add_argument("--agent-sweep", default="",
-                    help="comma list of agent counts; runs the full rate "
-                         "sweep once per count and reports the scaling "
-                         "curve (VERDICT r3 #1/#6)")
+                    help="comma list of agent counts (e.g. 1,2,4,8); "
+                         "runs the full rate sweep once per count and "
+                         "reports the scaling curve — aggregate drain, "
+                         "per-agent drain, fairness (VERDICT r3 #1/#6)")
+    ap.add_argument("--quick", action="store_true",
+                    help="negative-scaling smoke: one past-saturation "
+                         "rate at 1 then 2 agents; prints the 2-over-1 "
+                         "aggregate ratio (the r05 regression gate)")
     ap.add_argument("--seconds", type=int, default=4)
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -309,7 +359,9 @@ def main():
         args.agents = max(1, min(4, (os.cpu_count() or 1) - 1))
     rates = [int(r) for r in args.rates.split(",")]
     on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
-    if args.agent_sweep:
+    if args.quick:
+        res = run_quick(seconds=min(args.seconds, 3), on_log=on_log)
+    elif args.agent_sweep:
         counts = [int(c) for c in args.agent_sweep.split(",")]
         curve = []
         res = None
@@ -323,7 +375,13 @@ def main():
                 "drain_per_agent_per_sec":
                     r["dispatch_plane_drain_per_agent_per_sec"],
                 "saturation_offered_per_sec":
-                    r["dispatch_plane_saturation_offered_per_sec"]})
+                    r["dispatch_plane_saturation_offered_per_sec"],
+                "fairness_min_over_max":
+                    r.get("dispatch_plane_fairness_min_over_max"),
+                "watch_frames_per_event":
+                    r.get("dispatch_plane_watch_frames_per_event"),
+                "stripe_contention":
+                    r.get("dispatch_plane_store_stripe_contention")})
             if res is None:
                 res = r           # single-agent fields stay top-level
         res["dispatch_plane_agent_curve"] = curve

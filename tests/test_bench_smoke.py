@@ -1,0 +1,39 @@
+"""Dispatch-plane scaling regression gate (slow tier).
+
+BENCH_r05 found NEGATIVE agent scaling: 2 agents drained 6.2k orders/s
+aggregate vs 7.0k/s for one — the plane's store serialized everything
+behind one lock and one-wire-frame-per-event delivery.  This smoke runs
+``scripts/bench_dispatch.py --quick`` (one past-saturation rate, 1 then
+2 agents) and asserts the striped/batched plane scales: 2-agent
+aggregate drain >= 1.5x 1-agent.
+
+Marked slow (two short benches + real agent subprocesses); the tier-1
+run excludes it.  Needs >= 6 host cores to be meaningful (2 agents +
+store + logd + driver), and skips below that.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+
+@pytest.mark.slow
+def test_two_agents_scale_aggregate_drain():
+    if (os.cpu_count() or 1) < 6:
+        pytest.skip("needs >= 6 cores for a meaningful scaling signal")
+    import bench_dispatch
+    res = bench_dispatch.run_quick(
+        seconds=3, on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res["agg_1_agent_per_s"] > 0
+    assert res["scaling_2_over_1"] >= 1.5, (
+        f"negative/flat agent scaling regressed: 2 agents drained "
+        f"{res['agg_2_agents_per_s']}/s vs {res['agg_1_agent_per_s']}/s "
+        f"for one (ratio {res['scaling_2_over_1']})")
+    # the batched watch wire must be active under the burst
+    fpe = res.get("watch_frames_per_event")
+    assert fpe is None or fpe < 1.0, f"watch batching inactive: {fpe}"

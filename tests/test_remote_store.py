@@ -608,6 +608,49 @@ def test_claim_bundle_semantics(remote):
     assert s.get("/d/n1/201") is None
 
 
+def test_claim_bundle_many_semantics(remote):
+    """store.claim_bundle_many: a backlog of coalesced bundles consumed
+    in ONE atomic op — per-bundle win lists identical to claim_bundle,
+    shared leases validated before any mutation, every reservation key
+    deleted exactly once.  Both backends must agree bit-for-bit (the
+    herd catch-up hot path)."""
+    _, s, s2 = remote
+    fl = s.grant(30.0)
+    pl = s.grant(30.0)
+    s.put("/dm/n1/300", '["g/a","g/b"]')
+    s.put("/dm/n1/301", '["g/c"]')
+    s.put("/dm/n1/302", '["g/d"]')
+    # pre-take one fence: that member loses in the batch too
+    assert s2.put_if_absent("/lkm/b/300", "other") is True
+    wins = s.claim_bundle_many([
+        ("/dm/n1/300", [("/lkm/a/300", "n1@1-1", "/prm/a/300", '{"t":1}'),
+                        ("/lkm/b/300", "n1@1-2", "/prm/b/300", '{"t":2}')]),
+        ("/dm/n1/301", [("/lkm/c/301", "n1@1-3", "", "")]),
+        ("/dm/n1/302", [("bad",)]),         # malformed item: per-item False
+    ], fl, pl)
+    assert wins == [[True, False], [True], [False]]
+    assert s.get("/lkm/a/300").value == "n1@1-1"
+    assert s.get("/prm/a/300").value == '{"t":1}'
+    assert s.get("/lkm/b/300").value == "other"
+    assert s.get("/prm/b/300") is None
+    assert s.get("/lkm/c/301").value == "n1@1-3"
+    # every reservation key consumed, including the all-malformed bundle
+    for k in ("/dm/n1/300", "/dm/n1/301", "/dm/n1/302"):
+        assert s.get(k) is None, k
+    # an invalid lease raises with NO half-applied batch
+    s.put("/dm/n1/303", '["g/e"]')
+    with pytest.raises(KeyError):
+        s.claim_bundle_many(
+            [("/dm/n1/303", [("/lkm/e/303", "n1", "/prm/e", "{}")])],
+            fl, 999999)
+    assert s.get("/lkm/e/303") is None
+    assert s.get("/dm/n1/303") is not None
+    # empty batch is a no-op; empty items still release the reservation
+    assert s.claim_bundle_many([], fl, pl) == []
+    assert s.claim_bundle_many([("/dm/n1/303", [])], fl, pl) == [[]]
+    assert s.get("/dm/n1/303") is None
+
+
 def test_op_stats_counts_hot_ops(remote):
     """Per-op server-side timing (claim paths, bulk writes, watch
     fan-out) is queryable over the wire on both backends — the bench

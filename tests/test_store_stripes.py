@@ -1,0 +1,277 @@
+"""Striped-store concurrency contract.
+
+The MemStore shards its keyspace across hash-striped lock domains; these
+tests pin the invariants striping must NOT break:
+
+- the global revision counter stays strictly monotonic and gap-free
+  (every mutation = exactly one revision = exactly one watch event);
+- watch streams deliver every event, in order — per key AND globally in
+  revision order (the event plane serializes fan-out);
+- cross-stripe atomic ops (claim_bundle / claim_bundle_many / txns)
+  settle every fence exactly once under writer contention;
+- the Python and native backends agree bit-for-bit on the cross-stripe
+  claim paths (differential, shared wire).
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from cronsun_tpu.store.memstore import DELETE, MemStore, PUT
+from cronsun_tpu.store.native import NativeStoreServer, find_binary
+from cronsun_tpu.store.remote import RemoteStore, StoreServer
+
+
+def test_multiwriter_contention_fuzz():
+    """N writer threads hammer put/txn/claim_bundle/put_many over a
+    shared key universe while one watcher observes everything.  The
+    stream must reconstruct the exact final state with gap-free,
+    monotonic revisions and per-key prev-kv chains intact."""
+    store = MemStore(stripes=8)
+    w = store.watch("/f/")
+    n_threads, ops = 8, 250
+    errors = []
+    win_counts = [[0] * ops for _ in range(n_threads)]
+
+    def worker(tid):
+        rng = random.Random(1000 + tid)
+        try:
+            for i in range(ops):
+                op = rng.randrange(6)
+                key = f"/f/k{rng.randrange(32)}"
+                if op == 0:
+                    store.put(key, f"{tid}-{i}")
+                elif op == 1:
+                    store.delete(key)
+                elif op == 2:
+                    store.put_if_absent(key, f"{tid}-{i}")
+                elif op == 3:
+                    kv = store.get(key)
+                    store.put_if_mod_rev(key, f"cas-{tid}-{i}",
+                                         kv.mod_rev if kv else 0)
+                elif op == 4:
+                    # every thread races on the SAME fence for round i:
+                    # exactly one claim_bundle may win it
+                    order = f"/f/ord-{tid}-{i}"
+                    store.put(order, "o")
+                    wins = store.claim_bundle(
+                        order, [(f"/f/fence-{i}", f"n{tid}", "", "")])
+                    win_counts[tid][i] = 1 if wins[0] else 0
+                else:
+                    store.put_many([(f"/f/m{rng.randrange(32)}", "v"),
+                                    (key, f"pm-{tid}-{i}")])
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    evs = w.drain()
+    assert evs, "watcher saw nothing"
+    # revision stream: strictly monotonic, gap-free (all mutations were
+    # under the watched prefix, so every revision is exactly one event)
+    revs = [e.kv.mod_rev for e in evs]
+    assert revs == sorted(revs), "stream not revision-ordered"
+    assert len(set(revs)) == len(revs), "duplicate revisions"
+    assert revs == list(range(revs[0], revs[0] + len(revs))), \
+        "revision gaps: some mutation lost its event"
+    # per-key prev-kv chains: each event's prev matches the key's last
+    # observed state — no lost or reordered per-key events
+    state = {}
+    for e in evs:
+        key = e.kv.key
+        prev = state.get(key)
+        if prev is None or prev.type == DELETE:
+            if e.type == PUT:
+                assert e.prev_kv is None, f"{key}: stale prev on create"
+        else:
+            assert e.prev_kv is not None, f"{key}: dropped prev"
+            assert e.prev_kv.mod_rev == prev.kv.mod_rev, \
+                f"{key}: prev-kv chain broken (lost/reordered event)"
+        state[key] = e
+    # replaying the stream reproduces the store's final contents
+    replayed = {k: e.kv for k, e in state.items() if e.type == PUT}
+    final = {kv.key: kv for kv in store.get_prefix("/f/")}
+    assert replayed == final, "event stream diverged from final state"
+    # each contended fence was claimed exactly once across all threads
+    for i in range(ops):
+        wins = sum(win_counts[t][i] for t in range(n_threads))
+        if any(win_counts[t][i] is not None for t in range(n_threads)):
+            assert wins <= 1, f"fence-{i} claimed {wins} times"
+    # claim_bundle consumed every order key it was handed
+    assert not [kv for kv in store.get_prefix("/f/ord-")], \
+        "unconsumed bundle order keys"
+    store.close()
+
+
+def test_concurrent_claim_bundle_many_exclusive():
+    """Several threads race claim_bundle_many over overlapping fence
+    sets that span every stripe: each fence has exactly one winner and
+    every reservation key is consumed."""
+    store = MemStore(stripes=16)
+    rounds, n_threads = 40, 6
+    for t in range(n_threads):
+        store.put_many([(f"/d/n{t}/{i}", "o") for i in range(rounds)])
+    results = {}
+
+    def worker(tid):
+        out = []
+        for i in range(rounds):
+            wins = store.claim_bundle_many(
+                [(f"/d/n{tid}/{i}",
+                  [(f"/lk/a/{i}", f"n{tid}", f"/pr/n{tid}/a/{i}", "{}"),
+                   (f"/lk/b/{i}", f"n{tid}", "", "")])])
+            out.append(wins[0])
+        results[tid] = out
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(rounds):
+        for fence_idx, fence in enumerate(("a", "b")):
+            winners = [t for t in range(n_threads)
+                       if results[t][i][fence_idx]]
+            assert len(winners) == 1, \
+                f"/lk/{fence}/{i} won by {winners}"
+            kv = store.get(f"/lk/{fence}/{i}")
+            assert kv is not None and kv.value == f"n{winners[0]}"
+    # winners' proc keys exist, losers' don't
+    for i in range(rounds):
+        owner = int(store.get(f"/lk/a/{i}").value[1:])
+        for t in range(n_threads):
+            present = store.get(f"/pr/n{t}/a/{i}") is not None
+            assert present == (t == owner)
+    # every reservation key consumed exactly once
+    assert store.count_prefix("/d/") == 0
+    store.close()
+
+
+def _script_bundle_ops(s, tag):
+    """A deterministic cross-stripe claim script; returns all results."""
+    out = []
+    fl = s.grant(300.0)
+    pl = s.grant(300.0)
+    s.put_many([(f"/{tag}/d/n1/{i}", "o") for i in range(6)])
+    # pre-held fence: claim must lose on it in both backends
+    s.put_if_absent(f"/{tag}/lk/j3/0", "other")
+    out.append(s.claim_bundle(
+        f"/{tag}/d/n1/0",
+        [(f"/{tag}/lk/j{j}/0", "n1", f"/{tag}/pr/j{j}/0" if j % 2 else "",
+          '{"t":1}') for j in range(5)], fl, pl))
+    out.append(s.claim_bundle_many(
+        [(f"/{tag}/d/n1/{i}",
+          [(f"/{tag}/lk/j{j}/{i}", "n1", "", "") for j in range(4)])
+         for i in range(1, 6)], fl, pl))
+    # duplicate delivery re-claims and loses everywhere
+    s.put(f"/{tag}/d/n1/1", "o")
+    out.append(s.claim_bundle_many(
+        [(f"/{tag}/d/n1/1",
+          [(f"/{tag}/lk/j{j}/1", "n2", "", "") for j in range(4)])],
+        fl, pl))
+    out.append([(kv.key, kv.value, kv.create_rev > 0)
+                for kv in s.get_prefix(f"/{tag}/")])
+    return out
+
+
+def test_py_native_claim_bundle_parity():
+    """Differential: the same cross-stripe claim_bundle /
+    claim_bundle_many script against the Python server and the native
+    stored must produce identical wins and identical keyspaces."""
+    binary = find_binary()
+    if binary is None:
+        pytest.skip("native store binary unavailable")
+    py = StoreServer(MemStore()).start()
+    nt = NativeStoreServer(binary=binary)
+    a = RemoteStore(py.host, py.port, reconnect=False)
+    b = RemoteStore(nt.host, nt.port, reconnect=False)
+    try:
+        ra = _script_bundle_ops(a, "p")
+        rb = _script_bundle_ops(b, "p")
+        assert ra[:-1] == rb[:-1], "claim results diverged"
+        # keyspace contents equal modulo exact revision numbers
+        ka = [(k, v) for k, v, _c in ra[-1]]
+        kb = [(k, v) for k, v, _c in rb[-1]]
+        assert ka == kb, "final keyspaces diverged"
+    finally:
+        a.close()
+        b.close()
+        py.stop()
+        nt.stop()
+
+
+def test_expiry_delete_skips_rebound_keys():
+    """The expiry/revoke window: between popping a doomed lease and the
+    striped delete pass, a writer can re-bind one of its keys under a
+    NEW lease — the delete pass must skip it (the key belongs to the
+    new owner now; the old global lock made this interleaving
+    impossible)."""
+    store = MemStore()
+    l1 = store.grant(30)
+    l2 = store.grant(30)
+    store.put("/r/gone", "old", lease=l1)
+    store.put("/r/rebound", "old", lease=l1)
+    # simulate the window deterministically: lease popped, then the key
+    # re-bound before the doomed-key pass runs
+    with store._lease_lock:
+        doomed = store._leases.pop(l1)
+    store.put("/r/rebound", "new", lease=l2)
+    store._delete_keys(sorted(doomed.keys), only_lease=l1)
+    assert store.get("/r/gone") is None
+    kv = store.get("/r/rebound")
+    assert kv is not None and kv.value == "new" and kv.lease == l2
+    store.close()
+
+
+def test_write_rejects_expired_unswept_lease():
+    """With a sweeper owning expiry, write paths skip the per-op scan —
+    but a lease whose deadline has passed must still reject writes (the
+    O(1) deadline check), or a put could silently attach to a lease the
+    next sweep will kill."""
+    clk = [0.0]
+    store = MemStore(clock=lambda: clk[0])
+    store.start_sweeper(interval=3600)   # owns expiry, never fires here
+    l = store.grant(1.0)
+    store.put("/el/k", "v", lease=l)
+    clk[0] = 2.0                         # past the deadline, unswept
+    with pytest.raises(KeyError):
+        store.put("/el/k2", "v", lease=l)
+    with pytest.raises(KeyError):
+        store.put_many([("/el/k3", "v")], lease=l)
+    with pytest.raises(KeyError):
+        store.claim("/el/f/1", "n", l)
+    assert store.get("/el/k3") is None
+    store.close()
+
+
+def test_stripe_contention_is_counted():
+    """Blocked stripe acquisitions surface in op_stats so a bench can
+    attribute a ceiling to lock contention by name."""
+    store = MemStore(stripes=1)   # force every key onto one stripe
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            store.put(f"/c/{i % 8}", "v")
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join()
+    stats = store.op_stats()
+    assert stats.get("stripe_contention", {}).get("count", 0) > 0
+    store.close()
