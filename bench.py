@@ -386,7 +386,14 @@ def main():
                 detail["dispatch_plane_native_agent_curve"] = \
                     nd.get("dispatch_plane_agent_curve")
                 for k in ("dispatch_plane_exec_lag_p50_s",
-                          "dispatch_plane_exec_lag_p99_s"):
+                          "dispatch_plane_exec_lag_p99_s",
+                          "dispatch_plane_exec_lag_net_p50_s",
+                          "dispatch_plane_exec_lag_net_p99_s",
+                          "dispatch_plane_exec_lag_offset_s",
+                          "dispatch_plane_agent_records_per_flush",
+                          "dispatch_plane_logd_records_per_batch",
+                          "dispatch_plane_logd_op_stats",
+                          "dispatch_plane_records_dropped"):
                     if k in nd:
                         detail[k.replace("plane_", "plane_native_")] = nd[k]
             else:

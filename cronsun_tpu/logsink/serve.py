@@ -44,7 +44,7 @@ from .joblog import JobLogStore, LogRecord
 _PLAIN_OPS = ("get_log", "stat_overall", "stat_day", "stat_days",
               "upsert_node", "set_node_alived", "get_nodes", "get_node",
               "upsert_account", "get_account", "list_accounts",
-              "delete_account")
+              "delete_account", "op_stats")
 
 
 def _rec_wire(rec: Optional[LogRecord]):
@@ -278,10 +278,15 @@ class RemoteJobLogStore:
 
     # -- surface (mirrors JobLogStore) -------------------------------------
 
-    def create_job_log(self, rec: LogRecord):
-        # one token per logical record, stable across the reconnect retry
+    def create_job_log(self, rec: LogRecord, idem: str = ""):
+        # one token per logical record, stable across the reconnect
+        # retry; callers that re-send a record after an INDETERMINATE
+        # reply (the agent's record flusher) pass their own stable
+        # ``idem`` so an applied-but-reply-lost write dedups
+        # server-side instead of double-inserting (the token contract
+        # of _Conn._idempotent above)
         rec.id = self._call("create_job_log", _rec_wire(rec),
-                            uuid.uuid4().hex)
+                            idem or uuid.uuid4().hex)
 
     def create_job_logs(self, recs: List[LogRecord], idem: str = ""):
         """Bulk insert in one round trip (one idempotency token per
@@ -311,6 +316,11 @@ class RemoteJobLogStore:
 
     def stat_days(self, n_days: int) -> List[dict]:
         return self._call("stat_days", n_days)
+
+    def op_stats(self) -> dict:
+        """Server-side per-op timing snapshot (JobLogStore.op_stats —
+        bulk create vs query attribution for the result plane)."""
+        return self._call("op_stats")
 
     def upsert_node(self, node_id: str, doc: str, alived: bool):
         self._call("upsert_node", node_id, doc, alived)

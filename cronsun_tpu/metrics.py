@@ -12,11 +12,56 @@ failure-must-not-stall-the-caller rule.
 from __future__ import annotations
 
 import json
+import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from . import log
 from .core import Keyspace
+
+
+class OpStats:
+    """Per-op server-side timing/count aggregation behind one lock:
+    op -> [count, total_ns, max_ns].  The shared primitive behind both
+    stores' ``op_stats`` surfaces (memstore's claim/put/watch timings
+    and the result store's create/query timings), so their snapshot
+    shape — and the ``/v1/metrics`` rendering built on it — cannot
+    drift between the two."""
+
+    __slots__ = ("_ns", "_lock")
+
+    def __init__(self):
+        self._ns: Dict[str, list] = {}
+        self._lock = threading.Lock()
+
+    def record(self, op: str, t0_ns: int) -> None:
+        dt = time.perf_counter_ns() - t0_ns
+        with self._lock:
+            ent = self._ns.get(op)
+            if ent is None:
+                self._ns[op] = [1, dt, dt]
+            else:
+                ent[0] += 1
+                ent[1] += dt
+                if dt > ent[2]:
+                    ent[2] = dt
+
+    def count(self, op: str, n: int = 1) -> None:
+        """Count-only stat (no timing): contention ticks, frame/event
+        tallies, per-record tallies under a bulk op."""
+        with self._lock:
+            ent = self._ns.get(op)
+            if ent is None:
+                self._ns[op] = [n, 0, 0]
+            else:
+                ent[0] += n
+
+    def snapshot(self) -> dict:
+        """{op: {count, total_ms, max_ms}} — the op_stats wire shape."""
+        with self._lock:
+            return {op: {"count": c, "total_ms": round(t / 1e6, 3),
+                         "max_ms": round(m / 1e6, 3)}
+                    for op, (c, t, m) in self._ns.items()}
 
 
 class LatencyRing:

@@ -186,7 +186,8 @@ class NodeAgent:
         # sink outage coverage
         self.rec_flush_max_fails = 30
         self._rec_flush_fails = 0
-        self._rec_retry: Optional[Tuple[list, str]] = None
+        # (batch, batch idem token, per-record idem tokens)
+        self._rec_retry: Optional[Tuple[list, str, list]] = None
         self._rec_retry_at = 0.0
         # sink-outage backstop: the live buffer stops growing here
         # (oldest dropped, counted) instead of absorbing the outage in
@@ -194,6 +195,17 @@ class NodeAgent:
         self.rec_buf_max = 100_000
         self._rec_dropped = 0
         self._rec_drop_log_at = 0.0
+        # per-record idempotency on the degraded (no-create_job_logs)
+        # path needs the sink to accept an idem kwarg; resolved lazily
+        # from the signature (None = not yet probed) — catching
+        # TypeError at the call site would misread a TypeError raised
+        # INSIDE a conforming sink as "no idem support" and silently
+        # disable dedup forever
+        self._sink_takes_idem: Optional[bool] = None
+        # record-plane flush telemetry: flush count, records shipped,
+        # and the largest batch one flush carried (the coalescing win
+        # the bench reads as records-per-flush)
+        self._rec_flush_max_batch = 0
         # delayed proc-registry puts (the ProcReq threshold) ride ONE
         # monitor thread instead of a threading.Timer per execution —
         # a timer thread per order was a measured top cost of the
@@ -219,7 +231,9 @@ class NodeAgent:
         # are bumped from concurrent pool workers -> lock the increments
         self.stats = {"orders_consumed_total": 0, "execs_total": 0,
                       "execs_failed_total": 0, "watch_losses_total": 0,
-                      "ack_flush_total": 0, "ack_flush_orders_total": 0}
+                      "ack_flush_total": 0, "ack_flush_orders_total": 0,
+                      "rec_flush_total": 0, "rec_flush_records_total": 0,
+                      "rec_dropped_total": 0}
         self._stats_mu = threading.Lock()
         # scheduled-second -> exec-start lag samples (the end-to-end
         # dispatch SLA), published as p50/p99 in the metrics snapshot
@@ -327,7 +341,17 @@ class NodeAgent:
             snap["exec_start_lag_p99_s"] = round(q(0.99), 3)
         snap["running"] = len(self.running)
         snap["procs_registered"] = len(self._procs)
+        snap["rec_flush_max_batch"] = self._rec_flush_max_batch
+        with self._rec_mu:
+            snap["rec_buf"] = len(self._rec_buf)
         return snap
+
+    def _record_flushed(self, n: int):
+        with self._stats_mu:
+            self.stats["rec_flush_total"] += 1
+            self.stats["rec_flush_records_total"] += n
+        if n > self._rec_flush_max_batch:
+            self._rec_flush_max_batch = n
 
     def unregister(self):
         if self._lease is not None:
@@ -857,6 +881,7 @@ class NodeAgent:
                 # error line (~8k/s measured) would make the log pipe
                 # the next bottleneck of the outage
                 self._rec_dropped += drop
+                self._bump("rec_dropped_total", drop)
                 now = self.clock()
                 if now >= self._rec_drop_log_at:
                     self._rec_drop_log_at = now + 5.0
@@ -925,25 +950,54 @@ class NodeAgent:
                 return
             self._flush_records()
 
-    def _send_records(self, batch: list, idem: str) -> bool:
+    def _send_records(self, batch: list, idem: str,
+                      toks: Optional[list] = None) -> bool:
         """One write attempt.  On a mid-batch failure of the per-record
-        path the already-written head is removed from ``batch`` in
-        place, so a caller that re-buffers retries only the unwritten
-        tail (re-sending the head would duplicate job-log rows)."""
+        path the already-written head is removed from ``batch`` (and
+        ``toks``) in place, so a caller that re-buffers retries only
+        the unwritten tail (re-sending the head would duplicate
+        job-log rows).  ``toks`` are the per-record idempotency tokens
+        minted when the batch first formed: they stay pinned across
+        EVERY retry of the same logical records, so a record whose
+        first per-record attempt committed with the reply lost dedups
+        server-side on the re-send instead of double-inserting (the
+        token contract of logsink/serve.py) — the same guarantee the
+        bulk path gets from the batch-level ``idem``."""
         written = 0
         try:
             if hasattr(self.sink, "create_job_logs"):
                 self.sink.create_job_logs(batch, idem=idem)
             else:                   # minimal sink: per-record
-                for r in batch:
-                    self.sink.create_job_log(r)
+                use_idem = toks is not None and self._sink_idem_ok()
+                for k, r in enumerate(batch):
+                    if use_idem:
+                        self.sink.create_job_log(r, idem=toks[k])
+                    else:
+                        self.sink.create_job_log(r)
                     written += 1
             return True
         except Exception as e:  # noqa: BLE001 — sink client already
             del batch[:written]  # retried once; caller decides the rest
+            if toks is not None:
+                del toks[:written]
             log.warnf("record write failed (%d records unwritten): %s",
                       len(batch), e)
             return False
+
+    def _sink_idem_ok(self) -> bool:
+        """Does the sink's per-record create accept an ``idem`` kwarg?
+        Resolved once from the signature, never from a caught
+        TypeError (which could equally come from inside the sink)."""
+        if self._sink_takes_idem is None:
+            try:
+                import inspect
+                params = inspect.signature(
+                    self.sink.create_job_log).parameters
+                self._sink_takes_idem = "idem" in params or any(
+                    p.kind == p.VAR_KEYWORD for p in params.values())
+            except (TypeError, ValueError):  # builtins, odd callables
+                self._sink_takes_idem = False
+        return self._sink_takes_idem
 
     def _flush_records(self, final: bool = False, force: bool = False):
         # pop AND write under one flush mutex: join_running()/stop() use
@@ -970,8 +1024,9 @@ class NodeAgent:
                 early = self.clock() < self._rec_retry_at
                 if not (final or force) and early:
                     return   # between backoff attempts; fresh waits too
-                batch, idem = self._rec_retry
-                if self._send_records(batch, idem):
+                batch, idem, toks = self._rec_retry
+                if self._send_records(batch, idem, toks):
+                    self._record_flushed(len(batch))
                     self._rec_retry = None
                     self._rec_flush_fails = 0
                 elif force and not final and early:
@@ -990,6 +1045,7 @@ class NodeAgent:
                             "record flush failed (%d records dropped "
                             "after %d attempts)", len(batch),
                             self._rec_flush_fails)
+                        self._bump("rec_dropped_total", len(batch))
                         self._rec_retry = None
                         self._rec_flush_fails = 0
                     else:
@@ -1004,14 +1060,22 @@ class NodeAgent:
                 batch, self._rec_buf = self._rec_buf, []
             if not batch:
                 return
+            # batch token + per-record tokens minted ONCE per logical
+            # batch: both stay pinned in the retry slot so every
+            # re-send (bulk or per-record degraded path) dedups
+            # server-side
             idem = uuid.uuid4().hex
-            if not self._send_records(batch, idem):
-                if final:
-                    log.errorf("record flush failed (%d records dropped "
-                               "at shutdown)", len(batch))
-                elif batch:
-                    self._rec_retry = (batch, idem)
-                    self._rec_retry_at = self.clock() + 0.5
+            toks = [f"{idem}.{i}" for i in range(len(batch))]
+            sent = len(batch)
+            if self._send_records(batch, idem, toks):
+                self._record_flushed(sent)
+            elif final:
+                log.errorf("record flush failed (%d records dropped "
+                           "at shutdown)", len(batch))
+                self._bump("rec_dropped_total", len(batch))
+            elif batch:
+                self._rec_retry = (batch, idem, toks)
+                self._rec_retry_at = self.clock() + 0.5
 
     # ---- event processing (synchronous; threads call these) --------------
 

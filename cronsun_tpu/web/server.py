@@ -505,28 +505,36 @@ class ApiServer:
                     lines.append(f"# TYPE {name} {kind}")
                     seen_types.add(name)
                 lines.append(f'{name}{{instance="{inst}"}} {val}')
-        # server-side store op timings (the store's own op_stats op):
-        # names the component that owns a dispatch-plane ceiling —
-        # claim paths, bulk writes, watch fan-out — and, next to the
-        # scheduler's pipeline_stall_* gauges, shows operators
-        # publisher backpressure without running a bench
-        op_stats = getattr(self.store, "op_stats", None)
-        if op_stats is not None:
+        # server-side op timings from BOTH backing servers (their own
+        # op_stats op).  Store: names the component that owns a
+        # dispatch-plane ceiling — claim paths, bulk writes, watch
+        # fan-out — and, next to the scheduler's pipeline_stall_*
+        # gauges, shows publisher backpressure without running a bench.
+        # Logsink: the RESULT plane's attribution — create_job_logs
+        # count vs the log_records tally gives the fleet's
+        # records-per-flush (the coalescing win), and total_ms names
+        # logd itself as (or rules it out as) the exec-lag ceiling.
+        for backend, prefix in ((self.store, "store"),
+                                (self.sink, "logsink")):
+            op_stats = getattr(backend, "op_stats", None)
+            if op_stats is None:
+                continue
             try:
                 stats = op_stats()
-            except Exception:  # noqa: BLE001 — older store server
+            except Exception:  # noqa: BLE001 — older server
                 stats = {}
-            if stats:
-                for field, kind in (("count", "counter"),
-                                    ("total_ms", "counter"),
-                                    ("max_ms", "gauge")):
-                    name = f"cronsun_store_op_{field}"
-                    lines.append(f"# TYPE {name} {kind}")
-                    for op, ent in sorted(stats.items()):
-                        if field not in ent:
-                            continue
-                        o = op.replace('\\', r'\\').replace('"', r'\"')
-                        lines.append(f'{name}{{op="{o}"}} {ent[field]}')
+            if not stats:
+                continue
+            for field, kind in (("count", "counter"),
+                                ("total_ms", "counter"),
+                                ("max_ms", "gauge")):
+                name = f"cronsun_{prefix}_op_{field}"
+                lines.append(f"# TYPE {name} {kind}")
+                for op, ent in sorted(stats.items()):
+                    if field not in ent:
+                        continue
+                    o = op.replace('\\', r'\\').replace('"', r'\"')
+                    lines.append(f'{name}{{op="{o}"}} {ent[field]}')
         return PlainText("\n".join(lines) + "\n")
 
     # ---- plumbing --------------------------------------------------------

@@ -958,9 +958,15 @@ class Executor {
     };
     if (instant_) {
       // benchmarking mode (--instant-exec): the dispatch-plane sweep
-      // measures the PLANE (claims, proc registry, order consume, log
-      // records), not fork/exec of /bin/true at 10k/s
-      fire_once();
+      // measures the PLANE (claims, order consume, log records), not
+      // fork/exec of /bin/true at 10k/s.  The ProcReq hook does NOT
+      // fire: an instant run (begin == end) never outlives the
+      // threshold, so registering it would (a) contradict the
+      // short-run-suppression semantics the threshold exists for
+      // (proc.go:218-236) and (b) pay one lock-step proc-put RPC per
+      // exec — which was the next per-exec serializer after the
+      // record flusher removed the create_job_log one.  The Python
+      // bench's InstantExecutor skips the hook the same way.
       ExecResult r;
       r.begin = r.end = now_s();
       r.success = true;
@@ -1091,6 +1097,9 @@ class Agent {
   }
 
   void set_instant_exec(bool v) { exec_.instant_ = v; }
+  void set_rec_flush_interval(double s) {
+    if (s > 0) rec_flush_interval_ = s;
+  }
 
   bool start() {
     if (probe_duplicate() != ProbeResult::kOk) return false;
@@ -1101,6 +1110,7 @@ class Agent {
     std::thread(&Agent::keepalive_loop, this).detach();
     std::thread(&Agent::event_loop, this).detach();
     std::thread(&Agent::ack_flush_loop, this).detach();
+    std::thread(&Agent::rec_flush_loop, this).detach();
     return true;
   }
 
@@ -1110,7 +1120,23 @@ class Agent {
       std::lock_guard<std::mutex> g(qmu_);
       qcv_.notify_all();
     }
+    // bounded join of in-flight executions BEFORE the final flushes
+    // (agent.py stop() joins running work the same way): a worker that
+    // claimed its fence and is completing right now must get its
+    // record into the barrier flush, not lose it to the process exit.
+    // Workers take no NEW tasks once stop_ is set, so waiting out
+    // running_ is enough; the initial nap covers the popped-but-not-
+    // yet-counted window.
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    double join_deadline = now_s() + 10;
+    while (now_s() < join_deadline && running_.load() > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
     flush_acks();   // final synchronous drain of buffered order acks
+    // flush barrier: buffered execution records (and fail notices)
+    // must land before the process exits — anything the sink won't
+    // take NOW is dropped loudly, never silently
+    flush_records(true);
+    flush_notices();
     if (lease_) store_.revoke(lease_);
     if (proc_lease_) store_.revoke(proc_lease_);
     if (fence_lease_) store_.revoke(fence_lease_);
@@ -1141,6 +1167,19 @@ class Agent {
     ack_buf_.push_back(key);
   }
 
+  // Finished-run proc-registry deletes ride the same delete_many
+  // flush but are buffered — and counted — APART: ack_flush_orders_
+  // total must keep meaning consumed orders, and unlike order keys
+  // (short leases, drop-on-failure is fine) proc keys live on
+  // proc_ttl (default 600 s) — a dropped delete would show a finished
+  // run as "executing" for minutes, so a failed flush re-buffers them
+  // for the next tick.
+  void proc_delete(const std::string& key) {
+    if (key.empty()) return;
+    std::lock_guard<std::mutex> g(ack_mu_);
+    proc_del_buf_.push_back(key);
+  }
+
   void ack_flush_loop() {
     while (!stop_) {
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
@@ -1149,17 +1188,44 @@ class Agent {
   }
 
   void flush_acks() {
-    std::vector<std::string> batch;
+    std::vector<std::string> batch, procs;
     {
       std::lock_guard<std::mutex> g(ack_mu_);
       batch.swap(ack_buf_);
+      procs.swap(proc_del_buf_);
     }
-    if (batch.empty()) return;
-    // a failed batch drops: order keys are leased and age out
-    // server-side — retrying here could hold keys past their usefulness
+    if (batch.empty() && procs.empty()) return;
+    size_t norders = batch.size();
+    batch.insert(batch.end(), procs.begin(), procs.end());
     if (store_.delete_many(batch)) {
       ack_flushes_++;
-      ack_orders_ += (long long)batch.size();
+      ack_orders_ += (long long)norders;
+      proc_deletes_ += (long long)procs.size();
+    } else if (!procs.empty()) {
+      // failed order acks drop (leased keys age out server-side);
+      // proc keys re-buffer, bounded — the live registry is finite.
+      // Past the cap they drop COUNTED and logged (the finished runs
+      // will show as "executing" until the proc lease expires).
+      bool dropped = false;
+      {
+        std::lock_guard<std::mutex> g(ack_mu_);
+        if (proc_del_buf_.size() + procs.size() <= 100000)
+          proc_del_buf_.insert(proc_del_buf_.end(), procs.begin(),
+                               procs.end());
+        else
+          dropped = true;
+      }
+      if (dropped) {
+        proc_del_dropped_ += (long long)procs.size();
+        double nw = now_s();
+        if (nw >= proc_drop_log_at_) {
+          proc_drop_log_at_ = nw + 5.0;
+          fprintf(stderr, "proc-delete buffer over cap during store "
+                  "outage; %lld deletes dropped so far (finished runs "
+                  "show as executing until the proc lease expires)\n",
+                  proc_del_dropped_.load());
+        }
+      }
     }
   }
 
@@ -1280,6 +1346,23 @@ class Agent {
     jint(snap, ack_flushes_.load());
     snap += ",\"ack_flush_orders_total\":";
     jint(snap, ack_orders_.load());
+    snap += ",\"proc_flush_deletes_total\":";
+    jint(snap, proc_deletes_.load());
+    snap += ",\"proc_flush_deletes_dropped_total\":";
+    jint(snap, proc_del_dropped_.load());
+    snap += ",\"rec_flush_total\":";
+    jint(snap, rec_flushes_.load());
+    snap += ",\"rec_flush_records_total\":";
+    jint(snap, rec_flush_records_.load());
+    snap += ",\"rec_dropped_total\":";
+    jint(snap, rec_dropped_.load());
+    snap += ",\"rec_flush_max_batch\":";
+    jint(snap, rec_flush_max_batch_.load());
+    {
+      std::lock_guard<std::mutex> rg(rec_mu_);
+      snap += ",\"rec_buf\":";
+      jint(snap, (long long)rec_buf_.size());
+    }
     snap += ",\"running\":";
     jint(snap, running_.load());
     snap += ",\"procs_registered\":";
@@ -1435,12 +1518,33 @@ class Agent {
       ack_order(key);   // malformed/empty: release the reservation
       return;
     }
-    auto t = std::make_shared<Task>();
-    t->epoch = epoch;
-    t->bundle = true;
-    t->order_key = key;
-    t->entries = std::move(entries);
-    enqueue_task(std::move(t), epoch);
+    // Oversized bundles split into chunk tasks the worker pool claims
+    // CONCURRENTLY: one worker serially resolving + claiming a
+    // 10k-member bundle (one get_many + one claim_bundle of 10k items)
+    // put the whole preprocessing time on every member's
+    // exec-start lag.  Exactly-once is untouched — fences are per
+    // member.  Chunks claim with an EMPTY order key (both store
+    // backends no-op it) and share a countdown; the chunk that settles
+    // LAST releases the reservation via the ack flusher, so a crash —
+    // or one chunk's unreachable-store bailout — leaves the leased
+    // bundle key in the store for redelivery, where already-claimed
+    // members simply lose their fences.
+    const size_t kChunk = 2048;
+    size_t nchunks = (entries.size() + kChunk - 1) / kChunk;
+    auto left = nchunks > 1
+                    ? std::make_shared<std::atomic<int>>((int)nchunks)
+                    : nullptr;
+    for (size_t off = 0; off < entries.size(); off += kChunk) {
+      size_t end = std::min(off + kChunk, entries.size());
+      auto t = std::make_shared<Task>();
+      t->epoch = epoch;
+      t->bundle = true;
+      t->order_key = key;
+      t->chunks_left = left;
+      t->entries.assign(entries.begin() + (long)off,
+                        entries.begin() + (long)end);
+      enqueue_task(std::move(t), epoch);
+    }
   }
 
   void handle_broadcast(const std::string& key) {
@@ -1514,6 +1618,12 @@ class Agent {
     // and order_key is the bundle key (the capacity reservation)
     bool bundle = false;
     std::vector<std::string> entries;
+    // oversized-bundle chunk: the bundle's chunks share this countdown
+    // and the reservation key is released only when the LAST chunk has
+    // settled its claims — a reservation deleted while sibling chunks
+    // were still pending would lose their members forever if the agent
+    // died (nothing left in the store to re-deliver)
+    std::shared_ptr<std::atomic<int>> chunks_left;
     // member execution whose fence (and Alone lock) a bundle claim
     // already settled — execute() skips the claim section
     bool preclaimed = false;
@@ -1570,7 +1680,12 @@ class Agent {
       }
       if (!task) return;
       if (task->bundle) {
+        // counted as running work: stop()'s join barrier must wait
+        // out a bundle mid-resolve/claim, or records its members
+        // buffer right after the final flush would be lost silently
+        running_++;
         run_bundle(*task);
+        running_--;
         continue;
       }
       execute(task->job, task->epoch, task->fenced, task->gate,
@@ -1718,7 +1833,12 @@ class Agent {
     if (proc_put) {
       std::lock_guard<std::mutex> g(procs_mu_);
       procs_.erase(proc_key);
-      store_.del(proc_key);
+      // the delete rides the ack/delete_many flusher: clearing a
+      // finished run's registry entry is bookkeeping (the key is
+      // leased and would age out anyway) — an exec thread must not
+      // block on a per-exec delete RPC.  Erased from procs_ first, so
+      // a concurrent lease repair can't re-put it after the flush.
+      proc_delete(proc_key);
     }
     if (alone_lease) {
       alone_stop->store(true);
@@ -1748,6 +1868,16 @@ class Agent {
   // pool.  Per-job exactly-once is unchanged: a duplicate bundle
   // delivery re-claims and loses on the fences.
   void run_bundle(const Task& task) {
+    // chunked sibling of an oversized bundle: this chunk claims with
+    // an EMPTY order key; whichever chunk settles last releases the
+    // shared reservation (buffered delete).  An unreachable-store
+    // bailout never settles, so the leased key survives for
+    // redelivery.
+    const bool chunked = task.chunks_left != nullptr;
+    auto settle = [&] {
+      if (chunked && task.chunks_left->fetch_sub(1) == 1)
+        ack_order(task.order_key);
+    };
     // resolve every member's job doc in ONE get_many round trip — a
     // per-member get would put bundle-size sequential RTTs on the
     // scheduled-second -> exec-start SLA path (the Python agent batches
@@ -1803,18 +1933,24 @@ class Agent {
       members.push_back(std::move(m));
     }
     if (members.empty()) {
-      ack_order(task.order_key);   // nothing claimable: release the
-      return;                      // capacity reservation
+      // nothing claimable in this (chunk of the) bundle: release the
+      // capacity reservation — for a chunk, only once every sibling
+      // has settled
+      if (chunked) settle();
+      else ack_order(task.order_key);
+      return;
     }
     std::vector<bool> wins;
-    if (!bundle_claim(task.order_key, items, members, wins)) {
+    if (!bundle_claim(chunked ? std::string() : task.order_key, items,
+                      members, wins)) {
       // store unreachable: do NOT run unfenced — stop the Alone
       // keepalives so those locks expire; the leased bundle key ages
-      // out and a resync re-delivers
+      // out (a chunk also skips its settle) and a resync re-delivers
       for (auto& m : members)
         if (m.alone_stop) m.alone_stop->store(true);
       return;
     }
+    settle();
     orders_consumed_ += (long long)members.size();
     for (size_t i = 0; i < members.size(); i++) {
       BundleMember& m = members[i];
@@ -2090,6 +2226,18 @@ class Agent {
     return false;  // store unreachable: do NOT run unfenced
   }
 
+  // -- the record flusher ------------------------------------------------
+  // Exec threads ENQUEUE execution records; a background flusher ships
+  // size/interval-capped batches over ONE bulk create_job_logs RPC per
+  // flush (the Python agent's _flush_records architecture).  The
+  // lock-step create_job_log-per-execution this replaces ceilinged a
+  // native agent near the logd RTT (~0.7k execs/s under instant-exec,
+  // BENCH_r05) — the RPC serialized every worker thread through the
+  // lock-step LogClient.  An exec thread now never blocks on logd: a
+  // degraded sink parks the batch in a retry slot (idempotency token
+  // pinned, exponential backoff, bounded attempts) while fresh records
+  // keep buffering behind a drop cap.
+
   void record(const JobSpec& j, const ExecResult& res) {
     execs_++;
     if (!res.success) execs_failed_++;
@@ -2119,11 +2267,19 @@ class Agent {
     rec += ",\"end_ts\":";
     jdbl(rec, res.end);
     rec += ",\"id\":null}";
-    std::string args = "[" + rec + ",";
-    jesc(args, idem_token());
-    args += "]";
-    std::string rep;
-    logd_.call("create_job_log", args, rep);
+    {
+      std::lock_guard<std::mutex> g(rec_mu_);
+      rec_buf_.push_back(std::move(rec));
+      // sink-outage backstop: drop oldest past the cap instead of
+      // absorbing the outage in unbounded memory (chunked trim, same
+      // hysteresis as agent.py)
+      if (rec_buf_.size() > rec_buf_max_ + 4096) {
+        size_t drop = rec_buf_.size() - rec_buf_max_;
+        rec_buf_.erase(rec_buf_.begin(),
+                       rec_buf_.begin() + (long)drop);
+        rec_dropped_ += (long long)drop;
+      }
+    }
     if (!res.success && j.fail_notify) {
       std::string body = "job: " + j.group + "/" + j.id + "\nnode: " + id_ +
                          "\noutput: " + res.output + "\nerror: " + res.error;
@@ -2137,8 +2293,108 @@ class Agent {
         jesc(msg, j.to[i]);
       }
       msg += "]}";
-      store_.put(pfx_ + "/noticer/" + id_, msg, 0);
+      // the noticer put rides the flusher thread too: a degraded
+      // store must not stall an exec thread on the notify RPC
+      std::lock_guard<std::mutex> g(notice_mu_);
+      notice_buf_.push_back(std::move(msg));
     }
+  }
+
+  void rec_flush_loop() {
+    while (!stop_) {
+      std::this_thread::sleep_for(
+          std::chrono::duration<double>(rec_flush_interval_));
+      flush_records(false);
+      flush_notices();
+    }
+  }
+
+  // one bulk write attempt; the whole batch rides ONE idempotency
+  // token, so a retry of an applied-but-reply-lost attempt replays the
+  // original ids server-side instead of double-inserting
+  bool send_records(const std::vector<std::string>& batch,
+                    const std::string& idem) {
+    std::string args = "[[";
+    for (size_t i = 0; i < batch.size(); i++) {
+      if (i) args += ',';
+      args += batch[i];
+    }
+    args += "],";
+    jesc(args, idem);
+    args += "]";
+    std::string rep;
+    if (!logd_.call("create_job_logs", args, rep)) return false;
+    JParser jp(rep);
+    JV v;
+    return jp.value(v) && v.t == JV::OBJ && v.get("e") == nullptr;
+  }
+
+  // Drain the buffer (and any parked retry batch) through ONE bulk RPC
+  // each.  ``final_flush`` is the stop() barrier: attempt everything
+  // now regardless of backoff, and drop — loudly — what the sink still
+  // won't take.  The whole body holds rec_flush_mu_ so the barrier
+  // caller can never return while a popped batch is still in flight.
+  void flush_records(bool final_flush) {
+    std::lock_guard<std::mutex> fg(rec_flush_mu_);
+    if (!rec_retry_.empty()) {
+      if (!final_flush && now_s() < rec_retry_at_) return;  // backoff
+      if (send_records(rec_retry_, rec_retry_idem_)) {
+        note_flush(rec_retry_.size());
+        rec_retry_.clear();
+        rec_flush_fails_ = 0;
+      } else {
+        rec_flush_fails_++;
+        if (final_flush || rec_flush_fails_ >= rec_flush_max_fails_) {
+          fprintf(stderr, "record flush failed (%zu records dropped "
+                  "after %d attempts)\n", rec_retry_.size(),
+                  rec_flush_fails_);
+          rec_dropped_ += (long long)rec_retry_.size();
+          rec_retry_.clear();
+          rec_flush_fails_ = 0;
+        } else {
+          rec_retry_at_ = now_s() + std::min(
+              10.0, 0.25 * (double)(1 << std::min(rec_flush_fails_, 8)));
+          return;  // sink still down; fresh records wait behind it
+        }
+      }
+    }
+    std::vector<std::string> batch;
+    {
+      std::lock_guard<std::mutex> g(rec_mu_);
+      batch.swap(rec_buf_);
+    }
+    if (batch.empty()) return;
+    std::string idem = idem_token();
+    if (send_records(batch, idem)) {
+      note_flush(batch.size());
+    } else if (final_flush) {
+      fprintf(stderr, "record flush failed (%zu records dropped at "
+              "shutdown)\n", batch.size());
+      rec_dropped_ += (long long)batch.size();
+    } else {
+      rec_retry_ = std::move(batch);
+      rec_retry_idem_ = idem;
+      rec_retry_at_ = now_s() + 0.5;
+    }
+  }
+
+  void note_flush(size_t n) {
+    rec_flushes_++;
+    rec_flush_records_ += (long long)n;
+    long long prev = rec_flush_max_batch_.load();
+    while ((long long)n > prev &&
+           !rec_flush_max_batch_.compare_exchange_weak(prev, (long long)n)) {
+    }
+  }
+
+  void flush_notices() {
+    std::vector<std::string> batch;
+    {
+      std::lock_guard<std::mutex> g(notice_mu_);
+      batch.swap(notice_buf_);
+    }
+    for (const std::string& msg : batch)
+      store_.put(pfx_ + "/noticer/" + id_, msg, 0);
   }
 
   void update_avg_time(const JobSpec& j, const ExecResult& res) {
@@ -2224,8 +2480,28 @@ class Agent {
   std::atomic<long long> orders_consumed_{0}, execs_{0}, execs_failed_{0},
       watch_losses_{0}, running_{0};
   std::mutex ack_mu_;                    // buffered consumed-order acks
-  std::vector<std::string> ack_buf_;
-  std::atomic<long long> ack_flushes_{0}, ack_orders_{0};
+  std::vector<std::string> ack_buf_;     // consumed order keys
+  std::vector<std::string> proc_del_buf_;  // finished-run proc keys
+  std::atomic<long long> ack_flushes_{0}, ack_orders_{0},
+      proc_deletes_{0}, proc_del_dropped_{0};
+  double proc_drop_log_at_ = 0;  // rate-limits the overflow log line
+  // record flusher state (the Python agent's _flush_records twin)
+  std::mutex rec_mu_;                    // guards rec_buf_
+  std::vector<std::string> rec_buf_;     // serialized LogRecord objects
+  size_t rec_buf_max_ = 100000;
+  std::mutex rec_flush_mu_;              // pop+send atomicity: the stop
+                                         // barrier can't return while a
+                                         // popped batch is in flight
+  std::vector<std::string> rec_retry_;   // failed batch, idem pinned
+  std::string rec_retry_idem_;
+  double rec_retry_at_ = 0;
+  int rec_flush_fails_ = 0;
+  int rec_flush_max_fails_ = 30;
+  double rec_flush_interval_ = 0.05;
+  std::atomic<long long> rec_flushes_{0}, rec_flush_records_{0},
+      rec_dropped_{0}, rec_flush_max_batch_{0};
+  std::mutex notice_mu_;                 // buffered fail notices
+  std::vector<std::string> notice_buf_;
   std::mutex metrics_mu_;       // lease lifecycle vs shutdown revoke
   long long metrics_lease_ = 0; // -1 = revoked at stop, never re-grant
   double metrics_at_ = 0;
@@ -2244,6 +2520,7 @@ int main(int argc, char** argv) {
   std::string node_id, prefix = "/cronsun";
   std::string store_token, log_token;
   double ttl = 10, proc_ttl = 600, lock_ttl = 300, proc_req = 5;
+  double rec_flush_interval = 0.05;
   bool instant_exec = false;
   int workers = 64;
   for (int i = 1; i < argc; i++) {
@@ -2257,6 +2534,7 @@ int main(int argc, char** argv) {
     else if (a == "--proc-ttl") proc_ttl = atof(next());
     else if (a == "--lock-ttl") lock_ttl = atof(next());
     else if (a == "--proc-req") proc_req = atof(next());
+    else if (a == "--rec-flush-interval") rec_flush_interval = atof(next());
     else if (a == "--workers") workers = atoi(next());
     else if (a == "--store-token") store_token = next();
     else if (a == "--log-token") log_token = next();
@@ -2268,8 +2546,9 @@ int main(int argc, char** argv) {
     else if (a == "--help") {
       printf("cronsun-agentd --store H:P --logsink H:P --node-id ID "
              "[--prefix /cronsun] [--ttl S] [--proc-ttl S] [--lock-ttl S] "
-             "[--proc-req S] [--workers N] [--store-token T] "
-             "[--log-token T] [--die-with-parent] [--instant-exec]\n");
+             "[--proc-req S] [--rec-flush-interval S] [--workers N] "
+             "[--store-token T] [--log-token T] [--die-with-parent] "
+             "[--instant-exec]\n");
       return 0;
     }
   }
@@ -2335,6 +2614,7 @@ int main(int argc, char** argv) {
   Agent agent(store, logd, node_id, prefix, ttl, proc_ttl, lock_ttl,
               proc_req, workers);
   agent.set_instant_exec(instant_exec);
+  agent.set_rec_flush_interval(rec_flush_interval);
   if (!agent.start()) return 1;
   printf("READY %s\n", node_id.c_str());
   fflush(stdout);

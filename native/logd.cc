@@ -128,6 +128,59 @@ static bool contains_nocase(const std::string& hay, const std::string& needle) {
 }
 
 // ---------------------------------------------------------------------------
+// per-op server-side timing (stored.cc / memstore.py op_stats parity):
+// lets a bench attribute the RESULT plane's ceiling to a named op —
+// bulk create vs query vs single create — instead of "logd".
+// ---------------------------------------------------------------------------
+
+struct OpStat {
+  long long count = 0, total_ns = 0, max_ns = 0;
+};
+static std::mutex g_op_mu;
+static std::map<std::string, OpStat> g_op_stats;
+
+static long long mono_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static void op_record(const std::string& op, long long t0_ns) {
+  long long dt = mono_ns() - t0_ns;
+  std::lock_guard<std::mutex> g(g_op_mu);
+  OpStat& s = g_op_stats[op];
+  s.count++;
+  s.total_ns += dt;
+  if (dt > s.max_ns) s.max_ns = dt;
+}
+
+// count-only stat (no timing): per-record tallies under the bulk op —
+// log_records / create_job_logs gives the server-observed batch size
+static void op_count(const std::string& op, long long n) {
+  std::lock_guard<std::mutex> g(g_op_mu);
+  g_op_stats[op].count += n;
+}
+
+static void op_stats_json(std::string& out) {
+  std::lock_guard<std::mutex> g(g_op_mu);
+  out += '{';
+  bool first = true;
+  for (const auto& [op, s] : g_op_stats) {
+    if (!first) out += ',';
+    first = false;
+    jesc(out, op);
+    out += ":{\"count\":";
+    jint(out, s.count);
+    out += ",\"total_ms\":";
+    jdbl(out, (double)s.total_ns / 1e6);
+    out += ",\"max_ms\":";
+    jdbl(out, (double)s.max_ns / 1e6);
+    out += '}';
+  }
+  out += '}';
+}
+
+// ---------------------------------------------------------------------------
 // WAL (same design as stored.cc's: append + flush now, fdatasync by
 // sweeper or per-commit; boot replay then compacted snapshot rewrite)
 // ---------------------------------------------------------------------------
@@ -145,6 +198,25 @@ class Wal {
     if (!f_) return;
     if (fwrite(line.data(), 1, line.size(), f_) != line.size() ||
         fputc('\n', f_) == EOF || fflush(f_) != 0) {
+      fprintf(stderr, "FATAL: wal append failed: %s\n", strerror(errno));
+      abort();
+    }
+    if (sync_per_commit_ && fdatasync(fileno(f_)) != 0) {
+      fprintf(stderr, "FATAL: wal fdatasync failed: %s\n", strerror(errno));
+      abort();
+    }
+  }
+
+  // N pre-joined '\n'-terminated lines as ONE write + ONE flush (+ one
+  // fdatasync under --fsync-per-commit): a bulk create commits a whole
+  // batch at the durability cost of a single record — the per-record
+  // append was the 4-write pattern's last per-record cost on this path
+  void append_block(const std::string& block) {
+    if (block.empty()) return;
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_) return;
+    if (fwrite(block.data(), 1, block.size(), f_) != block.size() ||
+        fflush(f_) != 0) {
       fprintf(stderr, "FATAL: wal append failed: %s\n", strerror(errno));
       abort();
     }
@@ -205,8 +277,11 @@ class LogStore {
   // Bulk create (agent record flushers): one idem token covers the
   // whole batch.  Ids are allocated consecutively under the lock, so a
   // replayed retry reconstructs the full id list from the recorded
-  // first id.  Returns false on an unparseable record (nothing
-  // applied).
+  // first id.  The per-record side writes COALESCE per batch — one
+  // stat bump per (day) touched, one latest-table upsert per
+  // (job, node) (last record in batch order wins, exactly the
+  // sequential outcome), one WAL block append — so a 1k-record batch
+  // pays ~4 table touches, not 4k.
   bool create_many(const std::vector<Rec>& recs, const std::string& idem,
                    std::string& res) {
     std::lock_guard<std::mutex> g(mu);
@@ -217,15 +292,42 @@ class LogStore {
     }
     if (first < 0) {
       first = next_id_;
+      std::string block;
+      std::map<std::pair<std::string, std::string>, Rec> last;
+      std::map<std::string, Stat> deltas;
+      Stat overall;
       for (Rec r : recs) {
         r.id = next_id_++;
-        apply_create(r);
+        recs_.push_back(r);
+        Stat& d = deltas[day_of(r.begin)];
+        d.total++;
+        (r.success ? d.ok : d.fail)++;
+        overall.total++;
+        (r.success ? overall.ok : overall.fail)++;
         if (wal_) {
-          std::string line;
-          wal_create(line, r);
-          wal_->append(line);
+          wal_create(block, r);
+          block += '\n';
         }
+        last[{r.job_id, r.node}] = std::move(r);
       }
+      while (recs_.size() > retain_) recs_.pop_front();
+      for (auto& [key, r] : last) latest_[key] = std::move(r);
+      for (const auto& [day, d] : deltas) {
+        Stat& s = stats_[day];
+        s.total += d.total;
+        s.ok += d.ok;
+        s.fail += d.fail;
+      }
+      Stat& o = stats_[std::string()];
+      o.total += overall.total;
+      o.ok += overall.ok;
+      o.fail += overall.fail;
+      if (wal_) wal_->append_block(block);
+      // counted HERE, not at the handle layer: an idempotent replay of
+      // a retried batch must not inflate the records-per-batch ratio
+      // (the Python backend counts inside create_job_logs the same
+      // way — the serve-layer dedup skips the thunk)
+      op_count("log_records", (long long)recs.size());
       if (!idem.empty()) {
         idem_[idem] = first;
         idem_fifo_.push_back(idem);
@@ -783,8 +885,11 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
     return;
   }
   std::string res;
+  long long t0 = mono_ns();
   if (op == "auth") {
     res = "true";
+  } else if (op == "op_stats") {
+    op_stats_json(res);
   } else if (op == "create_job_log") {
     Rec r;
     if (args.arr.empty() || !rec_unwire(args.arr[0], r)) {
@@ -844,6 +949,7 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
     out += "}\n";
     return;
   }
+  op_record(op, t0);
   out += ",\"r\":";
   out += res;
   out += "}\n";

@@ -167,12 +167,19 @@ def run_bench(rates, n_agents, seconds, on_log=print):
 
         delivered_before = 0
         per_rate = []
+        lag_offset = 0.0
         legacy_orders = os.environ.get("BENCH_ORDER_FORMAT") == "legacy"
         for rate in rates:
             on_log(f"rate {rate}/s x {seconds}s ...")
             lease = store.grant(300.0)
             t_start = time.time()
             epoch0 = int(t_start) - 2      # past epochs run immediately
+            # second e's orders (epoch0 + e) are published at wall time
+            # t_start + e, so every exec-start lag carries this offset
+            # by construction; the agents' lag ring holds the LAST
+            # swept rate, so keep the last rate's offset for the net
+            # figures below
+            lag_offset = t_start - epoch0
             for e in range(seconds):
                 orders = []
                 if legacy_orders:
@@ -253,6 +260,7 @@ def run_bench(rates, n_agents, seconds, on_log=print):
         # plane that scales only because one agent hogs the drain shows
         # a min/max ratio far below 1.
         lag_p50, lag_p99, consumed_per_agent = [], [], []
+        rec_flushes = rec_flush_records = rec_dropped = 0
         for kv in store.get_prefix(ks.metrics + "node/"):
             m = json.loads(kv.value)
             if "exec_start_lag_p99_s" in m:
@@ -260,6 +268,11 @@ def run_bench(rates, n_agents, seconds, on_log=print):
                 lag_p99.append(m["exec_start_lag_p99_s"])
             if "orders_consumed_total" in m:
                 consumed_per_agent.append(m["orders_consumed_total"])
+            # record-plane health: flush batching + outage drops, as
+            # published by both agents' record flushers
+            rec_flushes += m.get("rec_flush_total", 0)
+            rec_flush_records += m.get("rec_flush_records_total", 0)
+            rec_dropped += m.get("rec_dropped_total", 0)
         results.update({
             "dispatch_plane_sweep": per_rate,
             "dispatch_plane_orders_per_sec": round(sustained, 1),
@@ -287,10 +300,39 @@ def run_bench(rates, n_agents, seconds, on_log=print):
                 op_stats.get("stripe_contention", {}).get("count", 0)
         except Exception as e:  # noqa: BLE001 — older server
             on_log(f"op_stats unavailable: {e}")
+        # the RESULT plane's attribution: logd's own per-op timings,
+        # plus the coalescing ratios on both ends of the record wire —
+        # records per bulk RPC as logd observed them, and records per
+        # flush as the agents batched them
+        if rec_flushes:
+            results["dispatch_plane_agent_records_per_flush"] = round(
+                rec_flush_records / rec_flushes, 2)
+        results["dispatch_plane_records_dropped"] = rec_dropped
+        try:
+            logd_stats = sink.op_stats()
+            results["dispatch_plane_logd_op_stats"] = logd_stats
+            bulk = logd_stats.get("create_job_logs", {}).get("count", 0)
+            nrecs = logd_stats.get("log_records", {}).get("count", 0)
+            if bulk:
+                results["dispatch_plane_logd_records_per_batch"] = round(
+                    nrecs / bulk, 2)
+        except Exception as e:  # noqa: BLE001 — older logd server
+            on_log(f"logd op_stats unavailable: {e}")
         if lag_p99:
             results.update({
                 "dispatch_plane_exec_lag_p50_s": max(lag_p50),
                 "dispatch_plane_exec_lag_p99_s": max(lag_p99),
+                # the sweep offers PAST epochs (epoch0 = int(t_start)
+                # - 2, "past epochs run immediately") so raw lag
+                # carries a 2-3 s publication offset by construction;
+                # the net figures subtract the exact offset — what
+                # remains is plane latency (watch delivery, bundle
+                # claim, local queueing)
+                "dispatch_plane_exec_lag_offset_s": round(lag_offset, 3),
+                "dispatch_plane_exec_lag_net_p50_s": round(
+                    max(0.0, max(lag_p50) - lag_offset), 3),
+                "dispatch_plane_exec_lag_net_p99_s": round(
+                    max(0.0, max(lag_p99) - lag_offset), 3),
             })
     finally:
         for p in agents:
@@ -327,6 +369,17 @@ def run_quick(seconds=3, rate=24000, on_log=print):
             r2.get("dispatch_plane_fairness_min_over_max"),
         "watch_frames_per_event":
             r2.get("dispatch_plane_watch_frames_per_event"),
+        # record-plane numbers: is the result wire batched, did the
+        # flushers drop anything, and how late do execs start
+        "agent_records_per_flush":
+            r2.get("dispatch_plane_agent_records_per_flush"),
+        "logd_records_per_batch":
+            r2.get("dispatch_plane_logd_records_per_batch"),
+        "records_dropped": r2.get("dispatch_plane_records_dropped"),
+        "exec_lag_p50_s": r2.get("dispatch_plane_exec_lag_p50_s"),
+        "exec_lag_p99_s": r2.get("dispatch_plane_exec_lag_p99_s"),
+        "drain_per_agent_1": r1.get(
+            "dispatch_plane_drain_per_agent_per_sec"),
         "backend": r2["dispatch_plane_backend"],
     }
 

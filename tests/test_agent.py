@@ -524,6 +524,55 @@ def test_record_flush_retries_without_loss_or_duplicates():
     store.close()
 
 
+def test_per_record_tokens_stable_across_flush_retry():
+    """The degraded per-record path (a sink without create_job_logs):
+    an attempt that COMMITS but loses its reply must dedup on the
+    agent-level retry — the retry re-sends the SAME per-record
+    idempotency token (the logsink/serve.py token contract), where a
+    fresh token per call would double-insert the record."""
+    store = MemStore()
+
+    class IndetSink:
+        """Minimal per-record sink with server-side idem dedup; the
+        first N calls commit and then raise (reply lost)."""
+
+        def __init__(self):
+            self.rows = {}       # idem -> rec (the dedup table)
+            self.fail = 0
+
+        def create_job_log(self, rec, idem=""):
+            assert idem, "agent must pass a per-record token"
+            if idem not in self.rows:
+                self.rows[idem] = rec
+            if self.fail > 0:
+                self.fail -= 1
+                raise OSError("reply lost")
+
+        def set_node_alived(self, *a, **kw):
+            pass
+
+    sink = IndetSink()
+    agent = NodeAgent(store, sink, node_id="n0")
+    agent.rec_flush_interval = 3600
+    job = make_job()
+    for i in range(3):
+        agent._record(job, ExecResult(
+            success=True, output=f"r{i}", error="",
+            begin_ts=time.time(), end_ts=time.time(), skipped=False))
+    sink.fail = 2                       # first two records: commit, then
+    agent._flush_records()              # "fail" -> head committed twice
+    agent._rec_retry_at = 0.0
+    agent._flush_records()              # retry the unwritten-looking tail
+    agent._rec_retry_at = 0.0
+    agent._flush_records()
+    assert agent._rec_retry is None and not agent._rec_buf
+    assert len(sink.rows) == 3, (
+        f"indeterminate per-record writes double-inserted: "
+        f"{len(sink.rows)} rows for 3 executions")
+    agent.stop()
+    store.close()
+
+
 def test_record_flush_final_drop_is_not_silent():
     """stop()'s final flush cannot retry: a still-down sink means the
     batch is dropped — and dropped loudly, not parked behind a 'retry'

@@ -23,6 +23,43 @@ sys.path.insert(0, os.path.join(
 
 
 @pytest.mark.slow
+def test_native_agent_record_drain_not_record_bound():
+    """Result-plane regression gate: BENCH_r05 measured the NATIVE
+    agent's instant-exec drain ceilinged near 0.7k execs/s by one
+    lock-step create_job_log RPC per execution.  With the background
+    record flusher the same sweep must drain >= 2x that per-record
+    baseline, ship the record wire in real batches, and drop nothing —
+    with exec-start lag bounded by the drained backlog, not by the
+    record path."""
+    if (os.cpu_count() or 1) < 6:
+        pytest.skip("needs >= 6 cores for a meaningful drain signal")
+    agentd = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cronsun-agentd")
+    if not os.path.exists(agentd):
+        pytest.skip("native agent binary unavailable")
+    os.environ["BENCH_AGENT"] = "native"
+    try:
+        import bench_dispatch
+        res = bench_dispatch.run_bench(
+            [8000], 1, 3, on_log=lambda *a: print(*a, file=sys.stderr))
+    finally:
+        os.environ.pop("BENCH_AGENT", None)
+    drain = res["dispatch_plane_drain_per_agent_per_sec"]
+    assert drain >= 1400, (
+        f"native agent drained {drain}/s — at/below 2x the 0.7k/s "
+        f"lock-step per-record baseline; the record flusher regressed")
+    assert res.get("dispatch_plane_records_dropped", 0) == 0
+    rpb = res.get("dispatch_plane_logd_records_per_batch")
+    assert rpb is None or rpb > 2, (
+        f"record wire not batched ({rpb} records/bulk-RPC)")
+    # the sweep offers 3s of orders then waits for the drain: exec lag
+    # p99 must stay within the drained-backlog bound, not minutes of
+    # record-path queueing (13.6 s p50 was the r05 symptom)
+    lag99 = res.get("dispatch_plane_exec_lag_p99_s")
+    assert lag99 is None or lag99 < 30, f"exec lag p99 {lag99}s"
+
+
+@pytest.mark.slow
 def test_two_agents_scale_aggregate_drain():
     if (os.cpu_count() or 1) < 6:
         pytest.skip("needs >= 6 cores for a meaningful scaling signal")

@@ -398,15 +398,24 @@ def test_differential_fuzz_python_vs_native():
         for step in range(300):
             op = rng.randrange(8)
             if op <= 2:
-                r = _rec(job=rng.choice(jobs), node=rng.choice(nodes),
-                         ok=rng.random() < 0.7,
-                         begin=1000.0 + rng.randrange(0, 500_000),
-                         name=rs(), output=rs(20), command=rs(12))
+                # single create, or a BULK batch (the coalesced path:
+                # per-day stat folding + last-per-(job, node) latest
+                # upsert must stay byte-identical across backends)
+                n = rng.randrange(2, 5) if rng.random() < 0.4 else 1
+                rrs = [_rec(job=rng.choice(jobs), node=rng.choice(nodes),
+                            ok=rng.random() < 0.7,
+                            begin=1000.0 + rng.randrange(0, 500_000),
+                            name=rs(), output=rs(20), command=rs(12))
+                       for _ in range(n)]
 
                 def create(c):
-                    rec = LogRecord(**{**r.__dict__, "id": None})
-                    c.create_job_log(rec)
-                    return rec.id
+                    recs = [LogRecord(**{**r.__dict__, "id": None})
+                            for r in rrs]
+                    if len(recs) == 1:
+                        c.create_job_log(recs[0])
+                    else:
+                        c.create_job_logs(recs)
+                    return [r.id for r in recs]
                 ia, ib = both(create)
                 assert ia == ib, f"step {step}: assigned ids {ia} != {ib}"
             elif op == 3:
@@ -495,6 +504,70 @@ def test_after_id_cursor(sink):
     # latest view ignores the cursor (its rows carry no id)
     recs, lt = sink.query_logs(latest=True, after_id=10**9)
     assert lt == 3
+
+
+@pytest.mark.parametrize("backend", ["py", "native"])
+def test_create_job_logs_bulk_idempotent_retry(backend):
+    """A retried BULK create (same whole-batch idempotency token — what
+    the agents' record flushers re-send after an indeterminate reply)
+    must not double-insert or double-count: the replay returns the
+    original id list, stats count the batch once, and the latest view
+    is unchanged.  Both server backends."""
+    srv = (LogSinkServer().start() if backend == "py"
+           else _native_server())
+    c = RemoteJobLogStore(srv.host, srv.port)
+    wires = [{"job_id": f"b{i}", "job_group": "g", "name": f"n{i}",
+              "node": "nd", "user": "", "command": "t", "output": "o",
+              "success": i % 2 == 0, "begin_ts": 1000.0 + i,
+              "end_ts": 1001.0 + i, "id": None} for i in range(4)]
+    ids1 = c._call("create_job_logs", wires, "bulk-tok")
+    ids2 = c._call("create_job_logs", wires, "bulk-tok")    # the retry
+    assert ids1 == ids2 and len(ids1) == 4
+    _, total = c.query_logs()
+    assert total == 4, "bulk retry double-inserted"
+    assert c.stat_overall() == {"total": 4, "successed": 2, "failed": 2}
+    _, lt = c.query_logs(latest=True)
+    assert lt == 4
+    ids3 = c._call("create_job_logs", wires, "bulk-tok-2")  # NEW batch
+    assert ids3[0] > ids1[-1]
+    assert c.stat_overall()["total"] == 8
+    c.close()
+    srv.stop()
+
+
+def test_bulk_coalesced_stats_and_latest_lww(sink):
+    """The bulk path coalesces its side writes per batch (one stat
+    bump per day, one latest upsert per (job, node)) — the OBSERVABLE
+    contract stays exactly the sequential one: per-day counters match
+    the records, and within a batch the LAST record per (job, node) in
+    batch order owns the latest row (even when an earlier record has a
+    later begin_ts).  All three backends."""
+    day0, day1 = 1000.0, 90000.0          # 1970-01-01 / 1970-01-02 UTC
+    recs = [
+        _rec(job="jA", node="n1", ok=True, begin=day0),
+        _rec(job="jA", node="n1", ok=False, begin=day1),
+        # LAST (jA, n1) in batch order — wins latest despite the
+        # EARLIER begin_ts than the record above
+        _rec(job="jA", node="n1", ok=True, begin=day0 + 5),
+        _rec(job="jB", node="n2", ok=False, begin=day1 + 5),
+    ]
+    sink.create_job_logs(recs)
+    assert sink.stat_overall() == {"total": 4, "successed": 2,
+                                   "failed": 2}
+    assert sink.stat_day("1970-01-01") == {"total": 2, "successed": 2,
+                                           "failed": 0}
+    assert sink.stat_day("1970-01-02") == {"total": 2, "successed": 0,
+                                           "failed": 2}
+    latest, lt = sink.query_logs(latest=True)
+    assert lt == 2
+    ja = [r for r in latest if r.job_id == "jA"][0]
+    assert ja.begin_ts == day0 + 5 and ja.success, \
+        "latest is not last-in-batch-order"
+    # a LATER batch still overrides (cross-batch ordering unchanged)
+    sink.create_job_logs([_rec(job="jA", node="n1", ok=False,
+                               begin=day0 + 1)])
+    latest, _ = sink.query_logs(job_ids=["jA"], latest=True)
+    assert latest[0].begin_ts == day0 + 1 and not latest[0].success
 
 
 def test_create_job_logs_bulk(sink):

@@ -1140,3 +1140,109 @@ def test_native_agent_consumes_coalesced_bundle(tmp_path):
         direct.close()
     finally:
         _teardown(procs)
+
+
+def test_native_agentd_record_flusher_batches_and_barriers(tmp_path):
+    """agentd's background record flusher: a burst of instant
+    executions lands in the result store through a handful of bulk
+    create_job_logs RPCs (not one lock-step RPC per exec — the
+    BENCH_r05 ~0.7k/s ceiling), stat counters exactly match the
+    executions (no loss, no double-count under the batch-coalesced
+    logd path), and a SIGTERM right after the orders are consumed
+    still lands every buffered record (the stop() flush barrier)."""
+    import pathlib
+    agentd = pathlib.Path(REPO) / "native" / "cronsun-agentd"
+    from cronsun_tpu.store.native import find_binary
+    if find_binary() is None or not agentd.exists():
+        pytest.skip("native binaries unavailable")
+
+    procs = []
+    try:
+        store_p = _spawn("cronsun_tpu.bin.store", "--native", "--port", "0")
+        procs.append(store_p)
+        store_addr = _await_ready(store_p)
+        sh, _, sp = store_addr.rpartition(":")
+        logd_p = _spawn("cronsun_tpu.bin.logd", "--native", "--port", "0",
+                        "--db", str(tmp_path / "logd.wal"))
+        procs.append(logd_p)
+        logd_addr = _await_ready(logd_p)
+        p = subprocess.Popen(
+            [str(agentd), "--store", store_addr, "--logsink", logd_addr,
+             "--node-id", "cxF", "--ttl", "5", "--proc-req", "5",
+             "--instant-exec"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        _await_ready(p)
+
+        from cronsun_tpu.core import Keyspace
+        from cronsun_tpu.logsink import RemoteJobLogStore
+        from cronsun_tpu.store.remote import RemoteStore
+        ks = Keyspace()
+        direct = RemoteStore(sh, int(sp))
+        lh, _, lp = logd_addr.rpartition(":")
+        sink = RemoteJobLogStore(lh, int(lp))
+
+        # N crosses the oversized-bundle chunk boundary (2048): the
+        # bundle fans out as concurrent chunk tasks — every member
+        # still runs exactly once and the reservation key is released
+        N = 3000
+        direct.put_many([
+            (ks.job_key("g", f"fj{i}"), json.dumps({
+                "name": f"fj{i}", "command": "true", "kind": 2,
+                "rules": [{"id": "r", "timer": "* * * * * *",
+                           "nids": ["cxF"]}]}))
+            for i in range(N)])
+        epoch = int(time.time()) - 2        # past: runs immediately
+        bundle = ks.dispatch_bundle_key("cxF", epoch)
+        direct.put(bundle, json.dumps([f"g/fj{i}" for i in range(N)]))
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if sink.stat_overall()["total"] >= N:
+                break
+            time.sleep(0.2)
+        assert sink.stat_overall() == {
+            "total": N, "successed": N, "failed": 0}
+        # the chunked reservation release rides the buffered ack flush
+        # (only after EVERY chunk settled) — poll briefly
+        deadline = time.time() + 10
+        while time.time() < deadline and direct.get(bundle) is not None:
+            time.sleep(0.1)
+        assert direct.get(bundle) is None, "reservation key not released"
+        # a DUPLICATE chunked delivery re-claims and loses every fence
+        direct.put(bundle, json.dumps([f"g/fj{i}" for i in range(N)]))
+        deadline = time.time() + 15
+        while time.time() < deadline and direct.get(bundle) is not None:
+            time.sleep(0.2)
+        assert direct.get(bundle) is None, "duplicate bundle not consumed"
+        time.sleep(1.0)
+        assert sink.stat_overall()["total"] == N, \
+            "duplicate chunked bundle re-ran a member"
+        # batched, not lock-step: the whole burst rode far fewer bulk
+        # RPCs than records (the flusher ships interval-capped batches)
+        stats = sink.op_stats()
+        bulk = stats.get("create_job_logs", {}).get("count", 0)
+        singles = stats.get("create_job_log", {}).get("count", 0)
+        nrecs = stats.get("log_records", {}).get("count", 0)
+        assert nrecs == N and singles == 0, stats
+        assert 0 < bulk <= N // 4, \
+            f"record wire not batched: {bulk} RPCs for {N} records"
+
+        # flush barrier on stop: a second burst, SIGTERM the moment the
+        # order key is consumed — records still in the 50 ms buffer
+        # must land before the process exits
+        epoch2 = int(time.time()) - 1
+        bundle2 = ks.dispatch_bundle_key("cxF", epoch2)
+        direct.put(bundle2, json.dumps([f"g/fj{i}" for i in range(50)]))
+        deadline = time.time() + 15
+        while time.time() < deadline and direct.get(bundle2) is not None:
+            time.sleep(0.02)
+        assert direct.get(bundle2) is None, "second bundle not consumed"
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=15)
+        assert sink.stat_overall()["total"] == N + 50, \
+            f"stop() barrier lost buffered records: {sink.stat_overall()}"
+        sink.close()
+        direct.close()
+    finally:
+        _teardown(procs)
