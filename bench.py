@@ -405,7 +405,9 @@ def main():
     # The whole cycle a real tick pays (watch drain + reconcile + flush +
     # plan + order build + bulk publish) against the native store, plus
     # the failover story: cold load vs warm-standby takeover (VERDICT r3
-    # #3/#4).  Full runs only — at 1M jobs this is minutes.
+    # #3/#4) vs checkpoint-restore warm takeover (failover_warm_* /
+    # sched_checkpoint_* keys, merged below like the rest).  Full runs
+    # only — at 1M jobs this is minutes.
     if not quick:
         log("scheduler system: full step + failover @ 1M jobs")
         try:

@@ -498,6 +498,21 @@ def cmd_metrics(api, args):
     sys.stdout.write(api.call("GET", "/v1/metrics"))
 
 
+def cmd_checkpoint(api, args):
+    """Trigger the checkpoint plane: store WAL snapshot + scheduler
+    state checkpoints (admin)."""
+    out = api.call("POST", "/v1/checkpoint")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    if "store_snapshot_rev" in out:
+        print(f"store snapshot written at revision "
+              f"{out['store_snapshot_rev']} (WAL truncated)")
+    else:
+        print(f"store snapshot: {out.get('store_snapshot')}")
+    print(out.get("scheduler", ""))
+
+
 def cmd_configurations(api, args):
     print(json.dumps(api.call("GET", "/v1/configurations"), indent=2))
 
@@ -632,6 +647,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--old", default=None, help="prompted when omitted")
     p.add_argument("--new", default=None, help="prompted when omitted")
     add("metrics", cmd_metrics, "Prometheus metrics text")
+    add("checkpoint", cmd_checkpoint,
+        "trigger store WAL snapshot + scheduler checkpoints (admin)")
     add("configurations", cmd_configurations,
         "security/alarm config exposed to the UI")
     return ap

@@ -171,12 +171,18 @@ def main(argv=None) -> int:
         planner = sync_proxy = PlannerSyncProxy(planner)
         log.infof("mesh leader: broadcasting plan deltas to %d workers",
                   args.mesh_hosts - 1)
+    # sharded/proxied planners are refused by SchedulerService itself
+    # (it logs why); per-rank shard checkpoints are a ROADMAP follow-on
+    ckpt_dir = os.path.expanduser(cfg.checkpoint_dir) \
+        if cfg.checkpoint_dir else None
     sched = SchedulerService(
         store, ks=ks, job_capacity=cfg.job_capacity,
         node_capacity=cfg.node_capacity, window_s=cfg.window_s,
         default_node_cap=cfg.default_node_cap, node_id=args.node_id,
         dispatch_ttl=cfg.lock_ttl, tz=tz, planner=planner,
-        pipelined=None if cfg.pipelined_step else False)
+        pipelined=None if cfg.pipelined_step else False,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_interval_s=float(cfg.checkpoint_interval))
     sched.start()
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)

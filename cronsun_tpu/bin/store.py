@@ -25,8 +25,15 @@ def main(argv=None) -> int:
     ap.add_argument("--native", action="store_true",
                     help="serve with the native C++ store")
     ap.add_argument("--wal", default=None, metavar="FILE",
-                    help="write-ahead log: state survives restarts "
-                         "(requires --native)")
+                    help="write-ahead log + snapshot sidecar (FILE and "
+                         "FILE.snap): state survives restarts; boot is "
+                         "load-snapshot + replay-tail (both backends)")
+    ap.add_argument("--compact-wal-bytes", type=int, default=-1,
+                    metavar="N",
+                    help="snapshot + truncate the WAL once it exceeds N "
+                         "bytes — bounds restart replay by snapshot "
+                         "cadence (default: backend default, 256 MiB; "
+                         "0 disables size-triggered compaction)")
     ap.add_argument("--token", default=None,
                     help="shared secret clients must present "
                          "(default: conf store_token)")
@@ -35,10 +42,6 @@ def main(argv=None) -> int:
                          "16); more stripes = more concurrent writers "
                          "before lock contention")
     args = ap.parse_args(argv)
-    if args.wal and not args.native:
-        # pure argv check BEFORE setup_common side effects (conf watcher)
-        print("error: --wal requires --native", file=sys.stderr)
-        return 2
     cfg, ks, watcher = setup_common(args)
 
     token = cfg.store_token if args.token is None else args.token
@@ -48,7 +51,9 @@ def main(argv=None) -> int:
         from ..store.native import NativeStoreServer
         srv = NativeStoreServer(host=args.host, port=args.port,
                                 wal=args.wal, token=token,
-                                stripes=args.stripes).start()
+                                stripes=args.stripes,
+                                compact_wal_bytes=args.compact_wal_bytes
+                                ).start()
 
         def child_died(code: int):
             # the wrapper must not sit healthy-looking in front of a dead
@@ -60,7 +65,14 @@ def main(argv=None) -> int:
     else:
         from ..store.memstore import MemStore
         store = MemStore(stripes=args.stripes) if args.stripes > 0 \
-            else None
+            else MemStore()
+        if args.wal:
+            # replay (snapshot + tail) BEFORE serving: no concurrent
+            # clients may observe a half-replayed keyspace
+            kw = {}
+            if args.compact_wal_bytes >= 0:   # 0 = disable, -1 = default
+                kw["compact_bytes"] = args.compact_wal_bytes
+            store.open_wal(args.wal, **kw)
         srv = StoreServer(store=store, host=args.host, port=args.port,
                           token=token, sslctx=sslctx).start()
     log.infof("cronsun-store serving on %s:%d%s", srv.host, srv.port,

@@ -84,6 +84,16 @@ class Config:
                                 # Clients use ca(+cert/key for mutual TLS);
                                 # servers use cert/key(+ca to demand client
                                 # certs).  See cronsun_tpu/tlsutil.py.
+    checkpoint_dir: str = ""    # scheduler checkpoint directory: the
+                                # leader (and warm standbys) persist
+                                # their built state there and a restart
+                                # restores it + replays the watch delta
+                                # instead of cold-loading the store.
+                                # "" disables (cold loads only).
+    checkpoint_interval: int = 0
+                                # seconds between periodic scheduler
+                                # checkpoint saves (0 = only on the
+                                # `cronsun-ctl checkpoint` trigger)
     compile_cache: str = "~/.cache/cronsun-tpu/xla"
                                 # persistent XLA compilation cache: a
                                 # restarted scheduler (or a cold failover

@@ -93,6 +93,30 @@ class Keyspace:
         return f"{self.metrics}{component}/{instance}"
 
     @property
+    def ckpt(self) -> str:       # checkpoint plane control keys
+        return f"{self.prefix}/ckpt/"
+
+    @property
+    def ckpt_req(self) -> str:
+        """Operator checkpoint trigger (``cronsun-ctl checkpoint`` via
+        the web API): schedulers watch the ckpt prefix and save on a
+        PUT here."""
+        return f"{self.ckpt}request"
+
+    @property
+    def ckpt_barrier(self) -> str:
+        """Watch-quiesce barrier: the scheduler writes a nonce here and
+        drains its watches until the nonce arrives, which proves every
+        event at or before the write's revision is applied to its
+        mirrors — the revision a checkpoint is tagged with."""
+        return f"{self.ckpt}barrier"
+
+    def ckpt_done_key(self, node_id: str) -> str:
+        """Per-scheduler checkpoint result (JSON: rev/ms/path) written
+        after an operator-requested save."""
+        return f"{self.ckpt}done/{node_id}"
+
+    @property
     def phase(self) -> str:      # @every phase anchors, survive failover
         return f"{self.prefix}/phase/"
 

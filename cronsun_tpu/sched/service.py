@@ -25,6 +25,7 @@ death the lease expires and a standby takes over within ``lease_ttl``.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -40,7 +41,7 @@ from ..cron.parser import ParseError, parse
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
 from ..ops.schedule_table import make_row, _INACTIVE_ROW
-from ..store.memstore import DELETE, MemStore, WatchLost
+from ..store.memstore import CompactedError, DELETE, MemStore, WatchLost
 
 # ids that serialize into a JSON string verbatim (no escapes needed)
 _WIRE_SAFE = re.compile(r"^[A-Za-z0-9_.:-]*$").match
@@ -117,6 +118,8 @@ class SchedulerService:
                  publish_lanes: int = 0,
                  sync_publish: Optional[bool] = None,
                  pipelined: Optional[bool] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_interval_s: float = 0.0,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -204,7 +207,30 @@ class SchedulerService:
         self._ae_rekick = False
         self._ae_store = None   # lazy clone for background listings
 
-        self._open_watches()
+        # checkpoint plane: periodic/operator-triggered saves of the
+        # BUILT state (see checkpoint_save), restored at construction
+        # when a checkpoint is present — the warm-takeover path.
+        # Sharded/proxied planners are refused HERE (not just in the
+        # launcher): a checkpoint of sharded device state would restore
+        # as plain single-device arrays and silently break the mesh
+        # sharding invariants the collective plan path relies on
+        # (per-rank shard checkpoints are a ROADMAP follow-on).
+        from ..ops.planner import TickPlanner as _PlainPlanner
+        if checkpoint_dir and type(self.planner) is not _PlainPlanner:
+            log.warnf("checkpoint_dir is not supported with %s planners "
+                      "yet; disabling scheduler checkpoints",
+                      type(self.planner).__name__)
+            checkpoint_dir = None
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_interval_s = checkpoint_interval_s
+        self._ckpt_requested = False
+        self._ckpt_barrier_rev = 0   # highest barrier-key mod_rev seen
+        self._ckpt_next_at = (clock() + checkpoint_interval_s
+                              if checkpoint_dir and checkpoint_interval_s
+                              else float("inf"))
+        self._ckpt_stats = {"saves_total": 0, "save_errors_total": 0,
+                            "last_save_ms": 0.0, "last_rev": 0,
+                            "restored": 0, "restore_ms": 0.0}
 
         # async publisher: lanes are extra connections when the store
         # can clone (networked), else the shared store.  The publish
@@ -292,31 +318,64 @@ class SchedulerService:
             store, self.ks, "sched", self.node_id, self.metrics_snapshot,
             interval_s=5.0, clock=clock)
 
-        self._load_initial()
+        # warm path first: restore a checkpoint (built state + watch
+        # delta replay) when one is present; any mismatch falls back to
+        # the cold load, LOUDLY — a checkpoint is an optimization,
+        # never an alternate source of truth
+        restored = False
+        if checkpoint_dir:
+            restored = self._checkpoint_restore()
+        if not restored:
+            self._open_watches()
+            self._load_initial()
 
     @property
     def _alone_pfx(self) -> str:
         return self.ks.alone_lock
 
-    def _open_watches(self):
-        self._w_jobs = self.store.watch(self.ks.cmd)
-        self._w_groups = self.store.watch(self.ks.group)
-        self._w_nodes = self.store.watch(self.ks.node)
-        self._w_procs = self.store.watch(self.ks.proc)
-        # delete-only: the leader WRITES this prefix by the tens of
-        # thousands per window — watching its own puts meant every
-        # publish came straight back as watch pushes to serialize,
-        # ship and re-parse (a measured majority of the r4 publish
-        # span).  Own publishes are mirrored locally at submit time;
-        # consumption/expiry arrives as DELETEs; other-leader writes
-        # are covered by anti-entropy.
-        self._w_orders = self.store.watch(self.ks.dispatch,
-                                          events="delete")
-        self._w_alone = self.store.watch(self._alone_pfx)
+    def _open_watches(self, start_rev: int = 0):
+        """Open every watch; with ``start_rev`` (checkpoint restore),
+        resume each stream from that revision so the deltas since the
+        checkpointed state replay instead of being re-listed — raises
+        CompactedError/WatchLost when the store's bounded history no
+        longer reaches back that far (the caller cold-loads).  A partial
+        failure closes the watches already opened."""
+        opened = []
+
+        def w(prefix, events=""):
+            wx = self.store.watch(prefix, start_rev=start_rev,
+                                  events=events)
+            opened.append(wx)
+            return wx
+        try:
+            self._w_jobs = w(self.ks.cmd)
+            self._w_groups = w(self.ks.group)
+            self._w_nodes = w(self.ks.node)
+            self._w_procs = w(self.ks.proc)
+            # delete-only: the leader WRITES this prefix by the tens of
+            # thousands per window — watching its own puts meant every
+            # publish came straight back as watch pushes to serialize,
+            # ship and re-parse (a measured majority of the r4 publish
+            # span).  Own publishes are mirrored locally at submit time;
+            # consumption/expiry arrives as DELETEs; other-leader writes
+            # are covered by anti-entropy.
+            self._w_orders = w(self.ks.dispatch, events="delete")
+            self._w_alone = w(self._alone_pfx)
+            # checkpoint-plane control keys: operator save requests and
+            # the save barrier nonces
+            self._w_ckpt = w(self.ks.ckpt)
+        except BaseException:
+            for wx in opened:
+                try:
+                    wx.close()
+                except Exception:  # noqa: BLE001 — already dead
+                    pass
+            raise
 
     def _all_watches(self):
         return (self._w_jobs, self._w_groups, self._w_nodes,
-                self._w_procs, self._w_orders, self._w_alone)
+                self._w_procs, self._w_orders, self._w_alone,
+                self._w_ckpt)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -655,6 +714,17 @@ class SchedulerService:
                 self._alone_live.discard(jid)
             else:
                 self._alone_live.add(jid)
+        # checkpoint-plane control: operator save requests + the save
+        # barrier (checkpoint_save proves mirror quiescence by watching
+        # its own nonce come back through this stream)
+        for ev in self._w_ckpt.drain():
+            if ev.type == DELETE:
+                continue
+            if ev.kv.key == self.ks.ckpt_req:
+                self._ckpt_requested = True
+            elif ev.kv.key == self.ks.ckpt_barrier:
+                if ev.kv.mod_rev > self._ckpt_barrier_rev:
+                    self._ckpt_barrier_rev = ev.kv.mod_rev
 
     def _parse_proc(self, key: str) -> Optional[Tuple[str, str, str]]:
         rest = key[len(self.ks.proc):].split("/")
@@ -851,6 +921,362 @@ class SchedulerService:
                                            name="sched-antientropy")
         self._ae_thread.start()
 
+    # ---- checkpoint plane ------------------------------------------------
+
+    @property
+    def checkpoint_restored(self) -> bool:
+        """True when this instance booted from a checkpoint (warm)
+        rather than the cold store load."""
+        return bool(self._ckpt_stats["restored"])
+
+    def _checkpoint_path(self) -> str:
+        from ..checkpoint.sched_ckpt import FILE_NAME
+        if not self.checkpoint_dir:
+            raise RuntimeError("no checkpoint_dir configured")
+        return os.path.join(self.checkpoint_dir, FILE_NAME)
+
+    def _checkpoint_barrier(self, timeout: float = 30.0) -> int:
+        """Quiesce point for a checkpoint: returns a store revision R
+        such that every watch event with mod_rev <= R has been applied
+        to the host mirrors.
+
+        Protocol: write a barrier nonce under the watched ckpt prefix
+        and drain watches until its revision comes back, TWICE.  Watch
+        events reach this process through one connection whose server
+        batches frames per watcher, so a frame carrying the first
+        barrier can overtake an older event's frame within the same
+        send batch — but the second barrier is only written after the
+        first was OBSERVED, i.e. after that whole batch was on the
+        wire; seeing barrier #2 therefore proves every event at or
+        before barrier #1's revision is in the client-side queues, and
+        one final drain applies them.  R is barrier #1's revision."""
+        deadline = time.monotonic() + timeout
+        rev = 0
+        for i in (1, 2):
+            r = self.store.put(self.ks.ckpt_barrier,
+                               f"{self.node_id}/{i}")
+            if i == 1:
+                rev = r
+            while self._ckpt_barrier_rev < r:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"checkpoint barrier timed out after {timeout}s")
+                self._drain_watches_once()
+                if self._ckpt_barrier_rev >= r:
+                    break
+                time.sleep(0.005)
+        self._drain_watches_once()
+        return rev
+
+    def checkpoint_save(self, path: Optional[str] = None) -> dict:
+        """Serialize the BUILT state — packed schedule-table arrays,
+        eligibility masks, row allocator, job metadata, execution-state
+        mirrors — to a versioned on-disk checkpoint keyed by the store
+        revision it reflects, written atomically (temp file + rename).
+        STEP-THREAD (or quiesced-service) only: the mirrors have a
+        single writer and the barrier drains watches inline.
+
+        Accounting for builds still in flight on the pipeline worker
+        lands after their windows complete; a restore therefore may
+        under-count the leader's own most-recent order reservations —
+        the same bounded over-commit a fresh leadership has, healed by
+        the anti-entropy listing the restore kicks immediately."""
+        from ..checkpoint import save_checkpoint
+        if path is None:
+            path = self._checkpoint_path()
+        from ..checkpoint.sched_ckpt import gc_paused
+        t0 = time.perf_counter()
+        rev = self._checkpoint_barrier()
+        with gc_paused():
+            state = self._checkpoint_state(rev)
+            save_checkpoint(path, state)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._ckpt_stats["saves_total"] += 1
+        self._ckpt_stats["last_save_ms"] = round(ms, 3)
+        self._ckpt_stats["last_rev"] = rev
+        log.infof("scheduler checkpoint saved: rev %d, %.0f ms, %s",
+                  rev, ms, path)
+        return {"rev": rev, "ms": ms, "path": path}
+
+    def _checkpoint_state(self, rev: int) -> dict:
+        import dataclasses
+        import jax
+        from ..checkpoint.sched_ckpt import pack_jobs
+        table = self.planner.table
+        return dict(
+            rev=rev, saved_at=time.time(), node_id=self.node_id,
+            prefix=self.ks.prefix, J=self.planner.J, N=self.planner.N,
+            # device state materialized to host numpy: the packed
+            # schedule table (no cron re-parse on restore), eligibility
+            # matrix, job meta.  load/rem_cap are NOT checkpointed —
+            # reconcile_capacity rewrites both absolutely from the
+            # mirrors every leading step.
+            table={f.name: np.asarray(jax.device_get(
+                       getattr(table, f.name)))
+                   for f in dataclasses.fields(table)},
+            elig=np.asarray(jax.device_get(self.planner.elig)),
+            exclusive=np.asarray(jax.device_get(self.planner.exclusive)),
+            cost=np.asarray(jax.device_get(self.planner.cost)),
+            # jobs ride columnar (pack_jobs); the builder's per-row rule
+            # inputs and reverse group index are DERIVED from them at
+            # restore (set_job aliases the rules' own lists, so the
+            # derivation reproduces both the data and the sharing)
+            jobs=pack_jobs(self.jobs), groups=self.groups,
+            node_caps=self.node_caps,
+            rows=dict(by_cmd=self.rows.by_cmd, free=self.rows._free),
+            universe=dict(index=self.universe.index,
+                          free=self.universe._free),
+            builder=dict(group_mask=self.builder.group_mask,
+                         matrix=self.builder.matrix),
+            row_phase=self._row_phase,
+            row_dispatch=self._row_dispatch,
+            rd=dict(flags=self._rd_flags, payload=self._rd_payload,
+                    suffix=self._rd_suffix, bentry=self._rd_bentry,
+                    job=self._rd_job),
+            col_node=self._col_node, col_live=self._col_live,
+            mirrors=dict(procs=self._procs, orders=self._orders,
+                         alone=self._alone_live, excl=self._excl_cnt,
+                         load=self._load_sum),
+        )
+
+    def _checkpoint_restore(self) -> bool:
+        """Warm takeover: load the checkpoint, open every watch at
+        ``rev + 1`` (replaying exactly the delta since the checkpointed
+        state), and install the built state host- and device-side.
+        Any mismatch — missing/torn file, version or shape skew, or a
+        revision that fell out of the store's bounded watch history —
+        falls back to the cold load, LOUDLY.  Validation happens before
+        any state mutates, so a refused checkpoint leaves a clean slate
+        for the cold path.  The whole restore runs with the cyclic GC
+        paused: it allocates ~1M live objects, and the gen-2
+        collections that triggers scan the entire heap for nothing
+        (measured as the majority of the takeover time at 50k jobs)."""
+        from ..checkpoint.sched_ckpt import gc_paused
+        with gc_paused():
+            return self._checkpoint_restore_inner()
+
+    def _checkpoint_restore_inner(self) -> bool:
+        from ..checkpoint import CheckpointError, load_checkpoint
+        import jax.numpy as jnp
+        from ..ops.schedule_table import ScheduleTable
+        path = self._checkpoint_path()
+        t0 = time.perf_counter()
+        try:
+            st = load_checkpoint(path)
+            # every key the install below dereferences, validated HERE:
+            # a version-valid pickle missing a field (hand-edited,
+            # foreign build) must cold-load, not crash-loop the
+            # constructor on a KeyError with the bad file still on disk
+            missing = [k for k in (
+                "rev", "prefix", "J", "N", "table", "elig", "exclusive",
+                "cost", "jobs", "groups", "node_caps", "rows",
+                "universe", "builder", "row_phase", "row_dispatch",
+                "rd", "col_node", "col_live", "mirrors") if k not in st]
+            for outer, subkeys in (
+                    ("rows", ("by_cmd", "free")),
+                    ("universe", ("index", "free")),
+                    ("builder", ("group_mask", "matrix")),
+                    ("rd", ("flags", "payload", "suffix", "bentry",
+                            "job")),
+                    ("mirrors", ("procs", "orders", "alone", "excl",
+                                 "load"))):
+                if not isinstance(st.get(outer), dict):
+                    missing.append(outer)
+                else:
+                    missing += [f"{outer}.{k}" for k in subkeys
+                                if k not in st[outer]]
+            if missing:
+                raise CheckpointError(
+                    f"checkpoint missing fields {missing}")
+            if st.get("prefix") != self.ks.prefix:
+                raise CheckpointError(
+                    f"keyspace prefix {st.get('prefix')!r} != "
+                    f"{self.ks.prefix!r}")
+            if st.get("J") != self.planner.J \
+                    or st.get("N") != self.planner.N:
+                raise CheckpointError(
+                    f"planner shape J={st.get('J')}/N={st.get('N')} != "
+                    f"J={self.planner.J}/N={self.planner.N}")
+            rev = int(st["rev"])
+            try:
+                table = ScheduleTable(**{k: jnp.asarray(v)
+                                         for k, v in st["table"].items()})
+                elig = jnp.asarray(st["elig"])
+                excl = jnp.asarray(st["exclusive"])
+                cost = jnp.asarray(st["cost"])
+            except Exception as e:  # noqa: BLE001 — torn/foreign payload
+                raise CheckpointError(f"device payload malformed: {e}")
+            # the store must be the SAME incarnation the checkpoint was
+            # cut from: a rev-regressed store (wiped/lost WAL, fresh
+            # store) would accept watch(start_rev=rev+1) silently —
+            # past-the-end watches register without error — and the
+            # restored scheduler would dispatch ghost state forever
+            try:
+                store_rev = self.store.rev()
+            except Exception as e:  # noqa: BLE001 — server predates
+                # the rev op: cannot prove incarnation, cold-load
+                raise CheckpointError(
+                    f"store revision unverifiable ({e})")
+            if store_rev < rev:
+                raise CheckpointError(
+                    f"store revision {store_rev} is BEHIND checkpoint "
+                    f"rev {rev} — different store incarnation")
+            # the delta since the checkpoint must still be replayable
+            # from the store's watch history, or the checkpoint is too
+            # stale to be safe — cold load instead
+            try:
+                self._open_watches(start_rev=rev + 1)
+            except (CompactedError, WatchLost) as e:
+                raise CheckpointError(
+                    f"rev {rev} fell out of the store's watch history "
+                    f"({e})")
+        except CheckpointError as e:
+            log.warnf("scheduler checkpoint restore from %s failed: %s "
+                      "— falling back to COLD load", path, e)
+            return False
+        except (KeyError, TypeError, ValueError) as e:
+            # malformed-but-version-valid payload the explicit checks
+            # missed: same contract — cold load, loudly, never a
+            # constructor crash-loop with the bad file still on disk
+            log.warnf("scheduler checkpoint restore from %s failed "
+                      "(malformed payload: %r) — falling back to COLD "
+                      "load", path, e)
+            return False
+        # install host state (plain assignments: nothing here can fail
+        # and leave a half-restored scheduler)
+        from ..checkpoint.sched_ckpt import unpack_jobs
+        st_rows = st["rows"]
+        self.rows.by_cmd = st_rows["by_cmd"]
+        self.rows._free = st_rows["free"]
+        self.rows.by_row = {row: key
+                            for key, row in st_rows["by_cmd"].items()}
+        by_job: Dict[Tuple[str, str], Set[str]] = {}
+        for (g, j, rid), _row in st_rows["by_cmd"].items():
+            by_job.setdefault((g, j), set()).add(rid)
+        self.rows.by_job = by_job
+        self.jobs = unpack_jobs(st["jobs"])
+        self.groups = st["groups"]
+        self.node_caps = st["node_caps"]
+        u = st["universe"]
+        self.universe.index = u["index"]
+        self.universe._free = u["free"]
+        b = st["builder"]
+        self.builder.group_mask = b["group_mask"]
+        self.builder.matrix = b["matrix"]
+        self.builder._dirty = set()
+        # per-row rule inputs + reverse group index, derived from the
+        # restored jobs exactly as _apply_job builds them — including
+        # the ownership-transfer aliasing (the builder's lists ARE the
+        # rules' lists, never copies)
+        job_rules: Dict[int, dict] = {}
+        group_jobs: Dict[str, set] = {}
+        for (g, jid, rid), row in st_rows["by_cmd"].items():
+            job = self.jobs.get((g, jid))
+            rule = None
+            if job is not None:
+                for r in job.rules:
+                    if r.id == rid:
+                        rule = r
+                        break
+            if rule is None:
+                continue
+            job_rules[row] = dict(nids=rule.nids, gids=rule.gids,
+                                  ex=rule.exclude_nids)
+            for gid in rule.gids:
+                group_jobs.setdefault(gid, set()).add(row)
+        self.builder.job_rules = job_rules
+        self.builder.group_jobs = group_jobs
+        self._row_phase = st["row_phase"]
+        self._row_dispatch = st["row_dispatch"]
+        rd = st["rd"]
+        self._rd_flags = rd["flags"]
+        self._rd_payload = rd["payload"]
+        self._rd_suffix = rd["suffix"]
+        self._rd_bentry = rd["bentry"]
+        self._rd_job = rd["job"]
+        self._col_node = st["col_node"]
+        self._col_live = st["col_live"]
+        m = st["mirrors"]
+        self._procs = m["procs"]
+        self._orders = m["orders"]
+        self._alone_live = m["alone"]
+        self._excl_cnt = m["excl"]
+        self._load_sum = m["load"]
+        # device state: table + eligibility + job meta land whole; node
+        # capacities as at a cold load's end (reconcile_capacity
+        # rewrites load/rem_cap from the mirrors every leading step)
+        self.planner.set_table(table)
+        self.planner.elig = elig
+        self.planner.exclusive = excl
+        self.planner.cost = cost
+        if self.universe.index:
+            cols = np.asarray(list(self.universe.index.values()),
+                              np.int32)
+            caps = np.asarray(
+                [self.node_caps.get(n, self.default_node_cap)
+                 for n in self.universe.index], np.int64)
+            cols, caps = self._pad_pow2(cols, caps)
+            self.planner.set_node_capacity(cols, caps)
+        # own-publish reservations between the checkpoint's barrier and
+        # the previous leader's death aren't in the mirrors (the orders
+        # watch is delete-only): kick anti-entropy from post-restore
+        # ground truth immediately — same bounded over-commit window as
+        # any fresh leadership
+        self._mirror_resync_at = 0.0
+        ms = (time.perf_counter() - t0) * 1e3
+        self._ckpt_stats["restored"] = 1
+        self._ckpt_stats["restore_ms"] = round(ms, 3)
+        self._ckpt_stats["last_rev"] = rev
+        log.infof("scheduler checkpoint RESTORED: rev %d, %d jobs, "
+                  "%.0f ms (watch delta replays from rev %d)",
+                  rev, len(self.jobs), ms, rev + 1)
+        return True
+
+    def _maybe_checkpoint(self):
+        """Periodic / operator-requested checkpoint saves (step
+        thread; leaders and warm standbys both run it — every instance
+        with a checkpoint_dir keeps its own restore point fresh)."""
+        due = self.clock() >= self._ckpt_next_at
+        req = self._ckpt_requested
+        if not (due or req):
+            return
+        self._ckpt_requested = False
+        if self.checkpoint_interval_s:
+            self._ckpt_next_at = self.clock() + self.checkpoint_interval_s
+        if not self.checkpoint_dir:
+            if req:
+                log.warnf("checkpoint requested but no checkpoint_dir "
+                          "configured on %s; ignoring", self.node_id)
+            return
+        try:
+            out = self.checkpoint_save()
+            # the save ran inline on the step thread: a leader's lease
+            # got no keepalive for its whole duration — refresh it NOW
+            # rather than a step later, and tell the operator when the
+            # save is eating a dangerous share of the ttl (at that
+            # point the checkpoint cadence belongs on a standby)
+            if self._leader_lease is not None:
+                if not self.store.keepalive(self._leader_lease):
+                    self._leader_lease = None
+            if out["ms"] > self.lease_ttl * 500:    # ms vs s: ttl/2
+                log.warnf("checkpoint save took %.0f ms — more than "
+                          "half of lease_ttl (%.0fs); run the "
+                          "checkpoint cadence on a standby or raise "
+                          "the ttl", out["ms"], self.lease_ttl)
+            if req:
+                # ack the operator trigger so `cronsun-ctl checkpoint`
+                # has something observable beyond the metrics gauges
+                self.store.put(
+                    self.ks.ckpt_done_key(self.node_id),
+                    json.dumps({"rev": out["rev"],
+                                "ms": round(out["ms"], 1),
+                                "path": out["path"]},
+                               separators=(",", ":")))
+        except Exception as e:  # noqa: BLE001 — a failed save must
+            # never take down the scheduler loop
+            self._ckpt_stats["save_errors_total"] += 1
+            log.errorf("scheduler checkpoint save failed: %s", e)
+
     @staticmethod
     def _pad_pow2(rows: np.ndarray, *arrays):
         """Pad a scatter batch to the next power-of-two length by
@@ -1009,6 +1435,7 @@ class SchedulerService:
         n_done = self._drain_build_acct()
         self._drain_replan_reqs()
         self._maybe_antientropy_bg()
+        self._maybe_checkpoint()
         led_before = self.is_leader
         if not self.try_lead():
             self._next_epoch = None
@@ -1713,6 +2140,15 @@ class SchedulerService:
             "publish_max_second_keys": self.publisher.max_second_keys,
             "publish_max_second_node_keys": self.max_second_node_keys,
             "publish_max_second_excl_fires": self.max_second_excl_fires,
+            # checkpoint plane: save cadence health + whether this
+            # instance booted warm (restored=1) and how fast
+            "checkpoint_saves_total": self._ckpt_stats["saves_total"],
+            "checkpoint_save_errors_total":
+                self._ckpt_stats["save_errors_total"],
+            "checkpoint_last_save_ms": self._ckpt_stats["last_save_ms"],
+            "checkpoint_last_rev": self._ckpt_stats["last_rev"],
+            "checkpoint_restored": self._ckpt_stats["restored"],
+            "checkpoint_restore_ms": self._ckpt_stats["restore_ms"],
         }
 
     def _advance_hwm(self, value: int):

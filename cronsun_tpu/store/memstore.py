@@ -243,6 +243,12 @@ class MemStore:
         # store's op_stats (metrics.OpStats).
         from ..metrics import OpStats
         self._ops = OpStats()
+        # optional persistence (checkpoint plane): WAL + snapshot
+        # sidecar, same record format as the native stored.cc — see
+        # open_wal / snapshot
+        self._wal = None
+        self._replaying = False
+        self._wal_compact_bytes = 0
 
     # ---- striped locking -------------------------------------------------
 
@@ -296,6 +302,22 @@ class MemStore:
         def run():
             while not self._stop.wait(interval):
                 self._expire_leases()
+                wal = self._wal
+                if wal is not None:
+                    # fdatasync rides the sweep cadence (the native
+                    # server's contract); size-triggered compaction
+                    # keeps the WAL — and therefore the next boot's
+                    # replay — bounded by snapshot cadence, not history
+                    wal.sync()
+                    if self._wal_compact_bytes and \
+                            wal.size() > self._wal_compact_bytes:
+                        try:
+                            self.snapshot()
+                        except Exception as e:  # noqa: BLE001 — retry
+                            import sys      # at the next sweep; a full
+                            print(f"wal compaction failed: {e}",  # disk
+                                  file=sys.stderr)  # must not kill the
+                                                    # sweeper
         self._sweeper = threading.Thread(target=run, daemon=True,
                                          name="memstore-sweeper")
         self._sweeper.start()
@@ -305,6 +327,136 @@ class MemStore:
         with self._ev_lock:
             for w in list(self._watchers):
                 w.close()
+        if self._wal is not None:
+            self._wal.sync()
+            self._wal.close()
+
+    # ---- persistence (checkpoint plane) ----------------------------------
+
+    def open_wal(self, path: str, sync_per_commit: bool = False,
+                 compact_bytes: int = 256 << 20) -> "MemStore":
+        """Attach a WAL + snapshot pair at ``path`` / ``path + ".snap"``
+        (native stored.cc record format): replay the snapshot, replay
+        the WAL tail through the normal mutation paths, then write a
+        fresh snapshot and truncate the WAL — boot cost is bounded by
+        snapshot cadence, not total history.  Must run before the store
+        serves clients (no concurrent mutations during replay)."""
+        from ..checkpoint.walsnap import WalFile, read_records, snap_path
+        if self._wal is not None:
+            raise RuntimeError("wal already open")
+        self._replaying = True
+        try:
+            t0 = time.perf_counter_ns()
+            for rec in read_records(snap_path(path)):
+                self._replay_record(rec)
+            self._op_record("snapshot_load", t0)
+            t0 = time.perf_counter_ns()
+            for rec in read_records(path):
+                self._replay_record(rec)
+            self._op_record("wal_replay", t0)
+        finally:
+            self._replaying = False
+        self._wal = WalFile(path, sync_per_commit)
+        self._wal_compact_bytes = compact_bytes
+        self.snapshot()
+        return self
+
+    def snapshot(self) -> int:
+        """Write a consistent point-in-time image of the striped
+        keyspace + lease table (tagged with its revision) to the
+        snapshot sidecar — temp file + atomic rename — then truncate
+        the WAL to entries after it (none: the locks order appends).
+        Returns the snapshot's revision.  Mutations stall for the write
+        duration; the operator-facing cost shows as the ``snapshot``
+        op in op_stats."""
+        if self._wal is None:
+            raise RuntimeError("snapshot: no WAL configured "
+                               "(open_wal first)")
+        from ..checkpoint.walsnap import write_snapshot
+        with self._locked(all_stripes=True), self._lease_lock, \
+                self._ev_lock:
+            t0 = time.perf_counter_ns()
+            write_snapshot(self._wal.path, self._snapshot_lines())
+            self._wal.truncate()
+            rev = self._rev
+            self._op_record("snapshot", t0)
+            return rev
+
+    def rev(self) -> int:
+        """Current store revision — the checkpoint plane tags scheduler
+        checkpoints with it so a restore can replay exactly the watch
+        delta since the checkpointed state."""
+        with self._ev_lock:
+            return self._rev
+
+    def _snapshot_lines(self):
+        """Caller holds every stripe lock + lease + event locks."""
+        yield ["v", self._rev, self._next_lease]
+        now_c, now_w = self._clock(), time.time()
+        for lid, l in self._leases.items():
+            # deadlines persist as WALL-clock instants (the store clock
+            # is monotonic and does not survive the process)
+            yield ["g", lid, l.ttl, now_w + (l.deadline - now_c)]
+        for s in self._stripes:
+            for k, kv in s.kv.items():
+                yield ["s", k, kv.value, kv.create_rev, kv.mod_rev,
+                       kv.lease]
+
+    def _replay_record(self, rec: list):
+        """Apply one snapshot/WAL record (boot only: no clients yet)."""
+        op = rec[0]
+        if op == "p" and len(rec) >= 4:
+            key, value, lease = rec[1], rec[2], int(rec[3] or 0)
+            with self._lease_lock:
+                if lease and lease not in self._leases:
+                    # the lease expired+vanished during downtime; a
+                    # recreate-then-expire is indistinguishable — drop
+                    return
+            with self._locked([key]):
+                self._put_locked(key, value, lease)
+        elif op == "d" and len(rec) >= 2:
+            with self._locked([rec[1]]):
+                self._delete_locked(rec[1])
+        elif op == "g" and len(rec) >= 4:
+            lid, ttl, wall_deadline = int(rec[1]), float(rec[2]), \
+                float(rec[3])
+            with self._lease_lock:
+                self._leases[lid] = Lease(
+                    lid, ttl, self._clock() + (wall_deadline - time.time()))
+                if lid >= self._next_lease:
+                    self._next_lease = lid + 1
+        elif op == "k" and len(rec) >= 3:
+            with self._lease_lock:
+                l = self._leases.get(int(rec[1]))
+                if l is not None:
+                    l.deadline = self._clock() + (float(rec[2])
+                                                  - time.time())
+        elif op == "x" and len(rec) >= 2:
+            # full revoke semantics: delete attached keys too — closes
+            # the crash window between a flushed "x" and its "d"s
+            lid = int(rec[1])
+            with self._lease_lock:
+                l = self._leases.pop(lid, None)
+            if l is not None:
+                self._delete_keys(sorted(l.keys), only_lease=lid)
+        elif op == "v" and len(rec) >= 3:
+            self._rev = int(rec[1])
+            self._next_lease = int(rec[2])
+        elif op == "s" and len(rec) >= 6:
+            key, value = rec[1], rec[2]
+            kv = KV(key, value, int(rec[3]), int(rec[4]), int(rec[5]))
+            if kv.lease:
+                with self._lease_lock:
+                    l = self._leases.get(kv.lease)
+                    if l is None:
+                        # the key's lease is gone (snapshot raced a
+                        # revoke/expiry between the lease pop and the
+                        # key deletes): the key was doomed — keeping it
+                        # would resurrect it PERMANENTLY, attached to a
+                        # lease that can never expire it
+                        return
+                    l.keys.add(key)
+            self._stripes[self._sidx(key)].kv[key] = kv
 
     # ---- KV --------------------------------------------------------------
 
@@ -388,6 +540,8 @@ class MemStore:
             kv = KV(key, value, prev.create_rev if prev else self._rev,
                     self._rev, lease)
             kvmap[key] = kv
+            if self._wal is not None and not self._replaying:
+                self._wal.append(["p", key, value, lease])
             self._notify(Event(PUT, kv, prev))
             return self._rev
 
@@ -460,6 +614,8 @@ class MemStore:
         with self._ev_lock:
             self._rev += 1
             tomb = KV(key, "", prev.create_rev, self._rev, 0)
+            if self._wal is not None and not self._replaying:
+                self._wal.append(["d", key])
             self._notify(Event(DELETE, tomb, prev))
         return True
 
@@ -711,6 +867,8 @@ class MemStore:
             lid = self._next_lease
             self._next_lease += 1
             self._leases[lid] = Lease(lid, ttl, self._clock() + ttl)
+            if self._wal is not None and not self._replaying:
+                self._wal.append(["g", lid, ttl, time.time() + ttl])
             return lid
 
     def keepalive(self, lease_id: int) -> bool:
@@ -721,11 +879,18 @@ class MemStore:
             if l is None or l.deadline <= self._clock():
                 return False
             l.deadline = self._clock() + l.ttl
+            if self._wal is not None and not self._replaying:
+                self._wal.append(["k", lease_id, time.time() + l.ttl])
             return True
 
     def revoke(self, lease_id: int) -> bool:
         with self._lease_lock:
             l = self._leases.pop(lease_id, None)
+            # lease removal logs as "x" (replay deletes attached keys
+            # itself); the deletions below log their own "d" records
+            if l is not None and self._wal is not None \
+                    and not self._replaying:
+                self._wal.append(["x", lease_id])
         if l is None:
             return False
         self._delete_keys(sorted(l.keys), only_lease=lease_id)
@@ -747,6 +912,8 @@ class MemStore:
                        if l.deadline <= now]
             for l in expired:
                 del self._leases[l.id]
+                if self._wal is not None and not self._replaying:
+                    self._wal.append(["x", l.id])
         # key deletion happens OUTSIDE the lease lock through the normal
         # striped path (lock order: stripes before lease) — a doomed
         # key's events and attachments behave exactly as a delete would

@@ -27,7 +27,7 @@ class NativeStoreServer(NativeProcess):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  binary: Optional[str] = None, history: int = 65536,
                  wal: Optional[str] = None, token: str = "",
-                 stripes: int = 0,
+                 stripes: int = 0, compact_wal_bytes: int = -1,
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         binary = binary or find_binary()
@@ -42,5 +42,9 @@ class NativeStoreServer(NativeProcess):
             argv += ["--stripes", str(stripes)]
         if wal:
             argv += ["--wal", wal]
+        if compact_wal_bytes >= 0:
+            # size-triggered WAL compaction threshold (checkpoint
+            # plane); 0 disables it, negative keeps the server default
+            argv += ["--compact-wal-bytes", str(compact_wal_bytes)]
         super().__init__(binary, argv, token=token,
                          ready_timeout=ready_timeout)

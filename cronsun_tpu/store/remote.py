@@ -82,7 +82,8 @@ _OPS = ("put", "put_many", "get", "get_many", "get_prefix",
         "get_prefix_page", "count_prefix", "delete",
         "delete_prefix", "delete_many", "put_if_absent", "put_if_mod_rev",
         "claim", "claim_many", "claim_bundle", "claim_bundle_many",
-        "grant", "keepalive", "revoke", "lease_ttl_remaining", "op_stats")
+        "grant", "keepalive", "revoke", "lease_ttl_remaining", "op_stats",
+        "snapshot", "rev")
 
 
 class _Conn(LineJsonHandler):
@@ -593,6 +594,17 @@ class RemoteStore:
     def op_stats(self) -> dict:
         """Server-side per-op timing snapshot (memstore.op_stats)."""
         return self._call("op_stats")
+
+    def snapshot(self) -> int:
+        """Checkpoint plane: write a consistent point-in-time snapshot
+        of the server's keyspace + lease table and truncate its WAL
+        (memstore.snapshot / stored.cc snapshot).  Returns the
+        snapshot's revision; errors if the server runs without a WAL."""
+        return self._call("snapshot")
+
+    def rev(self) -> int:
+        """Current store revision (memstore.rev)."""
+        return self._call("rev")
 
     # -- leases ------------------------------------------------------------
 

@@ -132,6 +132,7 @@ class ApiServer:
         route("DELETE", r"/v1/node/group/(?P<id>[^/]+)", self.group_delete)
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
+        route("POST", r"/v1/checkpoint", self.checkpoint, admin=True)
         # unauthenticated like /v1/version: Prometheus scrapers don't
         # hold sessions, and the surface carries only operational gauges
         route("GET", r"/v1/metrics", self.metrics, auth=False)
@@ -473,6 +474,31 @@ class ApiServer:
             },
             "alarm": bool(self.alarm),
         }
+
+    # ---- handlers: checkpoint plane --------------------------------------
+
+    def checkpoint(self, ctx):
+        """Operator checkpoint trigger (``cronsun-ctl checkpoint``):
+        snapshot the coordination store's WAL (when the backing server
+        persists) and ask every scheduler to save its state checkpoint
+        — they watch the ckpt prefix and ack under ``ckpt/done/<id>``;
+        save health is also visible as ``cronsun_sched_checkpoint_*``
+        gauges at ``/v1/metrics``."""
+        import time as _time
+        out = {}
+        snap = getattr(self.store, "snapshot", None)
+        if snap is None:
+            out["store_snapshot"] = "unsupported by this store client"
+        else:
+            try:
+                out["store_snapshot_rev"] = snap()
+            except Exception as e:  # noqa: BLE001 — store without a WAL
+                out["store_snapshot"] = f"unavailable: {e}"
+        self.store.put(self.ks.ckpt_req, str(int(_time.time() * 1000)))
+        out["scheduler"] = ("checkpoint requested; acks land under "
+                            f"{self.ks.ckpt}done/, save health at "
+                            "/v1/metrics (cronsun_sched_checkpoint_*)")
+        return out
 
     # ---- handlers: metrics ----------------------------------------------
 

@@ -41,6 +41,7 @@
 #include <signal.h>
 #include <sys/prctl.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -122,13 +123,17 @@ static void ev_wire(std::string& out, const Ev& e) {
 struct KeyErr { std::string msg; };
 struct CompactedErr { std::string msg; };
 
-// Write-ahead log: every mutation appends one JSON-array line; boot
-// replays the file through the normal mutation paths (with logging
-// suppressed) and then rewrites it as a compacted snapshot.  Appends are
-// flushed to the OS immediately; by default fdatasync rides the sweeper
-// cadence, so mutations are acknowledged BEFORE they are durable and the
-// window of acknowledged-but-lost writes on power loss / OS crash is one
-// sweep interval.  (This is weaker than etcd, which fsyncs before
+// Write-ahead log (checkpoint plane): every mutation appends one
+// JSON-array line; the full state lives in a SNAPSHOT sidecar at
+// `path + ".snap"`, atomically replaced (temp file + rename), so boot
+// is load-snapshot + replay-tail instead of replay-everything and a
+// live `snapshot` op (or the sweeper's size trigger) truncates the WAL
+// to entries after the snapshot — replay time is bounded by snapshot
+// cadence, not total history.  Appends are flushed to the OS
+// immediately; by default fdatasync rides the sweeper cadence, so
+// mutations are acknowledged BEFORE they are durable and the window of
+// acknowledged-but-lost writes on power loss / OS crash is one sweep
+// interval.  (This is weaker than etcd, which fsyncs before
 // acknowledging.)  --fsync-per-commit closes the window: every append
 // fdatasyncs before the ack, for deployments where e.g. put_if_absent
 // lock acquisitions must survive a host crash.
@@ -159,6 +164,25 @@ class Wal {
   void sync() {
     std::lock_guard<std::mutex> g(mu_);
     if (f_) fdatasync(fileno(f_));
+  }
+  // Drop every logged record (a just-written snapshot covers them).
+  // The caller holds the locks that order appends, so no mutation can
+  // land between the snapshot and the truncation.  Fail-stop like
+  // append: a snapshot that "succeeded" over an untruncatable WAL
+  // would replay stale records over future snapshots forever.
+  void truncate() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_) return;
+    if (fflush(f_) != 0 || ftruncate(fileno(f_), 0) != 0) {
+      fprintf(stderr, "FATAL: wal truncate failed: %s\n", strerror(errno));
+      abort();
+    }
+  }
+  long long size() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_) return 0;
+    struct stat st;
+    return fstat(fileno(f_), &st) == 0 ? (long long)st.st_size : 0;
   }
   void close_file() {
     std::lock_guard<std::mutex> g(mu_);
@@ -695,116 +719,72 @@ class Store {
     if (wal_) wal_->sync();
   }
 
-  // Open the WAL: replay an existing file through the normal mutation
-  // paths, rewrite it as a compacted snapshot (full state + exact revs,
-  // no history), then append mutations from here on.  The in-RAM event
-  // ring starts empty after a boot, so a watcher resuming from a
-  // pre-restart revision gets CompactedError and re-lists — exactly
-  // etcd's compaction contract.
+  // Open the WAL: replay the snapshot sidecar (`path + ".snap"`), then
+  // the WAL tail, both through the normal mutation paths; then write a
+  // fresh snapshot and truncate the WAL (boot compaction — the next
+  // boot's replay is bounded by snapshot cadence, not history).  A
+  // pre-sidecar WAL (old layout: compacted state + appended mutations
+  // in one file) replays unchanged — replay_line handles "v"/"s"
+  // records — and migrates to the sidecar layout on this first boot.
+  // The in-RAM event ring starts empty after a boot, so a watcher
+  // resuming from a pre-restart revision gets CompactedError and
+  // re-lists — exactly etcd's compaction contract.
   bool open_wal(const std::string& path, std::string& err,
                 bool sync_per_commit = false) {
     // boot-time only: no concurrent clients exist yet (the listener
     // starts after open_wal returns), so no stripe locks are needed
     // beyond the ones replay's mutation helpers take themselves
+    wal_path_ = path;
     replaying_ = true;
-    FILE* f = fopen(path.c_str(), "r");
-    if (f) {
-      char* lineptr = nullptr;   // getline grows it: records have no
-      size_t cap = 0;            // length limit (values can be large)
-      ssize_t n;
-      bool bad = false;
-      std::string line;
-      while ((n = getline(&lineptr, &cap, f)) != -1) {
-        line.assign(lineptr, (size_t)n);
-        while (!line.empty() &&
-               (line.back() == '\n' || line.back() == '\r'))
-          line.pop_back();
-        if (!line.empty() && !replay_line(line)) {
-          bad = true;   // torn final record (crash mid-append) is fine;
-          break;        // a bad record with more after it is corruption
-        }
-      }
-      if (bad && getline(&lineptr, &cap, f) != -1) {
-        err = "corrupt wal record: " + line.substr(0, 200);
-        free(lineptr);
-        fclose(f);
-        replaying_ = false;
-        return false;
-      }
-      free(lineptr);
-      fclose(f);
+    long long t0 = mono_ns();
+    bool ok = replay_file(path + ".snap", err);
+    op_record("snapshot_load", t0);
+    if (ok) {
+      t0 = mono_ns();
+      ok = replay_file(path, err);
+      op_record("wal_replay", t0);
     }
     replaying_ = false;
+    if (!ok) return false;
 
-    // compacted snapshot -> temp file -> atomic rename.  Lines stream
-    // one at a time and every write is CHECKED — an ENOSPC mid-snapshot
-    // must abort before the rename, not silently truncate the only
-    // copy of the state.
-    std::string tmp = path + ".tmp";
-    FILE* out = fopen(tmp.c_str(), "w");
-    if (!out) {
-      err = "cannot write " + tmp;
-      return false;
-    }
-    std::string rec;
-    bool wok = true;
-    auto emit = [&]() {
-      rec += '\n';
-      wok = wok && fwrite(rec.data(), 1, rec.size(), out) == rec.size();
-      rec.clear();
-    };
-    rec = "[\"v\",";
-    jint(rec, rev_);
-    rec += ',';
-    jint(rec, next_lease_);
-    rec += ']';
-    emit();
-    double steady = now(), wall = wall_now();
-    for (const auto& [lid, l] : leases_) {
-      rec += "[\"g\",";
-      jint(rec, lid);
-      rec += ',';
-      jdbl(rec, l.ttl);
-      rec += ',';
-      jdbl(rec, wall + (l.deadline - steady));
-      rec += ']';
-      emit();
-    }
-    for (const Stripe& st : stripes_) {
-      for (const auto& [key, kv] : st.kv) {
-        rec += "[\"s\",";
-        jesc(rec, key);
-        rec += ',';
-        jesc(rec, kv.value);
-        rec += ',';
-        jint(rec, kv.create_rev);
-        rec += ',';
-        jint(rec, kv.mod_rev);
-        rec += ',';
-        jint(rec, kv.lease);
-        rec += ']';
-        emit();
-      }
-    }
-    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
-    fclose(out);
-    if (!wok) {
-      remove(tmp.c_str());
-      err = "snapshot write to " + tmp + " failed: " + strerror(errno);
-      return false;
-    }
-    if (rename(tmp.c_str(), path.c_str()) != 0) {
-      err = "rename failed for " + tmp;
-      return false;
-    }
+    if (!write_snapshot(err)) return false;
     wal_ = &wal_storage_;
     if (!wal_->open_append(path, sync_per_commit)) {
       err = "cannot append to " + path;
       wal_ = nullptr;
       return false;
     }
+    // the WAL's records are now covered by the fresh snapshot
+    wal_->truncate();
     return true;
   }
+
+  // Live snapshot op: write a consistent point-in-time image of the
+  // striped keyspace + lease table (tagged with its revision via the
+  // "v" record) to the sidecar, then truncate the WAL.  Holds every
+  // stripe + the lease table + the event plane, so no mutation — and
+  // no WAL append — can interleave between image and truncation.
+  // Returns the snapshot's revision.
+  long long snapshot() {
+    if (!wal_) throw std::runtime_error("snapshot: no WAL configured");
+    StripeLock g(*this, all_idxs());
+    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+    std::lock_guard<std::mutex> sg(sync_mu_);
+    long long t0 = mono_ns();
+    std::string err;
+    if (!write_snapshot(err)) throw std::runtime_error(err);
+    wal_->truncate();
+    op_record("snapshot", t0);
+    return rev_;
+  }
+
+  long long rev() {
+    std::lock_guard<std::mutex> sg(sync_mu_);
+    return rev_;
+  }
+
+  long long wal_size() { return wal_ ? wal_->size() : 0; }
+  bool has_wal() const { return wal_ != nullptr; }
 
   // watch: registers the sink and (with start_rev) replays retained
   // events — registration AND replay delivery happen under every stripe
@@ -1024,6 +1004,108 @@ class Store {
 
   void notify_locked(Ev ev);
 
+  // replay one snapshot/WAL file through the normal mutation paths.
+  // A torn FINAL record (crash mid-append) is tolerated; a bad record
+  // with more after it is corruption.  A missing file is fine (fresh
+  // store / pre-sidecar layout).
+  bool replay_file(const std::string& path, std::string& err) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return true;
+    char* lineptr = nullptr;   // getline grows it: records have no
+    size_t cap = 0;            // length limit (values can be large)
+    ssize_t n;
+    bool bad = false;
+    std::string line;
+    while ((n = getline(&lineptr, &cap, f)) != -1) {
+      line.assign(lineptr, (size_t)n);
+      while (!line.empty() &&
+             (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      if (!line.empty() && !replay_line(line)) {
+        bad = true;   // torn final record (crash mid-append) is fine;
+        break;        // a bad record with more after it is corruption
+      }
+    }
+    if (bad && getline(&lineptr, &cap, f) != -1) {
+      err = "corrupt record in " + path + ": " + line.substr(0, 200);
+      free(lineptr);
+      fclose(f);
+      return false;
+    }
+    free(lineptr);
+    fclose(f);
+    return true;
+  }
+
+  // full-state snapshot -> `.snap.tmp` -> atomic rename over `.snap`.
+  // Lines stream one at a time and every write is CHECKED — an ENOSPC
+  // mid-snapshot must abort before the rename, not silently truncate
+  // the only copy of the state (the torn temp file is ignored at
+  // boot).  Caller holds whatever locks freeze the state (none at
+  // boot; everything in the live snapshot() op).
+  bool write_snapshot(std::string& err) {
+    std::string snap = wal_path_ + ".snap";
+    std::string tmp = snap + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "w");
+    if (!out) {
+      err = "cannot write " + tmp;
+      return false;
+    }
+    std::string rec;
+    bool wok = true;
+    auto emit = [&]() {
+      rec += '\n';
+      wok = wok && fwrite(rec.data(), 1, rec.size(), out) == rec.size();
+      rec.clear();
+    };
+    rec = "[\"v\",";
+    jint(rec, rev_);
+    rec += ',';
+    jint(rec, next_lease_);
+    rec += ']';
+    emit();
+    double steady = now(), wall = wall_now();
+    for (const auto& [lid, l] : leases_) {
+      rec += "[\"g\",";
+      jint(rec, lid);
+      rec += ',';
+      jdbl(rec, l.ttl);
+      rec += ',';
+      jdbl(rec, wall + (l.deadline - steady));
+      rec += ']';
+      emit();
+    }
+    for (const Stripe& st : stripes_) {
+      for (const auto& [key, kv] : st.kv) {
+        rec += "[\"s\",";
+        jesc(rec, key);
+        rec += ',';
+        jesc(rec, kv.value);
+        rec += ',';
+        jint(rec, kv.create_rev);
+        rec += ',';
+        jint(rec, kv.mod_rev);
+        rec += ',';
+        jint(rec, kv.lease);
+        rec += ']';
+        emit();
+      }
+    }
+    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
+    fclose(out);
+    if (!wok) {
+      remove(tmp.c_str());
+      err = "snapshot write to " + tmp + " failed: " +
+            std::string(strerror(errno));
+      return false;
+    }
+    if (rename(tmp.c_str(), snap.c_str()) != 0) {
+      err = "rename failed for " + tmp;
+      return false;
+    }
+    return true;
+  }
+
   // replay one WAL record; false on parse failure
   bool replay_line(const std::string& line) {
     JParser jp(line);
@@ -1079,11 +1161,15 @@ class Store {
     } else if (op == "s") {
       if (v.arr.size() < 6) return false;
       KVRec rec{s(2), inum(3), inum(4), inum(5)};
-      stripes_[sidx(s(1))].kv[s(1)] = rec;
       if (rec.lease) {
         auto it = leases_.find(rec.lease);
-        if (it != leases_.end()) it->second.keys.insert(s(1));
+        // lease gone (snapshot raced a revoke/expiry between the lease
+        // pop and the key deletes): the key was doomed — keeping it
+        // would resurrect it permanently under an inexpirable lease
+        if (it == leases_.end()) return true;
+        it->second.keys.insert(s(1));
       }
+      stripes_[sidx(s(1))].kv[s(1)] = rec;
     } else {
       return false;
     }
@@ -1108,6 +1194,7 @@ class Store {
   size_t history_cap_;
   Wal wal_storage_;
   Wal* wal_ = nullptr;
+  std::string wal_path_;
   bool replaying_ = false;
   std::atomic<bool> has_sweeper_{false};
 };
@@ -1424,6 +1511,10 @@ static void handle_request(std::shared_ptr<Conn> c, const std::string& line) {
                                   res);
     } else if (op == "op_stats") {
       op_stats_json(res);
+    } else if (op == "snapshot") {
+      jint(res, c->store->snapshot());
+    } else if (op == "rev") {
+      jint(res, c->store->rev());
     } else if (op == "put_if_absent") {
       res = c->store->put_if_absent(arg_s(args, 0), arg_s(args, 1), arg_i(args, 2)) ? "true" : "false";
     } else if (op == "put_if_mod_rev") {
@@ -1511,6 +1602,7 @@ int main(int argc, char** argv) {
   size_t history = 65536;
   size_t stripes = Store::kDefaultStripes;
   double sweep_s = 0.2;
+  long long compact_wal_bytes = 256ll << 20;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -1521,6 +1613,7 @@ int main(int argc, char** argv) {
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--wal") wal_path = next();
     else if (a == "--fsync-per-commit") fsync_per_commit = true;
+    else if (a == "--compact-wal-bytes") compact_wal_bytes = atoll(next());
     else if (a == "--token") g_token = next();
     else if (a == "--token-file") {
       // keeps the secret out of /proc/<pid>/cmdline
@@ -1548,6 +1641,7 @@ int main(int argc, char** argv) {
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
              "[--stripes N] [--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
+             "[--compact-wal-bytes N] "
              "[--token T | --token-file F] [--die-with-parent]\n");
       return 0;
     }
@@ -1585,10 +1679,20 @@ int main(int argc, char** argv) {
   printf("READY %s:%d\n", host.c_str(), (int)ntohs(addr.sin_port));
   fflush(stdout);
   store.set_has_sweeper();   // write paths leave lease expiry to it
-  std::thread([&] {
+  std::thread([&, compact_wal_bytes] {
     while (true) {
       std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));
       store.sweep();
+      // size-triggered WAL compaction: restart replay stays bounded by
+      // snapshot cadence, not total history (0 disables)
+      if (compact_wal_bytes > 0 && store.has_wal() &&
+          store.wal_size() > compact_wal_bytes) {
+        try {
+          store.snapshot();
+        } catch (const std::exception& e) {  // full disk: retry next
+          fprintf(stderr, "wal compaction failed: %s\n", e.what());
+        }
+      }
     }
   }).detach();
 

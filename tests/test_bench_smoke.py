@@ -60,6 +60,34 @@ def test_native_agent_record_drain_not_record_bound():
 
 
 @pytest.mark.slow
+def test_warm_takeover_beats_cold_load_at_scale():
+    """Checkpoint-plane gate at the CPU-host scale (50k jobs x 512
+    nodes): a standby restoring a scheduler checkpoint must take over
+    >= 5x faster than the full cold load, restore for real (not fall
+    back cold), and dispatch a first window byte-identical to the
+    cold-loaded scheduler's — zero divergence."""
+    if (os.cpu_count() or 1) < 6:
+        pytest.skip("needs >= 6 cores for a meaningful takeover signal")
+    import bench_sched
+    res = bench_sched.run_bench(
+        50_000, 512, steps=3,
+        on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res.get("failover_warm_restored") == 1, (
+        "warm takeover fell back to a cold load: "
+        f"{res.get('failover_warm_restored')}")
+    cold = res["failover_cold_load_s"]
+    warm = res["failover_warm_takeover_s"]
+    assert warm * 5 <= cold, (
+        f"warm takeover {warm}s is not >= 5x faster than the cold "
+        f"load {cold}s")
+    assert res.get("failover_warm_divergence_orders") == 0, (
+        f"restored scheduler diverged on "
+        f"{res.get('failover_warm_divergence_orders')} of "
+        f"{res.get('failover_warm_window_orders')} first-window orders")
+    assert res.get("failover_warm_window_orders", 0) > 0
+
+
+@pytest.mark.slow
 def test_two_agents_scale_aggregate_drain():
     if (os.cpu_count() or 1) < 6:
         pytest.skip("needs >= 6 cores for a meaningful scaling signal")

@@ -1,0 +1,578 @@
+"""Checkpoint plane: store snapshots + WAL compaction (both backends)
+and scheduler state checkpoints (save / warm restore / delta replay /
+loud cold fallback).
+
+The crash matrix the store tests pin (temp-file + rename atomicity):
+
+- kill mid-snapshot: a torn ``.snap.tmp`` is left behind — boot must
+  recover from the PREVIOUS snapshot + the full (untruncated) WAL;
+- crash after the rename but before the WAL truncation: the new
+  snapshot replays first, then the stale WAL re-applies a prefix of the
+  history it already contains — last-write-wins records must converge
+  to the exact pre-crash state;
+- WAL truncation: restart replay is bounded by snapshot cadence, not
+  total history.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.store.memstore import MemStore
+from cronsun_tpu.store.native import NativeStoreServer, find_binary
+from cronsun_tpu.store.remote import RemoteStore
+
+
+# ---------------------------------------------------------------------------
+# store snapshots + WAL (Python backend: deterministic crash injection)
+# ---------------------------------------------------------------------------
+
+def _seed(s):
+    r1 = s.put("/jobs/a", "v1")
+    s.put("/jobs/a", "v2")
+    s.put("/jobs/b", "x")
+    s.delete("/jobs/b")
+    lease = s.grant(30)
+    s.put("/leased", "l", lease=lease)
+    for i in range(50):
+        s.put("/hot", f"val-{i}")
+    return r1, lease
+
+
+def test_memstore_snapshot_truncates_and_restores(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal)
+    r1, lease = _seed(s)
+    assert s._wal.size() > 0
+    rev = s.snapshot()
+    assert rev == s.rev()
+    # the WAL is truncated: replay after a restart is the snapshot +
+    # the post-snapshot tail only
+    assert s._wal.size() == 0
+    s.put("/post", "tail")
+    tail = s._wal.size()
+    assert 0 < tail < 80      # exactly one record
+    s.close()
+
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/jobs/a").value == "v2"
+    assert s2.get("/jobs/a").create_rev == r1
+    assert s2.get("/jobs/b") is None
+    assert s2.get("/hot").value == "val-49"
+    assert s2.get("/post").value == "tail"
+    assert s2.keepalive(lease)           # lease survived with its ttl
+    assert s2.rev() >= rev + 1
+    ops = s2.op_stats()
+    assert ops["snapshot_load"]["count"] == 1
+    assert ops["wal_replay"]["count"] == 1
+    s2.close()
+
+
+def test_memstore_boot_recovers_from_torn_snapshot_tmp(tmp_path):
+    """Kill mid-snapshot: the torn ``.snap.tmp`` must be ignored and
+    boot recover from the previous snapshot + the full WAL."""
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal)
+    _seed(s)
+    s.close()
+    # simulate a crash mid-snapshot-write: garbage temp file alongside
+    # the real artifacts
+    with open(wal + ".snap.tmp", "w") as f:
+        f.write('["v",99999')          # torn, not even valid JSON
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/jobs/a").value == "v2"
+    assert s2.get("/hot").value == "val-49"
+    s2.close()
+
+
+def test_memstore_boot_converges_after_crash_before_truncate(tmp_path):
+    """Crash after the snapshot rename but before the WAL truncation:
+    the stale WAL re-applies a prefix of the history the snapshot
+    already contains; last-write-wins replay must converge to the
+    exact pre-crash KV state."""
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal)
+    _seed(s)
+    # preserve the pre-snapshot WAL, snapshot (which truncates), then
+    # put the old WAL back — exactly the rename-then-crash artifact set
+    shutil.copy(wal, wal + ".pre")
+    s.snapshot()
+    # the store object keeps appending to the (now truncated) file; we
+    # model the crash by abandoning it entirely
+    s._wal.close()
+    s._wal = None
+    s.close()
+    os.replace(wal + ".pre", wal)
+
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/jobs/a").value == "v2"
+    assert s2.get("/jobs/b") is None
+    assert s2.get("/hot").value == "val-49"
+    assert s2.get("/leased") is not None
+    s2.close()
+
+
+def test_memstore_corrupt_wal_mid_file_refuses_boot(tmp_path):
+    """A torn FINAL record is a tolerated crash artifact; a bad record
+    with more records after it is corruption and must refuse to boot,
+    not silently drop history."""
+    from cronsun_tpu.checkpoint.walsnap import SnapshotCorrupt
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal)
+    s.put("/a", "1")
+    s.put("/b", "2")
+    s.close()
+    lines = open(wal).read().splitlines()
+    assert len(lines) >= 2
+    lines[0] = '["p", "torn'
+    with open(wal, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(SnapshotCorrupt):
+        MemStore().open_wal(wal)
+    # torn FINAL record: tolerated
+    s2 = MemStore().open_wal(str(tmp_path / "w2.wal"))
+    s2.put("/a", "1")
+    s2.close()
+    with open(str(tmp_path / "w2.wal"), "a") as f:
+        f.write('["p","/x"')
+    s3 = MemStore().open_wal(str(tmp_path / "w2.wal"))
+    assert s3.get("/a").value == "1"
+    s3.close()
+
+
+def test_snapshot_drops_keys_of_vanished_leases(tmp_path):
+    """A snapshot can race a revoke/expiry between the lease pop and
+    the attached-key deletes: the image then carries keys with a
+    dangling lease id and no lease record.  Replay must DROP them —
+    keeping them would resurrect doomed keys permanently, attached to
+    a lease that can never expire them (e.g. a dead node's lock key
+    becoming a phantom lock)."""
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal)
+    l = s.grant(30)
+    s.put("/doomed", "x", lease=l)
+    s.put("/keep", "y")
+    # simulate the raced artifact: lease popped (its "x" truncated away
+    # with the WAL), key deletes not yet run when the image was taken
+    with s._lease_lock:
+        del s._leases[l]
+    s.snapshot()
+    s.close()
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/doomed") is None, "revoked-lease key resurrected"
+    assert s2.get("/keep").value == "y"
+    s2.close()
+
+
+def test_memstore_sweeper_compacts_oversized_wal(tmp_path):
+    """Size-triggered compaction: the sweeper snapshots once the WAL
+    exceeds the bound, keeping restart replay bounded by cadence."""
+    wal = str(tmp_path / "store.wal")
+    s = MemStore().open_wal(wal, compact_bytes=2048)
+    s.start_sweeper(interval=0.05)
+    for i in range(300):
+        s.put("/hot", f"value-{i}")
+    deadline = time.time() + 5
+    while time.time() < deadline and s._wal.size() > 2048:
+        time.sleep(0.05)
+    assert s._wal.size() <= 2048, "sweeper never compacted the WAL"
+    assert s.op_stats()["snapshot"]["count"] >= 2   # boot + sweeper
+    s.close()
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/hot").value == "value-299"
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# store snapshots + WAL (native backend, over the wire)
+# ---------------------------------------------------------------------------
+
+def _native(tmp_path, **kw):
+    binary = find_binary()
+    if binary is None:
+        pytest.skip("native store binary unavailable")
+    return NativeStoreServer(binary=binary, wal=str(tmp_path / "store.wal"),
+                             **kw)
+
+
+def test_native_snapshot_op_truncates_wal_and_survives_kill9(tmp_path):
+    """The live snapshot op: WAL truncated to entries after the tagged
+    revision; a kill -9 later restores snapshot + tail exactly —
+    restart replay is bounded by snapshot cadence, not total history."""
+    wal = str(tmp_path / "store.wal")
+    srv = _native(tmp_path)
+    s = RemoteStore(srv.host, srv.port, reconnect=False)
+    r1, lease = _seed(s)
+    assert os.path.getsize(wal) > 0
+    rev = s.snapshot()
+    assert rev == s.rev()
+    assert os.path.getsize(wal) == 0          # truncated
+    assert os.path.getsize(wal + ".snap") > 0
+    s.put("/post", "tail")
+    time.sleep(0.3)                           # sync rides the sweeper
+    tail_size = os.path.getsize(wal)
+    assert 0 < tail_size < 80                 # ONLY the post-snapshot op
+    s.close()
+    srv._proc.kill()
+    srv._proc.wait()
+
+    srv2 = _native(tmp_path)
+    try:
+        s2 = RemoteStore(srv2.host, srv2.port, reconnect=False)
+        assert s2.get("/jobs/a").value == "v2"
+        assert s2.get("/jobs/a").create_rev == r1
+        assert s2.get("/jobs/b") is None
+        assert s2.get("/hot").value == "val-49"
+        assert s2.get("/post").value == "tail"
+        assert s2.keepalive(lease)
+        ops = s2.op_stats()
+        assert ops["snapshot_load"]["count"] == 1
+        assert ops["wal_replay"]["count"] == 1
+        s2.close()
+    finally:
+        srv2.stop()
+
+
+def test_native_boot_recovers_from_torn_snapshot_tmp(tmp_path):
+    """Native mid-snapshot crash artifact: torn .snap.tmp is ignored,
+    boot recovers from the previous snapshot + full WAL."""
+    wal = str(tmp_path / "store.wal")
+    srv = _native(tmp_path)
+    s = RemoteStore(srv.host, srv.port, reconnect=False)
+    _seed(s)
+    s.snapshot()
+    s.put("/post", "tail")
+    time.sleep(0.3)
+    s.close()
+    srv._proc.kill()
+    srv._proc.wait()
+    with open(wal + ".snap.tmp", "w") as f:
+        f.write('["v",42')                    # torn temp from the crash
+    srv2 = _native(tmp_path)
+    try:
+        s2 = RemoteStore(srv2.host, srv2.port, reconnect=False)
+        assert s2.get("/jobs/a").value == "v2"
+        assert s2.get("/hot").value == "val-49"
+        assert s2.get("/post").value == "tail"
+        s2.close()
+    finally:
+        srv2.stop()
+
+
+def test_native_compaction_loop_bounds_wal(tmp_path):
+    """--compact-wal-bytes: the server snapshots by itself once the WAL
+    exceeds the bound."""
+    wal = str(tmp_path / "store.wal")
+    srv = _native(tmp_path, compact_wal_bytes=2048)
+    try:
+        s = RemoteStore(srv.host, srv.port, reconnect=False)
+        for i in range(300):
+            s.put("/hot", f"value-{i}")
+        deadline = time.time() + 5
+        while time.time() < deadline and os.path.getsize(wal) > 2048:
+            time.sleep(0.05)
+        assert os.path.getsize(wal) <= 2048, \
+            "server never compacted the WAL"
+        assert s.op_stats()["snapshot"]["count"] >= 1
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_snapshot_refused_without_wal():
+    """Both surfaces refuse a snapshot with no WAL configured (loud
+    error, not a silent no-op)."""
+    from cronsun_tpu.store.remote import RemoteStoreError, StoreServer
+    s = MemStore()
+    with pytest.raises(RuntimeError):
+        s.snapshot()
+    srv = StoreServer().start()
+    c = RemoteStore(srv.host, srv.port, reconnect=False)
+    with pytest.raises(RemoteStoreError):
+        c.snapshot()
+    assert c.rev() >= 0
+    c.close()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler checkpoints
+# ---------------------------------------------------------------------------
+
+def _seed_sched(store, ks, n_jobs=64, n_nodes=8):
+    for i in range(n_nodes):
+        store.put(ks.node_key(f"n{i}"), "1")
+    store.put(ks.group_key("g0"), json.dumps(
+        {"id": "g0", "name": "g0",
+         "nids": [f"n{i}" for i in range(max(1, n_nodes // 2))]}))
+    for i in range(n_jobs):
+        kind = [0, 2, 1][i % 3]
+        rule = {"id": "r", "timer": f"@every {10 + i % 50}s"}
+        if i % 4:
+            rule["nids"] = [f"n{i % n_nodes}"]
+        else:
+            rule["gids"] = ["g0"]
+        store.put(f"{ks.cmd}g/j{i}", json.dumps(
+            {"name": f"j{i}", "command": "true", "kind": kind,
+             "rules": [rule]}))
+
+
+def _make_sched(store, ks, node_id, **kw):
+    from cronsun_tpu.sched import SchedulerService
+    return SchedulerService(store, ks=ks, job_capacity=512,
+                            node_capacity=32, node_id=node_id, **kw)
+
+
+def _window_orders(svc, ep, window=2):
+    """Plan a fixed window and build its orders — the dispatch plan a
+    leader would publish, without leading."""
+    secs, acct = [], []
+    n = 0
+    for p in svc.planner.plan_window(ep, window):
+        n += svc._build_plan_orders(p, secs, acct)
+    return n, sorted((e, k, v) for e, orders in secs for k, v in orders)
+
+
+@pytest.fixture
+def sched_world(tmp_path):
+    ks = Keyspace()
+    store = MemStore()
+    _seed_sched(store, ks)
+    svcs = []
+    yield store, ks, str(tmp_path), svcs
+    for s in svcs:
+        s.stop()
+
+
+def _fire_set(ks, orders):
+    """Placement-independent view of a built window: broadcast orders
+    byte-for-byte, exclusive fires as the multiset of (epoch, job)
+    bundle entries (WHICH node a group-placed job lands on legitimately
+    depends on row-allocation order, which a fresh cold load permutes)."""
+    bcast, excl = [], []
+    for ep, key, val in orders:
+        if key.startswith(ks.dispatch_all):
+            bcast.append((ep, key, val))
+        else:
+            excl += [(ep, e) for e in json.loads(val)]
+    return sorted(bcast), sorted(excl)
+
+
+def test_sched_checkpoint_roundtrip_identical_dispatch(sched_world):
+    """The restore contract, both halves: (1) a restored standby that
+    replayed the delta is BIT-IDENTICAL to the live scheduler it
+    checkpointed — same row allocation, same mirrors, byte-identical
+    dispatch orders for the next window; (2) against a fresh cold load
+    of the current store it fires the exact same (epoch, job) set
+    (placement of group-placed jobs may permute with row order).  The
+    delta replayed between checkpoint and takeover covers a job added,
+    a job deleted, a node added, and proc + alone-lock mirror entries."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    out = a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    assert out["rev"] > 0
+
+    # the delta between checkpoint and takeover
+    store.put(f"{ks.cmd}g/extra", json.dumps(
+        {"name": "extra", "command": "true", "kind": 2,
+         "rules": [{"id": "r", "timer": "@every 10s", "nids": ["n1"]}]}))
+    store.delete(f"{ks.cmd}g/j5")
+    store.put(ks.node_key("n8"), "1")
+    lease = store.grant(60)
+    store.put(ks.proc_key("n1", "g", "j1", 1234), "x", lease=lease)
+    store.put(ks.alone_lock_key("j2"), "n0", lease=lease)
+
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_restored
+    b.drain_watches()                 # apply the replayed delta
+    b._flush_device()
+    # A is live on the same store: apply the SAME delta to it — B
+    # restored A's allocator state and replays the same sequence, so
+    # the two must now be byte-identical
+    a.drain_watches()
+    a._flush_device()
+
+    assert b.jobs.keys() == a.jobs.keys()
+    assert ("g", "extra") in b.jobs and ("g", "j5") not in b.jobs
+    assert b.universe.index == a.universe.index
+    assert b.rows.by_cmd == a.rows.by_cmd
+    assert b._procs == a._procs
+    assert b._alone_live == a._alone_live
+    assert b._excl_cnt == a._excl_cnt
+
+    ep = (int(time.time()) // 60 + 2) * 60
+    nb, ob = _window_orders(b, ep)
+    na, oa = _window_orders(a, ep)
+    assert nb == na
+    assert ob == oa                   # byte-identical orders
+    assert len(ob) > 0                # the window actually dispatches
+
+    # half (2): a fresh cold load fires the same (epoch, job) set
+    c = _make_sched(store, ks, "C")
+    svcs.append(c)
+    assert b.jobs.keys() == c.jobs.keys()
+    assert b._procs == c._procs
+    nc, oc = _window_orders(c, ep)
+    assert nb == nc
+    assert _fire_set(ks, ob) == _fire_set(ks, oc)
+
+
+def test_sched_checkpoint_restore_is_warm_on_metrics(sched_world):
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    snap = a.metrics_snapshot()
+    assert snap["checkpoint_saves_total"] == 1
+    assert snap["checkpoint_last_rev"] > 0
+    assert snap["checkpoint_restored"] == 0
+
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    snap = b.metrics_snapshot()
+    assert snap["checkpoint_restored"] == 1
+    assert snap["checkpoint_restore_ms"] > 0
+
+
+def test_sched_checkpoint_too_stale_falls_back_cold(sched_world):
+    """A checkpoint whose revision fell out of the store's bounded
+    watch history must cold-load (loudly), never restore a state whose
+    delta is unreplayable."""
+    ks = Keyspace()
+    store = MemStore(history=64)
+    _seed_sched(store, ks)
+    _, _, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    for i in range(500):              # blow past the 64-event ring
+        store.put("/junk", str(i))
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 64          # cold load still produced a leader
+
+
+def test_sched_checkpoint_shape_mismatch_falls_back_cold(sched_world):
+    from cronsun_tpu.sched import SchedulerService
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    b = SchedulerService(store, ks=ks, job_capacity=1024,
+                         node_capacity=32, node_id="B",
+                         checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 64
+
+
+def test_sched_checkpoint_rev_regressed_store_falls_back_cold(sched_world):
+    """A store whose revision is BEHIND the checkpoint's rev is a
+    DIFFERENT incarnation (wiped/lost WAL): past-the-end watches
+    register silently, so without the explicit rev guard the scheduler
+    would boot warm against ghost state and never resync."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    fresh = MemStore()              # the "restarted without WAL" store
+    _seed_sched(fresh, ks, n_jobs=8)
+    assert fresh.rev() < a.metrics_snapshot()["checkpoint_last_rev"]
+    b = _make_sched(fresh, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 8         # cold load of the REAL store
+
+
+def test_sched_checkpoint_refused_on_non_plain_planner(sched_world, capsys):
+    """checkpoint_dir with a sharded/proxied planner must be refused at
+    construction (not just in the launcher): restoring single-device
+    arrays onto a mesh planner would break its sharding invariants."""
+    from cronsun_tpu.ops.planner import TickPlanner
+
+    class NotPlain(TickPlanner):
+        pass
+
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d,
+                    planner=NotPlain(job_capacity=512, node_capacity=32))
+    svcs.append(a)
+    assert a.checkpoint_dir is None
+    store.put(ks.ckpt_req, "1")
+    a.step()                        # request must be a no-op, not a save
+    assert not os.path.exists(os.path.join(d, "sched.ckpt"))
+
+
+def test_sched_checkpoint_missing_or_torn_falls_back_cold(sched_world):
+    store, ks, d, svcs = sched_world
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)   # no file at all
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 64
+    with open(os.path.join(d, "sched.ckpt"), "wb") as f:
+        f.write(b"\x80\x04 torn pickle")
+    c = _make_sched(store, ks, "C", checkpoint_dir=d)
+    svcs.append(c)
+    assert not c.checkpoint_restored
+    assert len(c.jobs) == 64
+
+
+def test_sched_checkpoint_missing_field_falls_back_cold(sched_world):
+    """A version-valid checkpoint missing an expected field (foreign
+    build, hand-edited file) must cold-load LOUDLY — never crash-loop
+    the constructor on a KeyError with the bad file still on disk."""
+    import pickle
+    from cronsun_tpu.checkpoint.sched_ckpt import FORMAT_VERSION
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A")
+    svcs.append(a)
+    a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+    st = pickle.load(open(os.path.join(d, "sched.ckpt"), "rb"))
+    assert st["version"] == FORMAT_VERSION
+    del st["mirrors"]
+    st["rows"].pop("by_cmd")
+    with open(os.path.join(d, "sched.ckpt"), "wb") as f:
+        pickle.dump(st, f)
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 64
+
+
+def test_sched_checkpoint_request_key_triggers_save(sched_world):
+    """The operator trigger: a PUT on the ckpt request key (what the
+    web /v1/checkpoint endpoint writes) makes the scheduler save and
+    ack under ckpt/done/<node_id>."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    store.put(ks.ckpt_req, "42")
+    a.step()                          # drain + _maybe_checkpoint
+    assert os.path.exists(os.path.join(d, "sched.ckpt"))
+    done = store.get(ks.ckpt_done_key("A"))
+    assert done is not None
+    ack = json.loads(done.value)
+    assert ack["rev"] > 0
+    assert a.metrics_snapshot()["checkpoint_saves_total"] == 1
+
+
+def test_sched_periodic_checkpoint(sched_world):
+    store, ks, d, svcs = sched_world
+    clock = [1000.0]
+    a = _make_sched(store, ks, "A", checkpoint_dir=d,
+                    checkpoint_interval_s=30.0,
+                    clock=lambda: clock[0])
+    svcs.append(a)
+    a.step()
+    assert not os.path.exists(os.path.join(d, "sched.ckpt"))
+    clock[0] += 31.0
+    a.step()
+    assert os.path.exists(os.path.join(d, "sched.ckpt"))
+    assert a.metrics_snapshot()["checkpoint_saves_total"] == 1
