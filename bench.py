@@ -400,6 +400,35 @@ def main():
                 detail["dispatch_plane_native_error"] = proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
             detail["dispatch_plane_native_error"] = str(e)
+    # the shard-count ladder: one past-saturation rate at a fixed
+    # agent count across 1/2/4 store shards — the horizontal-scaling
+    # claim (ORDER drain past the one-PROCESS store ceiling) measured
+    # in the same artifact.  Native agents drive (Python agents
+    # saturate on the interpreter first); the store side is
+    # BENCH_STORE=py, one bin.store process per shard: the GIL-bound
+    # backend is the one whose single-process ceiling sits below the
+    # fleet's drive capacity on one host, so its curve shows the
+    # partitioning win (the native server is internally striped and
+    # multithreaded — its shard win is per-machine).  Own error scope
+    # like the native sweep.
+    if not quick:
+        log("dispatch plane: store shard ladder 1/2/4")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_dispatch.py"),
+                 "--rates", "150000", "--seconds", "3", "--agents", "8",
+                 "--shard-ladder", "1,2,4"],
+                capture_output=True, text=True, timeout=1800, cwd=here,
+                env={**os.environ, "BENCH_AGENT": "native",
+                     "BENCH_STORE": "py"})
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["dispatch_plane_shard_ladder_error"] = \
+                    proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["dispatch_plane_shard_ladder_error"] = str(e)
 
     # ---- scheduler system: full step() + failover at c5 scale --------------
     # The whole cycle a real tick pays (watch drain + reconcile + flush +

@@ -8,7 +8,6 @@ from typing import Optional, Tuple
 from .. import events, log
 from ..conf import Config, ConfigWatcher, parse as parse_conf
 from ..core import Keyspace
-from ..store.remote import RemoteStore
 
 
 def base_parser(doc: str, store_required: bool = True) -> argparse.ArgumentParser:
@@ -74,8 +73,15 @@ def server_tls(tls, native: bool, daemon: str):
 
 
 def connect_store(addr: str, token: str = "", tls=None,
-                  timeout: float = 120.0) -> RemoteStore:
+                  timeout: float = 120.0, prefix: str = "/cronsun"):
     """``tls`` is the conf ``store_tls`` section (tlsutil.Tls) or None.
+
+    ``addr`` may be a comma-separated SHARD SET ("h1:7070,h2:7070,…"):
+    more than one address returns a routing ShardedStore (same client
+    surface, keyspace partitioned by the deterministic token hash —
+    store/sharded.py); one address returns the plain RemoteStore after
+    the read-only shard-map pin check (a stale single-store config
+    pointed at one shard of a sharded layout refuses at startup).
 
     The default RPC timeout is generous because bulk operations scale
     with fleet size: a scheduler cold-loading 1M jobs lists the whole
@@ -83,11 +89,15 @@ def connect_store(addr: str, token: str = "", tls=None,
     on a 1-core store host, which timed out the old 10 s default
     mid-boot)."""
     from ..tlsutil import client_context
-    host, _, port = addr.rpartition(":")
     sslctx = client_context(tls) if tls is not None else None
-    return RemoteStore(host or "127.0.0.1", int(port), token=token,
-                       timeout=timeout, sslctx=sslctx,
-                       tls_hostname=tls.hostname if tls else "")
+    addrs = [a.strip() for a in addr.split(",") if a.strip()]
+    if not addrs:
+        raise ValueError(
+            f"store address {addr!r} has no host:port entries")
+    from ..store.sharded import connect_sharded
+    return connect_sharded(addrs, prefix=prefix, timeout=timeout,
+                           token=token, sslctx=sslctx,
+                           tls_hostname=tls.hostname if tls else "")
 
 
 def make_sink(cfg: Config, log_addr: Optional[str] = None):

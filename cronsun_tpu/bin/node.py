@@ -20,7 +20,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     cfg, ks, watcher = setup_common(args)
 
-    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
+    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls,
+                          prefix=cfg.prefix)
     sink = make_sink(cfg, args.logsink)
     fatal: list = []
 

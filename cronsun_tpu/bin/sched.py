@@ -164,7 +164,8 @@ def main(argv=None) -> int:
         steps = run_worker(planner)
         log.infof("mesh worker released after %d plan steps", steps)
         return 0
-    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls)
+    store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls,
+                          prefix=cfg.prefix)
     sync_proxy = None
     if args.mesh_hosts > 1:
         from ..parallel.hostsync import PlannerSyncProxy

@@ -41,44 +41,88 @@ def main(argv=None) -> int:
                     help="keyspace lock stripes (0 = backend default, "
                          "16); more stripes = more concurrent writers "
                          "before lock contention")
+    ap.add_argument("--shards", type=int, default=1, metavar="N",
+                    help="serve a SHARD SET: N store servers on ports "
+                         "port..port+N-1, each with its own WAL "
+                         "(FILE.s<i>) — clients connect with the "
+                         "comma-joined address list and route by the "
+                         "deterministic key hash (store/sharded.py)")
     args = ap.parse_args(argv)
+    if args.shards < 1:
+        ap.error(f"--shards must be >= 1 (got {args.shards})")
     cfg, ks, watcher = setup_common(args)
 
     token = cfg.store_token if args.token is None else args.token
     sslctx = server_tls(cfg.store_tls, args.native, "cronsun-store")
+    return _serve_shard_set(args, token, sslctx, watcher)
+
+
+def _serve_shard_set(args, token, sslctx, watcher) -> int:
+    """One supervising process, N shard servers on consecutive ports
+    (N=1 is the ordinary single store on args.port with the plain FILE
+    WAL name).  Each shard is an ordinary store server with its own WAL
+    + snapshot sidecar (FILE.s<i>); the partitioning lives entirely in
+    the clients' routing hash, so a shard set can equally be launched
+    as N independent ``cronsun-store`` processes across machines (the
+    production layout — docs/OPERATIONS.md)."""
     rc = [0]
+    servers = []
+
+    def shard_wal(i):
+        if not args.wal:
+            return None
+        # N=1 keeps the plain FILE name (and its existing snapshot
+        # sidecar from a pre-shard deployment)
+        return args.wal if args.shards == 1 else f"{args.wal}.s{i}"
+
+    def shard_port(i):
+        # --port 0 = ephemeral: every shard picks its own free port
+        # (0+i would try to bind fixed low ports); the READY line
+        # carries the actual bound addresses either way
+        return args.port + i if args.port else 0
+
     if args.native:
         from ..store.native import NativeStoreServer
-        srv = NativeStoreServer(host=args.host, port=args.port,
-                                wal=args.wal, token=token,
-                                stripes=args.stripes,
-                                compact_wal_bytes=args.compact_wal_bytes
-                                ).start()
 
         def child_died(code: int):
             # the wrapper must not sit healthy-looking in front of a dead
-            # store — exit so process supervision restarts the pair
+            # store — exit so process supervision restarts the set
             log.errorf("native store exited rc=%d; shutting down", code)
             rc[0] = code if code > 0 else 1   # signal deaths -> plain 1
             events.shutdown()
-        srv.monitor(child_died)
+        for i in range(args.shards):
+            srv = NativeStoreServer(host=args.host, port=shard_port(i),
+                                    wal=shard_wal(i), token=token,
+                                    stripes=args.stripes,
+                                    compact_wal_bytes=args.compact_wal_bytes
+                                    ).start()
+            srv.monitor(child_died)
+            servers.append(srv)
     else:
         from ..store.memstore import MemStore
-        store = MemStore(stripes=args.stripes) if args.stripes > 0 \
-            else MemStore()
-        if args.wal:
-            # replay (snapshot + tail) BEFORE serving: no concurrent
-            # clients may observe a half-replayed keyspace
-            kw = {}
-            if args.compact_wal_bytes >= 0:   # 0 = disable, -1 = default
-                kw["compact_bytes"] = args.compact_wal_bytes
-            store.open_wal(args.wal, **kw)
-        srv = StoreServer(store=store, host=args.host, port=args.port,
-                          token=token, sslctx=sslctx).start()
-    log.infof("cronsun-store serving on %s:%d%s", srv.host, srv.port,
-              " (tls)" if sslctx is not None else "")
-    print(f"READY {srv.host}:{srv.port}", flush=True)
-    events.on(events.EXIT, srv.stop)
+        for i in range(args.shards):
+            store = MemStore(stripes=args.stripes) if args.stripes > 0 \
+                else MemStore()
+            if args.wal:
+                # replay (snapshot + tail) BEFORE serving: no concurrent
+                # clients may observe a half-replayed keyspace
+                kw = {}
+                if args.compact_wal_bytes >= 0:   # 0 = disable, -1 = default
+                    kw["compact_bytes"] = args.compact_wal_bytes
+                store.open_wal(shard_wal(i), **kw)
+            servers.append(StoreServer(store=store, host=args.host,
+                                       port=shard_port(i), token=token,
+                                       sslctx=sslctx).start())
+    addrs = ",".join(f"{s.host}:{s.port}" for s in servers)
+    if args.shards == 1:
+        log.infof("cronsun-store serving on %s%s", addrs,
+                  " (tls)" if sslctx is not None else "")
+    else:
+        log.infof("cronsun-store serving %d shards on %s%s", args.shards,
+                  addrs, " (tls)" if sslctx is not None else "")
+    print(f"READY {addrs}", flush=True)
+    for s in servers:
+        events.on(events.EXIT, s.stop)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()

@@ -86,6 +86,12 @@ class Keyspace:
         return f"{self.prefix}/hwm"
 
     @property
+    def shardmap(self) -> str:
+        """Shard-topology pin (store/sharded.py): lives on shard 0 by
+        fiat; clients verify their configured shard count against it."""
+        return f"{self.prefix}/shardmap"
+
+    @property
     def metrics(self) -> str:    # leased per-process metric snapshots
         return f"{self.prefix}/metrics/"
 

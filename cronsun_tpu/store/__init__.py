@@ -21,3 +21,5 @@ that architecture but behind a small interface:
 from .memstore import (CompactedError, Event, KV, Lease,  # noqa: F401
                        MemStore, WatchLost, Watcher)
 from .remote import RemoteStore, StoreServer  # noqa: F401
+from .sharded import (ShardedStore, ShardedWatcher,  # noqa: F401
+                      connect_sharded, shard_index, shard_token)

@@ -542,25 +542,48 @@ class ApiServer:
         # logd itself as (or rules it out as) the exec-lag ceiling.
         for backend, prefix in ((self.store, "store"),
                                 (self.sink, "logsink")):
-            op_stats = getattr(backend, "op_stats", None)
-            if op_stats is None:
-                continue
-            try:
-                stats = op_stats()
-            except Exception:  # noqa: BLE001 — older server
-                stats = {}
-            if not stats:
-                continue
+            # sharded store clients expose per-SHARD stats; with more
+            # than one shard each series carries a ``shard`` label so
+            # cronsun_store_op_* series from different shards don't
+            # collide.  Single-shard output is byte-identical to the
+            # unlabeled form below.
+            labeled = None    # [(shard label or None, stats dict), ...]
+            oss = getattr(backend, "op_stats_shards", None)
+            if oss is not None:
+                try:
+                    parts = oss()
+                    if len(parts) > 1:
+                        labeled = list(enumerate(parts))
+                    elif parts and parts[0]:
+                        # one shard: unlabeled form, without re-fetching
+                        # the same stats through op_stats() below
+                        labeled = [(None, parts[0])]
+                except Exception:  # noqa: BLE001 — degraded shard set
+                    labeled = None
+            if labeled is None:
+                op_stats = getattr(backend, "op_stats", None)
+                if op_stats is None:
+                    continue
+                try:
+                    stats = op_stats()
+                except Exception:  # noqa: BLE001 — older server
+                    stats = {}
+                if not stats:
+                    continue
+                labeled = [(None, stats)]
             for field, kind in (("count", "counter"),
                                 ("total_ms", "counter"),
                                 ("max_ms", "gauge")):
                 name = f"cronsun_{prefix}_op_{field}"
                 lines.append(f"# TYPE {name} {kind}")
-                for op, ent in sorted(stats.items()):
-                    if field not in ent:
-                        continue
-                    o = op.replace('\\', r'\\').replace('"', r'\"')
-                    lines.append(f'{name}{{op="{o}"}} {ent[field]}')
+                for si, stats in labeled:
+                    shard = "" if si is None else f',shard="{si}"'
+                    for op, ent in sorted(stats.items()):
+                        if field not in ent:
+                            continue
+                        o = op.replace('\\', r'\\').replace('"', r'\"')
+                        lines.append(
+                            f'{name}{{op="{o}"{shard}}} {ent[field]}')
         return PlainText("\n".join(lines) + "\n")
 
     # ---- plumbing --------------------------------------------------------

@@ -74,9 +74,20 @@ static double now_s() {
 
 struct WatchEvent {
   long long wid = 0;
+  int shard = 0;   // which store shard delivered it (sharded client)
   bool lost = false;
   bool is_delete = false;
   std::string key, value;
+};
+
+// shared event funnel: a sharded client points every per-shard
+// StoreClient at ONE of these so the agent's event loop pops a single
+// merged stream (per-shard ordering preserved — each shard's reader
+// appends its own events in arrival order)
+struct EventSink {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<WatchEvent> q;
 };
 
 struct StoreError {
@@ -87,6 +98,14 @@ class StoreClient {
  public:
   StoreClient(std::string host, int port, std::string token)
       : host_(std::move(host)), port_(port), token_(std::move(token)) {}
+
+  // sharded mode: deliver watch events (tagged with this shard's
+  // index) into a shared sink instead of the per-client queue.  Must
+  // be set before connect_once().
+  void set_sink(EventSink* sink, int tag) {
+    sink_ = sink;
+    sink_tag_ = tag;
+  }
 
   bool connect_once() {
     int fd = dial();
@@ -489,6 +508,21 @@ class StoreClient {
     pending_.erase(rid);
   }
 
+  // one lock round per frame, into the shared sink (sharded client)
+  // or the per-client queue — tagged with this client's shard index
+  void push_events(std::vector<WatchEvent>&& evs) {
+    for (WatchEvent& ev : evs) ev.shard = sink_tag_;
+    if (sink_) {
+      std::lock_guard<std::mutex> g(sink_->mu);
+      for (WatchEvent& ev : evs) sink_->q.push_back(std::move(ev));
+      sink_->cv.notify_all();
+      return;
+    }
+    std::lock_guard<std::mutex> g(evmu_);
+    for (WatchEvent& ev : evs) events_.push_back(std::move(ev));
+    evcv_.notify_all();
+  }
+
   void reader(int fd, long long gen) {
     std::string buf;
     char chunk[65536];
@@ -521,12 +555,12 @@ class StoreClient {
       pending_.clear();
     }
     {
-      std::lock_guard<std::mutex> g(evmu_);
       WatchEvent lost;
       lost.wid = -1;  // -1 = ALL streams lost (consumer resyncs)
       lost.lost = true;
-      events_.push_back(lost);
-      evcv_.notify_all();
+      std::vector<WatchEvent> evs;
+      evs.push_back(std::move(lost));
+      push_events(std::move(evs));
     }
     if (stop_) return;
     std::thread([this] {
@@ -557,25 +591,25 @@ class StoreClient {
         }
         return true;
       };
-      std::lock_guard<std::mutex> g(evmu_);
+      std::vector<WatchEvent> out;
       if (const JV* lost = v.get("lost")) {
         WatchEvent ev;
         ev.wid = wid;
         ev.lost = lost->t == JV::BOOL && lost->b;
-        events_.push_back(std::move(ev));
+        out.push_back(std::move(ev));
       } else if (const JV* evs = v.get("evs")) {
         // batched push: one frame, many events
         if (evs->t == JV::ARR)
           for (const JV& e : evs->arr) {
             WatchEvent ev;
-            if (parse_ev(e, ev)) events_.push_back(std::move(ev));
+            if (parse_ev(e, ev)) out.push_back(std::move(ev));
           }
       } else if (const JV* e = v.get("ev")) {  // legacy single push
         WatchEvent ev;
         if (!parse_ev(*e, ev)) return;
-        events_.push_back(std::move(ev));
+        out.push_back(std::move(ev));
       }
-      evcv_.notify_all();
+      if (!out.empty()) push_events(std::move(out));
       return;
     }
     const JV* i = v.get("i");
@@ -611,7 +645,579 @@ class StoreClient {
   std::mutex evmu_;
   std::condition_variable evcv_;
   std::deque<WatchEvent> events_;
+  EventSink* sink_ = nullptr;
+  int sink_tag_ = 0;
   std::atomic<bool> stop_{false};
+};
+
+// ---------------------------------------------------------------------------
+// sharded routing client (mirror of cronsun_tpu/store/sharded.py)
+// ---------------------------------------------------------------------------
+//
+// N independent stored shards behind the StoreClient surface the agent
+// already speaks.  Routing is the shared deterministic scheme — a
+// TOKEN extracted from the key (job for lock/proc/cmd/once/phase keys,
+// node for dispatch/node keys, the full key otherwise) hashed with
+// 64-bit FNV-1a — so a fire's fence + proc key + job doc co-locate on
+// one shard (the per-item claim stays atomic) and this agent's order
+// stream lives on one shard.  Multi-key ops split per shard;
+// claim_bundle splits per fence shard with the reservation-key release
+// ordered LAST (a failure mid-bundle leaves the leased order key for
+// redelivery).  Leases are granted on every shard behind one composite
+// id.  Watches open per shard and merge through the shared EventSink
+// with composite wids; any shard's connection loss surfaces the usual
+// wid=-1 full-resync event.  With ONE shard everything passes through
+// verbatim.
+
+static unsigned long long fnv1a64(const std::string& s) {
+  unsigned long long h = 0xcbf29ce484222325ULL;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 0x100000001b3ULL;
+  }
+  return h;
+}
+
+static std::string shard_token(const std::string& key,
+                               const std::string& prefix) {
+  const std::string pfx = prefix + "/";
+  if (key.compare(0, pfx.size(), pfx) != 0) return key;
+  std::vector<std::string> seg;
+  size_t start = pfx.size();
+  while (seg.size() < 5) {
+    size_t slash = key.find('/', start);
+    if (slash == std::string::npos) {
+      seg.push_back(key.substr(start));
+      break;
+    }
+    seg.push_back(key.substr(start, slash - start));
+    start = slash + 1;
+  }
+  const std::string& comp = seg[0];
+  if ((comp == "dispatch" || comp == "node") && seg.size() >= 2 &&
+      !seg[1].empty())
+    return "n:" + seg[1];
+  if (comp == "lock") {
+    if (seg.size() >= 3 && seg[1] == "alone" && !seg[2].empty())
+      return "j:" + seg[2];
+    if (seg.size() >= 2 && !seg[1].empty()) return "j:" + seg[1];
+  }
+  if (comp == "proc" && seg.size() >= 4 && !seg[3].empty())
+    return "j:" + seg[3];
+  if ((comp == "cmd" || comp == "once" || comp == "phase") &&
+      seg.size() >= 3 && !seg[2].empty())
+    return "j:" + seg[2];
+  return key;
+}
+
+// Routing token shared by EVERY key under pfx_str, or false when keys
+// under it can hash to different shards (mirrors the Python client's
+// prefix_shard_token).  A segment counts only when the prefix CLOSES
+// it with a '/' — "…/dispatch/A" also matches node "AB", so only
+// "…/dispatch/A/" pins to "n:A".  Lets the agent's dispatch watch and
+// re-list hit ONE shard instead of fanning N ways.
+static bool prefix_shard_token(const std::string& pfx_str,
+                               const std::string& prefix,
+                               std::string& tok) {
+  const std::string pfx = prefix + "/";
+  if (pfx_str.compare(0, pfx.size(), pfx) != 0) return false;
+  std::vector<std::string> seg;
+  size_t start = pfx.size();
+  while (seg.size() < 6) {
+    size_t slash = pfx_str.find('/', start);
+    if (slash == std::string::npos) {
+      seg.push_back(pfx_str.substr(start));
+      break;
+    }
+    seg.push_back(pfx_str.substr(start, slash - start));
+    start = slash + 1;
+  }
+  // closed(i): segment i is complete (a '/' follows it in the prefix)
+  auto closed = [&](size_t i) {
+    return i + 1 < seg.size() && !seg[i].empty();
+  };
+  const std::string& comp = seg[0];
+  if ((comp == "dispatch" || comp == "node") && closed(1)) {
+    tok = "n:" + seg[1];
+    return true;
+  }
+  if (comp == "lock") {
+    if (closed(1) && seg[1] == "alone") {
+      if (closed(2)) {
+        tok = "j:" + seg[2];
+        return true;
+      }
+      return false;
+    }
+    if (closed(1)) {
+      tok = "j:" + seg[1];
+      return true;
+    }
+    return false;
+  }
+  if (comp == "proc" && closed(3)) {
+    tok = "j:" + seg[3];
+    return true;
+  }
+  if ((comp == "cmd" || comp == "once" || comp == "phase") && closed(2)) {
+    tok = "j:" + seg[2];
+    return true;
+  }
+  return false;
+}
+
+class ShardedStoreClient {
+ public:
+  ShardedStoreClient(const std::vector<std::pair<std::string, int>>& addrs,
+                     const std::string& token, std::string prefix)
+      : prefix_(std::move(prefix)) {
+    for (const auto& [h, p] : addrs)
+      shards_.emplace_back(new StoreClient(h, p, token));
+    n_ = shards_.size();
+    if (n_ > 1)
+      for (size_t i = 0; i < n_; i++)
+        shards_[i]->set_sink(&sink_, (int)i);
+  }
+
+  size_t nshards() const { return n_; }
+
+  size_t idx(const std::string& key) const {
+    if (n_ <= 1) return 0;
+    if (key == prefix_ + "/shardmap") return 0;  // topology pin: shard
+                                                 // 0 by fiat
+    return (size_t)(fnv1a64(shard_token(key, prefix_)) % n_);
+  }
+
+  // shard index when every key under pfx_str routes there, else n_
+  // (sentinel: fan out)
+  size_t prefix_idx(const std::string& pfx_str) const {
+    if (n_ <= 1) return 0;
+    std::string tok;
+    if (!prefix_shard_token(pfx_str, prefix_, tok)) return n_;
+    return (size_t)(fnv1a64(tok) % n_);
+  }
+
+  bool connect_once() {
+    for (auto& s : shards_)
+      if (!s->connect_once()) return false;
+    return true;
+  }
+
+  void close() {
+    for (auto& s : shards_) s->close();
+  }
+
+  bool connected() {
+    for (auto& s : shards_)
+      if (!s->connected()) return false;
+    return true;
+  }
+
+  // topology pin: verify (or publish) the shard-map key on shard 0 —
+  // two clients with different shard counts must not scatter one
+  // keyspace under two layouts.  Matches the Python client's value
+  // byte-for-byte (json.dumps(sort_keys=True)).
+  bool verify_shard_map() {
+    if (n_ <= 1) {
+      // single-address client: read-only pin check — a stale one-store
+      // config pointed at shard 0 of a multi-shard layout must refuse
+      // (it would fence every job on one shard and race the fleet),
+      // not silently serve.  An un-sharded set never writes the pin.
+      const std::string key = prefix_ + "/shardmap";
+      std::string value;
+      bool found = false;
+      if (!shards_[0]->get(key, value, nullptr, found)) {
+        fprintf(stderr, "shard-map read failed at %s\n", key.c_str());
+        return false;
+      }
+      if (!found) return true;
+      JParser jp(value);
+      JV v;
+      long long got_n = -1;
+      if (jp.value(v) && v.t == JV::OBJ)
+        if (const JV* nn = v.get("n")) got_n = nn->as_int();
+      if (got_n != 1) {
+        fprintf(stderr,
+                "shard-map mismatch at %s: store laid out as %s, this "
+                "agent is configured for a single store\n",
+                key.c_str(), value.c_str());
+        return false;
+      }
+      return true;
+    }
+    char want[96];
+    snprintf(want, sizeof want,
+             "{\"hash\": \"fnv1a-token-v1\", \"n\": %zu}", n_);
+    const std::string key = prefix_ + "/shardmap";
+    bool won = false;
+    shards_[0]->put_if_absent(key, want, 0, won);
+    std::string value;
+    bool found = false;
+    if (!shards_[0]->get(key, value, nullptr, found) || !found) {
+      fprintf(stderr, "shard-map read failed at %s\n", key.c_str());
+      return false;
+    }
+    JParser jp(value);
+    JV v;
+    long long got_n = -1;
+    std::string got_hash;
+    if (jp.value(v) && v.t == JV::OBJ) {
+      if (const JV* nn = v.get("n")) got_n = nn->as_int();
+      if (const JV* hh = v.get("hash")) got_hash = hh->s;
+    }
+    if (got_n != (long long)n_ || got_hash != "fnv1a-token-v1") {
+      fprintf(stderr,
+              "shard-map mismatch at %s: store laid out as %s, this "
+              "agent is configured for %zu shards\n",
+              key.c_str(), value.c_str(), n_);
+      return false;
+    }
+    return true;
+  }
+
+  // -- leases (composite id -> one lease per shard) -----------------------
+
+  long long grant(double ttl) {
+    if (n_ == 1) return shards_[0]->grant(ttl);
+    std::vector<long long> ids(n_);
+    for (size_t i = 0; i < n_; i++) {
+      ids[i] = shards_[i]->grant(ttl);
+      if (!ids[i]) {
+        for (size_t j = 0; j < i; j++) shards_[j]->revoke(ids[j]);
+        return 0;
+      }
+    }
+    std::lock_guard<std::mutex> g(lease_mu_);
+    long long cid = next_lease_++;
+    leases_[cid] = std::move(ids);
+    return cid;
+  }
+
+  bool keepalive(long long lease) {
+    if (n_ == 1) return shards_[0]->keepalive(lease);
+    std::vector<long long> ids;
+    {
+      std::lock_guard<std::mutex> g(lease_mu_);
+      auto it = leases_.find(lease);
+      if (it == leases_.end()) return false;
+      ids = it->second;
+    }
+    bool ok = true;
+    for (size_t i = 0; i < n_; i++)
+      ok = shards_[i]->keepalive(ids[i]) && ok;
+    return ok;
+  }
+
+  void revoke(long long lease) {
+    if (n_ == 1) {
+      shards_[0]->revoke(lease);
+      return;
+    }
+    std::vector<long long> ids;
+    {
+      std::lock_guard<std::mutex> g(lease_mu_);
+      auto it = leases_.find(lease);
+      if (it == leases_.end()) return;
+      ids = it->second;
+      leases_.erase(it);
+    }
+    for (size_t i = 0; i < n_; i++) shards_[i]->revoke(ids[i]);
+  }
+
+  long long xlease(long long lease, size_t i) {
+    if (!lease || n_ == 1) return lease;
+    std::lock_guard<std::mutex> g(lease_mu_);
+    auto it = leases_.find(lease);
+    // unknown composite id (revoked under a racing thread): pass a
+    // server-impossible id so the shard rejects the op LOUDLY ("lease
+    // not found" -> the caller's rotate/retry ladder), exactly like a
+    // stale id against a single store.  Returning 0 here would write
+    // the keys UNLEASED — permanent ghost fences/procs (the Python
+    // client raises KeyError for the same reason).
+    return it == leases_.end() ? -1 : it->second[i];
+  }
+
+  // -- routed single-key ops ---------------------------------------------
+
+  bool put(const std::string& k, const std::string& v, long long lease = 0) {
+    size_t i = idx(k);
+    return shards_[i]->put(k, v, xlease(lease, i));
+  }
+
+  bool get(const std::string& k, std::string& value, long long* mod_rev,
+           bool& found) {
+    return shards_[idx(k)]->get(k, value, mod_rev, found);
+  }
+
+  bool del(const std::string& k) { return shards_[idx(k)]->del(k); }
+
+  bool put_if_absent(const std::string& k, const std::string& v,
+                     long long lease, bool& won) {
+    StoreError e;
+    return put_if_absent_err(k, v, lease, won, e);
+  }
+
+  bool put_if_absent_err(const std::string& k, const std::string& v,
+                         long long lease, bool& won, StoreError& err) {
+    size_t i = idx(k);
+    return shards_[i]->put_if_absent_err(k, v, xlease(lease, i), won, err);
+  }
+
+  bool put_if_mod_rev(const std::string& k, const std::string& v,
+                      long long mod_rev, bool& won) {
+    return shards_[idx(k)]->put_if_mod_rev(k, v, mod_rev, won);
+  }
+
+  // -- split multi-key ops ------------------------------------------------
+
+  bool delete_many(const std::vector<std::string>& keys) {
+    if (n_ == 1) return shards_[0]->delete_many(keys);
+    std::map<size_t, std::vector<std::string>> groups;
+    for (const auto& k : keys) groups[idx(k)].push_back(k);
+    bool ok = true;
+    for (auto& [i, g] : groups) ok = shards_[i]->delete_many(g) && ok;
+    return ok;
+  }
+
+  bool get_many(const std::vector<std::string>& keys,
+                std::vector<std::pair<bool, std::string>>& out) {
+    if (n_ == 1) return shards_[0]->get_many(keys, out);
+    std::map<size_t, std::vector<size_t>> groups;
+    for (size_t p = 0; p < keys.size(); p++) groups[idx(keys[p])].push_back(p);
+    out.assign(keys.size(), {false, std::string()});
+    for (auto& [i, ps] : groups) {
+      std::vector<std::string> sub;
+      sub.reserve(ps.size());
+      for (size_t p : ps) sub.push_back(keys[p]);
+      std::vector<std::pair<bool, std::string>> part;
+      if (!shards_[i]->get_many(sub, part)) return false;
+      for (size_t j = 0; j < ps.size(); j++) out[ps[j]] = std::move(part[j]);
+    }
+    return true;
+  }
+
+  bool get_prefix(const std::string& pfx,
+                  std::vector<std::pair<std::string, std::string>>& out) {
+    size_t pi = prefix_idx(pfx);
+    if (pi < n_) return shards_[pi]->get_prefix(pfx, out);
+    bool ok = true;
+    for (auto& s : shards_) ok = s->get_prefix(pfx, out) && ok;
+    return ok;
+  }
+
+  // -- claims -------------------------------------------------------------
+  //
+  // Per-item atomicity happens on the FENCE's shard; an order or proc
+  // key hashing elsewhere (rare by the token design) is applied around
+  // it — remote proc put for a winner first, order-key release LAST.
+
+  bool claim_err(const std::string& fence_key, const std::string& fence_val,
+                 long long fence_lease, const std::string& order_key,
+                 const std::string& proc_key, const std::string& proc_val,
+                 long long proc_lease, bool& won, StoreError& err) {
+    size_t fi = idx(fence_key);
+    bool order_local = !order_key.empty() && idx(order_key) == fi;
+    bool proc_local = !proc_key.empty() && idx(proc_key) == fi;
+    if (!shards_[fi]->claim_err(
+            fence_key, fence_val, xlease(fence_lease, fi),
+            order_local ? order_key : std::string(),
+            proc_local ? proc_key : std::string(),
+            proc_local ? proc_val : std::string(),
+            proc_local ? xlease(proc_lease, fi) : 0, won, err))
+      return false;
+    if (won && !proc_key.empty() && !proc_local) {
+      size_t pi = idx(proc_key);
+      shards_[pi]->put(proc_key, proc_val, xlease(proc_lease, pi));
+    }
+    if (!order_key.empty() && !order_local) shards_[idx(order_key)]->del(order_key);
+    return true;
+  }
+
+  bool claim_bundle_err(const std::string& order_key, const JV& items,
+                        long long fence_lease, long long proc_lease,
+                        std::vector<bool>& wins, StoreError& err) {
+    if (n_ == 1)
+      return shards_[0]->claim_bundle_err(order_key, items, fence_lease,
+                                          proc_lease, wins, err);
+    // no order key (a chunked sibling of an oversized bundle — THE hot
+    // path at herd scale) means no reservation to release: every
+    // sub-bundle fans out in phase 1 and phase 2 is skipped.  kNoShard
+    // matches no group, so the phase-1 loop takes them all.
+    const size_t kNoShard = (size_t)-1;
+    size_t oi = order_key.empty() ? kNoShard : idx(order_key);
+    // split items per fence shard, building each shard's sub-bundle
+    // ONCE (positions remembered for the merged win list).  A proc key
+    // that hashes off its fence's shard — with job-token routing fence
+    // and proc co-locate, so this is the malformed/foreign-key edge,
+    // not the hot path — is stripped from the claim and, for winners,
+    // applied as a routed put AFTER the claim (the claim_err/claim_many
+    // contract: a won fence never silently loses its proc
+    // registration).
+    struct Group {
+      std::vector<size_t> ps;
+      JV sub;
+    };
+    std::map<size_t, Group> groups;
+    std::vector<std::tuple<size_t, std::string, std::string>> stripped;
+    for (size_t p = 0; p < items.arr.size(); p++) {
+      const JV& it = items.arr[p];
+      size_t fi = (it.t == JV::ARR && it.arr.size() >= 1)
+                      ? idx(it.arr[0].s)
+                      : (oi != kNoShard ? oi : 0);
+      Group& g = groups[fi];
+      g.sub.t = JV::ARR;
+      g.ps.push_back(p);
+      g.sub.arr.push_back(it);
+      JV& sit = g.sub.arr.back();
+      if (sit.t == JV::ARR && sit.arr.size() >= 4 &&
+          !sit.arr[2].s.empty() && idx(sit.arr[2].s) != fi) {
+        stripped.emplace_back(p, sit.arr[2].s, sit.arr[3].s);
+        sit.arr[2].s.clear();
+        sit.arr[3].s.clear();
+      }
+    }
+    wins.assign(items.arr.size(), false);
+    auto claim_group = [&](size_t i, const Group& g, const std::string& ok,
+                           std::vector<bool>& sub_wins,
+                           StoreError& my_err) -> bool {
+      return shards_[i]->claim_bundle_err(ok, g.sub, xlease(fence_lease, i),
+                                          xlease(proc_lease, i), sub_wins,
+                                          my_err);
+    };
+    auto merge_wins = [&](const Group& g, const std::vector<bool>& sw) {
+      for (size_t j = 0; j < g.ps.size() && j < sw.size(); j++)
+        wins[g.ps[j]] = sw[j];
+    };
+    // phase 1: every sub-bundle NOT carrying the reservation key, fanned
+    // out CONCURRENTLY across shards (the Python client's _fan; each
+    // StoreClient already multiplexes concurrent requests for the
+    // worker pool) — sequential rounds would stack one wire round trip
+    // per shard onto EVERY chunk's claim latency.  A failure here
+    // leaves the leased order key for redelivery.  fan_mu covers the
+    // win-list merges too: wins is a bit-packed vector<bool>, so even
+    // disjoint positions share words.
+    {
+      std::vector<std::thread> fan;
+      std::mutex fan_mu;
+      bool ok_all = true;
+      for (auto& [i, g] : groups) {
+        if (i == oi) continue;
+        fan.emplace_back([&, gi = i, gp = &g] {
+          StoreError my_err;
+          std::vector<bool> sub_wins;
+          bool ok = claim_group(gi, *gp, std::string(), sub_wins, my_err);
+          std::lock_guard<std::mutex> lk(fan_mu);
+          if (!ok) {
+            ok_all = false;
+            err = my_err;
+            return;
+          }
+          merge_wins(*gp, sub_wins);
+        });
+      }
+      for (auto& t : fan) t.join();
+      if (!ok_all) return false;
+    }
+    // phase 2: the reservation release, last (skipped entirely when
+    // there is no reservation — a chunk claim already settled above)
+    if (oi != kNoShard) {
+      auto it = groups.find(oi);
+      Group none;
+      none.sub.t = JV::ARR;
+      const Group& g = it == groups.end() ? none : it->second;
+      std::vector<bool> sub_wins;
+      if (!claim_group(oi, g, order_key, sub_wins, err)) return false;
+      merge_wins(g, sub_wins);
+    }
+    // winners whose proc key hashed off the fence shard: routed put
+    // (post-claim, like claim_err's remote-proc path — the key is
+    // leased, so a crash here ages out instead of leaking)
+    for (auto& [p, pk, pv] : stripped)
+      if (wins[p]) {
+        size_t pi = idx(pk);
+        shards_[pi]->put(pk, pv, xlease(proc_lease, pi));
+      }
+    return true;
+  }
+
+  // -- watches (composite wids over the shared sink) ----------------------
+
+  long long watch(const std::string& pfx) {
+    if (n_ == 1) return shards_[0]->watch(pfx);
+    // a token-pinned prefix (this agent's dispatch/<node>/ stream)
+    // lives on ONE shard: open one stream, not n_-1 idle ones
+    size_t pi = prefix_idx(pfx);
+    std::vector<std::pair<int, long long>> opened;
+    for (size_t i = 0; i < n_; i++) {
+      if (pi < n_ && i != pi) continue;
+      long long w = shards_[i]->watch(pfx);
+      if (w < 0) {
+        for (auto& [j, wj] : opened) shards_[j]->unwatch(wj);
+        return -1;
+      }
+      opened.emplace_back((int)i, w);
+    }
+    std::lock_guard<std::mutex> g(wmap_mu_);
+    long long cwid = next_cwid_++;
+    for (auto& [i, w] : opened) wmap_[{i, w}] = cwid;
+    children_[cwid] = std::move(opened);
+    return cwid;
+  }
+
+  void unwatch(long long cwid) {
+    if (cwid < 0) return;
+    if (n_ == 1) {
+      shards_[0]->unwatch(cwid);
+      return;
+    }
+    std::vector<std::pair<int, long long>> wids;
+    {
+      std::lock_guard<std::mutex> g(wmap_mu_);
+      auto it = children_.find(cwid);
+      if (it == children_.end()) return;
+      wids = it->second;
+      children_.erase(it);
+      for (auto& [i, w] : wids) wmap_.erase({i, w});
+    }
+    for (auto& [i, w] : wids) shards_[i]->unwatch(w);
+  }
+
+  bool next_event(WatchEvent& ev, double timeout_s) {
+    if (n_ == 1) return shards_[0]->next_event(ev, timeout_s);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    std::unique_lock<std::mutex> g(sink_.mu);
+    while (true) {
+      if (!sink_.cv.wait_until(g, deadline, [&] { return !sink_.q.empty(); }))
+        return false;
+      ev = std::move(sink_.q.front());
+      sink_.q.pop_front();
+      if (ev.lost && ev.wid == -1) return true;  // shard connection lost:
+                                                 // full resync upstream
+      long long cwid;
+      {
+        std::lock_guard<std::mutex> wg(wmap_mu_);
+        auto it = wmap_.find({ev.shard, ev.wid});
+        if (it == wmap_.end()) continue;  // stale stream (post-unwatch)
+        cwid = it->second;
+      }
+      ev.wid = cwid;
+      return true;
+    }
+  }
+
+ private:
+  std::string prefix_;
+  std::vector<std::unique_ptr<StoreClient>> shards_;
+  size_t n_ = 0;
+  EventSink sink_;
+  std::mutex lease_mu_;
+  std::map<long long, std::vector<long long>> leases_;
+  long long next_lease_ = 1;
+  std::mutex wmap_mu_;
+  std::map<std::pair<int, long long>, long long> wmap_;
+  std::map<long long, std::vector<std::pair<int, long long>>> children_;
+  long long next_cwid_ = 1;
 };
 
 // ---------------------------------------------------------------------------
@@ -1081,7 +1687,7 @@ static bool parse_job(const std::string& json, JobSpec& j) {
 
 class Agent {
  public:
-  Agent(StoreClient& store, LogClient& logd, std::string node_id,
+  Agent(ShardedStoreClient& store, LogClient& logd, std::string node_id,
         std::string prefix, double ttl, double proc_ttl, double lock_ttl,
         double proc_req, int workers)
       : store_(store), logd_(logd), id_(std::move(node_id)),
@@ -1710,7 +2316,7 @@ class Agent {
       return false;
     }
     auto stop = std::make_shared<std::atomic<bool>>(false);
-    StoreClient* sc = &store_;
+    ShardedStoreClient* sc = &store_;
     std::thread([sc, lease, attl, stop] {
       while (!stop->load()) {
         std::this_thread::sleep_for(
@@ -2448,7 +3054,7 @@ class Agent {
     return buf;
   }
 
-  StoreClient& store_;
+  ShardedStoreClient& store_;
   LogClient& logd_;
   Executor exec_;
   std::string id_, pfx_, hostname_;
@@ -2600,16 +3206,42 @@ int main(int argc, char** argv) {
     p = atoi(a.c_str() + (c == std::string::npos ? 0 : c + 1));
     if (h.empty()) h = "127.0.0.1";
   };
-  std::string sh, lh;
-  int sp = 0, lp = 0;
-  split_addr(store_addr, sh, sp);
+  std::string lh;
+  int lp = 0;
   split_addr(logd_addr, lh, lp);
 
-  StoreClient store(sh, sp, store_token);
+  // --store accepts a comma-separated SHARD SET ("h1:7070,h2:7070"):
+  // more than one address routes the keyspace by the deterministic
+  // token hash (the Python client's store/sharded.py, mirrored above)
+  std::vector<std::pair<std::string, int>> store_addrs;
+  {
+    size_t start = 0;
+    while (start <= store_addr.size()) {
+      size_t comma = store_addr.find(',', start);
+      std::string one = store_addr.substr(
+          start, comma == std::string::npos ? std::string::npos
+                                            : comma - start);
+      if (!one.empty()) {
+        std::string h;
+        int p = 0;
+        split_addr(one, h, p);
+        store_addrs.emplace_back(h, p);
+      }
+      if (comma == std::string::npos) break;
+      start = comma + 1;
+    }
+  }
+  if (store_addrs.empty()) {
+    fprintf(stderr,
+            "--store %s has no host:port entries\n", store_addr.c_str());
+    return 1;
+  }
+  ShardedStoreClient store(store_addrs, store_token, prefix);
   if (!store.connect_once()) {
     fprintf(stderr, "cannot connect to store %s\n", store_addr.c_str());
     return 1;
   }
+  if (!store.verify_shard_map()) return 1;
   LogClient logd(lh, lp, log_token);
   Agent agent(store, logd, node_id, prefix, ttl, proc_ttl, lock_ttl,
               proc_req, workers);

@@ -99,6 +99,66 @@ def test_two_agents_scale_aggregate_drain():
         f"negative/flat agent scaling regressed: 2 agents drained "
         f"{res['agg_2_agents_per_s']}/s vs {res['agg_1_agent_per_s']}/s "
         f"for one (ratio {res['scaling_2_over_1']})")
-    # the batched watch wire must be active under the burst
-    fpe = res.get("watch_frames_per_event")
-    assert fpe is None or fpe < 1.0, f"watch batching inactive: {fpe}"
+    # the quick gate is wider than the scaling ratio: per-agent
+    # fairness and the watch frames/event ratio also trip it — the two
+    # ways a routing regression that serializes one shard (or one
+    # agent) shows up without flattening the 2-over-1 curve
+    assert res["quick_gate_failures"] == [], res["quick_gate_failures"]
+
+
+@pytest.mark.slow
+def test_shard_scaling():
+    """Horizontal-store gate: at a FIXED agent count past the one-shard
+    saturation point, 2 store shards must lift aggregate ORDER drain
+    >= 1.5x over 1 shard with per-agent fairness holding >= 0.8 —
+    partitioning the keyspace has to buy real concurrency (separate
+    event planes and accept loops), not re-serialize behind one hot
+    shard.  Native instant-exec agents put the store on the critical
+    path (Python agents saturate on their own interpreter first); the
+    STORE side runs BENCH_STORE=py — one bin.store process per shard —
+    because the single-PROCESS ceiling is the thing sharding removes,
+    and on one host only the GIL-bound backend has that ceiling below
+    the fleet's drive capacity (the native server is internally
+    striped/multithreaded, so its single-host shard curve measures
+    leftover CPU headroom, not the partitioning win).  The record
+    plane stays logd-gated either way — the ladder's order-drain
+    figure isolates the sharded boundary."""
+    if (os.cpu_count() or 1) < 12:
+        pytest.skip("needs >= 12 cores for a store-bound drain signal")
+    agentd = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cronsun-agentd")
+    if not os.path.exists(agentd):
+        pytest.skip("native agent binary unavailable")
+    os.environ["BENCH_AGENT"] = "native"
+    os.environ["BENCH_STORE"] = "py"
+    try:
+        import bench_dispatch
+        # a shared host's scheduler noise swings short benches; one
+        # retry keeps the gate sharp on regressions (a re-serialized
+        # shard split fails BOTH runs) without tripping on jitter
+        for attempt in (1, 2):
+            res = bench_dispatch.run_shard_ladder(
+                [1, 2], rate=150000, n_agents=8, seconds=3,
+                on_log=lambda *a: print(*a, file=sys.stderr))
+            ladder = res["dispatch_plane_shard_ladder"]
+            one, two = ladder[0], ladder[1]
+            fair = two["fairness_min_over_max"]
+            if (two["scaling_vs_1_shard"] >= 1.5
+                    and (fair is None or fair >= 0.8)) or attempt == 2:
+                break
+            print("shard ladder below gate "
+                  f"({two['scaling_vs_1_shard']}x, fairness {fair}); "
+                  "retrying once", file=sys.stderr)
+    finally:
+        os.environ.pop("BENCH_AGENT", None)
+        os.environ.pop("BENCH_STORE", None)
+    assert one["order_drain_per_sec"] > 0
+    assert two["scaling_vs_1_shard"] >= 1.5, (
+        f"2-shard order drain {two['order_drain_per_sec']}/s is only "
+        f"{two['scaling_vs_1_shard']}x the 1-shard "
+        f"{one['order_drain_per_sec']}/s — the shard split "
+        "re-serialized")
+    fair = two["fairness_min_over_max"]
+    assert fair is None or fair >= 0.8, (
+        f"2-shard fairness {fair} < 0.8 — one shard (or its agent) "
+        "is hogging the drain")

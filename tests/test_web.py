@@ -358,6 +358,45 @@ def test_metrics_endpoint_surfaces_store_op_stats(world):
         in text
 
 
+def test_metrics_op_stats_carry_shard_label_when_sharded():
+    """Against a sharded store, each cronsun_store_op_* series carries
+    a ``shard`` label so per-shard counters don't collide; with ONE
+    shard the rendering stays byte-identical to the unlabeled form."""
+    from cronsun_tpu.store.sharded import ShardedStore
+    shards = [MemStore(), MemStore()]
+    store = ShardedStore(shards)
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, port=0).start()
+    try:
+        # a timed op on EVERY shard (puts of co-located job keys until
+        # both shards saw a put_many)
+        store.put_many([(KS.job_key("g", f"m{i}"), "v")
+                        for i in range(16)])
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/metrics").read().decode()
+        assert 'cronsun_store_op_count{op="put_many",shard="0"}' in text
+        assert 'cronsun_store_op_count{op="put_many",shard="1"}' in text
+        # no unlabeled series slips through to collide across shards
+        assert 'cronsun_store_op_count{op="put_many"}' not in text
+    finally:
+        srv.stop()
+        store.close()
+
+    # single-shard: byte-identical to the plain MemStore rendering
+    m = MemStore()
+    one = ShardedStore([m])
+    srv1 = ApiServer(one, JobLogStore(), port=0).start()
+    try:
+        one.put_many([("/warm/key", "v")])
+        text1 = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv1.port}/v1/metrics").read().decode()
+        assert 'cronsun_store_op_count{op="put_many"} 1' in text1
+        assert 'shard=' not in text1
+    finally:
+        srv1.stop()
+        one.close()
+
+
 def test_agent_publishes_metrics_snapshot():
     """Agents publish leased node snapshots the /v1/metrics surface
     renders — execution counters included."""
