@@ -429,6 +429,50 @@ def main():
                     proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
             detail["dispatch_plane_shard_ladder_error"] = str(e)
+    # the RESULT-plane shard ladder: one past-ingest-ceiling rate at a
+    # fixed agent count across 1/2/4 logd shards — the record-drain
+    # scaling curve the sharded result plane must deliver (PR 6's probe
+    # measured the unsharded logd as the wall at ~33k records/s).
+    # Native agents drive; BENCH_LOGD=py (one bin.logd process per
+    # shard) is the backend whose single-process ceiling the sharding
+    # removes on one host — the store-ladder lesson applied to logd.
+    if not quick:
+        log("result plane: logd shard ladder 1/2/4")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_dispatch.py"),
+                 "--rates", "60000", "--seconds", "3", "--agents", "4",
+                 "--logd-shards", "1,2,4"],
+                capture_output=True, text=True, timeout=1800, cwd=here,
+                env={**os.environ, "BENCH_AGENT": "native",
+                     "BENCH_LOGD": "py"})
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["result_plane_logd_ladder_error"] = \
+                    proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["result_plane_logd_ladder_error"] = str(e)
+    # the READ plane: queries/s + p50/p99 for the three dashboard
+    # shapes (latest view, paged history filter, stat_days) at M
+    # concurrent readers while a writer drives bulk ingest at full
+    # drain — the query-path claim beside the ingest claim.  Runs in
+    # quick mode too (it is cheap) so every artifact carries it.
+    log("query plane: concurrent readers under full-drain writes")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts",
+                                          "bench_query.py"),
+             "--logd-shards", "1" if quick else "2",
+             "--readers", "4", "--seconds", "2" if quick else "4"],
+            capture_output=True, text=True, timeout=600, cwd=here)
+        if proc.returncode == 0:
+            detail.update(json.loads(proc.stdout))
+        else:
+            detail["query_plane_error"] = proc.stderr[-500:]
+    except Exception as e:  # noqa: BLE001
+        detail["query_plane_error"] = str(e)
 
     # ---- scheduler system: full step() + failover at c5 scale --------------
     # The whole cycle a real tick pays (watch drain + reconcile + flush +

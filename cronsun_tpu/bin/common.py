@@ -21,8 +21,11 @@ def base_parser(doc: str, store_required: bool = True) -> argparse.ArgumentParse
                         help="coordination store address")
         ap.add_argument("--logsink", default=None, metavar="HOST:PORT",
                         help="networked result store (cronsun-logd) "
-                             "address; default: conf log_addr, else the "
-                             "local log_db SQLite file")
+                             "address, or a comma-joined SHARD SET "
+                             "(h1:7078,h2:7078,...) routed by the "
+                             "deterministic job hash; default: conf "
+                             "log_addr, else the local log_db SQLite "
+                             "file")
     return ap
 
 
@@ -103,15 +106,22 @@ def connect_store(addr: str, token: str = "", tls=None,
 def make_sink(cfg: Config, log_addr: Optional[str] = None):
     """Result-store handle: the networked store when an address is
     configured (processes may live on different machines — the
-    reference's Mongo topology), else the local SQLite file."""
+    reference's Mongo topology), else the local SQLite file.
+
+    ``log_addr`` may be a comma-joined SHARD SET ("h1:7078,h2:7078,…"):
+    more than one address returns a routing ShardedJobLogStore (same
+    client surface, record space partitioned by the deterministic
+    job-id hash — logsink/sharded.py); one address returns the plain
+    RemoteJobLogStore after the read-only logmap pin check (a stale
+    single-sink config pointed at one shard of a sharded layout
+    refuses at startup)."""
     addr = log_addr if log_addr is not None else cfg.log_addr
     if addr:
-        from ..logsink import RemoteJobLogStore
+        from ..logsink.sharded import connect_sharded_sink
         from ..tlsutil import client_context
-        host, _, port = addr.rpartition(":")
-        return RemoteJobLogStore(host or "127.0.0.1", int(port),
-                                 token=cfg.log_token,
-                                 sslctx=client_context(cfg.log_tls),
-                                 tls_hostname=cfg.log_tls.hostname)
+        return connect_sharded_sink(
+            [a.strip() for a in addr.split(",") if a.strip()],
+            token=cfg.log_token, sslctx=client_context(cfg.log_tls),
+            tls_hostname=cfg.log_tls.hostname)
     from ..logsink import JobLogStore
     return JobLogStore(cfg.log_db)

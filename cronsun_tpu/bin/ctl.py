@@ -292,30 +292,48 @@ def _log_line(r) -> str:
 
 def _follow_logs(api, params, interval: float, as_json: bool):
     """tail -f over the result store, cursor-exact: the afterId query
-    returns rows ordered by id (= insertion order), so records inserted
-    with old begin_ts — long jobs finishing late — are never missed."""
-    out = api.call("GET", "/v1/logs", dict(params, page=1, pageSize=1))
-    # the default view orders by begin_ts; one cursored probe past its
-    # newest id finds the true insertion high-water mark
-    last_id = max((r["id"] for r in out["list"]), default=0)
-    while True:
-        nxt = api.call("GET", "/v1/logs",
-                       dict(params, afterId=last_id, page=1, pageSize=500))
-        if not nxt["list"]:
-            break
-        last_id = nxt["list"][-1]["id"]
-    print(f"following (after record #{last_id}; ^C to stop)",
-          file=sys.stderr)
+    returns rows in per-shard insertion order, so records inserted with
+    old begin_ts — long jobs finishing late — are never missed.  The
+    cursor is OPAQUE to this loop (a scalar id for one sink, a
+    comma-joined per-shard vector for a sharded one): bootstrap asks
+    the server for the tail (``afterId=tail`` — the sink revision IS
+    the tail cursor, one cheap read instead of draining history) and
+    every poll carries forward the ``cursor`` the server returns."""
+    try:
+        out = api.call("GET", "/v1/logs",
+                       dict(params, afterId="tail", page=1, pageSize=1))
+        cursor = out.get("cursor")
+    except ApiError as e:
+        # a pre-cursor server parses afterId with q_int and 400s on
+        # "tail" — that's the compat signal, not a failure
+        if e.status != 400:
+            raise
+        cursor = None
+    if cursor is None:
+        # pre-cursor server: the old probe path — one begin_ts-ordered
+        # page finds the newest id, then cursored drains find the true
+        # insertion high-water mark
+        out = api.call("GET", "/v1/logs", dict(params, page=1, pageSize=1))
+        cursor = str(max((r["id"] for r in out["list"]), default=0))
+        while True:
+            nxt = api.call("GET", "/v1/logs",
+                           dict(params, afterId=cursor, page=1,
+                                pageSize=500))
+            if not nxt["list"]:
+                break
+            cursor = nxt.get("cursor", str(nxt["list"][-1]["id"]))
+    print(f"following (cursor {cursor}; ^C to stop)", file=sys.stderr)
     while True:
         time.sleep(interval)
         while True:      # drain bursts larger than one page
             out = api.call("GET", "/v1/logs",
-                           dict(params, afterId=last_id, page=1,
+                           dict(params, afterId=cursor, page=1,
                                 pageSize=500))
             for r in out["list"]:
                 print(json.dumps(r) if as_json else _log_line(r),
                       flush=True)
-                last_id = r["id"]
+            if out["list"]:
+                cursor = out.get("cursor", str(out["list"][-1]["id"]))
             if len(out["list"]) < 500:
                 break
 

@@ -39,6 +39,13 @@ def main(argv=None) -> int:
                     help="execution-history retention cap in records, "
                          ">= 1 (stats/latest-status stay exact); "
                          "default: native 1M, Python unbounded")
+    ap.add_argument("--shards", type=int, default=1, metavar="N",
+                    help="serve a RESULT-PLANE SHARD SET: N logd "
+                         "servers on ports port..port+N-1, each with "
+                         "its own DB/WAL sidecar (FILE.s<i>) — clients "
+                         "connect with the comma-joined address list "
+                         "and route by the deterministic job hash "
+                         "(logsink/sharded.py)")
     args = ap.parse_args(argv)
     if args.retain is not None and args.retain < 1:
         # 0 would mean "unbounded" to the SQLite store but "keep
@@ -46,33 +53,61 @@ def main(argv=None) -> int:
         print("error: --retain must be >= 1 (omit it for the default)",
               file=sys.stderr)
         return 2
+    if args.shards < 1:
+        ap.error(f"--shards must be >= 1 (got {args.shards})")
     cfg, ks, watcher = setup_common(args)
     token = cfg.log_token if args.token is None else args.token
 
     sslctx = server_tls(cfg.log_tls, args.native, "cronsun-logd")
     rc = [0]
+    servers = []
+    db_base = args.db or cfg.log_db
+
+    def shard_db(i):
+        # N=1 keeps the plain FILE name (and an existing pre-shard DB);
+        # :memory: stays :memory: — each server owns its own anyway
+        if args.shards == 1 or db_base == ":memory:":
+            return db_base
+        return f"{db_base}.s{i}"
+
+    def shard_port(i):
+        # --port 0 = ephemeral: every shard picks its own free port
+        # (0+i would try to bind fixed low ports); the READY line
+        # carries the actual bound addresses either way
+        return args.port + i if args.port else 0
+
     if args.native:
         from ..logsink.native import NativeLogSinkServer
-        srv = NativeLogSinkServer(host=args.host, port=args.port,
-                                  db=args.db or cfg.log_db,
-                                  retain=args.retain, token=token).start()
 
         def child_died(code: int):
             # don't sit healthy-looking in front of a dead result store
             log.errorf("native logd exited rc=%d; shutting down", code)
             rc[0] = code if code > 0 else 1
             events.shutdown()
-        srv.monitor(child_died)
+        for i in range(args.shards):
+            srv = NativeLogSinkServer(host=args.host, port=shard_port(i),
+                                      db=shard_db(i), retain=args.retain,
+                                      token=token).start()
+            srv.monitor(child_died)
+            servers.append(srv)
     else:
-        srv = LogSinkServer(db_path=args.db or cfg.log_db,
-                            host=args.host, port=args.port,
-                            token=token, sslctx=sslctx,
-                            retain=args.retain or 0).start()
-    log.infof("cronsun-logd serving on %s:%d (db %s)%s", srv.host, srv.port,
-              args.db or cfg.log_db,
-              " (tls)" if sslctx is not None else "")
-    print(f"READY {srv.host}:{srv.port}", flush=True)
-    events.on(events.EXIT, srv.stop)
+        for i in range(args.shards):
+            servers.append(LogSinkServer(db_path=shard_db(i),
+                                         host=args.host,
+                                         port=shard_port(i),
+                                         token=token, sslctx=sslctx,
+                                         retain=args.retain or 0).start())
+    addrs = ",".join(f"{s.host}:{s.port}" for s in servers)
+    if args.shards == 1:
+        log.infof("cronsun-logd serving on %s (db %s)%s", addrs, db_base,
+                  " (tls)" if sslctx is not None else "")
+    else:
+        log.infof("cronsun-logd serving %d shards on %s (db %s.s<i>)%s",
+                  args.shards, addrs, db_base,
+                  " (tls)" if sslctx is not None else "")
+    print(f"READY {addrs}", flush=True)
+    for s in servers:
+        events.on(events.EXIT, s.stop)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()

@@ -51,6 +51,9 @@ CREATE TABLE IF NOT EXISTS node (
 
 CREATE TABLE IF NOT EXISTS account (
   email TEXT PRIMARY KEY, doc TEXT NOT NULL);
+
+CREATE TABLE IF NOT EXISTS meta (
+  k TEXT PRIMARY KEY, v TEXT NOT NULL);
 """
 
 
@@ -260,7 +263,13 @@ class JobLogStore:
         ``id > after_id``, ordered by id ASCENDING — insertion order, so
         a poller (cronsun-ctl logs --follow) never misses a record that
         was inserted with an old begin_ts (ids are monotone; begin_ts is
-        not).  Ignored for the latest view, whose rows have no id."""
+        not).  Ignored for the latest view, whose rows have no id.
+
+        Cursor mode returns ``total == -1``: the poller advances its
+        cursor from the delivered ids and never reads the total, but
+        computing it cost a full filtered COUNT(*) scan PER POLL — the
+        one O(history) term left on the follow path.  Both backends
+        pin the same -1."""
         table = "job_latest_log" if latest else "job_log"
         where, args = [], []
         if after_id is not None and not latest:
@@ -289,13 +298,17 @@ class JobLogStore:
         # the native backend pins the same bound)
         page = max(1, min(page, 1 << 40))
         page_size = max(1, min(page_size, 500))
+        cursor_mode = after_id is not None and not latest
         with self._lock:
-            total = self._db.execute(
+            total = -1 if cursor_mode else self._db.execute(
                 f"SELECT COUNT(*) c FROM {table}{cond}", args).fetchone()["c"]
-            # tie order pinned explicitly (id ASC within equal begin_ts)
-            # so the native backend can page identically
-            order = "id ASC" if (after_id is not None and not latest) else \
-                "begin_ts DESC" + (", id ASC" if not latest else "")
+            # tie order pinned explicitly (id ASC within equal begin_ts;
+            # the id-less latest view breaks ties by its (job_id, node)
+            # primary key) so the native backend — and the sharded
+            # client's scatter-gather merge — page identically
+            order = "id ASC" if cursor_mode else \
+                "begin_ts DESC" + (", job_id ASC, node ASC" if latest
+                                   else ", id ASC")
             rows = self._db.execute(
                 f"SELECT * FROM {table}{cond} ORDER BY {order} "
                 "LIMIT ? OFFSET ?",
@@ -316,6 +329,39 @@ class JobLogStore:
             node=r["node"], user=r["job_user"], command=r["command"],
             output=r["output"], success=bool(r["success"]),
             begin_ts=r["begin_ts"], end_ts=r["end_ts"])
+
+    # ---- change revision + topology pin ----------------------------------
+
+    def revision(self) -> int:
+        """Monotone change token for the read plane: the max record id
+        ever assigned (0 when empty).  Every create bumps it; retention
+        trims only the oldest rows so it never regresses — the web
+        tier's revision-keyed ETag (and a follow poller's tail
+        bootstrap) key off this instead of re-running the query."""
+        with self._lock:
+            r = self._db.execute(
+                "SELECT seq FROM sqlite_sequence WHERE name='job_log'"
+            ).fetchone()
+        return int(r["seq"]) if r else 0
+
+    def logmap(self, n=None, hash=None):
+        """The sharded-result-plane topology pin (the store's shardmap,
+        result-plane edition): with arguments, publish {n, hash} if no
+        pin exists yet and return whatever pin now holds; without
+        arguments, a read-only peek (None when unpinned).  Lives on
+        shard 0 by fiat so a client can check it knowing only the
+        address list; a mismatched client refuses to start instead of
+        scattering one job's history under two layouts."""
+        with self._lock:
+            if n is not None:
+                self._db.execute(
+                    "INSERT OR IGNORE INTO meta VALUES ('logmap', ?)",
+                    (json.dumps({"n": int(n), "hash": hash},
+                                sort_keys=True),))
+                self._db.commit()
+            r = self._db.execute(
+                "SELECT v FROM meta WHERE k='logmap'").fetchone()
+        return json.loads(r["v"]) if r else None
 
     # ---- stats -----------------------------------------------------------
 

@@ -44,7 +44,7 @@ from .joblog import JobLogStore, LogRecord
 _PLAIN_OPS = ("get_log", "stat_overall", "stat_day", "stat_days",
               "upsert_node", "set_node_alived", "get_nodes", "get_node",
               "upsert_account", "get_account", "list_accounts",
-              "delete_account", "op_stats")
+              "delete_account", "op_stats", "revision", "logmap")
 
 
 def _rec_wire(rec: Optional[LogRecord]):
@@ -321,6 +321,18 @@ class RemoteJobLogStore:
         """Server-side per-op timing snapshot (JobLogStore.op_stats —
         bulk create vs query attribution for the result plane)."""
         return self._call("op_stats")
+
+    def revision(self) -> int:
+        """Monotone change token (max record id ever assigned) — the
+        web tier's ETag key and the follow poller's tail bootstrap."""
+        return self._call("revision")
+
+    def logmap(self, n=None, hash=None):
+        """Topology pin (see JobLogStore.logmap): publish-if-absent with
+        arguments, read-only peek without."""
+        if n is None:
+            return self._call("logmap")
+        return self._call("logmap", n, hash)
 
     def upsert_node(self, node_id: str, doc: str, alived: bool):
         self._call("upsert_node", node_id, doc, alived)

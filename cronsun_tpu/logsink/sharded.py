@@ -1,0 +1,470 @@
+"""Horizontal result-plane sharding: a routing client over N ``logd``
+shards.
+
+The shard ladder proved the dispatch store scales past one process, and
+measured the UNSHARDED logd sink as the new wall (~33k records/s on the
+bench host, logd op_stats showing 60 s of busy time in a 13 s run).
+This module partitions the RESULT keyspace across N independent logd
+processes — each a perfectly ordinary ``cronsun-logd`` (same wire
+protocol, same WAL/SQLite sidecar, just a smaller record space) — and
+gives every component a drop-in client with the exact JobLogStore
+surface, mirroring ``store/sharded.py`` end to end.
+
+Routing — deterministic, shared with ``native/agentd.cc`` bit-for-bit:
+
+- the token is the record's ``job_id``, hashed with the same 64-bit
+  FNV-1a the store shards use (:func:`~cronsun_tpu.store.sharded.fnv1a`
+  — Python's salted builtin hash can't agree across processes).  A
+  job's ``job_log`` rows, its ``job_latest_log`` entries, and its
+  retention trim therefore all live on ONE shard: the hot write path
+  (an agent's bulk flush) splits per shard and fans out concurrently,
+  and the common dashboard filter ("this job's history") is a
+  single-shard read.
+- ``node`` and ``account`` tables pin to SHARD 0 — tiny, single-writer,
+  not worth scattering.
+
+Record ids are encoded ``raw * N + shard`` so they stay globally unique
+and decodable: ``get_log`` routes by ``id % N``, and a follow poller
+can recover each record's shard from the id alone.
+
+Writes: :meth:`ShardedJobLogStore.create_job_logs` splits the batch by
+job token, derives ONE pinned idempotency token per sub-batch from the
+caller's batch token (``idem + ".s<shard>"`` — deterministic, so a
+whole-batch retry re-derives the same per-shard tokens), and fans the
+sub-batches out concurrently.  A retry after a partial failure re-sends
+every sub-batch; shards that already applied dedup server-side — the
+PR 4 whole-batch retry contract, unchanged PER SHARD.
+
+Reads scatter-gather:
+
+- ``query_logs`` fetches up to ``page * page_size`` candidates per
+  shard (paging the shard at a fixed stride) and merge-sorts with a
+  DOCUMENTED stable tie order so paging is deterministic:
+  ``(begin_ts DESC, shard ASC, id ASC)`` for history rows, and
+  ``(begin_ts DESC, job_id ASC, node ASC)`` for the id-less latest
+  view — the latter is exactly the order both backends pin, so the
+  merged latest view is byte-identical to an unsharded sink's.
+- cursor mode (``after_id``) becomes a PER-SHARD CURSOR VECTOR (the
+  sharded store's revision-vector pattern): each shard keeps its own
+  monotone id space, so one scalar cannot resume N independent
+  streams without missing a slow shard's records.  Results merge by
+  ``(raw id ASC, shard ASC)`` and carry encoded ids; the consumer
+  advances its vector per delivered record (:func:`advance_cursor`).
+- ``stat_overall`` / ``stat_day`` / ``stat_days`` sum per-shard
+  counters — exact, because every record lands on exactly one shard
+  (and a day in the global top-n is by date order within every
+  shard's top-n where present).
+
+The shard topology is pinned by a ``logmap`` record on shard 0: the
+first client publishes ``{"n": N, "hash": HASH}``, every later client
+verifies it, and a client configured with a different shard count
+refuses to start instead of scattering one job's history under two
+layouts.  With ONE shard every operation passes through verbatim — no
+split, no id encoding, no pin write (:func:`connect_sharded_sink`
+returns the plain client after a read-only pin check).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..store.sharded import fnv1a
+from .joblog import LogRecord
+
+LOG_HASH_SCHEME = "fnv1a-job-v1"
+
+
+def log_shard_index(job_id: str, nshards: int) -> int:
+    """The routing hash: 64-bit FNV-1a of the raw ``job_id`` mod N —
+    deterministic across processes and languages (native/agentd.cc
+    carries the same constants)."""
+    if nshards <= 1:
+        return 0
+    return fnv1a(job_id) % nshards
+
+
+def encode_log_id(raw: int, shard: int, nshards: int) -> int:
+    """Globally-unique record id: ``raw * N + shard``.  Monotone per
+    shard, decodable without a lookup."""
+    return raw * nshards + shard
+
+
+def decode_log_id(gid: int, nshards: int) -> Tuple[int, int]:
+    """-> (raw per-shard id, shard index)."""
+    return gid // nshards, gid % nshards
+
+
+def advance_cursor(vec: Sequence[int], recs, nshards: int) -> List[int]:
+    """Next per-shard cursor vector after consuming ``recs`` (records
+    with ENCODED ids, as returned by a sharded cursor query): each
+    delivered record advances its own shard's entry; shards that
+    delivered nothing keep theirs."""
+    out = list(vec)
+    for r in recs:
+        if r.id is None:
+            continue
+        raw, si = decode_log_id(r.id, nshards)
+        if raw > out[si]:
+            out[si] = raw
+    return out
+
+
+class ShardedJobLogStore:
+    """Routing client over N result-store shards with the full
+    JobLogStore surface — agents, web, noticer and ctl run unchanged
+    against it.
+
+    ``shards`` is a list of sink clients (RemoteJobLogStore per shard
+    in production; in-process JobLogStore works too, which is what the
+    differential tests use)."""
+
+    def __init__(self, shards: Sequence, verify_map: bool = True):
+        if not shards:
+            raise ValueError("ShardedJobLogStore needs at least one shard")
+        self.shards = list(shards)
+        self.nshards = len(self.shards)
+        self._pool = (ThreadPoolExecutor(
+            max_workers=max(2, 2 * self.nshards),
+            thread_name_prefix="logshard-fan") if self.nshards > 1 else None)
+        self._lock = threading.Lock()
+        if self.nshards > 1 and verify_map:
+            self._pin_log_map()
+
+    # ---- routing ---------------------------------------------------------
+
+    def _idx(self, job_id: str) -> int:
+        return log_shard_index(job_id, self.nshards)
+
+    def _fan(self, fns):
+        """Run thunks concurrently (one per shard touched); re-raises
+        the first failure after all complete."""
+        fns = list(fns)
+        if len(fns) == 1 or self._pool is None:
+            return [fn() for fn in fns]
+        futs = [self._pool.submit(fn) for fn in fns]
+        out, first_err = [], None
+        for f in futs:
+            try:
+                out.append(f.result())
+            except BaseException as e:  # noqa: BLE001 — collected below
+                out.append(None)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return out
+
+    def _pin_log_map(self):
+        got = self.shards[0].logmap(self.nshards, LOG_HASH_SCHEME)
+        if not isinstance(got, dict) or got.get("n") != self.nshards \
+                or got.get("hash") != LOG_HASH_SCHEME:
+            raise RuntimeError(
+                f"logmap mismatch: result-store set was laid out as "
+                f"{got!r}, this client is configured for "
+                f"{{'n': {self.nshards}, 'hash': {LOG_HASH_SCHEME!r}}} — "
+                "refusing to scatter one job's history under two "
+                "topologies")
+
+    # ---- writes ----------------------------------------------------------
+
+    def create_job_log(self, rec: LogRecord, idem: str = ""):
+        # idem passes through untouched (the wire client mints its own
+        # per-call token when empty, exactly the unsharded behavior)
+        si = self._idx(rec.job_id)
+        self.shards[si].create_job_log(rec, idem=idem)
+        if rec.id is not None:
+            rec.id = encode_log_id(rec.id, si, self.nshards)
+        return rec.id
+
+    def create_job_logs(self, recs, idem: str = "") -> list:
+        """Split the batch by job token, fan the sub-batches out
+        concurrently — one bulk RPC per shard touched, each riding a
+        per-shard idempotency token DERIVED from the batch token
+        (``idem + ".s<shard>"``).  A caller retrying the whole logical
+        batch (the agents' record flushers, token pinned) re-derives
+        the same per-shard tokens, so shards that applied the first
+        attempt dedup server-side while the failed shard gets its
+        records — whole-batch retry, per shard.  Raises on ANY shard
+        failing (after every sub-batch settles), matching the
+        unsharded client's all-or-retry contract."""
+        recs = list(recs)
+        if not recs:
+            return []
+        groups: Dict[int, list] = {}
+        for pos, r in enumerate(recs):
+            groups.setdefault(self._idx(r.job_id), []).append((pos, r))
+
+        def send(si, group):
+            sub = [r for _p, r in group]
+            # no caller token -> each shard's wire client mints its own
+            # per-call token (a bare ".s<i>" suffix would be one shared
+            # token for EVERY token-less batch — a dedup collision)
+            self.shards[si].create_job_logs(
+                sub, idem=f"{idem}.s{si}" if idem else "")
+        self._fan([lambda si=si, g=g: send(si, g)
+                   for si, g in groups.items()])
+        for si, group in groups.items():
+            for _pos, r in group:
+                if r.id is not None:
+                    r.id = encode_log_id(r.id, si, self.nshards)
+        return [r.id for r in recs]
+
+    # ---- queries ---------------------------------------------------------
+
+    def _fetch_top(self, si: int, kw: dict, need: int):
+        """Top ``need`` rows from shard ``si`` under ``kw``'s filters
+        (the shard's own order), paging at a fixed stride so backend
+        OFFSET math stays consistent.  -> (rows, shard total)."""
+        ps = max(1, min(500, need))
+        out: List[LogRecord] = []
+        total = 0
+        page = 1
+        while len(out) < need:
+            rows, total = self.shards[si].query_logs(
+                **kw, page=page, page_size=ps)
+            out.extend(rows)
+            if len(rows) < ps:
+                break
+            page += 1
+        return out[:need], total
+
+    def query_logs(self, node: Optional[str] = None,
+                   job_ids: Optional[List[str]] = None,
+                   name_like: Optional[str] = None,
+                   begin: Optional[float] = None,
+                   end: Optional[float] = None,
+                   failed_only: bool = False,
+                   latest: bool = False,
+                   page: int = 1, page_size: int = 50,
+                   after_id=None) -> Tuple[List[LogRecord], int]:
+        """Scatter-gather read.  ``after_id`` in SHARDED cursor mode is
+        a per-shard raw-id VECTOR (list/tuple, one entry per shard;
+        scalar 0 means "from the beginning everywhere") — one scalar
+        cannot resume N independent id spaces without skipping a slow
+        shard's records.  Cursor results merge by (raw id ASC, shard
+        ASC) with total pinned to -1; the consumer advances its vector
+        from the delivered encoded ids (:func:`advance_cursor`)."""
+        kw = dict(node=node, job_ids=job_ids, name_like=name_like,
+                  begin=begin, end=end, failed_only=failed_only,
+                  latest=latest)
+        page = max(1, min(page, 1 << 40))
+        page_size = max(1, min(page_size, 500))
+        # a job-filtered read touches only the filter's shards — the
+        # dashboard's "this job's history" is a single-shard read
+        sids = sorted({self._idx(j) for j in job_ids}) if job_ids \
+            else list(range(self.nshards))
+
+        if after_id is not None and not latest:
+            if isinstance(after_id, (list, tuple)):
+                if len(after_id) != self.nshards:
+                    raise ValueError(
+                        f"cursor vector has {len(after_id)} entries for "
+                        f"{self.nshards} shards")
+                vec = [int(v) for v in after_id]
+            elif int(after_id) == 0:
+                vec = [0] * self.nshards
+            else:
+                raise ValueError(
+                    "a sharded sink resumes from a per-shard cursor "
+                    "vector (advance_cursor()), not a scalar id")
+            parts = self._fan([
+                lambda si=si: (si, self.shards[si].query_logs(
+                    **kw, after_id=vec[si], page=1,
+                    page_size=page_size)[0])
+                for si in sids])
+            merged = [(r.id, si, r) for si, rows in parts for r in rows]
+            merged.sort(key=lambda t: (t[0], t[1]))
+            out = []
+            for raw, si, r in merged[:page_size]:
+                r.id = encode_log_id(raw, si, self.nshards)
+                out.append(r)
+            return out, -1
+
+        need = page * page_size
+        parts = self._fan([
+            lambda si=si: (si, *self._fetch_top(si, kw, need))
+            for si in sids])
+        total = sum(t for _si, _rows, t in parts)
+        if latest:
+            # both backends pin (begin_ts DESC, job_id, node) and the
+            # (job, node) space partitions by shard, so this merge IS
+            # the global order — byte-identical to an unsharded sink
+            rows = [r for _si, part, _t in parts for r in part]
+            rows.sort(key=lambda r: (-r.begin_ts, r.job_id, r.node))
+        else:
+            # documented cross-shard tie order: (begin_ts DESC, shard
+            # ASC, id ASC) — per-shard order is preserved, ties across
+            # shards break deterministically so page N+1 never
+            # re-serves or skips a row page N touched
+            keyed = [(-r.begin_ts, si, r.id, r)
+                     for si, part, _t in parts for r in part]
+            keyed.sort(key=lambda t: t[:3])
+            rows = []
+            for _b, si, raw, r in keyed:
+                r.id = encode_log_id(raw, si, self.nshards)
+                rows.append(r)
+        return rows[(page - 1) * page_size: page * page_size], total
+
+    def get_log(self, log_id: int) -> Optional[LogRecord]:
+        raw, si = decode_log_id(int(log_id), self.nshards)
+        rec = self.shards[si].get_log(raw)
+        if rec is not None and rec.id is not None:
+            rec.id = encode_log_id(rec.id, si, self.nshards)
+        return rec
+
+    # ---- stats (exact per-shard summation) -------------------------------
+
+    @staticmethod
+    def _sum_stats(parts: List[dict]) -> dict:
+        return {k: sum(p[k] for p in parts)
+                for k in ("total", "successed", "failed")}
+
+    def stat_overall(self) -> dict:
+        return self._sum_stats(self._fan([lambda s=s: s.stat_overall()
+                                          for s in self.shards]))
+
+    def stat_day(self, day: str) -> dict:
+        return self._sum_stats(self._fan([lambda s=s: s.stat_day(day)
+                                          for s in self.shards]))
+
+    def stat_days(self, n_days: int) -> List[dict]:
+        # each shard's top-n days contain every one of its days that
+        # falls in the GLOBAL top-n (day order is global), so summing
+        # per day over the per-shard lists is exact
+        parts = self._fan([lambda s=s: s.stat_days(n_days)
+                           for s in self.shards])
+        days: Dict[str, List[int]] = {}
+        for part in parts:
+            for d in part:
+                ent = days.setdefault(d["day"], [0, 0, 0])
+                ent[0] += d["total"]
+                ent[1] += d["successed"]
+                ent[2] += d["failed"]
+        return [{"day": day, "total": t, "successed": s, "failed": f}
+                for day, (t, s, f) in
+                sorted(days.items(), reverse=True)[:max(0, n_days)]]
+
+    # ---- change revision / ops -------------------------------------------
+
+    def revision(self) -> List[int]:
+        """Per-shard revision VECTOR (each entry that shard's max
+        record id) — the web tier's ETag key and a follow poller's
+        tail-cursor bootstrap in one read."""
+        return self._fan([lambda s=s: s.revision() for s in self.shards])
+
+    def op_stats(self) -> dict:
+        """Per-op stats MERGED across shards (counts/total summed,
+        max_ms maxed) — same shape as a single sink's."""
+        parts = self.op_stats_shards()
+        if len(parts) == 1:
+            return parts[0]
+        merged: Dict[str, dict] = {}
+        for part in parts:
+            for op, ent in part.items():
+                m = merged.setdefault(op, {"count": 0, "total_ms": 0.0,
+                                           "max_ms": 0.0})
+                m["count"] += ent.get("count", 0)
+                m["total_ms"] = round(
+                    m["total_ms"] + ent.get("total_ms", 0.0), 3)
+                m["max_ms"] = max(m["max_ms"], ent.get("max_ms", 0.0))
+        return merged
+
+    def op_stats_shards(self) -> List[dict]:
+        """Per-SHARD op stats, shard order — /v1/metrics renders these
+        with a ``shard`` label when more than one is present."""
+        return self._fan([lambda s=s: s.op_stats() for s in self.shards])
+
+    def logmap(self, n=None, hash=None):
+        return self.shards[0].logmap(n, hash)
+
+    # ---- node mirror + accounts (tiny, single-writer: shard 0) -----------
+
+    def upsert_node(self, node_id: str, doc: str, alived: bool):
+        self.shards[0].upsert_node(node_id, doc, alived)
+
+    def set_node_alived(self, node_id: str, alived: bool):
+        self.shards[0].set_node_alived(node_id, alived)
+
+    def get_nodes(self) -> List[dict]:
+        return self.shards[0].get_nodes()
+
+    def get_node(self, node_id: str) -> Optional[dict]:
+        return self.shards[0].get_node(node_id)
+
+    def upsert_account(self, email: str, doc: str):
+        self.shards[0].upsert_account(email, doc)
+
+    def get_account(self, email: str) -> Optional[str]:
+        return self.shards[0].get_account(email)
+
+    def list_accounts(self) -> List[str]:
+        return self.shards[0].list_accounts()
+
+    def delete_account(self, email: str) -> bool:
+        return self.shards[0].delete_account(email)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def close(self):
+        for s in self.shards:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+
+def verify_single_sink(sink):
+    """Topology pin for a SINGLE-address client: a stale one-logd
+    config pointed at shard 0 of a multi-shard layout must refuse (it
+    would see a fraction of every job's history and write new records
+    into the wrong id space), not silently serve.  Read-only — an
+    un-sharded deployment never writes the pin, so its behavior is
+    unchanged."""
+    try:
+        got = sink.logmap()
+    except Exception:  # noqa: BLE001 — pre-logmap server: nothing to pin
+        return
+    if got is None:
+        return
+    if not isinstance(got, dict) or got.get("n") != 1:
+        raise RuntimeError(
+            f"logmap mismatch: result-store set was laid out as {got!r}, "
+            "this client is configured for a single result store — "
+            "refusing to scatter one job's history under two topologies")
+
+
+def connect_sharded_sink(addrs: Sequence[str], timeout: float = 10.0,
+                         token: str = "", sslctx=None,
+                         tls_hostname: str = ""):
+    """Connect a routing client to a logd shard set.  One address
+    returns a plain RemoteJobLogStore (byte-identical single-sink
+    behavior) after the read-only pin check; several return a
+    ShardedJobLogStore that pins/verifies the logmap."""
+    from .serve import RemoteJobLogStore
+    addrs = [a for a in addrs if a]
+    if not addrs:
+        raise ValueError("logsink address list has no host:port entries")
+    conns = []
+    try:
+        for addr in addrs:
+            host, _, port = addr.rpartition(":")
+            conns.append(RemoteJobLogStore(host or "127.0.0.1", int(port),
+                                           timeout=timeout, token=token,
+                                           sslctx=sslctx,
+                                           tls_hostname=tls_hostname))
+    except BaseException:
+        for c in conns:
+            c.close()
+        raise
+    if len(conns) == 1:
+        try:
+            verify_single_sink(conns[0])
+        except BaseException:
+            conns[0].close()
+            raise
+        return conns[0]
+    return ShardedJobLogStore(conns)

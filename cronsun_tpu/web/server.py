@@ -48,6 +48,15 @@ class HttpError(Exception):
         self.msg = msg
 
 
+class NotModified(HttpError):
+    """304 via If-None-Match: the client's cached body is current.
+    Carries the ETag so the transport can re-assert it; no body."""
+
+    def __init__(self, etag: str):
+        super().__init__(304, "not modified")
+        self.etag = etag
+
+
 class PlainText(str):
     """Handler return type served as text/plain instead of JSON
     (the /v1/metrics Prometheus exposition)."""
@@ -125,6 +134,8 @@ class ApiServer:
               self.job_execute)
         route("GET", r"/v1/logs", self.log_list)
         route("GET", r"/v1/log/(?P<id>\d+)", self.log_detail)
+        route("GET", r"/v1/stat/overall", self.stat_overall)
+        route("GET", r"/v1/stat/days", self.stat_days)
         route("GET", r"/v1/nodes", self.node_list)
         route("GET", r"/v1/node/groups", self.group_list)
         route("GET", r"/v1/node/group/(?P<id>[^/]+)", self.group_get)
@@ -361,20 +372,102 @@ class ApiServer:
 
     # ---- handlers: logs --------------------------------------------------
 
+    def _sink_revision(self):
+        """The result store's change token: scalar max record id
+        (unsharded) or the per-shard vector (sharded) — one cheap read
+        instead of re-running the dashboard query."""
+        rev = getattr(self.sink, "revision", None)
+        if rev is None:
+            return None
+        try:
+            return rev()
+        except Exception:  # noqa: BLE001 — pre-revision server
+            return None
+
+    @staticmethod
+    def _rev_str(rev) -> str:
+        return ",".join(str(v) for v in rev) \
+            if isinstance(rev, (list, tuple)) else str(rev)
+
+    def _etag_guard(self, ctx, extra: str = ""):
+        """Revision-keyed ETag for the read endpoints: repeated
+        dashboard polls answer ``304 Not Modified`` in O(1) — one
+        revision read, no query — whenever nothing was written since
+        the poll that produced the cached body.  ``extra``
+        discriminates endpoints sharing the same revision key (a
+        stat_days body and a latest-view body must not satisfy each
+        other's cache)."""
+        rev = self._sink_revision()
+        if rev is None:
+            return
+        etag = f'W/"{extra}{self._rev_str(rev)}"'
+        if ctx.header("If-None-Match") == etag:
+            raise NotModified(etag)
+        ctx.out_headers["ETag"] = etag
+
     def log_list(self, ctx):
-        recs, total = self.sink.query_logs(
-            node=ctx.q("node") or None,
-            job_ids=ctx.q("ids").split(",") if ctx.q("ids") else None,
-            name_like=ctx.q("names") or None,
-            begin=ctx.q_float("begin"),
-            end=ctx.q_float("end"),
-            failed_only=ctx.q("failedOnly") in ("true", "1"),
-            latest=ctx.q("latest") in ("true", "1"),
-            page=ctx.q_int("page", 1),
-            page_size=ctx.q_int("pageSize", 50),
-            # cursor mode for pollers: id > afterId, ordered id ASC
-            after_id=ctx.q_int("afterId"))
-        return {"total": total, "list": [self._log_dict(r) for r in recs]}
+        latest = ctx.q("latest") in ("true", "1")
+        if latest:
+            # the latest view is THE dashboard poll: revision-keyed 304
+            # makes an idle dashboard O(1) per poll
+            self._etag_guard(ctx, "logs:")
+        nshards = getattr(self.sink, "nshards", 1)
+        after_raw = ctx.q("afterId")
+        after_id = None
+        if after_raw and not latest:
+            if after_raw == "tail":
+                # cursor bootstrap: the revision IS the tail cursor
+                # (max assigned id, per shard when sharded) — a follow
+                # poller starts here instead of draining history
+                rev = self._sink_revision()
+                if rev is None:
+                    raise HttpError(400, "sink has no revision support")
+                return {"total": -1, "list": [],
+                        "cursor": self._rev_str(rev)}
+            try:
+                if "," in after_raw:
+                    after_id = [int(v) for v in after_raw.split(",")]
+                else:
+                    after_id = int(after_raw)
+            except ValueError:
+                raise HttpError(
+                    400, f"bad integer for 'afterId': {after_raw!r}")
+        try:
+            recs, total = self.sink.query_logs(
+                node=ctx.q("node") or None,
+                job_ids=ctx.q("ids").split(",") if ctx.q("ids") else None,
+                name_like=ctx.q("names") or None,
+                begin=ctx.q_float("begin"),
+                end=ctx.q_float("end"),
+                failed_only=ctx.q("failedOnly") in ("true", "1"),
+                latest=latest,
+                page=ctx.q_int("page", 1),
+                page_size=ctx.q_int("pageSize", 50),
+                # cursor mode for pollers: id > afterId (scalar, or the
+                # per-shard vector a sharded sink's poller carries)
+                after_id=after_id)
+        except (ValueError, TypeError) as e:
+            # a scalar cursor against a sharded sink, a wrong-length
+            # vector, or a vector against an UNSHARDED sink (a stale
+            # poller after a topology change — int(list) is the
+            # TypeError) is a client error, not a 500
+            raise HttpError(400, str(e))
+        out = {"total": total, "list": [self._log_dict(r) for r in recs]}
+        if after_id is not None:
+            # the poller's next cursor: per delivered record (encoded
+            # ids carry the shard), shards that delivered nothing keep
+            # their entry
+            vec = after_id if isinstance(after_id, list) else \
+                ([0] * nshards if nshards > 1 else None)
+            if vec is not None:
+                from ..logsink.sharded import advance_cursor
+                out["cursor"] = self._rev_str(
+                    advance_cursor(vec, recs, nshards))
+            else:
+                nxt = max([after_id] + [r.id for r in recs
+                                        if r.id is not None])
+                out["cursor"] = str(nxt)
+        return out
 
     @staticmethod
     def _log_dict(r) -> dict:
@@ -389,6 +482,17 @@ class ApiServer:
         if rec is None:
             raise HttpError(404, "no such log")
         return self._log_dict(rec)
+
+    # ---- handlers: stats (revision-keyed, 304 on unchanged) -------------
+
+    def stat_overall(self, ctx):
+        self._etag_guard(ctx, "so:")
+        return self.sink.stat_overall()
+
+    def stat_days(self, ctx):
+        n = ctx.q_int("days", 7)
+        self._etag_guard(ctx, f"sd{n}:")
+        return self.sink.stat_days(max(0, min(n or 0, 3660)))
 
     # ---- handlers: nodes + groups ---------------------------------------
 
@@ -589,9 +693,9 @@ class ApiServer:
     # ---- plumbing --------------------------------------------------------
 
     def handle(self, method: str, path: str, query: dict, body: bytes,
-               cookies: dict):
+               cookies: dict, headers: Optional[dict] = None):
         """Transport-independent dispatch (tests call this directly)."""
-        ctx = _Ctx(query, body, cookies)
+        ctx = _Ctx(query, body, cookies, headers)
         for m, rx, fn, need_auth, need_admin in self.routes:
             if m != method:
                 continue
@@ -638,7 +742,8 @@ class ApiServer:
                 ctype = "application/json"
                 try:
                     result, ctx = server.handle(method, parsed.path, query,
-                                                body, cookies)
+                                                body, cookies,
+                                                dict(self.headers))
                     if isinstance(result, PlainText):
                         payload = result.encode()
                         ctype = "text/plain; version=0.0.4"
@@ -648,6 +753,15 @@ class ApiServer:
                     for k, v in ctx.out_cookies.items():
                         self.send_header(
                             "Set-Cookie", f"sid={v}; Path=/; HttpOnly")
+                    for k, v in ctx.out_headers.items():
+                        self.send_header(k, v)
+                except NotModified as e:
+                    # per RFC 9110 a 304 carries no body — just the
+                    # validator the cached response stays keyed on
+                    self.send_response(304)
+                    self.send_header("ETag", e.etag)
+                    self.end_headers()
+                    return
                 except HttpError as e:
                     payload = json.dumps({"error": e.msg}).encode()
                     self.send_response(e.status)
@@ -686,13 +800,16 @@ class ApiServer:
 
 
 class _Ctx:
-    def __init__(self, query: dict, body: bytes, cookies: dict):
+    def __init__(self, query: dict, body: bytes, cookies: dict,
+                 headers: Optional[dict] = None):
         self.query = query
         self.body = body
         self.cookies = cookies
+        self.headers = headers or {}
         self.path_args: dict = {}
         self.session = None
         self.out_cookies: dict = {}
+        self.out_headers: dict = {}
 
     @property
     def sid(self) -> str:
@@ -700,6 +817,13 @@ class _Ctx:
 
     def q(self, name: str) -> str:
         return self.query.get(name, "")
+
+    def header(self, name: str) -> str:
+        """Request header, case-insensitive."""
+        for k, v in self.headers.items():
+            if k.lower() == name.lower():
+                return v
+        return ""
 
     def q_int(self, name: str, default=None):
         """Query int with a 400 (not a 500) on malformed values."""

@@ -1331,6 +1331,123 @@ class LogClient {
 };
 
 // ---------------------------------------------------------------------------
+// sharded result-store client
+// ---------------------------------------------------------------------------
+//
+// N independent logd shards behind the LogClient surface.  Routing is
+// the shared deterministic scheme of cronsun_tpu/logsink/sharded.py —
+// the record's JOB ID hashed with the same 64-bit FNV-1a the store
+// shards use — so a job's log rows, its latest entry and its retention
+// trim co-locate on one shard.  The record flusher splits each bulk
+// flush per shard and fans the sub-batches out concurrently, each
+// riding an idempotency token DERIVED from the batch token
+// (idem + ".s<i>") so a whole-batch retry re-derives the same tokens
+// and an applied shard dedups server-side (the PR 4 whole-batch retry
+// contract, per shard).  Node-mirror ops pin to shard 0 (tiny,
+// single-writer).  With ONE shard everything passes through verbatim,
+// plain token included.
+
+class ShardedLogClient {
+ public:
+  ShardedLogClient(const std::vector<std::pair<std::string, int>>& addrs,
+                   const std::string& token) {
+    for (const auto& [h, p] : addrs)
+      shards_.emplace_back(new LogClient(h, p, token));
+    n_ = shards_.size();
+  }
+
+  size_t n() const { return n_; }
+
+  size_t shard_of(const std::string& job_id) const {
+    return n_ <= 1 ? 0 : (size_t)(fnv1a64(job_id) % n_);
+  }
+
+  // node/account/stat ops pin to shard 0 by design
+  bool call(const std::string& op, const std::string& args_json,
+            std::string& reply_line) {
+    return shards_[0]->call(op, args_json, reply_line);
+  }
+
+  bool call_shard(size_t i, const std::string& op,
+                  const std::string& args_json, std::string& reply_line) {
+    return shards_[i]->call(op, args_json, reply_line);
+  }
+
+  // topology pin: publish (or verify) the logmap record on shard 0 —
+  // two clients with different shard counts must not scatter one job's
+  // history under two layouts.  Single-address clients do a read-only
+  // check (an un-sharded deployment never writes the pin; a pre-logmap
+  // server erroring on the op passes, since there is nothing to pin).
+  bool verify_log_map() {
+    std::string rep;
+    std::string args = "[]";
+    if (n_ > 1) {
+      args = "[";
+      jint(args, (long long)n_);
+      args += ",\"fnv1a-job-v1\"]";
+    }
+    if (!shards_[0]->call("logmap", args, rep)) {
+      if (n_ <= 1) {
+        // advisory-only for a single address: the agent has always
+        // tolerated starting while the sink is down (records buffer in
+        // rec_buf_ and flush on reconnect) — don't turn an outage into
+        // a hard exit.  A SHARDED config must verify before routing.
+        fprintf(stderr,
+                "logmap check skipped: result store unreachable "
+                "(records will buffer)\n");
+        return true;
+      }
+      fprintf(stderr, "logmap read failed on shard 0\n");
+      return false;
+    }
+    JParser jp(rep);
+    JV v;
+    const JV* r = nullptr;
+    bool has_err = false;
+    if (jp.value(v) && v.t == JV::OBJ) {
+      r = v.get("r");
+      has_err = v.get("e") != nullptr;
+    }
+    if (has_err || r == nullptr) {
+      if (n_ <= 1) return true;   // pre-logmap server: nothing to pin
+      fprintf(stderr, "logmap op unsupported by shard 0 — cannot pin "
+              "a %zu-shard result-plane topology\n", n_);
+      return false;
+    }
+    if (r->t == JV::NUL) return n_ <= 1;  // n>1 pin write cannot no-op
+    long long got_n = -1;
+    std::string got_hash;
+    if (r->t == JV::OBJ) {
+      if (const JV* nn = r->get("n")) got_n = nn->as_int();
+      if (const JV* hh = r->get("hash")) got_hash = hh->s;
+    }
+    if (n_ <= 1) {
+      if (got_n == 1) return true;
+      fprintf(stderr,
+              "logmap mismatch: result-store set was laid out with n=%lld, "
+              "this agent is configured for a single result store\n", got_n);
+      return false;
+    }
+    if (got_n != (long long)n_ || got_hash != "fnv1a-job-v1") {
+      fprintf(stderr,
+              "logmap mismatch: result-store set was laid out with n=%lld "
+              "hash=%s, this agent is configured for %zu shards\n",
+              got_n, got_hash.c_str(), n_);
+      return false;
+    }
+    return true;
+  }
+
+  void close() {
+    for (auto& s : shards_) s->close();
+  }
+
+ private:
+  std::vector<std::unique_ptr<LogClient>> shards_;
+  size_t n_ = 0;
+};
+
+// ---------------------------------------------------------------------------
 // executor (fork/exec, setuid, process-group timeout, retry, gate)
 // ---------------------------------------------------------------------------
 
@@ -1687,7 +1804,8 @@ static bool parse_job(const std::string& json, JobSpec& j) {
 
 class Agent {
  public:
-  Agent(ShardedStoreClient& store, LogClient& logd, std::string node_id,
+  Agent(ShardedStoreClient& store, ShardedLogClient& logd,
+        std::string node_id,
         std::string prefix, double ttl, double proc_ttl, double lock_ttl,
         double proc_req, int workers)
       : store_(store), logd_(logd), id_(std::move(node_id)),
@@ -2875,7 +2993,7 @@ class Agent {
     rec += ",\"id\":null}";
     {
       std::lock_guard<std::mutex> g(rec_mu_);
-      rec_buf_.push_back(std::move(rec));
+      rec_buf_.emplace_back(j.id, std::move(rec));
       // sink-outage backstop: drop oldest past the cap instead of
       // absorbing the outage in unbounded memory (chunked trim, same
       // hysteresis as agent.py)
@@ -2915,24 +3033,53 @@ class Agent {
     }
   }
 
-  // one bulk write attempt; the whole batch rides ONE idempotency
-  // token, so a retry of an applied-but-reply-lost attempt replays the
-  // original ids server-side instead of double-inserting
-  bool send_records(const std::vector<std::string>& batch,
-                    const std::string& idem) {
-    std::string args = "[[";
-    for (size_t i = 0; i < batch.size(); i++) {
-      if (i) args += ',';
-      args += batch[i];
+  // one bulk write attempt.  The batch SPLITS per result-store shard
+  // (by each record's job_id — the deterministic fnv1a routing) and
+  // the sub-batches fan out CONCURRENTLY, each riding an idempotency
+  // token DERIVED from the whole-batch token (idem + ".s<i>"; single
+  // shard: the plain token, wire-identical to the unsharded client).
+  // A retry of the same logical batch re-derives the same per-shard
+  // tokens, so a shard whose first attempt applied with the reply
+  // lost replays its original ids server-side instead of
+  // double-inserting — the whole-batch retry contract, per shard.
+  bool send_records(
+      const std::vector<std::pair<std::string, std::string>>& batch,
+      const std::string& idem) {
+    size_t n = logd_.n();
+    std::vector<std::vector<const std::string*>> groups(n);
+    for (const auto& [jid, rec] : batch)
+      groups[logd_.shard_of(jid)].push_back(&rec);
+    std::vector<std::pair<size_t, std::string>> calls;
+    for (size_t i = 0; i < n; i++) {
+      if (groups[i].empty()) continue;
+      std::string args = "[[";
+      for (size_t k = 0; k < groups[i].size(); k++) {
+        if (k) args += ',';
+        args += *groups[i][k];
+      }
+      args += "],";
+      jesc(args, n == 1 ? idem : idem + ".s" + std::to_string(i));
+      args += "]";
+      calls.emplace_back(i, std::move(args));
     }
-    args += "],";
-    jesc(args, idem);
-    args += "]";
-    std::string rep;
-    if (!logd_.call("create_job_logs", args, rep)) return false;
-    JParser jp(rep);
-    JV v;
-    return jp.value(v) && v.t == JV::OBJ && v.get("e") == nullptr;
+    auto one = [this](size_t i, const std::string& args) {
+      std::string rep;
+      if (!logd_.call_shard(i, "create_job_logs", args, rep)) return false;
+      JParser jp(rep);
+      JV v;
+      return jp.value(v) && v.t == JV::OBJ && v.get("e") == nullptr;
+    };
+    if (calls.size() == 1)
+      return one(calls[0].first, calls[0].second);
+    std::atomic<bool> ok{true};
+    std::vector<std::thread> ts;
+    ts.reserve(calls.size());
+    for (const auto& [i, args] : calls)
+      ts.emplace_back([&, i = i, a = &args] {
+        if (!one(i, *a)) ok = false;
+      });
+    for (auto& t : ts) t.join();
+    return ok;
   }
 
   // Drain the buffer (and any parked retry batch) through ONE bulk RPC
@@ -2964,7 +3111,7 @@ class Agent {
         }
       }
     }
-    std::vector<std::string> batch;
+    std::vector<std::pair<std::string, std::string>> batch;
     {
       std::lock_guard<std::mutex> g(rec_mu_);
       batch.swap(rec_buf_);
@@ -3055,7 +3202,7 @@ class Agent {
   }
 
   ShardedStoreClient& store_;
-  LogClient& logd_;
+  ShardedLogClient& logd_;
   Executor exec_;
   std::string id_, pfx_, hostname_;
   double ttl_, proc_ttl_, lock_ttl_, proc_req_;
@@ -3091,14 +3238,16 @@ class Agent {
   std::atomic<long long> ack_flushes_{0}, ack_orders_{0},
       proc_deletes_{0}, proc_del_dropped_{0};
   double proc_drop_log_at_ = 0;  // rate-limits the overflow log line
-  // record flusher state (the Python agent's _flush_records twin)
+  // record flusher state (the Python agent's _flush_records twin);
+  // each buffered record carries its job_id so the flusher can split
+  // the batch per result-store shard without re-parsing the JSON
   std::mutex rec_mu_;                    // guards rec_buf_
-  std::vector<std::string> rec_buf_;     // serialized LogRecord objects
+  std::vector<std::pair<std::string, std::string>> rec_buf_;
   size_t rec_buf_max_ = 100000;
   std::mutex rec_flush_mu_;              // pop+send atomicity: the stop
                                          // barrier can't return while a
                                          // popped batch is in flight
-  std::vector<std::string> rec_retry_;   // failed batch, idem pinned
+  std::vector<std::pair<std::string, std::string>> rec_retry_;
   std::string rec_retry_idem_;
   double rec_retry_at_ = 0;
   int rec_flush_fails_ = 0;
@@ -3150,7 +3299,8 @@ int main(int argc, char** argv) {
       if (getppid() == 1) return 1;
     }
     else if (a == "--help") {
-      printf("cronsun-agentd --store H:P --logsink H:P --node-id ID "
+      printf("cronsun-agentd --store H:P[,H:P...] --logsink H:P[,H:P...] "
+             "--node-id ID "
              "[--prefix /cronsun] [--ttl S] [--proc-ttl S] [--lock-ttl S] "
              "[--proc-req S] [--rec-flush-interval S] [--workers N] "
              "[--store-token T] [--log-token T] [--die-with-parent] "
@@ -3206,34 +3356,39 @@ int main(int argc, char** argv) {
     p = atoi(a.c_str() + (c == std::string::npos ? 0 : c + 1));
     if (h.empty()) h = "127.0.0.1";
   };
-  std::string lh;
-  int lp = 0;
-  split_addr(logd_addr, lh, lp);
-
-  // --store accepts a comma-separated SHARD SET ("h1:7070,h2:7070"):
-  // more than one address routes the keyspace by the deterministic
-  // token hash (the Python client's store/sharded.py, mirrored above)
-  std::vector<std::pair<std::string, int>> store_addrs;
-  {
+  // --store and --logsink both accept comma-separated SHARD SETS
+  // ("h1:7070,h2:7070"): more than one address routes by the
+  // deterministic hash (store/sharded.py and logsink/sharded.py,
+  // mirrored above)
+  auto split_addrs = [&](const std::string& joined,
+                         std::vector<std::pair<std::string, int>>& out) {
     size_t start = 0;
-    while (start <= store_addr.size()) {
-      size_t comma = store_addr.find(',', start);
-      std::string one = store_addr.substr(
+    while (start <= joined.size()) {
+      size_t comma = joined.find(',', start);
+      std::string one = joined.substr(
           start, comma == std::string::npos ? std::string::npos
                                             : comma - start);
       if (!one.empty()) {
         std::string h;
         int p = 0;
         split_addr(one, h, p);
-        store_addrs.emplace_back(h, p);
+        out.emplace_back(h, p);
       }
       if (comma == std::string::npos) break;
       start = comma + 1;
     }
-  }
+  };
+  std::vector<std::pair<std::string, int>> store_addrs, log_addrs;
+  split_addrs(store_addr, store_addrs);
+  split_addrs(logd_addr, log_addrs);
   if (store_addrs.empty()) {
     fprintf(stderr,
             "--store %s has no host:port entries\n", store_addr.c_str());
+    return 1;
+  }
+  if (log_addrs.empty()) {
+    fprintf(stderr,
+            "--logsink %s has no host:port entries\n", logd_addr.c_str());
     return 1;
   }
   ShardedStoreClient store(store_addrs, store_token, prefix);
@@ -3242,7 +3397,8 @@ int main(int argc, char** argv) {
     return 1;
   }
   if (!store.verify_shard_map()) return 1;
-  LogClient logd(lh, lp, log_token);
+  ShardedLogClient logd(log_addrs, log_token);
+  if (!logd.verify_log_map()) return 1;
   Agent agent(store, logd, node_id, prefix, ttl, proc_ttl, lock_ttl,
               proc_req, workers);
   agent.set_instant_exec(instant_exec);

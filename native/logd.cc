@@ -457,7 +457,17 @@ class LogStore {
     if (latest) {
       for (const auto& [k, r] : latest_)
         if (match(r)) hits.push_back(&r);
-      sort_begin_desc(hits);
+      // the id-less latest view breaks begin_ts ties by its
+      // (job_id, node) primary key — pinned in BOTH backends so the
+      // sharded client's scatter-gather merge by the same key
+      // reproduces the global order exactly
+      std::stable_sort(hits.begin(), hits.end(),
+                       [](const Rec* a, const Rec* b) {
+                         if (a->begin != b->begin) return a->begin > b->begin;
+                         if (a->job_id != b->job_id)
+                           return a->job_id < b->job_id;
+                         return a->node < b->node;
+                       });
     } else if (after_id >= 0) {
       // cursor mode: ids are contiguous (retention only pops the
       // front — same invariant get_log exploits), so a poller's
@@ -479,7 +489,10 @@ class LogStore {
     page = std::min(page, (long long)1 << 40);
     size_t off = (size_t)((page - 1) * page_size);
     res += "{\"total\":";
-    jint(res, (long long)hits.size());
+    // cursor mode pins total == -1 (the SQLite backend's contract: a
+    // follow poller never reads it, and there it cost a full filtered
+    // COUNT(*) scan per poll)
+    jint(res, after_id >= 0 ? -1LL : (long long)hits.size());
     res += ",\"list\":[";
     for (size_t i = off; i < hits.size() && i < off + (size_t)page_size; i++) {
       if (i != off) res += ',';
@@ -495,6 +508,37 @@ class LogStore {
     const Rec& r = recs_[(size_t)(id - recs_.front().id)];
     rec_wire(res, r, true);
     return true;
+  }
+
+  // monotone change token for the read plane: the max record id ever
+  // assigned (0 when empty).  Creates bump it; retention only pops the
+  // front — the web tier's revision-keyed ETag and a follow poller's
+  // tail bootstrap read this instead of re-running the query.
+  long long revision() {
+    std::lock_guard<std::mutex> g(mu);
+    return next_id_ - 1;
+  }
+
+  // sharded-result-plane topology pin: with n >= 0, publish-if-absent
+  // {hash, n}; always replies with the current pin (or null).  The
+  // stored text matches the Python backend's json.dumps(sort_keys=True)
+  // byte for byte so a differential across backends can't diverge.
+  void logmap(long long n, const std::string& hash, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    if (n >= 0 && logmap_.empty()) {
+      logmap_ = "{\"hash\": ";
+      jesc(logmap_, hash);
+      logmap_ += ", \"n\": ";
+      jint(logmap_, n);
+      logmap_ += '}';
+      if (wal_) {
+        std::string line = "[\"M\",";
+        jesc(line, logmap_);
+        line += ']';
+        wal_->append(line);
+      }
+    }
+    res += logmap_.empty() ? "null" : logmap_;
   }
 
   void stat(const std::string& day, std::string& res) {
@@ -644,6 +688,12 @@ class LogStore {
       jesc(line, email);
       line += ',';
       jesc(line, doc);
+      line += ']';
+      emit();
+    }
+    if (!logmap_.empty()) {
+      line = "[\"M\",";
+      jesc(line, logmap_);
       line += ']';
       emit();
     }
@@ -825,6 +875,9 @@ class LogStore {
     } else if (tag == "A") {
       if (v.arr.size() < 3) return false;
       accounts_[v.arr[1].s] = v.arr[2].s;
+    } else if (tag == "M") {
+      if (v.arr.size() < 2) return false;
+      logmap_ = v.arr[1].s;
     } else if (tag == "D") {
       if (v.arr.size() < 2) return false;
       accounts_.erase(v.arr[1].s);
@@ -843,6 +896,7 @@ class LogStore {
   std::map<std::string, Stat> stats_;
   std::map<std::string, std::pair<std::string, bool>> nodes_;
   std::map<std::string, std::string> accounts_;
+  std::string logmap_;
   std::unordered_map<std::string, long long> idem_;
   std::deque<std::string> idem_fifo_;
   Wal wal_storage_;
@@ -918,6 +972,16 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
   } else if (op == "get_log") {
     long long id = args.arr.empty() ? 0 : args.arr[0].as_int();
     if (!store.get_log(id, res)) res = "null";
+  } else if (op == "revision") {
+    jint(res, store.revision());
+  } else if (op == "logmap") {
+    long long n = -1;
+    std::string hash;
+    if (!args.arr.empty()) {
+      n = args.arr[0].as_int();
+      hash = arg_s(args, 1);
+    }
+    store.logmap(n, hash, res);
   } else if (op == "stat_overall") {
     store.stat("", res);
   } else if (op == "stat_day") {

@@ -35,9 +35,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
     """A real NodeAgent process with an instant executor.
-    ``store_addr`` may be a comma-separated shard set — the agent then
-    runs against the routing client (store/sharded.py)."""
-    from cronsun_tpu.logsink import RemoteJobLogStore
+    ``store_addr`` and ``logd_addr`` may be comma-separated shard sets
+    — the agent then runs against the routing clients
+    (store/sharded.py, logsink/sharded.py)."""
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
     from cronsun_tpu.node.agent import NodeAgent
     from cronsun_tpu.node.executor import ExecResult
     from cronsun_tpu.store.sharded import connect_sharded
@@ -50,8 +51,7 @@ def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
                               begin_ts=now, end_ts=now, skipped=False)
 
     store = connect_sharded(store_addr.split(","))
-    lh, _, lp = logd_addr.rpartition(":")
-    sink = RemoteJobLogStore(lh or "127.0.0.1", int(lp))
+    sink = connect_sharded_sink(logd_addr.split(","))
     # proc_req=5: the reference sample default — sub-5s runs never touch
     # the proc registry (proc.go:218-236), exactly the short-job regime
     # this bench sweeps
@@ -71,19 +71,20 @@ def worker_main(store_addr: str, logd_addr: str, node_id: str) -> int:
 
 # ---------------------------------------------------------------- driver
 
-class _PyShardServer:
-    """A Python store shard as its OWN PROCESS (``bin.store``).
+class _PyProcServer:
+    """A Python store/logd shard as its OWN PROCESS.
 
-    ``StoreServer().start()`` would serve from a thread inside the
-    driver — N "shards" sharing one GIL measure nothing.  The whole
-    point of the py rungs on the shard ladder is that each shard is a
-    separate single-process ceiling (one GIL, one event plane), so each
-    one must be a separate process, exactly like production."""
+    An in-process ``.start()`` server thread would serve from inside
+    the driver — N "shards" sharing one GIL measure nothing.  The whole
+    point of the py rungs on a shard ladder is that each shard is a
+    separate single-process ceiling (one GIL, one event plane / one
+    SQLite lock), so each one must be a separate process, exactly like
+    production."""
 
-    def __init__(self):
+    def __init__(self, module="cronsun_tpu.bin.store", extra=()):
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "cronsun_tpu.bin.store",
-             "--host", "127.0.0.1", "--port", "0"],
+            [sys.executable, "-m", module,
+             "--host", "127.0.0.1", "--port", "0", *extra],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         for _ in range(200):
@@ -92,7 +93,8 @@ class _PyShardServer:
                 break
         if not line or not line.startswith("READY"):
             self.proc.kill()
-            raise RuntimeError(f"py store shard failed to start: {line!r}")
+            raise RuntimeError(f"py shard ({module}) failed to start: "
+                               f"{line!r}")
         addr = line.split()[1]
         self.host, _, port = addr.rpartition(":")
         self.port = int(port)
@@ -103,6 +105,15 @@ class _PyShardServer:
             self.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self.proc.kill()
+
+
+def _PyShardServer():
+    return _PyProcServer("cronsun_tpu.bin.store")
+
+
+def _PyLogShardServer():
+    # :memory: — a bench logd must not leave cronsun.db files around
+    return _PyProcServer("cronsun_tpu.bin.logd", ("--db", ":memory:"))
 
 
 def _native_agent_workers(n_agents: int) -> str:
@@ -117,22 +128,24 @@ def _native_agent_workers(n_agents: int) -> str:
     return str(max(4, min(64, (2 * cores) // max(1, n_agents))))
 
 
-def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
+def run_bench(rates, n_agents, seconds, on_log=print, shards=1,
+              logd_shards=1):
     from cronsun_tpu.core import Keyspace
     from cronsun_tpu.core.models import Job, JobRule
-    from cronsun_tpu.logsink import LogSinkServer, RemoteJobLogStore
     from cronsun_tpu.logsink.native import (NativeLogSinkServer,
                                             find_binary as find_logd)
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
     from cronsun_tpu.store.native import NativeStoreServer, find_binary
     from cronsun_tpu.store.sharded import connect_sharded
 
     ks = Keyspace()
     shards = max(1, shards)
+    logd_shards = max(1, logd_shards)
     # every resource below tears down in the except: a failure starting
     # a later shard / logd / agent must not orphan the subprocesses
     # already spawned (Popen children outlive a dead driver)
     store_srvs = []
-    logd = None
+    logds = []
     store = sink = None
     agents = []
     try:
@@ -157,14 +170,21 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
         if shards > 1:
             backend += f"x{shards}-shards"
         store_addr = ",".join(f"{s.host}:{s.port}" for s in store_srvs)
-        logd_bin = find_logd()
-        if logd_bin:
-            logd = NativeLogSinkServer(binary=logd_bin)
-            backend += "+native-logd"
-        else:
-            logd = LogSinkServer().start()
+        # result plane: BENCH_LOGD=py forces the Python/SQLite logd —
+        # the same ladder logic as BENCH_STORE (each py shard its own
+        # bin.logd process; the one-process SQLite lock is the ceiling
+        # result-plane sharding removes on one host)
+        logd_bin = (None if os.environ.get("BENCH_LOGD") == "py"
+                    else find_logd())
+        for _ in range(logd_shards):
+            logds.append(NativeLogSinkServer(binary=logd_bin) if logd_bin
+                         else _PyLogShardServer())
+        backend += "+native-logd" if logd_bin else "+py-logd"
+        if logd_shards > 1:
+            backend += f"x{logd_shards}-shards"
+        logd_addr = ",".join(f"{l.host}:{l.port}" for l in logds)
         store = connect_sharded(store_addr.split(","))
-        sink = RemoteJobLogStore(logd.host, logd.port)
+        sink = connect_sharded_sink(logd_addr.split(","))
 
         import threading
         agents = []
@@ -187,7 +207,7 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
                 # the end of a short sweep, not one stale beat behind.
                 p = subprocess.Popen(
                     [agentd, "--store", store_addr,
-                     "--logsink", f"{logd.host}:{logd.port}",
+                     "--logsink", logd_addr,
                      "--node-id", nid, "--proc-req", "5", "--instant-exec",
                      "--workers", _native_agent_workers(n_agents),
                      "--ttl", "3"],
@@ -195,7 +215,7 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
             else:
                 p = subprocess.Popen(
                     [sys.executable, here, "--worker", store_addr,
-                     f"{logd.host}:{logd.port}", nid],
+                     logd_addr, nid],
                     stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
             agents.append(p)
         for p in agents:
@@ -217,6 +237,7 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
                    + ("+native-agents" if use_native_agents else ""),
                    "dispatch_plane_agents": n_agents,
                    "dispatch_plane_store_shards": shards,
+                   "dispatch_plane_logd_shards": logd_shards,
                    # the whole plane (store server, logd, driver, agents)
                    # shares this host's cores; on 1 core the figure measures
                    # per-order CPU cost, not fleet scale-out (real agents
@@ -234,9 +255,9 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
                     c.close()
                 except Exception:
                     pass
-        if logd is not None:
+        for l in logds:
             try:
-                logd.stop()
+                l.stop()
             except Exception:
                 pass
         for srv in store_srvs:
@@ -512,7 +533,8 @@ def run_bench(rates, n_agents, seconds, on_log=print, shards=1):
                 p.kill()
         store.close()
         sink.close()
-        logd.stop()
+        for l in logds:
+            l.stop()
         for srv in store_srvs:
             srv.stop()
     return results
@@ -635,6 +657,58 @@ def run_shard_ladder(counts, rate=40000, n_agents=2, seconds=3,
     }
 
 
+def run_logd_ladder(counts, rate=60000, n_agents=4, seconds=3,
+                    on_log=print):
+    """The RESULT-plane shard ladder: one offered rate past the
+    single-logd ingest ceiling at a fixed agent count, swept across
+    logd shard counts (1/2/4 by default).  Everything but the logd
+    shard count is held still — the store stays a single native server
+    (its ~130k orders/s ceiling sits far above the record rates swept
+    here), agents are whatever BENCH_AGENT says — so the curve isolates
+    what partitioning the RECORD space buys: the sustained record
+    drain (executions landed in the result store over time) must scale
+    toward linear while zero records drop and per-agent fairness
+    holds.  A broken job-routing hash shows up as one hot logd shard
+    and a flat curve.
+
+    Backend choice mirrors the store ladder's lesson: the ceiling
+    sharding removes is the single-PROCESS one (one SQLite lock / one
+    big store mutex), so the demonstrative rungs run BENCH_LOGD=py by
+    default — each logd shard its own bin.logd process — where that
+    ceiling is real and low on one host.  BENCH_LOGD=native measures
+    the (already multithreaded) C++ logd instead, whose shard win is
+    per-machine."""
+    os.environ.setdefault("BENCH_LOGD", "py")
+    ladder = []
+    base = None
+    backend = None
+    for n in counts:
+        on_log(f"=== logd shard ladder: {n} shard(s) ===")
+        r = run_bench([rate], n_agents, seconds, on_log=on_log,
+                      logd_shards=n)
+        rec_rate = r["dispatch_plane_orders_per_sec"]
+        if base is None:
+            base = rec_rate
+            backend = r["dispatch_plane_backend"]
+        ladder.append({
+            "logd_shards": n,
+            "records_per_sec": rec_rate,
+            "scaling_vs_1_shard": round(rec_rate / max(1.0, base), 3),
+            "records_dropped": r.get("dispatch_plane_records_dropped"),
+            "records_per_batch":
+                r.get("dispatch_plane_logd_records_per_batch"),
+            "fairness_min_over_max":
+                r.get("dispatch_plane_fairness_min_over_max"),
+            "exec_lag_net_p99_s":
+                r.get("dispatch_plane_exec_lag_net_p99_s")})
+    return {
+        "result_plane_logd_ladder_rate_offered_per_s": rate,
+        "result_plane_logd_ladder_agents": n_agents,
+        "result_plane_logd_ladder_backend": backend,
+        "result_plane_logd_ladder": ladder,
+    }
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         return worker_main(sys.argv[2], sys.argv[3], sys.argv[4])
@@ -667,6 +741,13 @@ def main():
                          "one past-saturation rate at --agents across "
                          "shard counts — the drain-scaling curve the "
                          "sharded store must deliver")
+    ap.add_argument("--logd-shards", default="",
+                    help="comma list of RESULT-store shard counts "
+                         "(e.g. 1,2,4): one past-ingest-ceiling rate "
+                         "at --agents across logd shard counts — the "
+                         "record-drain curve the sharded result plane "
+                         "must deliver (BENCH_LOGD=py per-process "
+                         "shards by default)")
     ap.add_argument("--seconds", type=int, default=4)
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -687,6 +768,11 @@ def main():
         res = run_shard_ladder(counts, rate=max(rates),
                                n_agents=args.agents,
                                seconds=args.seconds, on_log=on_log)
+    elif args.logd_shards:
+        counts = [int(c) for c in args.logd_shards.split(",")]
+        res = run_logd_ladder(counts, rate=max(rates),
+                              n_agents=args.agents,
+                              seconds=args.seconds, on_log=on_log)
     elif args.agent_sweep:
         counts = [int(c) for c in args.agent_sweep.split(",")]
         curve = []

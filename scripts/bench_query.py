@@ -1,0 +1,216 @@
+"""Read-plane benchmark: queries/s and latency percentiles for the
+three dashboard shapes WHILE the write path runs at full drain.
+
+The web/query plane was the last plane with no bench: dashboards for
+millions of users hit stats / latest / log-history against the result
+store, and until the result plane sharded, every such query scanned one
+SQLite file behind one lock while the agents' bulk flushes held it.
+This bench pins the contended figure — M concurrent readers against a
+logd (shard set) that is simultaneously ingesting records as fast as a
+saturating writer can offer them:
+
+- ``latest``    — the dashboard's landing view
+  (``query_logs(latest=True, page_size=500)``)
+- ``history``   — a paged, filtered job-history read
+  (``query_logs(job_ids=[...], page=2, page_size=50)``)
+- ``stat_days`` — the overview counters (``stat_days(7)``)
+
+    python scripts/bench_query.py [--logd-shards N] [--readers M]
+        [--seconds S] [--json out.json]
+
+Backend: native logd when the binary exists, BENCH_LOGD=py forces the
+Python/SQLite server (each shard its own ``bin.logd`` process).  Run
+standalone or via bench.py (which merges ``query_plane_*`` into
+bench_detail.json).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHAPES = ("latest", "history", "stat_days")
+
+
+def _pctl(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run_query_bench(logd_shards=1, readers=4, seconds=4.0, on_log=print,
+                    seed_records=4000):
+    from cronsun_tpu.logsink import LogRecord
+    from cronsun_tpu.logsink.native import find_binary as find_logd
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
+    from bench_dispatch import _PyLogShardServer  # noqa: E402 — same dir
+    from cronsun_tpu.logsink.native import NativeLogSinkServer
+
+    logd_shards = max(1, logd_shards)
+    logd_bin = (None if os.environ.get("BENCH_LOGD") == "py"
+                else find_logd())
+    backend = ("native-logd" if logd_bin else "py-logd") + (
+        f"x{logd_shards}-shards" if logd_shards > 1 else "")
+    logds = []
+    sink = None
+    jobs = [f"qj{i}" for i in range(64)]
+    nodes = [f"qn{i}" for i in range(8)]
+
+    def mkrec(i):
+        now = time.time()
+        return LogRecord(job_id=jobs[i % len(jobs)], job_group="q",
+                         name=f"query-bench-{i % len(jobs)}",
+                         node=nodes[i % len(nodes)], user="",
+                         command="true", output="bench",
+                         success=i % 7 != 0, begin_ts=now, end_ts=now)
+
+    side_sinks = []
+    try:
+        for _ in range(logd_shards):
+            logds.append(NativeLogSinkServer(binary=logd_bin) if logd_bin
+                         else _PyLogShardServer())
+        addrs = [f"{l.host}:{l.port}" for l in logds]
+        sink = connect_sharded_sink(addrs)
+
+        def own_sink():
+            # one client PER thread: the wire client is lock-step under
+            # one mutex, so a shared client would measure client-side
+            # lock waits (readers queued behind the writer's bulk RPC),
+            # not the server's read/write concurrency
+            s = connect_sharded_sink(addrs)
+            side_sinks.append(s)
+            return s
+        on_log(f"seeding {seed_records} records ({backend})")
+        n = 0
+        while n < seed_records:
+            batch = [mkrec(n + k) for k in range(500)]
+            sink.create_job_logs(batch)
+            n += len(batch)
+
+        stop = threading.Event()
+        wrote = [0]
+        werrs = [0]
+
+        def writer():
+            # full drain: back-to-back bulk flushes of agent-sized
+            # batches — the contention the dashboards must live under
+            wsink = own_sink()
+            while not stop.is_set():
+                batch = [mkrec(seed_records + wrote[0] + k)
+                         for k in range(500)]
+                try:
+                    wsink.create_job_logs(batch)
+                    wrote[0] += len(batch)
+                except Exception:  # noqa: BLE001 — counted, keep driving
+                    werrs[0] += 1
+
+        lats = {s: [] for s in SHAPES}
+        counts = {s: 0 for s in SHAPES}
+        rerrs = [0]
+        lock = threading.Lock()
+
+        def reader(k):
+            # every reader cycles the three shapes so each shape sees
+            # the same wall-clock window and M-way concurrency
+            import random
+            rng = random.Random(k)
+            rsink = own_sink()
+            while not stop.is_set():
+                for shape in SHAPES:
+                    t0 = time.perf_counter()
+                    try:
+                        if shape == "latest":
+                            rsink.query_logs(latest=True, page_size=500)
+                        elif shape == "history":
+                            rsink.query_logs(
+                                job_ids=rng.sample(jobs, 3),
+                                failed_only=rng.random() < 0.3,
+                                page=2, page_size=50)
+                        else:
+                            rsink.stat_days(7)
+                    except Exception:  # noqa: BLE001 — counted
+                        with lock:
+                            rerrs[0] += 1
+                        continue
+                    dt = (time.perf_counter() - t0) * 1000
+                    with lock:
+                        lats[shape].append(dt)
+                        counts[shape] += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        rts = [threading.Thread(target=reader, args=(k,), daemon=True)
+               for k in range(readers)]
+        t0 = time.time()
+        wt.start()
+        for t in rts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        wt.join(timeout=30)
+        for t in rts:
+            t.join(timeout=10)
+        elapsed = time.time() - t0
+
+        res = {
+            "query_plane_backend": backend,
+            "query_plane_logd_shards": logd_shards,
+            "query_plane_readers": readers,
+            "query_plane_seconds": round(elapsed, 2),
+            "query_plane_write_records_per_s": round(wrote[0] / elapsed, 1),
+            "query_plane_write_errors": werrs[0],
+            "query_plane_read_errors": rerrs[0],
+        }
+        for s in SHAPES:
+            res[f"query_plane_{s}_qps"] = round(counts[s] / elapsed, 1)
+            res[f"query_plane_{s}_p50_ms"] = round(_pctl(lats[s], 0.50), 2)
+            res[f"query_plane_{s}_p99_ms"] = round(_pctl(lats[s], 0.99), 2)
+        try:
+            res["query_plane_logd_op_stats"] = sink.op_stats()
+        except Exception:  # noqa: BLE001 — older server
+            pass
+        on_log(" ".join(f"{s}={res[f'query_plane_{s}_qps']}/s"
+                        f"(p99 {res[f'query_plane_{s}_p99_ms']}ms)"
+                        for s in SHAPES)
+               + f" writes={res['query_plane_write_records_per_s']}/s")
+        return res
+    finally:
+        for s in [sink] + side_sinks:
+            if s is None:
+                continue
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for l in logds:
+            try:
+                l.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--logd-shards", type=int, default=1)
+    ap.add_argument("--readers", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=4.0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    res = run_query_bench(logd_shards=args.logd_shards,
+                          readers=args.readers, seconds=args.seconds,
+                          on_log=on_log)
+    out = json.dumps(res, indent=1)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,3 +162,82 @@ def test_shard_scaling():
     assert fair is None or fair >= 0.8, (
         f"2-shard fairness {fair} < 0.8 — one shard (or its agent) "
         "is hogging the drain")
+
+
+@pytest.mark.slow
+def test_logd_shard_scaling():
+    """RESULT-plane gate, the store gate's twin: at a fixed agent count
+    and one offered rate past the single-logd ingest ceiling, 2 logd
+    shards must lift sustained RECORD drain >= 1.5x over 1 shard with
+    zero record drops and per-agent fairness >= 0.8.  Native
+    instant-exec agents drive (their flushers split each bulk flush per
+    shard); the logd side runs BENCH_LOGD=py — one bin.logd process per
+    shard — because the single-PROCESS SQLite ceiling is the thing the
+    sharding removes on one host (the C++ logd's shard win is
+    per-machine).  A broken job-routing hash fails this as one hot
+    shard and a flat curve."""
+    if (os.cpu_count() or 1) < 12:
+        pytest.skip("needs >= 12 cores for a logd-bound drain signal")
+    agentd = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cronsun-agentd")
+    if not os.path.exists(agentd):
+        pytest.skip("native agent binary unavailable")
+    os.environ["BENCH_AGENT"] = "native"
+    os.environ["BENCH_LOGD"] = "py"
+    try:
+        import bench_dispatch
+        # one retry for shared-host jitter, like the store gate: a real
+        # routing/serialization regression fails both runs
+        for attempt in (1, 2):
+            res = bench_dispatch.run_logd_ladder(
+                [1, 2], rate=60000, n_agents=4, seconds=3,
+                on_log=lambda *a: print(*a, file=sys.stderr))
+            ladder = res["result_plane_logd_ladder"]
+            one, two = ladder[0], ladder[1]
+            fair = two["fairness_min_over_max"]
+            if (two["scaling_vs_1_shard"] >= 1.5
+                    and (fair is None or fair >= 0.8)
+                    and not (one["records_dropped"]
+                             or two["records_dropped"])) or attempt == 2:
+                break
+            print("logd ladder below gate "
+                  f"({two['scaling_vs_1_shard']}x, fairness {fair}); "
+                  "retrying once", file=sys.stderr)
+    finally:
+        os.environ.pop("BENCH_AGENT", None)
+        os.environ.pop("BENCH_LOGD", None)
+    assert one["records_per_sec"] > 0
+    assert two["scaling_vs_1_shard"] >= 1.5, (
+        f"2-shard record drain {two['records_per_sec']}/s is only "
+        f"{two['scaling_vs_1_shard']}x the 1-shard "
+        f"{one['records_per_sec']}/s — the result-plane split "
+        "re-serialized")
+    assert not one["records_dropped"] and not two["records_dropped"], (
+        f"record drops under the ladder: {one['records_dropped']} / "
+        f"{two['records_dropped']}")
+    fair = two["fairness_min_over_max"]
+    assert fair is None or fair >= 0.8, (
+        f"2-shard fairness {fair} < 0.8 — one logd shard (or its "
+        "agent) is hogging the drain")
+
+
+def test_bench_query_smoke():
+    """Tier-1 smoke for the read-plane bench: a short run against one
+    py-logd shard with concurrent readers and a full-drain writer must
+    complete with NONZERO queries/s on every dashboard shape and zero
+    read/write errors — the query path stays alive under ingest, and
+    the bench itself stays runnable."""
+    os.environ["BENCH_LOGD"] = "py"
+    try:
+        import bench_query
+        res = bench_query.run_query_bench(
+            logd_shards=1, readers=2, seconds=1.5, seed_records=1000,
+            on_log=lambda *a: print(*a, file=sys.stderr))
+    finally:
+        os.environ.pop("BENCH_LOGD", None)
+    assert res["query_plane_read_errors"] == 0
+    assert res["query_plane_write_errors"] == 0
+    for shape in ("latest", "history", "stat_days"):
+        assert res[f"query_plane_{shape}_qps"] > 0, (
+            f"no {shape} queries completed")
+    assert res["query_plane_write_records_per_s"] > 0

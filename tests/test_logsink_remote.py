@@ -293,6 +293,7 @@ def test_native_wal_survives_restart(tmp_path):
                               begin=2000.0 + i))
     c.upsert_node("n1", '{"id": "n1", "pid": 3}', alived=True)
     c.upsert_account("a@b.c", '{"email": "a@b.c"}')
+    c.logmap(1, "fnv1a-job-v1")          # topology pin rides the WAL too
     before = c.stat_overall()
     c.close()
     srv._proc.send_signal(_sig.SIGKILL)      # crash, not clean stop
@@ -306,6 +307,7 @@ def test_native_wal_survives_restart(tmp_path):
     assert lt == 5                            # distinct (job, node) pairs
     assert c2.get_node("n1")["alived"]
     assert c2.get_account("a@b.c") is not None
+    assert c2.logmap() == {"n": 1, "hash": "fnv1a-job-v1"}
     # writes continue with fresh monotone ids
     r = _rec(job="after", begin=3000.0)
     c2.create_job_log(r)
@@ -485,7 +487,9 @@ def test_after_id_cursor(sink):
     """Cursor mode (after_id): only rows above the id, ordered by id
     ASCENDING (= insertion order) regardless of begin_ts — the contract
     `cronsun-ctl logs --follow` relies on to never miss a long job's
-    record inserted with an old begin time.  All three backends."""
+    record inserted with an old begin time.  Total is pinned to -1 (the
+    poller never reads it; computing it cost a full filtered COUNT scan
+    per poll on the SQLite backend).  All three backends."""
     # insert out of begin_ts order: the "slow job" finishes last but
     # STARTED first
     ids = []
@@ -494,16 +498,55 @@ def test_after_id_cursor(sink):
         sink.create_job_log(r)
         ids.append(r.id)
     recs, total = sink.query_logs(after_id=ids[0])
-    assert total == 2
+    assert total == -1                    # cursor mode: no COUNT scan
     assert [r.id for r in recs] == [ids[1], ids[2]]     # id order,
     assert [r.begin_ts for r in recs] == [900.0, 100.0]  # not begin order
     # cursor past the end is empty; after_id=0 sees everything in order
-    assert sink.query_logs(after_id=ids[-1])[1] == 0
+    recs, total = sink.query_logs(after_id=ids[-1])
+    assert recs == [] and total == -1
     recs, _ = sink.query_logs(after_id=0)
     assert [r.id for r in recs] == ids
-    # latest view ignores the cursor (its rows carry no id)
+    # latest view ignores the cursor (its rows carry no id, and the
+    # normal total comes back)
     recs, lt = sink.query_logs(latest=True, after_id=10**9)
     assert lt == 3
+
+
+def test_latest_view_tie_order(sink):
+    """Equal-begin_ts rows in the id-less latest view order by the
+    (job_id, node) primary key on EVERY backend — the documented tie
+    order the sharded client's scatter-gather merge reproduces, so a
+    merged latest view is byte-identical to an unsharded one."""
+    for job, node in (("zz", "n1"), ("aa", "n2"), ("aa", "n1")):
+        sink.create_job_log(_rec(job=job, node=node, begin=7000.0))
+    sink.create_job_log(_rec(job="mm", node="n9", begin=8000.0))
+    recs, _ = sink.query_logs(latest=True)
+    assert [(r.job_id, r.node) for r in recs] == \
+        [("mm", "n9"), ("aa", "n1"), ("aa", "n2"), ("zz", "n1")]
+
+
+def test_revision_tracks_creates(sink):
+    """revision() is the read plane's change token: max record id ever
+    assigned, bumped by every create, never regressed by retention —
+    what the web tier's ETag and a follow poller's tail bootstrap key
+    on."""
+    assert sink.revision() == 0
+    r = _rec(job="rv")
+    sink.create_job_log(r)
+    assert sink.revision() == r.id
+    sink.create_job_logs([_rec(job="rv2"), _rec(job="rv3")])
+    assert sink.revision() == r.id + 2
+
+
+def test_logmap_pin_publish_once(sink):
+    """The result-plane topology pin: first writer wins, later calls
+    (any arguments) read the existing pin back; argument-less calls are
+    a read-only peek."""
+    assert sink.logmap() is None
+    got = sink.logmap(2, "fnv1a-job-v1")
+    assert got == {"n": 2, "hash": "fnv1a-job-v1"}
+    assert sink.logmap(7, "other") == got      # first writer won
+    assert sink.logmap() == got
 
 
 @pytest.mark.parametrize("backend", ["py", "native"])

@@ -397,6 +397,158 @@ def test_metrics_op_stats_carry_shard_label_when_sharded():
         one.close()
 
 
+def _raw(base, path, headers=None):
+    r = urllib.request.Request(base + path, headers=headers or {})
+    try:
+        resp = urllib.request.urlopen(r)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_etag_304_on_unchanged_reads(world):
+    """Revision-keyed ETag on the dashboard reads: /v1/logs (latest
+    view) and /v1/stat* answer 304 Not Modified via If-None-Match as
+    long as no record landed — a repeated dashboard poll is one
+    revision read, not a query — and serve fresh bodies (new ETag) the
+    moment one does."""
+    _, sink, srv, c = world
+    c.login()
+    base = c.base
+    auth = {"Cookie": f"sid={c.sid}"}
+    for path in ("/v1/stat/overall", "/v1/stat/days?days=3"):
+        st, hd, _ = _raw(base, path, dict(auth))
+        assert st == 200
+        etag = hd.get("ETag")
+        assert etag, f"no ETag on {path}"
+        st2, hd2, body2 = _raw(base, path,
+                               dict(auth, **{"If-None-Match": etag}))
+        assert st2 == 304 and body2 == b""     # 304 carries no body
+        assert hd2.get("ETag") == etag
+    st, hd, _ = _raw(base, "/v1/logs?latest=true", dict(auth))
+    etag = hd.get("ETag")
+    assert st == 200 and etag
+    assert _raw(base, "/v1/logs?latest=true",
+                dict(auth, **{"If-None-Match": etag}))[0] == 304
+    # distinct endpoints must not satisfy each other's cache even
+    # though they share the revision key
+    assert _raw(base, "/v1/stat/overall",
+                dict(auth, **{"If-None-Match": etag}))[0] == 200
+    # a write invalidates: fresh body, fresh ETag
+    sink.create_job_log(LogRecord(
+        job_id="e1", job_group="g", name="etag", node="n", user="",
+        command="t", output="", success=True, begin_ts=1.0, end_ts=2.0))
+    st3, hd3, body3 = _raw(base, "/v1/logs?latest=true",
+                           dict(auth, **{"If-None-Match": etag}))
+    assert st3 == 200 and json.loads(body3)["total"] == 1
+    assert hd3.get("ETag") and hd3.get("ETag") != etag
+
+
+def test_logs_cursor_protocol_scalar_and_tail(world):
+    """The follow poller's wire contract: afterId=tail bootstraps at
+    the sink revision (no history drain), cursor mode returns total -1
+    plus the next cursor, and polls from that cursor deliver exactly
+    the new records."""
+    _, sink, srv, c = world
+    c.login()
+    auth = {"Cookie": f"sid={c.sid}"}
+    sink.create_job_log(LogRecord(
+        job_id="c0", job_group="g", name="old", node="n", user="",
+        command="t", output="", success=True, begin_ts=1.0, end_ts=2.0))
+    st, _, body = _raw(c.base, "/v1/logs?afterId=tail", dict(auth))
+    boot = json.loads(body)
+    assert st == 200 and boot["list"] == [] and boot["total"] == -1
+    assert boot["cursor"] == "1"
+    sink.create_job_log(LogRecord(
+        job_id="c1", job_group="g", name="new", node="n", user="",
+        command="t", output="", success=True, begin_ts=0.5, end_ts=2.0))
+    st, _, body = _raw(c.base, f"/v1/logs?afterId={boot['cursor']}",
+                       dict(auth))
+    out = json.loads(body)
+    assert [r["jobId"] for r in out["list"]] == ["c1"]
+    assert out["total"] == -1 and out["cursor"] == "2"
+    st, _, body = _raw(c.base, f"/v1/logs?afterId={out['cursor']}",
+                       dict(auth))
+    assert json.loads(body)["list"] == []
+    # malformed cursor is a 400, not a 500
+    assert _raw(c.base, "/v1/logs?afterId=xy", dict(auth))[0] == 400
+
+
+def test_logs_cursor_protocol_sharded_vector():
+    """Against a SHARDED sink the cursor is a comma-joined per-shard
+    vector: tail bootstrap returns the revision vector, polls advance
+    it per delivered record, and a stale scalar cursor is refused with
+    a 400."""
+    from cronsun_tpu.logsink.sharded import ShardedJobLogStore
+    sink = ShardedJobLogStore([JobLogStore(), JobLogStore()])
+    srv = ApiServer(MemStore(), sink, auth_enabled=False, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        st, _, body = _raw(base, "/v1/logs?afterId=tail")
+        boot = json.loads(body)
+        assert st == 200 and boot["cursor"] == "0,0"
+        sink.create_job_logs([LogRecord(
+            job_id=f"v{i}", job_group="g", name="n", node="nd", user="",
+            command="t", output="", success=True, begin_ts=1.0 + i,
+            end_ts=2.0) for i in range(6)])
+        st, _, body = _raw(base, f"/v1/logs?afterId={boot['cursor']}")
+        out = json.loads(body)
+        assert len(out["list"]) == 6 and out["total"] == -1
+        assert "," in out["cursor"]
+        st, _, body = _raw(base, f"/v1/logs?afterId={out['cursor']}")
+        assert json.loads(body)["list"] == []
+        # a nonzero scalar against a sharded sink: 400, loudly
+        assert _raw(base, "/v1/logs?afterId=3")[0] == 400
+    finally:
+        srv.stop()
+        sink.close()
+
+
+def test_metrics_logsink_op_stats_carry_shard_label_when_sharded():
+    """Against a sharded result store, each cronsun_logsink_op_* series
+    carries a ``shard`` label so per-shard counters don't collide; with
+    ONE shard the rendering stays byte-identical to the unlabeled
+    form (same contract as the coordination store's)."""
+    from cronsun_tpu.logsink.sharded import ShardedJobLogStore
+    shards = [JobLogStore(), JobLogStore()]
+    sink = ShardedJobLogStore(shards)
+    srv = ApiServer(MemStore(), sink, port=0).start()
+    try:
+        # a timed op on EVERY shard: co-located job batches until both
+        # shards saw a create_job_logs
+        sink.create_job_logs([LogRecord(
+            job_id=f"m{i}", job_group="g", name="n", node="nd", user="",
+            command="t", output="", success=True, begin_ts=1.0,
+            end_ts=2.0) for i in range(16)])
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/metrics").read().decode()
+        assert 'cronsun_logsink_op_count{op="create_job_logs",shard="0"}' \
+            in text
+        assert 'cronsun_logsink_op_count{op="create_job_logs",shard="1"}' \
+            in text
+        # no unlabeled series slips through to collide across shards
+        assert 'cronsun_logsink_op_count{op="create_job_logs"}' not in text
+    finally:
+        srv.stop()
+        sink.close()
+
+    # single-shard: byte-identical to the plain JobLogStore rendering
+    one = ShardedJobLogStore([JobLogStore()])
+    srv1 = ApiServer(MemStore(), one, port=0).start()
+    try:
+        one.create_job_logs([LogRecord(
+            job_id="s1", job_group="g", name="n", node="nd", user="",
+            command="t", output="", success=True, begin_ts=1.0,
+            end_ts=2.0)])
+        text1 = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv1.port}/v1/metrics").read().decode()
+        assert 'cronsun_logsink_op_count{op="create_job_logs"} 1' in text1
+        assert 'shard=' not in text1
+    finally:
+        srv1.stop()
+        one.close()
+
+
 def test_agent_publishes_metrics_snapshot():
     """Agents publish leased node snapshots the /v1/metrics surface
     renders — execution counters included."""
