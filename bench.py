@@ -23,6 +23,56 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def git_rev() -> str:
+    import os
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 — not a git checkout
+        return "unknown"
+
+
+def utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def check_artifact_provenance(rev: str) -> None:
+    """Loud STALE warnings when a committed artifact's git_rev doesn't
+    match HEAD — the "artifact predates PRs 1-5" trap, made structural:
+    every bench run stamps git_rev + UTC timestamp into
+    bench_detail.json and every MULTICHIP sidecar, and every run checks
+    the committed ones before anyone quotes a number from them."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    arts = [os.path.join(here, "bench_detail.json")] + sorted(
+        glob.glob(os.path.join(here, "MULTICHIP_*.json")))
+    for path in arts:
+        if not os.path.exists(path):
+            continue
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            log(f"STALE? {name}: unreadable ({e})")
+            continue
+        art_rev = art.get("git_rev")
+        if art_rev is None:
+            log(f"STALE: {name} carries no git_rev stamp — it predates "
+                f"provenance stamping entirely; its numbers reflect "
+                f"unknown code.  Re-run `python bench.py` (TPU-tunnel "
+                f"host for chip numbers) before quoting it.")
+        elif art_rev != rev:
+            log(f"STALE: {name} was generated at rev {art_rev}, HEAD is "
+                f"{rev} — its numbers predate the current code.  Re-run "
+                f"`python bench.py` before quoting it.")
+
+
 def synth_table(J, fire_period_lo, fire_period_hi, seed=0):
     import jax.numpy as jnp
     from cronsun_tpu.ops.schedule_table import ScheduleTable
@@ -106,8 +156,12 @@ def main():
     from cronsun_tpu.ops.planner import TickPlanner
     from cronsun_tpu.ops.schedule_table import build_table
     from cronsun_tpu.ops.tick import next_fire
+    rev = git_rev()
+    check_artifact_provenance(rev)
     detail = {"backend": jax.default_backend(),
-              "device": str(jax.devices()[0])}
+              "device": str(jax.devices()[0]),
+              "git_rev": rev,
+              "generated_at_utc": utc_now()}
     T0 = 1_753_000_000
     rng = np.random.default_rng(0)
 
@@ -473,6 +527,37 @@ def main():
             detail["query_plane_error"] = proc.stderr[-500:]
     except Exception as e:  # noqa: BLE001
         detail["query_plane_error"] = str(e)
+
+    # ---- multichip mesh ladder ---------------------------------------------
+    # tick+assign across device counts on the 1-D and 2-D meshes,
+    # replicated-waterfill vs bucket-sharded bidding, with per-phase
+    # breakdown and the per-round collective-bytes model (forced-host
+    # CPU devices in subprocesses; BENCH_MESH_TPU=1 on a multi-chip
+    # host uses real chips).  Full runs also refresh the
+    # MULTICHIP_ladder.json sidecar (git_rev-stamped).
+    log("multichip: mesh latency ladder")
+    try:
+        cmd = [sys.executable,
+               os.path.join(here, "scripts", "bench_mesh.py")]
+        if quick:
+            cmd.append("--quick")
+        else:
+            cmd += ["--devices", "1,2,4,8", "--shapes", "65536x1024",
+                    "--out", os.path.join(here, "MULTICHIP_ladder.json")]
+        # outer budget >= worst-case sum of per-worker budgets (the
+        # full ladder is up to 12 workers x 600 s each)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=7260, cwd=here)
+        if proc.returncode == 0:
+            merged = json.loads(proc.stdout)
+            # the parent's provenance stamp wins over the child's
+            merged.pop("git_rev", None)
+            merged.pop("generated_at_utc", None)
+            detail.update(merged)
+        else:
+            detail["multichip_ladder_error"] = proc.stderr[-500:]
+    except Exception as e:  # noqa: BLE001
+        detail["multichip_ladder_error"] = str(e)
 
     # ---- scheduler system: full step() + failover at c5 scale --------------
     # The whole cycle a real tick pays (watch drain + reconcile + flush +

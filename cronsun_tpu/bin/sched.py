@@ -92,6 +92,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh-coordinator", default="127.0.0.1:8476",
                     metavar="H:P", help="jax.distributed coordinator "
                                         "(rank 0's address)")
+    ap.add_argument("--mesh-replicated-bids", action="store_true",
+                    help="rollback switch: use the replicated-waterfill "
+                         "reconcile (O(fired-bucket) exchange per round) "
+                         "instead of bucket-sharded bidding (O(nodes)); "
+                         "every rank of a multi-host mesh must agree")
     args = ap.parse_args(argv)
     if args.mesh2d is not None:
         try:
@@ -137,19 +142,23 @@ def main(argv=None) -> int:
         from zoneinfo import ZoneInfo
         tz = ZoneInfo(cfg.timezone)
     planner = None
+    shard_bids = not args.mesh_replicated_bids
     if args.mesh2d is not None:
         from ..parallel.mesh import Sharded2DTickPlanner, make_mesh2d
         planner = Sharded2DTickPlanner(
             make_mesh2d(dj, dn), job_capacity=cfg.job_capacity,
-            node_capacity=cfg.node_capacity, tz=tz)
-        log.infof("planner sharded over a %dx%d (jobs x nodes) mesh",
-                  dj, dn)
+            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids)
+        log.infof("planner sharded over a %dx%d (jobs x nodes) mesh "
+                  "(%s bidding)", dj, dn,
+                  "bucket-sharded" if shard_bids else "replicated")
     elif args.mesh > 1:
         from ..parallel.mesh import ShardedTickPlanner, make_mesh
         planner = ShardedTickPlanner(
             make_mesh(args.mesh), job_capacity=cfg.job_capacity,
-            node_capacity=cfg.node_capacity, tz=tz)
-        log.infof("planner sharded over %d devices", args.mesh)
+            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids)
+        log.infof("planner sharded over %d devices (%s bidding)",
+                  args.mesh,
+                  "bucket-sharded" if shard_bids else "replicated")
     if args.mesh_hosts > 1 and args.mesh_proc_id > 0:
         # mesh worker: no store, no leadership — replay the leader's
         # broadcast deltas and join its collective plans until told to
@@ -172,8 +181,9 @@ def main(argv=None) -> int:
         planner = sync_proxy = PlannerSyncProxy(planner)
         log.infof("mesh leader: broadcasting plan deltas to %d workers",
                   args.mesh_hosts - 1)
-    # sharded/proxied planners are refused by SchedulerService itself
-    # (it logs why); per-rank shard checkpoints are a ROADMAP follow-on
+    # single-host mesh planners checkpoint like the plain one (shards
+    # host-gather through _fetch, topology-tagged); proxied multi-host
+    # planners are still refused by SchedulerService itself (it logs why)
     ckpt_dir = os.path.expanduser(cfg.checkpoint_dir) \
         if cfg.checkpoint_dir else None
     sched = SchedulerService(

@@ -119,7 +119,16 @@ def choose_impl(n_per_device: int, *bucket_ks: int) -> str:
     ~2 GB of score tile the pallas bid takes over: not for speed but to
     BOUND memory next to 1M-row schedule state.  Everything falls back
     to jnp off-TPU or when a bucket breaks the 256-row alignment the
-    kernels require."""
+    kernels require.
+
+    Shapes are PER-DEVICE, always: the bid tile a device materializes
+    is [its bucket rows, its node columns], so mesh planners must pass
+    ``k_local`` (the J/D-sharded bucket — never the global K) and
+    ``N // Dn`` — with bucket-sharded bidding the local bucket is also
+    what the reconcile sorts, so a global-K call would overshoot the
+    2 GB cutover Dj-fold and pick pallas where mixed wins.  The
+    planners' ``_resolve_impl`` owns that division; pinned by
+    tests/test_assign.py::test_choose_impl_boundaries."""
     if jax.default_backend() != "tpu" or any(k % _TJ for k in bucket_ks):
         return "jnp"
     tile_bytes = max(bucket_ks, default=0) * n_per_device * 4
@@ -210,6 +219,68 @@ def _assign_impl(fire, elig_packed, exclusive, load, rem_cap, cost,
         assigned = jnp.where(accept, choice, assigned)
 
     return assigned, load[:n_nodes], rem_cap[:n_nodes]
+
+
+def local_bid_demand(cand, choice, cost, n_padded: int):
+    """Per-shard half of the bucket-sharded waterfill reconcile.
+
+    Within THIS shard's candidate bucket: rank among same-node candidates
+    (stable, original-index order) and the exclusive cumulative cost of
+    the earlier same-node candidates — plus the per-node demand totals
+    (candidate count, candidate cost sum) that shards exchange instead of
+    the candidates themselves.  Counts ride f32 so the [2, N] demand
+    block is ONE array on the wire; exact below 2^24 candidates per node
+    (J tops out at 1M).
+
+    Returns (rank [K] i32, cum_in_seg [K] f32, demand [2, N] f32).
+    """
+    K = cand.shape[0]
+    key = jnp.where(cand, choice, n_padded)
+    rank_s, order, _sorted_key, first = _rank_within_choice(key)
+    w = jnp.where(cand, cost, 0.0)
+    w_sorted = w[order]
+    cum_excl = jnp.cumsum(w_sorted) - w_sorted
+    cum_seg_s = cum_excl - cum_excl[first]
+    rank = jnp.zeros(K, jnp.int32).at[order].set(rank_s)
+    cum = jnp.zeros(K, jnp.float32).at[order].set(cum_seg_s)
+    safe = jnp.clip(choice, 0, n_padded - 1)
+    cnt = jnp.zeros(n_padded, jnp.float32).at[safe].add(
+        cand.astype(jnp.float32))
+    wn = jnp.zeros(n_padded, jnp.float32).at[safe].add(w)
+    return rank, cum, jnp.stack([cnt, wn])
+
+
+def waterfill_accept_presplit(cand, choice, cost, load, rem_cap, is_final,
+                              rank_g, cum_g, tot_w):
+    """Accept decision for candidates whose GLOBAL within-node rank and
+    cumulative-demand cost are already known (local half + earlier
+    shards' per-node prefix).  The same accept predicate as
+    :func:`waterfill_accept` — ``rank < rem_cap`` capacity rationing,
+    waterfill quota against the global target level, rank-0 progress
+    guarantee — just evaluated per shard instead of on a gathered
+    bucket, so reconciling costs O(nodes) of exchange, not O(bucket).
+
+    The equivalence is EXACT, not approximate: the replicated
+    waterfill's rank/cum-cost are computed over candidate DEMAND (every
+    bid in the segment, accepted or not), so earlier shards' influence
+    summarizes into two per-node prefix scalars with no circular
+    dependency on their accept outcomes.  Bit-identical accepts
+    whenever the cost sums are exact in f32 (integer costs; float costs
+    can differ by accumulation-order ulps at exact quota boundaries).
+
+    Returns accept [K] bool; the caller owns the load/rem_cap update
+    (locally scattered, then psum'd back to replicated).
+    """
+    n_padded = load.shape[0]
+    safe = jnp.clip(choice, 0, n_padded - 1)
+    cap_at = rem_cap[safe]
+    open_n = rem_cap > 0
+    n_open = jnp.maximum(jnp.sum(open_n), 1)
+    level = (jnp.sum(jnp.where(open_n, load, 0.0)) + tot_w) / n_open
+    w = jnp.where(cand, cost, 0.0)
+    headroom = level - load[safe]
+    fits = (rank_g == 0) | (cum_g + w <= headroom)
+    return cand & (rank_g < cap_at) & (is_final | fits)
 
 
 def waterfill_accept(cand, choice, cost, load, rem_cap, is_final):

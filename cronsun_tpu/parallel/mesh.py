@@ -7,16 +7,32 @@ let XLA insert collectives):
   schedule+eligibility state each); each device owns J/D rows.
 - replicated: node load/capacity vectors ([N] — tiny), time fields.
 - per tick, each shard: local fire_mask -> local compact (K/D bucket) ->
-  local pallas bid.  Then ONE ``all_gather`` of the compacted candidate bids
-  (choice/cost/flags, O(K) bytes — rides ICI) and every shard runs the
-  *identical* waterfill accept on the gathered bucket, keeping load/rem_cap
-  replicated without a reduce.  D-1 more bid rounds repeat the exchange.
+  local pallas bid.  Then the per-round reconcile, one of two paths:
+
+  * **bucket-sharded bidding** (default, ``shard_bids=True``): each shard
+    waterfills its OWN candidates against the replicated load/rem_cap and
+    shards exchange only per-node DEMAND summaries — one ``all_gather`` of
+    a [2, N] (count, cost-sum) block plus one ``psum`` of the accepted
+    (count, cost) block — O(nodes x D) gathered bytes per round,
+    independent of the fired bucket (the replicated path is linear in
+    it; crossover math in ``estimate_collective_bytes``).  The accept
+    predicate is the replicated waterfill's
+    exactly (see assign.waterfill_accept_presplit): global within-node
+    rank = earlier-shards' demand-count prefix + local rank, global
+    cumulative cost likewise, so the result is bit-identical whenever
+    cost sums are exact (pinned by a randomized differential test).
+  * **replicated waterfill** (``shard_bids=False``, the reference path):
+    ONE ``all_gather`` of the compacted candidate bids (choice/cost/flags,
+    O(K) bytes) and every shard runs the *identical* waterfill accept on
+    the gathered bucket.  D-1 more bid rounds repeat the exchange.
+
 - result: each shard scatters its slice of the accept verdicts back to its
   local bucket; outputs concatenate along the bucket axis.
 
-Inter-chip traffic per tick is O(fired-bucket), independent of J — the
-design scales to multi-host DCN the same way (the gather payload is a few
-hundred KB).
+Inter-chip traffic per tick is O(nodes) sharded / O(fired-bucket)
+replicated, independent of J either way — the design scales to multi-host
+DCN the same way.  ``estimate_collective_bytes`` puts numbers on both
+paths at the planner's shapes; scripts/bench_mesh.py measures them.
 
 The reference has no analogue (every Go node redundantly runs the full cron
 loop, node/cron/cron.go:210-275); this module is the scale-out story that
@@ -33,7 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.assign import _steps, waterfill_accept
+from ..ops.assign import (_steps, local_bid_demand, waterfill_accept,
+                          waterfill_accept_presplit)
 from ..ops.planner import TickPlan, TickPlanner, _compact, _next_pow2
 from ..ops.schedule_table import FRAMEWORK_EPOCH, ScheduleTable
 from ..ops.tick import _fire_mask_jit
@@ -41,6 +58,63 @@ from ..ops.timecal import window_fields
 
 AXIS = "jobs"
 NAXIS = "nodes"
+
+
+def _shard_map(body, mesh, in_specs, out_specs):
+    """shard_map across jax versions: >= 0.6 exports it at top level with
+    ``check_vma``; older releases (0.4.x, the CPU tier-1 environment)
+    keep it under jax.experimental with ``check_rep``.  One shim so the
+    mesh planners — and therefore the whole tier-1 mesh test set — run
+    on both."""
+    try:
+        from jax import shard_map as sm
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def _reconcile_sharded(cand, choice, cost, load, rem_cap, is_final, axis):
+    """One bucket-sharded accept round: exchange per-node demand
+    summaries ([2, N] per shard) instead of the candidate bids
+    ([k_local] x 3 per shard) — payload independent of the fired
+    bucket.
+
+    1. local: rank + exclusive cumulative cost among same-node
+       candidates of THIS shard, and the [2, N] (count, cost-sum)
+       demand block (assign.local_bid_demand);
+    2. all_gather the demand blocks along ``axis`` -> [D, 2, N]; the
+       earlier-shards prefix (shard-major, matching the gathered
+       bucket's candidate order) lifts local rank/cum-cost to global;
+    3. the replicated waterfill's accept predicate, evaluated locally
+       (assign.waterfill_accept_presplit);
+    4. psum the accepted (count, cost) block so load/rem_cap stay
+       replicated — integer counts exact, cost sums exact for integer
+       costs (ulp-order-different otherwise).
+    """
+    n_padded = load.shape[0]
+    rank_l, cum_l, demand = local_bid_demand(cand, choice, cost, n_padded)
+    d = jax.lax.axis_index(axis)
+    demand_g = jax.lax.all_gather(demand, axis)            # [D, 2, N]
+    nsh = demand_g.shape[0]
+    before = (jnp.arange(nsh) < d)[:, None, None]
+    prefix = jnp.sum(jnp.where(before, demand_g, 0.0), axis=0)  # [2, N]
+    tot_w = jnp.sum(demand_g[:, 1, :])
+    safe = jnp.clip(choice, 0, n_padded - 1)
+    rank_g = prefix[0][safe].astype(jnp.int32) + rank_l
+    cum_g = prefix[1][safe] + cum_l
+    accept = waterfill_accept_presplit(
+        cand, choice, cost, load, rem_cap, is_final, rank_g, cum_g, tot_w)
+    a32 = accept.astype(jnp.float32)
+    upd = jax.lax.psum(jnp.stack([
+        jnp.zeros(n_padded, jnp.float32).at[safe].add(a32),
+        jnp.zeros(n_padded, jnp.float32).at[safe].add(
+            jnp.where(accept, cost, 0.0))]), axis)
+    load = load + upd[1]
+    rem_cap = rem_cap - upd[0].astype(jnp.int32)
+    return accept, load, rem_cap
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -65,11 +139,14 @@ def make_mesh2d(dj: int, dn: int) -> Mesh:
 
 
 def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
-                k_local: int, rounds: int, bid, fanout):
+                k_local: int, rounds: int, bid, fanout,
+                shard_bids: bool = False):
     """One second of the jobs-mesh plan, per shard: local compact + bid,
-    candidate all_gather, replicated waterfill.  THE single definition —
-    both the per-tick body and the fused windowed scan call it, so their
-    semantics cannot drift."""
+    then the per-round reconcile — bucket-sharded (O(N) demand exchange,
+    ``shard_bids=True``) or the replicated waterfill on the gathered
+    candidate bucket (O(K)).  THE single definition — both the per-tick
+    body and the fused windowed scan call it, so their semantics cannot
+    drift."""
     d = jax.lax.axis_index(AXIS)
     j_local = elig.shape[0]
     idx, valid, total = _compact(fire_col, k_local)
@@ -87,13 +164,20 @@ def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
         load_eff = jnp.where(rem_cap > 0, load, jnp.inf)
         best, choice = bid(packed_k, load_eff)
         cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
-        # Exchange compacted bids; every shard sees the same global bucket.
-        cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
-        choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
-        cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
-        accept_g, load, rem_cap = waterfill_accept(
-            cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
-        accept_l = jax.lax.dynamic_slice(accept_g, (d * k_local,), (k_local,))
+        if shard_bids:
+            accept_l, load, rem_cap = _reconcile_sharded(
+                cand_l, choice, cost_k, load, rem_cap,
+                r == rounds - 1, AXIS)
+        else:
+            # Exchange compacted bids; every shard sees the same global
+            # bucket.
+            cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
+            choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
+            cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
+            accept_g, load, rem_cap = waterfill_accept(
+                cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
+            accept_l = jax.lax.dynamic_slice(
+                accept_g, (d * k_local,), (k_local,))
         assigned = jnp.where(accept_l, choice, assigned)
 
     idx_global = jnp.where(jnp.arange(k_local) < total,
@@ -104,18 +188,20 @@ def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
 
 
 def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
-                       k_local: int, rounds: int, impl: str):
+                       k_local: int, rounds: int, impl: str,
+                       shard_bids: bool):
     """Runs per-shard inside shard_map.  All [J/D]-shaped inputs are the
     local shard; load/rem_cap are replicated."""
     bid, fanout = _steps(impl)
     f = [fields[i:i + 1] for i in range(7)]
     fire = _fire_mask_jit(table, *f)[:, 0]
     return _tick_local(fire, elig, exclusive, cost, load, rem_cap,
-                       k_local, rounds, bid, fanout)
+                       k_local, rounds, bid, fanout, shard_bids)
 
 
 def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
-                         rem_cap, k_local: int, rounds: int, impl: str):
+                         rem_cap, k_local: int, rounds: int, impl: str,
+                         shard_bids: bool):
     """Fused windowed plan per shard: W seconds under one lax.scan with
     the tick collectives inside — the production cadence (plan ahead of
     wall-clock, one dispatch per window) composed with the jobs mesh.
@@ -130,7 +216,7 @@ def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
         load, rem_cap = carry
         out, load, rem_cap = _tick_local(
             fire_col, elig, exclusive, cost, load, rem_cap,
-            k_local, rounds, bid, fanout)
+            k_local, rounds, bid, fanout, shard_bids)
         return (load, rem_cap), out
 
     (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
@@ -138,7 +224,8 @@ def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
 
 
 def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
-                  k_local: int, rounds: int, impl: str, bid_k, fanout):
+                  k_local: int, rounds: int, impl: str, bid_k, fanout,
+                  shard_bids: bool = False):
     """One second of the (jobs x nodes) mesh plan, per device — THE
     single definition shared by the per-tick body and the fused windowed
     scan (same no-drift contract as the 1-D _tick_local).
@@ -202,14 +289,22 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
                          axis=0)
         choice = jnp.where(jnp.isfinite(best), choice, 0)
         cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
-        # candidate exchange along jobs; identical accept on every shard
-        cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
-        choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
-        cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
-        accept_g, load, rem_cap = waterfill_accept(
-            cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
-        accept_l = jax.lax.dynamic_slice(accept_g, (dj * k_local,),
-                                         (k_local,))
+        if shard_bids:
+            # demand-summary exchange along jobs (O(N)); the node-axis
+            # argmin reduce above already made `choice` global
+            accept_l, load, rem_cap = _reconcile_sharded(
+                cand_l, choice, cost_k, load, rem_cap,
+                r == rounds - 1, AXIS)
+        else:
+            # candidate exchange along jobs; identical accept on every
+            # shard
+            cand_g = jax.lax.all_gather(cand_l, AXIS, tiled=True)
+            choice_g = jax.lax.all_gather(choice, AXIS, tiled=True)
+            cost_g = jax.lax.all_gather(cost_k, AXIS, tiled=True)
+            accept_g, load, rem_cap = waterfill_accept(
+                cand_g, choice_g, cost_g, load, rem_cap, r == rounds - 1)
+            accept_l = jax.lax.dynamic_slice(accept_g, (dj * k_local,),
+                                             (k_local,))
         assigned = jnp.where(accept_l, choice, assigned)
 
     idx_global = jnp.where(jnp.arange(k_local) < total,
@@ -220,18 +315,20 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
 
 
 def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
-                         rem_cap, k_local: int, rounds: int, impl: str):
+                         rem_cap, k_local: int, rounds: int, impl: str,
+                         shard_bids: bool):
     """Per-tick body over the (jobs, nodes) mesh — fire mask + one
     _tick2d_local."""
     bid_k, fanout = _steps(impl)
     f = [fields[i:i + 1] for i in range(7)]
     fire = _fire_mask_jit(table, *f)[:, 0]
     return _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
-                         k_local, rounds, impl, bid_k, fanout)
+                         k_local, rounds, impl, bid_k, fanout, shard_bids)
 
 
 def _sharded2d_window_body(table, fields_w, elig, exclusive, cost, load,
-                           rem_cap, k_local: int, rounds: int, impl: str):
+                           rem_cap, k_local: int, rounds: int, impl: str,
+                           shard_bids: bool):
     """Fused windowed plan over the 2-D mesh: W seconds under one
     lax.scan with all collectives inside — one dispatch per window (the
     RTT-amortizing production cadence, same as the 1-D planner's fused
@@ -246,7 +343,7 @@ def _sharded2d_window_body(table, fields_w, elig, exclusive, cost, load,
         load, rem_cap = carry
         out, load, rem_cap = _tick2d_local(
             fire_col, elig, exclusive, cost, load, rem_cap,
-            k_local, rounds, impl, bid_k, fanout)
+            k_local, rounds, impl, bid_k, fanout, shard_bids)
         return (load, rem_cap), out
 
     (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
@@ -261,12 +358,19 @@ class _ShardedPlannerBase:
 
     def _init_common(self, mesh: Mesh, job_capacity: int,
                      node_capacity: int, rounds: int, impl: str,
-                     max_fire_bucket: int, tz, word_align: int):
+                     max_fire_bucket: int, tz, word_align: int,
+                     shard_bids: bool = True):
         import datetime
         self.mesh = mesh
         self.tz = tz or datetime.timezone.utc
         self.rounds = rounds
         self.impl = impl
+        # bucket-sharded bidding (O(nodes) demand exchange per round) is
+        # the default; False keeps the replicated waterfill over the
+        # gathered candidate bucket (O(fired x k)) as the reference /
+        # rollback path — the randomized differential test pins the two
+        # fire-set-identical
+        self.shard_bids = shard_bids
         self.J = _next_pow2(max(job_capacity, self.Dj * 256))
         if self.J % self.Dj:
             raise ValueError("job capacity must shard evenly")
@@ -286,6 +390,16 @@ class _ShardedPlannerBase:
         self.load = jax.device_put(np.zeros(self.N, np.float32), self._repl)
         self.rem_cap = jax.device_put(np.zeros(self.N, np.int32), self._repl)
         self._step_cache = {}
+        # mesh tick observability: per-tick plan latency ring + phase /
+        # collective counters, surfaced by stats_snapshot() and rendered
+        # at /v1/metrics as cronsun_mesh_tick_* (the scheduler publishes
+        # a second leased snapshot under component "mesh")
+        from ..metrics import LatencyRing
+        self.tick_ms = LatencyRing()
+        self._ticks_total = 0
+        self._collective_bytes_total = 0
+        self._last_k_local = 0
+        self._phase_profile: dict = {}
         # multi-host meshes (jax.distributed over DCN / Gloo): per-shard
         # plan outputs span non-addressable devices, so fetching them
         # needs a cross-process allgather; single-host fetches stay a
@@ -300,15 +414,13 @@ class _ShardedPlannerBase:
         return np.asarray(arr)
 
     def _step(self, k_local: int, impl: str):
-        key = (k_local, impl)
+        key = (k_local, impl, self.shard_bids)
         if key not in self._step_cache:
-            from jax import shard_map
-            sm = shard_map(
+            sm = _shard_map(
                 self._body(k_local, impl), mesh=self.mesh,
                 in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
                           P(), P()),
-                out_specs=(P(None, AXIS), P(), P()),
-                check_vma=False)
+                out_specs=(P(None, AXIS), P(), P()))
             self._step_cache[key] = jax.jit(sm)
         return self._step_cache[key]
 
@@ -412,6 +524,7 @@ class _ShardedPlannerBase:
                         total_fired=total)
 
     def plan(self, epoch_s: int, sla_bucket: Optional[int] = None) -> TickPlan:
+        import time as _time
         k = sla_bucket or self.max_fire_bucket
         k_local = max(256, _next_pow2(k) // self.Dj)
         impl = self._resolve_impl(k_local)
@@ -419,22 +532,22 @@ class _ShardedPlannerBase:
         fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
                            f["dom"][0], f["month"][0], f["dow"][0],
                            epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
+        t0 = _time.perf_counter()
         out, self.load, self.rem_cap = self._step(k_local, impl)(
             self.table, jax.device_put(fields, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
         o = self._fetch(out)             # [3, Dj*k_local]
+        self._account_ticks(1, (_time.perf_counter() - t0) * 1e3, k_local)
         return self._decode(o, epoch_s, k_local)
 
     def _window_step(self, k_local: int, impl: str):
-        key = ("window", k_local, impl)
+        key = ("window", k_local, impl, self.shard_bids)
         if key not in self._step_cache:
-            from jax import shard_map
-            sm = shard_map(
+            sm = _shard_map(
                 self._window_body(k_local, impl), mesh=self.mesh,
                 in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
                           P(), P()),
-                out_specs=(P(None, None, AXIS), P(), P()),
-                check_vma=False)
+                out_specs=(P(None, None, AXIS), P(), P()))
             self._step_cache[key] = jax.jit(sm)
         return self._step_cache[key]
 
@@ -452,12 +565,182 @@ class _ShardedPlannerBase:
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
             np.arange(window_s, dtype=np.int64) + (epoch_s - FE),
         ], axis=1).astype(np.int32)
+        import time as _time
+        t0 = _time.perf_counter()
         outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
             self.table, jax.device_put(fields_w, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
         o = self._fetch(outs)            # [W, 3, Dj*k_local]
+        self._account_ticks(window_s, (_time.perf_counter() - t0) * 1e3,
+                            k_local)
         return [self._decode(o[w], epoch_s + w, k_local)
                 for w in range(window_s)]
+
+    # -- observability -----------------------------------------------------
+
+    def _account_ticks(self, n_ticks: int, total_ms: float, k_local: int):
+        # ONE ring sample per plan call (the window-averaged per-tick
+        # ms): repeating it per tick would let a single long window
+        # evict every real sample and flatten p99 onto p50
+        self.tick_ms.add(total_ms / max(1, n_ticks))
+        self._ticks_total += n_ticks
+        self._last_k_local = k_local
+        est = self.estimate_collective_bytes(k_local=k_local)
+        self._collective_bytes_total += n_ticks * est["per_tick"]
+
+    def estimate_collective_bytes(self, sla_bucket: Optional[int] = None,
+                                  k_local: Optional[int] = None) -> dict:
+        """Analytic per-tick inter-chip payload model at the planner's
+        shapes — the number the bench ladder reports and the slow-tier
+        gate compares.  ONE convention for every collective: the full
+        GATHERED output size for an all_gather (each device materializes
+        D x the per-shard payload; a ring moves ~that much past every
+        device), the logical payload once for a psum (reduce, not
+        replicate):
+
+        - replicated round: candidate triple all_gather — (1+4+4) B x
+          Dj*k_local gathered — linear in the fired bucket;
+        - sharded round: [2, N] f32 demand all_gather (8N x Dj
+          gathered) + [2, N] f32 accepted psum (8N) — independent of
+          the bucket but NOT of Dj: 8N*(Dj+1).  The crossover is
+          therefore 9*K vs 8N*(Dj+1): sharded bidding wins once the
+          fired bucket K clears ~0.9 x N x (Dj+1) rows — the herd
+          regime the optimization targets; at sparse ticks on wide
+          fleets (K below that) the replicated exchange is smaller
+          (see ROADMAP: compacted demand gather);
+        - 2-D meshes add the node-axis (best, choice) reduce — 8 B x
+          Dn*k_local gathered per round — and the [N] Common fan-out
+          gather; both paths pay those identically.
+        """
+        if k_local is None:
+            k = sla_bucket or self.max_fire_bucket
+            k_local = max(256, _next_pow2(k) // self.Dj)
+        N = self.N
+        dn = getattr(self, "Dn", 1)
+        repl_round = 9 * self.Dj * k_local
+        shard_round = 2 * N * 4 * (self.Dj + 1)
+        common = 4 * N * (2 if dn > 1 else 1)   # fanout psum (+2-D gather)
+        naxis_round = 8 * dn * k_local if dn > 1 else 0
+        mine = shard_round if self.shard_bids else repl_round
+        return {
+            "replicated_per_round": repl_round + naxis_round,
+            "sharded_per_round": shard_round + naxis_round,
+            "per_round": mine + naxis_round,
+            "per_tick": self.rounds * (mine + naxis_round) + common,
+            "k_local": k_local,
+        }
+
+    def profile_phases(self, sla_bucket: Optional[int] = None,
+                       iters: int = 10) -> dict:
+        """Per-phase microbench at the planner's CURRENT shapes: one
+        bid sweep, one round's collective exchange, one round's
+        waterfill/reconcile math — each timed as its own jitted program
+        (phases inside the fused shard_map step can't be timed in
+        situ).  Returns {bid_ms, gather_ms, reconcile_ms} per round and
+        caches the result for stats_snapshot()."""
+        import time as _time
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        impl = self._resolve_impl(k_local)
+        bid, _ = _steps(impl)
+        dn = getattr(self, "Dn", 1)
+        w32 = self.N // 32 // dn
+        rng = np.random.default_rng(0)
+        packed = jnp.asarray(
+            rng.integers(0, 2**32, (k_local, w32), dtype=np.uint32))
+        load = jnp.asarray(rng.random(w32 * 32).astype(np.float32))
+        loadN = jnp.asarray(rng.random(self.N).astype(np.float32))
+        cap = jnp.full(self.N, 4, jnp.int32)
+        cand = jnp.asarray(rng.random(k_local) < 0.5)
+        choice = jnp.asarray(
+            rng.integers(0, self.N, k_local).astype(np.int32))
+        cost = jnp.ones(k_local, jnp.float32)
+
+        bid_f = jax.jit(lambda p, l: bid(p, l))
+
+        if self.shard_bids:
+            def gather_body(d2):
+                g = jax.lax.all_gather(d2, AXIS)
+                return jax.lax.psum(d2, AXIS) + g.sum(0)
+            gather_f = jax.jit(_shard_map(
+                gather_body, mesh=self.mesh,
+                in_specs=(P(),), out_specs=P()))
+            gather_arg = (jnp.zeros((2, self.N), jnp.float32),)
+
+            def rec_f(cand, choice, cost, load, cap):
+                rank, cum, demand = local_bid_demand(
+                    cand, choice, cost, self.N)
+                acc = waterfill_accept_presplit(
+                    cand, choice, cost, load, cap, False, rank, cum,
+                    jnp.sum(demand[1]))
+                return acc, demand
+            rec_f = jax.jit(rec_f)
+            rec_args = (cand, choice, cost, loadN, cap)
+        else:
+            def gather_body(c, ch, co):
+                return (jax.lax.all_gather(c, AXIS, tiled=True),
+                        jax.lax.all_gather(ch, AXIS, tiled=True),
+                        jax.lax.all_gather(co, AXIS, tiled=True))
+            gather_f = jax.jit(_shard_map(
+                gather_body, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), P(AXIS)),
+                out_specs=(P(), P(), P())))
+            gather_arg = (
+                jax.device_put(np.zeros(self.Dj * k_local, bool),
+                               self._shard),
+                jax.device_put(np.zeros(self.Dj * k_local, np.int32),
+                               self._shard),
+                jax.device_put(np.zeros(self.Dj * k_local, np.float32),
+                               self._shard))
+            K = self.Dj * k_local
+            cand_g = jnp.asarray(rng.random(K) < 0.5)
+            choice_g = jnp.asarray(
+                rng.integers(0, self.N, K).astype(np.int32))
+            rec_f = jax.jit(partial(waterfill_accept, is_final=False))
+            rec_args = (cand_g, choice_g, jnp.ones(K, jnp.float32),
+                        loadN, cap)
+
+        def timed(fn, args):
+            out = fn(*args)
+            jax.tree_util.tree_map(
+                lambda a: getattr(a, "block_until_ready", lambda: a)(),
+                out)
+            best = np.inf
+            for _ in range(iters):
+                s = _time.perf_counter()
+                out = fn(*args)
+                jax.tree_util.tree_map(
+                    lambda a: getattr(a, "block_until_ready",
+                                      lambda: a)(), out)
+                best = min(best, _time.perf_counter() - s)
+            return best * 1e3
+
+        prof = {
+            "bid_ms": round(timed(bid_f, (packed, load)), 4),
+            "gather_ms": round(timed(gather_f, gather_arg), 4),
+            "reconcile_ms": round(timed(rec_f, rec_args), 4),
+        }
+        self._phase_profile = prof
+        return prof
+
+    def stats_snapshot(self) -> dict:
+        """Leased-metrics snapshot (component "mesh"): per-tick latency
+        distribution, tick totals, the analytic collective-bytes
+        estimate, and the last per-phase microbench if one ran."""
+        est = self.estimate_collective_bytes(
+            k_local=self._last_k_local or None)
+        return {
+            "tick_p50_ms": round(self.tick_ms.percentile(0.50), 3),
+            "tick_p99_ms": round(self.tick_ms.percentile(0.99), 3),
+            "ticks_total": self._ticks_total,
+            "collective_bytes_total": self._collective_bytes_total,
+            "collective_bytes_per_tick": est["per_tick"],
+            "collective_bytes_per_round": est["per_round"],
+            "devices": int(self.mesh.devices.size),
+            "shard_bids": 1 if self.shard_bids else 0,
+            "rounds": self.rounds,
+            **{f"phase_{k}": v for k, v in self._phase_profile.items()},
+        }
 
 
 class ShardedTickPlanner(_ShardedPlannerBase):
@@ -466,19 +749,23 @@ class ShardedTickPlanner(_ShardedPlannerBase):
 
     def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
                  rounds: int = 3, impl: str = "auto",
-                 max_fire_bucket: int = 65536, tz=None):
+                 max_fire_bucket: int = 65536, tz=None,
+                 shard_bids: bool = True):
         self.Dj = self.D = mesh.devices.size
         self._elig_spec = P(AXIS, None)
         self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
-                          max_fire_bucket, tz, word_align=32)
+                          max_fire_bucket, tz, word_align=32,
+                          shard_bids=shard_bids)
 
     def _body(self, k_local: int, impl: str):
         return partial(_sharded_plan_body, k_local=k_local,
-                       rounds=self.rounds, impl=impl)
+                       rounds=self.rounds, impl=impl,
+                       shard_bids=self.shard_bids)
 
     def _window_body(self, k_local: int, impl: str):
         return partial(_sharded_window_body, k_local=k_local,
-                       rounds=self.rounds, impl=impl)
+                       rounds=self.rounds, impl=impl,
+                       shard_bids=self.shard_bids)
 
 
 class Sharded2DTickPlanner(_ShardedPlannerBase):
@@ -494,19 +781,23 @@ class Sharded2DTickPlanner(_ShardedPlannerBase):
 
     def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
                  rounds: int = 3, impl: str = "jnp",
-                 max_fire_bucket: int = 65536, tz=None):
+                 max_fire_bucket: int = 65536, tz=None,
+                 shard_bids: bool = True):
         if mesh.axis_names != (AXIS, NAXIS):
             raise ValueError(f"need a ({AXIS!r}, {NAXIS!r}) mesh")
         self.Dj = mesh.shape[AXIS]
         self.Dn = mesh.shape[NAXIS]
         self._elig_spec = P(AXIS, NAXIS)
         self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
-                          max_fire_bucket, tz, word_align=32 * self.Dn)
+                          max_fire_bucket, tz, word_align=32 * self.Dn,
+                          shard_bids=shard_bids)
 
     def _body(self, k_local: int, impl: str):
         return partial(_sharded2d_plan_body, k_local=k_local,
-                       rounds=self.rounds, impl=impl)
+                       rounds=self.rounds, impl=impl,
+                       shard_bids=self.shard_bids)
 
     def _window_body(self, k_local: int, impl: str):
         return partial(_sharded2d_window_body, k_local=k_local,
-                       rounds=self.rounds, impl=impl)
+                       rounds=self.rounds, impl=impl,
+                       shard_bids=self.shard_bids)

@@ -210,17 +210,30 @@ class SchedulerService:
         # checkpoint plane: periodic/operator-triggered saves of the
         # BUILT state (see checkpoint_save), restored at construction
         # when a checkpoint is present — the warm-takeover path.
-        # Sharded/proxied planners are refused HERE (not just in the
-        # launcher): a checkpoint of sharded device state would restore
-        # as plain single-device arrays and silently break the mesh
-        # sharding invariants the collective plan path relies on
-        # (per-rank shard checkpoints are a ROADMAP follow-on).
+        # Single-host MESH planners checkpoint too: their device shards
+        # host-gather through the planner's _fetch into the same
+        # sched_ckpt format, tagged with the mesh topology (a
+        # topology-mismatched restore cold-loads loudly, and set_table/
+        # set_eligibility re-pin the canonical shardings on install).
+        # Refused HERE (not just in the launcher): proxied multi-host
+        # planners (PlannerSyncProxy and its workers' op-log replay) and
+        # unknown planner subclasses, whose restore would install
+        # arrays with invariants this code cannot vouch for.
         from ..ops.planner import TickPlanner as _PlainPlanner
         if checkpoint_dir and type(self.planner) is not _PlainPlanner:
-            log.warnf("checkpoint_dir is not supported with %s planners "
-                      "yet; disabling scheduler checkpoints",
-                      type(self.planner).__name__)
-            checkpoint_dir = None
+            ok = False
+            try:
+                from ..parallel.mesh import _ShardedPlannerBase
+                ok = (isinstance(self.planner, _ShardedPlannerBase)
+                      and not getattr(self.planner, "_multiprocess",
+                                      False))
+            except Exception:  # noqa: BLE001 — no mesh support installed
+                ok = False
+            if not ok:
+                log.warnf("checkpoint_dir is not supported with %s "
+                          "planners yet; disabling scheduler checkpoints",
+                          type(self.planner).__name__)
+                checkpoint_dir = None
         # sharded stores have PER-SHARD revisions: the scalar-rev watch
         # barrier that proves a checkpoint's quiescent revision doesn't
         # exist across shards yet (a per-shard barrier vector is a
@@ -327,6 +340,16 @@ class SchedulerService:
         self.metrics = MetricsPublisher(
             store, self.ks, "sched", self.node_id, self.metrics_snapshot,
             interval_s=5.0, clock=clock)
+        # mesh planners publish a SECOND leased snapshot under component
+        # "mesh" (per-tick latency ring, per-phase counters, estimated
+        # collective bytes) so /v1/metrics renders cronsun_mesh_tick_*
+        # beside the sched gauges
+        self._mesh_metrics = None
+        mesh_snap = getattr(self.planner, "stats_snapshot", None)
+        if callable(mesh_snap):
+            self._mesh_metrics = MetricsPublisher(
+                store, self.ks, "mesh", self.node_id, mesh_snap,
+                interval_s=5.0, clock=clock)
 
         # warm path first: restore a checkpoint (built state + watch
         # delta replay) when one is present; any mismatch falls back to
@@ -1008,25 +1031,45 @@ class SchedulerService:
                   rev, ms, path)
         return {"rev": rev, "ms": ms, "path": path}
 
+    def _mesh_topology(self) -> Optional[dict]:
+        """Mesh-planner topology tag for checkpoints: a checkpoint of
+        device shards is only restorable onto the SAME mesh shape (the
+        fetched host arrays are shape-complete, but a different split
+        changes placement determinism and the per-rank re-pin layout) —
+        a mismatch cold-loads loudly.  None for the plain planner, so
+        pre-mesh checkpoints (no "mesh" field) keep restoring."""
+        if getattr(self.planner, "mesh", None) is None:
+            return None
+        return {"kind": type(self.planner).__name__,
+                "dj": int(getattr(self.planner, "Dj", 1)),
+                "dn": int(getattr(self.planner, "Dn", 1)),
+                "devices": int(self.planner.mesh.devices.size)}
+
     def _checkpoint_state(self, rev: int) -> dict:
         import dataclasses
         import jax
         from ..checkpoint.sched_ckpt import pack_jobs
         table = self.planner.table
+        # device state materializes through the planner's _fetch when it
+        # has one (mesh planners: host-gathers the per-rank shards — on
+        # multihost meshes that is a cross-process allgather); the plain
+        # planner's arrays are a direct device read
+        fetch = getattr(self.planner, "_fetch",
+                        lambda a: np.asarray(jax.device_get(a)))
         return dict(
             rev=rev, saved_at=time.time(), node_id=self.node_id,
             prefix=self.ks.prefix, J=self.planner.J, N=self.planner.N,
+            mesh=self._mesh_topology(),
             # device state materialized to host numpy: the packed
             # schedule table (no cron re-parse on restore), eligibility
             # matrix, job meta.  load/rem_cap are NOT checkpointed —
             # reconcile_capacity rewrites both absolutely from the
             # mirrors every leading step.
-            table={f.name: np.asarray(jax.device_get(
-                       getattr(table, f.name)))
+            table={f.name: np.asarray(fetch(getattr(table, f.name)))
                    for f in dataclasses.fields(table)},
-            elig=np.asarray(jax.device_get(self.planner.elig)),
-            exclusive=np.asarray(jax.device_get(self.planner.exclusive)),
-            cost=np.asarray(jax.device_get(self.planner.cost)),
+            elig=np.asarray(fetch(self.planner.elig)),
+            exclusive=np.asarray(fetch(self.planner.exclusive)),
+            cost=np.asarray(fetch(self.planner.cost)),
             # jobs ride columnar (pack_jobs); the builder's per-row rule
             # inputs and reverse group index are DERIVED from them at
             # restore (set_job aliases the rules' own lists, so the
@@ -1107,6 +1150,13 @@ class SchedulerService:
                 raise CheckpointError(
                     f"planner shape J={st.get('J')}/N={st.get('N')} != "
                     f"J={self.planner.J}/N={self.planner.N}")
+            # mesh topology must match exactly (absent field == plain
+            # planner, so pre-mesh checkpoints stay restorable on plain
+            # planners and nothing else)
+            if st.get("mesh") != self._mesh_topology():
+                raise CheckpointError(
+                    f"mesh topology {st.get('mesh')} != this planner's "
+                    f"{self._mesh_topology()}")
             rev = int(st["rev"])
             try:
                 table = ScheduleTable(**{k: jnp.asarray(v)
@@ -1214,11 +1264,18 @@ class SchedulerService:
         self._load_sum = m["load"]
         # device state: table + eligibility + job meta land whole; node
         # capacities as at a cold load's end (reconcile_capacity
-        # rewrites load/rem_cap from the mirrors every leading step)
+        # rewrites load/rem_cap from the mirrors every leading step).
+        # Mesh planners install through their setters so every array is
+        # re-pinned to the canonical sharding (set_table already is the
+        # polymorphic re-pin point for both planner kinds).
         self.planner.set_table(table)
-        self.planner.elig = elig
-        self.planner.exclusive = excl
-        self.planner.cost = cost
+        if hasattr(self.planner, "set_eligibility"):
+            self.planner.set_eligibility(elig)
+            self.planner.set_job_meta_full(excl, cost)
+        else:
+            self.planner.elig = elig
+            self.planner.exclusive = excl
+            self.planner.cost = cost
         if self.universe.index:
             cols = np.asarray(list(self.universe.index.values()),
                               np.int32)
@@ -1459,6 +1516,8 @@ class SchedulerService:
             # standbys still publish (throttled): "is my failover target
             # alive" is an operator question too
             self.metrics.maybe_publish()
+            if self._mesh_metrics is not None:
+                self._mesh_metrics.maybe_publish()
             return 0
         if self.stats["steps_total"]:
             # escalation sizes warm while leading — but only after the
@@ -1538,6 +1597,8 @@ class SchedulerService:
             self._span_ring(k).add(v)
         self.stats["steps_total"] += 1
         self.metrics.maybe_publish()
+        if self._mesh_metrics is not None:
+            self._mesh_metrics.maybe_publish()
         return n_dispatch
 
     def _step_serial(self, start: int, window: int, spans: dict,
@@ -2245,3 +2306,5 @@ class SchedulerService:
             except Exception:  # noqa: BLE001 — already dead
                 pass
         self.metrics.revoke()
+        if self._mesh_metrics is not None:
+            self._mesh_metrics.revoke()

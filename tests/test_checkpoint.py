@@ -576,3 +576,118 @@ def test_sched_periodic_checkpoint(sched_world):
     a.step()
     assert os.path.exists(os.path.join(d, "sched.ckpt"))
     assert a.metrics_snapshot()["checkpoint_saves_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mesh-planner checkpoints (per-rank shards host-gathered through _fetch)
+# ---------------------------------------------------------------------------
+
+def _mesh_planner(kind="1d", job_capacity=2048, node_capacity=64):
+    """Planners engineered to SHARE J/N across topologies (J=2048,
+    N=64 for all three kinds) so a cross-topology restore exercises the
+    mesh-topology check, not the earlier shape check."""
+    from cronsun_tpu.parallel.mesh import (Sharded2DTickPlanner,
+                                           ShardedTickPlanner, make_mesh,
+                                           make_mesh2d)
+    if kind == "2d":
+        return Sharded2DTickPlanner(
+            make_mesh2d(4, 2), job_capacity=job_capacity,
+            node_capacity=node_capacity)
+    return ShardedTickPlanner(
+        make_mesh(8), job_capacity=job_capacity,
+        node_capacity=node_capacity, impl="jnp")
+
+
+def _make_mesh_sched(store, ks, node_id, kind="1d", **kw):
+    from cronsun_tpu.sched import SchedulerService
+    return SchedulerService(store, ks=ks, job_capacity=2048,
+                            node_capacity=64, node_id=node_id,
+                            planner=_mesh_planner(kind), **kw)
+
+
+def test_mesh_sched_checkpoint_roundtrip(sched_world):
+    """A mesh planner's scheduler ACCEPTS checkpoint_dir; a same-topology
+    restore is warm and fire-set-identical (byte-identical orders: the
+    restored standby replays the same allocator state and the sharded
+    plan is deterministic per mesh shape)."""
+    store, ks, d, svcs = sched_world
+    a = _make_mesh_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    assert a.checkpoint_dir == d      # accepted, not silently disabled
+    out = a.checkpoint_save()
+    assert out["rev"] > 0
+
+    # delta between checkpoint and takeover replays on restore
+    store.put(f"{ks.cmd}g/extra", json.dumps(
+        {"name": "extra", "command": "true", "kind": 2,
+         "rules": [{"id": "r", "timer": "@every 10s", "nids": ["n1"]}]}))
+    store.delete(f"{ks.cmd}g/j5")
+
+    b = _make_mesh_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_restored
+    b.drain_watches()
+    b._flush_device()
+    a.drain_watches()
+    a._flush_device()
+    assert b.jobs.keys() == a.jobs.keys()
+    assert ("g", "extra") in b.jobs and ("g", "j5") not in b.jobs
+
+    ep = (int(time.time()) // 60 + 2) * 60
+    na, oa = _window_orders(a, ep)
+    nb, ob = _window_orders(b, ep)
+    assert nb == na and ob == oa and len(ob) > 0
+
+
+def test_mesh_checkpoint_topology_mismatch_cold(sched_world, caplog):
+    """A checkpoint cut on one mesh topology must cold-load LOUDLY on a
+    different one — same J/N by construction, so only the topology tag
+    can refuse it: 1-D(8) -> 2-D(4x2), and 1-D(8) -> plain planner."""
+    from cronsun_tpu.sched import SchedulerService
+    store, ks, d, svcs = sched_world
+    a = _make_mesh_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+
+    b = _make_mesh_sched(store, ks, "B", kind="2d", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored      # cold, not crashed
+    assert len(b.jobs) == 64
+    # shapes really did match — the topology check is what refused it
+    assert b.planner.J == a.planner.J and b.planner.N == a.planner.N
+
+    p = SchedulerService(store, ks=ks, job_capacity=2048,
+                         node_capacity=64, node_id="P", checkpoint_dir=d)
+    svcs.append(p)
+    assert p.planner.J == a.planner.J and p.planner.N == a.planner.N
+    assert not p.checkpoint_restored
+    assert len(p.jobs) == 64
+
+    # and the reverse: a PLAIN checkpoint refuses onto a mesh planner
+    p.checkpoint_save()
+    c = _make_mesh_sched(store, ks, "C", checkpoint_dir=d)
+    svcs.append(c)
+    assert not c.checkpoint_restored
+    assert len(c.jobs) == 64
+
+
+def test_mesh_checkpoint_refused_multiprocess_and_proxy(sched_world):
+    """Multi-host mesh planners (and the hostsync proxy wrapping them)
+    stay refused: restore-time coordination across ranks isn't built."""
+    store, ks, d, svcs = sched_world
+    mp = _mesh_planner()
+    mp._multiprocess = True               # what jax.distributed would set
+    from cronsun_tpu.sched import SchedulerService
+    a = SchedulerService(store, ks=ks, job_capacity=2048,
+                         node_capacity=64, node_id="A", planner=mp,
+                         checkpoint_dir=d)
+    svcs.append(a)
+    assert a.checkpoint_dir is None
+
+    from cronsun_tpu.parallel.hostsync import PlannerSyncProxy
+    prox = PlannerSyncProxy(_mesh_planner())
+    b = SchedulerService(store, ks=ks, job_capacity=2048,
+                         node_capacity=64, node_id="B", planner=prox,
+                         checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_dir is None

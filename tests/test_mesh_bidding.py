@@ -1,0 +1,277 @@
+"""Bucket-sharded bidding vs the replicated waterfill: the differential
+contract.
+
+The sharded reconcile exchanges per-node demand summaries (O(nodes))
+instead of the candidate bids (O(fired x k)); assign.py's
+waterfill_accept_presplit docstring derives why the accept predicate is
+EXACTLY the replicated waterfill's.  These tests pin it empirically:
+randomized instances on the 1-D and 2-D meshes (8 forced-host devices)
+must produce identical fired sets AND identical placements (costs are
+integer-valued so every cost sum is exact in f32 — the equality is
+bit-for-bit, not approximate), with identical carried load/rem_cap.
+
+The slow-tier gate (test_mesh_bid_scaling) runs SUBPROCESS-ISOLATED at
+8 forced devices: 3 randomized shapes, fire sets identical, and the
+sharded path's estimated per-round collective bytes strictly below the
+replicated path's.  A tier-1 smoke pins `bench_mesh.py --quick` green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import forced_cpu_env
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _random_state(J, N, seed):
+    from cronsun_tpu.cron.parser import parse
+    from cronsun_tpu.ops.eligibility import pack_bitmask
+    rng = np.random.default_rng(seed)
+    specs = [parse("* * * * * *") if rng.random() < 0.3 else
+             parse(f"{rng.integers(0, 60)} * * * * *") for _ in range(J)]
+    elig = np.zeros((J, N // 32), np.uint32)
+    for j in range(J):
+        cols = rng.choice(N, size=rng.integers(1, 6), replace=False)
+        elig[j] = pack_bitmask(cols.tolist(), N // 32)
+    excl = rng.random(J) < 0.7
+    # INTEGER costs: cost sums are exact in f32, so the sharded accepts
+    # must be bit-identical, not merely equivalent
+    cost = rng.integers(1, 4, J).astype(np.float32)
+    # tight capacities so the rank < rem_cap rationing actually bites
+    caps = rng.integers(1, 4, N).astype(np.int32)
+    return specs, elig, excl, cost, caps
+
+
+def _build(cls, mesh, J, N, state, shard_bids, **kw):
+    from cronsun_tpu.ops.schedule_table import build_table
+    specs, elig, excl, cost, caps = state
+    sp = cls(mesh, job_capacity=J, node_capacity=N, max_fire_bucket=2048,
+             shard_bids=shard_bids, **kw)
+    sp.set_table(build_table(specs, capacity=sp.J))
+    full = np.zeros((sp.J, sp.N // 32), np.uint32)
+    full[:J, :N // 32] = elig
+    sp.set_eligibility(full)
+    fe = np.zeros(sp.J, bool)
+    fe[:J] = excl
+    fc = np.ones(sp.J, np.float32)
+    fc[:J] = cost
+    sp.set_job_meta_full(fe, fc)
+    fcaps = np.zeros(sp.N, np.int32)
+    fcaps[:N] = caps
+    sp.set_node_capacity_full(fcaps)
+    return sp
+
+
+def _assert_identical(sharded, replicated, t0, ticks=3):
+    """Plans tick-by-tick on both planners: identical fired sets,
+    identical placements, identical carried load/rem_cap — load carries
+    across ticks, so divergence anywhere would compound and surface."""
+    for i in range(ticks):
+        pa = sharded.plan(t0 + i)
+        pb = replicated.plan(t0 + i)
+        assert set(pa.fired.tolist()) == set(pb.fired.tolist()), i
+        da = dict(zip(pa.fired.tolist(), pa.assigned.tolist()))
+        db = dict(zip(pb.fired.tolist(), pb.assigned.tolist()))
+        assert da == db, {k: (da.get(k), db.get(k))
+                          for k in da if da.get(k) != db.get(k)}
+        assert pa.overflow == pb.overflow
+    np.testing.assert_array_equal(np.asarray(sharded.rem_cap),
+                                  np.asarray(replicated.rem_cap))
+    np.testing.assert_array_equal(np.asarray(sharded.load),
+                                  np.asarray(replicated.load))
+
+
+def test_sharded_bids_differential_1d(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    for seed in (1, 7):
+        J, N = 4096, 96
+        state = _random_state(J, N, seed)
+        a = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp")
+        b = _build(ShardedTickPlanner, mesh, J, N, state, False,
+                   impl="jnp")
+        _assert_identical(a, b, 1_753_000_000 + seed * 100)
+
+
+def test_sharded_bids_differential_2d(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+    for dj, dn in ((4, 2), (2, 4)):
+        J, N = 4096, 128
+        state = _random_state(J, N, seed=11 + dj)
+        a = _build(Sharded2DTickPlanner, make_mesh2d(dj, dn), J, N,
+                   state, True)
+        b = _build(Sharded2DTickPlanner, make_mesh2d(dj, dn), J, N,
+                   state, False)
+        _assert_identical(a, b, 1_753_000_000)
+
+
+def test_sharded_bids_windowed_matches_replicated(forced_host_devices):
+    """The fused windowed scan composes with sharded bidding exactly as
+    with the replicated waterfill: same per-second fired sets and
+    placements, same carried load."""
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    J, N = 2048, 64
+    state = _random_state(J, N, seed=21)
+    a = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp")
+    b = _build(ShardedTickPlanner, mesh, J, N, state, False, impl="jnp")
+    t0, W = 1_753_000_000, 4
+    pw_a = a.plan_window(t0, W)
+    pw_b = b.plan_window(t0, W)
+    for pa, pb in zip(pw_a, pw_b):
+        assert set(pa.fired.tolist()) == set(pb.fired.tolist())
+        assert dict(zip(pa.fired.tolist(), pa.assigned.tolist())) == \
+            dict(zip(pb.fired.tolist(), pb.assigned.tolist()))
+    np.testing.assert_array_equal(np.asarray(a.load), np.asarray(b.load))
+
+
+def test_collective_bytes_model_ordering(forced_host_devices):
+    """The analytic payload model (ONE convention: gathered size for
+    all_gathers, payload once for psums): sharded rounds are
+    8N*(Dj+1), independent of the bucket; replicated rounds are 9K,
+    linear in it — so the crossover sits at K ≈ 0.9*N*(Dj+1), below
+    which the replicated exchange is genuinely smaller (sparse ticks
+    on wide fleets) and above which sharded bidding wins and keeps
+    winning linearly (the herd regime)."""
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    sp = ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                            node_capacity=1024, impl="jnp")
+    small = sp.estimate_collective_bytes(2048)
+    big = sp.estimate_collective_bytes(16384)
+    huge = sp.estimate_collective_bytes(65536)
+    # bucket-independent vs bucket-linear
+    assert small["sharded_per_round"] == big["sharded_per_round"] \
+        == huge["sharded_per_round"]
+    assert big["replicated_per_round"] > small["replicated_per_round"]
+    # exact model values at this shape (N=1024, Dj=8)
+    assert small["sharded_per_round"] == 8 * sp.N * 9
+    assert small["replicated_per_round"] == 9 * 8 * 256
+    # below the crossover the replicated exchange is smaller; above it
+    # sharded wins (16384 -> k_local=2048, 9K=147456 > 73728)
+    assert small["sharded_per_round"] > small["replicated_per_round"]
+    assert big["sharded_per_round"] < big["replicated_per_round"]
+    assert huge["sharded_per_round"] < huge["replicated_per_round"]
+    # the planner's own stats reflect its configured path
+    assert sp.stats_snapshot()["shard_bids"] == 1
+
+
+def test_mesh_stats_snapshot_counts_ticks(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    J, N = 2048, 64
+    state = _random_state(J, N, seed=3)
+    sp = _build(ShardedTickPlanner, make_mesh(8), J, N, state, True,
+                impl="jnp")
+    sp.plan(1_753_000_000)
+    sp.plan_window(1_753_000_010, 2)
+    snap = sp.stats_snapshot()
+    assert snap["ticks_total"] == 3
+    assert snap["tick_p50_ms"] > 0
+    assert snap["collective_bytes_total"] == \
+        3 * snap["collective_bytes_per_tick"]
+    assert snap["devices"] == 8 and snap["shard_bids"] == 1
+
+
+def test_scheduler_publishes_mesh_metrics(forced_host_devices):
+    """A scheduler over a mesh planner publishes the component="mesh"
+    leased snapshot, rendered by /v1/metrics as cronsun_mesh_tick_*."""
+    from cronsun_tpu.core import Keyspace
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    from cronsun_tpu.sched import SchedulerService
+    from cronsun_tpu.store.memstore import MemStore
+    ks = Keyspace()
+    store = MemStore()
+    store.put(ks.node_key("n0"), "1")
+    store.put(f"{ks.cmd}g/j0", json.dumps(
+        {"name": "j0", "command": "true", "kind": 0,
+         "rules": [{"id": "r", "timer": "@every 2s", "nids": ["n0"]}]}))
+    svc = SchedulerService(
+        store, ks=ks, job_capacity=512, node_capacity=32, node_id="M",
+        planner=ShardedTickPlanner(make_mesh(8), job_capacity=512,
+                                   node_capacity=32, impl="jnp"))
+    try:
+        svc.step()
+        svc._mesh_metrics.maybe_publish()
+        kv = store.get(ks.metrics_key("mesh", "M"))
+        assert kv is not None
+        snap = json.loads(kv.value)
+        assert "tick_p50_ms" in snap and "collective_bytes_per_tick" in snap
+        assert snap["shard_bids"] == 1 and snap["devices"] == 8
+    finally:
+        svc.stop()
+
+
+def test_bench_mesh_quick_smoke():
+    """`bench_mesh.py --quick` exits 0 with nonzero tick counts — the
+    tier-1 pin that the ladder keeps running end to end (it spawns its
+    own forced-host subprocesses, so it is backend-independent)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_mesh.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=420, cwd=ROOT,
+        env=forced_cpu_env(2))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["multichip_ticks_total"] > 0
+    measured = [r for r in out["multichip_ladder"]
+                if r.get("path") in ("sharded", "replicated")]
+    assert measured and all(r["fired_per_tick"] > 0 for r in measured)
+    assert all(r["tick_p99_ms"] > 0 for r in measured)
+    assert out["git_rev"] and out["generated_at_utc"]
+
+
+# ---------------------------------------------------------------------------
+# slow-tier gate: subprocess-isolated scaling check at 8 forced devices
+# ---------------------------------------------------------------------------
+
+def _scaling_worker():
+    """Runs in a subprocess with 8 forced-host CPU devices: 3 randomized
+    shapes, sharded vs replicated — fire sets must be identical and the
+    sharded path's estimated per-round collective bytes strictly lower."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= 8, jax.devices()
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    out = []
+    for seed, (J, N) in ((31, (4096, 96)), (32, (8192, 64)),
+                         (33, (2048, 160))):
+        state = _random_state(J, N, seed)
+        a = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp")
+        b = _build(ShardedTickPlanner, mesh, J, N, state, False,
+                   impl="jnp")
+        _assert_identical(a, b, 1_753_000_000 + seed, ticks=2)
+        est = a.estimate_collective_bytes(2048)
+        out.append({
+            "shape": [J, N],
+            "sharded_per_round": est["sharded_per_round"],
+            "replicated_per_round": est["replicated_per_round"],
+            "identical": True,
+        })
+    print(json.dumps(out))
+
+
+@pytest.mark.slow
+def test_mesh_bid_scaling():
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scaling-worker"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+        env=forced_cpu_env(8))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert len(rows) == 3
+    for r in rows:
+        assert r["identical"]
+        assert r["sharded_per_round"] < r["replicated_per_round"], r
+
+
+if __name__ == "__main__":
+    if "--scaling-worker" in sys.argv:
+        sys.path.insert(0, ROOT)
+        _scaling_worker()
