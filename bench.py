@@ -519,7 +519,11 @@ def main():
             [sys.executable, os.path.join(here, "scripts",
                                           "bench_query.py"),
              "--logd-shards", "1" if quick else "2",
-             "--readers", "4", "--seconds", "2" if quick else "4"],
+             "--readers", "4" if quick else "6",
+             "--seconds", "2" if quick else "4"]
+            # full runs exercise the tier boundary: an aged-out day
+            # behind the watermark, 20% of history reads crossing it
+            + ([] if quick else ["--cold-fraction", "0.2"]),
             capture_output=True, text=True, timeout=600, cwd=here)
         if proc.returncode == 0:
             detail.update(json.loads(proc.stdout))

@@ -535,6 +535,66 @@ def cmd_configurations(api, args):
     print(json.dumps(api.call("GET", "/v1/configurations"), indent=2))
 
 
+def cmd_logd_reshard(api, args):
+    """Result-plane resharding escape hatch: record ids encode the
+    shard count (raw * N + shard), so changing N is a dump/rehash/load
+    into a FRESH shard set — this command performs it over the wire
+    (logsink/sharded.reshard_sinks), re-encoding every id under the new
+    layout and re-pinning the destination logmap.  Talks to the logd
+    shards directly, not the web API."""
+    del api
+    from ..logsink.serve import RemoteJobLogStore
+
+    def connect(addrs):
+        conns = []
+        try:
+            for addr in addrs.split(","):
+                host, _, port = addr.strip().rpartition(":")
+                conns.append(RemoteJobLogStore(host or "127.0.0.1",
+                                               int(port),
+                                               token=args.token or ""))
+        except BaseException:
+            for c in conns:
+                c.close()
+            raise
+        return conns
+    from ..logsink.sharded import reshard_sinks
+    src = dst = []
+    try:
+        src = connect(getattr(args, "from"))
+        dst = connect(args.to)
+        summary = reshard_sinks(
+            src, dst, batch=args.batch,
+            on_log=lambda m: print(m, file=sys.stderr, flush=True))
+    except (RuntimeError, ValueError) as e:
+        # refusals (non-empty destination, mismatched logmaps) and
+        # malformed addresses exit cleanly — the tool protecting the
+        # data is not a crash
+        raise SystemExit(f"error: {e}")
+    finally:
+        for c in src + dst:
+            try:
+                c.close()
+            except OSError:
+                pass
+    if args.json:
+        print(json.dumps(summary, indent=2))
+        return
+    print(f"resharded {len(src)} -> {len(dst)} shards: "
+          f"{summary['records']} records, {summary['nodes']} nodes, "
+          f"{summary['accounts']} accounts")
+    if summary["stat_shortfall"]:
+        print(f"WARNING: {summary['stat_shortfall']} executions counted "
+              "in source stats had no surviving record (retention-"
+              "evicted); destination counters reflect migrated records "
+              "only", file=sys.stderr)
+    if summary.get("latest_shortfall"):
+        print(f"WARNING: {summary['latest_shortfall']} (job, node) "
+              "latest-status rows had no surviving record to rebuild "
+              "from and are absent from the destination's latest view",
+              file=sys.stderr)
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -669,6 +729,18 @@ def build_parser() -> argparse.ArgumentParser:
         "trigger store WAL snapshot + scheduler checkpoints (admin)")
     add("configurations", cmd_configurations,
         "security/alarm config exposed to the UI")
+
+    p = add("logd-reshard", cmd_logd_reshard,
+            "dump/rehash/load the result store into a new shard count "
+            "(destination must be a fresh, empty logd set)")
+    p.add_argument("--from", required=True, metavar="H:P,H:P,...",
+                   help="current logd shard address list (ALL shards)")
+    p.add_argument("--to", required=True, metavar="H:P,...",
+                   help="destination logd shard address list (empty set)")
+    p.add_argument("--token", default=None,
+                   help="logd auth token (default: none)")
+    p.add_argument("--batch", type=positive_int, default=500,
+                   help="records per cursor page / bulk load (default 500)")
     return ap
 
 

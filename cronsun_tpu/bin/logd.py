@@ -39,6 +39,14 @@ def main(argv=None) -> int:
                     help="execution-history retention cap in records, "
                          ">= 1 (stats/latest-status stay exact); "
                          "default: native 1M, Python unbounded")
+    ap.add_argument("--hot-days", type=int, default=0, metavar="D",
+                    help="tiered retention: keep D whole UTC days of "
+                         "records HOT (in memory / SQL); older days age "
+                         "into immutable per-day segment files "
+                         "(FILE.segs/<day>.seg) the history queries "
+                         "merge back in.  0 (default) = no day aging; "
+                         "CRONSUN_TIERING=off also disables the hot "
+                         "read mirrors entirely")
     ap.add_argument("--shards", type=int, default=1, metavar="N",
                     help="serve a RESULT-PLANE SHARD SET: N logd "
                          "servers on ports port..port+N-1, each with "
@@ -55,6 +63,8 @@ def main(argv=None) -> int:
         return 2
     if args.shards < 1:
         ap.error(f"--shards must be >= 1 (got {args.shards})")
+    if args.hot_days < 0:
+        ap.error(f"--hot-days must be >= 0 (got {args.hot_days})")
     cfg, ks, watcher = setup_common(args)
     token = cfg.log_token if args.token is None else args.token
 
@@ -87,6 +97,7 @@ def main(argv=None) -> int:
         for i in range(args.shards):
             srv = NativeLogSinkServer(host=args.host, port=shard_port(i),
                                       db=shard_db(i), retain=args.retain,
+                                      hot_days=args.hot_days or None,
                                       token=token).start()
             srv.monitor(child_died)
             servers.append(srv)
@@ -96,7 +107,8 @@ def main(argv=None) -> int:
                                          host=args.host,
                                          port=shard_port(i),
                                          token=token, sslctx=sslctx,
-                                         retain=args.retain or 0).start())
+                                         retain=args.retain or 0,
+                                         hot_days=args.hot_days).start())
     addrs = ",".join(f"{s.host}:{s.port}" for s in servers)
     if args.shards == 1:
         log.infof("cronsun-logd serving on %s (db %s)%s", addrs, db_base,

@@ -26,6 +26,7 @@ class NativeLogSinkServer(NativeProcess):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  binary: Optional[str] = None, db: Optional[str] = None,
                  retain: Optional[int] = None, token: str = "",
+                 hot_days: Optional[int] = None,
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         binary = binary or find_binary()
@@ -39,5 +40,7 @@ class NativeLogSinkServer(NativeProcess):
             argv += ["--db", db]
         if retain is not None:
             argv += ["--retain", str(retain)]
+        if hot_days is not None:
+            argv += ["--hot-days", str(hot_days)]
         super().__init__(binary, argv, token=token,
                          ready_timeout=ready_timeout)

@@ -27,7 +27,6 @@ carries Mongo credentials through config the same way
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import socket
 import socketserver
@@ -40,22 +39,78 @@ from ..store.wire import LineJsonHandler
 from .joblog import JobLogStore, LogRecord
 
 # ops dispatched 1:1 onto the JobLogStore surface (auth + create_job_log
-# + query_logs get special marshalling)
+# + query_logs + tail_snapshot get special marshalling)
 _PLAIN_OPS = ("get_log", "stat_overall", "stat_day", "stat_days",
               "upsert_node", "set_node_alived", "get_nodes", "get_node",
               "upsert_account", "get_account", "list_accounts",
-              "delete_account", "op_stats", "revision", "logmap")
+              "delete_account", "op_stats", "revision", "logmap",
+              "age_out", "tier_info")
 
 
 def _rec_wire(rec: Optional[LogRecord]):
-    return None if rec is None else dataclasses.asdict(rec)
+    # dict(__dict__), not dataclasses.asdict: asdict routes through the
+    # recursive deep-copy machinery (~10x slower) and a latest-view
+    # reply marshals 500+ records per dashboard poll; field order (and
+    # so the wire bytes) is identical — __dict__ fills in declaration
+    # order
+    return None if rec is None else dict(rec.__dict__)
 
 
 def _rec_unwire(w) -> Optional[LogRecord]:
-    return None if w is None else LogRecord(**w)
+    # positional construction — a latest reply carries 500+ records and
+    # LogRecord(**w) pays the keyword-matching path per row
+    return None if w is None else LogRecord(
+        w["job_id"], w["job_group"], w["name"], w["node"], w["user"],
+        w["command"], w["output"], w["success"], w["begin_ts"],
+        w["end_ts"], w["id"])
 
 
 class _Conn(LineJsonHandler):
+    def _send_raw(self, line: str):
+        data = line.encode()
+        with self.wlock:
+            try:
+                self.request.sendall(data)
+            except OSError:
+                self.alive = False
+
+    def _latest_reply_cached(self, sink, rid, kw) -> bool:
+        """Serialized-reply memo for the latest view, keyed on the
+        sink's revision (the web cache's idea one level down): a
+        dashboard fleet polling between write batches reuses the
+        MARSHALLED bytes — no row copies, no dict building, no
+        json.dumps of 500 records per poll.  Sound for the same reason
+        the web cache is: the revision is read BEFORE computing, so a
+        write racing the compute bumps it and the entry can never
+        satisfy a later poll.  Only engaged on tiered sinks (revision
+        there is a mirror read, not a SQL query).  Returns True when
+        it handled the request."""
+        if not kw.get("latest") or not getattr(sink, "_tier", False):
+            return False
+        try:
+            rev = sink.revision()
+        except Exception:  # noqa: BLE001 — fall back to the plain path
+            return False
+        key = json.dumps(kw, sort_keys=True)
+        cache = self.server.reply_cache           # type: ignore[attr-defined]
+        lock = self.server.reply_lock             # type: ignore[attr-defined]
+        with lock:
+            ent = cache.get(key)
+            payload = ent[1] if ent and ent[0] == rev else None
+        if payload is not None:
+            sink.op_count("q_latest_memo")   # served from marshalled bytes
+        else:
+            recs, total = sink.query_logs(**kw)
+            payload = json.dumps(
+                {"total": total, "list": [_rec_wire(r) for r in recs]},
+                separators=(",", ":"))
+            with lock:
+                cache[key] = (rev, payload)
+                while len(cache) > 64:
+                    cache.pop(next(iter(cache)))
+        self._send_raw('{"i":%d,"r":%s}\n' % (rid, payload))
+        return True
+
     def dispatch(self, rid, op, args):
         sink: JobLogStore = self.server.sink      # type: ignore[attr-defined]
         try:
@@ -70,9 +125,15 @@ class _Conn(LineJsonHandler):
                                                    args[1] if len(args) > 1
                                                    else None)})
             elif op == "query_logs":
-                recs, total = sink.query_logs(**args[0])
+                if not self._latest_reply_cached(sink, rid, args[0]):
+                    recs, total = sink.query_logs(**args[0])
+                    self._send({"i": rid, "r": {
+                        "total": total,
+                        "list": [_rec_wire(r) for r in recs]}})
+            elif op == "tail_snapshot":
+                rev, recs = sink.tail_snapshot(args[0] if args else 0)
                 self._send({"i": rid, "r": {
-                    "total": total,
+                    "revision": rev,
                     "list": [_rec_wire(r) for r in recs]}})
             elif op in _PLAIN_OPS:
                 r = getattr(sink, op)(*args)
@@ -158,8 +219,20 @@ class LogSinkServer:
     def __init__(self, sink: Optional[JobLogStore] = None,
                  db_path: str = ":memory:", host: str = "127.0.0.1",
                  port: int = 0, token: str = "", sslctx=None,
-                 retain: int = 0):
-        self.sink = sink or JobLogStore(db_path, retain=retain)
+                 retain: int = 0, hot_days: int = 0,
+                 age_interval: float = 30.0):
+        self.sink = sink or JobLogStore(db_path, retain=retain,
+                                        hot_days=hot_days)
+        # the retention sweeper's tier move: day aging rides a
+        # background beat (cheap when nothing aged — the boundary scan
+        # is one indexed MIN(id)); native logd runs the same loop on
+        # its sweep thread
+        self._age_stop = threading.Event()
+        self._age_thread: Optional[threading.Thread] = None
+        self._age_interval = age_interval
+        if hot_days > 0 and sink is None and db_path != ":memory:":
+            self._age_thread = threading.Thread(
+                target=self._age_loop, daemon=True, name="logsink-ager")
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -170,20 +243,43 @@ class LogSinkServer:
         self._srv.sslctx = sslctx                 # type: ignore[attr-defined]
         self._srv.idem = {}                       # type: ignore[attr-defined]
         self._srv.idem_lock = threading.Lock()    # type: ignore[attr-defined]
+        self._srv.reply_cache = {}                # type: ignore[attr-defined]
+        self._srv.reply_lock = threading.Lock()   # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def _age_loop(self):
+        while not self._age_stop.wait(self._age_interval):
+            try:
+                self.sink.age_out()
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                log.warnf("logsink age_out failed: %s", e)
 
     def start(self) -> "LogSinkServer":
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True, name="logsink-server")
         self._thread.start()
+        if self._age_thread:
+            self._age_thread.start()
         return self
 
     def stop(self):
-        self._srv.shutdown()
-        self._srv.server_close()
+        self._age_stop.set()
         if self._thread:
+            self._srv.shutdown()
+            self._srv.server_close()
             self._thread.join(timeout=3)
+        else:
+            # never start()ed (error-path cleanup): shutdown() would
+            # block forever on the serve_forever event that never fires
+            self._srv.server_close()
+        if self._age_thread and self._age_thread.ident is not None:
+            # only a STARTED thread can be joined (stop() on a
+            # constructed-but-never-started server must not raise);
+            # passes are bounded (AGE_PASS_RECORDS) so the in-flight
+            # one finishes inside the timeout — and the age loop
+            # catches-and-warns if the close below still races it
+            self._age_thread.join(timeout=10)
         self.sink.close()
 
 
@@ -326,6 +422,23 @@ class RemoteJobLogStore:
         """Monotone change token (max record id ever assigned) — the
         web tier's ETag key and the follow poller's tail bootstrap."""
         return self._call("revision")
+
+    def tail_snapshot(self, limit: int = 0) -> Tuple[int, List[LogRecord]]:
+        """Revision AND the last ``limit`` records from ONE server-side
+        snapshot — the follow bootstrap's atomic read (see
+        JobLogStore.tail_snapshot for why two reads can skip)."""
+        r = self._call("tail_snapshot", limit)
+        return r["revision"], [_rec_unwire(w) for w in r["list"]]
+
+    def age_out(self, now: Optional[float] = None) -> int:
+        """Force a cold-aging pass (the sweeper runs it periodically);
+        ``now`` overrides the clock for deterministic tests."""
+        return self._call("age_out") if now is None \
+            else self._call("age_out", now)
+
+    def tier_info(self) -> dict:
+        """Tiering observability: watermark, hot sizes, segments."""
+        return self._call("tier_info")
 
     def logmap(self, n=None, hash=None):
         """Topology pin (see JobLogStore.logmap): publish-if-absent with

@@ -66,6 +66,7 @@ returns the plain client after a read-only pin check).
 
 from __future__ import annotations
 
+import json
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -109,6 +110,55 @@ def advance_cursor(vec: Sequence[int], recs, nshards: int) -> List[int]:
         if raw > out[si]:
             out[si] = raw
     return out
+
+
+def fetch_top(client, kw: dict, need: int):
+    """Top ``need`` rows from one sink client under ``kw``'s filters
+    (the client's own documented order), paging at a fixed stride so
+    backend OFFSET math stays consistent.  -> (rows, client total).
+    Module-level so the web tier's response cache can compute one
+    shard's partial with exactly the scatter-gather's fetch."""
+    ps = max(1, min(500, need))
+    out: List[LogRecord] = []
+    total = 0
+    page = 1
+    while len(out) < need:
+        rows, total = client.query_logs(**kw, page=page, page_size=ps)
+        out.extend(rows)
+        if len(rows) < ps:
+            break
+        page += 1
+    return out[:need], total
+
+
+def merge_latest_parts(parts, page: int, page_size: int):
+    """Merge per-shard latest-view partials [(rows, total), ...] into
+    the one global page: both backends pin (begin_ts DESC, job_id,
+    node) and the (job, node) space partitions by shard, so this sort
+    IS the global order — byte-identical to an unsharded sink.  Shared
+    by the sharded read path and the web response cache (which reuses
+    unchanged shards' cached partials before this merge)."""
+    rows = [r for part, _t in parts for r in part]
+    rows.sort(key=lambda r: (-r.begin_ts, r.job_id, r.node))
+    total = sum(t for _p, t in parts)
+    return rows[(page - 1) * page_size: page * page_size], total
+
+
+def merge_stat_days(parts: List[List[dict]], n_days: int) -> List[dict]:
+    """Sum per-shard stat_days partials per day, newest first.  Exact:
+    each shard's top-n days contain every one of its days that falls
+    in the GLOBAL top-n (day order is global).  Shared by the sharded
+    read path and the web response cache."""
+    days: Dict[str, List[int]] = {}
+    for part in parts:
+        for d in part:
+            ent = days.setdefault(d["day"], [0, 0, 0])
+            ent[0] += d["total"]
+            ent[1] += d["successed"]
+            ent[2] += d["failed"]
+    return [{"day": day, "total": t, "successed": s, "failed": f}
+            for day, (t, s, f) in
+            sorted(days.items(), reverse=True)[:max(0, n_days)]]
 
 
 class ShardedJobLogStore:
@@ -214,21 +264,7 @@ class ShardedJobLogStore:
     # ---- queries ---------------------------------------------------------
 
     def _fetch_top(self, si: int, kw: dict, need: int):
-        """Top ``need`` rows from shard ``si`` under ``kw``'s filters
-        (the shard's own order), paging at a fixed stride so backend
-        OFFSET math stays consistent.  -> (rows, shard total)."""
-        ps = max(1, min(500, need))
-        out: List[LogRecord] = []
-        total = 0
-        page = 1
-        while len(out) < need:
-            rows, total = self.shards[si].query_logs(
-                **kw, page=page, page_size=ps)
-            out.extend(rows)
-            if len(rows) < ps:
-                break
-            page += 1
-        return out[:need], total
+        return fetch_top(self.shards[si], kw, need)
 
     def query_logs(self, node: Optional[str] = None,
                    job_ids: Optional[List[str]] = None,
@@ -288,11 +324,8 @@ class ShardedJobLogStore:
             for si in sids])
         total = sum(t for _si, _rows, t in parts)
         if latest:
-            # both backends pin (begin_ts DESC, job_id, node) and the
-            # (job, node) space partitions by shard, so this merge IS
-            # the global order — byte-identical to an unsharded sink
-            rows = [r for _si, part, _t in parts for r in part]
-            rows.sort(key=lambda r: (-r.begin_ts, r.job_id, r.node))
+            return merge_latest_parts(
+                [(part, t) for _si, part, t in parts], page, page_size)
         else:
             # documented cross-shard tie order: (begin_ts DESC, shard
             # ASC, id ASC) — per-shard order is preserved, ties across
@@ -330,21 +363,9 @@ class ShardedJobLogStore:
                                           for s in self.shards]))
 
     def stat_days(self, n_days: int) -> List[dict]:
-        # each shard's top-n days contain every one of its days that
-        # falls in the GLOBAL top-n (day order is global), so summing
-        # per day over the per-shard lists is exact
         parts = self._fan([lambda s=s: s.stat_days(n_days)
                            for s in self.shards])
-        days: Dict[str, List[int]] = {}
-        for part in parts:
-            for d in part:
-                ent = days.setdefault(d["day"], [0, 0, 0])
-                ent[0] += d["total"]
-                ent[1] += d["successed"]
-                ent[2] += d["failed"]
-        return [{"day": day, "total": t, "successed": s, "failed": f}
-                for day, (t, s, f) in
-                sorted(days.items(), reverse=True)[:max(0, n_days)]]
+        return merge_stat_days(parts, n_days)
 
     # ---- change revision / ops -------------------------------------------
 
@@ -353,6 +374,34 @@ class ShardedJobLogStore:
         record id) — the web tier's ETag key and a follow poller's
         tail-cursor bootstrap in one read."""
         return self._fan([lambda s=s: s.revision() for s in self.shards])
+
+    def tail_snapshot(self, limit: int = 0):
+        """Per-shard atomic (revision, tail) snapshots, merged: the
+        vector is each shard's snapshot revision, the tail is the last
+        ``limit`` records under the cursor merge order (raw id, shard)
+        with ENCODED ids.  Each shard's pair is atomic, so a cursor
+        bootstrapped at this vector never skips a record that was
+        visible in (or before) the returned tail."""
+        parts = self._fan([lambda si=si: self.shards[si].tail_snapshot(limit)
+                           for si in range(self.nshards)])
+        vec = [rev for rev, _recs in parts]
+        merged = [(r.id, si, r) for si, (_rev, recs) in enumerate(parts)
+                  for r in recs]
+        merged.sort(key=lambda t: (t[0], t[1]))
+        out = []
+        for raw, si, r in merged[-limit:] if limit else []:
+            r.id = encode_log_id(raw, si, self.nshards)
+            out.append(r)
+        return vec, out
+
+    def age_out(self, now=None) -> int:
+        """Run a cold-aging pass on every shard; returns total aged."""
+        return sum(self._fan([lambda s=s: s.age_out(now)
+                              for s in self.shards]))
+
+    def tier_info(self) -> List[dict]:
+        """Per-shard tiering snapshots, shard order."""
+        return self._fan([lambda s=s: s.tier_info() for s in self.shards])
 
     def op_stats(self) -> dict:
         """Per-op stats MERGED across shards (counts/total summed,
@@ -415,6 +464,147 @@ class ShardedJobLogStore:
                 pass
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+
+
+def reshard_sinks(src: Sequence, dst: Sequence, batch: int = 500,
+                  on_log=None) -> dict:
+    """Online-resharding escape hatch: dump every record from the
+    ``src`` shard set, rehash by job token under the ``dst`` layout,
+    and load — closing the "record ids encode the shard count" trap
+    (ids are re-encoded ``raw' * N' + shard'`` as the destination
+    assigns them; the destination ``logmap`` is re-pinned to N').
+
+    The dump rides per-shard cursors (``after_id`` from 0 — the tiered
+    backends merge their COLD segments below the watermark, so aged
+    history migrates too) and merges by (raw id, shard), the sharded
+    cursor order; the load preserves that order, so each destination
+    shard's per-job id order matches the source's and the rebuilt
+    latest/stat tables land identical (stats for records the source
+    had already retention-evicted cannot migrate — reported loudly in
+    the summary as ``stat_shortfall``).
+
+    ``src``/``dst`` are lists of sink clients (RemoteJobLogStore in
+    production; in-process JobLogStore in tests).  Destination shards
+    must be EMPTY (revision 0) and unpinned — refusing a half-full
+    target beats interleaving two id spaces."""
+    log_ = on_log or (lambda *a: None)
+    if not src or not dst:
+        raise ValueError("reshard needs at least one source and one "
+                         "destination shard")
+    sgot = src[0].logmap()
+    if sgot is not None and sgot.get("n") != len(src):
+        raise RuntimeError(
+            f"source logmap {sgot!r} does not match the provided "
+            f"{len(src)} source addresses — a partial source set would "
+            "silently drop the missing shards' history")
+    for i, s in enumerate(dst):
+        rev = s.revision()
+        if rev != 0:
+            raise RuntimeError(
+                f"destination shard {i} is not empty (revision {rev}) — "
+                "reshard loads into a fresh shard set")
+    got = dst[0].logmap()
+    if got is not None and got.get("n") != len(dst):
+        raise RuntimeError(
+            f"destination logmap {got!r} does not match the "
+            f"{len(dst)}-shard layout")
+    out_sink = ShardedJobLogStore(dst) if len(dst) > 1 else dst[0]
+
+    # dump: per-source-shard cursors, merged by (raw id, shard) — the
+    # sharded cursor order — loaded in that order per batch
+    cursors = [0] * len(src)
+    done = [False] * len(src)
+    moved = 0
+    while not all(done):
+        rows_batch = []
+        for si, s in enumerate(src):
+            if done[si]:
+                continue
+            rows, _t = s.query_logs(after_id=cursors[si], page=1,
+                                    page_size=batch)
+            if not rows:
+                done[si] = True
+                continue
+            cursors[si] = rows[-1].id
+            rows_batch.extend((r.id, si, r) for r in rows)
+        if not rows_batch:
+            break
+        rows_batch.sort(key=lambda t: (t[0], t[1]))
+        recs = []
+        for _raw, _si, r in rows_batch:
+            r.id = None          # destination assigns its own raw ids
+            recs.append(r)
+        out_sink.create_job_logs(recs)
+        moved += len(recs)
+        log_(f"reshard: moved {moved} records")
+
+    # node mirror + accounts pin to shard 0 on both layouts
+    nodes = 0
+    for d in src[0].get_nodes():
+        doc = dict(d)
+        alived = bool(doc.pop("alived", False))
+        out_sink.upsert_node(doc.get("id", ""), json.dumps(doc), alived)
+        nodes += 1
+    accounts = 0
+    for doc in src[0].list_accounts():
+        email = json.loads(doc).get("email", "")
+        if email:
+            out_sink.upsert_account(email, doc)
+            accounts += 1
+
+    def latest_map(sink_or_shards):
+        out: Dict[tuple, float] = {}
+        clients = sink_or_shards if isinstance(sink_or_shards, list) \
+            else [sink_or_shards]
+        for cl in clients:
+            page = 1
+            while True:
+                rows, _t = cl.query_logs(latest=True, page=page,
+                                         page_size=500)
+                out.update(((r.job_id, r.node), r.begin_ts)
+                           for r in rows)
+                if len(rows) < 500:
+                    break
+                page += 1
+        return out
+
+    src_total = sum(s.stat_overall()["total"] for s in src)
+    dst_total = out_sink.stat_overall()["total"]
+    # the latest view survives retention (it summarizes ALL history),
+    # but the destination rebuilds it purely from migrated records — a
+    # (job, node) whose every record was evicted cannot reappear, and
+    # one whose NEWEST record was evicted rebuilds from an older run.
+    # Both counted and warned, not silently shrunk/regressed.
+    src_latest = latest_map(src)
+    dst_latest = latest_map(out_sink)
+    lost_latest = set(src_latest) - set(dst_latest)
+    stale_latest = {p for p, ts in dst_latest.items()
+                    if p in src_latest and ts < src_latest[p]}
+    summary = {"records": moved, "nodes": nodes, "accounts": accounts,
+               "src_stat_total": src_total, "dst_stat_total": dst_total,
+               "stat_shortfall": src_total - dst_total,
+               "latest_shortfall": len(lost_latest),
+               "latest_stale": len(stale_latest)}
+    if summary["stat_shortfall"]:
+        log_(f"reshard: WARNING — {summary['stat_shortfall']} executions "
+             "counted in the source stats have no surviving record "
+             "(retention-evicted before the reshard); the destination "
+             "counters reflect migrated records only")
+
+    def name_pairs(pairs):
+        return (", ".join(f"{j}@{n}" for j, n in sorted(pairs)[:5])
+                + ("…" if len(pairs) > 5 else ""))
+    if lost_latest:
+        log_(f"reshard: WARNING — {len(lost_latest)} (job, node) latest-"
+             "status rows had no surviving record to rebuild from "
+             "(fully retention-evicted jobs); they are absent from the "
+             "destination's latest view: " + name_pairs(lost_latest))
+    if stale_latest:
+        log_(f"reshard: WARNING — {len(stale_latest)} (job, node) "
+             "latest-status rows rebuilt from an OLDER surviving run "
+             "(the newest record was retention-evicted): "
+             + name_pairs(stale_latest))
+    return summary
 
 
 def verify_single_sink(sink):

@@ -66,7 +66,8 @@ class ApiServer:
     def __init__(self, store: MemStore, sink: JobLogStore,
                  ks: Optional[Keyspace] = None, security=None, alarm=None,
                  auth_enabled: bool = True,
-                 host: str = "127.0.0.1", port: int = 7079):
+                 host: str = "127.0.0.1", port: int = 7079,
+                 cache_enabled: Optional[bool] = None):
         # auth_enabled=False replicates the reference's Web.Auth.Enabled
         # switch (web/base.go:98: every request passes as an implicit
         # admin; the UI skips login).  Unlike the reference — whose Go
@@ -83,6 +84,12 @@ class ApiServer:
         self.sessions = SessionStore(store, self.ks)
         self.host, self.port = host, port
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # revision-vector response cache (web/cache.py): None = off —
+        # today's recompute-per-poll behavior, exactly
+        from .cache import ResponseCache, cache_default
+        if cache_enabled is None:
+            cache_enabled = cache_default()
+        self.cache = ResponseCache() if cache_enabled else None
         self._bootstrap_admin()
         self.routes = self._build_routes()
 
@@ -405,24 +412,124 @@ class ApiServer:
             raise NotModified(etag)
         ctx.out_headers["ETag"] = etag
 
+    def _sink_shards(self) -> list:
+        """The sink as a shard list — the real shard clients when
+        sharded, [sink] otherwise, so the cached scatter path has ONE
+        shape."""
+        return getattr(self.sink, "shards", None) or [self.sink]
+
+    def _scatter_pool(self):
+        """Lazy fan-out pool for cached-scatter recomputes (sharded
+        sinks only reach it with > 1 changed shard)."""
+        pool = getattr(self, "_scatter_pool_obj", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=8,
+                                      thread_name_prefix="web-scatter")
+            self._scatter_pool_obj = pool
+        return pool
+
+    def _cached_scatter(self, ctx, key, extra: str, per_shard, merge,
+                        direct):
+        """Serve a read endpoint through the revision-vector response
+        cache: 304 on a matching If-None-Match (today's ETag contract,
+        byte-identical tags), the cached body when the vector is
+        unchanged, and on a CHANGED vector recompute ONLY the shards
+        whose entry moved — unchanged shards' cached partials feed
+        ``merge`` unchanged.  ``per_shard(client, i)`` must return a
+        merge-stable partial; ``merge(parts)`` the response body.
+
+        With the cache off (or a sink without revision support) this
+        degrades to the plain guard + ``direct()`` — the sink's OWN
+        merged read (the sharded client fans concurrently on its
+        pool), exactly today's bytes AND today's latency."""
+        rev = self._sink_revision()
+        if rev is None or self.cache is None:
+            self._etag_guard(ctx, extra)
+            return direct()
+        etag = f'W/"{extra}{self._rev_str(rev)}"'
+        if ctx.header("If-None-Match") == etag:
+            self.cache.bump("etag_304_total")
+            raise NotModified(etag)
+        ctx.out_headers["ETag"] = etag
+        revs = list(rev) if isinstance(rev, (list, tuple)) else [rev]
+        ent = self.cache.lookup(key)
+        if ent is not None and ent["revs"] == revs:
+            self.cache.bump("body_hits_total")
+            return ent["body"]
+        shards = self._sink_shards()
+        same_shape = (ent is not None and len(ent["revs"]) == len(revs)
+                      == len(shards))
+        parts: list = [None] * len(shards)
+        recompute = []
+        reused = 0
+        for i, s in enumerate(shards):
+            if same_shape and ent["revs"][i] == revs[i]:
+                # reuse is sound: equal revision means no write landed
+                # on this shard since its partial was computed, so the
+                # partial is exactly what a fresh scatter would return
+                parts[i] = ent["parts"][i]
+                reused += 1
+            else:
+                recompute.append((i, s))
+        if len(recompute) > 1:
+            # recompute CONCURRENTLY — the uncached path fanned shard
+            # RPCs through the sharded client's pool, and a serial loop
+            # here would turn the poll into the SUM of shard latencies
+            futs = [(i, self._scatter_pool().submit(per_shard, s, i))
+                    for i, s in recompute]
+            first_err = None
+            for i, f in futs:
+                try:
+                    parts[i] = f.result()
+                except BaseException as e:  # noqa: BLE001 — collected
+                    if first_err is None:
+                        first_err = e
+            if first_err is not None:
+                raise first_err
+        elif recompute:
+            i, s = recompute[0]
+            parts[i] = per_shard(s, i)
+        body = merge(parts)
+        self.cache.store(key, revs, parts, body)
+        if ent is None:
+            self.cache.bump("misses_total")
+        self.cache.bump("shard_reused_total", reused)
+        self.cache.bump("shard_recomputed_total", len(shards) - reused)
+        return body
+
     def log_list(self, ctx):
         latest = ctx.q("latest") in ("true", "1")
         if latest:
             # the latest view is THE dashboard poll: revision-keyed 304
-            # makes an idle dashboard O(1) per poll
-            self._etag_guard(ctx, "logs:")
+            # (and the response cache's partial reuse) makes an idle
+            # dashboard O(1) per poll and a busy one O(changed shards)
+            return self._log_latest(ctx)
         nshards = getattr(self.sink, "nshards", 1)
         after_raw = ctx.q("afterId")
         after_id = None
-        if after_raw and not latest:
+        if after_raw:
             if after_raw == "tail":
-                # cursor bootstrap: the revision IS the tail cursor
-                # (max assigned id, per shard when sharded) — a follow
-                # poller starts here instead of draining history
-                rev = self._sink_revision()
+                # cursor bootstrap: revision AND the current tail from
+                # ONE sink-side snapshot.  Reading them in two steps
+                # (the old path: revision only, tail implied) lets a
+                # record land in between — included in the cursor yet
+                # absent from the tail page, so the first follow poll
+                # (id > cursor) skips it forever.
+                tsnap = getattr(self.sink, "tail_snapshot", None)
+                rev = recs = None
+                if tsnap is not None:
+                    try:
+                        rev, recs = tsnap(ctx.q_int("pageSize", 0) or 0)
+                    except Exception:  # noqa: BLE001 — pre-snapshot server
+                        rev = recs = None
+                if rev is None:
+                    rev = self._sink_revision()
+                    recs = []
                 if rev is None:
                     raise HttpError(400, "sink has no revision support")
-                return {"total": -1, "list": [],
+                return {"total": -1,
+                        "list": [self._log_dict(r) for r in recs],
                         "cursor": self._rev_str(rev)}
             try:
                 if "," in after_raw:
@@ -469,6 +576,53 @@ class ApiServer:
                 out["cursor"] = str(nxt)
         return out
 
+    def _log_latest(self, ctx):
+        """The latest view through the response cache: each shard's
+        partial is its filtered top rows (exactly the sharded client's
+        scatter fetch), the merge is the documented (begin_ts DESC,
+        job_id, node) order — byte-identical to the direct
+        ``sink.query_logs(latest=True, ...)`` path, pinned by test."""
+        from ..logsink.sharded import (fetch_top, log_shard_index,
+                                       merge_latest_parts)
+        page = max(1, min(ctx.q_int("page", 1), 1 << 40))
+        page_size = max(1, min(ctx.q_int("pageSize", 50), 500))
+        job_ids = ctx.q("ids").split(",") if ctx.q("ids") else None
+        kw = dict(node=ctx.q("node") or None,
+                  job_ids=job_ids,
+                  name_like=ctx.q("names") or None,
+                  begin=ctx.q_float("begin"),
+                  end=ctx.q_float("end"),
+                  failed_only=ctx.q("failedOnly") in ("true", "1"),
+                  latest=True)
+        need = page * page_size
+        key = ("latest", ctx.q("node"), ctx.q("ids"), ctx.q("names"),
+               ctx.q("begin"), ctx.q("end"), ctx.q("failedOnly"),
+               page, page_size)
+        # a job-filtered poll touches only the filter's shards — the
+        # sharded client's routing win, kept through the cache: pruned
+        # shards contribute a constant empty partial without an RPC
+        nshards = getattr(self.sink, "nshards", 1)
+        sids = ({log_shard_index(j, nshards) for j in job_ids}
+                if job_ids and nshards > 1 else None)
+
+        def per_shard(s, i):
+            if sids is not None and i not in sids:
+                return [], 0
+            return fetch_top(s, kw, need)
+
+        def merge(parts):
+            rows, total = merge_latest_parts(parts, page, page_size)
+            return {"total": total,
+                    "list": [self._log_dict(r) for r in rows]}
+
+        def direct():
+            rows, total = self.sink.query_logs(page=page,
+                                               page_size=page_size, **kw)
+            return {"total": total,
+                    "list": [self._log_dict(r) for r in rows]}
+        return self._cached_scatter(ctx, key, "logs:", per_shard, merge,
+                                    direct)
+
     @staticmethod
     def _log_dict(r) -> dict:
         return {"id": r.id, "jobId": r.job_id, "jobGroup": r.job_group,
@@ -486,13 +640,22 @@ class ApiServer:
     # ---- handlers: stats (revision-keyed, 304 on unchanged) -------------
 
     def stat_overall(self, ctx):
-        self._etag_guard(ctx, "so:")
-        return self.sink.stat_overall()
+        from ..logsink.sharded import ShardedJobLogStore
+        return self._cached_scatter(
+            ctx, ("stat_overall",), "so:",
+            lambda s, _i: s.stat_overall(),
+            ShardedJobLogStore._sum_stats,
+            self.sink.stat_overall)
 
     def stat_days(self, ctx):
+        from ..logsink.sharded import merge_stat_days
         n = ctx.q_int("days", 7)
-        self._etag_guard(ctx, f"sd{n}:")
-        return self.sink.stat_days(max(0, min(n or 0, 3660)))
+        days = max(0, min(n or 0, 3660))
+        return self._cached_scatter(
+            ctx, ("stat_days", days), f"sd{n}:",
+            lambda s, _i: s.stat_days(days),
+            lambda parts: merge_stat_days(parts, days),
+            lambda: self.sink.stat_days(days))
 
     # ---- handlers: nodes + groups ---------------------------------------
 
@@ -615,6 +778,14 @@ class ApiServer:
         lines = ["# HELP cronsun_web_up this web server is serving",
                  "# TYPE cronsun_web_up gauge",
                  "cronsun_web_up 1"]
+        if self.cache is not None:
+            # response-cache effectiveness (this web server's own):
+            # 304s, whole-body hits, and the per-shard partial
+            # reuse/recompute split behind CHANGED polls
+            for field, val in sorted(self.cache.snapshot().items()):
+                name = f"cronsun_web_cache_{field}"
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {val}")
         seen_types: set = set()
         for kv in self.store.get_prefix(self.ks.metrics):
             rest = kv.key[len(self.ks.metrics):].split("/", 1)
@@ -797,6 +968,10 @@ class ApiServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        pool = getattr(self, "_scatter_pool_obj", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+            self._scatter_pool_obj = None
 
 
 class _Ctx:

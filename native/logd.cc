@@ -20,11 +20,14 @@
 // Build: make -C native   (g++ -O2 -std=c++17 -pthread)
 
 #include <arpa/inet.h>
+#include <dirent.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
 #include <sys/prctl.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -110,6 +113,24 @@ static std::string day_of(double ts) {
   snprintf(buf, sizeof buf, "%04d-%02d-%02d", g.tm_year + 1900, g.tm_mon + 1,
            g.tm_mday);
   return buf;
+}
+
+// epoch seconds of a "YYYY-MM-DD" day's 00:00 UTC (-1 on parse failure)
+static double day_start(const std::string& day) {
+  struct tm g {};
+  if (sscanf(day.c_str(), "%d-%d-%d", &g.tm_year, &g.tm_mon, &g.tm_mday) != 3)
+    return -1;
+  g.tm_year -= 1900;
+  g.tm_mon -= 1;
+  return (double)timegm(&g);
+}
+
+// start of the hot window: records with begin_ts below this are eligible
+// to age cold.  hot_days counts whole UTC days including today —
+// hot_days=1 keeps only today hot (logsink/tiering.py pins the same).
+static double hot_cutoff_ts(double now, size_t hot_days) {
+  double today = day_start(day_of(now));
+  return today - 86400.0 * (double)((hot_days ? hot_days : 1) - 1);
 }
 
 // ASCII case-insensitive substring — the semantics of SQLite's
@@ -244,9 +265,19 @@ struct Stat {
   long long total = 0, ok = 0, fail = 0;
 };
 
+// cold-tier segment index entry: one immutable per-day file under
+// <wal>.segs/ (format shared byte-compatibly with logsink/tiering.py —
+// a ["d", day, count, min, max] header line then ["L", <rec body>]
+// lines, id ascending)
+struct Seg {
+  std::string day, path;
+  long long min_id = 0, max_id = 0, count = 0;
+};
+
 class LogStore {
  public:
-  explicit LogStore(size_t retain) : retain_(retain) {}
+  explicit LogStore(size_t retain, size_t hot_days = 0)
+      : retain_(retain), hot_days_(hot_days) {}
 
   // -- mutations ---------------------------------------------------------
 
@@ -453,6 +484,19 @@ class LogStore {
         return a->id < b->id;
       });
     };
+    // clamp before multiplying (UB guard — pinned below too) so the
+    // cold keep-bound can't overflow
+    page = std::min(page, (long long)1 << 40);
+    size_t need = (size_t)page * (size_t)page_size;
+    bool no_filter = node.empty() && job_ids.empty() &&
+                     name_like.empty() && !failed_only && !has_begin &&
+                     !has_end;
+    // extra matches the cold tier counted but did not retain (the
+    // keep bound) — added back into the reply total
+    long long cold_extra = 0;
+    // cold_store fully populated BEFORE any pointer into it is taken
+    // (a later push_back would reallocate under the hits vector)
+    std::vector<Rec> cold_store;
     std::vector<const Rec*> hits;
     if (latest) {
       for (const auto& [k, r] : latest_)
@@ -468,8 +512,22 @@ class LogStore {
                            return a->job_id < b->job_id;
                          return a->node < b->node;
                        });
+      op_count("q_latest_hot", 1);
     } else if (after_id >= 0) {
-      // cursor mode: ids are contiguous (retention only pops the
+      // a cursor resuming below the cold watermark merges the cold
+      // tier first: every cold id precedes every hot id, so segment
+      // matches (sorted by id) followed by the deque scan IS the
+      // global id-ascending order
+      bool cold = false;
+      if (!segs_.empty() && after_id < cold_boundary_) {
+        long long ct = 0;
+        cold = cold_collect(match, no_filter, has_begin, begin, has_end,
+                            end, after_id, need, /*hist=*/false,
+                            cold_store, ct) > 0;
+      }
+      op_count(cold ? "q_cursor_cold" : "q_cursor_hot", 1);
+      for (const Rec& r : cold_store) hits.push_back(&r);
+      // hot side: ids are contiguous (retention only pops the
       // front — same invariant get_log exploits), so a poller's
       // id > after_id is an index jump, and deque iteration order IS
       // id ASC — a follow poll costs O(new records), not O(store)
@@ -480,19 +538,30 @@ class LogStore {
       for (size_t i = start; i < recs_.size(); i++)
         if (match(recs_[i])) hits.push_back(&recs_[i]);
     } else {
+      // history: merge hot + cold under the documented
+      // (begin_ts DESC, id ASC) order — byte-identical to an untiered
+      // store fed the same stream (total counts both tiers)
+      if (!segs_.empty()) {
+        long long cold_total = 0;
+        if (cold_collect(match, no_filter, has_begin, begin, has_end,
+                         end, 0, need, /*hist=*/true, cold_store,
+                         cold_total) > 0)
+          op_count("q_history_cold", 1);
+        cold_extra = cold_total - (long long)cold_store.size();
+      }
+      for (const Rec& r : cold_store) hits.push_back(&r);
       for (const Rec& r : recs_)
         if (match(r)) hits.push_back(&r);
       sort_begin_desc(hits);
     }
-    // clamp before multiplying: a huge client-supplied page must not
-    // overflow signed arithmetic (UB), just return an empty page
-    page = std::min(page, (long long)1 << 40);
     size_t off = (size_t)((page - 1) * page_size);
     res += "{\"total\":";
     // cursor mode pins total == -1 (the SQLite backend's contract: a
     // follow poller never reads it, and there it cost a full filtered
-    // COUNT(*) scan per poll)
-    jint(res, after_id >= 0 ? -1LL : (long long)hits.size());
+    // COUNT(*) scan per poll); history totals add back the cold
+    // matches the keep bound counted but did not retain
+    jint(res, after_id >= 0 ? -1LL
+                            : (long long)hits.size() + cold_extra);
     res += ",\"list\":[";
     for (size_t i = off; i < hits.size() && i < off + (size_t)page_size; i++) {
       if (i != off) res += ',';
@@ -503,11 +572,86 @@ class LogStore {
 
   bool get_log(long long id, std::string& res) {
     std::lock_guard<std::mutex> g(mu);
-    if (recs_.empty() || id < recs_.front().id || id > recs_.back().id)
-      return false;
-    const Rec& r = recs_[(size_t)(id - recs_.front().id)];
-    rec_wire(res, r, true);
-    return true;
+    if (!recs_.empty() && id >= recs_.front().id && id <= recs_.back().id) {
+      const Rec& r = recs_[(size_t)(id - recs_.front().id)];
+      rec_wire(res, r, true);
+      op_count("q_get_hot", 1);
+      return true;
+    }
+    // cold lookup: only at or below the durable watermark (rows above
+    // it are authoritatively hot even if a pre-crash segment holds a
+    // copy) and above the retention floor (the untiered store would
+    // have evicted those rows — same visible set)
+    if (id > 0 && id <= cold_boundary_ && !segs_.empty()) {
+      long long floor_id = retain_ ? next_id_ - 1 - (long long)retain_ : 0;
+      if (id <= floor_id) return false;
+      for (const Seg& s : segs_) {
+        if (id < s.min_id || id > s.max_id) continue;
+        std::vector<Rec> rows;
+        read_segment(s.path, rows);
+        for (const Rec& r : rows)
+          if (r.id == id) {
+            rec_wire(res, r, true);
+            op_count("q_get_cold", 1);
+            return true;
+          }
+      }
+    }
+    return false;
+  }
+
+  // revision AND the last `limit` records from ONE lock hold — the
+  // follow bootstrap needs both atomically (a record landing between
+  // two separate reads would be skipped forever by an id > revision
+  // poll; logsink/joblog.py pins the same contract)
+  void tail_snapshot(long long limit, std::string& res) {
+    if (limit < 0) limit = 0;
+    if (limit > 500) limit = 500;
+    std::lock_guard<std::mutex> g(mu);
+    res += "{\"revision\":";
+    jint(res, next_id_ - 1);
+    res += ",\"list\":[";
+    size_t start = recs_.size() > (size_t)limit
+                       ? recs_.size() - (size_t)limit : 0;
+    for (size_t i = start; i < recs_.size(); i++) {
+      if (i != start) res += ',';
+      rec_wire(res, recs_[i], true);
+    }
+    res += "]}";
+  }
+
+  // observability: watermark, hot sizes, segment inventory (same shape
+  // as JobLogStore.tier_info)
+  void tier_info(std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    // native's in-memory tables ARE the hot mirrors; "tiering" here
+    // reports whether day AGING is active (the part the rollback
+    // switch controls) so the runbook's rollback check tells the truth
+    res += hot_days_ > 0 ? "{\"tiering\":true,\"hot_days\":"
+                         : "{\"tiering\":false,\"hot_days\":";
+    jint(res, (long long)hot_days_);
+    res += ",\"cold_boundary\":";
+    jint(res, cold_boundary_);
+    res += ",\"hot_records\":";
+    jint(res, (long long)recs_.size());
+    res += ",\"revision\":";
+    jint(res, next_id_ - 1);
+    res += ",\"segments\":[";
+    bool first = true;
+    for (const Seg& s : segs_) {
+      if (!first) res += ',';
+      first = false;
+      res += "{\"day\":";
+      jesc(res, s.day);
+      res += ",\"min\":";
+      jint(res, s.min_id);
+      res += ",\"max\":";
+      jint(res, s.max_id);
+      res += ",\"count\":";
+      jint(res, s.count);
+      res += '}';
+    }
+    res += "]}";
   }
 
   // monotone change token for the read plane: the max record id ever
@@ -609,6 +753,7 @@ class LogStore {
   bool open_wal(const std::string& path, std::string& err,
                 bool sync_per_commit) {
     std::lock_guard<std::mutex> g(mu);
+    seg_dir_ = path + ".segs";
     FILE* f = fopen(path.c_str(), "r");
     if (f) {
       char* lineptr = nullptr;
@@ -697,6 +842,15 @@ class LogStore {
       line += ']';
       emit();
     }
+    if (cold_boundary_ > 0) {
+      // the compacted snapshot re-emits only HOT records below — the
+      // cold watermark line keeps aged ids resolving to their
+      // segments after the rewrite
+      line = "[\"G\",";
+      jint(line, cold_boundary_);
+      line += ']';
+      emit();
+    }
     for (const Rec& r : recs_) {
       wal_create(line, r);
       emit();
@@ -718,6 +872,7 @@ class LogStore {
       wal_ = nullptr;
       return false;
     }
+    scan_segments();
     return true;
   }
 
@@ -725,7 +880,309 @@ class LogStore {
     if (wal_) wal_->sync();
   }
 
+  // Move every record whose UTC day fell out of the hot window into
+  // its day's immutable segment file, then trim the deque and append a
+  // durable ["G", boundary] watermark to the WAL.  Crash-safe by
+  // ordering: segments are written + fsynced FIRST (union by id — a
+  // redo converges on the same bytes), the trim + watermark land
+  // after; a kill -9 in between leaves the rows hot and the watermark
+  // behind, and reads stay exact because the cold tier is only
+  // consulted at or below the watermark.  The aged set is always a
+  // strict id-PREFIX of the deque (stop at the first record still in
+  // the window), preserving the contiguous-id invariant get_log and
+  // cursor mode index by.  Returns records aged.
+  // bounded like joblog.py's AGE_PASS_RECORDS: one monolithic pass on
+  // first enablement (retain_ defaults to 1M) would copy the whole
+  // backlog under mu, stalling every wire op for the duration
+  static constexpr size_t kAgePassRecords = 50000;
+
+  long long age_out(double now) {
+    if (hot_days_ == 0 || seg_dir_.empty() || !wal_) return 0;
+    // one pass at a time: the sweeper thread and the wire op can race,
+    // and two concurrent write_segment() calls truncate each other's
+    // .tmp mid-write — a torn segment published by the slower rename
+    // would read as empty AFTER the trim (the Python _age_mu contract)
+    std::lock_guard<std::mutex> ag(age_mu_);
+    double cutoff = hot_cutoff_ts(now, hot_days_);
+    long long total = 0;
+    while (true) {
+      long long aged = age_pass(cutoff);
+      total += aged;
+      if (aged < (long long)kAgePassRecords) break;
+    }
+    if (total) op_count("aged_records", total);
+    return total;
+  }
+
  private:
+  long long age_pass(double cutoff) {
+    std::vector<Rec> aged;
+    long long nb = 0;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      for (const Rec& r : recs_) {
+        if (r.begin >= cutoff || aged.size() >= kAgePassRecords) break;
+        aged.push_back(r);
+        nb = r.id;
+      }
+    }
+    if (aged.empty()) return 0;
+    long long count = (long long)aged.size();
+    // segment writes OUTSIDE the lock: new creates only get ids > nb,
+    // so the aged set is immutable while the files build; a reader
+    // racing the rename sees the old inode, whose rows are still hot
+    // and filtered out of cold reads by the unadvanced watermark
+    std::map<std::string, std::vector<Rec>> by_day;
+    for (Rec& r : aged) by_day[day_of(r.begin)].push_back(std::move(r));
+    std::vector<Seg> entries;
+    for (auto& [day, rs] : by_day) {
+      Seg e;
+      if (!write_segment(day, rs, e)) {
+        fprintf(stderr, "age_out: segment write failed for %s: %s\n",
+                day.c_str(), strerror(errno));
+        return 0;   // rows stay hot; the next pass retries
+      }
+      entries.push_back(std::move(e));
+    }
+    {
+      std::lock_guard<std::mutex> g(mu);
+      while (!recs_.empty() && recs_.front().id <= nb) recs_.pop_front();
+      if (nb > cold_boundary_) cold_boundary_ = nb;
+      std::string line = "[\"G\",";
+      jint(line, nb);
+      line += ']';
+      wal_->append(line);
+      for (const Seg& e : entries) upsert_seg(e);
+      // drop segments wholly below the retention floor — invisible
+      // either way; bounds disk like the untiered pop bounds memory
+      if (retain_) {
+        long long floor_id = next_id_ - 1 - (long long)retain_;
+        std::vector<Seg> keep;
+        for (Seg& s : segs_) {
+          if (s.max_id <= floor_id) remove(s.path.c_str());
+          else keep.push_back(std::move(s));
+        }
+        segs_.swap(keep);
+      }
+    }
+    return count;
+  }
+
+  // ---- cold-tier segments (format shared with logsink/tiering.py) ------
+
+  static bool read_segment(const std::string& path, std::vector<Rec>& out) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return false;
+    char* lineptr = nullptr;
+    size_t cap = 0;
+    ssize_t n;
+    bool first = true, ok = true;
+    while ((n = getline(&lineptr, &cap, f)) != -1) {
+      std::string line(lineptr, (size_t)n);
+      while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      if (line.empty()) continue;
+      JParser jp(line);
+      JV v;
+      if (!jp.value(v) || v.t != JV::ARR || v.arr.empty() ||
+          v.arr[0].t != JV::STR) {
+        ok = false;
+        break;
+      }
+      if (first) {
+        first = false;
+        if (v.arr[0].s != "d") { ok = false; break; }
+        continue;
+      }
+      Rec r;
+      if (v.arr[0].s != "L" || !parse_rec(v, 1, r)) {
+        ok = false;
+        break;
+      }
+      out.push_back(std::move(r));
+    }
+    free(lineptr);
+    fclose(f);
+    if (!ok) out.clear();   // torn/garbage file: treated as absent —
+                            // cold reads stop at the watermark, and the
+                            // age-out redo rewrites it whole
+    std::sort(out.begin(), out.end(),
+              [](const Rec& a, const Rec& b) { return a.id < b.id; });
+    return ok;
+  }
+
+  bool write_segment(const std::string& day, std::vector<Rec>& recs,
+                     Seg& entry) {
+    // union by id with the existing file — idempotent, so the crash
+    // redo and a late-record pass both converge on the same bytes;
+    // atomic publish via temp + fdatasync + rename
+    mkdir(seg_dir_.c_str(), 0777);
+    std::string path = seg_dir_ + "/" + day + ".seg";
+    std::map<long long, Rec> by_id;
+    {
+      std::vector<Rec> old;
+      read_segment(path, old);
+      for (Rec& r : old) by_id[r.id] = std::move(r);
+    }
+    for (Rec& r : recs) by_id[r.id] = std::move(r);
+    std::string tmp = path + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "w");
+    if (!out) return false;
+    std::string line = "[\"d\",";
+    jesc(line, day);
+    line += ',';
+    jint(line, (long long)by_id.size());
+    line += ',';
+    jint(line, by_id.empty() ? 0 : by_id.begin()->first);
+    line += ',';
+    jint(line, by_id.empty() ? 0 : by_id.rbegin()->first);
+    line += "]\n";
+    bool wok = fwrite(line.data(), 1, line.size(), out) == line.size();
+    for (const auto& [id, r] : by_id) {
+      line.clear();
+      wal_create(line, r);
+      line += '\n';
+      wok = wok && fwrite(line.data(), 1, line.size(), out) == line.size();
+    }
+    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
+    fclose(out);
+    if (!wok || rename(tmp.c_str(), path.c_str()) != 0) {
+      remove(tmp.c_str());
+      return false;
+    }
+    // fsync the DIRECTORY: the caller durably records the watermark
+    // right after, and a power loss must not persist a watermark whose
+    // segment's directory entry never hit disk (logsink/tiering.py
+    // pins the same ordering)
+    int dfd = open(seg_dir_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+      fsync(dfd);
+      close(dfd);
+    }
+    entry.day = day;
+    entry.path = path;
+    entry.min_id = by_id.empty() ? 0 : by_id.begin()->first;
+    entry.max_id = by_id.empty() ? 0 : by_id.rbegin()->first;
+    entry.count = (long long)by_id.size();
+    return true;
+  }
+
+  void scan_segments() {
+    segs_.clear();
+    DIR* d = opendir(seg_dir_.c_str());
+    if (!d) return;
+    while (struct dirent* e = readdir(d)) {
+      std::string name = e->d_name;
+      std::string path = seg_dir_ + "/" + name;
+      if (name.size() > 4 && name.compare(name.size() - 4, 4, ".tmp") == 0) {
+        remove(path.c_str());   // never published (rename is atomic)
+        continue;
+      }
+      if (name.size() <= 4 || name.compare(name.size() - 4, 4, ".seg") != 0)
+        continue;
+      FILE* f = fopen(path.c_str(), "r");
+      if (!f) continue;
+      char* lineptr = nullptr;
+      size_t cap = 0;
+      ssize_t n = getline(&lineptr, &cap, f);
+      fclose(f);
+      std::string line = n > 0 ? std::string(lineptr, (size_t)n) : "";
+      free(lineptr);
+      while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      JParser jp(line);
+      JV v;
+      if (!jp.value(v) || v.t != JV::ARR || v.arr.size() < 5 ||
+          v.arr[0].t != JV::STR || v.arr[0].s != "d")
+        continue;
+      Seg s;
+      s.day = v.arr[1].s;
+      s.path = path;
+      s.count = v.arr[2].as_int();
+      s.min_id = v.arr[3].as_int();
+      s.max_id = v.arr[4].as_int();
+      segs_.push_back(std::move(s));
+    }
+    closedir(d);
+    std::sort(segs_.begin(), segs_.end(),
+              [](const Seg& a, const Seg& b) { return a.day < b.day; });
+  }
+
+  void upsert_seg(const Seg& e) {
+    for (Seg& s : segs_)
+      if (s.day == e.day) {
+        s = e;
+        return;
+      }
+    segs_.push_back(e);
+    std::sort(segs_.begin(), segs_.end(),
+              [](const Seg& a, const Seg& b) { return a.day < b.day; });
+  }
+
+  // collect cold records passing `match` with ids in (min_id,
+  // cold_boundary_] and above the retention floor, day-pruned by the
+  // [begin, end) begin_ts filter — caller holds mu.  `keep` bounds the
+  // rows RETAINED (top `keep` under the caller's merge order: id ASC,
+  // or (begin DESC, id) with `hist`) while `total` stays exact, and an
+  // unfiltered (`no_filter`) wholly-visible segment whose every record
+  // must sort after the kept set contributes its header count without
+  // being parsed — a 90-day cold tier doesn't materialize per poll
+  // (logsink/tiering.py cold_query pins the same).  Returns segments
+  // actually read.
+  template <typename F>
+  int cold_collect(const F& match, bool no_filter, bool has_begin,
+                   double begin, bool has_end, double end,
+                   long long min_id, size_t keep, bool hist,
+                   std::vector<Rec>& out, long long& total) {
+    long long floor_id = retain_ ? next_id_ - 1 - (long long)retain_ : 0;
+    if (floor_id > min_id) min_id = floor_id;
+    auto order = [hist](const Rec& a, const Rec& b) {
+      if (hist) {
+        if (a.begin != b.begin) return a.begin > b.begin;
+        return a.id < b.id;
+      }
+      return a.id < b.id;
+    };
+    std::vector<Seg> segs = segs_;
+    std::sort(segs.begin(), segs.end(), [hist](const Seg& a, const Seg& b) {
+      return hist ? a.day > b.day : a.min_id < b.min_id;
+    });
+    int touched = 0;
+    for (const Seg& s : segs) {
+      if (s.min_id > cold_boundary_ || s.max_id <= min_id) continue;
+      double d0 = day_start(s.day);
+      if (d0 >= 0) {
+        if (has_begin && d0 + 86400.0 <= begin) continue;
+        if (has_end && d0 >= end) continue;
+      }
+      bool whole = no_filter && min_id < s.min_id &&
+                   s.max_id <= cold_boundary_ &&
+                   (!has_begin || (d0 >= 0 && begin <= d0)) &&
+                   (!has_end || (d0 >= 0 && end >= d0 + 86400.0));
+      if (whole && out.size() >= keep && !out.empty()) {
+        // out is kept sorted below; the worst kept row decides
+        if (hist ? (d0 >= 0 && out.back().begin >= d0 + 86400.0)
+                 : s.min_id > out.back().id) {
+          total += s.count;
+          continue;
+        }
+      }
+      touched++;
+      std::vector<Rec> rows;
+      read_segment(s.path, rows);
+      for (Rec& r : rows) {
+        if (r.id <= min_id || r.id > cold_boundary_) continue;
+        if (match(r)) {
+          total++;
+          out.push_back(std::move(r));
+        }
+      }
+      std::sort(out.begin(), out.end(), order);
+      if (out.size() > keep) out.resize(keep);
+    }
+    return touched;
+  }
+
   void apply_create(const Rec& r) {
     // the retained window stays contiguous in id: get_log indexes by
     // id - front.id
@@ -878,6 +1335,15 @@ class LogStore {
     } else if (tag == "M") {
       if (v.arr.size() < 2) return false;
       logmap_ = v.arr[1].s;
+    } else if (tag == "G") {
+      // cold watermark: every record appended before this line with
+      // id <= boundary moved to its day segment — drop it from the
+      // hot deque (stats/latest already account for it; L lines that
+      // FOLLOW a G line are post-trim appends and stay hot)
+      if (v.arr.size() < 2) return false;
+      long long b = v.arr[1].as_int();
+      while (!recs_.empty() && recs_.front().id <= b) recs_.pop_front();
+      if (b > cold_boundary_) cold_boundary_ = b;
     } else if (tag == "D") {
       if (v.arr.size() < 2) return false;
       accounts_.erase(v.arr[1].s);
@@ -888,7 +1354,12 @@ class LogStore {
   }
 
   std::mutex mu;
+  std::mutex age_mu_;           // serializes age-out passes (see age_out)
   size_t retain_;
+  size_t hot_days_ = 0;         // 0 = no day aging (tiering rollback)
+  long long cold_boundary_ = 0; // ids <= this live in segments
+  std::string seg_dir_;         // <wal>.segs (empty = no cold tier)
+  std::vector<Seg> segs_;       // index, day ASC
   long long next_id_ = 1;
   long long snapshot_watermark_ = 0;
   std::deque<Rec> recs_;
@@ -974,6 +1445,14 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
     if (!store.get_log(id, res)) res = "null";
   } else if (op == "revision") {
     jint(res, store.revision());
+  } else if (op == "tail_snapshot") {
+    store.tail_snapshot(args.arr.empty() ? 0 : args.arr[0].as_int(), res);
+  } else if (op == "age_out") {
+    double now = args.arr.empty() ? (double)time(nullptr)
+                                  : args.arr[0].as_dbl();
+    jint(res, store.age_out(now));
+  } else if (op == "tier_info") {
+    store.tier_info(res);
   } else if (op == "logmap") {
     long long n = -1;
     std::string hash;
@@ -1061,6 +1540,7 @@ int main(int argc, char** argv) {
   bool fsync_per_commit = false;
   int port = 7078;
   size_t retain = 1u << 20;
+  size_t hot_days = 0;
   double sweep_s = 0.5;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -1069,6 +1549,7 @@ int main(int argc, char** argv) {
     else if (a == "--port") port = atoi(next());
     else if (a == "--db" || a == "--wal") wal_path = next();
     else if (a == "--retain") retain = (size_t)atoll(next());
+    else if (a == "--hot-days") hot_days = (size_t)atoll(next());
     else if (a == "--sweep-interval") sweep_s = atof(next());
     else if (a == "--fsync-per-commit") fsync_per_commit = true;
     else if (a == "--token") g_token = next();
@@ -1092,11 +1573,17 @@ int main(int argc, char** argv) {
     }
     else if (a == "--help") {
       printf("cronsun-logd --host H --port P [--db FILE] [--retain N] "
-             "[--sweep-interval S] [--fsync-per-commit] "
+             "[--hot-days D] [--sweep-interval S] [--fsync-per-commit] "
              "[--token T | --token-file F] [--die-with-parent]\n");
       return 0;
     }
   }
+  // the tiering rollback switch (logsink/joblog.py honors the same):
+  // day aging off, everything stays in the retain-bounded deque
+  const char* tier_env = getenv("CRONSUN_TIERING");
+  if (tier_env && (!strcmp(tier_env, "off") || !strcmp(tier_env, "0") ||
+                   !strcmp(tier_env, "false")))
+    hot_days = 0;
   signal(SIGPIPE, SIG_IGN);
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -1117,7 +1604,7 @@ int main(int argc, char** argv) {
     perror("listen");
     return 1;
   }
-  static LogStore store(retain);
+  static LogStore store(retain, hot_days);
   if (!wal_path.empty()) {
     std::string err;
     if (!store.open_wal(wal_path, err, fsync_per_commit)) {
@@ -1133,6 +1620,9 @@ int main(int argc, char** argv) {
     while (true) {
       std::this_thread::sleep_for(std::chrono::duration<double>(sweep_s));
       store.sweep();
+      // day aging rides the sweeper: O(1) when nothing aged (the walk
+      // stops at the first record still inside the hot window)
+      store.age_out((double)time(nullptr));
     }
   }).detach();
 

@@ -81,11 +81,16 @@ class _PyProcServer:
     SQLite lock), so each one must be a separate process, exactly like
     production."""
 
-    def __init__(self, module="cronsun_tpu.bin.store", extra=()):
+    def __init__(self, module="cronsun_tpu.bin.store", extra=(), env=None):
+        child_env = None
+        if env:
+            child_env = dict(os.environ)
+            child_env.update(env)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", module,
              "--host", "127.0.0.1", "--port", "0", *extra],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=child_env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         for _ in range(200):
             line = self.proc.stdout.readline()
@@ -111,9 +116,13 @@ def _PyShardServer():
     return _PyProcServer("cronsun_tpu.bin.store")
 
 
-def _PyLogShardServer():
+def _PyLogShardServer(extra=(), env=None):
     # :memory: — a bench logd must not leave cronsun.db files around
-    return _PyProcServer("cronsun_tpu.bin.logd", ("--db", ":memory:"))
+    # (bench_query overrides with a tempdir DB when it exercises the
+    # cold tier, and with CRONSUN_TIERING=off for the untiered rung)
+    if not any(a == "--db" for a in extra):
+        extra = ("--db", ":memory:", *extra)
+    return _PyProcServer("cronsun_tpu.bin.logd", extra, env=env)
 
 
 def _native_agent_workers(n_agents: int) -> str:

@@ -230,8 +230,10 @@ def test_bench_query_smoke():
     os.environ["BENCH_LOGD"] = "py"
     try:
         import bench_query
+        # >= 3 readers: shapes are reader-dedicated round-robin, so
+        # fewer readers would leave a shape undriven
         res = bench_query.run_query_bench(
-            logd_shards=1, readers=2, seconds=1.5, seed_records=1000,
+            logd_shards=1, readers=3, seconds=1.5, seed_records=1000,
             on_log=lambda *a: print(*a, file=sys.stderr))
     finally:
         os.environ.pop("BENCH_LOGD", None)
