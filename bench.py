@@ -532,6 +532,26 @@ def main():
     except Exception as e:  # noqa: BLE001
         detail["query_plane_error"] = str(e)
 
+    # ---- store snapshot write-stall probe ----------------------------------
+    # the staggered-imaging claim: p99 client-visible put latency DURING
+    # a snapshot, full-lock hold vs per-stripe COW imaging, both
+    # backends (snapshot_write_stall_p99_ms_* / snapshot_stall_ratio_*).
+    # Cheap enough for quick runs at a smaller keyspace.
+    log("store: snapshot write-stall probe (full-lock vs staggered)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts",
+                                          "bench_store.py"),
+             "--stall-probe",
+             "--stall-keys", "50000" if quick else "200000"],
+            capture_output=True, text=True, timeout=600, cwd=here)
+        if proc.returncode == 0:
+            detail.update(json.loads(proc.stdout))
+        else:
+            detail["snapshot_stall_probe_error"] = proc.stderr[-500:]
+    except Exception as e:  # noqa: BLE001
+        detail["snapshot_stall_probe_error"] = str(e)
+
     # ---- multichip mesh ladder ---------------------------------------------
     # tick+assign across device counts on the 1-D and 2-D meshes,
     # replicated-waterfill vs bucket-sharded bidding, with per-phase

@@ -193,7 +193,10 @@ def main(argv=None) -> int:
         dispatch_ttl=cfg.lock_ttl, tz=tz, planner=planner,
         pipelined=None if cfg.pipelined_step else False,
         checkpoint_dir=ckpt_dir,
-        checkpoint_interval_s=float(cfg.checkpoint_interval))
+        checkpoint_interval_s=float(cfg.checkpoint_interval),
+        checkpoint_delta=cfg.checkpoint_delta,
+        delta_max_chain=cfg.checkpoint_rebase_chain,
+        delta_max_bytes=cfg.checkpoint_rebase_bytes)
     sched.start()
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)

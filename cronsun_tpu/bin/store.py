@@ -41,6 +41,13 @@ def main(argv=None) -> int:
                     help="keyspace lock stripes (0 = backend default, "
                          "16); more stripes = more concurrent writers "
                          "before lock contention")
+    ap.add_argument("--snapshot-staggered", choices=("on", "off"),
+                    default="on",
+                    help="snapshot imaging: 'on' (default) images "
+                         "stripes one at a time under their own locks "
+                         "against a pinned revision (copy-on-write side "
+                         "buffers; writers stall at most one stripe's "
+                         "copy); 'off' = the full-lock hold (rollback)")
     ap.add_argument("--shards", type=int, default=1, metavar="N",
                     help="serve a SHARD SET: N store servers on ports "
                          "port..port+N-1, each with its own WAL "
@@ -94,15 +101,18 @@ def _serve_shard_set(args, token, sslctx, watcher) -> int:
             srv = NativeStoreServer(host=args.host, port=shard_port(i),
                                     wal=shard_wal(i), token=token,
                                     stripes=args.stripes,
-                                    compact_wal_bytes=args.compact_wal_bytes
+                                    compact_wal_bytes=args.compact_wal_bytes,
+                                    snapshot_staggered=(
+                                        args.snapshot_staggered == "on")
                                     ).start()
             srv.monitor(child_died)
             servers.append(srv)
     else:
         from ..store.memstore import MemStore
         for i in range(args.shards):
-            store = MemStore(stripes=args.stripes) if args.stripes > 0 \
-                else MemStore()
+            kw0 = {"snapshot_staggered": args.snapshot_staggered == "on"}
+            store = MemStore(stripes=args.stripes, **kw0) \
+                if args.stripes > 0 else MemStore(**kw0)
             if args.wal:
                 # replay (snapshot + tail) BEFORE serving: no concurrent
                 # clients may observe a half-replayed keyspace

@@ -15,6 +15,30 @@ Compatibility is strict by design: any mismatch — version, planner
 shapes, keyspace prefix — raises :class:`CheckpointError` and the caller
 falls back to a cold load, LOUDLY.  A checkpoint is an optimization,
 never an alternate source of truth.
+
+DELTA CHAIN: a full (base) save is O(state) — ~seconds at 1M jobs —
+which caps how tight the checkpoint cadence can run.  Since the
+scheduler mirrors every mutation from its watch streams, the state
+since the last save is exactly the applied watch events: a DELTA save
+writes only those (plus the leader's own-publish order accounting,
+which never echoes back through the delete-only orders watch) as
+``FILE.d<seq>`` beside the base, each wrapped in a chain header —
+
+    {version, kind: "delta", chain: <base nonce>, seq, prev_rev, rev,
+     events: [(stream, type, key, value), ...]}
+
+Restore = load base, fold each delta's events through the SAME watch
+handlers live application used, then replay the store's watch tail from
+the last element's revision (the existing rev+1 path).  Chain
+validation is strict and runs BEFORE any state mutates: a torn element,
+a sequence gap, a foreign nonce, or a prev_rev/rev mismatch raises
+:class:`CheckpointError` and the caller cold-loads, loudly.  ``rev``
+is a scalar against a single store and a per-shard revision VECTOR
+against a sharded one (the resume shape ``ShardedStore.watch``
+accepts).  Rebase (a fresh full save) unlinks the chain tail in
+DESCENDING seq order before renaming the new base over the old, so
+every crash point leaves either the old chain (a contiguous prefix) or
+the new base — never a gap.
 """
 
 from __future__ import annotations
@@ -26,6 +50,9 @@ import pickle
 
 FORMAT_VERSION = 1
 FILE_NAME = "sched.ckpt"
+
+# delta-chain elements live beside the base as FILE.d1, FILE.d2, ...
+DELTA_SUFFIX = ".d"
 
 
 class CheckpointError(RuntimeError):
@@ -121,3 +148,142 @@ def load_checkpoint(path: str) -> dict:
         raise CheckpointError(
             f"checkpoint {path} version {ver} != {FORMAT_VERSION}")
     return state
+
+
+# ---- delta chain -----------------------------------------------------------
+
+def delta_path(base_path: str, seq: int) -> str:
+    return f"{base_path}{DELTA_SUFFIX}{seq}"
+
+
+def list_delta_seqs(base_path: str) -> list:
+    """Ascending seq numbers of every ``FILE.d<seq>`` beside the base
+    (gaps included — the chain validator refuses them)."""
+    d = os.path.dirname(base_path) or "."
+    name = os.path.basename(base_path) + DELTA_SUFFIX
+    seqs = []
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return []
+    for e in entries:
+        if e.startswith(name) and not e.endswith(".tmp"):
+            try:
+                seqs.append(int(e[len(name):]))
+            except ValueError:
+                continue
+    return sorted(seqs)
+
+
+def _valid_events(events) -> bool:
+    """Strict shape check so a validated delta's fold cannot fail on
+    malformed content AFTER base state is installed: every event is
+    (stream:str, type:str, key:str, value) where value is a str for
+    watch-stream events and a (node:str, jobs:list) pair for the
+    synthetic ``ordmirror`` own-publish accounting stream."""
+    if not isinstance(events, list):
+        return False
+    for ev in events:
+        if not (isinstance(ev, (list, tuple)) and len(ev) == 4
+                and isinstance(ev[0], str) and isinstance(ev[1], str)
+                and isinstance(ev[2], str)):
+            return False
+        v = ev[3]
+        if ev[0] == "ordmirror":
+            if not (isinstance(v, (list, tuple)) and len(v) == 2
+                    and isinstance(v[0], str)
+                    and isinstance(v[1], (list, tuple))):
+                return False
+        elif not isinstance(v, str):
+            return False
+    return True
+
+
+def save_delta(base_path: str, chain: str, seq: int, prev_rev, rev,
+               events: list) -> str:
+    """Atomically persist one delta-chain element.  ``prev_rev``/``rev``
+    are scalars (single store) or per-shard revision vectors (sharded);
+    the restore path treats them as opaque equality-checked tokens."""
+    path = delta_path(base_path, seq)
+    rec = dict(version=FORMAT_VERSION, kind="delta", chain=chain,
+               seq=seq, prev_rev=prev_rev, rev=rev, events=events)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f, gc_paused():
+            pickle.dump(rec, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fdatasync(f.fileno())
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+    return path
+
+
+def load_delta_chain(base_path: str, base_state: dict) -> list:
+    """Load and validate the WHOLE delta chain beside ``base_path``
+    against the loaded base: contiguous seqs from 1, matching chain
+    nonce, prev_rev linking element to element, well-formed event
+    tuples.  Any violation — torn pickle, gap, foreign nonce, rev
+    mismatch — raises :class:`CheckpointError` (the caller cold-loads
+    LOUDLY; a delta chain is never an alternate source of truth).
+    Returns the validated delta dicts in fold order ([] when the base
+    stands alone).  Runs before ANY state mutates, so a refused chain
+    leaves a clean slate."""
+    seqs = list_delta_seqs(base_path)
+    if not seqs:
+        return []
+    nonce = base_state.get("chain")
+    if not nonce:
+        raise CheckpointError(
+            f"delta files {seqs} beside a base with no chain nonce "
+            f"(pre-delta or foreign base) at {base_path}")
+    if seqs != list(range(1, len(seqs) + 1)):
+        raise CheckpointError(
+            f"delta chain at {base_path} has gaps: seqs {seqs}")
+    out = []
+    prev_rev = base_state.get("rev")
+    for seq in seqs:
+        p = delta_path(base_path, seq)
+        try:
+            with open(p, "rb") as f, gc_paused():
+                rec = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 — torn/foreign file
+            raise CheckpointError(f"unreadable delta {p}: {e}")
+        if not isinstance(rec, dict) or rec.get("kind") != "delta":
+            raise CheckpointError(f"malformed delta {p}")
+        if rec.get("version") != FORMAT_VERSION:
+            raise CheckpointError(
+                f"delta {p} version {rec.get('version')} != "
+                f"{FORMAT_VERSION}")
+        if rec.get("chain") != nonce:
+            raise CheckpointError(
+                f"delta {p} chain {rec.get('chain')!r} != base nonce "
+                f"{nonce!r}")
+        if rec.get("seq") != seq:
+            raise CheckpointError(
+                f"delta {p} header seq {rec.get('seq')} != file seq "
+                f"{seq}")
+        if rec.get("prev_rev") != prev_rev:
+            raise CheckpointError(
+                f"delta {p} prev_rev {rec.get('prev_rev')} != chain "
+                f"rev {prev_rev}")
+        if not _valid_events(rec.get("events")):
+            raise CheckpointError(f"delta {p} carries malformed events")
+        prev_rev = rec.get("rev")
+        out.append(rec)
+    return out
+
+
+def clear_delta_chain(base_path: str) -> None:
+    """Unlink every chain element, DESCENDING seq order — a crash
+    mid-way leaves a contiguous prefix (a valid, shorter chain), never
+    a gap."""
+    for seq in reversed(list_delta_seqs(base_path)):
+        try:
+            os.remove(delta_path(base_path, seq))
+        except OSError:
+            pass

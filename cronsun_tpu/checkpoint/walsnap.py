@@ -99,11 +99,79 @@ class WalFile:
         self._f.truncate(0)
         self._f.seek(0)
 
+    def rotate(self, dst: str) -> None:
+        """Move every record logged so far to ``dst`` and keep appending
+        to a FRESH file at the original path — the staggered snapshot's
+        pin: records at or before the pin land in ``dst`` (covered by
+        the snapshot being cut), records after it in the fresh file (the
+        replay tail).  Caller holds the locks that order appends.
+
+        If ``dst`` already exists (a previous snapshot attempt crashed
+        or failed between its pin and its rename), the current records
+        are APPENDED to it instead — both files' records predate the new
+        pin, and replacing dst would silently drop the older ones."""
+        self._f.flush()
+        self._f.close()
+        try:
+            if os.path.exists(dst) and os.path.getsize(dst) > 0:
+                # a previous merge that died mid-append can leave a
+                # TORN final line in dst; appending straight after it
+                # would glue records onto the torn line — a malformed
+                # record with valid records after it, which boot reads
+                # as mid-file corruption and refuses.  Trim to the last
+                # complete line first (a torn final record is a legal
+                # crash artifact to drop).
+                _trim_torn_tail(dst)
+                with open(dst, "a", encoding="utf-8") as out, \
+                        open(self.path, "r", encoding="utf-8",
+                             errors="replace") as src:
+                    for line in src:
+                        out.write(line)
+                    out.flush()
+                    os.fdatasync(out.fileno())
+                self._f = open(self.path, "w", encoding="utf-8")
+            else:
+                os.replace(self.path, dst)
+                self._f = open(self.path, "a", encoding="utf-8")
+        except OSError:
+            # never leave the WAL detached: whatever failed, appends
+            # must keep landing (fail-stop handles true write errors)
+            self._f = open(self.path, "a", encoding="utf-8")
+            raise
+
     def close(self) -> None:
         try:
             self._f.close()
         except OSError:
             pass
+
+
+def _trim_torn_tail(path: str) -> None:
+    """Truncate ``path`` to its last newline-terminated record (drop a
+    torn final line — the tolerated crash artifact — so appends never
+    glue onto it)."""
+    with open(path, "rb+") as f:
+        f.seek(0, os.SEEK_END)
+        pos = f.tell()
+        while pos > 0:
+            step = min(1 << 16, pos)
+            f.seek(pos - step)
+            chunk = f.read(step)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                f.truncate(pos - step + nl + 1)
+                return
+            pos -= step
+        f.truncate(0)
+
+
+def rotated_path(wal_path: str) -> str:
+    """Where a staggered snapshot parks the pre-pin WAL records while it
+    images (``FILE.1``): boot replays snapshot, then FILE.1 if present
+    (a snapshot died mid-image), then the live WAL — strictly older to
+    newer, so last-write-wins convergence holds across every crash
+    point."""
+    return wal_path + ".1"
 
 
 def read_records(path: str) -> Iterator[list]:

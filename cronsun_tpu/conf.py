@@ -94,6 +94,23 @@ class Config:
                                 # seconds between periodic scheduler
                                 # checkpoint saves (0 = only on the
                                 # `cronsun-ctl checkpoint` trigger)
+    checkpoint_delta: bool = True
+                                # incremental scheduler checkpoints: a
+                                # periodic full (base) save plus small
+                                # delta records of the applied watch
+                                # events since the last save — save cost
+                                # proportional to CHANGE, not state, so
+                                # the cadence can tighten at 1M jobs.
+                                # False = every save is a full image
+                                # (the rollback switch).
+    checkpoint_rebase_chain: int = 64
+                                # auto-rebase: a full save replaces the
+                                # delta chain once it reaches this many
+                                # elements (restore folds the whole
+                                # chain, so length bounds takeover time)
+    checkpoint_rebase_bytes: int = 64 << 20
+                                # ... or once the chain's on-disk bytes
+                                # cross this bound
     compile_cache: str = "~/.cache/cronsun-tpu/xla"
                                 # persistent XLA compilation cache: a
                                 # restarted scheduler (or a cold failover

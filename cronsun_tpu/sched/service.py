@@ -41,7 +41,8 @@ from ..cron.parser import ParseError, parse
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
 from ..ops.schedule_table import make_row, _INACTIVE_ROW
-from ..store.memstore import CompactedError, DELETE, MemStore, WatchLost
+from ..store.memstore import CompactedError, DELETE, MemStore, PUT, \
+    WatchLost
 
 # ids that serialize into a JSON string verbatim (no escapes needed)
 _WIRE_SAFE = re.compile(r"^[A-Za-z0-9_.:-]*$").match
@@ -120,6 +121,10 @@ class SchedulerService:
                  pipelined: Optional[bool] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_interval_s: float = 0.0,
+                 checkpoint_delta: Optional[bool] = None,
+                 delta_max_chain: int = 64,
+                 delta_max_bytes: int = 64 << 20,
+                 delta_max_events: int = 1_000_000,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -178,9 +183,14 @@ class SchedulerService:
         # first registration and must survive unrelated job rewrites (pause
         # toggles, avg_time updates) — only a changed timer re-anchors.
         self._row_phase: Dict[int, Tuple[str, int]] = {}
-        # bulk-load state (set only inside _load_initial)
+        # bulk-load state (set only inside _load_initial and the
+        # checkpoint-chain fold); _fold_ro marks the fold's READ-ONLY
+        # phase handling — anchors are prefetched current-store values
+        # and never written back or deleted (live application already
+        # settled them before the save's barrier)
         self._phase_prefetch: Optional[Dict[str, str]] = None
         self._phase_puts: Optional[list] = None
+        self._fold_ro = False
         # compiled-spec cache: fleets reuse timer strings heavily; at
         # 1M rows re-parsing "*/5 * * * * *" a thousand times dominates
         # a cold load for nothing
@@ -234,26 +244,49 @@ class SchedulerService:
                           "planners yet; disabling scheduler checkpoints",
                           type(self.planner).__name__)
                 checkpoint_dir = None
-        # sharded stores have PER-SHARD revisions: the scalar-rev watch
-        # barrier that proves a checkpoint's quiescent revision doesn't
-        # exist across shards yet (a per-shard barrier vector is a
-        # ROADMAP follow-on), so the warm path is refused loudly rather
-        # than saved against an unverifiable revision
-        if checkpoint_dir and getattr(store, "nshards", 1) > 1:
-            log.warnf("checkpoint_dir is not supported with a sharded "
-                      "store (%d shards) yet; disabling scheduler "
-                      "checkpoints", store.nshards)
-            checkpoint_dir = None
+        # sharded stores checkpoint too: the quiescent barrier runs the
+        # PR 5 double watch-barrier PER SHARD (one barrier nonce key
+        # mined to route to each shard) and the checkpoint is keyed on
+        # the per-shard revision VECTOR — the same resume shape the
+        # sharded watch/rev-vector machinery already speaks.  A
+        # mismatched vector shape at restore cold-loads loudly.
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_interval_s = checkpoint_interval_s
         self._ckpt_requested = False
-        self._ckpt_barrier_rev = 0   # highest barrier-key mod_rev seen
+        # barrier key -> highest mod_rev seen (one key per shard; the
+        # plain ckpt_barrier key against an unsharded store)
+        self._ckpt_barrier_seen: Dict[str, int] = {}
         self._ckpt_next_at = (clock() + checkpoint_interval_s
                               if checkpoint_dir and checkpoint_interval_s
                               else float("inf"))
         self._ckpt_stats = {"saves_total": 0, "save_errors_total": 0,
                             "last_save_ms": 0.0, "last_rev": 0,
-                            "restored": 0, "restore_ms": 0.0}
+                            "restored": 0, "restore_ms": 0.0,
+                            "delta_saves_total": 0,
+                            "last_delta_events": 0}
+        # delta checkpoints: record the applied watch events (plus the
+        # leader's own-publish order accounting, which the delete-only
+        # orders watch never echoes) into a buffer; a delta save writes
+        # the buffer as one chain element instead of re-serializing the
+        # whole built state.  checkpoint_delta=False (conf) or
+        # CRONSUN_CKPT_DELTA=off is the rollback: every save is full.
+        if checkpoint_delta is None:
+            checkpoint_delta = os.environ.get(
+                "CRONSUN_CKPT_DELTA", "on").lower() not in ("off", "0")
+        self._delta_on = bool(checkpoint_delta)
+        self.delta_max_chain = max(1, int(delta_max_chain))
+        self.delta_max_bytes = max(1, int(delta_max_bytes))
+        self.delta_max_events = max(1, int(delta_max_events))
+        # activated at the END of __init__ (after restore/cold load):
+        # events recorded from then on are exactly the state since the
+        # restored chain tip / the first full save clears them anyway
+        self._delta_buf: Optional[list] = None
+        self._delta_valid = True
+        self._delta_overflowed = False
+        # live chain bookkeeping: {nonce, seq, rev, bytes, path} after a
+        # full save or a chain restore; None = no base this process can
+        # extend (next save is full)
+        self._ckpt_chain: Optional[dict] = None
 
         # async publisher: lanes are extra connections when the store
         # can clone (networked), else the shared store.  The publish
@@ -361,6 +394,12 @@ class SchedulerService:
         if not restored:
             self._open_watches()
             self._load_initial()
+        # start recording the delta stream only once the slate is known
+        # (a restore's chain fold must not re-enter the buffer); the
+        # watch tail replayed after a warm restore drains through
+        # step() and IS recorded — it is part of the next delta
+        if self.checkpoint_dir and self._delta_on:
+            self._delta_buf = []
 
     @property
     def _alone_pfx(self) -> str:
@@ -618,7 +657,13 @@ class SchedulerService:
             self._meta_updates.pop(row, None)
             self._row_phase.pop(row, None)
             self._row_dispatch.pop(row, None)
-            self.store.delete(self.ks.phase_key(group, job_id, rule_id))
+            if not self._fold_ro:
+                # a checkpoint-chain fold must not touch stored phase
+                # anchors: live application already deleted this one —
+                # and possibly re-created it for a later event in the
+                # chain, which this delete would destroy fleet-wide
+                self.store.delete(self.ks.phase_key(group, job_id,
+                                                    rule_id))
 
     def _drop_job(self, group: str, job_id: str):
         for rule_id in self.rows.rules_of(group, job_id):
@@ -677,6 +722,12 @@ class SchedulerService:
                 w.close()
             except Exception:   # noqa: BLE001 — already-dead watchers
                 pass
+        # a lost watch stream dropped events the delta buffer never saw:
+        # the recorded stream is no longer the complete change set since
+        # the last save — the next checkpoint must be a full rebase
+        if self._delta_buf is not None:
+            self._delta_buf.clear()
+            self._delta_valid = False
         self._open_watches()
         # one listing per prefix serves both the liveness diff and the
         # reload (recovery runs when the scheduler is already behind)
@@ -703,61 +754,106 @@ class SchedulerService:
         self._load_initial(groups=group_kvs, nodes=node_kvs, jobs=job_kvs)
 
     def _drain_watches_once(self):
-        for ev in self._w_groups.drain():
-            gid = ev.kv.key[len(self.ks.group):]
-            if ev.type == DELETE:
-                self._drop_group(gid)
-            else:
-                self._apply_group(ev.kv.value)
-        for ev in self._w_nodes.drain():
-            node_id = ev.kv.key[len(self.ks.node):]
-            if ev.type == DELETE:
-                self._node_down(node_id)
-            else:
-                self._node_up(node_id)
-        for ev in self._w_jobs.drain():
-            if ev.type == DELETE:
-                rest = ev.kv.key[len(self.ks.cmd):]
-                if "/" in rest:
-                    group, job_id = rest.split("/", 1)
-                    self._drop_job(group, job_id)
-            else:
-                self._apply_job(ev.kv.key, ev.kv.value)
-        # execution-state mirrors: proc registry (leased keys expire ->
-        # DELETE events age dead executions out), outstanding exclusive
-        # orders (delete-only watch: own puts mirrored at submit), Alone
-        # lifetime locks
-        for ev in self._w_procs.drain():
-            if ev.type == DELETE:
-                self._acct_del(self._procs, ev.kv.key)
-            else:
-                t = self._parse_proc(ev.kv.key)
-                if t:
-                    self._acct_add(self._procs, ev.kv.key, *t)
-        for ev in self._w_orders.drain():
-            if ev.type == DELETE:
-                self._acct_del(self._orders, ev.kv.key)
-            else:       # defensive: the delete-only filter should
-                t = self._parse_order(ev.kv.key)       # suppress these
-                if t:
-                    self._acct_add(self._orders, ev.kv.key, *t)
-        for ev in self._w_alone.drain():
-            jid = ev.kv.key[len(self._alone_pfx):]
-            if ev.type == DELETE:
-                self._alone_live.discard(jid)
-            else:
-                self._alone_live.add(jid)
+        # every stream's events flow through ONE dispatcher (_apply_ev)
+        # shared with the delta-checkpoint fold, and — when a delta
+        # buffer is live — get RECORDED before application, in exactly
+        # the order they were applied (the fold replays the same order)
+        rec = self._delta_buf if self._delta_valid else None
+        for sid, w in (("groups", self._w_groups),
+                       ("nodes", self._w_nodes),
+                       ("jobs", self._w_jobs),
+                       ("procs", self._w_procs),
+                       ("orders", self._w_orders),
+                       ("alone", self._w_alone)):
+            for ev in w.drain():
+                if rec is not None:
+                    rec.append((sid, ev.type, ev.kv.key, ev.kv.value))
+                self._apply_ev(sid, ev.type, ev.kv.key, ev.kv.value)
+        if rec is not None and len(rec) > self.delta_max_events:
+            # a buffer past the bound means the next delta would rival
+            # a full save anyway — drop it and force a rebase
+            rec.clear()
+            self._delta_valid = False
+            if not self._delta_overflowed:
+                self._delta_overflowed = True
+                log.warnf("checkpoint delta buffer exceeded %d events; "
+                          "next save will be a full rebase",
+                          self.delta_max_events)
         # checkpoint-plane control: operator save requests + the save
         # barrier (checkpoint_save proves mirror quiescence by watching
-        # its own nonce come back through this stream)
+        # its own nonce come back through this stream).  NOT recorded
+        # into the delta buffer — barrier nonces and save requests are
+        # transient control flow, and replaying a request on fold would
+        # trigger a spurious save.
         for ev in self._w_ckpt.drain():
             if ev.type == DELETE:
                 continue
             if ev.kv.key == self.ks.ckpt_req:
                 self._ckpt_requested = True
-            elif ev.kv.key == self.ks.ckpt_barrier:
-                if ev.kv.mod_rev > self._ckpt_barrier_rev:
-                    self._ckpt_barrier_rev = ev.kv.mod_rev
+            elif ev.kv.key == self.ks.ckpt_barrier or \
+                    ev.kv.key.startswith(self.ks.ckpt_barrier + "/"):
+                if ev.kv.mod_rev > \
+                        self._ckpt_barrier_seen.get(ev.kv.key, 0):
+                    self._ckpt_barrier_seen[ev.kv.key] = ev.kv.mod_rev
+
+    def _apply_ev(self, sid: str, typ: str, key: str, value: str):
+        """Apply ONE watch event to the host mirrors — the shared body
+        of the live drain and the delta-checkpoint fold (a delta IS the
+        recorded (sid, type, key, value) stream, so both paths must be
+        the same code).  ``ordmirror`` is the synthetic stream for the
+        leader's own-publish order accounting, which never arrives by
+        watch (the orders watch is delete-only)."""
+        if sid == "groups":
+            gid = key[len(self.ks.group):]
+            if typ == DELETE:
+                self._drop_group(gid)
+            else:
+                self._apply_group(value)
+        elif sid == "nodes":
+            node_id = key[len(self.ks.node):]
+            if typ == DELETE:
+                self._node_down(node_id)
+            else:
+                self._node_up(node_id)
+        elif sid == "jobs":
+            if typ == DELETE:
+                rest = key[len(self.ks.cmd):]
+                if "/" in rest:
+                    group, job_id = rest.split("/", 1)
+                    self._drop_job(group, job_id)
+            else:
+                self._apply_job(key, value)
+        # execution-state mirrors: proc registry (leased keys expire ->
+        # DELETE events age dead executions out), outstanding exclusive
+        # orders (delete-only watch: own puts mirrored at submit), Alone
+        # lifetime locks
+        elif sid == "procs":
+            if typ == DELETE:
+                self._acct_del(self._procs, key)
+            else:
+                t = self._parse_proc(key)
+                if t:
+                    self._acct_add(self._procs, key, *t)
+        elif sid == "orders":
+            if typ == DELETE:
+                self._acct_del(self._orders, key)
+            else:       # defensive: the delete-only filter should
+                t = self._parse_order(key)             # suppress these
+                if t:
+                    self._acct_add(self._orders, key, *t)
+        elif sid == "alone":
+            jid = key[len(self._alone_pfx):]
+            if typ == DELETE:
+                self._alone_live.discard(jid)
+            else:
+                self._alone_live.add(jid)
+        elif sid == "ordmirror":
+            try:
+                node, jobs = value
+            except (TypeError, ValueError):
+                return
+            self._acct_add_order(key, node,
+                                 [tuple(j) for j in jobs])
 
     def _parse_proc(self, key: str) -> Optional[Tuple[str, str, str]]:
         rest = key[len(self.ks.proc):].split("/")
@@ -806,6 +902,16 @@ class SchedulerService:
         anti-entropy like every other mirror entry."""
         if key in self._orders:
             return
+        if self._delta_buf is not None and self._delta_valid:
+            # own publishes never echo back through the delete-only
+            # orders watch, so the delta stream records them HERE (a
+            # restored standby's mirrors then match the live leader's
+            # without waiting on the anti-entropy listing).  The value
+            # stays a raw (node, jobs) tuple — this append rides the
+            # step thread's publish accounting, and serialization
+            # belongs to save time, not the hot path.
+            self._delta_buf.append(
+                ("ordmirror", PUT, key, (node_id, list(jobs))))
         cost = 0.0
         for group, job_id in jobs:
             job = self.jobs.get((group, job_id))
@@ -968,44 +1074,89 @@ class SchedulerService:
             raise RuntimeError("no checkpoint_dir configured")
         return os.path.join(self.checkpoint_dir, FILE_NAME)
 
-    def _checkpoint_barrier(self, timeout: float = 30.0) -> int:
+    def _barrier_keys(self) -> List[str]:
+        """One barrier nonce key per shard.  Against a plain store this
+        is the bare ckpt_barrier key (byte-identical to the scalar
+        protocol); against N shards, suffixes are MINED so each key
+        hashes to a distinct shard (suffixed keys route by full-key
+        token, so the mapping is deterministic across processes) — all
+        under the watched ckpt prefix."""
+        n = getattr(self.store, "nshards", 1)
+        base = self.ks.ckpt_barrier
+        if n <= 1:
+            return [base]
+        from ..store.sharded import shard_index
+        prefix = getattr(self.store, "prefix", self.ks.prefix)
+        keys: List[Optional[str]] = [None] * n
+        found = j = 0
+        while found < n:
+            k = f"{base}/{j}"
+            i = shard_index(k, n, prefix)
+            if keys[i] is None:
+                keys[i] = k
+                found += 1
+            j += 1
+        return keys
+
+    def _checkpoint_barrier(self, timeout: float = 30.0):
         """Quiesce point for a checkpoint: returns a store revision R
         such that every watch event with mod_rev <= R has been applied
-        to the host mirrors.
+        to the host mirrors — a scalar against a plain store, a
+        per-shard revision VECTOR against a sharded one (each entry
+        quiescent for ITS shard's stream; there is no global revision
+        to quiesce on).
 
-        Protocol: write a barrier nonce under the watched ckpt prefix
-        and drain watches until its revision comes back, TWICE.  Watch
-        events reach this process through one connection whose server
-        batches frames per watcher, so a frame carrying the first
-        barrier can overtake an older event's frame within the same
-        send batch — but the second barrier is only written after the
-        first was OBSERVED, i.e. after that whole batch was on the
-        wire; seeing barrier #2 therefore proves every event at or
-        before barrier #1's revision is in the client-side queues, and
-        one final drain applies them.  R is barrier #1's revision."""
+        Protocol, per shard: write a barrier nonce under the watched
+        ckpt prefix and drain watches until its revision comes back,
+        TWICE.  Watch events reach this process through one connection
+        per shard whose server batches frames per watcher, so a frame
+        carrying the first barrier can overtake an older event's frame
+        within the same send batch — but the second barrier is only
+        written after the first was OBSERVED, i.e. after that whole
+        batch was on the wire; seeing barrier #2 therefore proves every
+        event at or before barrier #1's revision is in the client-side
+        queues, and one final drain applies them.  R is barrier #1's
+        revision (per shard)."""
+        keys = self._barrier_keys()
         deadline = time.monotonic() + timeout
-        rev = 0
+        revs = [0] * len(keys)
         for i in (1, 2):
-            r = self.store.put(self.ks.ckpt_barrier,
-                               f"{self.node_id}/{i}")
-            if i == 1:
-                rev = r
-            while self._ckpt_barrier_rev < r:
-                if time.monotonic() > deadline:
-                    raise RuntimeError(
-                        f"checkpoint barrier timed out after {timeout}s")
-                self._drain_watches_once()
-                if self._ckpt_barrier_rev >= r:
-                    break
-                time.sleep(0.005)
+            for ki, key in enumerate(keys):
+                r = self.store.put(key, f"{self.node_id}/{i}")
+                if i == 1:
+                    revs[ki] = r
+                while self._ckpt_barrier_seen.get(key, 0) < r:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"checkpoint barrier timed out after "
+                            f"{timeout}s (key {key})")
+                    self._drain_watches_once()
+                    if self._ckpt_barrier_seen.get(key, 0) >= r:
+                        break
+                    time.sleep(0.005)
         self._drain_watches_once()
-        return rev
+        return revs[0] if len(keys) == 1 else revs
 
-    def checkpoint_save(self, path: Optional[str] = None) -> dict:
-        """Serialize the BUILT state — packed schedule-table arrays,
-        eligibility masks, row allocator, job metadata, execution-state
-        mirrors — to a versioned on-disk checkpoint keyed by the store
-        revision it reflects, written atomically (temp file + rename).
+    def _delta_possible(self, path: str) -> bool:
+        """A delta save extends the live chain iff one exists for this
+        path, the event buffer is complete (no watch loss / overflow
+        since the last save), and the auto-rebase knobs aren't hit."""
+        ch = self._ckpt_chain
+        return (self._delta_on and ch is not None
+                and ch.get("path") == path
+                and self._delta_buf is not None and self._delta_valid
+                and ch["seq"] < self.delta_max_chain
+                and ch["bytes"] < self.delta_max_bytes)
+
+    def checkpoint_save(self, path: Optional[str] = None,
+                        kind: str = "auto") -> dict:
+        """Persist a restore point keyed by the store revision (scalar,
+        or the per-shard vector on a sharded store) the barrier proves
+        quiescent.  ``kind``: "auto" writes a small DELTA chain element
+        (the watch events applied since the last save) when a live
+        chain allows it and a full base save otherwise — save cost
+        proportional to CHANGE, not state; "full" forces a rebase;
+        "delta" forces a delta (raises when no chain is extendable).
         STEP-THREAD (or quiesced-service) only: the mirrors have a
         single writer and the barrier drains watches inline.
 
@@ -1014,22 +1165,69 @@ class SchedulerService:
         under-count the leader's own most-recent order reservations —
         the same bounded over-commit a fresh leadership has, healed by
         the anti-entropy listing the restore kicks immediately."""
-        from ..checkpoint import save_checkpoint
+        from ..checkpoint import (clear_delta_chain, save_checkpoint,
+                                  save_delta)
         if path is None:
             path = self._checkpoint_path()
         from ..checkpoint.sched_ckpt import gc_paused
         t0 = time.perf_counter()
         rev = self._checkpoint_barrier()
-        with gc_paused():
-            state = self._checkpoint_state(rev)
-            save_checkpoint(path, state)
+        as_delta = self._delta_possible(path) and kind != "full"
+        if kind == "delta" and not as_delta:
+            raise RuntimeError(
+                "delta checkpoint not possible: no extendable chain "
+                "(no base saved this process, buffer invalidated, or "
+                "rebase knobs hit)")
+        if as_delta:
+            ch = self._ckpt_chain
+            events = list(self._delta_buf)
+            seq = ch["seq"] + 1
+            p = save_delta(path, ch["nonce"], seq, ch["rev"], rev,
+                           events)
+            try:
+                ch["bytes"] += os.path.getsize(p)
+            except OSError:
+                pass
+            ch["seq"] = seq
+            ch["rev"] = rev
+            self._delta_buf.clear()
+            self._ckpt_stats["delta_saves_total"] += 1
+            self._ckpt_stats["last_delta_events"] = len(events)
+            out_kind = "delta"
+        else:
+            # the barrier's drains may have queued table/eligibility
+            # updates not yet scattered to the device: flush BEFORE
+            # capturing, or the saved device arrays lag the saved host
+            # dicts and a restore dispatches stale rows until those
+            # jobs next change (latent in the PR 5 save; the delta
+            # fold's explicit replay made it visible)
+            self._flush_device()
+            with gc_paused():
+                state = self._checkpoint_state(rev)
+                # a fresh base starts a fresh chain: stale elements are
+                # unlinked (descending seq — a crash mid-way leaves a
+                # contiguous, still-valid OLD chain) BEFORE the rename
+                # publishes the new base
+                state["chain"] = nonce = (
+                    f"{self.node_id}-{os.getpid()}-"
+                    f"{int(time.time() * 1e3):x}")
+                clear_delta_chain(path)
+                save_checkpoint(path, state)
+            self._ckpt_chain = {"nonce": nonce, "seq": 0, "rev": rev,
+                                "bytes": 0, "path": path}
+            if self._delta_buf is not None:
+                self._delta_buf.clear()
+            self._delta_valid = True
+            self._delta_overflowed = False
+            out_kind = "full"
         ms = (time.perf_counter() - t0) * 1e3
         self._ckpt_stats["saves_total"] += 1
         self._ckpt_stats["last_save_ms"] = round(ms, 3)
-        self._ckpt_stats["last_rev"] = rev
-        log.infof("scheduler checkpoint saved: rev %d, %.0f ms, %s",
-                  rev, ms, path)
-        return {"rev": rev, "ms": ms, "path": path}
+        self._ckpt_stats["last_rev"] = (max(rev) if isinstance(rev, list)
+                                        else rev)
+        log.infof("scheduler checkpoint saved (%s): rev %s, %.0f ms, %s",
+                  out_kind, rev, ms, path)
+        return {"rev": rev, "ms": ms, "path": path, "kind": out_kind}
 
     def _mesh_topology(self) -> Optional[dict]:
         """Mesh-planner topology tag for checkpoints: a checkpoint of
@@ -1112,10 +1310,15 @@ class SchedulerService:
         from ..checkpoint import CheckpointError, load_checkpoint
         import jax.numpy as jnp
         from ..ops.schedule_table import ScheduleTable
+        from ..checkpoint import load_delta_chain
         path = self._checkpoint_path()
         t0 = time.perf_counter()
         try:
             st = load_checkpoint(path)
+            # the delta chain validates WHOLE before anything mutates:
+            # torn element, seq gap, foreign nonce, rev mismatch all
+            # refuse here (cold load), never a half-folded scheduler
+            deltas = load_delta_chain(path, st)
             # every key the install below dereferences, validated HERE:
             # a version-valid pickle missing a field (hand-edited,
             # foreign build) must cold-load, not crash-loop the
@@ -1157,7 +1360,26 @@ class SchedulerService:
                 raise CheckpointError(
                     f"mesh topology {st.get('mesh')} != this planner's "
                     f"{self._mesh_topology()}")
-            rev = int(st["rev"])
+            # effective revision = the chain TIP's (the last delta's,
+            # or the base's when the base stands alone): a scalar
+            # against a plain store, a per-shard VECTOR against a
+            # sharded one.  Shape must match the store's topology — a
+            # 2-shard checkpoint against a 3-shard (or unsharded) store
+            # is a different deployment, cold load.
+            rev = deltas[-1]["rev"] if deltas else st["rev"]
+            nsh = getattr(self.store, "nshards", 1)
+            if isinstance(rev, (list, tuple)):
+                rev = [int(r) for r in rev]
+                if nsh <= 1 or len(rev) != nsh:
+                    raise CheckpointError(
+                        f"revision vector shape {len(rev)} != store "
+                        f"shard count {nsh}")
+            else:
+                rev = int(rev)
+                if nsh > 1:
+                    raise CheckpointError(
+                        f"scalar checkpoint revision against a "
+                        f"{nsh}-shard store")
             try:
                 table = ScheduleTable(**{k: jnp.asarray(v)
                                          for k, v in st["table"].items()})
@@ -1177,15 +1399,26 @@ class SchedulerService:
                 # the rev op: cannot prove incarnation, cold-load
                 raise CheckpointError(
                     f"store revision unverifiable ({e})")
-            if store_rev < rev:
+            if isinstance(rev, list):
+                if not isinstance(store_rev, (list, tuple)) \
+                        or len(store_rev) != len(rev):
+                    raise CheckpointError(
+                        f"store revision {store_rev!r} is not a "
+                        f"{len(rev)}-entry vector")
+                behind = any(s < r for s, r in zip(store_rev, rev))
+            else:
+                behind = store_rev < rev
+            if behind:
                 raise CheckpointError(
                     f"store revision {store_rev} is BEHIND checkpoint "
                     f"rev {rev} — different store incarnation")
             # the delta since the checkpoint must still be replayable
             # from the store's watch history, or the checkpoint is too
             # stale to be safe — cold load instead
+            resume = ([r + 1 for r in rev] if isinstance(rev, list)
+                      else rev + 1)
             try:
-                self._open_watches(start_rev=rev + 1)
+                self._open_watches(start_rev=resume)
             except (CompactedError, WatchLost) as e:
                 raise CheckpointError(
                     f"rev {rev} fell out of the store's watch history "
@@ -1284,6 +1517,74 @@ class SchedulerService:
                  for n in self.universe.index], np.int64)
             cols, caps = self._pad_pow2(cols, caps)
             self.planner.set_node_capacity(cols, caps)
+        # fold the delta chain through the SAME handlers that applied
+        # the events live (validated upfront: shape-complete tuples,
+        # contiguous seqs, matching nonce) — base + fold reproduces the
+        # saver's exact host state; the device flush pushes the folded
+        # rows so the first window plans against the chain tip, not the
+        # base.  Phase anchors are PREFETCHED in one get_many and the
+        # fold runs read-only against them: the live applier wrote
+        # every anchor synchronously before its save's barrier, so the
+        # store's current values are authoritative — per-rule anchor
+        # RPCs would serialize thousands of round trips into the
+        # takeover (measured: they dominated the 50k warm path), and a
+        # replayed phase delete could destroy an anchor a later chain
+        # event re-created.
+        n_ev = 0
+        if deltas:
+            pf_keys: List[str] = []
+            seen_pk: Set[str] = set()
+            for d in deltas:
+                for sid, typ, key, value in d["events"]:
+                    if sid != "jobs" or typ == DELETE:
+                        continue
+                    rest = key[len(self.ks.cmd):]
+                    if "/" not in rest:
+                        continue
+                    group, job_id = rest.split("/", 1)
+                    try:
+                        doc = json.loads(value)
+                    except ValueError:
+                        continue
+                    for r in (doc.get("rules") or []):
+                        rid = r.get("id", "") if isinstance(r, dict) \
+                            else ""
+                        pk = self.ks.phase_key(group, job_id, rid)
+                        if pk not in seen_pk:
+                            seen_pk.add(pk)
+                            pf_keys.append(pk)
+            prefetch: Dict[str, str] = {}
+            if pf_keys:
+                for pk, kv in zip(pf_keys, self.store.get_many(pf_keys)):
+                    if kv is not None:
+                        prefetch[pk] = kv.value
+            self._phase_prefetch = prefetch
+            self._phase_puts = []
+            self._fold_ro = True
+            try:
+                for d in deltas:
+                    for sid, typ, key, value in d["events"]:
+                        self._apply_ev(sid, typ, key, value)
+                    n_ev += len(d["events"])
+            finally:
+                self._phase_prefetch = None
+                self._phase_puts = None
+                self._fold_ro = False
+            self._flush_device()
+        # a restored chain stays extendable: later delta saves continue
+        # from its tip (events recorded from the replayed watch tail on)
+        if st.get("chain"):
+            from ..checkpoint.sched_ckpt import delta_path
+            nbytes = 0
+            for d in deltas:
+                try:
+                    nbytes += os.path.getsize(
+                        delta_path(path, d["seq"]))
+                except OSError:
+                    pass
+            self._ckpt_chain = {"nonce": st["chain"],
+                                "seq": len(deltas), "rev": rev,
+                                "bytes": nbytes, "path": path}
         # own-publish reservations between the checkpoint's barrier and
         # the previous leader's death aren't in the mirrors (the orders
         # watch is delete-only): kick anti-entropy from post-restore
@@ -1293,10 +1594,12 @@ class SchedulerService:
         ms = (time.perf_counter() - t0) * 1e3
         self._ckpt_stats["restored"] = 1
         self._ckpt_stats["restore_ms"] = round(ms, 3)
-        self._ckpt_stats["last_rev"] = rev
-        log.infof("scheduler checkpoint RESTORED: rev %d, %d jobs, "
-                  "%.0f ms (watch delta replays from rev %d)",
-                  rev, len(self.jobs), ms, rev + 1)
+        self._ckpt_stats["last_rev"] = (max(rev) if isinstance(rev, list)
+                                        else rev)
+        log.infof("scheduler checkpoint RESTORED: rev %s, %d jobs, "
+                  "%d deltas folded (%d events), %.0f ms (watch delta "
+                  "replays from rev+1)",
+                  rev, len(self.jobs), len(deltas), n_ev, ms)
         return True
 
     def _maybe_checkpoint(self):
@@ -2220,6 +2523,14 @@ class SchedulerService:
             "checkpoint_last_rev": self._ckpt_stats["last_rev"],
             "checkpoint_restored": self._ckpt_stats["restored"],
             "checkpoint_restore_ms": self._ckpt_stats["restore_ms"],
+            # delta-chain health: how many saves were small deltas, the
+            # live chain length (restore folds the whole chain — the
+            # rebase knobs bound it), and the last delta's event count
+            "checkpoint_delta_saves_total":
+                self._ckpt_stats["delta_saves_total"],
+            "checkpoint_last_delta_events":
+                self._ckpt_stats["last_delta_events"],
+            "checkpoint_chain_len": (self._ckpt_chain or {}).get("seq", 0),
         }
 
     def _advance_hwm(self, value: int):

@@ -205,18 +205,25 @@ class Watcher(LossyEventStream):
 
 
 class _Stripe:
-    __slots__ = ("lock", "kv")
+    __slots__ = ("lock", "kv", "imaged", "cow")
 
     def __init__(self):
         self.lock = threading.Lock()
         self.kv: Dict[str, KV] = {}
+        # staggered-snapshot state, guarded by this stripe's lock:
+        # imaged=False while a snapshot is active and this stripe's
+        # image hasn't been taken yet; cow holds the PRE-image (KV, or
+        # None for not-present) of every key mutated in that window
+        self.imaged = True
+        self.cow: Dict[str, Optional[KV]] = {}
 
 
 class MemStore:
     STRIPES = 16
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
-                 history: int = 65536, stripes: int = STRIPES):
+                 history: int = 65536, stripes: int = STRIPES,
+                 snapshot_staggered: Optional[bool] = None):
         self._nstripes = max(1, int(stripes))
         self._stripes = [_Stripe() for _ in range(self._nstripes)]
         # event plane: revision counter, history ring, watcher registry +
@@ -249,6 +256,19 @@ class MemStore:
         self._wal = None
         self._replaying = False
         self._wal_compact_bytes = 0
+        # staggered snapshots (default): image stripes one at a time
+        # under their OWN locks against a pinned revision boundary with
+        # per-stripe copy-on-write pre-images, so a multi-GB image never
+        # stalls writers longer than one stripe's copy.  Off = the PR 5
+        # full-lock hold (the rollback switch).
+        if snapshot_staggered is None:
+            import os as _os
+            snapshot_staggered = _os.environ.get(
+                "CRONSUN_SNAPSHOT_STAGGERED", "on").lower() \
+                not in ("off", "0")
+        self._snap_staggered = bool(snapshot_staggered)
+        self._snap_active = False
+        self._snap_mu = threading.Lock()   # one snapshot at a time
 
     # ---- striped locking -------------------------------------------------
 
@@ -341,7 +361,8 @@ class MemStore:
         fresh snapshot and truncate the WAL — boot cost is bounded by
         snapshot cadence, not total history.  Must run before the store
         serves clients (no concurrent mutations during replay)."""
-        from ..checkpoint.walsnap import WalFile, read_records, snap_path
+        from ..checkpoint.walsnap import (WalFile, read_records,
+                                          rotated_path, snap_path)
         if self._wal is not None:
             raise RuntimeError("wal already open")
         self._replaying = True
@@ -351,6 +372,12 @@ class MemStore:
                 self._replay_record(rec)
             self._op_record("snapshot_load", t0)
             t0 = time.perf_counter_ns()
+            # FILE.1 = pre-pin records parked by a staggered snapshot
+            # that died mid-image: strictly older than the live WAL,
+            # replayed between snapshot and tail so last-write-wins
+            # convergence holds
+            for rec in read_records(rotated_path(path)):
+                self._replay_record(rec)
             for rec in read_records(path):
                 self._replay_record(rec)
             self._op_record("wal_replay", t0)
@@ -362,25 +389,109 @@ class MemStore:
         return self
 
     def snapshot(self) -> int:
-        """Write a consistent point-in-time image of the striped
-        keyspace + lease table (tagged with its revision) to the
-        snapshot sidecar — temp file + atomic rename — then truncate
-        the WAL to entries after it (none: the locks order appends).
-        Returns the snapshot's revision.  Mutations stall for the write
-        duration; the operator-facing cost shows as the ``snapshot``
-        op in op_stats."""
+        """Write a point-in-time image of the striped keyspace + lease
+        table (tagged with its revision) to the snapshot sidecar — temp
+        file + atomic rename.  Two paths:
+
+        - STAGGERED (default): a brief all-locks PIN (revision + lease
+          copy + WAL rotation to ``FILE.1`` — O(1), no state copied but
+          the lease table), then stripes image ONE AT A TIME under
+          their own locks with copy-on-write pre-images for writes
+          racing the image — writers never wait longer than one
+          stripe's copy, and the ``.snap`` is consistent at the pinned
+          revision (every post-pin mutation is in the fresh WAL, so
+          boot replay converges regardless).  On success ``FILE.1`` is
+          deleted (its records are covered).
+        - FULL-LOCK (``snapshot_staggered=False`` /
+          CRONSUN_SNAPSHOT_STAGGERED=off): the PR 5 behavior — every
+          lock held for the whole serialization; kept as the rollback
+          and the bench's stall baseline.
+
+        Returns the snapshot's revision.  The per-path cost shows as
+        the ``snapshot`` (and staggered ``snapshot_pin``) op in
+        op_stats."""
         if self._wal is None:
             raise RuntimeError("snapshot: no WAL configured "
                                "(open_wal first)")
-        from ..checkpoint.walsnap import write_snapshot
-        with self._locked(all_stripes=True), self._lease_lock, \
-                self._ev_lock:
+        from ..checkpoint.walsnap import rotated_path, write_snapshot
+        if not self._snap_staggered:
+            with self._locked(all_stripes=True), self._lease_lock, \
+                    self._ev_lock:
+                t0 = time.perf_counter_ns()
+                write_snapshot(self._wal.path, self._snapshot_lines())
+                # any parked FILE.1 goes BEFORE the truncation: a crash
+                # between the two with the order reversed leaves
+                # snapshot + stale FILE.1 + empty WAL, and the next
+                # boot replays the stale records over the snapshot with
+                # no newer tail to converge them
+                self._remove_rotated(rotated_path(self._wal.path))
+                self._wal.truncate()
+                rev = self._rev
+                self._op_record("snapshot", t0)
+            return rev
+        with self._snap_mu:
             t0 = time.perf_counter_ns()
-            write_snapshot(self._wal.path, self._snapshot_lines())
-            self._wal.truncate()
-            rev = self._rev
+            rotated = rotated_path(self._wal.path)
+            # PIN — the brief exclusive window: all locks held only
+            # long enough to fix the revision boundary, copy the (small)
+            # lease table, rotate the WAL, and arm the per-stripe COW
+            with self._locked(all_stripes=True), self._lease_lock, \
+                    self._ev_lock:
+                tp = time.perf_counter_ns()
+                rev = self._rev
+                next_lease = self._next_lease
+                now_c, now_w = self._clock(), time.time()
+                leases = [(l.id, l.ttl, now_w + (l.deadline - now_c))
+                          for l in self._leases.values()]
+                self._wal.rotate(rotated)
+                for s in self._stripes:
+                    s.imaged = False
+                    s.cow = {}
+                self._snap_active = True
+                self._op_record("snapshot_pin", tp)
+            try:
+                def lines():
+                    yield ["v", rev, next_lease]
+                    for lid, ttl, wall in leases:
+                        yield ["g", lid, ttl, wall]
+                    for s in self._stripes:
+                        with s.lock:
+                            img = dict(s.kv)
+                            cow, s.cow = s.cow, {}
+                            s.imaged = True
+                        # pre-images overlay OUTSIDE the lock: a key
+                        # mutated post-pin reverts to its pinned value
+                        # (None = did not exist at the pin)
+                        for k, pre in cow.items():
+                            if pre is None:
+                                img.pop(k, None)
+                            else:
+                                img[k] = pre
+                        for k, kv in img.items():
+                            yield ["s", k, kv.value, kv.create_rev,
+                                   kv.mod_rev, kv.lease]
+                write_snapshot(self._wal.path, lines())
+            finally:
+                self._snap_active = False
+                for s in self._stripes:
+                    with s.lock:
+                        s.imaged = True
+                        s.cow = {}
+            # the rename published an image covering everything in the
+            # rotated pre-pin records — they are dead weight now (left
+            # in place on failure: boot and the next pin both handle a
+            # lingering FILE.1)
+            self._remove_rotated(rotated)
             self._op_record("snapshot", t0)
             return rev
+
+    @staticmethod
+    def _remove_rotated(rotated: str):
+        import os as _os
+        try:
+            _os.remove(rotated)
+        except OSError:
+            pass
 
     def rev(self) -> int:
         """Current store revision — the checkpoint plane tags scheduler
@@ -510,11 +621,26 @@ class MemStore:
             with self._lease_lock:
                 self._check_lease(lease)
 
+    def _cow_save(self, key: str):
+        """Staggered-snapshot copy-on-write: a mutation landing in a
+        stripe the active snapshot has NOT yet imaged first saves the
+        key's PRE-image (first touch only), so the image taken later
+        reads as of the pinned revision.  Caller holds the key's stripe
+        lock — the pin (which arms this under ALL stripe locks) and the
+        imager (which flips ``imaged`` under this stripe's lock) both
+        serialize against it, so the flag reads are race-free."""
+        if not self._snap_active:
+            return
+        s = self._stripes[self._sidx(key)]
+        if not s.imaged and key not in s.cow:
+            s.cow[key] = s.kv.get(key)
+
     def _put_locked(self, key: str, value: str, lease: int) -> int:
         """Caller holds the key's stripe lock and has VALIDATED the
         lease (existence + deadline) at the op's entry; the existence
         re-check here only guards the mid-batch pop race, where failing
         is correct (the applied prefix dies with the lease anyway)."""
+        self._cow_save(key)
         kvmap = self._stripes[self._sidx(key)].kv
         prev = kvmap.get(key)
         if lease or (prev and prev.lease):
@@ -602,6 +728,7 @@ class MemStore:
 
     def _delete_locked(self, key: str) -> bool:
         """Caller holds the key's stripe lock."""
+        self._cow_save(key)
         kvmap = self._stripes[self._sidx(key)].kv
         prev = kvmap.pop(key, None)
         if prev is None:

@@ -28,6 +28,7 @@ class NativeStoreServer(NativeProcess):
                  binary: Optional[str] = None, history: int = 65536,
                  wal: Optional[str] = None, token: str = "",
                  stripes: int = 0, compact_wal_bytes: int = -1,
+                 snapshot_staggered: bool = True,
                  extra_args: Optional[List[str]] = None,
                  ready_timeout: float = 10.0):
         binary = binary or find_binary()
@@ -46,5 +47,9 @@ class NativeStoreServer(NativeProcess):
             # size-triggered WAL compaction threshold (checkpoint
             # plane); 0 disables it, negative keeps the server default
             argv += ["--compact-wal-bytes", str(compact_wal_bytes)]
+        if not snapshot_staggered:
+            # rollback switch: full-lock snapshot imaging (the PR 5
+            # behavior, and the write-stall bench's baseline)
+            argv += ["--snapshot-staggered", "0"]
         super().__init__(binary, argv, token=token,
                          ready_timeout=ready_timeout)

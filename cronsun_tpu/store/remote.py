@@ -342,16 +342,62 @@ class RemoteStore:
             sock = wrap_client(sock, self._sslctx, self._tls_hostname)
         sock.settimeout(None)
         rfile = sock.makefile("rb")
-        threading.Thread(target=self._read_loop, args=(sock, rfile),
-                         daemon=True, name="remote-store-reader").start()
-        if self._token:
-            # authenticate BEFORE publishing the socket: a concurrent
-            # _call sending ahead of the handshake would hit the server's
-            # first-frame-must-auth rule and get the fresh connection
-            # closed under us (reconnect churn on every heal)
-            self._call("auth", self._token, sock_override=sock)
+        if self._sslctx is not None:
+            # First round trip runs SYNCHRONOUSLY, before the reader
+            # thread exists.  An OpenSSL connection is not a thread-safe
+            # object, and right after the handshake the post-handshake
+            # records (TLS 1.3 NewSessionTicket) are processed inside
+            # the connection's first SSL_read — a concurrent SSL_write
+            # from the calling thread raced that read and intermittently
+            # swallowed the first frame, which surfaced as the server's
+            # auth-timeout watchdog severing an apparently-healthy
+            # connection ~10 s in (the test_tls flake: first-rpc
+            # failures on fresh TLS connections under repetition).  One
+            # synchronous auth round trip drains those records single-
+            # threaded; afterwards the usual one-reader + serialized-
+            # writers discipline holds.
+            self._handshake_rpc(sock, rfile)
+            threading.Thread(target=self._read_loop, args=(sock, rfile),
+                             daemon=True,
+                             name="remote-store-reader").start()
+        else:
+            threading.Thread(target=self._read_loop, args=(sock, rfile),
+                             daemon=True,
+                             name="remote-store-reader").start()
+            if self._token:
+                # authenticate BEFORE publishing the socket: a
+                # concurrent _call sending ahead of the handshake would
+                # hit the server's first-frame-must-auth rule and get
+                # the fresh connection closed under us (reconnect churn
+                # on every heal)
+                self._call("auth", self._token, sock_override=sock)
         self._sock = sock
         self._rfile = rfile
+
+    def _handshake_rpc(self, sock, rfile):
+        """One blocking auth round trip on the freshly wrapped TLS
+        socket (no reader thread yet; open servers answer the auth op
+        as a no-op, so this doubles as the post-handshake drain)."""
+        data = (json.dumps({"i": 0, "o": "auth",
+                            "a": [self._token] if self._token else [""]},
+                           separators=(",", ":")) + "\n").encode()
+        sock.settimeout(self._timeout)
+        try:
+            sock.sendall(data)
+            line = rfile.readline()
+        except OSError as e:
+            raise RemoteStoreError(f"tls handshake rpc failed: {e}")
+        finally:
+            sock.settimeout(None)
+        if not line:
+            raise RemoteStoreError(
+                "connection closed during handshake rpc")
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            raise RemoteStoreError("malformed handshake rpc reply")
+        if "e" in msg:
+            raise RemoteStoreError(msg["e"])
 
     def _read_loop(self, sock, rfile):
         while not self._closed:

@@ -476,6 +476,38 @@ class LogStore {
     };
 
     std::lock_guard<std::mutex> g(mu);
+    size_t res_base = res.size();
+    std::string memo_key;
+    if (latest) {
+      // canonical key over every filter the latest view honors: the
+      // marshalled reply for an unchanged revision is reusable across
+      // a dashboard fleet's polls with zero row copies / re-marshals
+      memo_key = node;
+      memo_key += '\x1f';
+      memo_key += name_like;
+      memo_key += '\x1f';
+      for (const auto& j : job_ids) {
+        memo_key += j;
+        memo_key += '\x1e';
+      }
+      memo_key += '\x1f';
+      memo_key += has_begin ? std::to_string(begin) : std::string("-");
+      memo_key += '\x1f';
+      memo_key += has_end ? std::to_string(end) : std::string("-");
+      memo_key += '\x1f';
+      memo_key += failed_only ? '1' : '0';
+      memo_key += '\x1f';
+      memo_key += std::to_string(page);
+      memo_key += '\x1f';
+      memo_key += std::to_string(page_size);
+      auto mit = latest_memo_.find(memo_key);
+      if (mit != latest_memo_.end() &&
+          mit->second.first == next_id_ - 1) {
+        res += mit->second.second;
+        op_count("q_latest_memo", 1);
+        return;
+      }
+    }
     auto sort_begin_desc = [](std::vector<const Rec*>& v) {
       // ORDER BY begin_ts DESC, id ASC — the tie order the SQLite
       // backend pins explicitly; both backends must page identically
@@ -568,6 +600,11 @@ class LogStore {
       rec_wire(res, *hits[i], /*with_id=*/!latest);
     }
     res += "]}";
+    if (!memo_key.empty()) {
+      latest_memo_[memo_key] = {next_id_ - 1, res.substr(res_base)};
+      while (latest_memo_.size() > 64)
+        latest_memo_.erase(latest_memo_.begin());
+    }
   }
 
   bool get_log(long long id, std::string& res) {
@@ -1364,6 +1401,12 @@ class LogStore {
   long long snapshot_watermark_ = 0;
   std::deque<Rec> recs_;
   std::map<std::pair<std::string, std::string>, Rec> latest_;
+  // serialized-reply memo for the latest view, keyed on the request's
+  // canonical filter string -> (revision, marshalled reply).  Guarded
+  // by mu; sound because the revision and the reply are read/written
+  // under the SAME mu hold writers take to mutate (the py serve
+  // layer's memo one backend over; hits count as q_latest_memo).
+  std::map<std::string, std::pair<long long, std::string>> latest_memo_;
   std::map<std::string, Stat> stats_;
   std::map<std::string, std::pair<std::string, bool>> nodes_;
   std::map<std::string, std::string> accounts_;

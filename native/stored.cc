@@ -184,6 +184,90 @@ class Wal {
     struct stat st;
     return fstat(fileno(f_), &st) == 0 ? (long long)st.st_size : 0;
   }
+  // Move every record logged so far to `dst` and keep appending to a
+  // FRESH file at `path` — the staggered snapshot's pin: records at or
+  // before the pin land in dst (covered by the snapshot being cut),
+  // records after it in the fresh file (the replay tail).  Caller
+  // holds the locks that order appends.  If dst already exists (a
+  // previous snapshot attempt died between its pin and its rename),
+  // current records are APPENDED to it — both predate the new pin, and
+  // replacing dst would silently drop the older ones.
+  // truncate `path` to its last newline-terminated record (drop a torn
+  // final line — the tolerated crash artifact — so appends never glue
+  // onto it)
+  static void trim_torn_tail(const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb+");
+    if (!f) return;
+    if (fseek(f, 0, SEEK_END) != 0) {
+      fclose(f);
+      return;
+    }
+    long size = ftell(f);
+    long pos = size;
+    char buf[1 << 16];
+    while (pos > 0) {
+      long step = pos < (long)sizeof buf ? pos : (long)sizeof buf;
+      fseek(f, pos - step, SEEK_SET);
+      size_t n = fread(buf, 1, (size_t)step, f);
+      long nl = -1;
+      for (long i = (long)n - 1; i >= 0; i--)
+        if (buf[i] == '\n') {
+          nl = i;
+          break;
+        }
+      if (nl >= 0) {
+        long keep = pos - step + nl + 1;
+        if (keep < size) {
+          fflush(f);
+          if (ftruncate(fileno(f), keep) != 0) { /* best effort */ }
+        }
+        fclose(f);
+        return;
+      }
+      pos -= step;
+    }
+    fflush(f);
+    if (ftruncate(fileno(f), 0) != 0) { /* best effort */ }
+    fclose(f);
+  }
+
+  bool rotate(const std::string& path, const std::string& dst) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!f_ || fflush(f_) != 0) return false;
+    fclose(f_);
+    f_ = nullptr;
+    struct stat st;
+    if (stat(dst.c_str(), &st) == 0 && st.st_size > 0) {
+      // a previous merge that died mid-append can leave a TORN final
+      // line in dst; appending straight after it would glue records
+      // onto the torn line — a malformed record with valid records
+      // after it, which boot reads as mid-file corruption and refuses.
+      // Trim to the last complete line first (a torn final record is a
+      // legal crash artifact to drop).
+      trim_torn_tail(dst);
+      FILE* out = fopen(dst.c_str(), "a");
+      FILE* src = fopen(path.c_str(), "r");
+      bool ok = out && src;
+      if (ok) {
+        char buf[1 << 16];
+        size_t n;
+        while ((n = fread(buf, 1, sizeof buf, src)) > 0)
+          ok = ok && fwrite(buf, 1, n, out) == n;
+        ok = ok && !ferror(src) && fflush(out) == 0 &&
+             fdatasync(fileno(out)) == 0;
+      }
+      if (src) fclose(src);
+      if (out) fclose(out);
+      f_ = fopen(path.c_str(), ok ? "w" : "a");
+      return ok && f_ != nullptr;
+    }
+    if (rename(path.c_str(), dst.c_str()) != 0) {
+      f_ = fopen(path.c_str(), "a");
+      return false;
+    }
+    f_ = fopen(path.c_str(), "a");
+    return f_ != nullptr;
+  }
   void close_file() {
     std::lock_guard<std::mutex> g(mu_);
     if (f_) fclose(f_);
@@ -267,6 +351,11 @@ class Store {
   struct Stripe {
     std::mutex mu;
     std::map<std::string, KVRec> kv;
+    // staggered-snapshot state, guarded by mu: imaged=false while an
+    // active snapshot hasn't taken this stripe's image yet; cow holds
+    // the PRE-image (existed, rec) of every key mutated in that window
+    bool imaged = true;
+    std::map<std::string, std::pair<bool, KVRec>> cow;
   };
 
   size_t sidx(const std::string& key) const {
@@ -313,6 +402,24 @@ class Store {
   }
 
   void set_has_sweeper() { has_sweeper_ = true; }
+  void set_snapshot_staggered(bool on) { snap_staggered_ = on; }
+
+  // staggered-snapshot copy-on-write: a mutation landing in a stripe
+  // the active snapshot has NOT yet imaged first saves the key's
+  // pre-image (first touch only), so the image taken later reads as of
+  // the pinned revision.  Caller holds the key's stripe lock — the pin
+  // (which arms this under ALL stripe locks) and the imager (which
+  // flips `imaged` under this stripe's lock) both serialize against it.
+  void cow_save(const std::string& key) {
+    if (!snap_active_.load(std::memory_order_acquire)) return;
+    Stripe& st = stripes_[sidx(key)];
+    if (st.imaged || st.cow.count(key)) return;
+    auto it = st.kv.find(key);
+    if (it == st.kv.end())
+      st.cow.emplace(key, std::make_pair(false, KVRec{}));
+    else
+      st.cow.emplace(key, std::make_pair(true, it->second));
+  }
 
   // per-op lease expiry: leave expiry to the sweeper when one runs —
   // an unconditional whole-table scan per op (under the shared lease
@@ -741,7 +848,10 @@ class Store {
     op_record("snapshot_load", t0);
     if (ok) {
       t0 = mono_ns();
-      ok = replay_file(path, err);
+      // FILE.1 = pre-pin records parked by a staggered snapshot that
+      // died mid-image: strictly older than the live WAL, replayed
+      // between snapshot and tail so last-write-wins convergence holds
+      ok = replay_file(path + ".1", err) && replay_file(path, err);
       op_record("wal_replay", t0);
     }
     replaying_ = false;
@@ -754,28 +864,89 @@ class Store {
       wal_ = nullptr;
       return false;
     }
-    // the WAL's records are now covered by the fresh snapshot
+    // the WAL's records — and any parked rotation — are now covered by
+    // the fresh snapshot.  FILE.1 goes FIRST: a crash between the two
+    // with the order reversed would leave snapshot + stale FILE.1 +
+    // empty WAL, and the next boot would replay the stale records over
+    // the snapshot with no newer tail to converge them.
+    remove((path + ".1").c_str());
     wal_->truncate();
     return true;
   }
 
-  // Live snapshot op: write a consistent point-in-time image of the
-  // striped keyspace + lease table (tagged with its revision via the
-  // "v" record) to the sidecar, then truncate the WAL.  Holds every
-  // stripe + the lease table + the event plane, so no mutation — and
-  // no WAL append — can interleave between image and truncation.
+  // Live snapshot op: write a point-in-time image of the striped
+  // keyspace + lease table (tagged with its revision via the "v"
+  // record) to the sidecar.  Two paths:
+  //
+  // - STAGGERED (default): a brief all-locks PIN (revision + lease
+  //   copy + WAL rotation to FILE.1 — O(lease table), no key copied),
+  //   then stripes image ONE AT A TIME under their own locks with
+  //   copy-on-write pre-images for racing writers — a writer stalls at
+  //   most one stripe's copy, and the .snap is consistent at the
+  //   pinned revision (every post-pin mutation is in the fresh WAL, so
+  //   boot replay converges regardless).  On success FILE.1 is
+  //   removed (its records are covered).
+  // - FULL-LOCK (--snapshot-staggered 0): every stripe + the lease
+  //   table + the event plane held for the whole serialization — the
+  //   rollback switch and the write-stall bench's baseline.
+  //
   // Returns the snapshot's revision.
   long long snapshot() {
     if (!wal_) throw std::runtime_error("snapshot: no WAL configured");
-    StripeLock g(*this, all_idxs());
-    std::lock_guard<std::recursive_mutex> lg(lease_mu_);
-    std::lock_guard<std::mutex> sg(sync_mu_);
+    if (!snap_staggered_) {
+      StripeLock g(*this, all_idxs());
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+      std::lock_guard<std::mutex> sg(sync_mu_);
+      long long t0 = mono_ns();
+      std::string err;
+      if (!write_snapshot(err)) throw std::runtime_error(err);
+      // FILE.1 before the truncation (see open_wal: reversed, a crash
+      // in between regresses keys to pre-pin values on the next boot)
+      remove((wal_path_ + ".1").c_str());
+      wal_->truncate();
+      op_record("snapshot", t0);
+      return rev_;
+    }
+    std::lock_guard<std::mutex> smg(snap_mu_);  // one snapshot at a time
     long long t0 = mono_ns();
+    long long rev, nl;
+    std::vector<LeaseSnap> leases;
+    {
+      // PIN — the brief exclusive window: fix the revision boundary,
+      // copy the (small) lease table, park the pre-pin WAL records in
+      // FILE.1, arm the per-stripe COW.  O(1) in the keyspace.
+      StripeLock g(*this, all_idxs());
+      std::lock_guard<std::recursive_mutex> lg(lease_mu_);
+      std::lock_guard<std::mutex> sg(sync_mu_);
+      long long tp = mono_ns();
+      rev = rev_;
+      nl = next_lease_;
+      double steady = now(), wall = wall_now();
+      for (const auto& [lid, l] : leases_)
+        leases.push_back({lid, l.ttl, wall + (l.deadline - steady)});
+      if (!wal_->rotate(wal_path_, wal_path_ + ".1"))
+        throw std::runtime_error("wal rotate failed for " + wal_path_);
+      for (auto& st : stripes_) {
+        st.imaged = false;
+        st.cow.clear();
+      }
+      snap_active_.store(true, std::memory_order_release);
+      op_record("snapshot_pin", tp);
+    }
     std::string err;
-    if (!write_snapshot(err)) throw std::runtime_error(err);
-    wal_->truncate();
+    bool ok = write_snapshot_staggered(err, rev, nl, leases);
+    // disarm — also on failure (the parked FILE.1 + fresh WAL still
+    // replay to the exact live state; the next pin merges into FILE.1)
+    snap_active_.store(false, std::memory_order_release);
+    for (auto& st : stripes_) {
+      std::lock_guard<std::mutex> g(st.mu);
+      st.imaged = true;
+      st.cow.clear();
+    }
+    if (!ok) throw std::runtime_error(err);
+    remove((wal_path_ + ".1").c_str());
     op_record("snapshot", t0);
-    return rev_;
+    return rev;
   }
 
   long long rev() {
@@ -877,6 +1048,7 @@ class Store {
 
   // caller holds the key's stripe lock
   long long put_locked(const std::string& key, const std::string& value, long long lease) {
+    cow_save(key);
     auto& kvmap = stripes_[sidx(key)].kv;
     auto prev_it = kvmap.find(key);
     Ev ev;
@@ -928,6 +1100,7 @@ class Store {
 
   // caller holds the key's stripe lock
   bool delete_locked(const std::string& key) {
+    cow_save(key);
     auto& kvmap = stripes_[sidx(key)].kv;
     auto it = kvmap.find(key);
     if (it == kvmap.end()) return false;
@@ -1106,6 +1279,94 @@ class Store {
     return true;
   }
 
+  struct LeaseSnap {
+    long long id;
+    double ttl, wall_deadline;
+  };
+
+  // staggered image: header + leases from the PIN's copies, then each
+  // stripe copied under ITS OWN lock (the writers' worst-case stall)
+  // and serialized outside it, with the COW pre-images overlaid so the
+  // image reads as of the pinned revision.  Same tmp+rename+fdatasync
+  // discipline as write_snapshot.
+  bool write_snapshot_staggered(std::string& err, long long rev,
+                                long long next_lease,
+                                const std::vector<LeaseSnap>& leases) {
+    std::string snap = wal_path_ + ".snap";
+    std::string tmp = snap + ".tmp";
+    FILE* out = fopen(tmp.c_str(), "w");
+    if (!out) {
+      err = "cannot write " + tmp;
+      return false;
+    }
+    std::string rec;
+    bool wok = true;
+    auto emit = [&]() {
+      rec += '\n';
+      wok = wok && fwrite(rec.data(), 1, rec.size(), out) == rec.size();
+      rec.clear();
+    };
+    rec = "[\"v\",";
+    jint(rec, rev);
+    rec += ',';
+    jint(rec, next_lease);
+    rec += ']';
+    emit();
+    for (const LeaseSnap& l : leases) {
+      rec += "[\"g\",";
+      jint(rec, l.id);
+      rec += ',';
+      jdbl(rec, l.ttl);
+      rec += ',';
+      jdbl(rec, l.wall_deadline);
+      rec += ']';
+      emit();
+    }
+    for (size_t i = 0; i < nstripes_ && wok; i++) {
+      std::map<std::string, KVRec> img;
+      std::map<std::string, std::pair<bool, KVRec>> cow;
+      {
+        std::lock_guard<std::mutex> g(stripes_[i].mu);
+        img = stripes_[i].kv;
+        cow.swap(stripes_[i].cow);
+        stripes_[i].imaged = true;
+      }
+      for (const auto& [k, pre] : cow) {
+        if (pre.first)
+          img[k] = pre.second;
+        else
+          img.erase(k);
+      }
+      for (const auto& [key, kv] : img) {
+        rec += "[\"s\",";
+        jesc(rec, key);
+        rec += ',';
+        jesc(rec, kv.value);
+        rec += ',';
+        jint(rec, kv.create_rev);
+        rec += ',';
+        jint(rec, kv.mod_rev);
+        rec += ',';
+        jint(rec, kv.lease);
+        rec += ']';
+        emit();
+      }
+    }
+    wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
+    fclose(out);
+    if (!wok) {
+      remove(tmp.c_str());
+      err = "snapshot write to " + tmp + " failed: " +
+            std::string(strerror(errno));
+      return false;
+    }
+    if (rename(tmp.c_str(), snap.c_str()) != 0) {
+      err = "rename failed for " + tmp;
+      return false;
+    }
+    return true;
+  }
+
   // replay one WAL record; false on parse failure
   bool replay_line(const std::string& line) {
     JParser jp(line);
@@ -1193,6 +1454,13 @@ class Store {
   std::deque<Ev> history_;
   size_t history_cap_;
   Wal wal_storage_;
+  // staggered snapshots: snap_active_ arms the writers' COW hook (set
+  // under ALL stripe locks at the pin, so no mutator can straddle the
+  // flip); snap_mu_ serializes snapshots (sweeper vs wire op);
+  // snap_staggered_ is the rollback switch (--snapshot-staggered 0)
+  std::atomic<bool> snap_active_{false};
+  std::mutex snap_mu_;
+  bool snap_staggered_ = true;
   Wal* wal_ = nullptr;
   std::string wal_path_;
   bool replaying_ = false;
@@ -1603,6 +1871,7 @@ int main(int argc, char** argv) {
   size_t stripes = Store::kDefaultStripes;
   double sweep_s = 0.2;
   long long compact_wal_bytes = 256ll << 20;
+  bool snap_staggered = true;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -1614,6 +1883,7 @@ int main(int argc, char** argv) {
     else if (a == "--wal") wal_path = next();
     else if (a == "--fsync-per-commit") fsync_per_commit = true;
     else if (a == "--compact-wal-bytes") compact_wal_bytes = atoll(next());
+    else if (a == "--snapshot-staggered") snap_staggered = atoi(next()) != 0;
     else if (a == "--token") g_token = next();
     else if (a == "--token-file") {
       // keeps the secret out of /proc/<pid>/cmdline
@@ -1641,7 +1911,7 @@ int main(int argc, char** argv) {
     else if (a == "--help") {
       printf("cronsun-stored --host H --port P [--history N] "
              "[--stripes N] [--sweep-interval S] [--wal FILE] [--fsync-per-commit] "
-             "[--compact-wal-bytes N] "
+             "[--compact-wal-bytes N] [--snapshot-staggered 0|1] "
              "[--token T | --token-file F] [--die-with-parent]\n");
       return 0;
     }
@@ -1667,6 +1937,7 @@ int main(int argc, char** argv) {
     return 1;
   }
   static Store store(history, stripes);
+  store.set_snapshot_staggered(snap_staggered);
   if (!wal_path.empty()) {
     std::string err;
     if (!store.open_wal(wal_path, err, fsync_per_commit)) {

@@ -151,14 +151,20 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
             raise RuntimeError("step failed 50 times")
 
         on_log("cold load: store -> host mirrors -> device")
+        import shutil
+        import tempfile
+        ckpt_dir = tempfile.mkdtemp(prefix="cronsun-ckpt-")
         t0 = time.time()
         # dispatch_ttl 3600: the bench has NO consumers, so its orders
         # accumulate until lease expiry; the default 300 s would land a
         # mass-expiry DELETE burst mid-measurement (a sweep artifact no
-        # consuming fleet exhibits)
+        # consuming fleet exhibits).  checkpoint_dir arms the delta
+        # event recording the delta-save ladder below measures (no file
+        # exists yet, so this construction still COLD loads).
         a = SchedulerService(store, job_capacity=n_jobs,
                              node_capacity=n_nodes, window_s=window_s,
-                             dispatch_ttl=3600.0, node_id="bench-A")
+                             dispatch_ttl=3600.0, node_id="bench-A",
+                             checkpoint_dir=ckpt_dir)
         out["failover_cold_load_s"] = round(time.time() - t0, 2)
         on_log(f"cold load {out['failover_cold_load_s']}s "
                f"({len(a.jobs)} jobs)")
@@ -172,18 +178,56 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
         # what the cold-loaded one would (the donated device load/
         # rem_cap this perturbs is rewritten by reconcile_capacity at
         # A's first step, so the measured steps below are unaffected).
-        import shutil
-        import tempfile
-        ckpt_dir = tempfile.mkdtemp(prefix="cronsun-ckpt-")
         w = store_w = None
         try:
+            ckpt_path = os.path.join(ckpt_dir, "sched.ckpt")
             t0 = time.time()
-            save = a.checkpoint_save(
-                path=os.path.join(ckpt_dir, "sched.ckpt"))
+            save = a.checkpoint_save(path=ckpt_path, kind="full")
             out["sched_checkpoint_save_s"] = round(time.time() - t0, 2)
             on_log(f"checkpoint saved in "
                    f"{out['sched_checkpoint_save_s']}s "
                    f"(rev {save['rev']})")
+            # ---- delta saves: cost proportional to CHANGE ------------
+            # Cadence ladder: mutate K jobs (sparse churn — the steady
+            # state a tight checkpoint cadence sees), drain the watch
+            # events, save a DELTA chain element, and time it.  The
+            # tentpole's claim is sched_checkpoint_delta_save_s (the
+            # last rung) << sched_checkpoint_save_s (the full image).
+            ladder = {}
+            for n_mut in (10, 100, 1000):
+                if n_mut * 10 > n_jobs:
+                    break
+                muts = []
+                for m in range(n_mut):
+                    i = (m * 7919) % n_jobs
+                    muts.append((
+                        f"{ks.cmd}bench/bj{i}",
+                        f'{{"name":"b{i}","command":"true","kind":2,'
+                        f'"rules":[{{"id":"r","timer":"@every '
+                        f'{30 + m % 60}s",'
+                        f'"nids":["bn{i % n_nodes:05d}"]}}]}}'))
+                store.put_many(muts)
+                a.drain_watches()
+                t0 = time.time()
+                dsave = a.checkpoint_save(path=ckpt_path, kind="delta")
+                ladder[n_mut] = round(time.time() - t0, 3)
+                assert dsave["kind"] == "delta"
+            out["sched_checkpoint_delta_ladder_s"] = ladder
+            # flush A's device updates from the ladder's mutations (a
+            # leading step would have): the divergence check below
+            # compares device-planned windows, and the restored side
+            # folds+flushes the same mutations
+            a._flush_device()
+            if ladder:
+                out["sched_checkpoint_delta_save_s"] = \
+                    ladder[max(ladder)]
+                out["sched_checkpoint_delta_speedup"] = round(
+                    out["sched_checkpoint_save_s"]
+                    / max(1e-3, out["sched_checkpoint_delta_save_s"]),
+                    2)
+                on_log(f"delta saves (mutations -> s): {ladder} "
+                       f"({out['sched_checkpoint_delta_speedup']}x vs "
+                       f"full)")
             store_w = RemoteStore(srv.host, srv.port, timeout=600)
             t0 = time.time()
             w = SchedulerService(store_w, job_capacity=n_jobs,

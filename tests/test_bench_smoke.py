@@ -88,6 +88,47 @@ def test_warm_takeover_beats_cold_load_at_scale():
 
 
 @pytest.mark.slow
+def test_delta_checkpoint_scales():
+    """Incremental-checkpoint gate at the CPU-host scale (50k jobs x
+    512 nodes): a DELTA save under sparse churn must be >= 5x faster
+    than the full save, the warm takeover (which now folds the chain)
+    must still restore for real with zero dispatch divergence, and the
+    staggered snapshot's write stall must be bounded (p99 <= 0.25x the
+    full-lock hold at the probe's store size, both backends where
+    available)."""
+    if (os.cpu_count() or 1) < 6:
+        pytest.skip("needs >= 6 cores for a meaningful signal")
+    import bench_sched
+    res = bench_sched.run_bench(
+        50_000, 512, steps=3,
+        on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res.get("failover_warm_restored") == 1
+    assert res.get("failover_warm_divergence_orders") == 0, (
+        f"restored scheduler diverged on "
+        f"{res.get('failover_warm_divergence_orders')} of "
+        f"{res.get('failover_warm_window_orders')} first-window orders")
+    full = res["sched_checkpoint_save_s"]
+    delta = res["sched_checkpoint_delta_save_s"]
+    assert delta * 5 <= full, (
+        f"delta save {delta}s is not >= 5x faster than the full save "
+        f"{full}s (ladder {res.get('sched_checkpoint_delta_ladder_s')})")
+
+    import bench_store
+    stall = bench_store.run_stall_suite(
+        n_keys=100_000, on_log=lambda *a: print(*a, file=sys.stderr))
+    checked = 0
+    for backend in ("py", "native"):
+        ratio = stall.get(f"snapshot_stall_ratio_{backend}")
+        if ratio is None:
+            continue
+        checked += 1
+        assert ratio <= 0.25, (
+            f"{backend} staggered write-stall p99 is {ratio}x the "
+            f"full-lock hold (bound 0.25x): {stall}")
+    assert checked, f"no backend produced a stall ratio: {stall}"
+
+
+@pytest.mark.slow
 def test_two_agents_scale_aggregate_drain():
     if (os.cpu_count() or 1) < 6:
         pytest.skip("needs >= 6 cores for a meaningful scaling signal")

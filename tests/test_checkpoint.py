@@ -188,6 +188,147 @@ def test_memstore_sweeper_compacts_oversized_wal(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# staggered snapshot imaging (COW consistency + crash matrix)
+# ---------------------------------------------------------------------------
+
+def test_staggered_snapshot_is_point_in_time(tmp_path, monkeypatch):
+    """Writes racing the image land in COW side buffers: the .snap must
+    read as of the PIN — pre-image for mutated keys, no post-pin keys —
+    while boot (snap + rotated + tail) still converges to the live
+    state."""
+    from cronsun_tpu.checkpoint.walsnap import read_records
+    import cronsun_tpu.checkpoint.walsnap as walsnap
+    wal = str(tmp_path / "s.wal")
+    s = MemStore().open_wal(wal)
+    s.put("/a", "old")
+    s.put("/gone", "x")
+    real = walsnap.write_snapshot
+
+    def mutating(path, lines):
+        # the pin has been released, no stripe imaged yet: these hit
+        # the COW path exactly like a concurrent writer would
+        s.put("/a", "new")
+        s.delete("/gone")
+        s.put("/fresh", "y")
+        return real(path, lines)
+    monkeypatch.setattr(walsnap, "write_snapshot", mutating)
+    s.snapshot()
+    monkeypatch.setattr(walsnap, "write_snapshot", real)
+    snap_recs = {r[1]: r[2] for r in read_records(wal + ".snap")
+                 if r[0] == "s"}
+    assert snap_recs["/a"] == "old", "image leaked a post-pin write"
+    assert "/gone" in snap_recs, "image leaked a post-pin delete"
+    assert "/fresh" not in snap_recs, "image leaked a post-pin create"
+    assert s.get("/a").value == "new"          # live state unperturbed
+    assert s.op_stats()["snapshot_pin"]["count"] >= 1
+    s.close()
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/a").value == "new"
+    assert s2.get("/gone") is None
+    assert s2.get("/fresh").value == "y"
+    s2.close()
+
+
+def test_staggered_snapshot_crash_mid_image_converges(tmp_path,
+                                                      monkeypatch):
+    """Crash between the stripe imaging and the COW drain (mid-image):
+    artifacts are the OLD .snap, the rotated pre-pin records (FILE.1)
+    and the fresh post-pin WAL.  Boot must converge to the exact
+    pre-crash state from the previous snapshot + both record files, and
+    a RETRY snapshot merges the parked records instead of dropping
+    them."""
+    import cronsun_tpu.checkpoint.walsnap as walsnap
+    wal = str(tmp_path / "s.wal")
+    s = MemStore().open_wal(wal)
+    s.put("/a", "1")
+    s.put("/b", "2")
+    s.snapshot()                     # a real previous snapshot
+    s.put("/a", "3")                 # pre-pin tail
+
+    real = walsnap.write_snapshot
+    cur = [s]                        # the store the crash injects into
+
+    def dying(path, lines):
+        cur[0].put("/post", "late")  # post-pin write -> fresh WAL
+        raise OSError("disk died mid-image")
+    monkeypatch.setattr(walsnap, "write_snapshot", dying)
+    with pytest.raises(OSError):
+        s.snapshot()
+    monkeypatch.setattr(walsnap, "write_snapshot", real)
+    assert os.path.exists(wal + ".1"), "pre-pin records not parked"
+    s.put("/b", "4")                 # life goes on into the fresh WAL
+    final = {"/a": "3", "/b": "4", "/post": "late"}
+    s.close()
+
+    s2 = MemStore().open_wal(wal)
+    for k, v in final.items():
+        assert s2.get(k).value == v, f"{k} diverged after crash replay"
+    assert not os.path.exists(wal + ".1")   # boot compaction covered it
+    s2.close()
+
+    # retry path WITHOUT an intervening boot: a second snapshot merges
+    # the already-parked FILE.1 with the current WAL
+    s3 = MemStore().open_wal(wal)
+    s3.put("/c", "5")
+    cur[0] = s3
+    monkeypatch.setattr(walsnap, "write_snapshot", dying)
+    with pytest.raises(OSError):
+        s3.snapshot()
+    monkeypatch.setattr(walsnap, "write_snapshot", real)
+    s3.put("/c", "6")
+    s3.snapshot()                    # retry succeeds, merges FILE.1
+    assert not os.path.exists(wal + ".1")
+    s3.close()
+    s4 = MemStore().open_wal(wal)
+    assert s4.get("/c").value == "6"
+    assert s4.get("/post").value == "late"
+    s4.close()
+
+
+def test_rotate_merge_trims_torn_tail(tmp_path, monkeypatch):
+    """A parked FILE.1 whose final line is TORN (a merge that died
+    mid-append): the next rotation must trim it before appending —
+    gluing records onto the torn line would read as mid-file corruption
+    at boot and refuse to start."""
+    import cronsun_tpu.checkpoint.walsnap as walsnap
+    wal = str(tmp_path / "s.wal")
+    s = MemStore().open_wal(wal)
+    s.put("/a", "1")
+    with open(wal + ".1", "w") as f:
+        f.write('["p","/old","x",0]\n["p","/torn')    # torn final line
+    real = walsnap.write_snapshot
+
+    def dying(path, lines):
+        raise OSError("disk died post-rotate")
+    monkeypatch.setattr(walsnap, "write_snapshot", dying)
+    with pytest.raises(OSError):
+        s.snapshot()          # the pin merged the live WAL into FILE.1
+    monkeypatch.setattr(walsnap, "write_snapshot", real)
+    s.close()
+    s2 = MemStore().open_wal(wal)   # pre-fix: SnapshotCorrupt here
+    assert s2.get("/a").value == "1"
+    assert s2.get("/old").value == "x"
+    assert s2.get("/torn") is None  # the torn record was dropped
+    s2.close()
+
+
+def test_snapshot_staggered_off_rollback(tmp_path):
+    """The rollback switch: full-lock imaging still round-trips and
+    never records a pin op."""
+    wal = str(tmp_path / "s.wal")
+    s = MemStore(snapshot_staggered=False).open_wal(wal)
+    _seed(s)
+    s.snapshot()
+    assert "snapshot_pin" not in s.op_stats()
+    s.put("/post", "tail")
+    s.close()
+    s2 = MemStore().open_wal(wal)
+    assert s2.get("/jobs/a").value == "v2"
+    assert s2.get("/post").value == "tail"
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
 # store snapshots + WAL (native backend, over the wire)
 # ---------------------------------------------------------------------------
 
@@ -258,6 +399,41 @@ def test_native_boot_recovers_from_torn_snapshot_tmp(tmp_path):
         assert s2.get("/jobs/a").value == "v2"
         assert s2.get("/hot").value == "val-49"
         assert s2.get("/post").value == "tail"
+        s2.close()
+    finally:
+        srv2.stop()
+
+
+def test_native_staggered_crash_artifacts_converge(tmp_path):
+    """Native mid-image crash artifact set: a parked FILE.1 (pre-pin
+    records) beside the live WAL (post-pin records).  Boot must replay
+    snap -> FILE.1 -> WAL in that order (last-write-wins converges to
+    the pre-crash state) and the boot compaction must retire FILE.1."""
+    wal = str(tmp_path / "store.wal")
+    srv = _native(tmp_path)
+    s = RemoteStore(srv.host, srv.port, reconnect=False)
+    s.put("/only1", "a")
+    s.put("/k", "v1")
+    time.sleep(0.3)                   # sync rides the sweeper
+    s.close()
+    srv._proc.kill()
+    srv._proc.wait()
+    # craft the mid-image artifact set: every record so far parked in
+    # FILE.1, one post-pin mutation in the (fresh) WAL
+    os.replace(wal, wal + ".1")
+    with open(wal, "w") as f:
+        f.write('["p","/k","v2",0]\n')
+    srv2 = _native(tmp_path)
+    try:
+        s2 = RemoteStore(srv2.host, srv2.port, reconnect=False)
+        assert s2.get("/only1").value == "a"    # FILE.1 replayed
+        assert s2.get("/k").value == "v2"       # WAL wins over FILE.1
+        assert not os.path.exists(wal + ".1")   # boot compaction
+        # the live staggered op records its pin beside the image
+        s2.put("/more", "x")
+        s2.snapshot()
+        ops = s2.op_stats()
+        assert ops["snapshot_pin"]["count"] >= 1
         s2.close()
     finally:
         srv2.stop()
@@ -576,6 +752,315 @@ def test_sched_periodic_checkpoint(sched_world):
     a.step()
     assert os.path.exists(os.path.join(d, "sched.ckpt"))
     assert a.metrics_snapshot()["checkpoint_saves_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# delta checkpoint chain (incremental saves; crash matrix)
+# ---------------------------------------------------------------------------
+
+def _mutate_store(store, ks, tag="extra"):
+    """A small representative delta: job add, job delete, node add,
+    proc + alone mirror entries."""
+    store.put(f"{ks.cmd}g/{tag}", json.dumps(
+        {"name": tag, "command": "true", "kind": 2,
+         "rules": [{"id": "r", "timer": "@every 10s", "nids": ["n1"]}]}))
+    store.delete(f"{ks.cmd}g/j5")
+    store.put(ks.node_key("n8"), "1")
+    lease = store.grant(60)
+    store.put(ks.proc_key("n1", "g", "j1", 1234), "x", lease=lease)
+    store.put(ks.alone_lock_key("j2"), "n0", lease=lease)
+
+
+def test_delta_checkpoint_roundtrip_identical(sched_world):
+    """Base + delta chain restores BIT-IDENTICAL to the live scheduler:
+    full save, sparse mutations, DELTA save (small file), restore folds
+    the chain — same rows/mirrors, byte-identical window orders, and
+    the restored instance can EXTEND the chain (seq continues)."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    out = a.checkpoint_save()
+    assert out["kind"] == "full"
+    _mutate_store(store, ks)
+    a.drain_watches()
+    out2 = a.checkpoint_save()
+    assert out2["kind"] == "delta"
+    assert os.path.exists(os.path.join(d, "sched.ckpt.d1"))
+
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_restored
+    b.drain_watches()
+    b._flush_device()
+    a.drain_watches()
+    a._flush_device()
+    assert b.jobs.keys() == a.jobs.keys()
+    assert ("g", "extra") in b.jobs and ("g", "j5") not in b.jobs
+    assert b.rows.by_cmd == a.rows.by_cmd
+    assert b._procs == a._procs
+    assert b._alone_live == a._alone_live
+    assert b._excl_cnt == a._excl_cnt
+    ep = (int(time.time()) // 60 + 2) * 60
+    assert _window_orders(b, ep) == _window_orders(a, ep)
+    assert _window_orders(b, ep)[0] > 0
+
+    # chain continuation: B's next save extends the restored chain
+    _mutate_store(store, ks, tag="extra2")
+    b.drain_watches()
+    out3 = b.checkpoint_save()
+    assert out3["kind"] == "delta"
+    assert os.path.exists(os.path.join(d, "sched.ckpt.d2"))
+    c = _make_sched(store, ks, "C", checkpoint_dir=d)
+    svcs.append(c)
+    assert c.checkpoint_restored
+    assert ("g", "extra2") in c.jobs
+
+
+def test_delta_records_own_publish_accounting(sched_world):
+    """The leader's own-publish order reservations never echo back
+    through the delete-only orders watch; the delta stream records them
+    at accounting time (the synthetic ``ordmirror`` stream) so a
+    restored standby's mirrors match the live leader's without waiting
+    on anti-entropy."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    key = f"{ks.dispatch}n1/12345"
+    a._acct_add_order(key, "n1", [("g", "j1"), ("g", "j2")])
+    a.checkpoint_save(kind="delta")
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_restored
+    assert b._orders == a._orders
+    assert b._excl_cnt == a._excl_cnt
+    assert b._load_sum == a._load_sum
+
+
+def test_delta_save_roundtrips_byte_identical_to_full(sched_world):
+    """The tier-1 equivalence smoke: restoring base+delta must yield the
+    EXACT state a fresh FULL save at the same point restores — same
+    serialized image (volatile header fields aside), same orders."""
+    import numpy as np
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    _mutate_store(store, ks)
+    a.drain_watches()
+    a.checkpoint_save(kind="delta")
+    # a SECOND, independent full save of the same live state
+    full_dir = os.path.join(d, "full")
+    a.checkpoint_save(path=os.path.join(full_dir, "sched.ckpt"),
+                      kind="full")
+
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)          # chain
+    svcs.append(b)
+    c = _make_sched(store, ks, "C", checkpoint_dir=full_dir)   # full
+    svcs.append(c)
+    assert b.checkpoint_restored and c.checkpoint_restored
+    sb = b._checkpoint_state(0)
+    sc = c._checkpoint_state(0)
+    for k in ("jobs", "groups", "node_caps", "rows", "universe",
+              "row_phase", "row_dispatch", "col_node", "mirrors"):
+        assert sb[k] == sc[k], f"state field {k} diverged"
+    for k in ("elig", "exclusive", "cost"):
+        assert np.array_equal(sb[k], sc[k]), f"device field {k} diverged"
+    for name, arr in sb["table"].items():
+        assert np.array_equal(arr, sc["table"][name]), \
+            f"table field {name} diverged"
+    ep = (int(time.time()) // 60 + 2) * 60
+    assert _window_orders(b, ep) == _window_orders(c, ep)
+
+
+def test_delta_torn_mid_chain_falls_back_cold(sched_world):
+    """Torn pickle in the MIDDLE of the chain: the whole restore is
+    refused (cold load) — never a fold of the valid prefix plus a
+    silently dropped suffix."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    for tag in ("x1", "x2"):
+        _mutate_store(store, ks, tag=tag)
+        a.drain_watches()
+        a.checkpoint_save(kind="delta")
+    with open(os.path.join(d, "sched.ckpt.d1"), "wb") as f:
+        f.write(b"\x80\x04 torn delta")
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 65          # cold load of the CURRENT store
+
+
+def test_delta_missing_element_falls_back_cold(sched_world):
+    """Base present but a chain element missing (seq gap): cold load."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    for tag in ("x1", "x2"):
+        _mutate_store(store, ks, tag=tag)
+        a.drain_watches()
+        a.checkpoint_save(kind="delta")
+    os.remove(os.path.join(d, "sched.ckpt.d1"))
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 65
+
+
+def test_delta_foreign_chain_falls_back_cold(sched_world):
+    """A delta whose nonce doesn't match the base (files moved between
+    deployments) refuses the restore — cold load, loudly."""
+    import pickle
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    _mutate_store(store, ks)
+    a.drain_watches()
+    a.checkpoint_save(kind="delta")
+    p = os.path.join(d, "sched.ckpt.d1")
+    rec = pickle.load(open(p, "rb"))
+    rec["chain"] = "some-other-base"
+    pickle.dump(rec, open(p, "wb"))
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert not b.checkpoint_restored
+    assert len(b.jobs) == 64          # 64 seeded + extra - j5
+
+
+def test_full_save_rebases_and_clears_chain(sched_world):
+    """A full save (auto-rebase) unlinks the stale chain elements, so a
+    later restore folds nothing stale; the rebase knobs force it."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d,
+                    delta_max_chain=2)
+    svcs.append(a)
+    a.checkpoint_save()
+    for tag in ("x1", "x2"):
+        _mutate_store(store, ks, tag=tag)
+        a.drain_watches()
+        assert a.checkpoint_save()["kind"] == "delta"
+    # chain is at the knob: the next auto save must REBASE
+    _mutate_store(store, ks, tag="x3")
+    a.drain_watches()
+    out = a.checkpoint_save()
+    assert out["kind"] == "full"
+    assert not os.path.exists(os.path.join(d, "sched.ckpt.d1"))
+    b = _make_sched(store, ks, "B", checkpoint_dir=d)
+    svcs.append(b)
+    assert b.checkpoint_restored
+    assert ("g", "x3") in b.jobs
+
+
+def test_delta_buffer_invalidated_by_watch_loss(sched_world):
+    """After a watch loss (resync) the recorded stream is incomplete:
+    the next save must be a FULL rebase, never a delta missing the
+    gap's events."""
+    store, ks, d, svcs = sched_world
+    a = _make_sched(store, ks, "A", checkpoint_dir=d)
+    svcs.append(a)
+    a.checkpoint_save()
+    _mutate_store(store, ks)
+    a.resync()                        # the watch-loss recovery path
+    out = a.checkpoint_save()
+    assert out["kind"] == "full"
+    # and the rebase re-arms delta recording
+    _mutate_store(store, ks, tag="post")
+    a.drain_watches()
+    assert a.checkpoint_save()["kind"] == "delta"
+
+
+# ---------------------------------------------------------------------------
+# sharded-store checkpoints (rev-vector barrier)
+# ---------------------------------------------------------------------------
+
+def _sharded_world(nshards=2):
+    from cronsun_tpu.store.sharded import ShardedStore
+    ks = Keyspace()
+    store = ShardedStore([MemStore() for _ in range(nshards)])
+    _seed_sched(store, ks)
+    return store, ks
+
+
+def test_sharded_store_checkpoint_not_refused(tmp_path):
+    """The PR 6 refusal is GONE: checkpoint_dir against a 2-shard store
+    saves (rev VECTOR) and a standby restores warm, replaying each
+    shard's watch tail from its own rev+1."""
+    store, ks = _sharded_world()
+    d = str(tmp_path)
+    svcs = []
+    try:
+        a = _make_sched(store, ks, "A", checkpoint_dir=d)
+        svcs.append(a)
+        assert a.checkpoint_dir == d       # not silently disabled
+        out = a.checkpoint_save()
+        assert isinstance(out["rev"], list) and len(out["rev"]) == 2
+        _mutate_store(store, ks)
+        a.drain_watches()
+        assert a.checkpoint_save()["kind"] == "delta"
+
+        b = _make_sched(store, ks, "B", checkpoint_dir=d)
+        svcs.append(b)
+        assert b.checkpoint_restored
+        b.drain_watches()
+        b._flush_device()
+        a.drain_watches()
+        a._flush_device()
+        assert b.jobs.keys() == a.jobs.keys()
+        assert b.rows.by_cmd == a.rows.by_cmd
+        assert b._procs == a._procs
+        ep = (int(time.time()) // 60 + 2) * 60
+        assert _window_orders(b, ep) == _window_orders(a, ep)
+        assert _window_orders(b, ep)[0] > 0
+    finally:
+        for s in svcs:
+            s.stop()
+        store.close()
+
+
+def test_sharded_checkpoint_rev_vector_shape_mismatch_cold(tmp_path):
+    """A checkpoint cut against N shards refuses restore against M != N
+    (or an unsharded store): the revision vector is meaningless under a
+    different topology — cold load, loudly."""
+    store2, ks = _sharded_world(2)
+    d = str(tmp_path)
+    svcs = []
+    try:
+        a = _make_sched(store2, ks, "A", checkpoint_dir=d)
+        svcs.append(a)
+        a.checkpoint_save()
+
+        from cronsun_tpu.store.sharded import ShardedStore
+        store3 = ShardedStore([MemStore() for _ in range(3)],
+                              verify_map=False)
+        _seed_sched(store3, ks, n_jobs=8)
+        b = _make_sched(store3, ks, "B", checkpoint_dir=d)
+        svcs.append(b)
+        assert not b.checkpoint_restored
+        assert len(b.jobs) == 8
+
+        plain = MemStore()
+        _seed_sched(plain, ks, n_jobs=8)
+        c = _make_sched(plain, ks, "C", checkpoint_dir=d)
+        svcs.append(c)
+        assert not c.checkpoint_restored
+        assert len(c.jobs) == 8
+
+        # and the reverse: a SCALAR checkpoint against a sharded store
+        d2 = os.path.join(d, "scalar")
+        p = _make_sched(plain, ks, "P")
+        svcs.append(p)
+        p.checkpoint_save(path=os.path.join(d2, "sched.ckpt"))
+        q = _make_sched(store2, ks, "Q", checkpoint_dir=d2)
+        svcs.append(q)
+        assert not q.checkpoint_restored
+    finally:
+        for s in svcs:
+            s.stop()
+        store2.close()
 
 
 # ---------------------------------------------------------------------------

@@ -609,6 +609,36 @@ def test_latest_reply_memo_over_the_wire(tmp_path):
         srv.stop()
 
 
+def test_latest_reply_memo_native(tmp_path):
+    """The NATIVE logd's serialized-reply memo (the py serve layer's
+    counterpart, ROADMAP query-plane carry-over): idle repeat polls of
+    the latest view reuse the marshalled bytes (counted q_latest_memo),
+    a write invalidates, and distinct filters don't cross-satisfy."""
+    srv = _native_server(db=str(tmp_path / "m.wal"))
+    try:
+        c = RemoteJobLogStore(srv.host, srv.port)
+        c.create_job_logs([_rec(i) for i in range(50)])
+        r1 = c.query_logs(latest=True, page_size=500)
+        r2 = c.query_logs(latest=True, page_size=500)
+        assert [x.__dict__ for x in r1[0]] == [x.__dict__ for x in r2[0]]
+        f1 = c.query_logs(latest=True, node="n1", page_size=500)
+        f2 = c.query_logs(latest=True, node="n1", page_size=500)
+        assert [x.__dict__ for x in f1[0]] == [x.__dict__ for x in f2[0]]
+        assert len(f1[0]) < len(r1[0])      # the filter actually filters
+        ops = c.op_stats()
+        assert ops["q_latest_memo"]["count"] == 2
+        assert ops["q_latest_hot"]["count"] == 2
+        # a write bumps the revision: the memo must NOT serve stale
+        # bytes (the new record upserts (j3, n0)'s latest row)
+        c.create_job_log(_rec(999))
+        r3 = c.query_logs(latest=True, page_size=500)
+        assert "o999" in {x.output for x in r3[0]}
+        assert c.op_stats()["q_latest_hot"]["count"] == 3
+        c.close()
+    finally:
+        srv.stop()
+
+
 # ------------------------------------------------------------- reshard
 
 

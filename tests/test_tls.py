@@ -346,6 +346,38 @@ def test_full_duplex_tls_under_load(certs):
         srv.stop()
 
 
+def test_tls_first_rpc_repeated_connections(certs):
+    """Regression pin for the post-handshake race: the FIRST rpc on a
+    fresh TLS connection intermittently vanished (the client's reader
+    thread's first SSL_read — which processes the TLS 1.3 session
+    tickets — raced the calling thread's SSL_write; OpenSSL connections
+    are not thread-safe objects), leaving the server's auth watchdog to
+    sever an apparently-healthy connection ~10 s in.  The fix runs the
+    first round trip synchronously before the reader thread exists.
+    Repetition is the trigger (~5% per connection pre-fix, so 40
+    connections catch a regression with high probability); the
+    per-connection deadline catches the stall long before the rpc
+    timeout would."""
+    d, _ = certs
+    sctx = server_context(_server_tls(d))
+    for token in ("", "s3cret"):
+        srv = StoreServer(MemStore(), sslctx=sctx, token=token).start()
+        try:
+            for i in range(20):
+                t0 = time.time()
+                c = RemoteStore(srv.host, srv.port, token=token,
+                                sslctx=client_context(_client_tls(d)))
+                try:
+                    c.put(f"/rep/{i}", "x")
+                finally:
+                    c.close()
+                assert time.time() - t0 < 5, (
+                    f"first-rpc stall on fresh TLS connection {i} "
+                    f"(token={bool(token)})")
+        finally:
+            srv.stop()
+
+
 def test_tls_server_refuses_probe_then_serves(certs):
     """A bare TCP probe that connects and disconnects (port scanner,
     health check) must not wedge the accept loop."""
