@@ -85,7 +85,9 @@ def synth_table(J, fire_period_lo, fire_period_hi, seed=0):
         dom_star=np.zeros(J, bool), dow_star=np.zeros(J, bool),
         is_every=np.ones(J, bool),
         period=rng.integers(fire_period_lo, fire_period_hi, J).astype(np.int32),
-        active=np.ones(J, bool), paused=np.zeros(J, bool))
+        active=np.ones(J, bool), paused=np.zeros(J, bool),
+        has_dep=np.zeros(J, bool), dep_policy=np.zeros(J, np.int32),
+        dep_cols=np.full((J, 8), -1, np.int32))
     # Uniform phases over each job's own period: steady aggregate fire rate
     # (clustered phases make bursty seconds that overflow the fired bucket).
     cols["phase_mod"] = (rng.integers(0, 1 << 30, J) % cols["period"]).astype(np.int32)
@@ -604,6 +606,27 @@ def main():
                 detail["sched_bench_error"] = proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
             detail["sched_bench_error"] = str(e)
+
+    # ---- workflow DAG plane: chain latency + exactly-once @ 50k ------------
+    # Dependency-triggered jobs evaluated in the batched tick: a 3-stage
+    # fan-out/fan-in DAG at 50k jobs x 512 nodes, chain-latency p50/p99
+    # (upstream-success -> downstream-fire), exactly-once fire counts,
+    # and a warm takeover with zero dispatch divergence (dag_* keys).
+    if not quick:
+        log("workflow DAG plane: chain latency @ 50k jobs x 512 nodes")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--dag", "--jobs", "50000", "--nodes", "512",
+                 "--rounds", "3"],
+                capture_output=True, text=True, timeout=3600, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["dag_bench_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["dag_bench_error"] = str(e)
 
     with open("bench_detail.json", "w") as f:
         json.dump(detail, f, indent=1)

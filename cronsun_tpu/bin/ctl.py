@@ -535,6 +535,94 @@ def cmd_configurations(api, args):
     print(json.dumps(api.call("GET", "/v1/configurations"), indent=2))
 
 
+def cmd_checkpoint_compact(api, args):
+    """Offline delta-chain compaction: fold every sched.ckpt.d<seq>
+    beside the base into ONE element (direct filesystem access, not the
+    web API).  Run against a QUIESCED checkpoint dir — compacting under
+    a live scheduler makes its next delta a seq gap (which a restore
+    then refuses, loudly)."""
+    del api
+    import os as _os
+    from ..checkpoint.sched_ckpt import (CheckpointError, FILE_NAME,
+                                         compact_delta_chain)
+    path = args.path
+    if _os.path.isdir(path):
+        path = _os.path.join(path, FILE_NAME)
+    try:
+        out = compact_delta_chain(path)
+    except (CheckpointError, OSError) as e:
+        # refusals (torn/gapped/foreign chains, missing base) exit
+        # cleanly with the files untouched — protection is not a crash
+        raise SystemExit(f"error: {e}")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    if not out["compacted"]:
+        print(f"nothing to compact ({out['folded']} chain element(s) "
+              f"at {path})")
+        return
+    print(f"compacted {out['folded']} delta elements -> 1 "
+          f"({out['events']} events, chain tip rev {out['rev']})")
+
+
+# ---------------------------------------------------------------------------
+# workflow DAG views
+# ---------------------------------------------------------------------------
+
+def cmd_dag_show(api, args):
+    """Render the group's dependency graph: topological order, each
+    job's upstreams, misfire policy and in-flight cap, plus broken
+    references (missing upstreams)."""
+    out = api.call("GET", f"/v1/dag/{urllib.parse.quote(args.group)}")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    if not out["jobs"]:
+        print(f"group {args.group!r} has no dep-triggered jobs")
+        return
+    rows = []
+    for j in out["jobs"]:
+        d = j.get("deps") or {}
+        rows.append([
+            j["id"], j.get("name", ""),
+            "paused" if j.get("pause") else "",
+            " ".join(d.get("on") or []) or "(time-triggered)",
+            d.get("misfire", ""),
+            d.get("max_in_flight") or "",
+        ])
+    table(rows, ["JOB", "NAME", "STATE", "UPSTREAMS", "MISFIRE",
+                 "MAX-IN-FLIGHT"])
+    if out.get("missing"):
+        print("\nBROKEN upstream references (dependents hold, never "
+              "fire):")
+        for dep_id, ups in sorted(out["missing"].items()):
+            print(f"  {dep_id} -> missing {', '.join(ups)}")
+
+
+def cmd_dag_runs(api, args):
+    """Latest completed round + in-flight executions per job of the
+    group's DAG — the chain's live state (reads the dep/ completion
+    keys and the proc registry)."""
+    out = api.call("GET",
+                   f"/v1/dag/{urllib.parse.quote(args.group)}/runs")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    if not out["jobs"]:
+        print(f"group {args.group!r} has no dep-triggered jobs")
+        return
+    rows = []
+    for j in out["jobs"]:
+        rows.append([
+            j["id"],
+            "dep" if j.get("deps") else "time",
+            ts(j.get("last_epoch")) or "(never)",
+            j.get("last_status", ""),
+            j.get("in_flight", 0),
+        ])
+    table(rows, ["JOB", "TRIGGER", "LAST ROUND", "RESULT", "IN-FLIGHT"])
+
+
 def cmd_logd_reshard(api, args):
     """Result-plane resharding escape hatch: record ids encode the
     shard count (raw * N + shard), so changing N is a dump/rehash/load
@@ -727,8 +815,24 @@ def build_parser() -> argparse.ArgumentParser:
     add("metrics", cmd_metrics, "Prometheus metrics text")
     add("checkpoint", cmd_checkpoint,
         "trigger store WAL snapshot + scheduler checkpoints (admin)")
+    p = add("checkpoint-compact", cmd_checkpoint_compact,
+            "fold a scheduler checkpoint's delta chain into one element "
+            "(offline; direct file access)")
+    p.add_argument("path", help="checkpoint dir or sched.ckpt path")
     add("configurations", cmd_configurations,
         "security/alarm config exposed to the UI")
+
+    dag = sub.add_parser("dag", help="workflow DAG views")
+    dsub = dag.add_subparsers(dest="dagcmd", required=True)
+    p = dsub.add_parser("show",
+                        help="dependency graph of a group (topo order, "
+                             "policies, broken refs)")
+    p.set_defaults(fn=cmd_dag_show)
+    p.add_argument("group")
+    p = dsub.add_parser("runs",
+                        help="latest round + in-flight state per DAG job")
+    p.set_defaults(fn=cmd_dag_runs)
+    p.add_argument("group")
 
     p = add("logd-reshard", cmd_logd_reshard,
             "dump/rehash/load the result store into a new shard count "

@@ -23,8 +23,9 @@ checkpoint-and-restore shape every training stack relies on:
 """
 
 from .sched_ckpt import (  # noqa: F401
-    CheckpointError, clear_delta_chain, list_delta_seqs,
-    load_checkpoint, load_delta_chain, save_checkpoint, save_delta)
+    CheckpointError, clear_delta_chain, compact_delta_chain,
+    list_delta_seqs, load_checkpoint, load_delta_chain, save_checkpoint,
+    save_delta)
 from .walsnap import (  # noqa: F401
     SnapshotCorrupt, WalFile, read_records, rotated_path, snap_path,
     write_snapshot)

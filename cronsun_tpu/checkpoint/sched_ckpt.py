@@ -72,24 +72,33 @@ def pack_jobs(jobs: dict) -> list:
             (key,
              (j.id, j.name, j.group, j.command, j.user, j.pause,
               j.timeout, j.parallels, j.retry, j.interval, j.kind,
-              j.avg_time, j.fail_notify, j.to),
+              j.avg_time, j.fail_notify, j.to,
+              # deps ride as (on, misfire, max_in_flight) or None —
+              # positional like every other column
+              None if j.deps is None
+              else (j.deps.on, j.deps.misfire, j.deps.max_in_flight)),
              [(r.id, r.timer, r.gids, r.nids, r.exclude_nids)
               for r in j.rules])
             for key, j in jobs.items()]
 
 
 def unpack_jobs(packed: list) -> dict:
-    from ..core.models import Job, JobRule
+    from ..core.models import DepSpec, Job, JobRule
     out = {}
     with gc_paused():
         for key, f, rules in packed:
+            # pre-DAG checkpoints packed 14 columns; deps default None
+            d = f[14] if len(f) > 14 else None
             out[tuple(key)] = Job(
                 id=f[0], name=f[1], group=f[2], command=f[3], user=f[4],
                 rules=[JobRule(id=r[0], timer=r[1], gids=r[2], nids=r[3],
                                exclude_nids=r[4]) for r in rules],
                 pause=f[5], timeout=f[6], parallels=f[7], retry=f[8],
                 interval=f[9], kind=f[10], avg_time=f[11],
-                fail_notify=f[12], to=f[13])
+                fail_notify=f[12], to=f[13],
+                deps=None if d is None
+                else DepSpec(on=list(d[0]), misfire=d[1],
+                             max_in_flight=d[2]))
     return out
 
 
@@ -287,3 +296,59 @@ def clear_delta_chain(base_path: str) -> None:
             os.remove(delta_path(base_path, seq))
         except OSError:
             pass
+
+
+def compact_delta_chain(base_path: str) -> dict:
+    """OFFLINE chain compaction: fold every ``FILE.d<seq>`` element into
+    ONE (``cronsun-ctl checkpoint-compact``) — a long chain rebases
+    without the O(state) full save the scheduler thread would otherwise
+    pay, and the next restore folds one element instead of N.
+
+    The chain validates WHOLE first with the same strictness a restore
+    applies (:func:`load_delta_chain`): torn elements, seq gaps, foreign
+    nonces and rev mismatches all refuse with :class:`CheckpointError`
+    and leave the files untouched.  Event order is preserved exactly —
+    the combined element is the concatenation in fold order, so base +
+    combined reproduces base + chain.
+
+    Crash-safe by the same prefix argument as the saver: the combined
+    element writes to a temp file first; stale elements unlink in
+    DESCENDING seq order (every intermediate crash leaves a contiguous,
+    still-valid — merely shorter — old chain); the final atomic rename
+    over ``.d1`` publishes the compacted chain.
+
+    OFFLINE means offline: a LIVE scheduler extending this chain keeps
+    its next seq in memory — compacting under it makes the live
+    scheduler's next delta a seq gap, which a restore then refuses
+    (loudly, cold load).  Run it against a quiesced checkpoint dir.
+    """
+    st = load_checkpoint(base_path)
+    deltas = load_delta_chain(base_path, st)
+    if len(deltas) <= 1:
+        return {"folded": len(deltas), "events": 0,
+                "rev": (deltas[-1]["rev"] if deltas else st.get("rev")),
+                "compacted": False}
+    events: list = []
+    for d in deltas:
+        events.extend(d["events"])
+    rec = dict(version=FORMAT_VERSION, kind="delta",
+               chain=st["chain"], seq=1, prev_rev=st.get("rev"),
+               rev=deltas[-1]["rev"], events=events)
+    d1 = delta_path(base_path, 1)
+    tmp = d1 + ".ctmp"
+    try:
+        with open(tmp, "wb") as f, gc_paused():
+            pickle.dump(rec, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fdatasync(f.fileno())
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    for d in reversed(deltas[1:]):
+        os.remove(delta_path(base_path, d["seq"]))
+    os.replace(tmp, d1)
+    return {"folded": len(deltas), "events": len(events),
+            "rev": rec["rev"], "compacted": True}

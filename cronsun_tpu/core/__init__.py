@@ -11,5 +11,6 @@ from .errors import (  # noqa: F401
 from .ids import next_id  # noqa: F401
 from .keyspace import Keyspace  # noqa: F401
 from .models import (  # noqa: F401
-    Account, Group, Job, JobRule, KIND_ALONE, KIND_COMMON, KIND_INTERVAL,
-    Node, ROLE_ADMIN, ROLE_DEVELOPER)
+    Account, DepSpec, Group, Job, JobRule, KIND_ALONE, KIND_COMMON,
+    KIND_INTERVAL, MAX_DEPS, MISFIRE_POLICIES, Node, ROLE_ADMIN,
+    ROLE_DEVELOPER, validate_dag)

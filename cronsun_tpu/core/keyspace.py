@@ -129,6 +129,21 @@ class Keyspace:
     def phase_key(self, group: str, job_id: str, rule_id: str) -> str:
         return f"{self.phase}{group}/{job_id}/{rule_id}"
 
+    @property
+    def dep(self) -> str:
+        """Workflow DAG completion events: one persistent key per job,
+        last completed round.  Agents write it at execution end; the
+        scheduler watches the prefix and folds the events into the
+        on-device success-epoch vectors (the dep-trigger edge signal)."""
+        return f"{self.prefix}/dep/"
+
+    def dep_key(self, group: str, job_id: str) -> str:
+        """Value wire format: ``"<scheduled epoch>|ok"`` or ``"...|fail"``
+        — the SCHEDULED second, not completion wall time, so every node
+        of a Common fan-out writes the same value for one round
+        (last-write-wins is idempotent per round)."""
+        return f"{self.dep}{group}/{job_id}"
+
     def proc_key(self, node_id: str, group: str, job_id: str, pid) -> str:
         return f"{self.proc}{node_id}/{group}/{job_id}/{pid}"
 

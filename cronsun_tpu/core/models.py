@@ -23,9 +23,115 @@ KIND_INTERVAL = 2  # at most one start per schedule interval
 ROLE_ADMIN = 1
 ROLE_DEVELOPER = 2
 
+# Workflow DAG plane: a dep-triggered job names up to MAX_DEPS upstream
+# jobs; the on-device dependency matrix is padded to this width
+# (ops/schedule_table.py stores one [capacity, MAX_DEPS] column block).
+MAX_DEPS = 8
+
+MISFIRE_SKIP = "skip"    # a failed upstream round is consumed, no fire
+MISFIRE_FIRE = "fire"    # fire anyway on upstream failure
+MISFIRE_HOLD = "hold"    # wait until every upstream's latest run succeeds
+MISFIRE_POLICIES = (MISFIRE_SKIP, MISFIRE_FIRE, MISFIRE_HOLD)
+
+# Rules of dep-triggered jobs carry this sentinel timer: placement
+# (nids/gids/exclude) still comes from the rule, but the trigger is the
+# upstream success-epoch test in the batched tick, not a cron mask.
+DEP_TIMER = "@dep"
+
 
 def _clean(s: Optional[str]) -> str:
     return (s or "").strip()
+
+
+@dataclasses.dataclass
+class DepSpec:
+    """Workflow dependency spec: the job fires when the latest run of
+    EVERY upstream job (same group) succeeds after this job's last fire.
+
+    ``misfire`` picks the behaviour when an upstream's latest round
+    FAILED (see MISFIRE_*); ``max_in_flight`` caps concurrently running
+    executions of this job (0 = unlimited) — a saturated job holds its
+    fire until a slot frees."""
+    on: List[str] = dataclasses.field(default_factory=list)
+    misfire: str = MISFIRE_SKIP
+    max_in_flight: int = 0
+
+    def validate(self):
+        self.on = [_clean(u) for u in self.on]
+        if not self.on:
+            raise ValidationError("deps.on must name at least one "
+                                  "upstream job id")
+        if len(self.on) > MAX_DEPS:
+            raise ValidationError(
+                f"deps.on lists {len(self.on)} upstreams; the dependency "
+                f"matrix is padded to {MAX_DEPS} columns per job")
+        seen = set()
+        for u in self.on:
+            if not u:
+                raise ValidationError("deps.on contains an empty job id")
+            if "/" in u:
+                raise ValidationError(
+                    f"cross-group dep reference {u!r}: dependencies "
+                    "resolve within the job's own group only")
+            if u in seen:
+                raise ValidationError(f"duplicate upstream {u!r} in deps.on")
+            seen.add(u)
+        self.misfire = _clean(self.misfire) or MISFIRE_SKIP
+        if self.misfire not in MISFIRE_POLICIES:
+            raise ValidationError(
+                f"unknown misfire policy {self.misfire!r} "
+                f"(one of {', '.join(MISFIRE_POLICIES)})")
+        if self.max_in_flight < 0:
+            raise ValidationError("deps.max_in_flight must be >= 0")
+
+    def to_dict(self) -> dict:
+        return {"on": self.on, "misfire": self.misfire,
+                "max_in_flight": self.max_in_flight}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DepSpec":
+        return cls(on=list(d.get("on") or []),
+                   misfire=d.get("misfire", MISFIRE_SKIP),
+                   max_in_flight=int(d.get("max_in_flight") or 0))
+
+
+def validate_dag(dep_map: dict, job_ids, root: str):
+    """Group-level DAG validation for one (changed) job: every upstream
+    reachable from ``root`` must exist in ``job_ids`` and the walk must
+    not revisit ``root`` or any node on the current path (a cycle).
+
+    ``dep_map`` is {job_id: [upstream ids]} for the whole group WITH the
+    changed job's new deps substituted; pure host code so the web tier
+    can run it at ``set_job`` without importing the device stack."""
+    path: List[str] = []
+    on_path = set()
+    done = set()   # fully-validated subtrees: each node expands ONCE,
+    #                or diamonds of shared substructure go exponential
+
+    def walk(jid: str):
+        if jid in done:
+            return
+        if jid in on_path:
+            cyc = path[path.index(jid):] + [jid]
+            raise ValidationError(
+                "dependency cycle: " + " -> ".join(cyc))
+        ups = dep_map.get(jid)
+        if not ups:
+            done.add(jid)
+            return
+        on_path.add(jid)
+        path.append(jid)
+        for u in ups:
+            if u not in job_ids:
+                raise ValidationError(
+                    f"unknown upstream job {u!r} (dep of {jid!r}; "
+                    "dependencies resolve within the job's group)")
+            walk(u)
+        path.pop()
+        on_path.discard(jid)
+        done.add(jid)
+
+    walk(root)
 
 
 @dataclasses.dataclass
@@ -38,8 +144,21 @@ class JobRule:
     nids: List[str] = dataclasses.field(default_factory=list)
     exclude_nids: List[str] = dataclasses.field(default_factory=list)
 
-    def validate(self):
+    def validate(self, dep_triggered: bool = False):
         self.timer = _clean(self.timer)
+        if dep_triggered:
+            # dep-triggered jobs: the rule is placement-only; the timer
+            # is pinned to the sentinel (an empty timer normalizes)
+            if self.timer not in ("", DEP_TIMER):
+                raise ValidationError(
+                    f"rule timer {self.timer!r} conflicts with the "
+                    "deps spec: dep-triggered jobs use timer "
+                    f"{DEP_TIMER!r} (or omit it)")
+            self.timer = DEP_TIMER
+            return
+        if self.timer == DEP_TIMER:
+            raise ValidationError(
+                f"timer {DEP_TIMER!r} requires a deps spec on the job")
         if not self.timer:
             raise ValidationError("rule timer required")
         try:
@@ -77,6 +196,9 @@ class Job:
     avg_time: float = 0.0       # EWMA execution seconds (job.go:581-589)
     fail_notify: bool = False
     to: List[str] = dataclasses.field(default_factory=list)
+    # workflow DAG trigger: when set, the job fires on upstream success
+    # instead of a cron mask (rules keep carrying placement)
+    deps: Optional[DepSpec] = None
 
     # ---- validation (reference job.go:502-537) ---------------------------
 
@@ -100,9 +222,21 @@ class Job:
             raise ValidationError(f"unknown kind {self.kind}")
         if not _clean(self.command):
             raise ValidationError("command required")
+        if isinstance(self.deps, dict):
+            self.deps = DepSpec.from_dict(self.deps)
+        if self.deps is not None:
+            self.deps.validate()
+            if self.id in self.deps.on:
+                raise ValidationError(
+                    f"job {self.id!r} cannot depend on itself")
+        dep_triggered = self.deps is not None
+        if dep_triggered and not self.rules:
+            raise ValidationError(
+                "dep-triggered jobs need at least one rule for "
+                "placement (nids/gids)")
         for rule in self.rules:
             rule.id = _clean(rule.id) or next_id()
-            rule.validate()
+            rule.validate(dep_triggered=dep_triggered)
 
     def security_valid(self, security) -> None:
         """Reject commands/users outside the policy (reference
@@ -133,6 +267,9 @@ class Job:
         d = dataclasses.asdict(self)
         d["rules"] = [r.to_dict() if isinstance(r, JobRule) else r
                       for r in self.rules]
+        if self.deps is None:
+            # wire compat: dep-less jobs serialize exactly as before
+            d.pop("deps", None)
         return json.dumps(d, separators=(",", ":"))
 
     _FIELDS = None   # lazily cached field-name set (NOT annotated: an
@@ -142,14 +279,20 @@ class Job:
     def from_json(cls, s: str) -> "Job":
         d = json.loads(s)
         rules = [JobRule.from_dict(r) for r in d.get("rules") or []]
+        deps = d.get("deps")
+        if isinstance(deps, dict) and deps.get("on"):
+            deps = DepSpec.from_dict(deps)
+        else:
+            deps = None
         known = cls._FIELDS
         if known is None:
             # cached: dataclasses.fields() introspection per document
             # was a measured slice of the 1M-job cold load
             known = frozenset(f.name for f in dataclasses.fields(cls))
             cls._FIELDS = known
-        kw = {k: v for k, v in d.items() if k in known and k != "rules"}
-        return cls(rules=rules, **kw)
+        kw = {k: v for k, v in d.items()
+              if k in known and k not in ("rules", "deps")}
+        return cls(rules=rules, deps=deps, **kw)
 
 
 @dataclasses.dataclass

@@ -94,7 +94,8 @@ class NodeAgent:
                  lock_ttl: float = 300.0, proc_req: float = 0.0,
                  executor: Optional[Executor] = None,
                  clock: Callable[[], float] = time.time,
-                 on_fatal: Optional[Callable] = None):
+                 on_fatal: Optional[Callable] = None,
+                 dep_events: bool = True):
         self.store = store
         self.sink = sink
         self.ks = ks or Keyspace()
@@ -103,6 +104,10 @@ class NodeAgent:
         self.proc_ttl = proc_ttl
         self.lock_ttl = lock_ttl
         self.proc_req = proc_req   # short-run suppression (proc.go:218-236)
+        # workflow DAG edge signal: publish one dep/ completion key per
+        # finished round (value = the SCHEDULED epoch + outcome, so every
+        # node of a Common fan-out writes the same round idempotently)
+        self.dep_events = dep_events
         self.executor = executor or Executor()
         self.clock = clock
         self.on_fatal = on_fatal
@@ -233,7 +238,8 @@ class NodeAgent:
                       "execs_failed_total": 0, "watch_losses_total": 0,
                       "ack_flush_total": 0, "ack_flush_orders_total": 0,
                       "rec_flush_total": 0, "rec_flush_records_total": 0,
-                      "rec_dropped_total": 0}
+                      "rec_dropped_total": 0, "dep_events_total": 0,
+                      "dep_event_failures_total": 0}
         self._stats_mu = threading.Lock()
         # scheduled-second -> exec-start lag samples (the end-to-end
         # dispatch SLA), published as p50/p99 in the metrics snapshot
@@ -610,7 +616,7 @@ class NodeAgent:
                 stop.set()
                 self.store.revoke(lease)   # deletes the alone lock key
             consume_order()                # consume the order regardless
-        self._record(job, res)
+        self._record(job, res, epoch_s)
         self._update_avg_time(job, res)
 
     _FENCE_GRACE = 60.0
@@ -853,12 +859,28 @@ class NodeAgent:
             if self.store.put_if_mod_rev(key, cur.to_json(), kv.mod_rev):
                 return
 
-    def _record(self, job: Job, res: ExecResult):
+    def _record(self, job: Job, res: ExecResult, epoch_s: int = 0):
         if res.skipped:
             return
         self._bump("execs_total")
         if not res.success:
             self._bump("execs_failed_total")
+        if self.dep_events and epoch_s:
+            # the workflow DAG edge signal: last-write-wins per job, the
+            # value carries the SCHEDULED round so N Common nodes
+            # completing one round write one idempotent value (the
+            # scheduler's fold is a monotone max on it).  Best-effort —
+            # a store outage here must not fail the execution path; the
+            # round re-announces on the job's next completion.
+            try:
+                self.store.put(
+                    self.ks.dep_key(job.group, job.id),
+                    f"{int(epoch_s)}|{'ok' if res.success else 'fail'}")
+                self._bump("dep_events_total")
+            except Exception as e:  # noqa: BLE001 — degraded, not down
+                self._bump("dep_event_failures_total")
+                log.warnf("dep completion event for %s/%s failed: %s",
+                          job.group, job.id, e)
         rec = LogRecord(
             job_id=job.id, job_group=job.group, name=job.name, node=self.id,
             user=job.user, command=job.command,

@@ -1,0 +1,164 @@
+"""Workflow DAG plane: dependency-trigger evaluation for the batched tick.
+
+A dep-triggered job "fires the tick after ALL upstream columns' success
+epochs pass its own last-fire epoch".  The upstream references live as a
+CSR-style padded column block in the packed :class:`ScheduleTable`
+(``dep_cols`` [J, MAX_DEPS], see ops/schedule_table.py); the mutable
+per-row state lives beside the planner's load/capacity vectors:
+
+- ``succ``/``fail`` [J] int32 — latest completed round's SCHEDULED epoch
+  (framework-relative) per outcome, folded from the store's ``dep/``
+  completion events by the scheduler (monotone max, so multi-node
+  Common completions and replayed watch events are idempotent);
+- ``last_fire`` [J] int32 — the epoch this dep row last fired (or
+  consumed a skipped round); carried THROUGH the window scan so a row
+  fires once per upstream round, not once per window second;
+- ``block`` [J] bool — host-computed max_in_flight saturation gate.
+
+:func:`dep_ready` is one masked gather + compare over the padded block —
+it composes into the planner's fused window scan (ops/planner.py) as a
+handful of elementwise ops per second, no graph walk, and is compiled
+OUT entirely (``use_deps`` static arg) while no dep rows exist, keeping
+dep-free tables bit-identical to the pre-DAG program.
+
+Misfire semantics per upstream round (``dep_policy``):
+
+- POLICY_FIRE: any completed round (success or failure) satisfies;
+- POLICY_HOLD: only success satisfies — a failed round parks the job
+  until a later success arrives;
+- POLICY_SKIP (default): a round where every upstream completed but at
+  least one upstream's LATEST outcome is a failure is CONSUMED
+  (last_fire advances, no fire) — the chain re-arms on the next round.
+
+A round whose scheduled epoch predates the downstream's last fire
+coalesces into it (epochs are compared, not counted): upstreams that
+complete slower than they are scheduled collapse their backlog into one
+downstream fire.
+
+:class:`ReferenceDagEvaluator` is the pure-Python spec of the same
+semantics, used by the randomized differential test in tests/test_dag.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+import numpy as np
+
+from .schedule_table import DEP_EMPTY
+
+POLICY_SKIP = 0
+POLICY_FIRE = 1
+POLICY_HOLD = 2
+
+POLICY_BY_NAME = {"skip": POLICY_SKIP, "fire": POLICY_FIRE,
+                  "hold": POLICY_HOLD}
+POLICY_NAMES = {v: k for k, v in POLICY_BY_NAME.items()}
+
+# "never completed" sentinel for the success/fail epoch vectors: below
+# any real framework-relative epoch and any last_fire anchor
+NEVER = int(np.iinfo(np.int32).min)
+
+
+def dep_ready(table, succ, fail, block, last_fire):
+    """[J] dep-trigger decisions at one instant: ``(fire, consume,
+    round_max)``.
+
+    Pure jnp — traced inside the planner's jitted window scan.  A slot is
+    satisfied when it is padding (DEP_EMPTY) or its upstream's epoch
+    passed ``last_fire``; DEP_BROKEN slots never satisfy.  ``consume``
+    marks POLICY_SKIP rows whose round completed with a failure: the
+    caller advances last_fire without firing.  ``round_max`` is the
+    newest upstream epoch the decision consumed: the caller advances
+    last_fire to ``max(tick, round_max)`` so a round whose scheduled
+    epoch runs AHEAD of the firing tick (clock skew, compressed virtual
+    time) is consumed whole instead of re-satisfying every later tick —
+    one fire per visible backlog, never one per window."""
+    import jax.numpy as jnp
+    cols = table.dep_cols                           # [J, D]
+    valid = cols >= 0
+    up = jnp.maximum(cols, 0)
+    s = succ[up]                                    # [J, D]
+    f = fail[up]
+    latest = jnp.maximum(s, f)
+    lf = last_fire[:, None]
+    pad_ok = cols == DEP_EMPTY                      # DEP_BROKEN stays False
+    sat_succ = jnp.where(valid, s > lf, pad_ok)
+    sat_any = jnp.where(valid, latest > lf, pad_ok)
+    all_succ = jnp.all(sat_succ, axis=1)
+    all_any = jnp.all(sat_any, axis=1)
+    # an upstream's round "ended in failure" iff its latest outcome is a
+    # failure newer than both our last fire and its own latest success
+    has_fail = jnp.any(valid & (f > lf) & (f > s), axis=1)
+    live = (table.has_dep & jnp.any(valid, axis=1)
+            & table.active & ~table.paused & ~block)
+    pol = table.dep_policy
+    fire = jnp.where(pol == POLICY_FIRE, all_any,
+                     jnp.where(pol == POLICY_HOLD, all_succ,
+                               all_any & ~has_fail))
+    consume = (pol == POLICY_SKIP) & all_any & has_fail
+    round_max = jnp.max(jnp.where(valid, latest, NEVER), axis=1)
+    return fire & live, consume & live, round_max
+
+
+class ReferenceDagEvaluator:
+    """Pure-Python reference of the dep-trigger semantics (the
+    differential-test oracle and the plain-language spec).
+
+    ``deps``: {row: (upstream_cols, policy)} where upstream_cols entries
+    are table rows or DEP_BROKEN; rows absent from ``deps`` never
+    dep-fire.  Epoch state mirrors the device vectors."""
+
+    def __init__(self, deps: Dict[int, Tuple[List[int], int]],
+                 last_fire: Dict[int, int] = None):
+        self.deps = {r: (list(c), p) for r, (c, p) in deps.items()}
+        self.succ: Dict[int, int] = {}
+        self.fail: Dict[int, int] = {}
+        self.last_fire: Dict[int, int] = dict(last_fire or {})
+        self.blocked: Set[int] = set()
+
+    def complete(self, row: int, epoch: int, ok: bool):
+        """Fold one completion event (monotone max, like the device)."""
+        d = self.succ if ok else self.fail
+        d[row] = max(d.get(row, NEVER), epoch)
+
+    def tick(self, t: int, live_rows: Iterable[int] = None) -> List[int]:
+        """Dep fires at instant ``t`` (sorted rows); advances last_fire
+        for fires AND consumed skip-policy rounds."""
+        PF, PH, PS = POLICY_FIRE, POLICY_HOLD, POLICY_SKIP
+        fired = []
+        for row, (cols, pol) in sorted(self.deps.items()):
+            if live_rows is not None and row not in live_rows:
+                continue
+            if row in self.blocked or not cols:
+                continue
+            lf = self.last_fire.get(row, 0)
+            sat_succ = sat_any = True
+            has_fail = False
+            round_max = NEVER
+            for c in cols:
+                if c == DEP_EMPTY:
+                    continue
+                if c < 0:                       # DEP_BROKEN
+                    sat_succ = sat_any = False
+                    break
+                s = self.succ.get(c, NEVER)
+                f = self.fail.get(c, NEVER)
+                sat_succ &= s > lf
+                sat_any &= max(s, f) > lf
+                has_fail |= f > lf and f > s
+                round_max = max(round_max, s, f)
+            if pol == PF:
+                fire, consume = sat_any, False
+            elif pol == PH:
+                fire, consume = sat_succ, False
+            else:
+                assert pol == PS
+                fire = sat_any and not has_fail
+                consume = sat_any and has_fail
+            if fire:
+                fired.append(row)
+            if fire or consume:
+                # consume the whole visible backlog (see dep_ready)
+                self.last_fire[row] = max(t, round_max)
+        return fired

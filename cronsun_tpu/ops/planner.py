@@ -80,11 +80,13 @@ def _compact(fire: jax.Array, k: int):
     return jnp.where(valid, idx, 0), valid, total
 
 
-@partial(jax.jit, static_argnames=("kx", "kc", "rounds", "impl"),
-         donate_argnames=("load", "rem_cap"))
+@partial(jax.jit, static_argnames=("kx", "kc", "rounds", "impl",
+                                   "use_deps"),
+         donate_argnames=("load", "rem_cap", "dep_last_fire"))
 def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
-                      load, rem_cap, kx: int, kc: int, rounds: int,
-                      impl: str):
+                      load, rem_cap, dep_succ, dep_fail, dep_block,
+                      dep_last_fire, kx: int, kc: int, rounds: int,
+                      impl: str, use_deps: bool):
     """W seconds in one dispatch: lax.scan over the window, exactly the
     semantics of W consecutive single ticks (load/capacity carry through),
     but one dispatch + one fetch — the host round-trip amortizes over the
@@ -98,9 +100,16 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     - fired jobs compact into SEPARATE buckets by kind: only exclusive
       fires (bucket kx) pay the ``rounds``x [K, N] bid sweep; Common
       fires (bucket kc) need exactly one fan-out pass for their load.
-    """
+
+    ``use_deps`` (static) folds the workflow-DAG trigger into the same
+    scan: per second, one masked gather over the padded dep matrix ORs
+    dep fires into the time fires, and the carried ``dep_last_fire``
+    advances so a row fires once per upstream round.  False compiles the
+    dep ops OUT — a dep-free table runs the exact pre-DAG program (the
+    differential test pins bit-identity)."""
     from .tick import _fire_mask_jit
     cols = [fields_w[:, i] for i in range(7)]
+    t_rel_w = fields_w[:, 6]
     with jax.named_scope("cronsun.fire_mask"):
         fire_w = _fire_mask_jit(table, *cols)              # [J, W]
 
@@ -110,8 +119,21 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     n_cols = elig.shape[1] * 32
     adt = jnp.int16 if n_cols <= 32767 else jnp.int32
 
-    def body(carry, fire_col):
-        load, rem_cap = carry
+    def body(carry, xs):
+        load, rem_cap, last_fire = carry
+        fire_col, t_rel = xs
+        if use_deps:
+            with jax.named_scope("cronsun.deps"):
+                from .deps import dep_ready
+                dep_f, dep_consume, round_max = dep_ready(
+                    table, dep_succ, dep_fail, dep_block, last_fire)
+                fire_col = fire_col | dep_f
+                # advance to the newest consumed upstream epoch, not
+                # just the tick: a round scheduled ahead of the firing
+                # tick must not re-satisfy the next window
+                last_fire = jnp.where(
+                    dep_f | dep_consume,
+                    jnp.maximum(t_rel, round_max), last_fire)
         with jax.named_scope("cronsun.compact"):
             xidx, xvalid, xtotal = _compact(fire_col & exclusive, kx)
             cidx, cvalid, ctotal = _compact(fire_col & ~exclusive, kc)
@@ -123,11 +145,12 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
         out32 = jnp.concatenate([
             jnp.asarray([xtotal, ctotal], jnp.int32),
             xidx, cidx])                               # [2 + kx + kc]
-        return (load, rem_cap), (out32, assigned.astype(adt))
+        return (load, rem_cap, last_fire), (out32, assigned.astype(adt))
 
-    (load, rem_cap), (outs32, outs16) = \
-        jax.lax.scan(body, (load, rem_cap), fire_w.T)
-    return outs32, outs16, load, rem_cap
+    (load, rem_cap, dep_last_fire), (outs32, outs16) = \
+        jax.lax.scan(body, (load, rem_cap, dep_last_fire),
+                     (fire_w.T, t_rel_w))
+    return outs32, outs16, load, rem_cap, dep_last_fire
 
 
 class _AdaptiveBucket:
@@ -250,6 +273,18 @@ class TickPlanner:
         self.cost = jnp.ones(self.J, jnp.float32)
         self.load = jnp.zeros(self.N, jnp.float32)
         self.rem_cap = jnp.zeros(self.N, jnp.int32)   # dead columns stay 0
+        # workflow DAG state: per-row latest-round epochs (monotone max
+        # fold of dep/ completion events), the last-fire vector the scan
+        # carries, and the host-computed max_in_flight gate.  The dep
+        # ops stay compiled OUT (use_deps static arg) until the
+        # scheduler installs the first dep row — dep-free tables run the
+        # exact pre-DAG program.
+        from .deps import NEVER
+        self.dep_succ = jnp.full(self.J, NEVER, jnp.int32)
+        self.dep_fail = jnp.full(self.J, NEVER, jnp.int32)
+        self.dep_last_fire = jnp.zeros(self.J, jnp.int32)
+        self.dep_block = jnp.zeros(self.J, bool)
+        self._dep_enabled = False
         # Adaptive fired-buckets (one per kind — exclusive fires pay the
         # bid rounds, Common fires only the fan-out): sized from the last
         # observed fire count so quiet tables don't pay the max-SLA solve.
@@ -301,6 +336,67 @@ class TickPlanner:
             c = jnp.asarray(np.asarray(cols, np.int32))
             self.rem_cap = self.rem_cap.at[c].set(
                 jnp.asarray(np.asarray(caps, np.int32)))
+
+    # -- workflow DAG state (scheduler-driven scatters) --------------------
+
+    @property
+    def dep_enabled(self) -> bool:
+        return self._dep_enabled
+
+    def set_dep_enabled(self, flag: bool = True):
+        """Arm (or disarm) the dep ops in the plan program.  Flipping
+        recompiles the window executable once (a static jit arg) — the
+        scheduler arms it when the first dep row lands and leaves it on
+        (disarming mid-flight would churn executables for no win)."""
+        self._dep_enabled = bool(flag)
+
+    def set_dep_epochs(self, rows, succ, fail):
+        """Fold completion-round epochs into the per-row vectors —
+        MONOTONE max, so duplicate watch deliveries, multi-node Common
+        completions of one round and pad_pow2's repeated rows are all
+        idempotent."""
+        if len(rows):
+            r = jnp.asarray(np.asarray(rows, np.int32))
+            self.dep_succ = self.dep_succ.at[r].max(
+                jnp.asarray(np.asarray(succ, np.int32)))
+            self.dep_fail = self.dep_fail.at[r].max(
+                jnp.asarray(np.asarray(fail, np.int32)))
+
+    def reset_dep_rows(self, rows, last_fire_rel=0):
+        """Row (re)initialization: epochs back to NEVER and last_fire to
+        the registration anchor (a fresh dep row only reacts to upstream
+        rounds NEWER than its registration — an upstream success from an
+        hour ago must not fire a just-created chain)."""
+        if len(rows):
+            from .deps import NEVER
+            r = jnp.asarray(np.asarray(rows, np.int32))
+            self.dep_succ = self.dep_succ.at[r].set(NEVER)
+            self.dep_fail = self.dep_fail.at[r].set(NEVER)
+            self.dep_last_fire = self.dep_last_fire.at[r].set(
+                jnp.asarray(np.asarray(last_fire_rel, np.int32)))
+            self.dep_block = self.dep_block.at[r].set(False)
+
+    def set_dep_block(self, rows, vals):
+        """max_in_flight saturation gate (host-computed per step)."""
+        if len(rows):
+            r = jnp.asarray(np.asarray(rows, np.int32))
+            self.dep_block = self.dep_block.at[r].set(
+                jnp.asarray(np.asarray(vals, bool)))
+
+    def dep_state(self) -> dict:
+        """Host copies of the mutable dep vectors (checkpoint capture)."""
+        return dict(succ=np.asarray(self.dep_succ),
+                    fail=np.asarray(self.dep_fail),
+                    last_fire=np.asarray(self.dep_last_fire),
+                    block=np.asarray(self.dep_block))
+
+    def set_dep_state(self, succ, fail, last_fire, block):
+        """Install checkpointed dep vectors whole (restore path)."""
+        self.dep_succ = jnp.asarray(np.asarray(succ, np.int32))
+        self.dep_fail = jnp.asarray(np.asarray(fail, np.int32))
+        self.dep_last_fire = jnp.asarray(
+            np.asarray(last_fire, np.int32))
+        self.dep_block = jnp.asarray(np.asarray(block, bool))
 
     def job_finished(self, node_col: int, cost: float):
         """Exclusive execution completed: release the capacity slot the
@@ -368,18 +464,23 @@ class TickPlanner:
             np.arange(window_s, dtype=np.int64) + (epoch_s - FRAMEWORK_EPOCH),
         ], axis=1).astype(np.int32)                     # [W, 7]
         with jax.profiler.TraceAnnotation("cronsun.plan.dispatch"):
-            # + 0.0 / | 0: the jit donates its load/rem_cap args, and
-            # the dispatch may run on the scheduler's dispatch thread
-            # while the step thread scatters capacity/load updates onto
-            # the SAME buffers — donating the live buffer would leave
-            # the step holding a deleted one.  Donating a fresh copy
-            # costs two [N] ops; a concurrently-landing scatter can at
-            # worst be lost for one window, and the scheduler's
-            # reconcile rewrites load/capacity absolutely every step.
-            outs32, outs16, self.load, self.rem_cap = _plan_window_step(
-                self.table, jnp.asarray(fields_w),
-                self.elig, self.exclusive, self.cost, self.load + 0.0,
-                self.rem_cap | 0, kx, kc, self.rounds, impl)
+            # + 0.0 / | 0: the jit donates its load/rem_cap/last_fire
+            # args, and the dispatch may run on the scheduler's dispatch
+            # thread while the step thread scatters capacity/load
+            # updates onto the SAME buffers — donating the live buffer
+            # would leave the step holding a deleted one.  Donating a
+            # fresh copy costs three [N]/[J] ops; a concurrently-landing
+            # scatter can at worst be lost for one window, and the
+            # scheduler's reconcile rewrites load/capacity absolutely
+            # every step (dep epoch folds are monotone max — a lost
+            # window re-applies at the next drain's scatter).
+            outs32, outs16, self.load, self.rem_cap, \
+                self.dep_last_fire = _plan_window_step(
+                    self.table, jnp.asarray(fields_w),
+                    self.elig, self.exclusive, self.cost, self.load + 0.0,
+                    self.rem_cap | 0, self.dep_succ, self.dep_fail,
+                    self.dep_block, self.dep_last_fire | 0,
+                    kx, kc, self.rounds, impl, self._dep_enabled)
         return epoch_s, kx, kc, outs32, outs16
 
     def gather_window(self, handle):
@@ -442,11 +543,12 @@ class TickPlanner:
             + (epoch_s - FRAMEWORK_EPOCH),
         ], axis=1).astype(np.int32)
         # + 0.0 / | 0: fresh buffers so the jit's donation can't
-        # invalidate the planner's live load/rem_cap
-        outs32, _outs16, _l, _r = _plan_window_step(
+        # invalidate the planner's live load/rem_cap/last_fire
+        outs32, _outs16, _l, _r, _lf = _plan_window_step(
             self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
-            self.cost, self.load + 0.0, self.rem_cap | 0, kx, kc,
-            self.rounds, impl)
+            self.cost, self.load + 0.0, self.rem_cap | 0, self.dep_succ,
+            self.dep_fail, self.dep_block, self.dep_last_fire | 0, kx, kc,
+            self.rounds, impl, self._dep_enabled)
         np.asarray(outs32[0, 0])   # a data fetch truly syncs the tunnel
 
     def warm_escalation(self, epoch_s: int, factor: int = 4) -> int:
@@ -468,10 +570,11 @@ class TickPlanner:
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
             np.asarray([epoch_s - FRAMEWORK_EPOCH], np.int64),
         ], axis=1).astype(np.int32)
-        outs32, _o, _l, _r = _plan_window_step(
+        outs32, _o, _l, _r, _lf = _plan_window_step(
             self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
-            self.cost, self.load + 0.0, self.rem_cap | 0, k, k,
-            self.rounds, impl)
+            self.cost, self.load + 0.0, self.rem_cap | 0, self.dep_succ,
+            self.dep_fail, self.dep_block, self.dep_last_fire | 0, k, k,
+            self.rounds, impl, self._dep_enabled)
         np.asarray(outs32[0, 0])
         self._warmed_single.add(k)
         return k

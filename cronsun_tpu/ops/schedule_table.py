@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.models import MAX_DEPS
 from ..cron.parser import CronSpec, EverySpec, parse
 
 # 2020-01-01T00:00:00Z — device times are int32 seconds relative to this.
@@ -38,6 +39,14 @@ FRAMEWORK_EPOCH = 1577836800
 
 _MASK32 = (1 << 32) - 1
 _STAR_OFF = ~(1 << 63)  # strip star bit before splitting
+
+# dependency-column sentinels (the [capacity, MAX_DEPS] dep_cols block):
+# >= 0 is the upstream job's table row; DEP_EMPTY pads unused slots
+# (always satisfied); DEP_BROKEN marks an unresolvable upstream (job
+# missing / no rows) — never satisfied, so the row holds instead of
+# firing dep-less.
+DEP_EMPTY = -1
+DEP_BROKEN = -2
 
 
 def _split64(mask: int) -> "tuple[int, int]":
@@ -65,10 +74,23 @@ class ScheduleTable:
     phase_mod: jax.Array  # int32, phase mod period (framework-epoch relative)
     active: jax.Array    # bool — live row
     paused: jax.Array    # bool — Job.Pause (reference job.go:53)
+    # workflow DAG plane: the padded dependency matrix beside the cron
+    # masks.  has_dep marks dep-triggered rows (their cron masks are
+    # empty); dep_cols is the [capacity, MAX_DEPS] upstream-row block
+    # (DEP_EMPTY pads, DEP_BROKEN never satisfies); dep_policy is the
+    # misfire policy (POLICY_* in ops/deps.py).  Success/fail epochs and
+    # the last-fire vector are PLANNER state (they mutate on watch
+    # events / inside the scan), not table rows.
+    has_dep: jax.Array   # bool
+    dep_policy: jax.Array  # int32 (POLICY_SKIP/FIRE/HOLD)
+    dep_cols: jax.Array    # int32 [capacity, MAX_DEPS]
 
     @property
     def capacity(self) -> int:
         return self.sec_lo.shape[0]
+
+
+_NO_DEPS = (DEP_EMPTY,) * MAX_DEPS
 
 
 def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
@@ -83,7 +105,8 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
             dow=0, dom_star=False, dow_star=False, is_every=True,
             period=period,
             phase_mod=int((phase_epoch_s - FRAMEWORK_EPOCH) % period),
-            active=True, paused=paused)
+            active=True, paused=paused,
+            has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
     sec_lo, sec_hi = _split64(spec.second)
     min_lo, min_hi = _split64(spec.minute)
     return dict(
@@ -91,7 +114,21 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
         hour=spec.hour & _MASK32, dom=spec.dom & _MASK32,
         month=spec.month & _MASK32, dow=spec.dow & _MASK32,
         dom_star=spec.dom_star, dow_star=spec.dow_star,
-        is_every=False, period=1, phase_mod=0, active=True, paused=paused)
+        is_every=False, period=1, phase_mod=0, active=True, paused=paused,
+        has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
+
+
+def make_dep_row(upstream_rows, policy: int, paused: bool = False) -> dict:
+    """Row dict for a dep-triggered job: cron masks empty (the row never
+    time-fires), dep columns padded to MAX_DEPS with DEP_EMPTY.
+    ``upstream_rows`` entries are table rows or DEP_BROKEN for
+    unresolvable upstreams."""
+    ups = list(upstream_rows)[:MAX_DEPS]
+    cols = tuple(ups) + (DEP_EMPTY,) * (MAX_DEPS - len(ups))
+    row = dict(_INACTIVE_ROW)
+    row.update(active=True, paused=paused, has_dep=True,
+               dep_policy=int(policy), dep_cols=cols)
+    return row
 
 
 _DTYPES = dict(
@@ -99,12 +136,17 @@ _DTYPES = dict(
     hour=np.uint32, dom=np.uint32, month=np.uint32, dow=np.uint32,
     dom_star=np.bool_, dow_star=np.bool_, is_every=np.bool_,
     period=np.int32, phase_mod=np.int32, active=np.bool_, paused=np.bool_,
+    has_dep=np.bool_, dep_policy=np.int32, dep_cols=np.int32,
 )
+
+# per-field trailing shape beyond [capacity] (only the dep matrix is 2-D)
+_SHAPES = {"dep_cols": (MAX_DEPS,)}
 
 _INACTIVE_ROW = dict(
     sec_lo=0, sec_hi=0, min_lo=0, min_hi=0, hour=0, dom=0, month=0, dow=0,
     dom_star=False, dow_star=False, is_every=False, period=1, phase_mod=0,
-    active=False, paused=False)
+    active=False, paused=False,
+    has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
 
 
 def build_table(specs: List[Union[CronSpec, EverySpec, str]],
@@ -122,7 +164,9 @@ def build_table(specs: List[Union[CronSpec, EverySpec, str]],
         capacity = max(1, 1 << (n - 1).bit_length()) if n else 1
     if capacity < n:
         raise ValueError(f"capacity {capacity} < {n} specs")
-    cols = {k: np.full(capacity, _INACTIVE_ROW[k], dtype=dt)
+    cols = {k: np.full((capacity, *_SHAPES.get(k, ())),
+                       DEP_EMPTY if k == "dep_cols" else _INACTIVE_ROW[k],
+                       dtype=dt)
             for k, dt in _DTYPES.items()}
     for i, spec in enumerate(specs):
         row = make_row(spec, phase_epoch_s=phase_epoch_s,

@@ -38,9 +38,11 @@ from .. import log
 from ..core import Group, Job, Keyspace
 from ..core.models import KIND_ALONE
 from ..cron.parser import ParseError, parse
+from ..ops.deps import NEVER as DEP_NEVER, POLICY_BY_NAME
 from ..ops.eligibility import EligibilityBuilder, NodeUniverse
 from ..ops.planner import TickPlanner
-from ..ops.schedule_table import make_row, _INACTIVE_ROW
+from ..ops.schedule_table import DEP_BROKEN, FRAMEWORK_EPOCH, \
+    make_dep_row, make_row, _INACTIVE_ROW
 from ..store.memstore import CompactedError, DELETE, MemStore, PUT, \
     WatchLost
 
@@ -196,6 +198,35 @@ class SchedulerService:
         # a cold load for nothing
         self._spec_cache: Dict[str, object] = {}
 
+        # ---- workflow DAG plane host state -----------------------------
+        # dep-triggered jobs + the reverse dependency index (upstream ->
+        # dependents, for re-resolving dep columns on upstream row churn)
+        self._dep_jobs: Dict[Tuple[str, str], object] = {}
+        self._dep_rdeps: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        # latest completed round per job, mirrored from the dep/ prefix:
+        # (success_rel, fail_rel) framework-relative scheduled epochs
+        self._dep_latest: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        # table rows currently holding dep-triggered jobs
+        self._dep_rows: Set[int] = set()
+        # pending device scatters, flushed by _flush_device in order:
+        # row resets (release/registration anchors) BEFORE epoch folds,
+        # so a reacquired row never keeps a previous tenant's epochs
+        self._dep_resets: Dict[int, int] = {}
+        self._dep_epoch_updates: Dict[int, Tuple[int, int]] = {}
+        self._dep_block_updates: Dict[int, bool] = {}
+        # max_in_flight gate: gated jobs (mif > 0), their running-exec
+        # counts (procs mirror; the order->proc gap is the same bounded
+        # over-commit window every capacity gate here has), and which
+        # are currently saturated
+        self._dep_gated: Dict[Tuple[str, str], int] = {}
+        self._dep_inflight: Dict[Tuple[str, str], int] = {}
+        self._dep_blocked: Set[Tuple[str, str]] = set()
+        # mesh planners don't evaluate deps yet (dep columns reference
+        # global rows across shards): refuse dep rows LOUDLY, keep time
+        # triggers working
+        self._dep_supported = hasattr(self.planner, "set_dep_epochs")
+        self._dep_warned: Set[Tuple[str, str]] = set()
+
         # watch-fed mirrors of the execution-state prefixes (proc registry,
         # outstanding exclusive orders, Alone lifetime locks).  The hot loop
         # must NOT re-list these every second — at planner fire rates that
@@ -263,7 +294,13 @@ class SchedulerService:
                             "last_save_ms": 0.0, "last_rev": 0,
                             "restored": 0, "restore_ms": 0.0,
                             "delta_saves_total": 0,
-                            "last_delta_events": 0}
+                            "last_delta_events": 0,
+                            "bg_writes_total": 0,
+                            "last_serialize_ms": 0.0}
+        # double-buffered full saves: the step thread captures a STABLE
+        # state copy; this writer thread serializes it while steps
+        # continue (the O(state) pickle was the step-thread stall)
+        self._ckpt_writer: Optional[threading.Thread] = None
         # delta checkpoints: record the applied watch events (plus the
         # leader's own-publish order accounting, which the delete-only
         # orders watch never echoes) into a buffer; a delta save writes
@@ -433,6 +470,10 @@ class SchedulerService:
             # are covered by anti-entropy.
             self._w_orders = w(self.ks.dispatch, events="delete")
             self._w_alone = w(self._alone_pfx)
+            # workflow DAG completion events (agents write one key per
+            # job round; the fold into the success-epoch vectors is the
+            # dep-trigger edge signal)
+            self._w_deps = w(self.ks.dep)
             # checkpoint-plane control keys: operator save requests and
             # the save barrier nonces
             self._w_ckpt = w(self.ks.ckpt)
@@ -447,7 +488,7 @@ class SchedulerService:
     def _all_watches(self):
         return (self._w_jobs, self._w_groups, self._w_nodes,
                 self._w_procs, self._w_orders, self._w_alone,
-                self._w_ckpt)
+                self._w_deps, self._w_ckpt)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -494,6 +535,12 @@ class SchedulerService:
                  for n in self.universe.index], np.int64)
             cols, caps = self._pad_pow2(cols, caps)
             self.planner.set_node_capacity(cols, caps)
+        # dep completion events BEFORE jobs: _apply_job seeds each fresh
+        # row's success/fail epochs from this mirror, so a cold-loaded
+        # scheduler's dep plane reflects rounds completed while it was
+        # down (the fold is a monotone max — re-listing is idempotent)
+        for kv in _list_prefix(self.store, self.ks.dep):
+            self._apply_ev("deps", PUT, kv.key, kv.value)
         self._phase_prefetch = {
             kv.key: kv.value
             for kv in _list_prefix(self.store, self.ks.phase)}
@@ -551,7 +598,38 @@ class SchedulerService:
         old_rules = self.rows.rules_of(group, job_id)
         new_rules = set()
         self.jobs[(group, job_id)] = job
+        jk = (group, job_id)
+        dep_spec = self._dep_spec_apply(jk, job)
+        dep_row_dict = None
+        if dep_spec is not None:
+            dep_row_dict = make_dep_row(
+                self._dep_upstream_cols(group, dep_spec),
+                POLICY_BY_NAME.get(dep_spec.misfire, 0),
+                paused=job.pause)
         for rule in job.rules:
+            if dep_spec is not None:
+                # dep-triggered row: no cron parse, no phase anchor —
+                # the trigger is the upstream success-epoch test
+                new_rules.add(rule.id)
+                fresh = (group, job_id, rule.id) not in self.rows.by_cmd
+                row = self.rows.acquire(group, job_id, rule.id)
+                if fresh or row not in self._dep_rows:
+                    # registration anchor: only upstream rounds NEWER
+                    # than now fire a just-created chain.  (The row's
+                    # OWN epochs — its downstream signal — are seeded
+                    # by the uniform end-of-apply reseed below.)
+                    self._dep_resets[row] = \
+                        int(self.clock()) - FRAMEWORK_EPOCH
+                    self._dep_rows.add(row)
+                self._row_phase.pop(row, None)
+                self._table_updates[row] = dep_row_dict
+                self.builder.set_job(row, rule.nids, rule.gids,
+                                     rule.exclude_nids)
+                self._meta_updates[row] = (
+                    job.exclusive,
+                    job.avg_time if job.avg_time > 0 else 1.0)
+                self._set_row_dispatch(row, job, rule, group, job_id)
+                continue
             spec = self._spec_cache.get(rule.timer)
             if spec is None:
                 try:
@@ -563,6 +641,7 @@ class SchedulerService:
                 self._spec_cache[rule.timer] = spec
             new_rules.add(rule.id)
             row = self.rows.acquire(group, job_id, rule.id)
+            self._dep_rows.discard(row)   # dep -> cron transition
             prev = self._row_phase.get(row)
             if prev is not None and prev[0] == rule.timer:
                 phase_epoch = prev[1]       # unchanged rule keeps its phase
@@ -575,33 +654,182 @@ class SchedulerService:
             self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
             self._meta_updates[row] = (job.exclusive,
                                        job.avg_time if job.avg_time > 0 else 1.0)
-            if _WIRE_SAFE(rule.id):
-                # default ids are next_id() hex: skip the json encoder
-                # (measured at 1M-job load scale)
-                payload = '{"rule":"%s","kind":%d}' % (rule.id, job.kind)
-            else:
-                payload = json.dumps({"rule": rule.id, "kind": job.kind},
-                                     separators=(",", ":"))
-            suffix = f"/{group}/{job_id}"
-            bentry = json.dumps(f"{group}/{job_id}")
-            self._row_dispatch[row] = (
-                job.exclusive, payload,
-                group, job_id, job.kind,
-                suffix,                 # precomputed key tail: the
-                                        # order-build loop is concat-only
-                # pre-escaped bundle entry: coalesced (node, second)
-                # values are "[" + ",".join(entries) + "]" at build time
-                bentry)
-            # parallel arrays for the vectorized build; flags LAST so a
-            # concurrently building worker never sees a half-set row
-            self._rd_payload[row] = payload
-            self._rd_suffix[row] = suffix
-            self._rd_bentry[row] = bentry
-            self._rd_job[row] = (group, job_id)
-            self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
-                                   | (4 if job.kind == KIND_ALONE else 0))
+            self._set_row_dispatch(row, job, rule, group, job_id)
         for rule_id in old_rules - new_rules:
             self._drop_rule(group, job_id, rule_id)
+        # upstream row set may have changed: re-resolve dependents' dep
+        # columns AND re-seed this job's (possibly fresh) rows with its
+        # latest completion epochs — rule churn must not lose a round
+        # (a dict miss for the overwhelming dep-less majority)
+        if self._dep_rdeps.get(jk):
+            self._dep_refresh_dependents(group, job_id)
+            self._dep_seed_job_rows(group, job_id)
+
+    def _set_row_dispatch(self, row: int, job: Job, rule, group: str,
+                          job_id: str):
+        """Per-row dispatch cache install (tuple + parallel arrays);
+        flags LAST so a concurrently building worker never sees a
+        half-set row."""
+        if _WIRE_SAFE(rule.id):
+            # default ids are next_id() hex: skip the json encoder
+            # (measured at 1M-job load scale)
+            payload = '{"rule":"%s","kind":%d}' % (rule.id, job.kind)
+        else:
+            payload = json.dumps({"rule": rule.id, "kind": job.kind},
+                                 separators=(",", ":"))
+        suffix = f"/{group}/{job_id}"
+        bentry = json.dumps(f"{group}/{job_id}")
+        self._row_dispatch[row] = (
+            job.exclusive, payload,
+            group, job_id, job.kind,
+            suffix,                 # precomputed key tail: the
+                                    # order-build loop is concat-only
+            # pre-escaped bundle entry: coalesced (node, second)
+            # values are "[" + ",".join(entries) + "]" at build time
+            bentry)
+        self._rd_payload[row] = payload
+        self._rd_suffix[row] = suffix
+        self._rd_bentry[row] = bentry
+        self._rd_job[row] = (group, job_id)
+        self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
+                               | (4 if job.kind == KIND_ALONE else 0))
+
+    # ---- workflow DAG plane ---------------------------------------------
+
+    def _dep_spec_apply(self, jk: Tuple[str, str], job: Job):
+        """Maintain the dep-job registry + reverse index for one applied
+        job; returns the effective DepSpec (None = time-triggered, or
+        deps unsupported on this planner)."""
+        old = self._dep_jobs.get(jk)
+        new = job.deps if (job.deps is not None
+                           and getattr(job.deps, "on", None)) else None
+        if new is not None and not self._dep_supported:
+            if jk not in self._dep_warned:
+                self._dep_warned.add(jk)
+                log.errorf(
+                    "job %s/%s has a deps spec but planner %s does not "
+                    "support dep triggers (mesh planners shard rows "
+                    "across devices) — the job will NOT fire",
+                    jk[0], jk[1], type(self.planner).__name__)
+            new = None
+        if old is None and new is None:
+            return None
+        group = jk[0]
+        if old is not None:
+            for u in old.on:
+                s = self._dep_rdeps.get((group, u))
+                if s:
+                    s.discard(jk)
+                    if not s:
+                        del self._dep_rdeps[(group, u)]
+        if new is not None:
+            self._dep_jobs[jk] = new
+            for u in new.on:
+                fresh_edge = not self._dep_rdeps.get((group, u))
+                self._dep_rdeps.setdefault((group, u), set()).add(jk)
+                if fresh_edge:
+                    # the upstream's completion scatters were skipped
+                    # while nothing depended on it: seed its rows from
+                    # the mirror now (monotone — idempotent)
+                    self._dep_seed_job_rows(group, u)
+            if new.max_in_flight > 0:
+                newly_gated = jk not in self._dep_gated
+                self._dep_gated[jk] = new.max_in_flight
+                if newly_gated:
+                    # the incremental counter only tracks gated jobs:
+                    # recount this one from the procs mirror now (rare
+                    # operator action; O(procs) once)
+                    n = 0
+                    for k in self._procs:
+                        t = self._parse_proc(k)
+                        if t and (t[1], t[2]) == jk:
+                            n += 1
+                    if n:
+                        self._dep_inflight[jk] = n
+                    else:
+                        self._dep_inflight.pop(jk, None)
+            else:
+                self._dep_gated.pop(jk, None)
+                self._dep_inflight.pop(jk, None)
+                self._dep_blocked.discard(jk)
+            if not self.planner.dep_enabled:
+                self.planner.set_dep_enabled(True)
+        else:
+            self._dep_jobs.pop(jk, None)
+            self._dep_gated.pop(jk, None)
+            self._dep_inflight.pop(jk, None)
+            self._dep_blocked.discard(jk)
+        return new
+
+    def _dep_seed_job_rows(self, group: str, job_id: str):
+        """Queue the job's latest completion epochs onto every row it
+        holds (fresh rows after rule churn, or an upstream gaining its
+        first dependent).  Monotone device fold — re-seeding is
+        idempotent."""
+        if not self._dep_supported:
+            return
+        latest = self._dep_latest.get((group, job_id))
+        if latest is None:
+            return
+        by_cmd = self.rows.by_cmd
+        for rid in self.rows.by_job.get((group, job_id), ()):
+            row = by_cmd.get((group, job_id, rid))
+            if row is not None:
+                self._dep_epoch_updates[row] = latest
+
+    def _dep_upstream_cols(self, group: str, spec) -> List[int]:
+        """Upstream job ids -> table-row anchors.  A job with several
+        rules holds several rows, all carrying the same success epochs
+        (completion events scatter to every row of the job) — the
+        anchor is the smallest.  Missing/row-less upstreams resolve to
+        DEP_BROKEN: the dependent HOLDS (never fires dep-less) until
+        the upstream (re)appears and re-resolution runs."""
+        by_cmd = self.rows.by_cmd
+        cols = []
+        for u in spec.on:
+            rids = self.rows.by_job.get((group, u))
+            if not rids:
+                cols.append(DEP_BROKEN)
+                continue
+            cols.append(min(by_cmd[(group, u, rid)] for rid in rids))
+        return cols
+
+    def _dep_refresh_dependents(self, group: str, job_id: str):
+        """An upstream's row set changed (applied/dropped): rebuild every
+        dependent's dep-column block."""
+        for dk in list(self._dep_rdeps.get((group, job_id), ())):
+            spec = self._dep_jobs.get(dk)
+            job = self.jobs.get(dk)
+            if spec is None or job is None:
+                continue
+            row_dict = make_dep_row(
+                self._dep_upstream_cols(dk[0], spec),
+                POLICY_BY_NAME.get(spec.misfire, 0), paused=job.pause)
+            by_cmd = self.rows.by_cmd
+            for rid in self.rows.rules_of(dk[0], dk[1]):
+                row = by_cmd.get((dk[0], dk[1], rid))
+                if row is not None:
+                    self._table_updates[row] = row_dict
+
+    def _dep_refresh_blocks(self):
+        """Recompute the max_in_flight saturation gate and queue device
+        scatters for rows whose blocked state flipped.  O(gated jobs)
+        per flush."""
+        if not self._dep_gated or not self._dep_supported:
+            return
+        by_cmd = self.rows.by_cmd
+        for jk, mif in self._dep_gated.items():
+            blocked = self._dep_inflight.get(jk, 0) >= mif
+            if blocked == (jk in self._dep_blocked):
+                continue
+            if blocked:
+                self._dep_blocked.add(jk)
+            else:
+                self._dep_blocked.discard(jk)
+            for rid in self.rows.rules_of(jk[0], jk[1]):
+                row = by_cmd.get((jk[0], jk[1], rid))
+                if row is not None:
+                    self._dep_block_updates[row] = blocked
 
     def _phase_anchor(self, group: str, job_id: str, rule_id: str,
                       timer: str) -> int:
@@ -641,6 +869,14 @@ class SchedulerService:
     def _drop_rule(self, group: str, job_id: str, rule_id: str):
         row = self.rows.release_rule(group, job_id, rule_id)
         if row is not None:
+            if self._dep_supported:
+                # released rows hand a clean dep slate to the next
+                # tenant: epochs back to NEVER, anchor 0; pending
+                # scatters for the row are superseded by the reset
+                self._dep_rows.discard(row)
+                self._dep_epoch_updates.pop(row, None)
+                self._dep_block_updates.pop(row, None)
+                self._dep_resets[row] = 0
             # invalidate the flags ONLY — the object cells keep their
             # stale values on purpose: the build worker reads flags and
             # the field lists at different instants, and a None-ed cell
@@ -669,6 +905,22 @@ class SchedulerService:
         for rule_id in self.rows.rules_of(group, job_id):
             self._drop_rule(group, job_id, rule_id)
         self.jobs.pop((group, job_id), None)
+        jk = (group, job_id)
+        spec = self._dep_jobs.pop(jk, None)
+        if spec is not None:
+            for u in spec.on:
+                s = self._dep_rdeps.get((group, u))
+                if s:
+                    s.discard(jk)
+                    if not s:
+                        del self._dep_rdeps[(group, u)]
+        self._dep_gated.pop(jk, None)
+        self._dep_inflight.pop(jk, None)
+        self._dep_blocked.discard(jk)
+        if self._dep_rdeps.get(jk):
+            # a dropped upstream breaks its dependents' columns
+            # (DEP_BROKEN: they hold, loudly visible in dag show)
+            self._dep_refresh_dependents(group, job_id)
 
     def _apply_group(self, value: str):
         try:
@@ -762,6 +1014,7 @@ class SchedulerService:
         for sid, w in (("groups", self._w_groups),
                        ("nodes", self._w_nodes),
                        ("jobs", self._w_jobs),
+                       ("deps", self._w_deps),
                        ("procs", self._w_procs),
                        ("orders", self._w_orders),
                        ("alone", self._w_alone)):
@@ -823,6 +1076,45 @@ class SchedulerService:
                     self._drop_job(group, job_id)
             else:
                 self._apply_job(key, value)
+        elif sid == "deps":
+            # workflow DAG completion events: fold the round's scheduled
+            # epoch into the job's (success, fail) pair and queue the
+            # device scatter for every row the job occupies.  Monotone
+            # max host-side AND device-side, so duplicate deliveries,
+            # multi-node Common completions and delta-chain replays are
+            # all idempotent.
+            rest = key[len(self.ks.dep):]
+            if "/" not in rest:
+                return
+            group, job_id = rest.split("/", 1)
+            jk = (group, job_id)
+            if typ == DELETE:
+                # an operator wiped the key: forget the host mirror (a
+                # later row acquire seeds from scratch); device epochs
+                # stay — they are monotone and rows reset on release
+                self._dep_latest.pop(jk, None)
+                return
+            epoch_s, _, status = value.partition("|")
+            try:
+                rel = int(float(epoch_s)) - FRAMEWORK_EPOCH
+            except ValueError:
+                return
+            succ, fail = self._dep_latest.get(jk, (DEP_NEVER, DEP_NEVER))
+            if status == "fail":
+                fail = max(fail, rel)
+            else:
+                succ = max(succ, rel)
+            self._dep_latest[jk] = (succ, fail)
+            # device scatters only for jobs something DEPENDS ON: a
+            # dep-free fleet's completion stream must cost the mirror
+            # fold alone, not a padded device scatter per flush (the
+            # mirror re-seeds rows if a dependent registers later)
+            if self._dep_supported and self._dep_rdeps.get(jk):
+                by_cmd = self.rows.by_cmd
+                for rid in self.rows.by_job.get(jk, ()):
+                    row = by_cmd.get((group, job_id, rid))
+                    if row is not None:
+                        self._dep_epoch_updates[row] = (succ, fail)
         # execution-state mirrors: proc registry (leased keys expire ->
         # DELETE events age dead executions out), outstanding exclusive
         # orders (delete-only watch: own puts mirrored at submit), Alone
@@ -891,6 +1183,9 @@ class SchedulerService:
         self._load_sum[node_id] = self._load_sum.get(node_id, 0.0) + cost
         if excl:
             self._excl_cnt[node_id] = self._excl_cnt.get(node_id, 0) + 1
+        if mirror is self._procs and (group, job_id) in self._dep_gated:
+            jk = (group, job_id)
+            self._dep_inflight[jk] = self._dep_inflight.get(jk, 0) + 1
 
     def _acct_add_order(self, key: str, node_id: str, jobs: list):
         """Mirror + counter add for one COALESCED order key: the bundle
@@ -928,6 +1223,15 @@ class SchedulerService:
         ent = mirror.pop(key, None)
         if ent is None:
             return
+        if mirror is self._procs and self._dep_gated:
+            t = self._parse_proc(key)
+            if t is not None and (t[1], t[2]) in self._dep_gated:
+                jk = (t[1], t[2])
+                n = self._dep_inflight.get(jk, 0) - 1
+                if n > 0:
+                    self._dep_inflight[jk] = n
+                else:
+                    self._dep_inflight.pop(jk, None)
         node_id, cost, excl = ent
         s = self._load_sum.get(node_id, 0.0) - cost
         if s > 1e-9:
@@ -1016,6 +1320,17 @@ class SchedulerService:
     def _install_mirrors(self, built):
         self._procs, self._orders, self._alone_live, \
             self._excl_cnt, self._load_sum = built
+        # ground-truth rebuild of the dep in-flight counters from the
+        # fresh procs mirror (the incremental counters drift with the
+        # same bounded windows the load/excl counters do)
+        infl: Dict[Tuple[str, str], int] = {}
+        if self._dep_gated:
+            for k in self._procs:
+                t = self._parse_proc(k)
+                if t is not None and (t[1], t[2]) in self._dep_gated:
+                    jk = (t[1], t[2])
+                    infl[jk] = infl.get(jk, 0) + 1
+        self._dep_inflight = infl
         self._mirror_resync_at = self.clock() + self.mirror_resync_s
 
     def _mirror_antientropy(self):
@@ -1148,8 +1463,18 @@ class SchedulerService:
                 and ch["seq"] < self.delta_max_chain
                 and ch["bytes"] < self.delta_max_bytes)
 
+    def _ckpt_join(self, timeout: Optional[float] = None):
+        """Wait out an in-flight background full-save serialization
+        (saves serialize against each other: a delta element must not
+        race the base writer's clear-then-rename)."""
+        t = self._ckpt_writer
+        if t is not None:
+            t.join(timeout)
+            if not t.is_alive():
+                self._ckpt_writer = None
+
     def checkpoint_save(self, path: Optional[str] = None,
-                        kind: str = "auto") -> dict:
+                        kind: str = "auto", wait: bool = True) -> dict:
         """Persist a restore point keyed by the store revision (scalar,
         or the per-shard vector on a sharded store) the barrier proves
         quiescent.  ``kind``: "auto" writes a small DELTA chain element
@@ -1159,6 +1484,17 @@ class SchedulerService:
         "delta" forces a delta (raises when no chain is extendable).
         STEP-THREAD (or quiesced-service) only: the mirrors have a
         single writer and the barrier drains watches inline.
+
+        Full saves are DOUBLE-BUFFERED: the step thread captures a
+        stable state copy (shallow dict/array copies + device fetches),
+        and the O(state) pickle serialization runs on a background
+        writer so steps continue while the bytes land (``wait=False``,
+        the periodic cadence's path; ``wait=True`` joins the writer
+        before returning — the synchronous contract tests and operator
+        triggers rely on).  The returned/recorded ``ms`` is the
+        STEP-THREAD portion (barrier + capture) — the lease-health
+        number; the serialize span lands in
+        ``checkpoint_last_serialize_ms``.
 
         Accounting for builds still in flight on the pipeline worker
         lands after their windows complete; a restore therefore may
@@ -1170,6 +1506,9 @@ class SchedulerService:
         if path is None:
             path = self._checkpoint_path()
         from ..checkpoint.sched_ckpt import gc_paused
+        # serialize saves: a previous base's writer must finish before
+        # this save touches the chain files
+        self._ckpt_join()
         t0 = time.perf_counter()
         rev = self._checkpoint_barrier()
         as_delta = self._delta_possible(path) and kind != "full"
@@ -1204,21 +1543,45 @@ class SchedulerService:
             self._flush_device()
             with gc_paused():
                 state = self._checkpoint_state(rev)
-                # a fresh base starts a fresh chain: stale elements are
-                # unlinked (descending seq — a crash mid-way leaves a
-                # contiguous, still-valid OLD chain) BEFORE the rename
-                # publishes the new base
-                state["chain"] = nonce = (
-                    f"{self.node_id}-{os.getpid()}-"
-                    f"{int(time.time() * 1e3):x}")
-                clear_delta_chain(path)
-                save_checkpoint(path, state)
+            # a fresh base starts a fresh chain: stale elements are
+            # unlinked (descending seq — a crash mid-way leaves a
+            # contiguous, still-valid OLD chain) BEFORE the rename
+            # publishes the new base
+            state["chain"] = nonce = (
+                f"{self.node_id}-{os.getpid()}-"
+                f"{int(time.time() * 1e3):x}")
+            # chain bookkeeping at CAPTURE time: the delta stream
+            # restarts from this instant whether or not the bytes have
+            # landed yet (saves serialize via _ckpt_join, so no delta
+            # element can precede the base on disk)
             self._ckpt_chain = {"nonce": nonce, "seq": 0, "rev": rev,
                                 "bytes": 0, "path": path}
             if self._delta_buf is not None:
                 self._delta_buf.clear()
             self._delta_valid = True
             self._delta_overflowed = False
+
+            def write():
+                ts = time.perf_counter()
+                try:
+                    with gc_paused():
+                        clear_delta_chain(path)
+                        save_checkpoint(path, state)
+                except Exception as e:  # noqa: BLE001 — a failed base
+                    # leaves no extendable chain (the next save rebases)
+                    self._ckpt_chain = None
+                    self._ckpt_stats["save_errors_total"] += 1
+                    log.errorf("checkpoint serialization failed: %s", e)
+                finally:
+                    self._ckpt_stats["last_serialize_ms"] = round(
+                        (time.perf_counter() - ts) * 1e3, 3)
+            if wait:
+                write()
+            else:
+                self._ckpt_stats["bg_writes_total"] += 1
+                self._ckpt_writer = threading.Thread(
+                    target=write, daemon=True, name="sched-ckpt-write")
+                self._ckpt_writer.start()
             out_kind = "full"
         ms = (time.perf_counter() - t0) * 1e3
         self._ckpt_stats["saves_total"] += 1
@@ -1244,6 +1607,12 @@ class SchedulerService:
                 "devices": int(self.planner.mesh.devices.size)}
 
     def _checkpoint_state(self, rev: int) -> dict:
+        """Capture the BUILT state as a STABLE copy: every mutable host
+        structure is shallow-copied (and the in-place-scattered builder
+        arrays deep-copied), so the serialization can run on a
+        background thread while steps keep mutating the originals (the
+        double-buffered full save).  Device arrays fetch into fresh
+        host buffers by construction."""
         import dataclasses
         import jax
         from ..checkpoint.sched_ckpt import pack_jobs
@@ -1254,6 +1623,11 @@ class SchedulerService:
         # planner's arrays are a direct device read
         fetch = getattr(self.planner, "_fetch",
                         lambda a: np.asarray(jax.device_get(a)))
+        dep = dict(latest=dict(self._dep_latest))
+        if self._dep_supported:
+            # the mutable dep vectors — last_fire especially: a restore
+            # without it would re-fire every chain's last round
+            dep.update(self.planner.dep_state())
         return dict(
             rev=rev, saved_at=time.time(), node_id=self.node_id,
             prefix=self.ks.prefix, J=self.planner.J, N=self.planner.N,
@@ -1268,26 +1642,33 @@ class SchedulerService:
             elig=np.asarray(fetch(self.planner.elig)),
             exclusive=np.asarray(fetch(self.planner.exclusive)),
             cost=np.asarray(fetch(self.planner.cost)),
+            dep=dep,
             # jobs ride columnar (pack_jobs); the builder's per-row rule
             # inputs and reverse group index are DERIVED from them at
             # restore (set_job aliases the rules' own lists, so the
             # derivation reproduces both the data and the sharing)
-            jobs=pack_jobs(self.jobs), groups=self.groups,
-            node_caps=self.node_caps,
-            rows=dict(by_cmd=self.rows.by_cmd, free=self.rows._free),
-            universe=dict(index=self.universe.index,
-                          free=self.universe._free),
-            builder=dict(group_mask=self.builder.group_mask,
-                         matrix=self.builder.matrix),
-            row_phase=self._row_phase,
-            row_dispatch=self._row_dispatch,
-            rd=dict(flags=self._rd_flags, payload=self._rd_payload,
-                    suffix=self._rd_suffix, bentry=self._rd_bentry,
-                    job=self._rd_job),
-            col_node=self._col_node, col_live=self._col_live,
-            mirrors=dict(procs=self._procs, orders=self._orders,
-                         alone=self._alone_live, excl=self._excl_cnt,
-                         load=self._load_sum),
+            jobs=pack_jobs(self.jobs), groups=dict(self.groups),
+            node_caps=dict(self.node_caps),
+            rows=dict(by_cmd=dict(self.rows.by_cmd),
+                      free=list(self.rows._free)),
+            universe=dict(index=dict(self.universe.index),
+                          free=list(self.universe._free)),
+            builder=dict(group_mask=dict(self.builder.group_mask),
+                         matrix=np.array(self.builder.matrix)),
+            row_phase=dict(self._row_phase),
+            row_dispatch=dict(self._row_dispatch),
+            rd=dict(flags=np.array(self._rd_flags),
+                    payload=list(self._rd_payload),
+                    suffix=list(self._rd_suffix),
+                    bentry=list(self._rd_bentry),
+                    job=list(self._rd_job)),
+            col_node=list(self._col_node),
+            col_live=np.array(self._col_live),
+            mirrors=dict(procs=dict(self._procs),
+                         orders=dict(self._orders),
+                         alone=set(self._alone_live),
+                         excl=dict(self._excl_cnt),
+                         load=dict(self._load_sum)),
         )
 
     def _checkpoint_restore(self) -> bool:
@@ -1325,13 +1706,14 @@ class SchedulerService:
             # constructor on a KeyError with the bad file still on disk
             missing = [k for k in (
                 "rev", "prefix", "J", "N", "table", "elig", "exclusive",
-                "cost", "jobs", "groups", "node_caps", "rows",
+                "cost", "dep", "jobs", "groups", "node_caps", "rows",
                 "universe", "builder", "row_phase", "row_dispatch",
                 "rd", "col_node", "col_live", "mirrors") if k not in st]
             for outer, subkeys in (
                     ("rows", ("by_cmd", "free")),
                     ("universe", ("index", "free")),
                     ("builder", ("group_mask", "matrix")),
+                    ("dep", ("latest",)),
                     ("rd", ("flags", "payload", "suffix", "bentry",
                             "job")),
                     ("mirrors", ("procs", "orders", "alone", "excl",
@@ -1495,6 +1877,54 @@ class SchedulerService:
         self._alone_live = m["alone"]
         self._excl_cnt = m["excl"]
         self._load_sum = m["load"]
+        # workflow DAG state: the completion mirror + device vectors
+        # land from the checkpoint; the registries (dep jobs, reverse
+        # index, gated set, row set) are DERIVED from the restored jobs
+        # exactly as _apply_job builds them, and the in-flight counters
+        # from the restored procs mirror
+        dep = st["dep"]
+        self._dep_latest = dep["latest"]
+        self._dep_jobs = {}
+        self._dep_rdeps = {}
+        self._dep_gated = {}
+        self._dep_rows = set()
+        for k, job in self.jobs.items():
+            spec = job.deps
+            if spec is None or not spec.on:
+                continue
+            self._dep_jobs[k] = spec
+            for u in spec.on:
+                self._dep_rdeps.setdefault((k[0], u), set()).add(k)
+            if spec.max_in_flight > 0:
+                self._dep_gated[k] = spec.max_in_flight
+            for rid in self.rows.rules_of(*k):
+                row = self.rows.by_cmd.get((k[0], k[1], rid))
+                if row is not None:
+                    self._dep_rows.add(row)
+        infl: Dict[Tuple[str, str], int] = {}
+        if self._dep_gated:
+            for pk in self._procs:
+                t = self._parse_proc(pk)
+                if t is not None and (t[1], t[2]) in self._dep_gated:
+                    infl[(t[1], t[2])] = infl.get((t[1], t[2]), 0) + 1
+        self._dep_inflight = infl
+        self._dep_blocked = set()
+        if self._dep_supported and "succ" in dep:
+            self.planner.set_dep_state(dep["succ"], dep["fail"],
+                                       dep["last_fire"], dep["block"])
+            # the saved block array may carry saturated rows; the host
+            # gate recomputes from scratch — force a full re-scatter so
+            # device and host agree from the first flush
+            for jk, mif in self._dep_gated.items():
+                blocked = self._dep_inflight.get(jk, 0) >= mif
+                if blocked:
+                    self._dep_blocked.add(jk)
+                for rid in self.rows.rules_of(*jk):
+                    row = self.rows.by_cmd.get((jk[0], jk[1], rid))
+                    if row is not None:
+                        self._dep_block_updates[row] = blocked
+        if self._dep_rows and self._dep_supported:
+            self.planner.set_dep_enabled(True)
         # device state: table + eligibility + job meta land whole; node
         # capacities as at a cold load's end (reconcile_capacity
         # rewrites load/rem_cap from the mirrors every leading step).
@@ -1619,7 +2049,11 @@ class SchedulerService:
                           "configured on %s; ignoring", self.node_id)
             return
         try:
-            out = self.checkpoint_save()
+            # periodic saves serialize in the background (the step
+            # thread pays barrier + capture only); operator-REQUESTED
+            # saves stay synchronous — the done-key ack must mean the
+            # bytes are on disk
+            out = self.checkpoint_save(wait=bool(req))
             # the save ran inline on the step thread: a leader's lease
             # got no keepalive for its whole duration — refresh it NOW
             # rather than a step later, and tell the operator when the
@@ -1688,6 +2122,36 @@ class SchedulerService:
             rows, excl, cost = self._pad_pow2(rows, excl, cost)
             self.planner.set_job_meta(rows, excl, cost)
             self._meta_updates.clear()
+        # workflow DAG scatters, strictly ordered: row RESETS first (a
+        # released row's clean slate must not be re-poisoned by a stale
+        # queued fold), then the monotone epoch folds, then the
+        # max_in_flight gate
+        self._dep_refresh_blocks()
+        if self._dep_resets:
+            rows = np.array(sorted(self._dep_resets), dtype=np.int32)
+            anchors = np.array([self._dep_resets[int(r)] for r in rows],
+                               dtype=np.int32)
+            rows, anchors = self._pad_pow2(rows, anchors)
+            self.planner.reset_dep_rows(rows, anchors)
+            self._dep_resets.clear()
+        if self._dep_epoch_updates:
+            rows = np.array(sorted(self._dep_epoch_updates),
+                            dtype=np.int32)
+            succ = np.array([self._dep_epoch_updates[int(r)][0]
+                             for r in rows], dtype=np.int32)
+            fail = np.array([self._dep_epoch_updates[int(r)][1]
+                             for r in rows], dtype=np.int32)
+            rows, succ, fail = self._pad_pow2(rows, succ, fail)
+            self.planner.set_dep_epochs(rows, succ, fail)
+            self._dep_epoch_updates.clear()
+        if self._dep_block_updates:
+            rows = np.array(sorted(self._dep_block_updates),
+                            dtype=np.int32)
+            vals = np.array([self._dep_block_updates[int(r)]
+                             for r in rows])
+            rows, vals = self._pad_pow2(rows, vals)
+            self.planner.set_dep_block(rows, vals)
+            self._dep_block_updates.clear()
 
     def _start_warm(self):
         """Background compile of the plan executables this process will
@@ -2531,6 +2995,16 @@ class SchedulerService:
             "checkpoint_last_delta_events":
                 self._ckpt_stats["last_delta_events"],
             "checkpoint_chain_len": (self._ckpt_chain or {}).get("seq", 0),
+            # double-buffered full saves: how many serialized off the
+            # step thread, and what the last pickle actually cost there
+            "checkpoint_bg_writes_total":
+                self._ckpt_stats["bg_writes_total"],
+            "checkpoint_last_serialize_ms":
+                self._ckpt_stats["last_serialize_ms"],
+            # workflow DAG plane health
+            "dep_jobs": len(self._dep_jobs),
+            "dep_blocked_jobs": len(self._dep_blocked),
+            "dep_events_mirrored": len(self._dep_latest),
         }
 
     def _advance_hwm(self, value: int):
@@ -2605,6 +3079,7 @@ class SchedulerService:
         self._builder.stop()
         self.publisher.stop()
         self._drain_build_acct()
+        self._ckpt_join()   # an in-flight base write finishes its rename
         self._dispatch_pool.shutdown(wait=False)
         if self._ae_store is not None and self._ae_store is not self.store:
             try:

@@ -29,7 +29,8 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import log
 from ..core import (
-    Account, Group, Job, Keyspace, ROLE_ADMIN, ValidationError, next_id)
+    Account, Group, Job, Keyspace, ROLE_ADMIN, ValidationError, next_id,
+    validate_dag)
 from ..core.models import hash_password
 from ..logsink import JobLogStore
 from ..store.memstore import MemStore
@@ -135,6 +136,8 @@ class ApiServer:
         route("GET", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)", self.job_get)
         route("DELETE", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)",
               self.job_delete)
+        route("GET", r"/v1/dag/(?P<group>[^/]+)/runs", self.dag_runs)
+        route("GET", r"/v1/dag/(?P<group>[^/]+)", self.dag_show)
         route("GET", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)/nodes",
               self.job_nodes)
         route("PUT", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)/execute",
@@ -299,10 +302,55 @@ class ApiServer:
             job.security_valid(self.security)
         except ValidationError as e:
             raise HttpError(400, str(e))
+        if job.deps is not None:
+            # DAG validation is group-scoped: every upstream must exist
+            # in the group and the new edges must not close a cycle —
+            # refused HERE, loudly, before the document lands (the
+            # scheduler would otherwise hold the job forever)
+            self._validate_job_dag(job)
         if old_group and old_group != job.group:
+            # a group move deletes the old-group document: same
+            # dependents guard as job_delete, or the move silently
+            # breaks downstream chains the delete path refuses to
+            dep_map, _ids = self._group_dep_map(old_group)
+            dependents = sorted(j for j, ups in dep_map.items()
+                                if job.id in ups and j != job.id)
+            if dependents:
+                raise HttpError(
+                    409, f"job {job.id!r} is an upstream of "
+                         f"{', '.join(dependents)} in group "
+                         f"{old_group!r} — moving it would break their "
+                         "chains; update or delete the dependents "
+                         "first")
             self.store.delete(self.ks.job_key(old_group, job.id))
         self.store.put(self.ks.job_key(job.group, job.id), job.to_json())
         return {"id": job.id, "group": job.group}
+
+    def _group_dep_map(self, group: str):
+        """{job_id: [upstream ids]} + the id set for one group (the
+        validate_dag inputs), read straight from the store."""
+        prefix = self.ks.cmd + group + "/"
+        dep_map, ids = {}, set()
+        for kv in self.store.get_prefix(prefix):
+            jid = kv.key[len(prefix):]
+            ids.add(jid)
+            try:
+                doc = json.loads(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            d = doc.get("deps")
+            if isinstance(d, dict) and d.get("on"):
+                dep_map[jid] = [str(u) for u in d["on"]]
+        return dep_map, ids
+
+    def _validate_job_dag(self, job: Job):
+        dep_map, ids = self._group_dep_map(job.group)
+        dep_map[job.id] = list(job.deps.on)
+        ids.add(job.id)
+        try:
+            validate_dag(dep_map, ids, job.id)
+        except ValidationError as e:
+            raise HttpError(400, str(e))
 
     def _load_job(self, ctx) -> Job:
         group, job_id = ctx.path_args["group"], ctx.path_args["id"]
@@ -319,6 +367,17 @@ class ApiServer:
 
     def job_delete(self, ctx):
         group, job_id = ctx.path_args["group"], ctx.path_args["id"]
+        # deleting an upstream leaves its dependents' dep columns BROKEN
+        # (they hold forever): refuse unless the operator forces it
+        dep_map, _ids = self._group_dep_map(group)
+        dependents = sorted(j for j, ups in dep_map.items()
+                            if job_id in ups and j != job_id)
+        if dependents and ctx.q("force") != "true":
+            raise HttpError(
+                409, f"job {job_id!r} is an upstream of "
+                     f"{', '.join(dependents)} — their chains would "
+                     "hold forever; delete them first or pass "
+                     "?force=true")
         if not self.store.delete(self.ks.job_key(group, job_id)):
             raise HttpError(404, "no such job")
         return {}
@@ -355,6 +414,103 @@ class ApiServer:
         node = ctx.q("node")
         self.store.put(self.ks.once_key(group, job_id), node)
         return {}
+
+    # ---- workflow DAG views ---------------------------------------------
+
+    def _dag_group_jobs(self, group: str):
+        """Jobs of the group that participate in its DAG (dep-triggered
+        jobs + their upstreams), plus the dep-less lookup set."""
+        prefix = self.ks.cmd + group + "/"
+        jobs = {}
+        for kv in self.store.get_prefix(prefix):
+            jid = kv.key[len(prefix):]
+            try:
+                job = Job.from_json(kv.value)
+            except (json.JSONDecodeError, TypeError):
+                continue
+            job.group, job.id = group, jid
+            jobs[jid] = job
+        dag = {jid: j for jid, j in jobs.items() if j.deps is not None}
+        involved = set(dag)
+        for j in dag.values():
+            involved.update(j.deps.on)
+        return jobs, dag, involved
+
+    def dag_show(self, ctx):
+        """Dependency graph of one group: involved jobs in topological
+        order (upstreams first), edges, and broken references."""
+        group = ctx.path_args["group"]
+        jobs, dag, involved = self._dag_group_jobs(group)
+        missing = {}
+        for jid, j in dag.items():
+            gone = [u for u in j.deps.on if u not in jobs]
+            if gone:
+                missing[jid] = gone
+        # Kahn topo over the involved subgraph (cycles can't exist for
+        # validated saves; hand-written store content falls back to
+        # sorted order for any leftover)
+        indeg = {jid: 0 for jid in involved}
+        downs = {jid: [] for jid in involved}
+        for jid, j in dag.items():
+            for u in j.deps.on:
+                if u in indeg:
+                    indeg[jid] += 1
+                    downs[u].append(jid)
+        ready = sorted(j for j, d in indeg.items() if d == 0)
+        order = []
+        while ready:
+            cur = ready.pop(0)
+            order.append(cur)
+            for dn in sorted(downs[cur]):
+                indeg[dn] -= 1
+                if indeg[dn] == 0:
+                    ready.append(dn)
+        order += sorted(j for j in involved if j not in set(order))
+        out_jobs = []
+        for jid in order:
+            j = jobs.get(jid)
+            if j is None:
+                continue            # missing upstream: listed in missing
+            d = json.loads(j.to_json())
+            out_jobs.append({"id": jid, "name": j.name, "pause": j.pause,
+                             "kind": j.kind, "deps": d.get("deps")})
+        edges = [[u, jid] for jid, j in sorted(dag.items())
+                 for u in j.deps.on]
+        return {"group": group, "jobs": out_jobs, "edges": edges,
+                "missing": missing}
+
+    def dag_runs(self, ctx):
+        """Live chain state per DAG job: latest completed round (the
+        dep/ completion key) and in-flight executions (proc registry)."""
+        group = ctx.path_args["group"]
+        jobs, dag, involved = self._dag_group_jobs(group)
+        in_flight = {}
+        pfx = self.ks.proc
+        for kv in self.store.get_prefix(pfx):
+            rest = kv.key[len(pfx):].split("/")
+            if len(rest) != 4 or rest[1] != group:
+                continue
+            if rest[2] in involved:
+                in_flight[rest[2]] = in_flight.get(rest[2], 0) + 1
+        out = []
+        for jid in sorted(involved):
+            j = jobs.get(jid)
+            row = {"id": jid,
+                   "deps": (json.loads(j.to_json()).get("deps")
+                            if j is not None else None),
+                   "missing": j is None,
+                   "in_flight": in_flight.get(jid, 0),
+                   "last_epoch": None, "last_status": ""}
+            kv = self.store.get(self.ks.dep_key(group, jid))
+            if kv is not None:
+                epoch, _, status = kv.value.partition("|")
+                try:
+                    row["last_epoch"] = int(float(epoch))
+                    row["last_status"] = status or "ok"
+                except ValueError:
+                    pass
+            out.append(row)
+        return {"group": group, "jobs": out}
 
     def job_executing(self, ctx):
         """Scan of the proc registry (reference web/job.go:278-337)."""

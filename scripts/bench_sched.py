@@ -475,17 +475,260 @@ def run_bench(n_jobs, n_nodes, steps, window_s=4, on_log=print):
     return out
 
 
+def seed_dag(store, ks, n_jobs, n_nodes, fan_in, on_log):
+    """3-stage fan-out/fan-in DAG in one group: stage 1 (~40%) are
+    time-triggered sources (a never-in-bench cron — the bench drives
+    their completions by writing dep/ events, standing in for agent
+    completions); stage 2 (~40%) each depend on ``fan_in`` stage-1 jobs;
+    stage 3 (the rest) each depend on ``fan_in`` stage-2 jobs.  All jobs
+    are Common kind so every fire publishes ONE broadcast key per
+    (second, job) — countable per job for the exactly-once check."""
+    node_ids = [f"dn{i:05d}" for i in range(n_nodes)]
+    store.put_many([(ks.node_key(n), "bench:1") for n in node_ids])
+    n1 = max(fan_in, int(n_jobs * 0.4))
+    n2 = max(1, int(n_jobs * 0.4))
+    n3 = max(1, n_jobs - n1 - n2)
+    stages = ([f"s1j{i}" for i in range(n1)],
+              [f"s2j{i}" for i in range(n2)],
+              [f"s3j{i}" for i in range(n3)])
+    on_log(f"seeding DAG: {n1} sources -> {n2} mid -> {n3} sinks "
+           f"(fan-in {fan_in}) across {n_nodes} nodes")
+    items = []
+    for i, jid in enumerate(stages[0]):
+        items.append((f"{ks.cmd}dag/{jid}",
+                      f'{{"name":"{jid}","command":"true","kind":0,'
+                      f'"rules":[{{"id":"r","timer":"0 0 0 29 2 ?",'
+                      f'"nids":["{node_ids[i % n_nodes]}"]}}]}}'))
+    for si, (stage, ups) in enumerate(((stages[1], stages[0]),
+                                       (stages[2], stages[1]))):
+        for i, jid in enumerate(stage):
+            deps = ",".join(f'"{ups[(i * fan_in + k) % len(ups)]}"'
+                            for k in range(fan_in))
+            items.append((
+                f"{ks.cmd}dag/{jid}",
+                f'{{"name":"{jid}","command":"true","kind":0,'
+                f'"deps":{{"on":[{deps}],"misfire":"skip"}},'
+                f'"rules":[{{"id":"r","timer":"@dep",'
+                f'"nids":["{node_ids[i % n_nodes]}"]}}]}}'))
+    for i in range(0, len(items), 20_000):
+        store.put_many(items[i:i + 20_000])
+    return stages
+
+
+def run_dag_bench(n_jobs=50_000, n_nodes=512, rounds=3, window_s=4,
+                  fan_in=4, on_log=print):
+    """Workflow DAG workload: chain latency (upstream-success ->
+    downstream-fire) p50/p99, exactly-once fire counts across rounds,
+    and a warm takeover (delta-chain restore) with a dispatch-divergence
+    check over a window carrying live dep fires."""
+    from cronsun_tpu.bin.common import enable_compile_cache
+    from cronsun_tpu.core import Keyspace
+    from cronsun_tpu.sched import SchedulerService
+    from cronsun_tpu.store.native import NativeStoreServer, find_binary
+    from cronsun_tpu.store.remote import RemoteStore, StoreServer
+
+    enable_compile_cache("~/.cache/cronsun-tpu/xla")
+    import numpy as np
+    import shutil
+    import tempfile
+    ks = Keyspace()
+    binary = find_binary()
+    if binary:
+        srv = NativeStoreServer(binary=binary)
+        backend = "native"
+    else:
+        srv = StoreServer().start()
+        backend = "py"
+    out = {"dag_bench_backend": backend, "dag_bench_jobs": n_jobs,
+           "dag_bench_nodes": n_nodes, "dag_bench_rounds": rounds,
+           "dag_bench_fan_in": fan_in}
+    store = RemoteStore(srv.host, srv.port, timeout=600)
+    ckpt_dir = tempfile.mkdtemp(prefix="cronsun-dag-ckpt-")
+    svc = w = store_w = None
+    try:
+        s1, s2, s3 = seed_dag(store, ks, n_jobs, n_nodes, fan_in, on_log)
+        out["dag_stage_sizes"] = [len(s1), len(s2), len(s3)]
+        t0 = time.time()
+        svc = SchedulerService(store, job_capacity=n_jobs + 1024,
+                               node_capacity=n_nodes, window_s=window_s,
+                               dispatch_ttl=3600.0, node_id="dag-A",
+                               checkpoint_dir=ckpt_dir)
+        out["dag_load_s"] = round(time.time() - t0, 2)
+        t0 = time.time()
+        svc.step()                       # first step pays the compile
+        svc._builder.flush()
+        out["dag_first_step_s"] = round(time.time() - t0, 2)
+        svc.reset_latency_stats()
+        bcast = ks.dispatch_all
+
+        def stage_counts():
+            c2 = c3 = 0
+            per_job = {}
+            for kv in store.get_prefix(bcast):
+                jid = kv.key.rsplit("/", 1)[1]
+                per_job[jid] = per_job.get(jid, 0) + 1
+                if jid.startswith("s2"):
+                    c2 += 1
+                elif jid.startswith("s3"):
+                    c3 += 1
+            return c2, c3, per_job
+
+        def drive_round(events, expect_fn, timeout=120.0):
+            """Write the upstream completions, then step until the
+            expected downstream fires are all VISIBLE in the store;
+            returns wall-ms marks at first/50%/99%/100% of the fires."""
+            t0 = time.perf_counter()
+            for i in range(0, len(events), 20_000):
+                store.put_many(events[i:i + 20_000])
+            marks = {}
+            want = expect_fn()[1]
+            while time.perf_counter() - t0 < timeout:
+                svc.step()
+                svc._builder.flush()
+                svc.publisher.flush()
+                got, want = expect_fn()
+                ms = (time.perf_counter() - t0) * 1e3
+                if got > 0:
+                    marks.setdefault("first", ms)
+                if got >= want * 0.5:
+                    marks.setdefault("p50", ms)
+                if got >= int(want * 0.99):
+                    marks.setdefault("p99", ms)
+                if got >= want:
+                    marks.setdefault("full", ms)
+                    break
+                time.sleep(0.02)
+            return marks
+
+        lat = {"first": [], "p50": [], "p99": [], "full": []}
+        incomplete = 0
+        for r in range(rounds):
+            # virtual round epochs: the planner runs ahead of wall
+            # clock under tight stepping, and a round's scheduled epoch
+            # must land beyond every chain's last fire
+            ep1 = (svc._next_epoch or int(time.time())) + window_s
+            base2, base3, _ = stage_counts()
+            m = drive_round(
+                [(ks.dep_key("dag", j), f"{ep1}|ok") for j in s1],
+                lambda: (stage_counts()[0] - base2, len(s2)))
+            for k, v in m.items():
+                lat[k].append(v)
+            if "full" not in m:
+                incomplete += 1
+            ep2 = (svc._next_epoch or int(time.time())) + window_s
+            m = drive_round(
+                [(ks.dep_key("dag", j), f"{ep2}|ok") for j in s2],
+                lambda: (stage_counts()[1] - base3, len(s3)))
+            for k, v in m.items():
+                lat[k].append(v)
+            if "full" not in m:
+                incomplete += 1
+            on_log(f"round {r + 1}/{rounds}: chain full in "
+                   f"{m.get('full', float('nan')):.0f} ms")
+
+        # ---- exactly-once across every round ------------------------
+        _c2, _c3, per_job = stage_counts()
+        dup = miss = 0
+        for jid in s2 + s3:
+            c = per_job.get(jid, 0)
+            dup += max(0, c - rounds)
+            miss += max(0, rounds - c)
+        out["dag_duplicate_fires"] = dup
+        out["dag_missing_fires"] = miss
+        out["dag_fires_total"] = sum(
+            per_job.get(j, 0) for j in s2 + s3)
+        out["dag_expected_fires"] = rounds * (len(s2) + len(s3))
+        out["dag_incomplete_rounds"] = incomplete
+        out["dag_publish_failures"] = \
+            svc.publisher.stats["publish_failures"]
+        # chain latency: upstream-success -> downstream-fire (wall ms
+        # from the completion batch landing to the fires being VISIBLE)
+        for k in ("first", "p50", "p99", "full"):
+            if lat[k]:
+                out[f"dag_chain_{k}_ms"] = round(
+                    float(np.median(lat[k])), 1)
+        snap = svc.metrics_snapshot()
+        out["dag_step_p50_ms"] = snap["sched_step_p50_ms"]
+        out["dag_step_p99_ms"] = snap["sched_step_p99_ms"]
+        out["dag_dep_jobs"] = snap["dep_jobs"]
+
+        # ---- warm takeover: delta-chain restore, zero divergence ----
+        # one more pending round makes the compared window carry LIVE
+        # dep fires (a quiet window would only prove time triggers)
+        ep = (svc._next_epoch or int(time.time())) + window_s
+        store.put_many([(ks.dep_key("dag", j), f"{ep}|ok") for j in s1])
+        svc.drain_watches()
+        svc._flush_device()
+        t0 = time.time()
+        save = svc.checkpoint_save(kind="full")
+        out["dag_checkpoint_save_s"] = round(time.time() - t0, 2)
+        store_w = RemoteStore(srv.host, srv.port, timeout=600)
+        t0 = time.time()
+        w = SchedulerService(store_w, job_capacity=n_jobs + 1024,
+                             node_capacity=n_nodes, window_s=window_s,
+                             dispatch_ttl=3600.0, node_id="dag-W",
+                             checkpoint_dir=ckpt_dir)
+        out["dag_warm_takeover_s"] = round(time.time() - t0, 2)
+        out["dag_warm_restored"] = 1 if w.checkpoint_restored else 0
+        plan_ep = ep + window_s
+
+        def build(s):
+            secs, acct = [], []
+            for p in s.planner.plan_window(plan_ep, window_s):
+                s._build_plan_orders(p, secs, acct)
+            return sorted((e, k, v) for e, os_ in secs for k, v in os_)
+        cold_orders = build(svc)
+        warm_orders = build(w)
+        out["dag_warm_divergence_orders"] = sum(
+            1 for x, y in zip(cold_orders, warm_orders) if x != y
+        ) + abs(len(cold_orders) - len(warm_orders))
+        out["dag_warm_window_orders"] = len(cold_orders)
+        out["dag_warm_window_dep_fires"] = sum(
+            1 for _e, k, _v in cold_orders
+            if k.rsplit("/", 1)[1].startswith(("s2", "s3")))
+        on_log(f"warm takeover {out['dag_warm_takeover_s']}s "
+               f"(restored={out['dag_warm_restored']}, rev "
+               f"{save['rev']}), divergence "
+               f"{out['dag_warm_divergence_orders']}/"
+               f"{len(cold_orders)} orders "
+               f"({out['dag_warm_window_dep_fires']} dep fires in the "
+               f"compared window)")
+    finally:
+        if w is not None:
+            w.stop()
+        if store_w is not None:
+            store_w.close()
+        if svc is not None:
+            svc.stop()
+        store.close()
+        srv.stop()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=100_000)
     ap.add_argument("--nodes", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--dag", action="store_true",
+                    help="run the workflow DAG workload (chain latency "
+                         "+ exactly-once + warm takeover) instead of "
+                         "the step/failover bench")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="--dag: completion rounds to drive")
+    ap.add_argument("--fan-in", type=int, default=4,
+                    help="--dag: upstreams per dependent job")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
-    res = run_bench(args.jobs, args.nodes, args.steps, args.window,
-                    on_log=lambda *a: print(*a, file=sys.stderr,
-                                            flush=True))
+    on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    if args.dag:
+        res = run_dag_bench(args.jobs, args.nodes, args.rounds,
+                            args.window, args.fan_in, on_log=on_log)
+    else:
+        res = run_bench(args.jobs, args.nodes, args.steps, args.window,
+                        on_log=on_log)
     out = json.dumps(res, indent=1)
     if args.json:
         with open(args.json, "w") as f:

@@ -262,6 +262,27 @@ def test_logd_shard_scaling():
         "agent) is hogging the drain")
 
 
+def test_bench_sched_dag_smoke():
+    """Tier-1 smoke for the workflow-DAG bench: a quick 3-stage
+    fan-out/fan-in workload must complete with NONZERO chain fires
+    delivered exactly once (no duplicates, no misses), zero publish
+    failures, and a zero-divergence warm takeover — the DAG plane and
+    the bench that measures it both stay alive."""
+    import bench_sched
+    res = bench_sched.run_dag_bench(
+        n_jobs=300, n_nodes=8, rounds=2, window_s=2,
+        on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res["dag_fires_total"] > 0
+    assert res["dag_fires_total"] == res["dag_expected_fires"]
+    assert res["dag_duplicate_fires"] == 0
+    assert res["dag_missing_fires"] == 0
+    assert res["dag_incomplete_rounds"] == 0
+    assert res["dag_publish_failures"] == 0
+    assert res["dag_warm_restored"] == 1
+    assert res["dag_warm_divergence_orders"] == 0
+    assert res["dag_chain_p99_ms"] > 0
+
+
 def test_bench_query_smoke():
     """Tier-1 smoke for the read-plane bench: a short run against one
     py-logd shard with concurrent readers and a full-drain writer must

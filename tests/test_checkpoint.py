@@ -750,8 +750,12 @@ def test_sched_periodic_checkpoint(sched_world):
     assert not os.path.exists(os.path.join(d, "sched.ckpt"))
     clock[0] += 31.0
     a.step()
+    # periodic full saves serialize on the background writer (the step
+    # thread only pays barrier + capture): join it before asserting
+    a._ckpt_join()
     assert os.path.exists(os.path.join(d, "sched.ckpt"))
     assert a.metrics_snapshot()["checkpoint_saves_total"] == 1
+    assert a.metrics_snapshot()["checkpoint_bg_writes_total"] == 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,668 @@
+"""Workflow DAG plane: validation, on-device dep evaluation, scheduler
+plumbing, checkpoint interaction, and the delta-chain compactor.
+
+The trigger semantics under test (ops/deps.py docstring is the spec):
+a dep-triggered job fires the tick after ALL upstream columns' success
+epochs pass its own last-fire epoch, under the misfire policy; dep-free
+tables must plan bit-identically to the pre-DAG program.
+"""
+
+import json
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from cronsun_tpu.core import Keyspace, ValidationError, validate_dag
+from cronsun_tpu.core.models import DepSpec, Job, MAX_DEPS
+from cronsun_tpu.ops.deps import (
+    NEVER, POLICY_FIRE, POLICY_HOLD, POLICY_SKIP, ReferenceDagEvaluator)
+from cronsun_tpu.ops.planner import TickPlanner
+from cronsun_tpu.ops.schedule_table import (
+    DEP_BROKEN, FRAMEWORK_EPOCH, build_table, make_dep_row, update_rows)
+from cronsun_tpu.store.memstore import MemStore
+
+KS = Keyspace()
+T0 = 1_753_000_000          # a safely modern epoch, mid-minute
+NEVER_CRON = "0 0 0 29 2 ?"  # Feb 29 midnight: never fires in a test
+
+
+# ---------------------------------------------------------------------------
+# model validation
+# ---------------------------------------------------------------------------
+
+def _dep_job(jid="b", on=("a",), misfire="skip", mif=0, rules=None):
+    return Job(id=jid, name=jid, group="g", command="true",
+               deps=DepSpec(on=list(on), misfire=misfire,
+                            max_in_flight=mif),
+               rules=rules if rules is not None else
+               [__import__("cronsun_tpu.core.models",
+                           fromlist=["JobRule"]).JobRule(
+                   id="r", timer="@dep", nids=["n1"])])
+
+
+def test_depspec_validation_errors():
+    with pytest.raises(ValidationError):
+        _dep_job(on=()).check()                      # empty
+    with pytest.raises(ValidationError):
+        _dep_job(on=[f"u{i}" for i in range(MAX_DEPS + 1)]).check()
+    with pytest.raises(ValidationError):
+        _dep_job(on=("a", "a")).check()              # duplicate
+    with pytest.raises(ValidationError):
+        _dep_job(on=("other/x",)).check()            # cross-group
+    with pytest.raises(ValidationError):
+        _dep_job(misfire="explode").check()
+    with pytest.raises(ValidationError):
+        _dep_job(mif=-1).check()
+    with pytest.raises(ValidationError):
+        _dep_job(jid="b", on=("b",)).check()         # self-dep
+    with pytest.raises(ValidationError):
+        _dep_job(rules=[]).check()                   # placement needed
+    # a cron timer on a dep job's rule conflicts
+    from cronsun_tpu.core.models import JobRule
+    with pytest.raises(ValidationError):
+        _dep_job(rules=[JobRule(id="r", timer="@every 5s",
+                                nids=["n1"])]).check()
+    # @dep timer without a deps spec
+    j = Job(id="x", name="x", group="g", command="true",
+            rules=[JobRule(id="r", timer="@dep", nids=["n1"])])
+    with pytest.raises(ValidationError):
+        j.check()
+    ok = _dep_job()
+    ok.check()
+    assert ok.rules[0].timer == "@dep"
+
+
+def test_validate_dag_cycle_and_unknown():
+    with pytest.raises(ValidationError, match="cycle"):
+        validate_dag({"a": ["b"], "b": ["c"], "c": ["a"]},
+                     {"a", "b", "c"}, "a")
+    with pytest.raises(ValidationError, match="unknown upstream"):
+        validate_dag({"a": ["zz"]}, {"a"}, "a")
+    # a diamond is NOT a cycle
+    validate_dag({"d": ["b", "c"], "b": ["a"], "c": ["a"]},
+                 {"a", "b", "c", "d"}, "d")
+
+
+def test_validate_dag_shared_substructure_is_linear():
+    """A ladder of diamonds (each level depends on BOTH jobs of the
+    previous) has 2^N paths but O(N) nodes — validation must memoize
+    fully-checked subtrees or a web PUT hangs the API tier."""
+    dep_map, ids = {}, {"l0a", "l0b"}
+    for lvl in range(1, 60):
+        for side in "ab":
+            jid = f"l{lvl}{side}"
+            dep_map[jid] = [f"l{lvl - 1}a", f"l{lvl - 1}b"]
+            ids.add(jid)
+    t0 = time.perf_counter()
+    validate_dag(dep_map, ids, "l59a")
+    assert time.perf_counter() - t0 < 1.0
+    # cycles through shared structure still refuse
+    dep_map["l0a"] = ["l59a"]
+    with pytest.raises(ValidationError, match="cycle"):
+        validate_dag(dep_map, ids, "l59a")
+
+
+def test_job_wire_roundtrip_with_deps():
+    j = _dep_job(misfire="hold", mif=3)
+    j.check()
+    j2 = Job.from_json(j.to_json())
+    assert j2.deps.on == ["a"]
+    assert j2.deps.misfire == "hold"
+    assert j2.deps.max_in_flight == 3
+    # dep-less jobs keep the pre-DAG wire format exactly
+    plain = Job(id="p", name="p", group="g", command="true")
+    assert "deps" not in json.loads(plain.to_json())
+
+
+# ---------------------------------------------------------------------------
+# planner-level dep evaluation
+# ---------------------------------------------------------------------------
+
+def _planner(specs, deps=None, enable=True):
+    """Planner over ``specs`` rows; ``deps`` = {row: (cols, policy)}."""
+    p = TickPlanner(job_capacity=max(64, len(specs)), node_capacity=32)
+    t = build_table(specs, capacity=p.J)
+    if deps:
+        rows = sorted(deps)
+        t = update_rows(t, np.asarray(rows, np.int32),
+                        [make_dep_row(deps[r][0], deps[r][1])
+                         for r in rows])
+    p.set_table(t)
+    p.set_eligibility_rows(
+        np.arange(p.J), np.full((p.J, p.N // 32), 0xFFFFFFFF, np.uint32))
+    p.set_node_capacity(np.arange(p.N), np.full(p.N, 1 << 16))
+    if enable:
+        p.set_dep_enabled(True)
+    return p
+
+
+def _fires(plans):
+    return [sorted(pl.fired.tolist()) for pl in plans]
+
+
+def rel(epoch):
+    return epoch - FRAMEWORK_EPOCH
+
+
+def test_dep_free_table_bit_identical():
+    """Dep-free tables plan BIT-IDENTICALLY with the dep machinery
+    armed and disarmed — the new matrix is free when unused.  The
+    disarmed program is structurally dep-free (no cronsun.deps scope in
+    the lowered module), i.e. the exact pre-DAG executable shape."""
+    rng = np.random.default_rng(3)
+    specs = [f"*/{int(k)} * * * * *" for k in rng.integers(2, 9, 40)] + \
+        [f"@every {int(k)}s" for k in rng.integers(2, 30, 24)]
+    a = _planner(specs, enable=False)
+    b = _planner(specs, enable=True)
+    for w0 in (T0, T0 + 7, T0 + 61):
+        pa = a.plan_window(w0, 4)
+        pb = b.plan_window(w0, 4)
+        for x, y in zip(pa, pb):
+            assert x.fired.tolist() == y.fired.tolist()
+            assert x.assigned.tolist() == y.assigned.tolist()
+            assert (x.overflow, x.total_fired, x.n_excl) == \
+                (y.overflow, y.total_fired, y.n_excl)
+    import jax
+    import jax.numpy as jnp
+    from cronsun_tpu.ops.planner import _plan_window_step
+    from cronsun_tpu.ops.timecal import window_fields
+    f = window_fields(T0, 2, tz=a.tz)
+    fields_w = np.stack(
+        [f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+         np.arange(2, dtype=np.int64) + rel(T0)], axis=1).astype(np.int32)
+    args = (a.table, jnp.asarray(fields_w), a.elig, a.exclusive, a.cost,
+            a.load + 0.0, a.rem_cap | 0, a.dep_succ, a.dep_fail,
+            a.dep_block, a.dep_last_fire | 0)
+    kw = dict(kx=2048, kc=2048, rounds=2, impl="jnp")
+    off = jax.jit(_plan_window_step,
+                  static_argnames=("kx", "kc", "rounds", "impl",
+                                   "use_deps")
+                  ).lower(*args, use_deps=False, **kw).as_text()
+    on = jax.jit(_plan_window_step,
+                 static_argnames=("kx", "kc", "rounds", "impl",
+                                  "use_deps")
+                 ).lower(*args, use_deps=True, **kw).as_text()
+    # structural free-ness: the [J, MAX_DEPS] dep matrix appears in the
+    # disarmed module only as an (unused) parameter — never in an op
+    sig = f"{a.J}x{MAX_DEPS}xi32"
+    assert off.count(sig) < on.count(sig)
+    assert off.count(sig) <= 2      # the arg signature mentions, no ops
+
+
+def test_dep_fires_first_tick_and_once_per_round():
+    # row 0 = upstream (never-firing cron), row 1 depends on it
+    p = _planner([NEVER_CRON, NEVER_CRON],
+                 deps={1: ([0], POLICY_SKIP)})
+    assert _fires(p.plan_window(T0, 3)) == [[], [], []]
+    # upstream round completed at T0 - 1: the dep fires at the FIRST
+    # second of the next planned window — the tick after the fold
+    p.set_dep_epochs([0], [rel(T0 - 1)], [NEVER])
+    assert _fires(p.plan_window(T0 + 3, 3)) == [[1], [], []]
+    # no refire without a new upstream round
+    assert _fires(p.plan_window(T0 + 6, 3)) == [[], [], []]
+    # next round -> next fire
+    p.set_dep_epochs([0], [rel(T0 + 8)], [NEVER])
+    assert _fires(p.plan_window(T0 + 9, 3)) == [[1], [], []]
+
+
+def test_misfire_policies():
+    # rows 1..3 depend on row 0 with skip / fire / hold
+    p = _planner([NEVER_CRON] * 4,
+                 deps={1: ([0], POLICY_SKIP), 2: ([0], POLICY_FIRE),
+                       3: ([0], POLICY_HOLD)})
+    # upstream's round FAILED
+    p.set_dep_epochs([0], [NEVER], [rel(T0 - 1)])
+    # fire-anyway fires; skip consumes the round silently; hold parks
+    assert _fires(p.plan_window(T0, 2)) == [[2], []]
+    # a later SUCCESSFUL round satisfies everyone (skip re-armed, hold
+    # released, fire-anyway sees a fresh round)
+    p.set_dep_epochs([0], [rel(T0 + 5)], [NEVER])
+    assert _fires(p.plan_window(T0 + 6, 2)) == [[1, 2, 3], []]
+
+
+def test_fan_in_needs_every_upstream():
+    p = _planner([NEVER_CRON] * 3, deps={2: ([0, 1], POLICY_SKIP)})
+    p.set_dep_epochs([0], [rel(T0 - 2)], [NEVER])
+    assert _fires(p.plan_window(T0, 2)) == [[], []]     # one of two
+    p.set_dep_epochs([1], [rel(T0 - 1)], [NEVER])
+    assert _fires(p.plan_window(T0 + 2, 2)) == [[2], []]
+
+
+def test_dep_block_and_broken_upstream():
+    p = _planner([NEVER_CRON] * 3,
+                 deps={1: ([0], POLICY_SKIP),
+                       2: ([DEP_BROKEN], POLICY_SKIP)})
+    p.set_dep_epochs([0, 1, 2], [rel(T0 - 1)] * 3, [NEVER] * 3)
+    p.set_dep_block([1], [True])
+    # blocked row holds; broken upstream NEVER satisfies
+    assert _fires(p.plan_window(T0, 2)) == [[], []]
+    p.set_dep_block([1], [False])
+    assert _fires(p.plan_window(T0 + 2, 2)) == [[1], []]
+    assert _fires(p.plan_window(T0 + 60, 4)) == [[], [], [], []]
+
+
+def test_randomized_differential_vs_reference():
+    """The device evaluation against the pure-Python reference DAG
+    evaluator: random layered DAGs, random completion streams (success
+    and failure), random policies, window-carried last_fire."""
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        n = 24
+        deps = {}
+        for row in range(6, n):
+            k = int(rng.integers(1, min(4, row)))
+            ups = rng.choice(row, size=k, replace=False).tolist()
+            pol = int(rng.integers(0, 3))
+            deps[row] = (ups, pol)
+        p = _planner([NEVER_CRON] * n, deps=deps)
+        ref = ReferenceDagEvaluator(deps)
+        t = T0
+        for it in range(12):
+            # a burst of completion events strictly older than the
+            # window about to be planned
+            for _ in range(int(rng.integers(1, 6))):
+                row = int(rng.integers(0, n))
+                ok = bool(rng.random() < 0.7)
+                ev = rel(t - int(rng.integers(1, 3)))
+                p.set_dep_epochs([row], [ev if ok else NEVER],
+                                 [NEVER if ok else ev])
+                ref.complete(row, ev, ok)
+            W = int(rng.integers(1, 4))
+            plans = p.plan_window(t, W)
+            for w in range(W):
+                want = ref.tick(rel(t + w))
+                got = sorted(plans[w].fired.tolist())
+                assert got == want, (
+                    f"trial {trial} it {it} w {w}: device {got} != "
+                    f"reference {want}")
+            t += W
+
+
+# ---------------------------------------------------------------------------
+# scheduler plumbing (MemStore end-to-end)
+# ---------------------------------------------------------------------------
+
+def _put_job(store, jid, doc):
+    store.put(f"{KS.cmd}dag/{jid}", json.dumps(doc))
+
+
+def _cron_job(jid, timer=NEVER_CRON):
+    return {"name": jid, "command": "true", "kind": 0,
+            "rules": [{"id": "r", "timer": timer, "nids": ["n1"]}]}
+
+
+def _dep_doc(jid, on, misfire="skip", mif=0):
+    return {"name": jid, "command": "true", "kind": 0,
+            "deps": {"on": list(on), "misfire": misfire,
+                     "max_in_flight": mif},
+            "rules": [{"id": "r", "timer": "@dep", "nids": ["n1"]}]}
+
+
+def _mk_svc(store, node_id="S", **kw):
+    from cronsun_tpu.sched import SchedulerService
+    return SchedulerService(store, ks=KS, job_capacity=256,
+                            node_capacity=32, window_s=2,
+                            dispatch_ttl=3600.0, node_id=node_id, **kw)
+
+
+def _dep_orders(store):
+    return sorted(kv.key for kv in store.get_prefix(KS.dispatch))
+
+
+@pytest.fixture
+def world():
+    store = MemStore()
+    store.put(KS.node_key("n1"), "1")
+    svcs = []
+    yield store, svcs
+    for s in svcs:
+        s.stop()
+
+
+def _drive(svc, n=6):
+    total = 0
+    for _ in range(n):
+        total += svc.step()
+    return total
+
+
+def test_sched_dep_end_to_end_exactly_once(world):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    assert _drive(svc) == 0
+    assert svc.metrics_snapshot()["dep_jobs"] == 1
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 5}|ok")
+    assert _drive(svc) == 1
+    orders = _dep_orders(store)
+    assert len(orders) == 1 and "/B" in orders[0]
+    # one round -> one fire, no matter how many further windows plan
+    assert _drive(svc) == 0
+
+
+def test_sched_fan_in_and_failure_policies(world):
+    store, svcs = world
+    _put_job(store, "A1", _cron_job("A1"))
+    _put_job(store, "A2", _cron_job("A2"))
+    _put_job(store, "Bskip", _dep_doc("Bskip", ["A1", "A2"]))
+    _put_job(store, "Bfire", _dep_doc("Bfire", ["A1", "A2"],
+                                      misfire="fire"))
+    _put_job(store, "Bhold", _dep_doc("Bhold", ["A1", "A2"],
+                                      misfire="hold"))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    now = int(time.time())
+    store.put(KS.dep_key("dag", "A1"), f"{now + 5}|ok")
+    assert _drive(svc) == 0                  # A2 still pending
+    store.put(KS.dep_key("dag", "A2"), f"{now + 6}|fail")
+    # round complete but A2 failed: fire-anyway fires, skip consumes,
+    # hold parks
+    assert _drive(svc) == 1
+    assert sum("Bfire" in k for k in _dep_orders(store)) == 1
+    # A2 retries successfully: hold releases; skip re-armed; fire sees
+    # a fresh round
+    store.put(KS.dep_key("dag", "A2"), f"{now + 30}|ok")
+    store.put(KS.dep_key("dag", "A1"), f"{now + 30}|ok")
+    assert _drive(svc) == 3
+    ks_counts = {j: sum(f"/{j}" in k for k in _dep_orders(store))
+                 for j in ("Bskip", "Bfire", "Bhold")}
+    assert ks_counts == {"Bskip": 1, "Bfire": 2, "Bhold": 1}
+
+
+def test_sched_max_in_flight_gate(world):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"], mif=1))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    # a running execution of B saturates its cap
+    lease = store.grant(60)
+    store.put(KS.proc_key("n1", "dag", "B", 77), "x", lease=lease)
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 5}|ok")
+    assert _drive(svc) == 0
+    assert svc.metrics_snapshot()["dep_blocked_jobs"] == 1
+    # the execution finishes -> the held round fires
+    store.delete(KS.proc_key("n1", "dag", "B", 77))
+    assert _drive(svc) == 1
+    assert svc.metrics_snapshot()["dep_blocked_jobs"] == 0
+
+
+def test_sched_upstream_churn_reresolves(world):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    # upstream deleted: B's column goes BROKEN — it must hold even
+    # though a (stale) completion event arrives for the old id
+    store.delete(f"{KS.cmd}dag/A")
+    _drive(svc, 2)
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time())}|ok")
+    assert _drive(svc) == 0
+    # upstream re-created: the dep re-resolves, and a FRESH round fires
+    _put_job(store, "A", _cron_job("A"))
+    _drive(svc, 2)
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 60}|ok")
+    assert _drive(svc) == 1
+
+
+def test_sched_upstream_rule_churn_keeps_round(world):
+    """Rule churn on an upstream must NOT lose its latest completed
+    round: the fresh row re-seeds from the completion mirror, so a
+    dependent that had not yet consumed the round still fires."""
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    svc.drain_watches()
+    svc._flush_device()
+    ep = int(time.time()) + 5
+    store.put(KS.dep_key("dag", "A"), f"{ep}|ok")
+    svc.drain_watches()            # fold the round; do NOT plan yet
+    # rewrite A with a DIFFERENT rule id: old row released (epochs
+    # reset), new row acquired — must re-seed from _dep_latest
+    store.put(f"{KS.cmd}dag/A", json.dumps(
+        {"name": "A", "command": "true", "kind": 0,
+         "rules": [{"id": "r2", "timer": NEVER_CRON, "nids": ["n1"]}]}))
+    assert _drive(svc) == 1        # B still fires for round ep
+
+
+def test_sched_dep_less_completions_queue_no_scatters(world):
+    """Completion events for jobs nothing depends on cost the mirror
+    fold only — no device scatter per flush on a dep-free fleet."""
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 5}|ok")
+    svc.drain_watches()
+    assert svc._dep_latest          # mirror folded
+    assert not svc._dep_epoch_updates
+    # a dependent registering LATER seeds the upstream's rows
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc.drain_watches()
+    assert svc._dep_epoch_updates
+
+
+def test_sched_dep_free_never_arms_the_kernel(world):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A", timer="@every 2s"))
+    svc = _mk_svc(store)
+    svcs.append(svc)
+    _drive(svc, 3)
+    assert svc.planner.dep_enabled is False
+
+
+def test_sched_checkpoint_restores_dep_state(world, tmp_path):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store, checkpoint_dir=str(tmp_path))
+    svcs.append(svc)
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 5}|ok")
+    assert _drive(svc) == 1                   # B fired once
+    svc.checkpoint_save(kind="full")
+    w = _mk_svc(store, node_id="W", checkpoint_dir=str(tmp_path))
+    svcs.append(w)
+    assert w.checkpoint_restored
+    assert w._dep_latest == svc._dep_latest
+    for f in ("dep_succ", "dep_fail", "dep_last_fire", "dep_block"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(w.planner, f)),
+            np.asarray(getattr(svc.planner, f)), err_msg=f)
+    # the restored standby must NOT re-fire B's already-consumed round
+    # (last_fire rode the checkpoint)
+    before = len(_dep_orders(store))
+    svc.stop()
+    svcs.remove(svc)
+    for _ in range(8):
+        w.step()
+    assert w.is_leader
+    assert len(_dep_orders(store)) == before
+    # ...but a genuinely new round fires exactly once on the new leader
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time()) + 90}|ok")
+    fired = sum(w.step() for _ in range(6))
+    assert fired == 1
+
+
+def test_sched_delta_chain_carries_dep_events(world, tmp_path):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store, checkpoint_dir=str(tmp_path))
+    svcs.append(svc)
+    out = svc.checkpoint_save(kind="full")
+    assert out["kind"] == "full"
+    ep = int(time.time())
+    store.put(KS.dep_key("dag", "A"), f"{ep}|ok")
+    svc.drain_watches()
+    out2 = svc.checkpoint_save(kind="delta")
+    assert out2["kind"] == "delta"
+    w = _mk_svc(store, node_id="W", checkpoint_dir=str(tmp_path))
+    svcs.append(w)
+    assert w.checkpoint_restored
+    # the dep event arrived ONLY through the delta chain fold
+    assert w._dep_latest[("dag", "B")] if ("dag", "B") in w._dep_latest \
+        else True
+    assert w._dep_latest[("dag", "A")][0] == ep - FRAMEWORK_EPOCH
+    row = next(iter(
+        w.rows.by_cmd[k] for k in w.rows.by_cmd if k[1] == "A"))
+    assert int(np.asarray(w.planner.dep_succ)[row]) == \
+        ep - FRAMEWORK_EPOCH
+
+
+# ---------------------------------------------------------------------------
+# double-buffered full saves
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_full_save_async_then_delta(world, tmp_path):
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A"))
+    svc = _mk_svc(store, checkpoint_dir=str(tmp_path))
+    svcs.append(svc)
+    out = svc.checkpoint_save(kind="full", wait=False)
+    assert out["kind"] == "full"
+    svc._ckpt_join()
+    path = os.path.join(str(tmp_path), "sched.ckpt")
+    assert os.path.exists(path)
+    assert svc.metrics_snapshot()["checkpoint_last_serialize_ms"] >= 0
+    # the chain armed at CAPTURE time: a delta extends the async base
+    # (checkpoint_save joins the writer first)
+    _put_job(store, "C", _cron_job("C"))
+    svc.drain_watches()
+    out2 = svc.checkpoint_save(kind="delta")
+    assert out2["kind"] == "delta"
+    w = _mk_svc(store, node_id="W", checkpoint_dir=str(tmp_path))
+    svcs.append(w)
+    assert w.checkpoint_restored
+    assert ("dag", "C") in w.jobs
+
+
+# ---------------------------------------------------------------------------
+# delta-chain compaction
+# ---------------------------------------------------------------------------
+
+def _synthetic_chain(tmp_path):
+    from cronsun_tpu.checkpoint import save_checkpoint, save_delta
+    base = os.path.join(str(tmp_path), "sched.ckpt")
+    save_checkpoint(base, {"chain": "nonce-1", "rev": 5})
+    save_delta(base, "nonce-1", 1, 5, 7, [("jobs", "PUT", "k1", "v1")])
+    save_delta(base, "nonce-1", 2, 7, 9, [("jobs", "PUT", "k2", "v2"),
+                                          ("deps", "PUT", "k3", "v3")])
+    save_delta(base, "nonce-1", 3, 9, 11, [("nodes", "DELETE", "k4", "")])
+    return base
+
+
+def test_compact_folds_chain_preserving_order(tmp_path):
+    from cronsun_tpu.checkpoint import (
+        compact_delta_chain, list_delta_seqs, load_checkpoint,
+        load_delta_chain)
+    base = _synthetic_chain(tmp_path)
+    out = compact_delta_chain(base)
+    assert out["compacted"] and out["folded"] == 3 and out["events"] == 4
+    assert list_delta_seqs(base) == [1]
+    deltas = load_delta_chain(base, load_checkpoint(base))
+    assert len(deltas) == 1
+    d = deltas[0]
+    assert d["prev_rev"] == 5 and d["rev"] == 11
+    assert [e[2] for e in d["events"]] == ["k1", "k2", "k3", "k4"]
+    # idempotent: a second run is a no-op
+    assert compact_delta_chain(base)["compacted"] is False
+
+
+def test_compact_refuses_invalid_chains(tmp_path):
+    from cronsun_tpu.checkpoint import CheckpointError, compact_delta_chain
+    base = _synthetic_chain(tmp_path)
+    os.remove(base + ".d2")                      # gap
+    with pytest.raises(CheckpointError, match="gaps"):
+        compact_delta_chain(base)
+
+    base2 = _synthetic_chain(tmp_path / "b2")
+    rec = pickle.load(open(base2 + ".d2", "rb"))
+    rec["chain"] = "foreign"
+    pickle.dump(rec, open(base2 + ".d2", "wb"))
+    with pytest.raises(CheckpointError, match="chain"):
+        compact_delta_chain(base2)
+
+    base3 = _synthetic_chain(tmp_path / "b3")
+    with open(base3 + ".d3", "wb") as f:
+        f.write(b"\x80\x04 torn")
+    with pytest.raises(CheckpointError, match="unreadable"):
+        compact_delta_chain(base3)
+    # every refusal left the files untouched
+    from cronsun_tpu.checkpoint import list_delta_seqs
+    assert list_delta_seqs(base3) == [1, 2, 3]
+
+
+def test_compact_live_restore_equivalence(world, tmp_path):
+    """base + N deltas and base + compacted(1 delta) restore the SAME
+    scheduler: identical jobs, dep mirrors, and planned orders."""
+    store, svcs = world
+    _put_job(store, "A", _cron_job("A", timer="@every 2s"))
+    _put_job(store, "B", _dep_doc("B", ["A"]))
+    svc = _mk_svc(store, checkpoint_dir=str(tmp_path))
+    svcs.append(svc)
+    svc.checkpoint_save(kind="full")
+    _put_job(store, "C", _cron_job("C", timer="@every 3s"))
+    svc.drain_watches()
+    svc.checkpoint_save(kind="delta")
+    store.put(KS.dep_key("dag", "A"), f"{int(time.time())}|ok")
+    _put_job(store, "D", _cron_job("D", timer="@every 4s"))
+    svc.drain_watches()
+    svc.checkpoint_save(kind="delta")
+
+    w1 = _mk_svc(store, node_id="W1", checkpoint_dir=str(tmp_path))
+    svcs.append(w1)
+    from cronsun_tpu.checkpoint import compact_delta_chain
+    out = compact_delta_chain(os.path.join(str(tmp_path), "sched.ckpt"))
+    assert out["folded"] == 2
+    w2 = _mk_svc(store, node_id="W2", checkpoint_dir=str(tmp_path))
+    svcs.append(w2)
+    assert w1.checkpoint_restored and w2.checkpoint_restored
+    assert set(w1.jobs) == set(w2.jobs)
+    assert w1._dep_latest == w2._dep_latest
+    ep = (int(time.time()) // 60 + 2) * 60
+    def orders(s):
+        secs, acct = [], []
+        for p in s.planner.plan_window(ep, 2):
+            s._build_plan_orders(p, secs, acct)
+        return sorted((e, k, v) for e, os_ in secs for k, v in os_)
+    assert orders(w1) == orders(w2)
+
+
+# ---------------------------------------------------------------------------
+# slow-tier gate: the dep matrix is free when unused
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dep_free_tick_p99_unchanged():
+    """Dep-free tables run the use_deps=False program — structurally
+    the pre-DAG executable (no dep ops lowered; pinned by the HLO check
+    in test_dep_free_table_bit_identical).  This gate bounds the wall
+    cost: the dep-free plan's p99 must not exceed the dep-ENABLED
+    (empty-matrix) plan's p99 — i.e. leaving the machinery disarmed
+    never costs more than the armed overhead it exists to avoid."""
+    rng = np.random.default_rng(5)
+    specs = [f"@every {int(k)}s" for k in rng.integers(2, 60, 2048)]
+
+    def p99(planner):
+        planner.plan_window(T0, 4)          # compile
+        xs = []
+        t = T0 + 4
+        for _ in range(60):
+            t0 = time.perf_counter()
+            planner.plan_window(t, 4)
+            xs.append(time.perf_counter() - t0)
+            t += 4
+        return float(np.percentile(xs, 99))
+    off = p99(_planner(specs, enable=False))
+    on = p99(_planner(specs, enable=True))
+    assert off <= on * 1.5 + 0.005, (
+        f"dep-free p99 {off * 1e3:.2f} ms vs dep-enabled "
+        f"{on * 1e3:.2f} ms — the disarmed path regressed")

@@ -639,3 +639,36 @@ def test_session_expiry_slides_on_use(world):
     _t.sleep(1.2)
     code, _ = c2.req("GET", "/v1/jobs")
     assert code == 401, "idle session survived its TTL"
+
+
+def test_dag_group_move_of_upstream_refused(world):
+    """Moving a depended-on job to another group deletes its old-group
+    document — the same chain-break the delete path 409s; the move must
+    refuse identically (no silent DEP_BROKEN dependents)."""
+    store, _, _, c = world
+    c.login()
+    code, _ = c.req("PUT", "/v1/job", {
+        "id": "up", "name": "up", "group": "etl", "command": "true",
+        "rules": [{"id": "r", "timer": "@every 60s", "nids": ["n1"]}]})
+    assert code == 200
+    code, _ = c.req("PUT", "/v1/job", {
+        "id": "down", "name": "down", "group": "etl", "command": "true",
+        "deps": {"on": ["up"]},
+        "rules": [{"id": "r", "timer": "@dep", "nids": ["n1"]}]})
+    assert code == 200
+    code, err = c.req("PUT", "/v1/job", {
+        "id": "up", "name": "up", "group": "other", "command": "true",
+        "oldGroup": "etl",
+        "rules": [{"id": "r", "timer": "@every 60s", "nids": ["n1"]}]})
+    assert code == 409 and "down" in err["error"]
+    assert store.get(KS.job_key("etl", "up")) is not None   # untouched
+    # delete the dependent first -> the move goes through
+    code, _ = c.req("DELETE", "/v1/job/etl-down")
+    assert code == 200
+    code, _ = c.req("PUT", "/v1/job", {
+        "id": "up", "name": "up", "group": "other", "command": "true",
+        "oldGroup": "etl",
+        "rules": [{"id": "r", "timer": "@every 60s", "nids": ["n1"]}]})
+    assert code == 200
+    assert store.get(KS.job_key("etl", "up")) is None
+    assert store.get(KS.job_key("other", "up")) is not None
