@@ -683,6 +683,49 @@ def cmd_logd_reshard(api, args):
               file=sys.stderr)
 
 
+def cmd_fsck(api, args):
+    """Offline global-invariant audit (chaos/invariants.fsck): leaked
+    dispatch reservations, orphan proc entries, fences without
+    execution records, dangling dep completions.  Talks to the store
+    (and optionally logd) shards DIRECTLY, read-only — the same checks
+    the chaos drills gate on, runnable against a live fleet.  Exits
+    nonzero when findings exist."""
+    del api
+    from ..chaos.invariants import fsck, render, to_json
+    from ..core import Keyspace
+    from ..store.sharded import connect_sharded
+    store = sink = None
+    try:
+        try:
+            store = connect_sharded(
+                [a.strip() for a in args.store.split(",") if a.strip()],
+                prefix=args.prefix, token=args.token or "")
+            if args.logsink:
+                from ..logsink.sharded import connect_sharded_sink
+                sink = connect_sharded_sink(
+                    [a.strip() for a in args.logsink.split(",")
+                     if a.strip()],
+                    token=args.token or "")
+            findings = fsck(store, sink=sink,
+                            ks=Keyspace(prefix=args.prefix),
+                            stale_order_s=args.stale_order_s,
+                            fence_settle_s=args.fence_settle_s)
+        except (RuntimeError, ValueError, OSError) as e:
+            raise SystemExit(f"error: {e}")
+    finally:
+        for c in (store, sink):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+    if args.json:
+        print(to_json(findings))
+    else:
+        print(render(findings))
+    raise SystemExit(1 if findings else 0)
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -821,6 +864,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("path", help="checkpoint dir or sched.ckpt path")
     add("configurations", cmd_configurations,
         "security/alarm config exposed to the UI")
+
+    p = add("fsck", cmd_fsck,
+            "offline invariant audit (direct store access, read-only; "
+            "nonzero exit on findings)")
+    p.add_argument("--store", required=True,
+                   help="store address(es), host:port[,host:port...]")
+    p.add_argument("--logsink", default="",
+                   help="logd address(es) for the fence-vs-record "
+                        "cross-check (optional)")
+    p.add_argument("--prefix", default="/cronsun")
+    p.add_argument("--token", default=os.environ.get("CRONSUN_TOKEN", ""),
+                   help="store/logsink shared secret (env CRONSUN_TOKEN)")
+    p.add_argument("--stale-order-s", type=float, default=900.0,
+                   help="dispatch keys older than this count as leaked "
+                        "reservations (default 900)")
+    p.add_argument("--fence-settle-s", type=float, default=60.0,
+                   help="fences older than this must have an execution "
+                        "record (default 60 — must stay BELOW the "
+                        "fence lease lifetime, lock_ttl+60, or the "
+                        "cross-check can never fire)")
 
     dag = sub.add_parser("dag", help="workflow DAG views")
     dsub = dag.add_subparsers(dest="dagcmd", required=True)

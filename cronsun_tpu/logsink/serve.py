@@ -35,6 +35,7 @@ import uuid
 from typing import List, Optional, Tuple
 
 from .. import log
+from ..chaos.hooks import hooks as _chaos
 from ..store.wire import LineJsonHandler
 from .joblog import JobLogStore, LogRecord
 
@@ -343,12 +344,27 @@ class RemoteJobLogStore:
     def _call(self, op: str, *args):
         if self._closed:
             raise LogSinkError("logsink connection closed")
+        # chaos-plane fault point (env-gated off in production): see
+        # store/remote.py — 'timeout' fails before the wire,
+        # 'reply_lost' lets the op apply and fails the reply path (the
+        # indeterminate shape the record flusher's pinned idempotency
+        # tokens exist for), 'delay' stalls the caller
+        act = _chaos.intercept("logsink.rpc", op) if _chaos.armed else None
+        if act is not None:
+            act.pre(LogSinkError, op)
         with self._lock:
             for attempt in (0, 1):
                 try:
                     if self._sock is None:
                         self._connect()
-                    return self._exchange(op, *args)
+                    r = self._exchange(op, *args)
+                    if act is not None:
+                        # LogSinkError, not OSError: the reply is
+                        # "lost" WITHOUT burning the reconnect retry
+                        # (the op applied; the caller's idem ladder
+                        # owns the re-send)
+                        act.post(LogSinkError, op)
+                    return r
                 except (OSError, ValueError) as e:
                     # ValueError covers JSONDecodeError and the
                     # UnicodeDecodeError binary garbage raises

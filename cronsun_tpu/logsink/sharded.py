@@ -71,7 +71,11 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..store.sharded import fnv1a
+from ..core.breaker import BreakerBank, ShardDegradedError  # noqa: F401
+# (ShardDegradedError re-exported: the error create_job_logs raises
+# fail-fast into the agents' retry ladders when a shard's breaker is
+# open)
+from ..store.sharded import breaker_env_deadline, fnv1a
 from .joblog import LogRecord
 
 LOG_HASH_SCHEME = "fnv1a-job-v1"
@@ -170,13 +174,34 @@ class ShardedJobLogStore:
     in production; in-process JobLogStore works too, which is what the
     differential tests use)."""
 
-    def __init__(self, shards: Sequence, verify_map: bool = True):
+    def __init__(self, shards: Sequence, verify_map: bool = True,
+                 shard_deadline: Optional[float] = None,
+                 breaker_fails: int = 3, breaker_cooldown: float = 1.0):
         if not shards:
             raise ValueError("ShardedJobLogStore needs at least one shard")
-        self.shards = list(shards)
-        self.nshards = len(self.shards)
+        self._raw = list(shards)
+        self.nshards = len(self._raw)
+        # per-shard brownout handling (the store client's contract,
+        # store/sharded.py): with a deadline configured (param or
+        # CRONSUN_SHARD_DEADLINE_S) each shard is breaker-guarded —
+        # writes against an OPEN shard fail fast into the agents'
+        # record-flush retry ladder (idem tokens pinned, so nothing
+        # duplicates on the re-send), dashboard reads skip it with a
+        # loud shard_degraded count.  deadline <= 0 (default) disables:
+        # self.shards IS the raw list, behavior byte-identical.
+        if shard_deadline is None:
+            shard_deadline = breaker_env_deadline()
+        self.shard_deadline = shard_deadline
+        self._bank = BreakerBank(self.nshards, shard_deadline,
+                                 fail_threshold=breaker_fails,
+                                 cooldown=breaker_cooldown,
+                                 label="logsink shard")
+        self._breakers = self._bank.breakers
+        self.shards = self._bank.guards(self._raw,
+                                        healthy_errors=(KeyError,))
         self._pool = (ThreadPoolExecutor(
-            max_workers=max(2, 2 * self.nshards),
+            max_workers=max(2, 2 * self.nshards) +
+            (2 * self.nshards if shard_deadline > 0 else 0),
             thread_name_prefix="logshard-fan") if self.nshards > 1 else None)
         self._lock = threading.Lock()
         if self.nshards > 1 and verify_map:
@@ -205,6 +230,18 @@ class ShardedJobLogStore:
         if first_err is not None:
             raise first_err
         return out
+
+    def _tolerant(self, i: int, fn, default=None):
+        """A dashboard read that can TOLERATE a missing shard
+        (core.breaker.BreakerBank): an open breaker yields ``default``
+        (counted loudly) instead of failing — or stalling — the whole
+        scatter-gather."""
+        return self._bank.tolerant(i, fn, default=default)
+
+    def breaker_snapshot(self) -> List[dict]:
+        """Per-shard breaker state + degraded-read counts (rendered at
+        /v1/metrics beside the store's).  Empty when disabled."""
+        return self._bank.snapshot()
 
     def _pin_log_map(self):
         got = self.shards[0].logmap(self.nshards, LOG_HASH_SCHEME)
@@ -306,10 +343,12 @@ class ShardedJobLogStore:
                     "a sharded sink resumes from a per-shard cursor "
                     "vector (advance_cursor()), not a scalar id")
             parts = self._fan([
-                lambda si=si: (si, self.shards[si].query_logs(
-                    **kw, after_id=vec[si], page=1,
-                    page_size=page_size)[0])
+                self._tolerant(si, lambda si=si: (
+                    si, self.shards[si].query_logs(
+                        **kw, after_id=vec[si], page=1,
+                        page_size=page_size)[0]))
                 for si in sids])
+            parts = [p for p in parts if p is not None]
             merged = [(r.id, si, r) for si, rows in parts for r in rows]
             merged.sort(key=lambda t: (t[0], t[1]))
             out = []
@@ -320,8 +359,10 @@ class ShardedJobLogStore:
 
         need = page * page_size
         parts = self._fan([
-            lambda si=si: (si, *self._fetch_top(si, kw, need))
+            self._tolerant(si, lambda si=si: (
+                si, *self._fetch_top(si, kw, need)))
             for si in sids])
+        parts = [p for p in parts if p is not None]
         total = sum(t for _si, _rows, t in parts)
         if latest:
             return merge_latest_parts(
@@ -355,17 +396,23 @@ class ShardedJobLogStore:
                 for k in ("total", "successed", "failed")}
 
     def stat_overall(self) -> dict:
-        return self._sum_stats(self._fan([lambda s=s: s.stat_overall()
-                                          for s in self.shards]))
+        parts = self._fan([
+            self._tolerant(i, lambda s=s: s.stat_overall())
+            for i, s in enumerate(self.shards)])
+        return self._sum_stats([p for p in parts if p is not None])
 
     def stat_day(self, day: str) -> dict:
-        return self._sum_stats(self._fan([lambda s=s: s.stat_day(day)
-                                          for s in self.shards]))
+        parts = self._fan([
+            self._tolerant(i, lambda s=s: s.stat_day(day))
+            for i, s in enumerate(self.shards)])
+        return self._sum_stats([p for p in parts if p is not None])
 
     def stat_days(self, n_days: int) -> List[dict]:
-        parts = self._fan([lambda s=s: s.stat_days(n_days)
-                           for s in self.shards])
-        return merge_stat_days(parts, n_days)
+        parts = self._fan([
+            self._tolerant(i, lambda s=s: s.stat_days(n_days))
+            for i, s in enumerate(self.shards)])
+        return merge_stat_days([p for p in parts if p is not None],
+                               n_days)
 
     # ---- change revision / ops -------------------------------------------
 
@@ -422,8 +469,12 @@ class ShardedJobLogStore:
 
     def op_stats_shards(self) -> List[dict]:
         """Per-SHARD op stats, shard order — /v1/metrics renders these
-        with a ``shard`` label when more than one is present."""
-        return self._fan([lambda s=s: s.op_stats() for s in self.shards])
+        with a ``shard`` label when more than one is present.  A
+        degraded shard reports ``{}`` (metrics scraping must not stall
+        behind a browned-out shard)."""
+        return self._fan([
+            self._tolerant(i, lambda s=s: s.op_stats(), default={})
+            for i, s in enumerate(self.shards)])
 
     def logmap(self, n=None, hash=None):
         return self.shards[0].logmap(n, hash)
@@ -457,7 +508,7 @@ class ShardedJobLogStore:
     # ---- lifecycle -------------------------------------------------------
 
     def close(self):
-        for s in self.shards:
+        for s in self._raw:
             try:
                 s.close()
             except Exception:  # noqa: BLE001 — best-effort teardown

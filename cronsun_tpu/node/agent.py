@@ -30,6 +30,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from .. import log
 from ..core import Group, Job, Keyspace, Node
+from ..core.backoff import REC_FLUSH
 from ..core.errors import DuplicateNode
 from ..core.models import KIND_ALONE
 from ..logsink import JobLogStore, LogRecord
@@ -1071,8 +1072,8 @@ class NodeAgent:
                         self._rec_retry = None
                         self._rec_flush_fails = 0
                     else:
-                        self._rec_retry_at = self.clock() + min(
-                            10.0, 0.25 * (1 << self._rec_flush_fails))
+                        self._rec_retry_at = self.clock() + \
+                            REC_FLUSH.delay(self._rec_flush_fails)
                         log.warnf("record flush failed (%d records held "
                                   "for retry %d/%d)", len(batch),
                                   self._rec_flush_fails,
@@ -1097,7 +1098,7 @@ class NodeAgent:
                 self._bump("rec_dropped_total", len(batch))
             elif batch:
                 self._rec_retry = (batch, idem, toks)
-                self._rec_retry_at = self.clock() + 0.5
+                self._rec_retry_at = self.clock() + REC_FLUSH.delay(1)
 
     # ---- event processing (synchronous; threads call these) --------------
 

@@ -20,6 +20,7 @@ from email.mime.text import MIMEText
 from typing import Callable, List, Optional
 
 from .core import Keyspace
+from .core.backoff import NOTICER
 from . import log
 from .logsink import JobLogStore
 from .store.memstore import DELETE, MemStore, WatchLost
@@ -123,7 +124,7 @@ class NoticerHost:
     exponential backoff (capped at RETRY_CAP seconds), and because the key
     survives, a noticer restart re-lists and re-delivers via resync()."""
 
-    RETRY_CAP = 30.0
+    RETRY_CAP = NOTICER.cap     # schedule lives in core.backoff.NOTICER
 
     def __init__(self, store: MemStore, sink: JobLogStore, sender,
                  ks: Optional[Keyspace] = None):
@@ -265,7 +266,7 @@ class NoticerHost:
             self.sender.send(p.notice)
         except Exception as e:  # noqa: BLE001 — notification must not crash
             p.attempts += 1
-            backoff = min(self.RETRY_CAP, 0.5 * (2 ** (p.attempts - 1)))
+            backoff = NOTICER.delay(p.attempts)
             p.next_at = time.time() + backoff
             log.errorf("noticer send failed (attempt %d, retry in %.1fs): %s",
                        p.attempts, backoff, e)

@@ -45,6 +45,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Sequence, Tuple
 
 from .. import log
+from ..core.backoff import PUBLISH, PUBLISH_ATTEMPTS
 
 
 class OrderPublisher:
@@ -165,13 +166,13 @@ class OrderPublisher:
         """One chunk; returns orders written (0 = definitively failed)."""
         conn = self._lane_conns[lane_i]
         err = None
-        for attempt in range(4):
+        for attempt in range(PUBLISH_ATTEMPTS):
             try:
                 conn.put_many(chunk, lease=lease)
                 return len(chunk)
             except Exception as e:  # noqa: BLE001 — retry with backoff
                 err = e
-                time.sleep(min(2.0, 0.2 * (1 << attempt)))
+                PUBLISH.sleep(attempt + 1)
         with self._mu:   # lanes race here; += on a dict entry isn't atomic
             self.stats["publish_failures"] += len(chunk)
         log.errorf("publish chunk of %d failed after retries: %s",

@@ -383,6 +383,15 @@ class SchedulerService:
         self._warmed = False
 
         self._leader_lease: Optional[int] = None
+        # lease watchdog: wall time of the last keepalive CONFIRM,
+        # anchored at the SEND instant (the server refreshed the lease
+        # somewhere inside the round trip; the send is the conservative
+        # bound).  A keepalive whose round trip exceeds lease_ttl/2 —
+        # or a confirm older than lease_ttl — means the leader may be
+        # dispatching on a lease it has already lost: resign LOUDLY
+        # (revoke, stop publishing, re-elect) instead of risking
+        # split-brain.
+        self._lease_confirmed_at: float = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._next_epoch: Optional[int] = None
@@ -390,7 +399,7 @@ class SchedulerService:
         self.stats = {"overflow_drops": 0, "overflow_late_fires": 0,
                       "skipped_seconds": 0,
                       "watch_losses": 0, "dispatches_total": 0,
-                      "steps_total": 0}
+                      "steps_total": 0, "lease_resigns_total": 0}
         # herd gauges, tracked where orders are built: the most
         # EXCLUSIVE (per-node) keys any one second published — bounded
         # by active nodes under coalescing, it was one per fire before —
@@ -561,9 +570,39 @@ class SchedulerService:
 
     def try_lead(self) -> bool:
         if self._leader_lease is not None:
-            if self.store.keepalive(self._leader_lease):
-                return True
-            self._leader_lease = None
+            t0 = time.monotonic()
+            ok = self.store.keepalive(self._leader_lease)
+            rtt = time.monotonic() - t0
+            if ok:
+                # keepalive watchdog: the server refreshed the lease at
+                # some instant inside [t0, t0+rtt] — when the round
+                # trip exceeds lease_ttl/2 the refresh instant is too
+                # uncertain to dispatch on (an injected RPC delay, a
+                # pegged host, a stalled link all look identical from
+                # here), and a confirm older than a full lease_ttl
+                # means the lease may already be expired with a new
+                # leader elected.  In both cases: resign LOUDLY and
+                # re-elect from scratch instead of risking split-brain.
+                stale = self._lease_confirmed_at and \
+                    t0 - self._lease_confirmed_at > self.lease_ttl
+                if rtt > self.lease_ttl / 2 or stale:
+                    self._resign_lease(
+                        f"keepalive round trip {rtt * 1e3:.0f} ms vs "
+                        f"lease_ttl {self.lease_ttl:.1f}s"
+                        if rtt > self.lease_ttl / 2 else
+                        f"last confirm {t0 - self._lease_confirmed_at:.1f}"
+                        f"s ago (> lease_ttl)")
+                else:
+                    self._lease_confirmed_at = t0
+                    return True
+            else:
+                self._leader_lease = None
+        # anchor the election's confirm BEFORE grant(): the lease's TTL
+        # countdown starts server-side when grant is processed, so on a
+        # slow store the win can arrive a full election round trip
+        # later — anchoring at the win would overstate freshness by
+        # exactly the delay regime the watchdog exists for
+        t_el = time.monotonic()
         lease = self.store.grant(self.lease_ttl)
         try:
             won = self.store.put_if_absent(self.ks.leader, self.node_id,
@@ -574,10 +613,47 @@ class SchedulerService:
             # step; the next attempt grants anew
             return False
         if won:
+            # the election leg gets the SAME uncertainty bound as the
+            # keepalive: if the grant+put round trip exceeded
+            # lease_ttl/2, the lease (whose TTL countdown started at
+            # the grant) may already be expired with another leader
+            # elected by the time this reply arrived — dispatching on
+            # it is the split-brain the watchdog exists to prevent
+            if time.monotonic() - t_el > self.lease_ttl / 2:
+                self.stats["lease_resigns_total"] += 1
+                log.errorf(
+                    "scheduler %s won election but the round trip took "
+                    "%.0f ms (> lease_ttl/2); discarding the win",
+                    self.node_id, (time.monotonic() - t_el) * 1e3)
+                try:
+                    self.store.revoke(lease)
+                except Exception:  # noqa: BLE001 — TTL is the backstop
+                    pass
+                return False
             self._leader_lease = lease
+            self._lease_confirmed_at = t_el
             return True
         self.store.revoke(lease)
         return False
+
+    def _resign_lease(self, why: str):
+        """Stop leading NOW: drop the lease reference (every dispatch
+        path gates on is_leader), log, count, and best-effort revoke so
+        the leader key frees for re-election immediately instead of at
+        TTL expiry.  The next step's try_lead re-elects from scratch —
+        possibly winning again, which is fine: what matters is never
+        dispatching across the uncertainty window."""
+        lease, self._leader_lease = self._leader_lease, None
+        self._lease_confirmed_at = 0.0
+        self.stats["lease_resigns_total"] += 1
+        log.errorf("scheduler %s resigning leadership: %s (stopped "
+                   "publishing; will re-elect)", self.node_id, why)
+        if lease is not None:
+            try:
+                self.store.revoke(lease)
+            except Exception as e:  # noqa: BLE001 — the TTL is the
+                # backstop; a failed revoke only delays re-election
+                log.warnf("lease revoke during resign failed: %s", e)
 
     @property
     def is_leader(self) -> bool:

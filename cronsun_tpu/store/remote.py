@@ -48,6 +48,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import log
+from ..chaos.hooks import hooks as _chaos
+from ..core.backoff import RECONNECT
 from .memstore import CompactedError, DELETE, LossyEventStream, PUT, \
     Event, KV, MemStore, WatchLost, Watcher
 from .wire import LineJsonHandler
@@ -451,7 +453,7 @@ class RemoteStore:
             w._q.put(None)
 
     def _heal(self):
-        delay = 0.2
+        attempt = 0
         while not self._closed:
             try:
                 self._connect()
@@ -462,8 +464,8 @@ class RemoteStore:
                 # retrying with backoff rather than dying silently
                 if isinstance(e, RemoteStoreError):
                     log.errorf("store reconnect refused: %s", e)
-                time.sleep(delay)
-                delay = min(2.0, delay * 2)
+                attempt += 1
+                RECONNECT.sleep(attempt)   # 0.2 s doubling, 2 s cap
         if self._closed:
             self._finalize()
             return
@@ -493,6 +495,15 @@ class RemoteStore:
               sock_override=None):
         if self._closed:
             raise RemoteStoreError("store connection closed")
+        # deterministic fault injection (chaos plane, env-gated off in
+        # production): a 'timeout' fault fails the RPC before anything
+        # reaches the wire; a 'reply_lost' fault lets the op APPLY
+        # server-side and fails the reply path — the
+        # applied-but-indeterminate shape every degraded ladder must
+        # survive; a 'delay' fault stalls the caller (browned-out wire)
+        act = _chaos.intercept("store.rpc", op) if _chaos.armed else None
+        if act is not None:
+            act.pre(RemoteStoreError, op)
         if rid is None:
             with self._id_lock:
                 rid = self._next_id
@@ -533,6 +544,8 @@ class RemoteStore:
             if kind == "WatchLost":
                 raise WatchLost(msg["e"])
             raise RemoteStoreError(msg["e"])
+        if act is not None:
+            act.post(RemoteStoreError, op)   # applied; reply "lost"
         return msg.get("r")
 
     # -- KV ----------------------------------------------------------------

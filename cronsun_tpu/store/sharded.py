@@ -70,12 +70,17 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import log
-from .memstore import Event, KV, LossyEventStream, WatchLost
+from ..core.breaker import BreakerBank, ShardDegradedError  # noqa: F401
+# (ShardDegradedError re-exported: it is the error sharded-store
+# callers catch around fail-fast claims)
+from .memstore import CompactedError, Event, KV, LossyEventStream, \
+    WatchLost
 
 HASH_SCHEME = "fnv1a-token-v1"
 
@@ -160,6 +165,22 @@ def shard_map_key(prefix: str = "/cronsun") -> str:
     """The topology pin.  Lives on shard 0 BY FIAT (not by hash): a
     client must be able to read it knowing only the shard list."""
     return f"{prefix}/shardmap"
+
+
+def breaker_env_deadline() -> float:
+    """Per-shard RPC deadline from the environment; 0 disables the
+    breaker (the default — single-host deployments and the tier-1
+    suite see no behavior change)."""
+    try:
+        return float(os.environ.get("CRONSUN_SHARD_DEADLINE_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+# server answers that are NOT shard-health failures: the RPC completed,
+# the server just said no (missing lease, compacted watch history, a
+# cancelled stream) — only transport errors and deadline overruns count
+_HEALTHY_ERRORS = (KeyError, CompactedError, WatchLost)
 
 
 class ShardedWatcher(LossyEventStream):
@@ -289,19 +310,45 @@ class ShardedStore:
     docstring for the bundle ordering contract)."""
 
     def __init__(self, shards: Sequence, prefix: str = "/cronsun",
-                 verify_map: bool = True, _parent: "ShardedStore" = None):
+                 verify_map: bool = True, _parent: "ShardedStore" = None,
+                 shard_deadline: Optional[float] = None,
+                 breaker_fails: int = 3, breaker_cooldown: float = 1.0):
         if not shards:
             raise ValueError("ShardedStore needs at least one shard")
-        self.shards = list(shards)
-        self.nshards = len(self.shards)
+        self._raw = list(shards)       # unguarded clients (lifecycle)
+        self.nshards = len(self._raw)
         self.prefix = prefix
+        # per-shard brownout handling: with a deadline configured
+        # (param, or CRONSUN_SHARD_DEADLINE_S), each shard client is
+        # wrapped in a breaker guard — ops against an OPEN shard fail
+        # fast, tolerant reads skip it with a loud shard_degraded
+        # count, and the plane's latency is bounded by its healthy
+        # shards.  deadline <= 0 (the default) disables everything:
+        # self.shards IS self._raw and behavior is byte-identical.
+        if shard_deadline is None:
+            shard_deadline = breaker_env_deadline()
+        self.shard_deadline = shard_deadline
+        if _parent is not None:
+            # clones (publisher lanes) share the parent's bank: shard
+            # health is a property of the SHARD, not of the lane
+            # observing it
+            self._bank = _parent._bank
+        else:
+            self._bank = BreakerBank(self.nshards, shard_deadline,
+                                     fail_threshold=breaker_fails,
+                                     cooldown=breaker_cooldown,
+                                     label="store shard")
+        self._breakers = self._bank.breakers
+        self.shards = self._bank.guards(self._raw,
+                                        healthy_errors=_HEALTHY_ERRORS)
         # close() closes only shards this instance opened: a clone()
         # over shard clients with no clone() of their own (MemStore)
         # ALIASES the parent's shards, and closing those would kill the
         # parent's live watchers and WAL mid-flight
         self._owned = [True] * self.nshards
         self._pool = (ThreadPoolExecutor(
-            max_workers=max(2, 2 * self.nshards),
+            max_workers=max(2, 2 * self.nshards) +
+            (2 * self.nshards if shard_deadline > 0 else 0),
             thread_name_prefix="shard-fan") if self.nshards > 1 else None)
         if _parent is not None:
             # clones (publisher lanes) share the composite-lease
@@ -353,6 +400,18 @@ class ShardedStore:
         if first_err is not None:
             raise first_err
         return out
+
+    def _tolerant(self, i: int, fn, default=None):
+        """Partial-tolerant fan thunk (core.breaker.BreakerBank): an
+        open breaker yields ``default`` (counted loudly) instead of
+        failing the scatter-gather."""
+        return self._bank.tolerant(i, fn, default=default)
+
+    def breaker_snapshot(self) -> List[dict]:
+        """Per-shard breaker state + degraded-read counts (rendered at
+        /v1/metrics; the chaos bench reads it too).  Empty when the
+        breaker is disabled."""
+        return self._bank.snapshot()
 
     def _pin_shard_map(self):
         key = shard_map_key(self.prefix)
@@ -480,12 +539,37 @@ class ShardedStore:
         return out
 
     def get_prefix(self, prefix: str) -> List[KV]:
+        # STRICT: a breaker-open shard fails the whole scan fast (still
+        # bounded latency — an error, not a stall).  Consumers that
+        # diff a listing against local state and treat missing keys as
+        # DELETIONS (the scheduler's resync, group scrubs) must never
+        # silently receive a partial result; dashboards that can
+        # tolerate one opt in via get_prefix_degraded.
         pi = self._prefix_idx(prefix)
         if pi is not None:
             return self.shards[pi].get_prefix(prefix)
         parts = self._fan([lambda s=s: s.get_prefix(prefix)
                            for s in self.shards])
         hits = [kv for part in parts for kv in part]
+        hits.sort(key=lambda kv: kv.key)
+        return hits
+
+    def get_prefix_degraded(self, prefix: str) -> List[KV]:
+        """Partial-tolerant prefix scan for DASHBOARD reads: a
+        breaker-open shard's keys are simply absent, counted loudly as
+        shard_degraded — one browned-out shard costs its own keys, not
+        the whole view.  Never use where a missing key is interpreted
+        as a deletion."""
+        pi = self._prefix_idx(prefix)
+        if pi is not None:
+            run = self._tolerant(
+                pi, lambda: self.shards[pi].get_prefix(prefix),
+                default=[])
+            return run()
+        parts = self._fan([
+            self._tolerant(i, lambda s=s: s.get_prefix(prefix))
+            for i, s in enumerate(self.shards)])
+        hits = [kv for part in parts if part for kv in part]
         hits.sort(key=lambda kv: kv.key)
         return hits
 
@@ -539,6 +623,19 @@ class ShardedStore:
             return self.shards[pi].count_prefix(prefix)
         return sum(self._fan([lambda s=s: s.count_prefix(prefix)
                               for s in self.shards]))
+
+    def count_prefix_degraded(self, prefix: str) -> int:
+        """Partial-tolerant count (see get_prefix_degraded): an open
+        shard contributes 0, counted loudly."""
+        pi = self._prefix_idx(prefix)
+        if pi is not None:
+            return self._tolerant(
+                pi, lambda: self.shards[pi].count_prefix(prefix),
+                default=0)()
+        return sum(self._fan([
+            self._tolerant(i, lambda s=s: s.count_prefix(prefix),
+                           default=0)
+            for i, s in enumerate(self.shards)]))
 
     def delete(self, key: str) -> bool:
         return self._shard(key).delete(key)
@@ -852,8 +949,12 @@ class ShardedStore:
 
     def op_stats_shards(self) -> List[dict]:
         """Per-SHARD op stats, shard order — /v1/metrics renders these
-        with a ``shard`` label when more than one is present."""
-        return self._fan([lambda s=s: s.op_stats() for s in self.shards])
+        with a ``shard`` label when more than one is present.  A
+        degraded shard reports ``{}`` (tolerant: metrics scraping must
+        not stall behind a browned-out shard)."""
+        return self._fan([
+            self._tolerant(i, lambda s=s: s.op_stats(), default={})
+            for i, s in enumerate(self.shards)])
 
     def snapshot(self) -> List[int]:
         """Snapshot every shard (per-shard WAL + snapshot sidecar, the
@@ -882,10 +983,11 @@ class ShardedStore:
         close() must leave it alone, or closing a publisher lane would
         kill the parent's live watchers and WAL."""
         kids = [s.clone() if hasattr(s, "clone") else s
-                for s in self.shards]
+                for s in self._raw]
         c = ShardedStore(kids, prefix=self.prefix, verify_map=False,
-                         _parent=self)
-        c._owned = [kid is not s for kid, s in zip(kids, self.shards)]
+                         _parent=self,
+                         shard_deadline=self.shard_deadline)
+        c._owned = [kid is not s for kid, s in zip(kids, self._raw)]
         return c
 
     def start_sweeper(self, interval: float = 0.2):
@@ -893,7 +995,7 @@ class ShardedStore:
             s.start_sweeper(interval)
 
     def close(self):
-        for own, s in zip(self._owned, self.shards):
+        for own, s in zip(self._owned, self._raw):
             if not own:
                 continue        # aliased parent shard (see clone())
             try:

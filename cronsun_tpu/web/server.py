@@ -275,7 +275,7 @@ class ApiServer:
         for l in latest:
             cur = status.setdefault(l.job_id, {"success": 0, "failed": 0})
             cur["success" if l.success else "failed"] += 1
-        for kv in self.store.get_prefix(prefix):
+        for kv in self._degraded_prefix(prefix):
             try:
                 job = Job.from_json(kv.value)
             except (json.JSONDecodeError, TypeError):
@@ -815,8 +815,27 @@ class ApiServer:
 
     # ---- handlers: nodes + groups ---------------------------------------
 
+    def _degraded_prefix(self, prefix: str):
+        """Dashboard prefix scan: against a sharded store with its
+        breaker armed, a browned-out shard's keys are served ABSENT
+        (counted loudly as shard_degraded) instead of stalling or
+        erroring the whole page.  Only for pure read views — never for
+        paths that interpret a missing key as a deletion."""
+        fn = getattr(self.store, "get_prefix_degraded", None)
+        return fn(prefix) if fn is not None else \
+            self.store.get_prefix(prefix)
+
+    def _degraded_count(self, prefix: str) -> int:
+        fn = getattr(self.store, "count_prefix_degraded", None)
+        return fn(prefix) if fn is not None else \
+            self.store.count_prefix(prefix)
+
     def node_list(self, ctx):
-        """Result-store mirror ⋈ live keys (reference web/node.go:141-165)."""
+        """Result-store mirror ⋈ live keys (reference web/node.go:141-165).
+        STRICT read: a missing liveness key renders as "disconnected" —
+        a state, exactly what the degraded helper's contract forbids
+        serving partially (a browned-out shard would paint its healthy
+        nodes down)."""
         live = {kv.key[len(self.ks.node):]
                 for kv in self.store.get_prefix(self.ks.node)}
         out = []
@@ -827,7 +846,7 @@ class ApiServer:
 
     def group_list(self, ctx):
         return [json.loads(kv.value)
-                for kv in self.store.get_prefix(self.ks.group)]
+                for kv in self._degraded_prefix(self.ks.group)]
 
     def group_get(self, ctx):
         kv = self.store.get(self.ks.group_key(ctx.path_args["id"]))
@@ -869,17 +888,17 @@ class ApiServer:
     # ---- handlers: info --------------------------------------------------
 
     def overview(self, ctx):
-        live = self.store.count_prefix(self.ks.node)
+        live = self._degraded_count(self.ks.node)
         # planner health straight from the leased scheduler snapshots
         # (same source as /v1/metrics), keyed by instance
         scheds = {}
-        for kv in self.store.get_prefix(self.ks.metrics + "sched/"):
+        for kv in self._degraded_prefix(self.ks.metrics + "sched/"):
             try:
                 scheds[kv.key.rsplit("/", 1)[1]] = json.loads(kv.value)
             except json.JSONDecodeError:
                 pass
         return {
-            "totalJobs": self.store.count_prefix(self.ks.cmd),
+            "totalJobs": self._degraded_count(self.ks.cmd),
             "jobExecuted": self.sink.stat_overall(),
             "jobExecutedDaily": self.sink.stat_days(7),
             "nodeCount": len(self.sink.get_nodes()),
@@ -943,7 +962,7 @@ class ApiServer:
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {val}")
         seen_types: set = set()
-        for kv in self.store.get_prefix(self.ks.metrics):
+        for kv in self._degraded_prefix(self.ks.metrics):
             rest = kv.key[len(self.ks.metrics):].split("/", 1)
             if len(rest) != 2:
                 continue
@@ -1015,6 +1034,34 @@ class ApiServer:
                         o = op.replace('\\', r'\\').replace('"', r'\"')
                         lines.append(
                             f'{name}{{op="{o}"{shard}}} {ent[field]}')
+            # per-shard brownout breakers (store/sharded.py PR 12):
+            # state gauge (0 closed / 1 probing / 2 open), opens,
+            # fail-fast refusals, and degraded partial reads — the
+            # operator's first stop when one shard browns out.  Absent
+            # entirely when the breaker is disabled.
+            bs = getattr(backend, "breaker_snapshot", None)
+            if bs is None:
+                continue
+            try:
+                snaps = bs()
+            except Exception:  # noqa: BLE001 — degraded shard set
+                snaps = []
+            if not snaps:
+                continue
+            state_num = {"closed": 0, "probing": 1, "open": 2}
+            for field, kind in (
+                    ("state", "gauge"),
+                    ("opens_total", "counter"),
+                    ("refused_total", "counter"),
+                    ("degraded_reads_total", "counter")):
+                name = f"cronsun_{prefix}_shard_breaker_{field}"
+                lines.append(f"# TYPE {name} {kind}")
+                for snap in snaps:
+                    val = snap.get(field, 0)
+                    if field == "state":
+                        val = state_num.get(val, -1)
+                    lines.append(
+                        f'{name}{{shard="{snap["shard"]}"}} {val}')
         return PlainText("\n".join(lines) + "\n")
 
     # ---- plumbing --------------------------------------------------------

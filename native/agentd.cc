@@ -68,6 +68,20 @@ static double now_s() {
       .count();
 }
 
+static double mono_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static double env_f(const char* key, double dflt) {
+  const char* v = getenv(key);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  double x = strtod(v, &end);
+  return end == v ? dflt : x;
+}
+
 // ---------------------------------------------------------------------------
 // store client (demuxed line-JSON; watch pushes -> one event queue)
 // ---------------------------------------------------------------------------
@@ -140,9 +154,31 @@ class StoreClient {
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
   }
 
-  // one RPC; false on transport error (err.kind == "io") or server error
+  // one RPC; false on transport error (err.kind == "io") or server error.
+  // The py client's brownout contract (store/sharded.py PR 12),
+  // mirrored: with CRONSUN_SHARD_DEADLINE_S set, a per-connection
+  // breaker opens after consecutive transport-or-slow outcomes and
+  // later calls fail FAST (the caller's degraded ladders own the
+  // retry; a claim that fails here leaves its leased order key for
+  // redelivery) — one browned-out shard costs its own keys, not every
+  // fan-out.  After a cooldown one probe per window retests the shard.
   bool call(const std::string& op, const JV& args, JV& result,
             StoreError& err) {
+    if (!brk_allow()) {
+      err = {"io", "shard degraded (breaker open); " + op +
+                       " refused fail-fast"};
+      return false;
+    }
+    double t0 = mono_s();
+    bool ok = call_inner(op, args, result, err);
+    // server-side answers (KeyError & co) are HEALTHY: the wire
+    // worked; only transport errors and deadline overruns count
+    brk_record(ok || err.kind != "io", mono_s() - t0);
+    return ok;
+  }
+
+  bool call_inner(const std::string& op, const JV& args, JV& result,
+                  StoreError& err) {
     long long rid;
     std::shared_ptr<Pending> p = std::make_shared<Pending>();
     {
@@ -162,8 +198,11 @@ class StoreClient {
       err = {"io", "send failed"};
       return false;
     }
+    // env-tunable rpc deadline (default 10 s; the chaos drills and
+    // brownout-sensitive fleets shrink it)
+    static const double kRpcTimeout = env_f("CRONSUN_RPC_TIMEOUT_S", 10.0);
     std::unique_lock<std::mutex> g(p->mu);
-    if (!p->cv.wait_for(g, std::chrono::seconds(10),
+    if (!p->cv.wait_for(g, std::chrono::duration<double>(kRpcTimeout),
                         [&] { return p->done; })) {
       drop_pending(rid);
       err = {"io", "rpc timeout: " + op};
@@ -445,6 +484,55 @@ class StoreClient {
     JV result;
     std::string err_kind, err_msg;
   };
+
+  // -- per-connection brownout breaker (closed -> open -> probe) ----------
+  // Enabled by CRONSUN_SHARD_DEADLINE_S > 0 (default off: behavior
+  // byte-identical).  Knobs mirror the Python client's:
+  // CRONSUN_SHARD_BREAKER_FAILS (3), CRONSUN_SHARD_BREAKER_COOLDOWN_S (1).
+  static double brk_deadline() {
+    static const double d = env_f("CRONSUN_SHARD_DEADLINE_S", 0.0);
+    return d;
+  }
+
+  bool brk_allow() {
+    if (brk_deadline() <= 0) return true;
+    static const double kCooldown =
+        env_f("CRONSUN_SHARD_BREAKER_COOLDOWN_S", 1.0);
+    std::lock_guard<std::mutex> g(brk_mu_);
+    if (!brk_open_) return true;
+    if (mono_s() - brk_open_at_ >= kCooldown && !brk_probe_out_) {
+      brk_probe_out_ = true;    // one probe per cooldown window
+      return true;
+    }
+    return false;
+  }
+
+  void brk_record(bool ok, double elapsed) {
+    double dl = brk_deadline();
+    if (dl <= 0) return;
+    static const int kFails =
+        (int)env_f("CRONSUN_SHARD_BREAKER_FAILS", 3.0);
+    if (ok && elapsed > dl) ok = false;   // slow success == brownout
+    std::lock_guard<std::mutex> g(brk_mu_);
+    if (ok) {
+      brk_open_ = false;
+      brk_fails_ = 0;
+      brk_probe_out_ = false;
+      return;
+    }
+    brk_fails_++;
+    if (brk_probe_out_ || brk_fails_ >= kFails) {
+      brk_open_ = true;
+      brk_open_at_ = mono_s();
+      brk_probe_out_ = false;
+    }
+  }
+
+  std::mutex brk_mu_;
+  bool brk_open_ = false;
+  bool brk_probe_out_ = false;
+  int brk_fails_ = 0;
+  double brk_open_at_ = 0;
 
   int dial() {
     struct addrinfo hints {};

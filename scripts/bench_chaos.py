@@ -1,0 +1,848 @@
+"""Chaos drills: scripted fault scenarios gated by global invariants.
+
+Every drill assembles a REAL fleet in-process — TCP store server(s)
+and a TCP result store behind :class:`FaultProxy` instances, wire
+clients, agents, scheduler(s) — injects a named fault scenario from a
+seeded, deterministic schedule, lets the system settle, and
+machine-checks the global invariants (cronsun_tpu/chaos/invariants.py):
+exactly-once, zero acked-record loss, clean fixpoint, bounded
+recovery.  Time is compressed the way tests/test_integration.py does
+it: the scheduler is stepped over synthetic past epochs, so a
+30-second scenario runs in a few wall seconds while leases, backoff
+ladders and fault windows ride real time.
+
+    python scripts/bench_chaos.py --drill smoke --seed 7
+    python scripts/bench_chaos.py --drill all --json chaos.json
+
+Drills:
+
+  smoke            seeded delay/dup/reorder on the store wire +
+                   reply-lost injections on both clients; tier-1 gate
+  leader_kill9     kill -9 the scheduler leader during a herd second;
+                   standby takes over; zero duplicate/lost fires,
+                   bounded recovery
+  shard_partition  one store shard of two severed mid-drain, then
+                   healed: publish hole + rewind + redelivery converge
+  logd_flap        the result store flaps (sever bursts) across the
+                   rec-flush retry budget: pinned idem tokens keep the
+                   sink exactly equal to the acked count
+  brownout         one store shard slow (not dead) under read load:
+                   pre-fix the healthy shard's reads stall behind it;
+                   with the breaker they are bounded (<= 2x baseline)
+  ckpt_race        checkpoint save racing a store partition: saves
+                   either land or fail LOUDLY, invariants hold
+  agent_kill       kill -9 an agent mid-execution: fence consumed, no
+                   double fire, fsck NAMES the fence-without-record
+
+The fault schedule is deterministic under --seed: the smoke drill
+asserts byte-identical schedules across two constructions, and every
+hook decision is a pure hash (chaos/hooks.det01).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("CRONSUN_CHAOS", "1")     # drills inject faults
+
+from cronsun_tpu.chaos.faultproxy import FaultProxy, FaultSchedule   # noqa: E402
+from cronsun_tpu.chaos.hooks import hooks                            # noqa: E402
+from cronsun_tpu.chaos import invariants                             # noqa: E402
+from cronsun_tpu.core import Job, JobRule, Keyspace                  # noqa: E402
+from cronsun_tpu.core.models import KIND_INTERVAL                    # noqa: E402
+from cronsun_tpu.logsink.serve import LogSinkServer, RemoteJobLogStore  # noqa: E402
+from cronsun_tpu.node.agent import NodeAgent                         # noqa: E402
+from cronsun_tpu.node.executor import ExecResult                     # noqa: E402
+from cronsun_tpu.store.memstore import MemStore                      # noqa: E402
+from cronsun_tpu.store.remote import RemoteStore, StoreServer        # noqa: E402
+from cronsun_tpu.store.sharded import ShardedStore                   # noqa: E402
+
+KS = Keyspace()
+T0 = 1_760_000_000          # synthetic drill epoch (past wall-clock)
+
+
+def pctl(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+class RecordingExecutor:
+    """Instant-exec executor that records every run into a shared
+    fleet ledger as (job_id, scheduled_second) — the exactly-once
+    evidence — and can BLOCK designated jobs (the kill -9 mid-execution
+    drill needs a run provably in flight)."""
+
+    def __init__(self, ledger, mu, block_jobs=(), clock=time.time):
+        self.ledger = ledger
+        self.mu = mu
+        self.block_jobs = set(block_jobs)
+        self.blocked = threading.Event()     # a blocked run has started
+        self.release = threading.Event()     # let blocked runs finish
+        self.clock = clock
+
+    def run_job(self, job_id="", command="", user="", timeout=0, retry=0,
+                interval=0, parallels=0, env=None, sleep=time.sleep):
+        sched_ts = int((env or {}).get("CRONSUN_SCHEDULED_TS", "0") or 0)
+        with self.mu:
+            self.ledger.append((job_id, sched_ts))
+        if job_id in self.block_jobs:
+            self.blocked.set()
+            self.release.wait(timeout=30)
+        now = self.clock()
+        return ExecResult(True, "ok", now, now, exit_code=0)
+
+
+class Fleet:
+    """One drill's world: proxied store shard(s) + proxied logd + N
+    in-process agents + one or more schedulers, driven over synthetic
+    seconds."""
+
+    def __init__(self, seed=0, n_jobs=10, n_agents=2, store_shards=1,
+                 n_scheds=1, lease_ttl=2.0, dispatch_ttl=300.0,
+                 shard_deadline=0.0, window_s=2, agent_ttl=10.0,
+                 proc_ttl=600.0, block_jobs=(), checkpoint_dir=None,
+                 client_timeout=8.0):
+        self.seed = seed
+        self.n_jobs = n_jobs
+        self.client_timeout = client_timeout
+        self.shard_deadline = shard_deadline
+        self.ks = KS
+        self.ledger = []
+        self.ledger_mu = threading.Lock()
+        self.step_errors = 0        # faulted-window step/poll failures
+        self._clients = []
+
+        # store shards, each behind its own proxy (schedule seeds are
+        # derived so a multi-shard drill is still one-seed determined)
+        self.store_srvs = [StoreServer(MemStore()).start()
+                           for _ in range(store_shards)]
+        self.store_scheds = [FaultSchedule(seed * 1000 + i)
+                             for i in range(store_shards)]
+        self.store_proxies = [
+            FaultProxy(("127.0.0.1", srv.port), sch,
+                       name=f"store-proxy-{i}").start()
+            for i, (srv, sch) in enumerate(zip(self.store_srvs,
+                                               self.store_scheds))]
+        # result store behind a proxy
+        self.logd = LogSinkServer().start()
+        self.logd_sched = FaultSchedule(seed * 1000 + 99)
+        self.logd_proxy = FaultProxy(("127.0.0.1", self.logd.port),
+                                     self.logd_sched,
+                                     name="logd-proxy").start()
+
+        # agents (each its own wire clients, like separate processes)
+        self.agents = []
+        self.dead_agents = []
+        for i in range(n_agents):
+            ex = RecordingExecutor(self.ledger, self.ledger_mu,
+                                   block_jobs=block_jobs)
+            a = NodeAgent(self.store_client(), self.sink_client(),
+                          node_id=f"node-{i}", ttl=agent_ttl,
+                          proc_ttl=proc_ttl, lock_ttl=120.0,
+                          proc_req=0.0, executor=ex)
+            a.register()
+            self.agents.append(a)
+
+        # scheduler(s): leader + warm standbys
+        from cronsun_tpu.sched import SchedulerService
+        cap = 256
+        while cap < n_jobs + 8:
+            cap *= 2
+        self.scheds = []
+        self.dead_scheds = []
+        for i in range(n_scheds):
+            self.scheds.append(SchedulerService(
+                self.store_client(), job_capacity=cap, node_capacity=64,
+                window_s=window_s, lease_ttl=lease_ttl,
+                dispatch_ttl=dispatch_ttl, node_id=f"sched-{i}",
+                checkpoint_dir=checkpoint_dir))
+
+        # auditor connections (never faulted mid-drill: audits run
+        # after heal)
+        self.audit_store = self.store_client()
+        self.audit_sink = self.sink_client()
+
+    # -- client factories --------------------------------------------------
+
+    def store_client(self):
+        conns = [RemoteStore("127.0.0.1", p.port,
+                             timeout=self.client_timeout)
+                 for p in self.store_proxies]
+        if len(conns) == 1:
+            c = conns[0]
+        else:
+            c = ShardedStore(conns, shard_deadline=self.shard_deadline)
+        self._clients.append(c)
+        return c
+
+    def sink_client(self):
+        c = RemoteJobLogStore("127.0.0.1", self.logd_proxy.port,
+                              timeout=self.client_timeout)
+        self._clients.append(c)
+        return c
+
+    # -- workload ----------------------------------------------------------
+
+    def put_jobs(self, prefix="cj", n=None, nids=None):
+        n = self.n_jobs if n is None else n
+        nids = nids or [a.id for a in self.agents]
+        ids = []
+        for i in range(n):
+            job = Job(id=f"{prefix}{i:04d}", name=f"{prefix}{i}",
+                      command="true", kind=KIND_INTERVAL,
+                      rules=[JobRule(timer="* * * * * *", nids=nids)])
+            job.check()
+            self.audit_store.put(self.ks.job_key(job.group, job.id),
+                                 job.to_json())
+            ids.append(job.id)
+        # the job watch is ASYNC: wait until every scheduler's mirror
+        # holds every job before driving, or the first window races the
+        # wire and "loses" fires that were simply not yet registered
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            for sc in self.live_scheds():
+                sc.drain_watches()
+            if all(sc.rows.rules_of("default", jid)
+                   for sc in self.live_scheds() for jid in ids):
+                break
+            time.sleep(0.02)
+        return ids
+
+    # -- drive/settle ------------------------------------------------------
+
+    def live_scheds(self):
+        return [s for s in self.scheds if s not in self.dead_scheds]
+
+    def live_agents(self):
+        return [a for a in self.agents if a not in self.dead_agents]
+
+    def drive(self, t, end, on_second=None, stall_timeout=30.0):
+        """Step schedulers over synthetic seconds [t, end); agents
+        consume as orders land.  When no scheduler leads (failover in
+        progress) real time passes until one wins.  Returns the final
+        plan cursor (every second below it was planned)."""
+        stall_t0 = time.monotonic()
+        while t < end:
+            # a partitioned store makes steps/polls THROW — the
+            # production loops catch and keep going (sched/service.py
+            # start(); the agents' poll loop likewise), so the drill
+            # drives the same way
+            for sc in self.live_scheds():
+                try:
+                    sc.step(now=t)
+                except Exception:  # noqa: BLE001 — faulted plane
+                    self.step_errors += 1
+            for a in self.live_agents():
+                try:
+                    a.poll()
+                except Exception:  # noqa: BLE001 — faulted plane
+                    self.step_errors += 1
+            if on_second is not None:
+                # BEFORE the join: kill-style callbacks need to act
+                # while executions are provably in flight
+                on_second(t)
+            for a in self.live_agents():
+                try:
+                    a.join_running(timeout=2.0)   # settle() fully joins
+                except Exception:  # noqa: BLE001 — faulted plane
+                    self.step_errors += 1
+            epochs = [sc._next_epoch for sc in self.live_scheds()
+                      if sc._next_epoch is not None]
+            nt = max(epochs) if epochs else None
+            if nt is None or nt <= t:
+                if time.monotonic() - stall_t0 > stall_timeout:
+                    raise RuntimeError(
+                        f"drive stalled at epoch {t} (no leader for "
+                        f"{stall_timeout:.0f}s)")
+                time.sleep(0.05)     # waiting out a lease (failover)
+                continue
+            stall_t0 = time.monotonic()
+            t = nt
+        return t
+
+    def quiesce_publishers(self, timeout=30.0):
+        """Flush every live scheduler's async build/publish pipeline so
+        submitted windows LAND (and the HWM persists).  Kill drills run
+        this before the kill: a real kill -9 almost always falls
+        between landed windows, and the coverage gate is about
+        takeover correctness, not about windows that provably never
+        reached the store (those are the bounded failover gap)."""
+        for sc in self.live_scheds():
+            try:
+                builder = getattr(sc, "_builder", None)
+                if builder is not None:
+                    builder.flush()       # pipelined step: gather/build
+                sc.publisher.flush(timeout=timeout)
+            except Exception:  # noqa: BLE001 — a dead/partitioned
+                pass           # publisher's windows are the drill's point
+
+    def settle(self, timeout=30.0):
+        """Let the fleet converge to a fixpoint: the async publisher
+        lands its queued windows, agents drain every published order,
+        executions finish, acks and records flush."""
+        self.quiesce_publishers(timeout)
+        deadline = time.monotonic() + timeout
+        stable = 0
+        while time.monotonic() < deadline:
+            for a in self.live_agents():
+                try:
+                    a.poll()
+                    a.join_running()
+                except Exception:  # noqa: BLE001 — still healing
+                    pass
+            try:
+                left = self.audit_store.count_prefix(self.ks.dispatch)
+                procs = self.audit_store.count_prefix(self.ks.proc)
+            except Exception:  # noqa: BLE001 — still healing
+                time.sleep(0.2)
+                continue
+            if left == 0 and procs == 0:
+                # two consecutive clean reads: one clean read can race
+                # a publisher lane that has not flushed yet
+                stable += 1
+                if stable >= 2:
+                    break
+            else:
+                stable = 0
+            time.sleep(0.1)
+        for a in self.live_agents():
+            a._flush_acks()
+            a._flush_records(force=True)
+        # retry slot may still hold a batch (sink was down): give the
+        # ladder a couple of beats to land it
+        for _ in range(40):
+            if all(a._rec_retry is None and not a._rec_buf
+                   for a in self.live_agents()):
+                break
+            time.sleep(0.25)
+            for a in self.live_agents():
+                a._flush_records(force=True)
+
+    # -- kill switches -----------------------------------------------------
+
+    def kill_sched(self, sc):
+        """kill -9 semantics: the process vanishes — EVERY socket dies
+        (main client AND the publisher's lane connections, which would
+        otherwise keep publishing queued windows from beyond the
+        grave), leases live on server-side until TTL, nothing is
+        flushed or revoked."""
+        self.dead_scheds.append(sc)
+        for conn in getattr(sc.publisher, "_lane_conns", []):
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — dying anyway
+                pass
+        sc.store.close()
+
+    def kill_agent(self, a):
+        self.dead_agents.append(a)
+        a.store.close()
+        a.sink.close()
+
+    # -- audits ------------------------------------------------------------
+
+    def flushed_totals(self):
+        flushed = dropped = 0
+        for a in self.agents:       # dead agents' acked counts included
+            flushed += a.stats["rec_flush_records_total"]
+            dropped += a.stats["rec_dropped_total"]
+        return flushed, dropped
+
+    def audit(self, expect_jobs=None, planned_range=None,
+              allow_unacked_extra=False, fixpoint=True):
+        """The drill gate: exactly-once + acked records (+ optional
+        full-coverage and fixpoint).  Returns (findings, info)."""
+        with self.ledger_mu:
+            ledger = list(self.ledger)
+        findings = invariants.check_exactly_once(ledger)
+        flushed, dropped = self.flushed_totals()
+        # audits run after heal, but a just-expired fault window can
+        # leave the auditor's connection mid-reconnect: retry briefly
+        sink_total = None
+        for _ in range(20):
+            try:
+                sink_total = self.audit_sink.stat_overall()["total"]
+                break
+            except Exception:  # noqa: BLE001 — healing
+                time.sleep(0.25)
+        if sink_total is None:
+            sink_total = self.audit_sink.stat_overall()["total"]
+        findings += invariants.check_acked_records(
+            flushed, dropped, sink_total,
+            allow_unacked_extra=allow_unacked_extra)
+        if fixpoint:
+            findings += invariants.check_fixpoint(self.audit_store,
+                                                  self.ks)
+        missing = 0
+        if expect_jobs is not None and planned_range is not None:
+            lo, hi = planned_range
+            have = set(ledger)
+            for jid in expect_jobs:
+                for sec in range(lo, hi):
+                    if (jid, sec) not in have:
+                        missing += 1
+                        findings.append(invariants.Finding(
+                            "lost_fire", f"{jid}@{sec}",
+                            "planned (job, second) never executed"))
+        info = {"executions": len(ledger), "flushed": flushed,
+                "dropped": dropped, "sink_total": sink_total,
+                "lost_fires": missing}
+        return findings, info
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self):
+        hooks.reset()
+        for sch in self.store_scheds + [self.logd_sched]:
+            sch.clear()
+        for a in self.agents:
+            if a not in self.dead_agents:
+                try:
+                    a.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        for sc in self.scheds:
+            if sc not in self.dead_scheds:
+                try:
+                    sc.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in self.store_proxies + [self.logd_proxy]:
+            p.stop()
+        for s in self.store_srvs:
+            s.stop()
+        self.logd.stop()
+
+
+def _findings_json(findings):
+    return [{"code": f.code, "key": f.key, "detail": f.detail}
+            for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# drills
+# ---------------------------------------------------------------------------
+
+def drill_smoke(seed=7, seconds=3, on_log=print):
+    """Tier-1 gate: a short seeded drill — wire-level delay/dup/reorder
+    on the store, deterministic reply-lost injections on both clients'
+    hot retry ladders — ends with zero invariant violations, and the
+    fault schedule is byte-identical across constructions."""
+    # determinism: same seed -> byte-identical schedule, twice
+    def mk():
+        s = FaultSchedule(seed)
+        s.add("delay", prob=0.2, ms=15)
+        s.add("dup", prob=0.10)
+        s.add("reorder", prob=0.05)
+        return s
+    deterministic = mk().schedule_bytes() == mk().schedule_bytes()
+
+    fleet = Fleet(seed=seed, n_jobs=10, n_agents=2)
+    try:
+        # the proxy wire faults (benign but real: slow lines, duplicated
+        # and swapped frames)
+        for sch in fleet.store_scheds:
+            sch.add("delay", prob=0.2, ms=15)
+            sch.add("dup", prob=0.10)
+            sch.add("reorder", prob=0.05)
+        # deterministic reply-lost hits on the two ladders built for it
+        hooks.arm("store.rpc", "reply_lost",
+                  ops=("claim_many", "claim_bundle"), count=2, seed=seed)
+        hooks.arm("logsink.rpc", "reply_lost", ops="create_job_logs",
+                  count=2, seed=seed)
+        jobs = fleet.put_jobs()
+        end = fleet.drive(T0, T0 + seconds)
+        fleet.settle()
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        info.update(injected=hooks.snapshot(),
+                    proxy_stats=[p.stats for p in fleet.store_proxies],
+                    schedule_deterministic=deterministic)
+        if not deterministic:
+            findings.append(invariants.Finding(
+                "schedule_nondeterministic", "",
+                "same seed produced different fault schedules"))
+        on_log(f"smoke: {info['executions']} execs, "
+               f"{len(findings)} finding(s), injected={info['injected']}")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+def drill_leader_kill9(seed=11, on_log=print):
+    """Kill -9 the leading scheduler DURING a herd second; the warm
+    standby must take over within a bounded window and the union of
+    both leaders' dispatches must cover every planned (job, second)
+    exactly once."""
+    fleet = Fleet(seed=seed, n_jobs=40, n_agents=2, n_scheds=2,
+                  lease_ttl=2.0)
+    try:
+        jobs = fleet.put_jobs()
+        mid = fleet.drive(T0, T0 + 3)
+        # let in-flight windows LAND (the HWM persists) — a kill that
+        # eats a never-landed window is the bounded failover gap, not
+        # the lost-fire invariant this drill gates
+        fleet.quiesce_publishers()
+        leader = next(s for s in fleet.scheds if s.is_leader)
+        on_log(f"killing leader {leader.node_id} at epoch {mid}")
+        t_kill = time.monotonic()
+        fleet.kill_sched(leader)
+        end = fleet.drive(mid, mid + 4)
+        standby = next(s for s in fleet.live_scheds() if s.is_leader)
+        recovery_s = time.monotonic() - t_kill
+        fleet.settle()
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end),
+                                     allow_unacked_extra=False)
+        # bounded recovery: lease expiry + a couple of steps
+        bound = 2.0 * 3 + 10
+        if recovery_s > bound:
+            findings.append(invariants.Finding(
+                "recovery_unbounded", "",
+                f"takeover took {recovery_s:.1f}s (> {bound:.0f}s)"))
+        info.update(recovery_s=round(recovery_s, 3),
+                    takeover_by=standby.node_id,
+                    resigns=sum(s.stats["lease_resigns_total"]
+                                for s in fleet.scheds))
+        on_log(f"leader_kill9: recovery {recovery_s:.2f}s, "
+               f"{info['executions']} execs, {len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+def drill_shard_partition(seed=13, on_log=print):
+    """One store shard of two severed for ~2.5 s mid-drain, then
+    healed: publishes to it hole-and-rewind, claims ladder through
+    indeterminacy, and after heal every planned fire lands exactly
+    once with a clean fixpoint."""
+    fleet = Fleet(seed=seed, n_jobs=24, n_agents=2, store_shards=2)
+    try:
+        jobs = fleet.put_jobs()
+        mid = fleet.drive(T0, T0 + 2)
+        on_log(f"severing store shard 1 at epoch {mid}")
+        el = fleet.store_proxies[1].elapsed()
+        rid = fleet.store_scheds[1].add("sever", start=el, end=el + 2.5)
+        t_fault = time.monotonic()
+        end = fleet.drive(mid, mid + 5, stall_timeout=60.0)
+        fleet.store_scheds[1].remove(rid)
+        fleet.settle(timeout=45.0)
+        recovery_s = time.monotonic() - t_fault
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        info.update(partition_s=2.5, recovery_s=round(recovery_s, 3),
+                    proxy_stats=[p.stats for p in fleet.store_proxies])
+        on_log(f"shard_partition: {info['executions']} execs, "
+               f"{len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+def drill_logd_flap(seed=17, on_log=print):
+    """The result store flaps — repeated short severs — while agents
+    execute: the rec-flush ladder (pinned idem tokens, 0.5-10 s
+    backoff) must deliver EXACTLY the acked set once the sink heals:
+    no drop (the flap fits the 30-attempt budget), no duplicate (the
+    tokens dedup every applied-but-unacked re-send)."""
+    fleet = Fleet(seed=seed, n_jobs=16, n_agents=2)
+    try:
+        jobs = fleet.put_jobs()
+        # three sever bursts over the drill: 0.6 s down, 0.6 s up
+        el = fleet.logd_proxy.elapsed()
+        last_end = 0.0
+        for i in range(3):
+            fleet.logd_sched.add("sever", start=el + 0.2 + 1.2 * i,
+                                 end=el + 0.8 + 1.2 * i)
+            last_end = el + 0.8 + 1.2 * i
+        end = fleet.drive(T0, T0 + 5)
+        # a fast drive can finish before the LAST burst has even
+        # started: wait the whole scripted window out before settling
+        while fleet.logd_proxy.elapsed() < last_end + 0.3:
+            time.sleep(0.1)
+        fleet.settle(timeout=45.0)
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        info.update(proxy_stats=fleet.logd_proxy.stats)
+        on_log(f"logd_flap: {info['executions']} execs, sink "
+               f"{info['sink_total']} == acked {info['flushed']}, "
+               f"{len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+def drill_brownout(seed=19, reads=150, delay_ms=250.0,
+                   deadline_s=0.08, on_log=print):
+    """THE brownout measurement (acceptance gate): shard 1 of 2 answers
+    slowly (alive, not dead) while a dashboard-style reader scans a
+    fanned prefix.  Pre-fix (no breaker) every read stalls behind the
+    slow shard; with per-shard breakers the healthy shard's reads are
+    bounded — p99 <= 2x the healthy baseline — and the skipped shard
+    is counted loudly in shard_degraded stats."""
+    fleet = Fleet(seed=seed, n_jobs=12, n_agents=2, store_shards=2)
+    try:
+        fleet.put_jobs()    # populate cmd/ across both shards
+
+        def measure(client, n):
+            # the dashboard read shape: partial-tolerant prefix scan
+            # (web's _degraded_prefix); plain clients fall back to the
+            # strict scan
+            read = getattr(client, "get_prefix_degraded", None) or \
+                client.get_prefix
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                read(KS.cmd)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            return lat
+
+        plain = fleet.store_client()                    # breaker OFF
+        hard = ShardedStore(
+            [RemoteStore("127.0.0.1", p.port, timeout=8.0)
+             for p in fleet.store_proxies],
+            shard_deadline=deadline_s, breaker_cooldown=2.0)
+        fleet._clients.append(hard)
+
+        base = measure(plain, reads)
+        baseline_p99 = pctl(base, 0.99)
+
+        el = fleet.store_proxies[1].elapsed()
+        rid = fleet.store_scheds[1].add("delay", start=el, ms=delay_ms,
+                                        direction="s2c")
+        degraded = measure(plain, max(20, reads // 4))  # pre-fix stall
+        measure(hard, 8)    # steady-state: let the breaker trip (its
+        # fail_threshold slow calls are the detection cost, paid once
+        # per brownout episode, not per read)
+        hardened = measure(hard, reads)                 # breaker path
+        fleet.store_scheds[1].remove(rid)
+
+        res = {
+            "baseline_p99_ms": round(baseline_p99, 2),
+            "degraded_p99_ms": round(pctl(degraded, 0.99), 2),
+            "hardened_p99_ms": round(pctl(hardened, 0.99), 2),
+            "hardened_p50_ms": round(pctl(hardened, 0.50), 2),
+            "delay_ms": delay_ms,
+            "breaker": hard.breaker_snapshot(),
+        }
+        findings = []
+        # the stall must be real (else the drill measured nothing) ...
+        if res["degraded_p99_ms"] < delay_ms * 0.8:
+            findings.append(invariants.Finding(
+                "brownout_not_induced", "",
+                f"pre-fix p99 {res['degraded_p99_ms']}ms never stalled "
+                f"behind the {delay_ms}ms shard"))
+        # ... and the breaker must bound it (the acceptance criterion;
+        # floor the bound for sub-ms baselines on fast hosts)
+        bound = max(2.0 * baseline_p99, 20.0)
+        if res["hardened_p99_ms"] > bound:
+            findings.append(invariants.Finding(
+                "brownout_unbounded", "",
+                f"breaker-on p99 {res['hardened_p99_ms']}ms exceeds "
+                f"{bound:.1f}ms (2x baseline)"))
+        if not any(b["degraded_reads_total"] > 0
+                   for b in res["breaker"]):
+            findings.append(invariants.Finding(
+                "degraded_not_counted", "",
+                "no shard_degraded stat was recorded for the skipped "
+                "shard"))
+        on_log(f"brownout: baseline p99 {res['baseline_p99_ms']}ms, "
+               f"stalled {res['degraded_p99_ms']}ms, hardened "
+               f"{res['hardened_p99_ms']}ms, {len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": res}
+    finally:
+        fleet.close()
+
+
+def drill_ckpt_race(seed=23, on_log=print):
+    """Checkpoint save racing a store partition: saves land or fail
+    LOUDLY (no torn/adopted state), the scheduler keeps dispatching
+    exactly-once afterwards, and a post-heal save succeeds."""
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-ckpt-")
+    fleet = Fleet(seed=seed, n_jobs=16, n_agents=2,
+                  checkpoint_dir=ckpt_dir)
+    try:
+        jobs = fleet.put_jobs()
+        mid = fleet.drive(T0, T0 + 2)
+        sc = fleet.scheds[0]
+        el = fleet.store_proxies[0].elapsed()
+        rid = fleet.store_scheds[0].add("sever", start=el + 0.1,
+                                        end=el + 1.6)
+        saves = {"ok": 0, "err": 0}
+
+        def try_save():
+            try:
+                sc.checkpoint_save()
+                saves["ok"] += 1
+            except Exception as e:  # noqa: BLE001 — loud failure IS
+                saves["err"] += 1   # the accepted outcome mid-partition
+                on_log(f"save during partition failed loudly: {e}")
+        th = threading.Thread(target=try_save)
+        time.sleep(0.2)              # inside the sever window
+        th.start()
+        th.join(timeout=60)
+        fleet.store_scheds[0].remove(rid)
+        time.sleep(0.3)
+        end = fleet.drive(mid, mid + 3, stall_timeout=60.0)
+        try:
+            sc.checkpoint_save()     # post-heal save must land
+            saves["ok"] += 1
+        except Exception as e:  # noqa: BLE001
+            saves["err"] += 1
+            on_log(f"post-heal save failed: {e}")
+        fleet.settle(timeout=45.0)
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        if saves["ok"] < 1:
+            findings.append(invariants.Finding(
+                "ckpt_never_landed", "",
+                f"no checkpoint save succeeded after heal ({saves})"))
+        info.update(saves=saves,
+                    ckpt_stats={k: v for k, v in sc.stats.items()
+                                if "checkpoint" in k})
+        on_log(f"ckpt_race: saves={saves}, {len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def drill_agent_kill(seed=29, on_log=print):
+    """Kill -9 an agent while an execution is provably in flight: its
+    fence is consumed so nobody double-fires, its leased keys age out
+    (clean fixpoint), the acked-record ledger shows no LOSS — and
+    fsck NAMES the crashed run as a fence-without-record finding."""
+    victim_job = "vk0000"
+    fleet = Fleet(seed=seed, n_jobs=8, n_agents=2, dispatch_ttl=3.0,
+                  agent_ttl=2.0, proc_ttl=2.0,
+                  block_jobs=(victim_job,))
+    try:
+        jobs = fleet.put_jobs()
+        jobs += fleet.put_jobs(prefix="vk", n=1)
+        end = fleet.drive(T0, T0 + 3)
+        fleet.quiesce_publishers()
+        # poll until the victim job is provably IN FLIGHT somewhere,
+        # then kill that agent mid-execution
+        killed = None
+        deadline = time.monotonic() + 20.0
+        while killed is None and time.monotonic() < deadline:
+            for a in fleet.live_agents():
+                try:
+                    a.poll()
+                except Exception:  # noqa: BLE001 — churn
+                    pass
+            for a in fleet.live_agents():
+                if a.executor.blocked.wait(timeout=0.1):
+                    on_log(f"killing agent {a.id} mid-execution")
+                    fleet.kill_agent(a)
+                    killed = a
+                    # victim's thread dies into closed sockets; the
+                    # SURVIVOR's blocked runs (other seconds of the
+                    # same job) complete normally from here on
+                    for b in fleet.agents:
+                        b.executor.release.set()
+                    break
+        if killed is None:
+            raise RuntimeError("victim job never started — drill bug")
+        end = fleet.drive(end, end + 2)
+        time.sleep(3.5)              # victim's leased keys age out
+        fleet.settle(timeout=45.0)
+        findings, info = fleet.audit(allow_unacked_extra=True)
+        # the offline audit must NAME the crashed run
+        fsck_findings = invariants.fsck(
+            fleet.audit_store, sink=fleet.audit_sink, ks=fleet.ks,
+            stale_order_s=60.0)
+        named = [f for f in fsck_findings
+                 if f.code == "fence_without_record"
+                 and f.key == victim_job]
+        if not named:
+            findings.append(invariants.Finding(
+                "fsck_blind", victim_job,
+                "fsck failed to name the fence-without-record left by "
+                "the killed agent"))
+        info.update(fsck=[str(f) for f in fsck_findings],
+                    killed=[a.id for a in fleet.dead_agents],
+                    planned_end=end)
+        on_log(f"agent_kill: {info['executions']} execs, fsck named "
+               f"{len(named)} crashed run(s), {len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+DRILLS = {
+    "smoke": drill_smoke,
+    "leader_kill9": drill_leader_kill9,
+    "shard_partition": drill_shard_partition,
+    "logd_flap": drill_logd_flap,
+    "brownout": drill_brownout,
+    "ckpt_race": drill_ckpt_race,
+    "agent_kill": drill_agent_kill,
+}
+
+
+def run_drills(names, seed=None, on_log=print):
+    out = {}
+    violations = 0
+    for name in names:
+        fn = DRILLS[name]
+        on_log(f"=== drill {name} ===")
+        t0 = time.monotonic()
+        kw = {} if seed is None else {"seed": seed}
+        try:
+            res = fn(on_log=on_log, **kw)
+        except Exception as e:  # noqa: BLE001 — a crashed drill is a
+            res = {"findings": [{"code": "drill_crashed", "key": name,
+                                 "detail": repr(e)}],   # failed gate
+                   "info": {}}
+            on_log(f"drill {name} CRASHED: {e!r}")
+        res["wall_s"] = round(time.monotonic() - t0, 2)
+        out[name] = res
+        violations += len(res["findings"])
+    out["total_findings"] = violations
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--drill", default="smoke",
+                    help="drill name or 'all' "
+                         f"({', '.join(DRILLS)})")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override each drill's default seed")
+    ap.add_argument("--json", default=None,
+                    help="write results JSON here")
+    args = ap.parse_args(argv)
+    names = list(DRILLS) if args.drill == "all" else \
+        [d.strip() for d in args.drill.split(",")]
+    for n in names:
+        if n not in DRILLS:
+            ap.error(f"unknown drill {n!r}")
+    res = run_drills(names, seed=args.seed)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=2)
+    print(json.dumps({k: (v if k == "total_findings"
+                          else {"findings": v["findings"],
+                                "wall_s": v["wall_s"]})
+                      for k, v in res.items()}, indent=2))
+    return 1 if res["total_findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

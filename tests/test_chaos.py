@@ -1,0 +1,712 @@
+"""Chaos plane: backoff ladders, circuit breaker, fault hooks,
+FaultProxy, invariant audits, brownout-hardened sharded clients, the
+leader-lease watchdog, and the tier-1 seeded smoke drill.
+
+The drills themselves (kill -9, partitions, flaps) live in the slow
+tier (test_chaos_drills.py); this module pins the building blocks and
+runs the one short deterministic drill the CI gate requires.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+from cronsun_tpu.chaos.faultproxy import FaultProxy, FaultSchedule
+from cronsun_tpu.chaos.hooks import ChaosHooks, det01
+from cronsun_tpu.chaos import invariants
+from cronsun_tpu.core import Job, JobRule, Keyspace
+from cronsun_tpu.core.backoff import (
+    Backoff, NOTICER, PUBLISH, PUBLISH_ATTEMPTS, RECONNECT, REC_FLUSH)
+from cronsun_tpu.core.breaker import (
+    CircuitBreaker, ShardDegradedError, ShardGuard)
+from cronsun_tpu.core.models import KIND_INTERVAL
+from cronsun_tpu.logsink.joblog import JobLogStore, LogRecord
+from cronsun_tpu.store.memstore import MemStore
+from cronsun_tpu.store.remote import RemoteStore, RemoteStoreError, \
+    StoreServer
+from cronsun_tpu.store.sharded import ShardedStore
+
+KS = Keyspace()
+
+
+@pytest.fixture
+def chaos_env(monkeypatch):
+    """Arm permission for the in-process hooks + a clean registry."""
+    monkeypatch.setenv("CRONSUN_CHAOS", "1")
+    from cronsun_tpu.chaos.hooks import hooks
+    hooks.reset()
+    yield hooks
+    hooks.reset()
+
+
+# ---------------------------------------------------------------------------
+# backoff: the published ladders are pinned (satellite: unify the four
+# hand-rolled retry copies; the schedule must not drift silently)
+# ---------------------------------------------------------------------------
+
+class TestBackoff:
+    def test_reconnect_ladder_pinned(self):
+        # store/remote.py _heal: 0.2 s doubling, capped at 2 s
+        assert [RECONNECT.delay(n) for n in range(1, 6)] == \
+            [0.2, 0.4, 0.8, 1.6, 2.0]
+
+    def test_rec_flush_ladder_pinned(self):
+        # node/agent.py retry slot: 0.5 s .. 10 s; with
+        # rec_flush_max_fails=30 that is ~4-5 min of outage coverage
+        assert [REC_FLUSH.delay(n) for n in range(1, 7)] == \
+            [0.5, 1.0, 2.0, 4.0, 8.0, 10.0]
+        assert REC_FLUSH.delay(30) == 10.0
+
+    def test_noticer_ladder_pinned(self):
+        assert [NOTICER.delay(n) for n in range(1, 9)] == \
+            [0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+
+    def test_publish_ladder_pinned(self):
+        assert PUBLISH_ATTEMPTS == 4
+        assert [PUBLISH.delay(n) for n in range(1, 5)] == \
+            [0.2, 0.4, 0.8, 1.6]
+
+    def test_unbounded_attempts_never_overflow(self):
+        # the reconnect/noticer loops retry forever: a multi-hour
+        # outage reaches attempt counts where an unclamped float pow
+        # raises OverflowError and kills the heal thread
+        assert RECONNECT.delay(100_000) == 2.0
+        assert NOTICER.delay(10_000_000) == 30.0
+
+    def test_consumers_reference_the_shared_ladders(self):
+        # the four call sites must use core.backoff, not a re-inlined
+        # copy — grep-level pin so a revert is loud
+        import inspect
+        from cronsun_tpu.store import remote
+        from cronsun_tpu.node import agent
+        from cronsun_tpu import noticer
+        from cronsun_tpu.sched import publisher
+        assert "RECONNECT.sleep" in inspect.getsource(remote)
+        assert "REC_FLUSH.delay" in inspect.getsource(agent)
+        assert "NOTICER.delay" in inspect.getsource(noticer)
+        assert "PUBLISH.sleep" in inspect.getsource(publisher)
+
+    def test_jitter_deterministic_under_seed(self):
+        a = Backoff(0.5, 10.0, jitter=0.5, seed=42)
+        b = Backoff(0.5, 10.0, jitter=0.5, seed=42)
+        xs = [a.delay(n) for n in range(1, 8)]
+        assert xs == [b.delay(n) for n in range(1, 8)]
+        base = Backoff(0.5, 10.0)
+        for n, x in enumerate(xs, 1):
+            assert base.delay(n) <= x <= base.delay(n) * 1.5
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Backoff(0, 1.0)
+        with pytest.raises(ValueError):
+            Backoff(1.0, 0.5)
+        with pytest.raises(ValueError):
+            Backoff(0.5, 1.0, jitter=2.0)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestBreaker:
+    def test_disabled_breaker_is_transparent(self):
+        b = CircuitBreaker(deadline=0.0)
+        for _ in range(10):
+            assert b.allow()
+            b.record(False)
+        assert b.state == "closed"
+
+    def test_open_after_threshold_then_probe_cycle(self):
+        clock = [0.0]
+        b = CircuitBreaker(deadline=0.1, fail_threshold=3, cooldown=1.0,
+                           clock=lambda: clock[0])
+        for _ in range(2):
+            assert b.allow()
+            b.record(False)
+        assert b.state == "closed"
+        b.record(False)                 # third consecutive -> open
+        assert b.state == "open"
+        assert not b.allow()            # fail-fast
+        assert b.snapshot()["refused_total"] == 1
+        clock[0] = 1.1                  # cooldown elapsed -> probing
+        assert b.state == "probing"
+        assert b.allow()                # exactly one probe
+        assert not b.allow()
+        b.record(False)                 # probe failed -> open again
+        assert b.state == "open"
+        clock[0] = 2.3
+        assert b.allow()                # next probe
+        b.record(True, elapsed=0.01)    # heals
+        assert b.state == "closed"
+        assert b.allow()
+        assert b.snapshot()["opens_total"] == 2
+
+    def test_straggler_failures_do_not_extend_cooldown(self):
+        # calls already in flight when the breaker opened fail late:
+        # they must not restart the cooldown (recovery would be pushed
+        # out indefinitely) nor inflate opens_total
+        clock = [0.0]
+        b = CircuitBreaker(deadline=0.1, fail_threshold=1, cooldown=1.0,
+                           clock=lambda: clock[0])
+        b.record(False)                 # open at t=0
+        clock[0] = 0.9
+        b.record(False)                 # straggler
+        assert b.snapshot()["opens_total"] == 1
+        clock[0] = 1.05
+        assert b.state == "probing"     # cooldown measured from t=0
+
+    def test_slow_success_counts_as_brownout(self):
+        b = CircuitBreaker(deadline=0.05, fail_threshold=2)
+        b.record(True, elapsed=0.2)     # succeeded, but SLOW
+        b.record(True, elapsed=0.2)
+        assert b.state == "open"
+
+    def test_guard_wraps_and_fails_fast(self):
+        clock = [0.0]
+        b = CircuitBreaker(deadline=5.0, fail_threshold=2, cooldown=30.0,
+                           clock=lambda: clock[0])
+
+        class Boom:
+            calls = 0
+
+            def get(self, k):
+                Boom.calls += 1
+                raise OSError("down")
+
+            def keyerr(self):
+                raise KeyError("lease 7")
+
+        g = ShardGuard(Boom(), b, 3, healthy_errors=(KeyError,),
+                       label="store shard")
+        with pytest.raises(KeyError):
+            g.keyerr()                  # healthy answer: no fail count
+        assert b.state == "closed"
+        for _ in range(2):
+            with pytest.raises(OSError):
+                g.get("k")
+        assert b.state == "open"
+        with pytest.raises(ShardDegradedError):
+            g.get("k")                  # refused BEFORE reaching the shard
+        assert Boom.calls == 2
+
+
+# ---------------------------------------------------------------------------
+# in-process hooks (reply-lost / timeout / delay)
+# ---------------------------------------------------------------------------
+
+class TestHooks:
+    def test_env_gated_off_in_production(self, monkeypatch):
+        monkeypatch.delenv("CRONSUN_CHAOS", raising=False)
+        h = ChaosHooks()
+        with pytest.raises(RuntimeError):
+            h.arm("store.rpc", "timeout")
+        assert not h.armed
+
+    def test_decisions_are_pure_hashes(self):
+        xs = [det01(7, "r1", k) for k in range(64)]
+        assert xs == [det01(7, "r1", k) for k in range(64)]
+        assert xs != [det01(8, "r1", k) for k in range(64)]
+        assert all(0.0 <= x < 1.0 for x in xs)
+
+    def test_probabilistic_rule_deterministic(self, chaos_env):
+        h = chaos_env
+        h.arm("s", "timeout", prob=0.5, seed=3, rule_id="fixed")
+        fired1 = [h.intercept("s", "op") is not None for _ in range(64)]
+        h.reset()
+        h.arm("s", "timeout", prob=0.5, seed=3, rule_id="fixed")
+        fired2 = [h.intercept("s", "op") is not None for _ in range(64)]
+        assert fired1 == fired2
+        assert any(fired1) and not all(fired1)
+
+    def test_count_budget_and_op_filter(self, chaos_env):
+        h = chaos_env
+        h.arm("s", "delay", ops=("get",), count=2, ms=1)
+        assert h.intercept("s", "put") is None
+        assert h.intercept("s", "get") is not None
+        assert h.intercept("s", "get") is not None
+        assert h.intercept("s", "get") is None     # budget spent
+        assert h.snapshot() == {"s:delay": 2}
+
+    def test_remote_store_timeout_and_reply_lost(self, chaos_env):
+        h = chaos_env
+        srv = StoreServer(MemStore()).start()
+        c = RemoteStore("127.0.0.1", srv.port, timeout=5)
+        try:
+            h.arm("store.rpc", "timeout", ops="put", count=1)
+            with pytest.raises(RemoteStoreError, match="chaos"):
+                c.put("/k1", "v")
+            assert c.get("/k1") is None      # never reached the wire
+
+            h.arm("store.rpc", "reply_lost", ops="put", count=1)
+            with pytest.raises(RemoteStoreError, match="reply-lost"):
+                c.put("/k2", "v2")
+            kv = c.get("/k2")                # APPLIED server-side
+            assert kv is not None and kv.value == "v2"
+        finally:
+            c.close()
+            srv.stop()
+
+    def test_logsink_reply_lost_dedups_via_idem(self, chaos_env):
+        from cronsun_tpu.logsink.serve import LogSinkServer, \
+            LogSinkError, RemoteJobLogStore
+        h = chaos_env
+        srv = LogSinkServer().start()
+        c = RemoteJobLogStore("127.0.0.1", srv.port, timeout=5)
+        try:
+            recs = [LogRecord("j1", "default", "n", "node-0", "",
+                              "true", "out", True, 1.0, 2.0)]
+            h.arm("logsink.rpc", "reply_lost", ops="create_job_logs",
+                  count=1)
+            with pytest.raises(LogSinkError, match="reply-lost"):
+                c.create_job_logs(list(recs), idem="tok-1")
+            # the caller's ladder re-sends the SAME idem: applied batch
+            # dedups server-side — exactly one row
+            recs2 = [LogRecord("j1", "default", "n", "node-0", "",
+                               "true", "out", True, 1.0, 2.0)]
+            c.create_job_logs(recs2, idem="tok-1")
+            assert c.stat_overall()["total"] == 1
+        finally:
+            c.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# FaultProxy
+# ---------------------------------------------------------------------------
+
+class TestFaultProxy:
+    def test_schedule_bytes_deterministic(self):
+        def mk(seed):
+            s = FaultSchedule(seed)
+            s.add("drop", prob=0.3)
+            s.add("delay", start=1.0, end=2.0, ms=50, prob=0.7,
+                  direction="s2c")
+            return s
+        assert mk(9).schedule_bytes() == mk(9).schedule_bytes()
+        assert mk(9).schedule_bytes() != mk(10).schedule_bytes()
+
+    def test_passthrough_sever_heal(self):
+        srv = StoreServer(MemStore()).start()
+        sched = FaultSchedule(1)
+        proxy = FaultProxy(("127.0.0.1", srv.port), sched).start()
+        c = RemoteStore("127.0.0.1", proxy.port, timeout=5)
+        try:
+            c.put("/a", "1")
+            assert c.get("/a").value == "1"
+            rid = sched.add("sever")
+            deadline = time.monotonic() + 5
+            with pytest.raises((RemoteStoreError, OSError)):
+                while time.monotonic() < deadline:
+                    c.put("/b", "2")     # monitor kills the pipe
+                    time.sleep(0.05)
+            sched.remove(rid)
+            # the client's RECONNECT ladder heals through the proxy
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    c.put("/c", "3")
+                    break
+                except RemoteStoreError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.1)
+            assert c.get("/c").value == "3"
+            assert proxy.stats["sever"] > 0
+        finally:
+            c.close()
+            proxy.stop()
+            srv.stop()
+
+    def test_delay_injects_latency(self):
+        srv = StoreServer(MemStore()).start()
+        sched = FaultSchedule(2)
+        proxy = FaultProxy(("127.0.0.1", srv.port), sched).start()
+        c = RemoteStore("127.0.0.1", proxy.port, timeout=5)
+        try:
+            c.put("/a", "1")
+            t0 = time.perf_counter()
+            c.get("/a")
+            fast = time.perf_counter() - t0
+            sched.add("delay", ms=120, direction="s2c")
+            t0 = time.perf_counter()
+            c.get("/a")
+            slow = time.perf_counter() - t0
+            assert slow >= 0.11 > fast
+            assert proxy.stats["delay"] > 0
+        finally:
+            c.close()
+            proxy.stop()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# invariant audits + fsck
+# ---------------------------------------------------------------------------
+
+def _mk_job(jid, kind=KIND_INTERVAL):
+    job = Job(id=jid, name=jid, command="true", kind=kind,
+              rules=[JobRule(timer="* * * * * *", nids=["node-0"])])
+    job.check()
+    return job
+
+
+class TestInvariants:
+    def test_exactly_once_flags_duplicates(self):
+        clean = invariants.check_exactly_once(
+            [("a", 1), ("a", 2), ("b", 1)])
+        assert clean == []
+        dup = invariants.check_exactly_once(
+            [("a", 1), ("a", 1), ("b", 2)])
+        assert [f.code for f in dup] == ["exactly_once_violation"]
+        assert dup[0].key == "a@1"
+
+    def test_acked_records(self):
+        assert invariants.check_acked_records(10, 0, 10) == []
+        loss = invariants.check_acked_records(10, 0, 8)
+        assert [f.code for f in loss] == ["acked_record_loss"]
+        dup = invariants.check_acked_records(10, 0, 12)
+        assert [f.code for f in dup] == ["duplicate_records"]
+        # kill -9: applied-but-unacked surplus is legitimate
+        assert invariants.check_acked_records(
+            10, 0, 12, allow_unacked_extra=True) == []
+        dropped = invariants.check_acked_records(10, 3, 10)
+        assert [f.code for f in dropped] == ["records_dropped"]
+
+    def test_fixpoint_flags_leftovers(self):
+        store = MemStore()
+        assert invariants.check_fixpoint(store, KS) == []
+        store.put(KS.dispatch_bundle_key("node-0", 100), "[]")
+        store.put(KS.proc_key("node-0", "default", "j1", 1), "{}")
+        store.put(KS.alone_lock_key("j2"), "node-0")
+        codes = sorted(f.code for f in
+                       invariants.check_fixpoint(store, KS))
+        assert codes == ["leaked_reservation", "orphan_proc",
+                         "stuck_alone_lock"]
+
+    def test_fsck_names_every_finding_class(self):
+        store = MemStore()
+        sink = JobLogStore()
+        now = 1_760_000_000
+        job = _mk_job("alive")
+        store.put(KS.job_key("default", "alive"), job.to_json())
+        # stale reservation (epoch 1h in the past), fresh one tolerated
+        store.put(KS.dispatch_bundle_key("node-0", now - 3600), "[]")
+        store.put(KS.dispatch_bundle_key("node-0", now - 1), "[]")
+        # orphan proc (job never existed)
+        store.put(KS.proc_key("node-0", "default", "ghost", 1), "{}")
+        # dangling dep
+        store.put(KS.dep_key("default", "ghost2"), "100|ok")
+        # orphan fence + a SETTLED consumed fence (an hour old — far
+        # past the flush ladder) with NO execution record; a fresh
+        # fence rides in-flight tolerance and is NOT a finding
+        store.put(KS.lock_key("ghost3", now), "x")
+        store.put(KS.lock_key("alive", now - 3600), "x")
+        store.put(KS.lock_key("alive", now - 1), "x")
+        out = invariants.fsck(store, sink=sink, ks=KS, now=now,
+                              stale_order_s=900.0)
+        codes = sorted(f.code for f in out)
+        assert codes == ["dangling_dep", "fence_without_record",
+                         "leaked_reservation", "orphan_fence",
+                         "orphan_proc"]
+        # record the execution: the fence finding clears
+        sink.create_job_log(LogRecord("alive", "default", "alive",
+                                      "node-0", "", "true", "", True,
+                                      1.0, 2.0))
+        out = invariants.fsck(store, sink=sink, ks=KS, now=now,
+                              stale_order_s=900.0)
+        assert "fence_without_record" not in {f.code for f in out}
+        assert "clean" not in invariants.render(out)
+        assert invariants.render([]).startswith("fsck: clean")
+
+    def test_ctl_fsck_exit_codes(self, capsys):
+        from cronsun_tpu.bin.ctl import main as ctl_main
+        store = MemStore()
+        srv = StoreServer(store).start()
+        addr = f"127.0.0.1:{srv.port}"
+        try:
+            with pytest.raises(SystemExit) as ei:
+                ctl_main(["fsck", "--store", addr])
+            assert ei.value.code == 0
+            assert "clean" in capsys.readouterr().out
+            store.put(KS.proc_key("node-0", "default", "ghost", 1), "{}")
+            with pytest.raises(SystemExit) as ei:
+                ctl_main(["fsck", "--store", addr])
+            assert ei.value.code == 1
+            assert "orphan_proc" in capsys.readouterr().out
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# brownout-hardened sharded clients
+# ---------------------------------------------------------------------------
+
+class _SlowStore(MemStore):
+    """MemStore whose reads stall — the browned-out shard."""
+
+    def __init__(self):
+        super().__init__()
+        self.slow_s = 0.0
+
+    def get_prefix(self, prefix):
+        if self.slow_s:
+            time.sleep(self.slow_s)
+        return super().get_prefix(prefix)
+
+
+class TestBrownout:
+    def test_degraded_reads_skip_open_shard_loudly(self):
+        s0, s1 = MemStore(), _SlowStore()
+        st = ShardedStore([s0, s1], shard_deadline=0.05,
+                          breaker_fails=2, breaker_cooldown=60.0)
+        try:
+            # seed both shards via direct writes (routing not at issue)
+            s0.put(KS.cmd + "default/a", "1")
+            s1.put(KS.cmd + "default/b", "2")
+            assert len(st.get_prefix(KS.cmd)) == 2
+            s1.slow_s = 0.2
+            for _ in range(2):        # trips the breaker (slow success)
+                st.get_prefix_degraded(KS.cmd)
+            snap = st.breaker_snapshot()
+            assert snap[1]["state"] == "open"
+            # the DASHBOARD read: partial, fast, counted loudly
+            t0 = time.perf_counter()
+            part = st.get_prefix_degraded(KS.cmd)
+            assert time.perf_counter() - t0 < 0.1   # no stall
+            assert [kv.key for kv in part] == [KS.cmd + "default/a"]
+            assert st.breaker_snapshot()[1]["degraded_reads_total"] >= 1
+            assert st.count_prefix_degraded(KS.cmd) == 1
+            # the STRICT scan (scheduler resync diffs listings against
+            # local state — missing keys read as deletions): never a
+            # silent partial, it fails FAST instead
+            t0 = time.perf_counter()
+            with pytest.raises(ShardDegradedError):
+                st.get_prefix(KS.cmd)
+            assert time.perf_counter() - t0 < 0.1
+            with pytest.raises(ShardDegradedError):
+                st.count_prefix(KS.cmd)
+        finally:
+            st.close()
+
+    def test_claims_fail_fast_on_open_shard(self):
+        s0, s1 = MemStore(), MemStore()
+        st = ShardedStore([s0, s1], shard_deadline=0.05,
+                          breaker_fails=1, breaker_cooldown=60.0)
+        try:
+            # find a job id hashing to shard 1 and open its breaker
+            jid = next(f"job{i}" for i in range(64)
+                       if st._idx(KS.lock_key(f"job{i}", 5)) == 1)
+            st._breakers[1].record(False)
+            assert st._breakers[1].state == "open"
+            with pytest.raises(ShardDegradedError):
+                st.claim_bundle("", [(KS.lock_key(jid, 5), "n", "", "",
+                                      "")], 0, 0)
+            with pytest.raises(ShardDegradedError):
+                st.put(KS.lock_key(jid, 6), "x")
+            # the HEALTHY shard's keys are untouched by the outage
+            other = next(f"job{i}" for i in range(64)
+                         if st._idx(KS.lock_key(f"job{i}", 5)) == 0)
+            assert st.claim_bundle(
+                "", [(KS.lock_key(other, 5), "n", "", "", "")],
+                0, 0) == [True]
+        finally:
+            st.close()
+
+    def test_disabled_breaker_keeps_raw_shards(self):
+        s0, s1 = MemStore(), MemStore()
+        st = ShardedStore([s0, s1])          # no deadline: raw clients
+        assert st.shards[0] is s0
+        assert st.breaker_snapshot() == []
+        st.close()
+
+    def test_sharded_sink_tolerant_stats(self):
+        from cronsun_tpu.logsink.sharded import ShardedJobLogStore
+        a, b = JobLogStore(), JobLogStore()
+        sk = ShardedJobLogStore([a, b], shard_deadline=0.05,
+                                breaker_fails=1, breaker_cooldown=60.0)
+        for i, sh in enumerate((a, b)):
+            sh.create_job_log(LogRecord(f"j{i}", "default", "n",
+                                        "node-0", "", "true", "", True,
+                                        1.0, 2.0))
+        assert sk.stat_overall()["total"] == 2
+        sk._breakers[1].record(False)
+        assert sk._breakers[1].state == "open"
+        assert sk.stat_overall()["total"] == 1   # partial, loud
+        assert sk.breaker_snapshot()[1]["degraded_reads_total"] >= 1
+        # writes routed to the open shard fail FAST into the agent's
+        # retry ladder instead of stalling the flush
+        jid = next(f"w{i}" for i in range(64) if sk._idx(f"w{i}") == 1)
+        with pytest.raises(ShardDegradedError):
+            sk.create_job_logs([LogRecord(jid, "default", "n", "node-0",
+                                          "", "true", "", True, 1.0,
+                                          2.0)],
+                               idem="t1")
+
+
+# ---------------------------------------------------------------------------
+# sharded-client degraded ladders over the real wire (satellite 4)
+# ---------------------------------------------------------------------------
+
+class TestShardedDegradedLadders:
+    def test_reply_lost_claim_bundle_fence_survives(self, chaos_env):
+        """Reply-lost claim_bundle on one shard of a 2-shard set: the
+        sub-claim APPLIED (fences written) but the client saw an
+        error, so the reservation key was never released — redelivery
+        finds the order intact and the fences refuse a double fire."""
+        h = chaos_env
+        srvs = [StoreServer(MemStore()).start() for _ in range(2)]
+        conns = [RemoteStore("127.0.0.1", s.port, timeout=5)
+                 for s in srvs]
+        st = ShardedStore(conns)
+        try:
+            # two jobs, one per shard, bundled under one order key
+            jids = {st._idx(KS.lock_key(f"j{i}", 7)): f"j{i}"
+                    for i in range(64)}
+            ja, jb = jids[0], jids[1]
+            order = KS.dispatch_bundle_key("node-0", 7)
+            st.put(order, "[]")
+            items = [(KS.lock_key(ja, 7), "n1", "", "", ""),
+                     (KS.lock_key(jb, 7), "n1", "", "", "")]
+            h.arm("store.rpc", "reply_lost", ops="claim_bundle", count=1)
+            with pytest.raises(RemoteStoreError, match="reply-lost"):
+                st.claim_bundle(order, items)
+            # phase-1 claim applied on its shard; the reservation key
+            # (phase 2, ordered LAST) was never consumed
+            assert st.get(order) is not None, \
+                "reservation lost — redelivery impossible"
+            # redelivery: the re-claim settles the bundle; fences from
+            # the applied sub-claim hold (False = no double fire)
+            items2 = [(KS.lock_key(ja, 7), "n2", "", "", ""),
+                      (KS.lock_key(jb, 7), "n2", "", "", "")]
+            wins = st.claim_bundle(order, items2)
+            assert st.get(order) is None       # consumed exactly once
+            fa = st.get(KS.lock_key(ja, 7)).value
+            fb = st.get(KS.lock_key(jb, 7)).value
+            # every fence holds exactly ONE claimant's nonce
+            for pos, val in ((0, fa), (1, fb)):
+                if wins[pos]:
+                    assert val == "n2"
+                else:
+                    assert val == "n1"    # the reply-lost claim won it
+            assert not all(wins), \
+                "the applied sub-claim's fences were re-won: double fire"
+        finally:
+            st.close()
+            for s in srvs:
+                s.stop()
+
+    def test_severed_shard_create_job_logs_idem_recovers(self):
+        """A severed logd shard mid create_job_logs fan-out: the
+        healthy shard applies, the severed one fails the whole-batch
+        contract; retries under the SAME idem token exhaust against
+        the dead shard, then recover after heal — with zero duplicates
+        on the shard that applied first."""
+        from cronsun_tpu.logsink.serve import LogSinkServer, \
+            LogSinkError, RemoteJobLogStore
+        from cronsun_tpu.logsink.sharded import ShardedJobLogStore
+        srvs = [LogSinkServer().start() for _ in range(2)]
+        sched = FaultSchedule(5)
+        proxy = FaultProxy(("127.0.0.1", srvs[1].port), sched).start()
+        conns = [RemoteJobLogStore("127.0.0.1", srvs[0].port, timeout=3),
+                 RemoteJobLogStore("127.0.0.1", proxy.port, timeout=3)]
+        sk = ShardedJobLogStore(conns)
+        try:
+            def rec(jid, k):
+                return LogRecord(jid, "default", jid, "node-0", "",
+                                 "true", "", True, float(k),
+                                 float(k) + 1)
+            jids = {sk._idx(f"j{i}"): f"j{i}" for i in range(64)}
+            batch = [rec(jids[0], 1), rec(jids[1], 2)]
+            rid = sched.add("sever")
+            time.sleep(0.1)
+            for attempt in range(2):   # exhaust against the dead shard
+                with pytest.raises(LogSinkError):
+                    sk.create_job_logs(
+                        [rec(jids[0], 1), rec(jids[1], 2)],
+                        idem="batch-7")
+            sched.remove(rid)
+            # heal, then the SAME logical batch + token lands clean
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    sk.create_job_logs(
+                        [rec(jids[0], 1), rec(jids[1], 2)],
+                        idem="batch-7")
+                    break
+                except LogSinkError:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.2)
+            # shard 0 applied (attempt 1 + exhausted retries + final) —
+            # the derived per-shard token dedups them all to ONE row
+            assert conns[0].stat_overall()["total"] == 1
+            assert conns[1].stat_overall()["total"] == 1
+            assert sk.stat_overall()["total"] == 2
+            del batch
+        finally:
+            sk.close()
+            proxy.stop()
+            for s in srvs:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# leader-lease watchdog (satellite 2: pinned by a FaultProxy delay)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_lease_watchdog_resigns_under_rpc_delay():
+    """An injected RPC delay > lease_ttl/2 on the keepalive round trip
+    must make the leader RESIGN loudly (stop publishing, count, revoke,
+    re-elect) instead of dispatching on a lease it may have lost."""
+    from cronsun_tpu.sched import SchedulerService
+    srv = StoreServer(MemStore()).start()
+    sched_rules = FaultSchedule(3)
+    proxy = FaultProxy(("127.0.0.1", srv.port), sched_rules).start()
+    store = RemoteStore("127.0.0.1", proxy.port, timeout=30)
+    sc = SchedulerService(store, job_capacity=256, node_capacity=64,
+                          window_s=2, lease_ttl=2.0, node_id="wd-1")
+    try:
+        assert sc.try_lead()
+        assert sc.is_leader
+        rid = sched_rules.add("delay", ms=1200, direction="s2c")
+        led = sc.try_lead()
+        assert sc.stats["lease_resigns_total"] >= 1
+        if not led:
+            assert not sc.is_leader    # stopped publishing
+        sched_rules.remove(rid)
+        # recovery: with the wire healthy the next attempts re-elect
+        deadline = time.monotonic() + 15
+        while not sc.try_lead():
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert sc.is_leader
+    finally:
+        sc.stop()
+        store.close()
+        proxy.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke: one short seeded drill, deterministic, zero
+# invariant violations (the CI gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_smoke_drill(monkeypatch):
+    monkeypatch.setenv("CRONSUN_CHAOS", "1")
+    import bench_chaos
+    res = bench_chaos.drill_smoke(seed=5, on_log=lambda *a: None)
+    assert res["info"]["schedule_deterministic"], \
+        "same seed must give byte-identical fault schedules"
+    assert res["findings"] == [], res["findings"]
+    assert res["info"]["executions"] > 0
+    inj = res["info"]["injected"]
+    assert inj.get("store.rpc:reply_lost", 0) > 0
+    assert inj.get("logsink.rpc:reply_lost", 0) > 0

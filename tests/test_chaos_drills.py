@@ -1,0 +1,72 @@
+"""Slow-tier chaos drill gates (ISSUE 12 CI satellite).
+
+Runs the heavyweight named drills from scripts/bench_chaos.py — kill -9
+of the scheduler leader, a store-shard partition, the logd flap, the
+brownout measurement, the checkpoint/partition race and the mid-
+execution agent kill — and asserts each converges with ZERO invariant
+violations (no duplicate fires, no lost fires where the drill
+guarantees coverage, no acked-record loss, clean fixpoint) within a
+bounded recovery window.
+
+Marked slow: each drill assembles a real TCP fleet and rides real
+lease/backoff clocks.  The deterministic tier-1 smoke lives in
+test_chaos.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+os.environ.setdefault("CRONSUN_CHAOS", "1")
+
+import bench_chaos  # noqa: E402
+
+
+def _run(drill, **kw):
+    res = bench_chaos.DRILLS[drill](on_log=lambda *a: None, **kw)
+    return res
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_drills():
+    """The issue's named gate: kill -9 leader + shard-partition drills
+    pass with zero duplicate/lost fires and bounded recovery."""
+    res = _run("leader_kill9")
+    assert res["findings"] == [], res["findings"]
+    assert res["info"]["recovery_s"] < 16.0
+    assert res["info"]["executions"] > 0
+
+    res = _run("shard_partition")
+    assert res["findings"] == [], res["findings"]
+    assert res["info"]["executions"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_brownout_drill_bounded_p99():
+    """Acceptance criterion: with one shard browned out, the
+    breaker-hardened client's read p99 stays <= 2x the healthy
+    baseline while the pre-fix client stalls at the injected delay."""
+    res = _run("brownout")
+    assert res["findings"] == [], res["findings"]
+    info = res["info"]
+    assert info["degraded_p99_ms"] >= info["delay_ms"] * 0.8
+    assert info["hardened_p99_ms"] <= \
+        max(2.0 * info["baseline_p99_ms"], 20.0)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_logd_flap_and_crash_drills():
+    """Result-plane flap (pinned idem tokens: sink == acked exactly),
+    checkpoint racing a partition (loud failure, clean convergence),
+    and the agent kill -9 mid-execution (fsck names the crashed run)."""
+    for name in ("logd_flap", "ckpt_race", "agent_kill"):
+        res = _run(name)
+        assert res["findings"] == [], (name, res["findings"])
