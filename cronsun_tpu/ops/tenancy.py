@@ -1,0 +1,336 @@
+"""Multi-tenant admission control: token buckets in the batched tick +
+host-side weighted max-min fair share.
+
+Two cooperating mechanisms, one per resource:
+
+- **Fire-rate token buckets** (device, :func:`admit`): every tenant with
+  a quota carries one token-bucket column — ``tokens`` [T] float32,
+  refilled by ``rate`` and capped at ``burst`` per scheduled second —
+  and the batched tick admits at most ``floor(tokens)`` of the tenant's
+  fires per second, in row order.  The pass composes into the planner's
+  fused window scan (ops/planner.py) exactly like the DAG plane: a
+  handful of elementwise ops per second, compiled OUT entirely
+  (``use_tenants`` static arg) while no limited tenant exists, so
+  single-tenant tables run the exact pre-tenancy program.
+
+- **Fair-share dispatch** (host, :func:`weighted_max_min` +
+  :func:`select_fair`): when a second's aggregate EXCLUSIVE demand
+  exceeds the fleet's remaining agent capacity, the order build clamps
+  each tenant to its weighted max-min share of the available slots
+  instead of letting whoever fired first (i.e. the biggest tenant)
+  take everything.  Vectorized numpy in the scheduler's
+  ``_build_plan_orders`` path — never a per-fire Python loop.
+
+Which fires get refused, and what happens to them:
+
+- admission picks the FIRST ``allowed`` fired rows of each tenant in
+  table-row order (deterministic; pinned by the reference evaluator);
+- a refused **time-triggered** fire is SHED — cron semantics, a missed
+  second does not come back (counted ``shed_fires``);
+- a refused **dep-triggered** fire is THROTTLED — its ``last_fire``
+  does not advance, so it retries next tick when the bucket refills
+  (counted in ``throttled_fires`` only);
+- both are loud: per-tenant counters in scheduler stats, rendered at
+  ``/v1/metrics`` as ``cronsun_tenant_*{tenant=...}``.
+
+The per-tenant rank needed to pick "first k fires of tenant t" is
+computed WITHOUT a [J, T] one-hot or a sort per tick: the planner keeps
+a host-snapshotted permutation grouping rows by tenant (recomputed only
+on tenant churn); inside the jit the rank is one gathered cumsum over
+the permuted fire column.
+
+:class:`ReferenceAdmission` is the pure-Python spec of the bucket
+semantics; :func:`reference_max_min` the fair-share oracle — both drive
+the randomized differential tests in tests/test_tenancy.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def tenant_order(tenants: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                               np.ndarray]:
+    """Precompute the admission permutation for a row->tenant map:
+    ``(perm, sorted_tenant, segbase)`` where ``perm`` stably sorts rows
+    by tenant, ``sorted_tenant[i] = tenants[perm[i]]`` and
+    ``segbase[i]`` is the permuted index where ``i``'s tenant segment
+    begins.  Host-side, O(J log J), recomputed only on tenant churn."""
+    t = np.asarray(tenants, np.int32)
+    perm = np.argsort(t, kind="stable").astype(np.int32)
+    ts = t[perm]
+    n = len(ts)
+    segbase = np.zeros(n, np.int32)
+    if n > 1:
+        new = ts[1:] != ts[:-1]
+        starts = np.concatenate([[0], np.flatnonzero(new) + 1])
+        seg_id = np.concatenate([[0], np.cumsum(new.astype(np.int64))])
+        segbase = starts[seg_id].astype(np.int32)
+    return perm, ts.astype(np.int32), segbase
+
+
+def fair_shares(demand, weight, capacity):
+    """Device weighted max-min (pure jnp): per-tenant shares of
+    ``capacity`` slots — maximize the minimum share/weight subject to
+    ``share <= demand`` and ``sum(share) <= capacity``.  Continuous
+    waterfill, floored, then the stranded remainder (< 1 slot per
+    unsaturated tenant) is granted one unit each to the tenants with
+    the smallest floored share/weight (ties to the lowest id) — no
+    scarce slot is wasted.  :func:`weighted_max_min` is the same spec
+    on the host; ``demand`` [T] int32, ``weight`` [T] f32,
+    ``capacity`` f32 scalar."""
+    import jax.numpy as jnp
+    T = demand.shape[0]
+    d = demand.astype(jnp.float32)
+    cap = jnp.maximum(capacity, 0.0)
+    r = d / weight
+    order = jnp.argsort(r)
+    d_s = d[order]
+    w_s = weight[order]
+    cum_d = jnp.cumsum(d_s)
+    cum_w = jnp.cumsum(w_s)
+    rem_cap = cap - jnp.concatenate([jnp.zeros(1, jnp.float32),
+                                     cum_d[:-1]])
+    rem_w = (cum_w[-1] - jnp.concatenate([jnp.zeros(1, jnp.float32),
+                                          cum_w[:-1]]))
+    level_k = rem_cap / jnp.maximum(rem_w, 1e-9)
+    saturates = d_s <= level_k * w_s
+    # tenants saturate in a prefix of the demand/weight order; cumprod
+    # finds its length robustly (spurious saturations past the split
+    # don't count)
+    k = jnp.sum(jnp.cumprod(saturates.astype(jnp.int32)))
+    level = level_k[jnp.minimum(k, T - 1)]
+    in_prefix = jnp.arange(T) < k
+    shares_s = jnp.where(in_prefix | (k >= T), d_s,
+                         jnp.minimum(d_s, jnp.floor(level * w_s)))
+    shares = jnp.zeros(T, jnp.int32).at[order].set(
+        shares_s.astype(jnp.int32))
+    # top-up: flooring strands < 1 unit per unsaturated tenant; grant
+    # the leftover one unit each by smallest floored share/weight
+    # (stable argsort: ties resolve to the lowest tenant id).  With
+    # abundant capacity nothing is eligible and the grant is empty.
+    eligible = shares < demand.astype(jnp.int32)
+    leftover = jnp.clip(jnp.floor(cap).astype(jnp.int32)
+                        - jnp.sum(shares), 0, T)
+    leftover = jnp.minimum(leftover,
+                           jnp.sum(eligible.astype(jnp.int32)))
+    key = jnp.where(eligible, shares.astype(jnp.float32) / weight,
+                    jnp.inf)
+    order2 = jnp.argsort(key)
+    grant = jnp.zeros(T, bool).at[order2].set(jnp.arange(T) < leftover)
+    return shares + (grant & eligible).astype(jnp.int32)
+
+
+def admit(fire, time_fire, exclusive, tokens, rate, burst, limited,
+          weight, rem_cap, perm, sorted_tenant, segbase,
+          n_tenants: int):
+    """One second of tenant admission (pure jnp, traced inside the
+    planner's jitted window scan), two clamps:
+
+    1. **token bucket** — each LIMITED tenant's fires clamp to
+       ``floor(tokens)`` after this second's refill, first fires in
+       row order winning;
+    2. **fair share** — when the surviving EXCLUSIVE demand exceeds
+       the fleet's remaining slots (``sum(rem_cap)``), each tenant
+       clamps to its weighted max-min share (:func:`fair_shares`), so
+       the scarce slots spread by weight instead of first-come.  Runs
+       BEFORE the capacity-constrained assign, which then places a
+       fair mix.  With abundant capacity shares == demand and the
+       clamp is inert.
+
+    ``fire`` [J] bool — all fires this second (time + dep);
+    ``time_fire`` [J] bool — the time-triggered subset (refusals are
+    shed, not retried); ``exclusive`` [J] bool; ``tokens``/``rate``/
+    ``burst``/``limited``/``weight`` [T]; ``rem_cap`` [N] int32;
+    ``perm``/``sorted_tenant``/``segbase`` from :func:`tenant_order`.
+
+    Tokens are spent by FINALLY admitted fires only (a fire the fair
+    clamp refused did not run).  Returns ``(admitted [J] bool,
+    new_tokens [T] f32, throttled [T] i32, shed [T] i32)``."""
+    import jax.numpy as jnp
+    T = n_tenants
+    # refill first: a second's own refill is spendable in that second
+    tokens = jnp.minimum(burst, tokens + rate)
+    allowed = jnp.floor(tokens).astype(jnp.int32)
+    fp = fire[perm].astype(jnp.int32)
+    c = jnp.cumsum(fp)
+    base = jnp.where(segbase > 0, c[jnp.maximum(segbase - 1, 0)], 0)
+    rank = c - base                       # 1-based among my tenant's fires
+    lim_row = limited[sorted_tenant]
+    a1_p = (fp > 0) & (~lim_row | (rank <= allowed[sorted_tenant]))
+    # fair share over the rate-admitted exclusive demand
+    ex_p = exclusive[perm]
+    fx = (a1_p & ex_p).astype(jnp.int32)
+    cx = jnp.cumsum(fx)
+    base_x = jnp.where(segbase > 0, cx[jnp.maximum(segbase - 1, 0)], 0)
+    rank_x = cx - base_x
+    demand_x = jnp.zeros(T, jnp.int32).at[sorted_tenant].add(fx)
+    cap = jnp.sum(jnp.maximum(rem_cap, 0).astype(jnp.float32))
+    shares = fair_shares(demand_x, weight, cap)
+    admit_p = a1_p & (~ex_p | (rank_x <= shares[sorted_tenant]))
+    admitted = jnp.zeros_like(fire).at[perm].set(admit_p)
+    fired_t = jnp.zeros(T, jnp.int32).at[sorted_tenant].add(fp)
+    adm_t = jnp.zeros(T, jnp.int32).at[sorted_tenant].add(
+        admit_p.astype(jnp.int32))
+    shed_p = (fp > 0) & ~admit_p & time_fire[perm]
+    shed_t = jnp.zeros(T, jnp.int32).at[sorted_tenant].add(
+        shed_p.astype(jnp.int32))
+    tokens = jnp.where(limited, tokens - adm_t.astype(jnp.float32),
+                       tokens)
+    return admitted, tokens, fired_t - adm_t, shed_t
+
+
+class ReferenceAdmission:
+    """Pure-Python spec of the token-bucket admission (the differential
+    oracle).  ``quotas``: {tenant_id: (rate, burst)}; absent tenants are
+    unlimited."""
+
+    def __init__(self, quotas: Dict[int, Tuple[float, float]]):
+        self.quotas = dict(quotas)
+        self.tokens = {t: b for t, (_r, b) in quotas.items()}
+
+    def tick(self, fires: Sequence[Tuple[int, int]]) -> List[bool]:
+        """``fires`` = [(row, tenant)] in ROW order; returns the admit
+        decision per fire after one second's refill."""
+        for t, (r, b) in self.quotas.items():
+            self.tokens[t] = min(b, self.tokens[t] + r)
+        allowed = {t: int(np.floor(v)) for t, v in self.tokens.items()}
+        taken: Dict[int, int] = {}
+        out = []
+        for _row, ten in sorted(fires):
+            if ten not in self.quotas:
+                out.append(True)
+                continue
+            k = taken.get(ten, 0)
+            ok = k < allowed[ten]
+            if ok:
+                taken[ten] = k + 1
+                self.tokens[ten] -= 1.0
+            out.append(ok)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fair share (host, vectorized)
+# ---------------------------------------------------------------------------
+
+def weighted_max_min(demand: np.ndarray, weight: np.ndarray,
+                     capacity: int) -> np.ndarray:
+    """Integer weighted max-min shares: maximize the minimum
+    ``share/weight`` subject to ``share_t <= demand_t`` and
+    ``sum(share) <= capacity``.
+
+    Vectorized waterfill: tenants sorted by ``demand/weight`` saturate
+    in that order; the rest split the remaining capacity by weight.
+    Fractional remainders are granted one unit each in ascending tenant
+    order (deterministic).  Returns int64 shares, same shape as demand.
+    """
+    d = np.asarray(demand, np.int64)
+    w = np.asarray(weight, np.float64)
+    n = len(d)
+    shares = np.zeros(n, np.int64)
+    if capacity <= 0 or n == 0:
+        return shares
+    if d.sum() <= capacity:
+        return d.copy()
+    active = d > 0
+    idx = np.flatnonzero(active)
+    r = d[idx] / w[idx]
+    order = idx[np.argsort(r, kind="stable")]
+    # walk saturation points: after the k cheapest tenants saturate,
+    # the level is (capacity - sum of their demands) / remaining weight;
+    # the first k where the next tenant would NOT saturate is the split
+    d_sorted = d[order].astype(np.float64)
+    w_sorted = w[order]
+    cum_d = np.concatenate([[0.0], np.cumsum(d_sorted)])
+    cum_w = np.concatenate([[0.0], np.cumsum(w_sorted)])
+    total_w = cum_w[-1]
+    rem_cap = capacity - cum_d[:-1]                    # before tenant k
+    rem_w = total_w - cum_w[:-1]
+    level = rem_cap / np.maximum(rem_w, 1e-12)
+    saturates = d_sorted <= level * w_sorted
+    # tenants saturate in a prefix (level is monotone non-increasing
+    # past the true split); the first non-saturating index is the split
+    ns = np.flatnonzero(~saturates)
+    k = int(ns[0]) if len(ns) else len(order)
+    sat = order[:k]
+    uns = order[k:]
+    shares[sat] = d[sat]
+    if len(uns):
+        lvl = (capacity - d[sat].sum()) / w[uns].sum()
+        frac = lvl * w[uns]
+        base = np.floor(frac).astype(np.int64)
+        base = np.minimum(base, d[uns])
+        shares[uns] = base
+        # flooring strands < 1 unit per unsaturated tenant; grant the
+        # leftover ONE unit each to the tenants with the smallest
+        # floored share/weight (ties to the lowest id) — the exact
+        # rule the device :func:`fair_shares` applies, single pass.
+        left = int(capacity - shares.sum())
+        if left > 0:
+            cands = np.flatnonzero(shares < d)
+            order2 = cands[np.argsort(shares[cands] / w[cands],
+                                      kind="stable")]
+            shares[order2[:left]] += 1
+    return shares
+
+
+def reference_max_min(demand, weight, capacity) -> np.ndarray:
+    """O(T^2) oracle for :func:`weighted_max_min` — the same spec
+    (continuous weighted max-min, then floor + smallest-share/weight
+    top-up) computed the obviously-correct way: iterative saturation
+    with no sort, no prefix algebra.  Differential target for the
+    vectorized version."""
+    d = np.asarray(demand, np.int64)
+    w = np.asarray(weight, np.float64)
+    n = len(d)
+    shares = np.zeros(n, np.int64)
+    cap = float(capacity)
+    if capacity <= 0 or n == 0:
+        return shares
+    if d.sum() <= capacity:
+        return d.copy()
+    active = {t for t in range(n) if d[t] > 0}
+    # peel off saturating tenants until the level is below everyone
+    level = 0.0
+    while active:
+        level = cap / sum(w[t] for t in active)
+        sat = [t for t in active if d[t] <= level * w[t]]
+        if not sat:
+            break
+        for t in sat:
+            shares[t] = d[t]
+            cap -= float(d[t])
+            active.discard(t)
+    for t in active:
+        shares[t] = min(d[t], int(np.floor(level * w[t])))
+    left = int(capacity - shares.sum())
+    if left > 0:
+        cands = sorted((t for t in range(n) if shares[t] < d[t]),
+                       key=lambda t: (shares[t] / w[t], t))
+        for t in cands[:left]:
+            shares[t] += 1
+    return np.asarray(shares, np.int64)
+
+
+def select_fair(tenants: np.ndarray, caps: np.ndarray) -> np.ndarray:
+    """Keep mask selecting the FIRST ``caps[t]`` entries of each tenant
+    in input order (vectorized: stable argsort + per-segment rank).
+    ``tenants`` [F] int32 ids; ``caps`` [T] int64 (index by id)."""
+    t = np.asarray(tenants, np.int64)
+    n = len(t)
+    if n == 0:
+        return np.zeros(0, bool)
+    order = np.argsort(t, kind="stable")
+    ts = t[order]
+    # rank within segment, in input order (stable sort preserves it)
+    new = np.concatenate([[True], ts[1:] != ts[:-1]])
+    starts = np.flatnonzero(new)
+    seg_id = np.cumsum(new) - 1
+    rank = np.arange(n, dtype=np.int64) - starts[seg_id]
+    keep_sorted = rank < np.asarray(caps, np.int64)[ts]
+    keep = np.zeros(n, bool)
+    keep[order] = keep_sorted
+    return keep

@@ -35,7 +35,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Set, Tuple
 import numpy as np
 
 from .. import log
-from ..core import Group, Job, Keyspace
+from ..core import Group, Job, Keyspace, TenantQuota
 from ..core.models import KIND_ALONE
 from ..cron.parser import ParseError, parse
 from ..ops.deps import NEVER as DEP_NEVER, POLICY_BY_NAME
@@ -226,6 +226,36 @@ class SchedulerService:
         # triggers working
         self._dep_supported = hasattr(self.planner, "set_dep_epochs")
         self._dep_warned: Set[Tuple[str, str]] = set()
+
+        # ---- multi-tenant control plane host state ---------------------
+        # quota registry (tenant/ watch mirror), the small-int tenant id
+        # space the device columns key on (0 = default, never limited),
+        # and the per-row tenant map the fair-share build reads.  Token
+        # buckets need planner support (mesh planners shard rows — like
+        # deps, they refuse LOUDLY); fair-share + max_running are pure
+        # host paths and work on every planner.
+        self._tenant_supported = hasattr(self.planner, "set_row_tenants")
+        self._tenant_T = int(getattr(self.planner, "T", 64))
+        self._tenants: Dict[str, TenantQuota] = {}
+        self._tenant_ids: Dict[str, int] = {"": 0}
+        self._tid_name: List[str] = [""]
+        self._tenant_ids_exhausted = False
+        self._tenant_limit_warned = False
+        self._row_tenant = np.zeros(J, np.int32)
+        self._tenant_row_updates: Dict[int, int] = {}
+        # loud per-tenant admission counters, fed from the build stage
+        # via a GIL-atomic deque (the build worker must not write the
+        # step thread's dicts)
+        self._tenant_counters: Dict[str, Dict[str, int]] = {}
+        import collections as _collections
+        self._tenant_q: "_collections.deque" = _collections.deque()
+        # outstanding EXCLUSIVE work per tenant id (order reservations +
+        # running procs), the max_running gate's input; _acct_tid
+        # freezes each mirror key's tenant breakdown at entry time so
+        # the delete decrements exactly what the add incremented
+        self._tenant_excl: Dict[int, int] = {}
+        self._acct_tid: Dict[str, dict] = {}
+        self._agg_excl_avail = float("inf")
 
         # watch-fed mirrors of the execution-state prefixes (proc registry,
         # outstanding exclusive orders, Alone lifetime locks).  The hot loop
@@ -419,6 +449,13 @@ class SchedulerService:
         self.metrics = MetricsPublisher(
             store, self.ks, "sched", self.node_id, self.metrics_snapshot,
             interval_s=5.0, clock=clock)
+        # per-tenant admission counters ride a SECOND leased snapshot
+        # under component "tenant" ({tenant: {field: n}}), rendered at
+        # /v1/metrics as cronsun_tenant_*{tenant=...}; published only
+        # once a tenant exists
+        self._tenant_metrics = MetricsPublisher(
+            store, self.ks, "tenant", self.node_id,
+            self.tenant_snapshot, interval_s=5.0, clock=clock)
         # mesh planners publish a SECOND leased snapshot under component
         # "mesh" (per-tick latency ring, per-phase counters, estimated
         # collective bytes) so /v1/metrics renders cronsun_mesh_tick_*
@@ -483,6 +520,9 @@ class SchedulerService:
             # job round; the fold into the success-epoch vectors is the
             # dep-trigger edge signal)
             self._w_deps = w(self.ks.dep)
+            # tenant quota records (the web/ctl tier writes them; job
+            # index markers under the same prefix are ignored here)
+            self._w_tenants = w(self.ks.tenant)
             # checkpoint-plane control keys: operator save requests and
             # the save barrier nonces
             self._w_ckpt = w(self.ks.ckpt)
@@ -497,7 +537,7 @@ class SchedulerService:
     def _all_watches(self):
         return (self._w_jobs, self._w_groups, self._w_nodes,
                 self._w_procs, self._w_orders, self._w_alone,
-                self._w_deps, self._w_ckpt)
+                self._w_deps, self._w_tenants, self._w_ckpt)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -513,6 +553,20 @@ class SchedulerService:
         cold-loading standbys racing it can shift a fresh anchor by the
         seconds between their boots, which only matters for @every rules
         never anchored before (existing anchors are honored)."""
+        # tenant quotas first (jobs reference tenant ids; ids allocate
+        # on demand either way, but quota limits should be armed before
+        # the first window plans).  The same listing doubles as the
+        # resync liveness diff: quotas deleted during a lost-watch gap
+        # are dropped here.
+        live_quotas = set()
+        for kv in _list_prefix(self.store, self.ks.tenant):
+            rest = kv.key[len(self.ks.tenant):]
+            if rest.endswith("/quota"):
+                live_quotas.add(rest[:-len("/quota")])
+                self._apply_ev("tenants", PUT, kv.key, kv.value)
+        for name in [n for n in self._tenants if n not in live_quotas]:
+            self._apply_ev("tenants", DELETE,
+                           self.ks.tenant_quota_key(name), "")
         for kv in (groups if groups is not None
                    else _list_prefix(self.store, self.ks.group)):
             self._apply_group(kv.value)
@@ -675,13 +729,14 @@ class SchedulerService:
         new_rules = set()
         self.jobs[(group, job_id)] = job
         jk = (group, job_id)
+        tid = self._tenant_id(job.tenant) if job.tenant else 0
         dep_spec = self._dep_spec_apply(jk, job)
         dep_row_dict = None
         if dep_spec is not None:
             dep_row_dict = make_dep_row(
                 self._dep_upstream_cols(group, dep_spec),
                 POLICY_BY_NAME.get(dep_spec.misfire, 0),
-                paused=job.pause)
+                paused=job.pause, tenant=tid)
         for rule in job.rules:
             if dep_spec is not None:
                 # dep-triggered row: no cron parse, no phase anchor —
@@ -699,6 +754,9 @@ class SchedulerService:
                     self._dep_rows.add(row)
                 self._row_phase.pop(row, None)
                 self._table_updates[row] = dep_row_dict
+                if self._row_tenant[row] != tid:
+                    self._row_tenant[row] = tid
+                    self._tenant_row_updates[row] = tid
                 self.builder.set_job(row, rule.nids, rule.gids,
                                      rule.exclude_nids)
                 self._meta_updates[row] = (
@@ -726,7 +784,11 @@ class SchedulerService:
                                                  rule.timer)
                 self._row_phase[row] = (rule.timer, phase_epoch)
             self._table_updates[row] = make_row(
-                spec, phase_epoch_s=phase_epoch, paused=job.pause)
+                spec, phase_epoch_s=phase_epoch, paused=job.pause,
+                tenant=tid)
+            if self._row_tenant[row] != tid:
+                self._row_tenant[row] = tid
+                self._tenant_row_updates[row] = tid
             self.builder.set_job(row, rule.nids, rule.gids, rule.exclude_nids)
             self._meta_updates[row] = (job.exclusive,
                                        job.avg_time if job.avg_time > 0 else 1.0)
@@ -769,6 +831,227 @@ class SchedulerService:
         self._rd_job[row] = (group, job_id)
         self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
                                | (4 if job.kind == KIND_ALONE else 0))
+
+    # ---- multi-tenant control plane -------------------------------------
+
+    def _tenant_id(self, name: str) -> int:
+        """Small-int id for a tenant name (allocated on first sight; 0
+        is the default tenant).  An exhausted id space maps overflow
+        tenants to 0 — UNLIMITED, never silently throttled — and
+        complains once."""
+        tid = self._tenant_ids.get(name)
+        if tid is not None:
+            return tid
+        if len(self._tid_name) >= self._tenant_T:
+            if not self._tenant_ids_exhausted:
+                self._tenant_ids_exhausted = True
+                log.errorf(
+                    "tenant id space exhausted (%d columns); tenant %r "
+                    "and later arrivals share the default UNLIMITED "
+                    "column — raise the planner's tenant_capacity",
+                    self._tenant_T, name)
+            self._tenant_ids[name] = 0
+            return 0
+        tid = len(self._tid_name)
+        self._tid_name.append(name)
+        self._tenant_ids[name] = tid
+        return tid
+
+    def _tname(self, tid: int) -> str:
+        return self._tid_name[tid] if 0 <= tid < len(self._tid_name) \
+            else f"tid{tid}"
+
+    def _apply_tenant_quota(self, name: str, value: str):
+        try:
+            q = TenantQuota.from_json(value)
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return
+        q.tenant = name
+        try:
+            q.validate()
+        except Exception as e:  # noqa: BLE001 — operator-written record
+            log.warnf("tenant %r quota record invalid (%s); ignored",
+                      name, e)
+            return
+        prev = self._tenants.get(name)
+        self._tenants[name] = q
+        tid = self._tenant_id(name)
+        if prev is not None and \
+                (prev.rate, prev.burst, prev.weight) == \
+                (q.rate, q.burst, q.weight):
+            # the DEVICE-relevant fields are unchanged (resync
+            # re-list, duplicate delivery, delta replay, or an edit to
+            # the host-only max_jobs/max_running): do NOT touch the
+            # planner — set_tenant_quota resets the bucket to FULL,
+            # and neither a watch flap nor a max_jobs bump may hand a
+            # throttled tenant a free burst
+            return
+        if not tid and name:
+            # the id space is exhausted and this tenant shares the
+            # default UNLIMITED column: the scheduler-side planes
+            # (fire rate, fair share, max_running) CANNOT enforce this
+            # quota — say so per quota, not just once at exhaustion
+            # (max_jobs still applies: the web tier reads the record
+            # directly)
+            log.errorf(
+                "quota for tenant %r cannot be enforced by the "
+                "scheduler: tenant id space exhausted (%d columns) — "
+                "raise the planner's tenant_capacity (max_jobs still "
+                "applies at the web tier)", name, self._tenant_T)
+            return
+        if q.limited and not self._tenant_supported:
+            if not self._tenant_limit_warned:
+                self._tenant_limit_warned = True
+                log.errorf(
+                    "tenant %r has a fire-rate quota but planner %s "
+                    "does not support token-bucket admission (mesh "
+                    "planners shard rows) — rate limits will NOT be "
+                    "enforced; fair-share and max_running still apply",
+                    name, type(self.planner).__name__)
+            return
+        if self._tenant_supported and tid:
+            self.planner.set_tenant_quota(
+                tid, q.rate if q.limited else 0.0, q.burst, q.weight)
+            # ANY quota record arms the admission pass: even a weight-
+            # only quota buys fair share under capacity scarcity.
+            # Tables with no quota at all keep the exact pre-tenancy
+            # program (the bit-identity pin).
+            if not self.planner.tenants_enabled:
+                self.planner.set_tenants_enabled(True)
+
+    def _drop_tenant_quota(self, name: str):
+        if self._tenants.pop(name, None) is None:
+            return
+        tid = self._tenant_ids.get(name, 0)
+        if tid and self._tenant_supported:
+            self.planner.clear_tenant_quota(tid)
+
+    def _drain_tenant_q(self):
+        """Fold build-stage admission/fair-share refusal counts into the
+        per-tenant counters (STEP thread: single writer)."""
+        q = self._tenant_q
+        while q:
+            item = q.popleft()
+            if item[0] == "adm":
+                _tag, thr, shed = item
+                for tid in np.flatnonzero(thr):
+                    c = self._tenant_counter(self._tname(int(tid)))
+                    c["throttled_fires"] += int(thr[tid])
+                    c["shed_fires"] += int(shed[tid])
+            else:
+                _tag, counts = item
+                for tid in np.flatnonzero(counts):
+                    c = self._tenant_counter(self._tname(int(tid)))
+                    n = int(counts[tid])
+                    c["throttled_fires"] += n
+                    c["shed_fires"] += n
+                    c["fair_shed_fires"] += n
+
+    def _tenant_counter(self, name: str) -> Dict[str, int]:
+        c = self._tenant_counters.get(name)
+        if c is None:
+            c = self._tenant_counters[name] = {
+                "throttled_fires": 0, "shed_fires": 0,
+                "fair_shed_fires": 0}
+        return c
+
+    def _fair_filter(self, rows: np.ndarray, xi: np.ndarray,
+                     cols: np.ndarray,
+                     pending: Optional[Dict[int, int]] = None):
+        """max_running clamp over one second's EXCLUSIVE fires
+        (vectorized; runs inside the order build, possibly on the
+        pipeline worker): tenants with an exec-concurrency quota clamp
+        to their remaining headroom against outstanding work (order
+        reservations + running procs — host mirror state the device
+        can't see) PLUS ``pending`` — admissions from earlier seconds
+        of the SAME window build, whose accounting only lands after
+        the window completes (without it a window_s-second build would
+        admit max_running fires per second, not per window).  Within a
+        tenant the FIRST fires in plan order survive; dropped fires
+        are shed loudly, and the device-side capacity reservation they
+        took self-heals at the next reconcile.  (Capacity fair share —
+        weighted max-min when aggregate demand exceeds the fleet's
+        slots — runs ON DEVICE in the admission pass, before
+        placement: ops/tenancy.py.)"""
+        from ..ops.tenancy import select_fair
+        T = self._tenant_T
+        BIG = np.int64(1) << 40
+        caps = None
+        capped: List[int] = []
+        # list(): this runs on the build worker while the step thread
+        # may insert/pop quota records — snapshot, don't iterate live
+        for name, quota in list(self._tenants.items()):
+            if not quota.max_running:
+                continue
+            tid = self._tenant_ids.get(name, 0)
+            if not tid:
+                continue
+            if caps is None:
+                caps = np.full(T, BIG, np.int64)
+            capped.append(tid)
+            caps[tid] = max(0, quota.max_running
+                            - self._tenant_excl.get(tid, 0)
+                            - (pending or {}).get(tid, 0))
+        if caps is None:
+            return xi, cols
+        tids = self._row_tenant[rows[xi]]
+        keep = select_fair(tids, caps)
+        if pending is not None:
+            kept_counts = np.bincount(tids[keep], minlength=T)
+            for tid in capped:
+                if kept_counts[tid]:
+                    pending[tid] = pending.get(tid, 0) + \
+                        int(kept_counts[tid])
+        if keep.all():
+            return xi, cols
+        self._tenant_q.append(
+            ("fair", np.bincount(tids[~keep], minlength=T)))
+        return xi[keep], cols[keep]
+
+    def tenant_snapshot(self) -> dict:
+        """{tenant: {field: number}} — the leased "tenant" component
+        snapshot /v1/metrics renders as cronsun_tenant_*{tenant=}."""
+        out: Dict[str, dict] = {}
+        for name, c in self._tenant_counters.items():
+            out[name or "default"] = dict(c)
+        for name, q in self._tenants.items():
+            ent = out.setdefault(name or "default", {})
+            ent["rate_quota"] = q.rate
+            ent["max_running_quota"] = q.max_running
+            tid = self._tenant_ids.get(name, 0)
+            ent["running_excl"] = self._tenant_excl.get(tid, 0)
+        return out
+
+    def _rebuild_tenant_excl(self, order_tids: Optional[dict] = None):
+        """Ground-truth rebuild of the per-tenant exclusive-work
+        counters after a mirror install: proc keys derive from the job
+        registry; order keys take the listing's parsed breakdown
+        (``order_tids``, built by _build_mirrors from the bundle
+        values — covering foreign leaders' orders too), falling back
+        to the frozen at-entry breakdown (checkpoint restore)."""
+        acct: Dict[str, dict] = {}
+        excl: Dict[int, int] = {}
+        old = self._acct_tid
+        for key, (_n, _c, ex) in self._procs.items():
+            d = old.get(key)
+            if d is None and ex and self._tenants:
+                t = self._parse_proc(key)
+                job = self.jobs.get((t[1], t[2])) if t else None
+                tid = self._tenant_ids.get(job.tenant, 0) \
+                    if job and job.tenant else 0
+                d = {tid: 1} if tid else None
+            if d:
+                acct[key] = d
+                for tid, n in d.items():
+                    excl[tid] = excl.get(tid, 0) + n
+        for key in self._orders:
+            d = (order_tids or {}).get(key) or old.get(key)
+            if d:
+                acct[key] = d
+                for tid, n in d.items():
+                    excl[tid] = excl.get(tid, 0) + n
+        self._acct_tid = acct
+        self._tenant_excl = excl
 
     # ---- workflow DAG plane ---------------------------------------------
 
@@ -964,6 +1247,9 @@ class SchedulerService:
             # overwritten when the row is reacquired (_apply_job writes
             # fields first, flags last).
             self._rd_flags[row] = 0
+            if self._row_tenant[row]:
+                self._row_tenant[row] = 0
+                self._tenant_row_updates[row] = 0
             self._table_updates[row] = dict(_INACTIVE_ROW)
             self.builder.del_job(row)
             self._meta_updates.pop(row, None)
@@ -1087,7 +1373,8 @@ class SchedulerService:
         # buffer is live — get RECORDED before application, in exactly
         # the order they were applied (the fold replays the same order)
         rec = self._delta_buf if self._delta_valid else None
-        for sid, w in (("groups", self._w_groups),
+        for sid, w in (("tenants", self._w_tenants),
+                       ("groups", self._w_groups),
                        ("nodes", self._w_nodes),
                        ("jobs", self._w_jobs),
                        ("deps", self._w_deps),
@@ -1152,6 +1439,19 @@ class SchedulerService:
                     self._drop_job(group, job_id)
             else:
                 self._apply_job(key, value)
+        elif sid == "tenants":
+            # tenant quota records only; the web tier's per-tenant job
+            # index markers share the prefix and are not ours to mirror
+            rest = key[len(self.ks.tenant):]
+            if not rest.endswith("/quota"):
+                return
+            name = rest[:-len("/quota")]
+            if not name or "/" in name:
+                return
+            if typ == DELETE:
+                self._drop_tenant_quota(name)
+            else:
+                self._apply_tenant_quota(name, value)
         elif sid == "deps":
             # workflow DAG completion events: fold the round's scheduled
             # epoch into the job's (success, fail) pair and queue the
@@ -1259,6 +1559,12 @@ class SchedulerService:
         self._load_sum[node_id] = self._load_sum.get(node_id, 0.0) + cost
         if excl:
             self._excl_cnt[node_id] = self._excl_cnt.get(node_id, 0) + 1
+            if self._tenants and job and job.tenant:
+                tid = self._tenant_ids.get(job.tenant, 0)
+                if tid:
+                    self._acct_tid[key] = {tid: 1}
+                    self._tenant_excl[tid] = \
+                        self._tenant_excl.get(tid, 0) + 1
         if mirror is self._procs and (group, job_id) in self._dep_gated:
             jk = (group, job_id)
             self._dep_inflight[jk] = self._dep_inflight.get(jk, 0) + 1
@@ -1284,9 +1590,18 @@ class SchedulerService:
             self._delta_buf.append(
                 ("ordmirror", PUT, key, (node_id, list(jobs))))
         cost = 0.0
+        tids: Optional[dict] = {} if self._tenants else None
         for group, job_id in jobs:
             job = self.jobs.get((group, job_id))
             cost += job.avg_time if job and job.avg_time > 0 else 1.0
+            if tids is not None and job and job.tenant:
+                t = self._tenant_ids.get(job.tenant, 0)
+                if t:
+                    tids[t] = tids.get(t, 0) + 1
+        if tids:
+            self._acct_tid[key] = tids
+            for t, n in tids.items():
+                self._tenant_excl[t] = self._tenant_excl.get(t, 0) + n
         slots = len(jobs)
         self._orders[key] = (node_id, cost, slots)
         self._load_sum[node_id] = self._load_sum.get(node_id, 0.0) + cost
@@ -1299,6 +1614,14 @@ class SchedulerService:
         ent = mirror.pop(key, None)
         if ent is None:
             return
+        tids = self._acct_tid.pop(key, None)
+        if tids:
+            for t, n in tids.items():
+                left = self._tenant_excl.get(t, 0) - n
+                if left > 0:
+                    self._tenant_excl[t] = left
+                else:
+                    self._tenant_excl.pop(t, None)
         if mirror is self._procs and self._dep_gated:
             t = self._parse_proc(key)
             if t is not None and (t[1], t[2]) in self._dep_gated:
@@ -1342,6 +1665,11 @@ class SchedulerService:
         orders: Dict[str, Tuple[str, float, bool]] = {}
         excl: Dict[str, int] = {}
         load: Dict[str, float] = {}
+        # per-key tenant breakdown of exclusive order slots, parsed
+        # from the bundle values while we have them (the mirrors only
+        # keep counts) — feeds _rebuild_tenant_excl
+        order_tids: Dict[str, dict] = {}
+        want_tids = bool(self._tenants)
 
         def add(mirror, key, node_id, group, job_id):
             job = self.jobs.get((group, job_id))
@@ -1373,6 +1701,7 @@ class SchedulerService:
                 node_id = rest[0]
                 cost = 0.0
                 slots = 0
+                tids: Dict[int, int] = {}
                 for e in entries:
                     if not isinstance(e, str) or "/" not in e:
                         continue
@@ -1381,6 +1710,12 @@ class SchedulerService:
                     cost += job.avg_time if job and job.avg_time > 0 \
                         else 1.0
                     slots += 1
+                    if want_tids and job and job.tenant:
+                        t = self._tenant_ids.get(job.tenant, 0)
+                        if t:
+                            tids[t] = tids.get(t, 0) + 1
+                if tids:
+                    order_tids[kv.key] = tids
                 orders[kv.key] = (node_id, cost, slots)
                 load[node_id] = load.get(node_id, 0.0) + cost
                 if slots:
@@ -1391,9 +1726,12 @@ class SchedulerService:
                 add(orders, kv.key, *t)
         alone = {kv.key[len(self._alone_pfx):]
                  for kv in _list_prefix(store, self._alone_pfx)}
-        return procs, orders, alone, excl, load
+        return procs, orders, alone, excl, load, order_tids
 
     def _install_mirrors(self, built):
+        order_tids = None
+        if len(built) == 6:
+            *built, order_tids = built
         self._procs, self._orders, self._alone_live, \
             self._excl_cnt, self._load_sum = built
         # ground-truth rebuild of the dep in-flight counters from the
@@ -1407,6 +1745,8 @@ class SchedulerService:
                     jk = (t[1], t[2])
                     infl[jk] = infl.get(jk, 0) + 1
         self._dep_inflight = infl
+        if self._tenants or self._acct_tid or order_tids:
+            self._rebuild_tenant_excl(order_tids)
         self._mirror_resync_at = self.clock() + self.mirror_resync_s
 
     def _mirror_antientropy(self):
@@ -1704,6 +2044,19 @@ class SchedulerService:
             # the mutable dep vectors — last_fire especially: a restore
             # without it would re-fire every chain's last round
             dep.update(self.planner.dep_state())
+        # tenancy: the quota registry, the id space, the row map and
+        # the per-tenant counters; plus the DYNAMIC token columns — a
+        # restore without them would hand every bucket a free burst
+        tenant = dict(
+            T=self._tenant_T,
+            quotas={n: q.to_dict() for n, q in self._tenants.items()},
+            ids=dict(self._tenant_ids), names=list(self._tid_name),
+            row_tenant=np.array(self._row_tenant),
+            counters={n: dict(c)
+                      for n, c in self._tenant_counters.items()},
+            acct_tid={k: dict(v) for k, v in self._acct_tid.items()},
+            state=(self.planner.tenant_state()
+                   if self._tenant_supported else {}))
         return dict(
             rev=rev, saved_at=time.time(), node_id=self.node_id,
             prefix=self.ks.prefix, J=self.planner.J, N=self.planner.N,
@@ -1718,7 +2071,7 @@ class SchedulerService:
             elig=np.asarray(fetch(self.planner.elig)),
             exclusive=np.asarray(fetch(self.planner.exclusive)),
             cost=np.asarray(fetch(self.planner.cost)),
-            dep=dep,
+            dep=dep, tenant=tenant,
             # jobs ride columnar (pack_jobs); the builder's per-row rule
             # inputs and reverse group index are DERIVED from them at
             # restore (set_job aliases the rules' own lists, so the
@@ -1811,6 +2164,17 @@ class SchedulerService:
                 raise CheckpointError(
                     f"planner shape J={st.get('J')}/N={st.get('N')} != "
                     f"J={self.planner.J}/N={self.planner.N}")
+            # tenant id space must match like J/N: restored tids index
+            # the [T] bucket columns and the fair-share cap arrays (an
+            # unstamped/absent blob predates the stamp — its ids were
+            # bounded by the old default and install tolerates it)
+            ten_blob = st.get("tenant")
+            if isinstance(ten_blob, dict):
+                saved_t = int(ten_blob.get("T", 0) or 0)
+                if saved_t and saved_t != self._tenant_T:
+                    raise CheckpointError(
+                        f"tenant id space T={saved_t} != planner "
+                        f"tenant_capacity {self._tenant_T}")
             # mesh topology must match exactly (absent field == plain
             # planner, so pre-mesh checkpoints stay restorable on plain
             # planners and nothing else)
@@ -1839,8 +2203,16 @@ class SchedulerService:
                         f"scalar checkpoint revision against a "
                         f"{nsh}-shard store")
             try:
+                tbl = dict(st["table"])
+                # pre-tenancy checkpoints predate the tenant column:
+                # default it (all rows on the unlimited default tenant)
+                # instead of refusing — the restore contract keeps old
+                # saves loading across the upgrade
+                if "tenant" not in tbl and "sec_lo" in tbl:
+                    tbl["tenant"] = np.zeros(
+                        len(tbl["sec_lo"]), np.int32)
                 table = ScheduleTable(**{k: jnp.asarray(v)
-                                         for k, v in st["table"].items()})
+                                         for k, v in tbl.items()})
                 elig = jnp.asarray(st["elig"])
                 excl = jnp.asarray(st["exclusive"])
                 cost = jnp.asarray(st["cost"])
@@ -2001,6 +2373,46 @@ class SchedulerService:
                         self._dep_block_updates[row] = blocked
         if self._dep_rows and self._dep_supported:
             self.planner.set_dep_enabled(True)
+        # tenancy: registry + id space + row map + counters land from
+        # the checkpoint; quotas re-scatter into the planner's bucket
+        # columns, then the DYNAMIC token state overrides the full-
+        # bucket reset set_tenant_quota performs.  Absent field = a
+        # pre-tenancy checkpoint (empty registry) — still restorable.
+        ten = st.get("tenant")
+        if ten:
+            self._tenants = {}
+            for n, qd in ten["quotas"].items():
+                try:
+                    q = TenantQuota(**qd)
+                    q.validate()
+                    self._tenants[n] = q
+                except Exception:  # noqa: BLE001 — skip a bad record
+                    pass
+            self._tenant_ids = dict(ten["ids"])
+            self._tid_name = list(ten["names"])
+            self._row_tenant = np.asarray(ten["row_tenant"], np.int32)
+            self._tenant_counters = {n: dict(c)
+                                     for n, c in ten["counters"].items()}
+            if self._tenant_supported:
+                self.planner.set_row_tenants(
+                    np.arange(self.planner.J, dtype=np.int32),
+                    self._row_tenant)
+                any_limited = False
+                for n, q in self._tenants.items():
+                    tid = self._tenant_ids.get(n, 0)
+                    if tid:
+                        self.planner.set_tenant_quota(
+                            tid, q.rate if q.limited else 0.0, q.burst,
+                            q.weight)
+                        any_limited |= q.limited
+                tok = (ten.get("state") or {}).get("tokens")
+                if tok is not None:
+                    self.planner.set_tenant_state(tok)
+                if any_limited or self._tenants:
+                    self.planner.set_tenants_enabled(True)
+            self._acct_tid = {k: dict(v) for k, v in
+                              (ten.get("acct_tid") or {}).items()}
+            self._rebuild_tenant_excl()
         # device state: table + eligibility + job meta land whole; node
         # capacities as at a cold load's end (reconcile_capacity
         # rewrites load/rem_cap from the mirrors every leading step).
@@ -2180,6 +2592,17 @@ class SchedulerService:
         return tuple(out)
 
     def _flush_device(self):
+        if self._tenant_row_updates:
+            if self._tenant_supported:
+                rows = np.fromiter(self._tenant_row_updates, np.int32,
+                                   len(self._tenant_row_updates))
+                tids = np.array([self._tenant_row_updates[int(r)]
+                                 for r in rows], np.int32)
+                # host-only snapshot update (the device tenant column
+                # rides the normal table scatters below); marks the
+                # admission permutation dirty for the next dispatch
+                self.planner.set_row_tenants(rows, tids)
+            self._tenant_row_updates.clear()
         if self._table_updates:
             rows = np.array(sorted(self._table_updates), dtype=np.int32)
             vals = [self._table_updates[int(r)] for r in rows]
@@ -2276,12 +2699,19 @@ class SchedulerService:
         running_excl = self._excl_cnt
         running_load = self._load_sum
         cols, caps = [], []
+        avail = 0
         loads = np.zeros(self.planner.N, np.float32)
         for node_id, col in self.universe.index.items():
             cap = self.node_caps.get(node_id, self.default_node_cap)
             cols.append(col)
-            caps.append(max(0, cap - running_excl.get(node_id, 0)))
+            c = max(0, cap - running_excl.get(node_id, 0))
+            caps.append(c)
+            avail += c
             loads[col] = running_load.get(node_id, 0.0)
+        # the fleet's remaining exclusive-slot budget — the fair-share
+        # build clamps tenants to weighted max-min shares of this when
+        # a second's aggregate demand exceeds it
+        self._agg_excl_avail = avail if cols else float("inf")
         if cols:
             pc, pk = self._pad_pow2(np.asarray(cols, np.int32),
                                     np.asarray(caps, np.int64))
@@ -2344,6 +2774,7 @@ class SchedulerService:
         # device dispatch stays on this thread)
         n_done = self._drain_build_acct()
         self._drain_replan_reqs()
+        self._drain_tenant_q()
         self._maybe_antientropy_bg()
         self._maybe_checkpoint()
         led_before = self.is_leader
@@ -2361,6 +2792,8 @@ class SchedulerService:
             self.metrics.maybe_publish()
             if self._mesh_metrics is not None:
                 self._mesh_metrics.maybe_publish()
+            if self._tenants:
+                self._tenant_metrics.maybe_publish()
             return 0
         if self.stats["steps_total"]:
             # escalation sizes warm while leading — but only after the
@@ -2439,9 +2872,12 @@ class SchedulerService:
         for k, v in spans.items():
             self._span_ring(k).add(v)
         self.stats["steps_total"] += 1
+        self._drain_tenant_q()
         self.metrics.maybe_publish()
         if self._mesh_metrics is not None:
             self._mesh_metrics.maybe_publish()
+        if self._tenants:
+            self._tenant_metrics.maybe_publish()
         return n_dispatch
 
     def _step_serial(self, start: int, window: int, spans: dict,
@@ -2468,6 +2904,7 @@ class SchedulerService:
         lease = self.store.grant(self.dispatch_ttl)
         seconds: List[Tuple[int, list]] = []
         excl_acct: List[Tuple[str, str, list]] = []
+        wpend: Dict[int, int] = {}    # this window's admitted-excl
         n_dispatch = 0
         # matured ASYNC overflow replans from the previous step publish
         # first (they are the oldest epochs); their full fire sets were
@@ -2511,7 +2948,8 @@ class SchedulerService:
                                "t=%d — dropped", plan.overflow,
                                plan.epoch_s)
             n_dispatch += self._build_plan_orders(plan, seconds,
-                                                  excl_acct)
+                                                  excl_acct,
+                                                  pending_excl=wpend)
         t = span("build", t)
         # hand the window to the async publisher: oldest second first,
         # HWM advanced after each second lands (the publisher owns the
@@ -2632,6 +3070,7 @@ class SchedulerService:
             acct["gather_ms"] = (time.perf_counter() - t) * 1e3
             t = time.perf_counter()
             seconds: List[Tuple[int, list]] = []
+            wpend: Dict[int, int] = {}
             for plan, may_replan in build_list:
                 if plan.overflow:
                     if may_replan:
@@ -2647,8 +3086,8 @@ class SchedulerService:
                         log.errorf("%d fires over the escalated bucket "
                                    "at t=%d — dropped", plan.overflow,
                                    plan.epoch_s)
-                acct["fires"] += self._build_plan_orders(plan, seconds,
-                                                         acct["excl"])
+                acct["fires"] += self._build_plan_orders(
+                    plan, seconds, acct["excl"], pending_excl=wpend)
             acct["build_ms"] = (time.perf_counter() - t) * 1e3
             t = time.perf_counter()
             # publisher backpressure lands HERE, which fills this
@@ -2736,7 +3175,8 @@ class SchedulerService:
         self._builder.stats["stall_ms_total"] = 0.0
 
     def _build_plan_orders(self, plan, seconds: List[Tuple[int, list]],
-                           excl_acct: List[Tuple[str, str, list]]
+                           excl_acct: List[Tuple[str, str, list]],
+                           pending_excl: Optional[Dict[int, int]] = None
                            ) -> int:
         """Build one TickPlan's dispatch orders into ``seconds`` (and
         the exclusive-accounting list) — the leader's share of the
@@ -2766,6 +3206,12 @@ class SchedulerService:
         n_fires = 0
         n_bundles = 0
         n_excl = 0
+        if plan.tenant_throttled is not None and \
+                (plan.tenant_throttled.any() or plan.tenant_shed.any()):
+            # device-side admission refusals: hand the per-tenant counts
+            # back to the step thread (this may run on the build worker)
+            self._tenant_q.append(("adm", plan.tenant_throttled,
+                                   plan.tenant_shed))
         if rows.size:
             flags = self._rd_flags[rows]
             live = (flags & 1) != 0
@@ -2806,6 +3252,11 @@ class SchedulerService:
                 ok &= self._col_live[np.where(ok, cols, 0)]
                 xi = xi[ok]
                 cols = cols[ok]
+            if xi.size and self._tenants:
+                # max_running clamp (vectorized — see _fair_filter;
+                # the capacity fair share runs on device)
+                xi, cols = self._fair_filter(rows, xi, cols,
+                                             pending=pending_excl)
             if xi.size:
                 order = np.argsort(cols, kind="stable")
                 sx = xi[order]
@@ -2855,12 +3306,31 @@ class SchedulerService:
 
     def _build_plan_orders_ref(self, plan,
                                seconds: List[Tuple[int, list]],
-                               excl_acct: List[Tuple[str, str, list]]
-                               ) -> int:
+                               excl_acct: List[Tuple[str, str, list]],
+                               pending_excl: Optional[Dict[int, int]]
+                               = None) -> int:
         """The per-fire Python loop the vectorized build replaced —
         kept as the differential-test REFERENCE (byte-identical output
         is asserted on randomized plans) and as the plain-language spec
-        of the build semantics."""
+        of the build semantics, INCLUDING the tenancy plane's
+        max_running clamp: a tenant's placed exclusive fires stop once
+        its exec-concurrency headroom (max_running − outstanding −
+        this window's prior admissions) is used up — first fires in
+        plan order win, exactly _fair_filter's select_fair."""
+        mr_caps = None
+        if self._tenants:
+            for tname, quota in list(self._tenants.items()):
+                if not quota.max_running:
+                    continue
+                tid = self._tenant_ids.get(tname, 0)
+                if tid:
+                    if mr_caps is None:
+                        mr_caps = {}
+                    mr_caps[tid] = max(
+                        0, quota.max_running
+                        - self._tenant_excl.get(tid, 0)
+                        - (pending_excl or {}).get(tid, 0))
+        mr_taken: Dict[int, int] = {}
         alone_live = self._alone_live
         row_disp = self._row_dispatch
         col_node = self._col_node
@@ -2884,6 +3354,14 @@ class SchedulerService:
                 if 0 <= node_col < n_cols:
                     node = col_node[node_col]
                     if node:
+                        if mr_caps is not None:
+                            tid = int(self._row_tenant[row])
+                            cap = mr_caps.get(tid)
+                            if cap is not None:
+                                if mr_taken.get(tid, 0) >= cap:
+                                    continue    # max_running shed
+                                mr_taken[tid] = \
+                                    mr_taken.get(tid, 0) + 1
                         bundles.setdefault(node, []).append(bentry)
                         bundle_jobs.setdefault(node, []).append(
                             (group, job_id))
@@ -2901,6 +3379,9 @@ class SchedulerService:
             self.max_second_node_keys = len(bundles)
         if n_excl > self.max_second_excl_fires:
             self.max_second_excl_fires = n_excl
+        if pending_excl is not None:
+            for tid, n in mr_taken.items():
+                pending_excl[tid] = pending_excl.get(tid, 0) + n
         seconds.append((plan.epoch_s, orders))
         return n_fires
 
@@ -2926,12 +3407,13 @@ class SchedulerService:
             lease = self.store.grant(self.dispatch_ttl)
             seconds: List[Tuple[int, list]] = []
             excl_acct: List[Tuple[str, str, list]] = []
+            wpend: Dict[int, int] = {}
             n = 0
             for _ep, handle, _fires in pending:
                 n += self._build_plan_orders(
                     self.planner.gather_window(
                         self._resolve_handle(handle))[0], seconds,
-                    excl_acct)
+                    excl_acct, pending_excl=wpend)
             self.publisher.submit(seconds, lease, 0)
             for key, node, jobs in excl_acct:
                 self._acct_add_order(key, node, jobs)
@@ -3081,6 +3563,17 @@ class SchedulerService:
             "dep_jobs": len(self._dep_jobs),
             "dep_blocked_jobs": len(self._dep_blocked),
             "dep_events_mirrored": len(self._dep_latest),
+            # multi-tenant admission health (per-tenant breakdown rides
+            # the "tenant" component snapshot -> cronsun_tenant_*)
+            "tenants": len(self._tenants),
+            "excl_slots_available": (
+                -1 if self._agg_excl_avail == float("inf")
+                else int(min(self._agg_excl_avail, 1 << 60))),
+            "tenant_throttled_fires_total": sum(
+                c["throttled_fires"]
+                for c in self._tenant_counters.values()),
+            "tenant_shed_fires_total": sum(
+                c["shed_fires"] for c in self._tenant_counters.values()),
         }
 
     def _advance_hwm(self, value: int):
@@ -3168,5 +3661,6 @@ class SchedulerService:
             except Exception:  # noqa: BLE001 — already dead
                 pass
         self.metrics.revoke()
+        self._tenant_metrics.revoke()
         if self._mesh_metrics is not None:
             self._mesh_metrics.revoke()

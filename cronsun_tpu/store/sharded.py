@@ -338,6 +338,12 @@ class ShardedStore:
                                      fail_threshold=breaker_fails,
                                      cooldown=breaker_cooldown,
                                      label="store shard")
+            # a shard browning out should PAGE, not just count: an
+            # OPEN transition writes a (rate-limited) notice key the
+            # NoticerHost delivers — routed through this same client,
+            # so it lands on a healthy shard immediately or on the
+            # broken one as it heals (core/breaker.py arm_notices)
+            self._bank.arm_notices(self, prefix)
         self._breakers = self._bank.breakers
         self.shards = self._bank.guards(self._raw,
                                         healthy_errors=_HEALTHY_ERRORS)

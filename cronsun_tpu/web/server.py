@@ -29,8 +29,8 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import log
 from ..core import (
-    Account, Group, Job, Keyspace, ROLE_ADMIN, ValidationError, next_id,
-    validate_dag)
+    Account, Group, Job, Keyspace, ROLE_ADMIN, TenantQuota,
+    ValidationError, next_id, validate_dag)
 from ..core.models import hash_password
 from ..logsink import JobLogStore
 from ..store.memstore import MemStore
@@ -92,6 +92,16 @@ class ApiServer:
             cache_enabled = cache_default()
         self.cache = ResponseCache() if cache_enabled else None
         self._bootstrap_admin()
+        # result-store shard breakers page through the noticer this
+        # process hosts: a browning-out logd shard writes a notice key
+        # into the coordination store (store-shard breakers arm
+        # themselves — they can write their own plane)
+        arm = getattr(sink, "arm_breaker_notices", None)
+        if arm is not None:
+            try:
+                arm(self.store, self.ks.prefix)
+            except Exception as e:  # noqa: BLE001 — paging is optional
+                log.warnf("breaker notice arming failed: %s", e)
         self.routes = self._build_routes()
 
     # ---- bootstrap (web/authentication.go:20-52) -------------------------
@@ -151,6 +161,11 @@ class ApiServer:
         route("GET", r"/v1/node/group/(?P<id>[^/]+)", self.group_get)
         route("PUT", r"/v1/node/group", self.group_update)
         route("DELETE", r"/v1/node/group/(?P<id>[^/]+)", self.group_delete)
+        route("GET", r"/v1/tenants", self.tenant_list)
+        route("PUT", r"/v1/tenant", self.tenant_set, admin=True)
+        route("GET", r"/v1/tenant/(?P<id>[^/]+)", self.tenant_get)
+        route("DELETE", r"/v1/tenant/(?P<id>[^/]+)", self.tenant_delete,
+              admin=True)
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
         route("POST", r"/v1/checkpoint", self.checkpoint, admin=True)
@@ -240,7 +255,8 @@ class ApiServer:
         acc = Account(email=email, salt=salt,
                       password=hash_password(password, salt),
                       role=int(body.get("role", 2)),
-                      status=int(body.get("status", 1)))
+                      status=int(body.get("status", 1)),
+                      tenant=str(body.get("tenant", "") or "").strip())
         self.sink.upsert_account(acc.email, acc.to_json())
         return {}
 
@@ -257,6 +273,8 @@ class ApiServer:
             acc.role = int(body["role"])
         if "status" in body:
             acc.status = int(body["status"])
+        if "tenant" in body:
+            acc.tenant = str(body["tenant"] or "").strip()
         if body.get("password"):
             acc.salt = next_id()
             acc.password = hash_password(body["password"], acc.salt)
@@ -293,6 +311,53 @@ class ApiServer:
                 groups.add(rest.split("/", 1)[0])
         return sorted(groups)
 
+    def _tenant_quota(self, tenant: str) -> Optional[TenantQuota]:
+        if not tenant:
+            return None
+        kv = self.store.get(self.ks.tenant_quota_key(tenant))
+        if kv is None:
+            return None
+        try:
+            q = TenantQuota.from_json(kv.value)
+            q.tenant = tenant
+            q.validate()
+            return q
+        except (json.JSONDecodeError, TypeError, ValueError,
+                ValidationError):
+            return None
+
+    def _account_tenant(self, ctx) -> str:
+        """The session account's pinned tenant ("" = unpinned).  Admins
+        are never pinned; with auth off every request is an implicit
+        admin (reference Web.Auth.Enabled semantics)."""
+        sess = ctx.session
+        if not self.auth_enabled or sess is None \
+                or sess.role == ROLE_ADMIN:
+            return ""
+        doc = self.sink.get_account(sess.email)
+        if doc is None:
+            return ""
+        return Account.from_json(doc).tenant or ""
+
+    def _guard_pinned(self, ctx, tenant: str):
+        """Refuse a MUTATION of a job owned by another tenant (or the
+        default tenant) from a tenant-pinned account — pinning must
+        cover pause/delete/run-now/overwrite, not just the tenant
+        field on create."""
+        acc = self._account_tenant(ctx)
+        if acc and (tenant or "") != acc:
+            raise HttpError(
+                403, f"account is pinned to tenant {acc!r}; cannot "
+                     f"modify jobs of tenant "
+                     f"{(tenant or 'default')!r}")
+
+    @staticmethod
+    def _doc_tenant(value: str) -> str:
+        try:
+            return json.loads(value).get("tenant") or ""
+        except (json.JSONDecodeError, TypeError, AttributeError):
+            return ""
+
     def job_update(self, ctx):
         body = ctx.json()
         old_group = (body.pop("oldGroup", "") or "").strip()
@@ -302,28 +367,106 @@ class ApiServer:
             job.security_valid(self.security)
         except ValidationError as e:
             raise HttpError(400, str(e))
-        if job.deps is not None:
-            # DAG validation is group-scoped: every upstream must exist
-            # in the group and the new edges must not close a cycle —
-            # refused HERE, loudly, before the document lands (the
-            # scheduler would otherwise hold the job forever)
-            self._validate_job_dag(job)
-        if old_group and old_group != job.group:
-            # a group move deletes the old-group document: same
-            # dependents guard as job_delete, or the move silently
-            # breaks downstream chains the delete path refuses to
-            dep_map, _ids = self._group_dep_map(old_group)
-            dependents = sorted(j for j, ups in dep_map.items()
-                                if job.id in ups and j != job.id)
-            if dependents:
+        # tenancy: a tenant-pinned account's jobs land in ITS tenant —
+        # a mismatching explicit tenant is refused, not silently moved
+        acc_tenant = self._account_tenant(ctx)
+        if acc_tenant:
+            if job.tenant and job.tenant != acc_tenant:
                 raise HttpError(
-                    409, f"job {job.id!r} is an upstream of "
-                         f"{', '.join(dependents)} in group "
-                         f"{old_group!r} — moving it would break their "
-                         "chains; update or delete the dependents "
-                         "first")
-            self.store.delete(self.ks.job_key(old_group, job.id))
-        self.store.put(self.ks.job_key(job.group, job.id), job.to_json())
+                    403, f"account is pinned to tenant {acc_tenant!r}; "
+                         f"cannot write jobs for {job.tenant!r}")
+            job.tenant = acc_tenant
+        # the document this PUT replaces (same id; possibly the old
+        # group on a move): its (tenant, group) decides whether the
+        # max_jobs gate sees a NEW job and which index marker to retire
+        src_group = old_group if (old_group and old_group != job.group) \
+            else job.group
+        prev_kv = self.store.get(self.ks.job_key(src_group, job.id))
+        prev = None
+        if prev_kv is not None:
+            prev = (self._doc_tenant(prev_kv.value), src_group)
+            # overwriting another tenant's (or an untenanted) existing
+            # job from a pinned account is a cross-tenant move — refuse
+            self._guard_pinned(ctx, prev[0])
+        dest = None
+        if src_group != job.group:
+            # a group move can ALSO overwrite a pre-existing job at
+            # the DESTINATION id: guard it and retire its marker too,
+            # or the clobbered tenant's index counts the ghost forever
+            dest_kv = self.store.get(self.ks.job_key(job.group, job.id))
+            if dest_kv is not None:
+                dest = (self._doc_tenant(dest_kv.value), job.group)
+                self._guard_pinned(ctx, dest[0])
+        reserved = None
+        if job.tenant:
+            quota = self._tenant_quota(job.tenant)
+            # a PUT that replaces a same-tenant document — at the
+            # source OR the move destination — is not a new job; the
+            # destination case also keeps the reservation key from
+            # ALIASING the live marker (a rollback would delete it)
+            replaces = (prev is not None and prev[0] == job.tenant) or \
+                (dest is not None and dest[0] == job.tenant)
+            if quota is not None and quota.max_jobs and not replaces:
+                # reserve the index marker FIRST, then recount: two
+                # racing creates both see each other's marker and the
+                # recount refuses past the quota (worst case both
+                # roll back one slot under — refusal is the safe
+                # direction; a plain count-then-put would admit both)
+                reserved = self.ks.tenant_job_key(job.tenant,
+                                                  job.group, job.id)
+                self.store.put(reserved, "1")
+                n = self.store.count_prefix(
+                    self.ks.tenant_jobs(job.tenant))
+                if n > quota.max_jobs:
+                    self.store.delete(reserved)
+                    raise HttpError(
+                        429, f"tenant {job.tenant!r} is at its "
+                             f"max_jobs quota "
+                             f"({n - 1}/{quota.max_jobs}); delete "
+                             "jobs or raise the quota")
+        try:
+            if job.deps is not None:
+                # DAG validation is group-scoped: every upstream must
+                # exist in the group and the new edges must not close
+                # a cycle — refused HERE, loudly, before the document
+                # lands (the scheduler would otherwise hold the job
+                # forever)
+                self._validate_job_dag(job)
+            if old_group and old_group != job.group:
+                # a group move deletes the old-group document: same
+                # dependents guard as job_delete, or the move silently
+                # breaks downstream chains the delete path refuses to
+                dep_map, _ids = self._group_dep_map(old_group)
+                dependents = sorted(j for j, ups in dep_map.items()
+                                    if job.id in ups and j != job.id)
+                if dependents:
+                    raise HttpError(
+                        409, f"job {job.id!r} is an upstream of "
+                             f"{', '.join(dependents)} in group "
+                             f"{old_group!r} — moving it would break "
+                             "their chains; update or delete the "
+                             "dependents first")
+                self.store.delete(self.ks.job_key(old_group, job.id))
+            self.store.put(self.ks.job_key(job.group, job.id),
+                           job.to_json())
+        except BaseException:
+            # a refusal after the reservation must not leak the
+            # marker (it would count a job that never landed)
+            if reserved is not None:
+                self.store.delete(reserved)
+            raise
+        # per-tenant job index: retire the replaced document's marker
+        # when its (tenant, group) moved, then assert the new one (the
+        # markers make the max_jobs gate one count_prefix, not a scan)
+        for old in (prev, dest):
+            if old is not None and old[0] and \
+                    (old[0] != job.tenant or old[1] != job.group):
+                self.store.delete(
+                    self.ks.tenant_job_key(old[0], old[1], job.id))
+        if job.tenant:
+            self.store.put(
+                self.ks.tenant_job_key(job.tenant, job.group, job.id),
+                "1")
         return {"id": job.id, "group": job.group}
 
     def _group_dep_map(self, group: str):
@@ -378,13 +521,22 @@ class ApiServer:
                      f"{', '.join(dependents)} — their chains would "
                      "hold forever; delete them first or pass "
                      "?force=true")
+        kv = self.store.get(self.ks.job_key(group, job_id))
+        if kv is None:
+            raise HttpError(404, "no such job")
+        tenant = self._doc_tenant(kv.value)
+        self._guard_pinned(ctx, tenant)
         if not self.store.delete(self.ks.job_key(group, job_id)):
             raise HttpError(404, "no such job")
+        if tenant:
+            self.store.delete(
+                self.ks.tenant_job_key(tenant, group, job_id))
         return {}
 
     def job_change_status(self, ctx):
         """Pause/resume via CAS (reference web/job.go:54-79)."""
         job = self._load_job(ctx)
+        self._guard_pinned(ctx, job.tenant)
         body = ctx.json()
         job.pause = bool(body.get("pause"))
         if not self.store.put_if_mod_rev(
@@ -409,8 +561,10 @@ class ApiServer:
     def job_execute(self, ctx):
         """Run-now (reference web/job.go:259-276 -> once.go:14-17)."""
         group, job_id = ctx.path_args["group"], ctx.path_args["id"]
-        if self.store.get(self.ks.job_key(group, job_id)) is None:
+        kv = self.store.get(self.ks.job_key(group, job_id))
+        if kv is None:
             raise HttpError(404, "no such job")
+        self._guard_pinned(ctx, self._doc_tenant(kv.value))
         node = ctx.q("node")
         self.store.put(self.ks.once_key(group, job_id), node)
         return {}
@@ -885,6 +1039,90 @@ class ApiServer:
                 self.store.put_if_mod_rev(kv.key, job.to_json(), kv.mod_rev)
         return {}
 
+    # ---- handlers: tenants ----------------------------------------------
+
+    def _tenant_live_stats(self, tenant: str) -> dict:
+        """Aggregate the schedulers' leased per-tenant snapshots for
+        one tenant (counters sum across instances; gauges take the
+        max — a standby's zeros must not mask the leader's numbers)."""
+        agg: dict = {}
+        for kv in self._degraded_prefix(self.ks.metrics + "tenant/"):
+            try:
+                snap = json.loads(kv.value)
+            except json.JSONDecodeError:
+                continue
+            ent = snap.get(tenant)
+            if not isinstance(ent, dict):
+                continue
+            for k, v in ent.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if k.endswith(("_fires", "_total")):
+                    agg[k] = agg.get(k, 0) + v
+                else:
+                    agg[k] = max(agg.get(k, 0), v)
+        return agg
+
+    def tenant_list(self, ctx):
+        # ONE prefix listing serves quotas, names AND the per-tenant
+        # job counts (the /job/ index markers are right there — a
+        # count_prefix per tenant would be N+1 fan-out RPCs)
+        quotas: dict = {}
+        counts: dict = {}
+        pfx = self.ks.tenant
+        for kv in self._degraded_prefix(pfx):
+            rest = kv.key[len(pfx):]
+            name, _, tail = rest.partition("/")
+            if not name:
+                continue
+            if tail == "quota":
+                try:
+                    q = TenantQuota.from_json(kv.value)
+                    q.tenant = name
+                    quotas[name] = q
+                except (json.JSONDecodeError, TypeError, ValueError):
+                    continue
+            elif tail.startswith("job/"):
+                counts[name] = counts.get(name, 0) + 1
+        out = []
+        for name in sorted(set(quotas) | set(counts)):
+            q = quotas.get(name)
+            out.append({"tenant": name, "jobs": counts.get(name, 0),
+                        "quota": q.to_dict() if q else None})
+        return out
+
+    def tenant_get(self, ctx):
+        name = ctx.path_args["id"]
+        q = self._tenant_quota(name)    # one get, not a prefix scan
+        jobs = self._degraded_count(self.ks.tenant_jobs(name))
+        if q is None and not jobs:
+            raise HttpError(404, "no such tenant")
+        return {"tenant": name, "jobs": jobs,
+                "quota": q.to_dict() if q else None,
+                "live": self._tenant_live_stats(name)}
+
+    def tenant_set(self, ctx):
+        body = ctx.json()
+        q = TenantQuota(
+            tenant=str(body.get("tenant", "")),
+            max_jobs=int(body.get("max_jobs", 0) or 0),
+            rate=float(body.get("rate", 0) or 0),
+            burst=float(body.get("burst", 0) or 0),
+            max_running=int(body.get("max_running", 0) or 0),
+            weight=float(body.get("weight", 1.0) or 1.0))
+        try:
+            q.validate()
+        except ValidationError as e:
+            raise HttpError(400, str(e))
+        self.store.put(self.ks.tenant_quota_key(q.tenant), q.to_json())
+        return q.to_dict()
+
+    def tenant_delete(self, ctx):
+        name = ctx.path_args["id"]
+        if not self.store.delete(self.ks.tenant_quota_key(name)):
+            raise HttpError(404, "no such tenant quota")
+        return {}
+
     # ---- handlers: info --------------------------------------------------
 
     def overview(self, ctx):
@@ -972,6 +1210,30 @@ class ApiServer:
             except json.JSONDecodeError:
                 continue
             inst = instance.replace('\\', r'\\').replace('"', r'\"')
+            if component == "tenant":
+                # per-tenant admission snapshots are NESTED
+                # ({tenant: {field: n}}): render each numeric leaf as
+                # cronsun_tenant_<field>{instance=,tenant=}
+                for tname, fields in sorted(snap.items()):
+                    if not isinstance(fields, dict):
+                        continue
+                    tn = str(tname).replace('\\', r'\\') \
+                        .replace('"', r'\"')
+                    for field, val in sorted(fields.items()):
+                        if not isinstance(val, (int, float)):
+                            continue
+                        name = f"cronsun_tenant_{field}"
+                        if name not in seen_types:
+                            kind = ("counter"
+                                    if field.endswith(("_total",
+                                                       "_fires"))
+                                    else "gauge")
+                            lines.append(f"# TYPE {name} {kind}")
+                            seen_types.add(name)
+                        lines.append(
+                            f'{name}{{instance="{inst}",'
+                            f'tenant="{tn}"}} {val}')
+                continue
             for field, val in sorted(snap.items()):
                 if not isinstance(val, (int, float)):
                     continue

@@ -110,11 +110,12 @@ class Fleet:
                  n_scheds=1, lease_ttl=2.0, dispatch_ttl=300.0,
                  shard_deadline=0.0, window_s=2, agent_ttl=10.0,
                  proc_ttl=600.0, block_jobs=(), checkpoint_dir=None,
-                 client_timeout=8.0):
+                 client_timeout=8.0, backend="py"):
         self.seed = seed
         self.n_jobs = n_jobs
         self.client_timeout = client_timeout
         self.shard_deadline = shard_deadline
+        self.backend = backend
         self.ks = KS
         self.ledger = []
         self.ledger_mu = threading.Lock()
@@ -122,9 +123,28 @@ class Fleet:
         self._clients = []
 
         # store shards, each behind its own proxy (schedule seeds are
-        # derived so a multi-shard drill is still one-seed determined)
-        self.store_srvs = [StoreServer(MemStore()).start()
-                           for _ in range(store_shards)]
+        # derived so a multi-shard drill is still one-seed determined).
+        # ``backend="native"`` runs the C++ stored/logd servers instead
+        # of the in-process Python ones — the FaultProxy is protocol-
+        # level, so every drill works unchanged against either; this is
+        # the plumbing the issue's "drills against the NATIVE backends"
+        # remainder asked for (native_available() gates it).
+        if backend == "native":
+            from cronsun_tpu.store.native import NativeStoreServer
+            from cronsun_tpu.logsink.native import \
+                find_binary as _logd_bin
+            from cronsun_tpu.store.native import \
+                find_binary as _stored_bin
+            sb, lb = _stored_bin(), _logd_bin()
+            if not sb or not lb:
+                raise RuntimeError(
+                    "native backends requested but cronsun-stored/"
+                    "cronsun-logd binaries are unavailable")
+            self.store_srvs = [NativeStoreServer(binary=sb)
+                               for _ in range(store_shards)]
+        else:
+            self.store_srvs = [StoreServer(MemStore()).start()
+                               for _ in range(store_shards)]
         self.store_scheds = [FaultSchedule(seed * 1000 + i)
                              for i in range(store_shards)]
         self.store_proxies = [
@@ -133,7 +153,11 @@ class Fleet:
             for i, (srv, sch) in enumerate(zip(self.store_srvs,
                                                self.store_scheds))]
         # result store behind a proxy
-        self.logd = LogSinkServer().start()
+        if backend == "native":
+            from cronsun_tpu.logsink.native import NativeLogSinkServer
+            self.logd = NativeLogSinkServer()
+        else:
+            self.logd = LogSinkServer().start()
         self.logd_sched = FaultSchedule(seed * 1000 + 99)
         self.logd_proxy = FaultProxy(("127.0.0.1", self.logd.port),
                                      self.logd_sched,
@@ -483,6 +507,52 @@ def drill_smoke(seed=7, seconds=3, on_log=print):
         fleet.close()
 
 
+def native_available() -> bool:
+    """Both native server binaries present (built on demand)?"""
+    try:
+        from cronsun_tpu.logsink.native import find_binary as lb
+        from cronsun_tpu.store.native import find_binary as sb
+        return bool(sb()) and bool(lb())
+    except Exception:  # noqa: BLE001 — no toolchain
+        return False
+
+
+def drill_native_smoke(seed=31, seconds=3, on_log=print):
+    """The smoke drill's fault set against the NATIVE stored/logd
+    backends: the FaultProxy is protocol-level, so the same wire-level
+    delay/dup/reorder and client reply-lost injections exercise the C++
+    servers' outbox/claim/WAL paths instead of the Python memstore's.
+    Skips cleanly (no findings, info.skipped) when the binaries are
+    unavailable — a missing toolchain is not an invariant violation."""
+    if not native_available():
+        on_log("native_smoke: SKIPPED (cronsun-stored/cronsun-logd "
+               "unavailable)")
+        return {"findings": [],
+                "info": {"skipped": "native binaries unavailable"}}
+    fleet = Fleet(seed=seed, n_jobs=10, n_agents=2, backend="native")
+    try:
+        for sch in fleet.store_scheds:
+            sch.add("delay", prob=0.2, ms=15)
+            sch.add("dup", prob=0.10)
+            sch.add("reorder", prob=0.05)
+        hooks.arm("store.rpc", "reply_lost",
+                  ops=("claim_many", "claim_bundle"), count=2, seed=seed)
+        hooks.arm("logsink.rpc", "reply_lost", ops="create_job_logs",
+                  count=2, seed=seed)
+        jobs = fleet.put_jobs()
+        end = fleet.drive(T0, T0 + seconds)
+        fleet.settle()
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        info.update(backend="native", injected=hooks.snapshot(),
+                    proxy_stats=[p.stats for p in fleet.store_proxies])
+        on_log(f"native_smoke: {info['executions']} execs, "
+               f"{len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
 def drill_leader_kill9(seed=11, on_log=print):
     """Kill -9 the leading scheduler DURING a herd second; the warm
     standby must take over within a bounded window and the union of
@@ -787,6 +857,7 @@ def drill_agent_kill(seed=29, on_log=print):
 
 DRILLS = {
     "smoke": drill_smoke,
+    "native_smoke": drill_native_smoke,
     "leader_kill9": drill_leader_kill9,
     "shard_partition": drill_shard_partition,
     "logd_flap": drill_logd_flap,

@@ -706,6 +706,183 @@ def run_dag_bench(n_jobs=50_000, n_nodes=512, rounds=3, window_s=4,
     return out
 
 
+def run_tenant_bench(n_tenants=6, victim_jobs=400, noisy_rate=20.0,
+                     noisy_factor=10, seconds=30, n_nodes=8,
+                     window_s=2, on_log=print):
+    """Skewed-tenant workload (ISSUE 13 acceptance): Zipf-sized victim
+    tenants plus ONE noisy tenant offering ``noisy_factor``x its
+    fire-rate quota, against the same fleet without the noisy tenant as
+    baseline.  Reports per-tenant admitted/throttled rates, the noisy
+    tenant's clamp ratio vs its quota (the ±5% gate), and the victim
+    tenants' fire-latency p99 (wall time from a window's step to its
+    orders being VISIBLE — step + build + publish) vs the
+    no-noisy-neighbor baseline (the ≤ 1.5x gate).
+
+    Runs against an in-process MemStore so the measured latency is the
+    scheduler plane itself (plan + admission + order build + publish),
+    not the wire; all jobs are Common kind, so every admitted fire is
+    one countable broadcast key — the exactly-once and admitted-rate
+    evidence reads straight out of the store."""
+    import numpy as np
+
+    from cronsun_tpu.core import Job, JobRule, Keyspace, TenantQuota
+    from cronsun_tpu.sched import SchedulerService
+    from cronsun_tpu.store.memstore import MemStore
+
+    ks = Keyspace()
+    noisy_jobs = int(noisy_rate * noisy_factor)
+    # Zipf victim tenant sizes (rank-1 law over n_tenants - 1 victims)
+    ranks = np.arange(1, max(2, n_tenants))
+    zw = 1.0 / ranks
+    sizes = np.maximum(1, (victim_jobs * zw / zw.sum()).astype(int))
+
+    def mk_fleet(with_noisy: bool):
+        store = MemStore()
+        for n in range(n_nodes):
+            store.put(ks.node_key(f"tn{n}"), "bench:1")
+        items = []
+        for ti, size in enumerate(sizes):
+            name = f"vic{ti}"
+            # victims carry REAL quotas with headroom: the admission
+            # machinery is armed for every tenant (the honest
+            # comparison), binding only on the noisy one
+            store.put(ks.tenant_quota_key(name),
+                      TenantQuota(tenant=name, rate=float(size) * 2,
+                                  burst=float(size) * 2).to_json())
+            for j in range(int(size)):
+                job = Job(id=f"{name}-j{j}", name=f"{name}-j{j}",
+                          command="true", tenant=name,
+                          rules=[JobRule(id="r", timer="* * * * * *",
+                                         nids=[f"tn{(ti + j) % n_nodes}"])])
+                job.check()
+                items.append((ks.job_key("bench", job.id),
+                              job.to_json()))
+        if with_noisy:
+            store.put(ks.tenant_quota_key("noisy"),
+                      TenantQuota(tenant="noisy", rate=noisy_rate,
+                                  burst=noisy_rate).to_json())
+            for j in range(noisy_jobs):
+                job = Job(id=f"noisy-j{j}", name=f"noisy-j{j}",
+                          command="true", tenant="noisy",
+                          rules=[JobRule(id="r", timer="* * * * * *",
+                                         nids=[f"tn{j % n_nodes}"])])
+                job.check()
+                items.append((ks.job_key("bench", job.id),
+                              job.to_json()))
+        store.put_many(items)
+        total = int(sizes.sum()) + (noisy_jobs if with_noisy else 0)
+        cap = 256
+        while cap < total + 64:
+            cap *= 2
+        svc = SchedulerService(store, job_capacity=cap,
+                               node_capacity=max(32, n_nodes),
+                               window_s=window_s, dispatch_ttl=3600.0,
+                               node_id="tenant-bench")
+        return store, svc
+
+    def drive(store, svc):
+        t = (int(time.time()) // 60 + 2) * 60
+        svc.step(now=t)                 # compile-paying first window
+        svc._builder.flush()
+        svc.publisher.flush()
+        t = svc._next_epoch
+        start_plan = t
+        lat = []
+        while t - start_plan < seconds:
+            t0 = time.perf_counter()
+            svc.step(now=t)
+            svc._builder.flush()
+            svc.publisher.flush()
+            lat.append((time.perf_counter() - t0) * 1e3)
+            t = svc._next_epoch
+        svc._drain_tenant_q()
+        return np.asarray(lat), start_plan, t
+
+    def fire_counts(store, lo, hi):
+        per_tenant = {}
+        per_job = {}
+        pfx = ks.dispatch_all
+        for kv in store.get_prefix(pfx):
+            rest = kv.key[len(pfx):].split("/")
+            if len(rest) != 3:
+                continue
+            ep, _grp, jid = int(rest[0]), rest[1], rest[2]
+            if not (lo <= ep < hi):
+                continue
+            ten = jid.rsplit("-", 1)[0]
+            per_tenant[ten] = per_tenant.get(ten, 0) + 1
+            per_job[jid] = per_job.get(jid, 0) + 1
+        return per_tenant, per_job
+
+    out = {"tenant_bench_tenants": int(len(sizes)) + 1,
+           "tenant_bench_victim_jobs": int(sizes.sum()),
+           "tenant_bench_victim_sizes": sizes.tolist(),
+           "tenant_bench_noisy_jobs": noisy_jobs,
+           "tenant_bench_seconds": seconds,
+           "tenant_noisy_quota_rate": noisy_rate,
+           "tenant_noisy_offered_rate": float(noisy_jobs)}
+
+    on_log(f"baseline (no noisy neighbor): {sizes.sum()} victim jobs "
+           f"across {len(sizes)} Zipf tenants")
+    store, svc = mk_fleet(with_noisy=False)
+    try:
+        lat, lo, hi = drive(store, svc)
+    finally:
+        svc.stop()
+    out["tenant_victim_fire_p50_ms_baseline"] = round(
+        float(np.percentile(lat, 50)), 2)
+    out["tenant_victim_fire_p99_ms_baseline"] = round(
+        float(np.percentile(lat, 99)), 2)
+
+    on_log(f"skewed run: + noisy tenant offering {noisy_jobs}/s "
+           f"against a {noisy_rate}/s quota")
+    store, svc = mk_fleet(with_noisy=True)
+    try:
+        lat, lo, hi = drive(store, svc)
+        span = hi - lo
+        per_tenant, per_job = fire_counts(store, lo, hi)
+        snap = svc.tenant_snapshot()
+    finally:
+        svc.stop()
+    out["tenant_victim_fire_p50_ms_noisy"] = round(
+        float(np.percentile(lat, 50)), 2)
+    out["tenant_victim_fire_p99_ms_noisy"] = round(
+        float(np.percentile(lat, 99)), 2)
+    base = out["tenant_victim_fire_p99_ms_baseline"]
+    out["tenant_victim_p99_ratio"] = round(
+        out["tenant_victim_fire_p99_ms_noisy"] / max(1e-3, base), 3)
+    adm = per_tenant.get("noisy", 0) / max(1, span)
+    out["tenant_noisy_admitted_rate"] = round(adm, 2)
+    out["tenant_noisy_clamp_ratio"] = round(adm / noisy_rate, 4)
+    out["tenant_noisy_throttled_fires"] = \
+        snap.get("noisy", {}).get("throttled_fires", 0)
+    out["tenant_noisy_shed_fires"] = \
+        snap.get("noisy", {}).get("shed_fires", 0)
+    # exactly-once coverage for every victim job over the driven span
+    missing = extra = 0
+    for ti, size in enumerate(sizes):
+        for j in range(int(size)):
+            c = per_job.get(f"vic{ti}-j{j}", 0)
+            missing += max(0, span - c)
+            extra += max(0, c - span)
+    out["tenant_victim_missing_fires"] = missing
+    out["tenant_victim_duplicate_fires"] = extra
+    out["tenant_victim_throttled_fires"] = sum(
+        v.get("throttled_fires", 0) for k, v in snap.items()
+        if k.startswith("vic"))
+    out["tenant_per_tenant_admitted_rate"] = {
+        k: round(v / max(1, span), 2)
+        for k, v in sorted(per_tenant.items())}
+    on_log(f"noisy admitted {adm:.1f}/s vs quota {noisy_rate}/s "
+           f"(clamp {out['tenant_noisy_clamp_ratio']:.3f}), "
+           f"throttled {out['tenant_noisy_throttled_fires']}; victim "
+           f"p99 {out['tenant_victim_fire_p99_ms_noisy']}ms vs "
+           f"baseline {base}ms "
+           f"(ratio {out['tenant_victim_p99_ratio']}), "
+           f"missing {missing}")
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=100_000)
@@ -720,10 +897,25 @@ def main():
                     help="--dag: completion rounds to drive")
     ap.add_argument("--fan-in", type=int, default=4,
                     help="--dag: upstreams per dependent job")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the skewed-tenant admission workload "
+                         "(Zipf tenants + one noisy neighbor offered "
+                         "10x its fire-rate quota) instead of the "
+                         "step/failover bench")
+    ap.add_argument("--n-tenants", type=int, default=6)
+    ap.add_argument("--victim-jobs", type=int, default=400)
+    ap.add_argument("--noisy-rate", type=float, default=20.0)
+    ap.add_argument("--seconds", type=int, default=30,
+                    help="--tenants: virtual seconds to drive per run")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
     on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
-    if args.dag:
+    if args.tenants:
+        res = run_tenant_bench(
+            n_tenants=args.n_tenants, victim_jobs=args.victim_jobs,
+            noisy_rate=args.noisy_rate, seconds=args.seconds,
+            window_s=args.window, on_log=on_log)
+    elif args.dag:
         res = run_dag_bench(args.jobs, args.nodes, args.rounds,
                             args.window, args.fan_in, on_log=on_log)
     else:

@@ -1,0 +1,106 @@
+"""Breaker-state push into the noticer (ISSUE 13 satellite, PR 12
+chaos-plane remainder): a shard breaker transitioning to OPEN writes a
+rate-limited notice key that the NoticerHost delivers — a browning-out
+shard pages, it doesn't just count."""
+
+import json
+import time
+
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.core.breaker import BreakerBank, CircuitBreaker
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.noticer import NoticerHost
+from cronsun_tpu.store.memstore import MemStore
+
+KS = Keyspace()
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_on_open_fires_once_per_transition():
+    seen = []
+    b = CircuitBreaker(deadline=0.05, fail_threshold=2, cooldown=10.0)
+    b.on_open = lambda: seen.append(1)
+    b.record(False)
+    assert seen == []              # below threshold
+    b.record(False)
+    assert seen == [1]             # CLOSED -> OPEN
+    b.record(False)                # straggler while OPEN: no re-fire
+    assert seen == [1]
+
+
+def test_bank_open_writes_notice_and_noticer_delivers():
+    store = MemStore()
+    bank = BreakerBank(2, deadline=0.05, fail_threshold=2,
+                       cooldown=60.0, label="store shard")
+    bank.arm_notices(store, "/cronsun", source="test")
+    for _ in range(2):
+        bank.breakers[1].record(False)
+    key_pfx = f"{KS.noticer}breaker-store-shard-1"
+    assert _wait_for(
+        lambda: store.get(key_pfx) is not None), "notice key not written"
+    doc = json.loads(store.get(key_pfx).value)
+    assert "circuit OPEN" in doc["subject"]
+    assert "shard 1" in doc["subject"] or "store shard 1" in doc["subject"]
+    assert "/v1/metrics" in doc["body"]
+
+    # the NoticerHost picks it up and delivers with its durable ladder
+    class Sender:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, notice):
+            self.sent.append(notice)
+    sender = Sender()
+    host = NoticerHost(store, JobLogStore(), sender)
+    host.resync()
+    assert any("circuit OPEN" in n.subject for n in sender.sent)
+    # delivered -> key deleted (durable-delivery contract)
+    assert store.get(key_pfx) is None
+
+    # rate limit: a second open inside the interval writes nothing new
+    bank.breakers[1].record(True)          # close (probe not needed:
+    bank.breakers[1]._state = "closed"     # force for the transition)
+    for _ in range(2):
+        bank.breakers[1].record(False)
+    time.sleep(0.3)
+    assert store.get(key_pfx) is None
+    store.close()
+
+
+def test_disabled_bank_is_inert():
+    store = MemStore()
+    bank = BreakerBank(2, deadline=0.0, label="store shard")
+    bank.arm_notices(store, "/cronsun")    # no-op when disabled
+    assert all(b.on_open is None for b in bank.breakers)
+    store.close()
+
+
+def test_sharded_store_arms_notices(monkeypatch):
+    """The sharded store client arms its own bank when the breaker is
+    enabled: opening one shard's breaker lands a notice key through
+    the client's own routing."""
+    from cronsun_tpu.store.sharded import ShardedStore
+    s = ShardedStore([MemStore(), MemStore()], shard_deadline=0.05)
+    assert all(b.on_open is not None for b in s._bank.breakers)
+    for _ in range(3):
+        s._bank.breakers[0].record(False)
+    key = f"{KS.noticer}breaker-store-shard-0"
+
+    def landed():
+        # the key may route to the OPEN shard: reads fail fast until
+        # the cooldown probe closes it, and the notice's background
+        # write ladder retries through the same heal
+        try:
+            return s.get(key) is not None
+        except Exception:  # noqa: BLE001 — breaker still open
+            return False
+    assert _wait_for(landed, timeout=15.0)
+    s.close()

@@ -63,6 +63,20 @@ def test_brownout_drill_bounded_p99():
 
 @pytest.mark.slow
 @pytest.mark.chaos
+def test_native_backend_drill():
+    """ISSUE 13 satellite (PR 12 chaos-plane remainder): the smoke
+    fault set against the NATIVE stored/logd backends — the FaultProxy
+    is protocol-level, so only this harness plumbing was missing."""
+    if not bench_chaos.native_available():
+        pytest.skip("cronsun-stored/cronsun-logd binaries unavailable")
+    res = _run("native_smoke")
+    assert res["findings"] == [], res["findings"]
+    assert res["info"].get("backend") == "native"
+    assert res["info"]["executions"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
 def test_logd_flap_and_crash_drills():
     """Result-plane flap (pinned idem tokens: sink == acked exactly),
     checkpoint racing a partition (loud failure, clean convergence),

@@ -179,11 +179,11 @@ def test_dep_free_table_bit_identical():
     kw = dict(kx=2048, kc=2048, rounds=2, impl="jnp")
     off = jax.jit(_plan_window_step,
                   static_argnames=("kx", "kc", "rounds", "impl",
-                                   "use_deps")
+                                   "use_deps", "use_tenants")
                   ).lower(*args, use_deps=False, **kw).as_text()
     on = jax.jit(_plan_window_step,
                  static_argnames=("kx", "kc", "rounds", "impl",
-                                  "use_deps")
+                                  "use_deps", "use_tenants")
                  ).lower(*args, use_deps=True, **kw).as_text()
     # structural free-ness: the [J, MAX_DEPS] dep matrix appears in the
     # disarmed module only as an (unused) parameter — never in an op
