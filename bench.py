@@ -87,7 +87,8 @@ def synth_table(J, fire_period_lo, fire_period_hi, seed=0):
         period=rng.integers(fire_period_lo, fire_period_hi, J).astype(np.int32),
         active=np.ones(J, bool), paused=np.zeros(J, bool),
         has_dep=np.zeros(J, bool), dep_policy=np.zeros(J, np.int32),
-        dep_cols=np.full((J, 8), -1, np.int32))
+        dep_cols=np.full((J, 8), -1, np.int32),
+        tenant=np.zeros(J, np.int32))
     # Uniform phases over each job's own period: steady aggregate fire rate
     # (clustered phases make bursty seconds that overflow the fired bucket).
     cols["phase_mod"] = (rng.integers(0, 1 << 30, J) % cols["period"]).astype(np.int32)
@@ -627,6 +628,28 @@ def main():
                 detail["dag_bench_error"] = proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
             detail["dag_bench_error"] = str(e)
+
+    # ---- multi-tenant admission: skewed-tenant workload --------------------
+    # Zipf victim tenants + one noisy tenant offering 10x its fire-rate
+    # quota: the noisy tenant must clamp to its quota (±5%) with loud
+    # throttle counters while the victims stay exactly-once with fire-
+    # latency p99 within 1.5x of the no-noisy-neighbor baseline
+    # (tenant_* keys).
+    if not quick:
+        log("multi-tenant admission: skewed-tenant workload")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--tenants", "--victim-jobs", "2000",
+                 "--noisy-rate", "100", "--seconds", "60"],
+                capture_output=True, text=True, timeout=1800, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["tenant_bench_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["tenant_bench_error"] = str(e)
 
     with open("bench_detail.json", "w") as f:
         json.dump(detail, f, indent=1)

@@ -569,6 +569,66 @@ def cmd_checkpoint_compact(api, args):
 # workflow DAG views
 # ---------------------------------------------------------------------------
 
+def cmd_tenants(api, args):
+    res = api.call("GET", "/v1/tenants")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    rows = []
+    for t in res:
+        q = t.get("quota") or {}
+        rows.append([t["tenant"], t["jobs"],
+                     q.get("max_jobs") or "-", q.get("rate") or "-",
+                     q.get("burst") or "-", q.get("max_running") or "-",
+                     q.get("weight", 1.0)])
+    table(rows, ["TENANT", "JOBS", "MAX_JOBS", "RATE/S", "BURST",
+                 "MAX_RUN", "WEIGHT"])
+
+
+def cmd_tenant_show(api, args):
+    res = api.call("GET", f"/v1/tenant/{args.id}")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    q = res.get("quota") or {}
+    print(f"tenant:      {res['tenant']}")
+    print(f"jobs:        {res['jobs']}"
+          + (f" / {q['max_jobs']}" if q.get("max_jobs") else ""))
+    if q:
+        print(f"fire rate:   {q.get('rate') or 'unlimited'}"
+              + (f"/s (burst {q.get('burst')})" if q.get("rate") else ""))
+        print(f"max running: {q.get('max_running') or 'unlimited'}")
+        print(f"weight:      {q.get('weight', 1.0)}")
+    else:
+        print("quota:       none (unlimited)")
+    live = res.get("live") or {}
+    if live:
+        print("live (scheduler snapshots):")
+        for k in sorted(live):
+            print(f"  {k}: {live[k]}")
+
+
+def cmd_tenant_set(api, args):
+    body = {"tenant": args.id}
+    for k in ("max_jobs", "rate", "burst", "max_running", "weight"):
+        v = getattr(args, k)
+        if v is not None:
+            body[k] = v
+    res = api.call("PUT", "/v1/tenant", body=body)
+    if args.json:
+        print(json.dumps(res, indent=2))
+    else:
+        print(f"quota set for tenant {res['tenant']!r}: "
+              f"max_jobs={res['max_jobs']} rate={res['rate']}/s "
+              f"burst={res['burst']} max_running={res['max_running']} "
+              f"weight={res['weight']}")
+
+
+def cmd_tenant_rm(api, args):
+    api.call("DELETE", f"/v1/tenant/{args.id}")
+    print(f"quota removed for tenant {args.id!r} (now unlimited)")
+
+
 def cmd_dag_show(api, args):
     """Render the group's dependency graph: topological order, each
     job's upstreams, misfire policy and in-flight cap, plus broken
@@ -884,6 +944,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "record (default 60 — must stay BELOW the "
                         "fence lease lifetime, lock_ttl+60, or the "
                         "cross-check can never fire)")
+
+    add("tenants", cmd_tenants, "list tenants (jobs + quotas)")
+    ten = sub.add_parser("tenant",
+                         help="tenant quotas and admission state")
+    tsub = ten.add_subparsers(dest="tenantcmd", required=True)
+    p = tsub.add_parser("show", help="one tenant's quota, job count "
+                                     "and live throttle counters")
+    p.set_defaults(fn=cmd_tenant_show)
+    p.add_argument("id")
+    p = tsub.add_parser("set", help="create/update a tenant quota "
+                                    "(admin; omitted fields keep 0 = "
+                                    "unlimited)")
+    p.set_defaults(fn=cmd_tenant_set)
+    p.add_argument("id")
+    p.add_argument("--max-jobs", dest="max_jobs", type=int, default=None)
+    p.add_argument("--rate", type=float, default=None,
+                   help="sustained fires/second (token-bucket refill)")
+    p.add_argument("--burst", type=float, default=None,
+                   help="bucket depth (default max(rate, 1))")
+    p.add_argument("--max-running", dest="max_running", type=int,
+                   default=None,
+                   help="max outstanding exclusive executions")
+    p.add_argument("--weight", type=float, default=None,
+                   help="fair-share weight under capacity scarcity")
+    p = tsub.add_parser("rm", help="remove a tenant's quota (admin)")
+    p.set_defaults(fn=cmd_tenant_rm)
+    p.add_argument("id")
 
     dag = sub.add_parser("dag", help="workflow DAG views")
     dsub = dag.add_subparsers(dest="dagcmd", required=True)

@@ -13,4 +13,4 @@ from .keyspace import Keyspace  # noqa: F401
 from .models import (  # noqa: F401
     Account, DepSpec, Group, Job, JobRule, KIND_ALONE, KIND_COMMON,
     KIND_INTERVAL, MAX_DEPS, MISFIRE_POLICIES, Node, ROLE_ADMIN,
-    ROLE_DEVELOPER, validate_dag)
+    ROLE_DEVELOPER, TenantQuota, validate_dag)

@@ -33,9 +33,10 @@ production fleets and the chaos drills opt in via
 from __future__ import annotations
 
 import inspect
+import json
 import threading
 import time
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 from .. import log
 
@@ -45,7 +46,7 @@ CLOSED, OPEN, PROBING = "closed", "open", "probing"
 class CircuitBreaker:
     __slots__ = ("deadline", "fail_threshold", "cooldown", "clock",
                  "_mu", "_state", "_fails", "_opened_at", "_probe_out",
-                 "opens_total", "refused_total")
+                 "opens_total", "refused_total", "on_open")
 
     def __init__(self, deadline: float = 0.0, fail_threshold: int = 3,
                  cooldown: float = 1.0,
@@ -61,6 +62,9 @@ class CircuitBreaker:
         self._probe_out = False
         self.opens_total = 0
         self.refused_total = 0
+        # invoked (outside the lock) on each CLOSED/PROBING -> OPEN
+        # transition; BreakerBank.arm_notices wires the noticer push
+        self.on_open: Optional[Callable[[], None]] = None
 
     @property
     def enabled(self) -> bool:
@@ -102,6 +106,7 @@ class CircuitBreaker:
             return
         if ok and elapsed > self.deadline:
             ok = False
+        opened = False
         with self._mu:
             st = self._effective_state_locked()
             if ok:
@@ -122,6 +127,15 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self.clock()
                 self._probe_out = False
+                opened = True
+        if opened and self.on_open is not None:
+            # outside the lock: the hook must never stall (or deadlock)
+            # the RPC path that reported the failure
+            try:
+                self.on_open()
+            except Exception as e:  # noqa: BLE001 — paging is
+                # best-effort; breaking is the load-bearing part
+                log.warnf("breaker on_open hook failed: %s", e)
 
     def snapshot(self) -> dict:
         with self._mu:
@@ -264,6 +278,70 @@ class BreakerBank:
                 self.note_degraded(i)
                 return default
         return run
+
+    def arm_notices(self, store, prefix: str, source: str = "",
+                    interval_s: float = 60.0):
+        """Push a breaker OPEN transition into the noticer plane: a
+        shard browning out should PAGE, not just count.
+
+        Each transition writes a notice key under
+        ``<prefix>/noticer/breaker-<label>-<shard>`` which the
+        NoticerHost (hosted by the web process) delivers by SMTP/HTTP
+        with its usual durable-retry ladder.  Rate-limited per shard
+        (``interval_s``) — a flapping breaker pages once a minute, not
+        once per open — and written BEST-EFFORT on a background thread
+        with a short retry ladder: the write itself may route to the
+        very shard that just opened, in which case it lands once the
+        probe closes the breaker (the page is late, the metrics gauge
+        is the real-time signal).
+
+        ``store`` is any client with ``put`` (typically the sharded
+        client that owns this bank); idempotent to call once per bank.
+        """
+        if not self.enabled:
+            return
+        slug = self.label.replace(" ", "-")
+        last = [0.0] * self.nshards
+
+        def mk(i: int):
+            def fire():
+                now = time.monotonic()
+                if now - last[i] < interval_s:
+                    return
+                last[i] = now
+                snap = self.breakers[i].snapshot()
+                key = f"{prefix}/noticer/breaker-{slug}-{i}"
+                body = json.dumps({
+                    "subject": f"[cronsun] {self.label} {i} circuit "
+                               f"OPEN" + (f" ({source})" if source
+                                          else ""),
+                    "body": f"{self.label} {i} breaker opened "
+                            f"(open #{snap['opens_total']}, deadline "
+                            f"{snap['deadline_s']}s): consecutive "
+                            "failures or brownouts; writes fail fast "
+                            "and tolerant reads serve without this "
+                            "shard until a cooldown probe succeeds. "
+                            "See cronsun_*_shard_breaker_* at "
+                            "/v1/metrics."})
+
+                def write():
+                    for _ in range(10):
+                        try:
+                            store.put(key, body)
+                            return
+                        except Exception:  # noqa: BLE001 — the notice
+                            # may route to the open shard; retry as it
+                            # heals, give up quietly after the ladder
+                            time.sleep(2.0)
+                    log.warnf("breaker-open notice for %s %d could not "
+                              "be written (store degraded)",
+                              self.label, i)
+                threading.Thread(target=write, daemon=True,
+                                 name=f"breaker-notice-{slug}-{i}"
+                                 ).start()
+            return fire
+        for i, b in enumerate(self.breakers):
+            b.on_open = mk(i)
 
     def snapshot(self) -> List[dict]:
         """Per-shard breaker state + degraded-read counts (rendered at

@@ -181,3 +181,26 @@ class Keyspace:
 
     def sess_key(self, sid: str) -> str:
         return f"{self.sess}{sid}"
+
+    # -- multi-tenant control plane ---------------------------------------
+
+    @property
+    def tenant(self) -> str:
+        """Tenancy keyspace family: per-tenant quota records and the
+        per-tenant job index markers the web tier maintains so
+        ``set_job``'s max_jobs check is one ``count_prefix``, not a
+        full ``cmd/`` scan."""
+        return f"{self.prefix}/tenant/"
+
+    def tenant_quota_key(self, tenant: str) -> str:
+        """Quota record (core.models.TenantQuota JSON); the scheduler
+        watches the tenant prefix and folds these into the per-tenant
+        token-bucket columns."""
+        return f"{self.tenant}{tenant}/quota"
+
+    def tenant_jobs(self, tenant: str) -> str:
+        """Prefix of one tenant's job index markers."""
+        return f"{self.tenant}{tenant}/job/"
+
+    def tenant_job_key(self, tenant: str, group: str, job_id: str) -> str:
+        return f"{self.tenant_jobs(tenant)}{group}/{job_id}"

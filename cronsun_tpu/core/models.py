@@ -186,6 +186,9 @@ class Job:
     group: str = ""
     command: str = ""
     user: str = ""
+    # multi-tenant control plane: the isolation axis quotas/admission
+    # key on; "" is the default tenant (never quota-limited)
+    tenant: str = ""
     rules: List[JobRule] = dataclasses.field(default_factory=list)
     pause: bool = False
     timeout: int = 0            # seconds; 0 = unlimited
@@ -210,6 +213,9 @@ class Job:
         self.group = _clean(self.group) or "default"
         if "/" in self.group:
             raise ValidationError("group name must not contain '/'")
+        self.tenant = _clean(self.tenant)
+        if "/" in self.tenant:
+            raise ValidationError("tenant name must not contain '/'")
         if self.timeout < 0:
             raise ValidationError("timeout must be >= 0")
         if self.parallels < 0:
@@ -270,6 +276,9 @@ class Job:
         if self.deps is None:
             # wire compat: dep-less jobs serialize exactly as before
             d.pop("deps", None)
+        if not self.tenant:
+            # wire compat: default-tenant jobs keep the pre-tenancy bytes
+            d.pop("tenant", None)
         return json.dumps(d, separators=(",", ":"))
 
     _FIELDS = None   # lazily cached field-name set (NOT annotated: an
@@ -362,6 +371,9 @@ class Account:
     status: int = 1              # 1 enabled, 0 banned
     session: str = ""
     unchangeable: bool = False
+    # multi-tenant control plane: a non-empty tenant PINS this
+    # account's jobs to that tenant (admins may set any tenant)
+    tenant: str = ""
 
     def check_password(self, password: str) -> bool:
         return hash_password(password, self.salt) == self.password
@@ -371,6 +383,59 @@ class Account:
 
     @classmethod
     def from_json(cls, s: str) -> "Account":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclasses.dataclass
+class TenantQuota:
+    """Per-tenant admission limits, stored under ``tenant/<id>/quota``.
+
+    Zero means unlimited for every field.  ``rate``/``burst`` feed the
+    scheduler's per-tenant token bucket (fires admitted per scheduled
+    second, evaluated inside the batched tick); ``max_jobs`` is enforced
+    at ``set_job`` (429 over quota); ``max_running`` caps concurrently
+    outstanding EXCLUSIVE executions (orders + procs); ``weight`` is the
+    fair-share weight when aggregate exclusive demand exceeds agent
+    capacity (weighted max-min, default 1.0)."""
+    tenant: str = ""
+    max_jobs: int = 0
+    rate: float = 0.0            # sustained fires/second
+    burst: float = 0.0           # bucket depth; defaults to max(rate, 1)
+    max_running: int = 0
+    weight: float = 1.0
+
+    def validate(self):
+        self.tenant = _clean(self.tenant)
+        if not self.tenant:
+            raise ValidationError("tenant name required")
+        if "/" in self.tenant:
+            raise ValidationError("tenant name must not contain '/'")
+        if self.max_jobs < 0 or self.max_running < 0:
+            raise ValidationError("quota counts must be >= 0")
+        if self.rate < 0 or self.burst < 0:
+            raise ValidationError("rate/burst must be >= 0")
+        if self.burst == 0 and self.rate > 0:
+            # a zero-depth bucket never admits; default to one second's
+            # worth (and at least 1 so sub-1/s rates can ever fire)
+            self.burst = max(self.rate, 1.0)
+        if self.weight <= 0:
+            raise ValidationError("weight must be > 0")
+
+    @property
+    def limited(self) -> bool:
+        """Whether the scheduler's token bucket applies at all."""
+        return self.rate > 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "TenantQuota":
         d = json.loads(s)
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})

@@ -207,6 +207,15 @@ class ShardedJobLogStore:
         if self.nshards > 1 and verify_map:
             self._pin_log_map()
 
+    def arm_breaker_notices(self, store, prefix: str = "/cronsun",
+                            source: str = ""):
+        """Route breaker OPEN transitions into the noticer plane.  The
+        logsink client cannot write notices itself (they live in the
+        COORDINATION store) — the process that owns both (the web
+        server hosts the noticer in the reference) passes its store
+        here.  No-op when the breaker bank is disabled."""
+        self._bank.arm_notices(store, prefix, source=source)
+
     # ---- routing ---------------------------------------------------------
 
     def _idx(self, job_id: str) -> int:

@@ -81,12 +81,16 @@ def _compact(fire: jax.Array, k: int):
 
 
 @partial(jax.jit, static_argnames=("kx", "kc", "rounds", "impl",
-                                   "use_deps"),
+                                   "use_deps", "use_tenants"),
          donate_argnames=("load", "rem_cap", "dep_last_fire"))
 def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
                       load, rem_cap, dep_succ, dep_fail, dep_block,
                       dep_last_fire, kx: int, kc: int, rounds: int,
-                      impl: str, use_deps: bool):
+                      impl: str, use_deps: bool,
+                      tn_perm=None, tn_sorted=None, tn_segbase=None,
+                      tb_rate=None, tb_burst=None, tb_limited=None,
+                      tb_weight=None, tb_tokens=None,
+                      use_tenants: bool = False):
     """W seconds in one dispatch: lax.scan over the window, exactly the
     semantics of W consecutive single ticks (load/capacity carry through),
     but one dispatch + one fetch — the host round-trip amortizes over the
@@ -106,7 +110,15 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     dep fires into the time fires, and the carried ``dep_last_fire``
     advances so a row fires once per upstream round.  False compiles the
     dep ops OUT — a dep-free table runs the exact pre-DAG program (the
-    differential test pins bit-identity)."""
+    differential test pins bit-identity).
+
+    ``use_tenants`` (static) folds per-tenant token-bucket admission in
+    after the dep OR (ops/tenancy.py): refill + rank + clamp per second,
+    the ``tb_tokens`` column carried through the scan, per-tenant
+    throttle/shed counts a third scan output.  False compiles ALL of it
+    out — carry, outputs and every tenant operand vanish from the
+    lowered module (they default to None), so a tenant-free table runs
+    the exact pre-tenancy program (pinned like the dep test)."""
     from .tick import _fire_mask_jit
     cols = [fields_w[:, i] for i in range(7)]
     t_rel_w = fields_w[:, 6]
@@ -120,20 +132,37 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     adt = jnp.int16 if n_cols <= 32767 else jnp.int32
 
     def body(carry, xs):
-        load, rem_cap, last_fire = carry
+        if use_tenants:
+            load, rem_cap, last_fire, tokens = carry
+        else:
+            load, rem_cap, last_fire = carry
         fire_col, t_rel = xs
+        time_col = fire_col
+        dep_f = dep_consume = round_max = None
         if use_deps:
             with jax.named_scope("cronsun.deps"):
                 from .deps import dep_ready
                 dep_f, dep_consume, round_max = dep_ready(
                     table, dep_succ, dep_fail, dep_block, last_fire)
                 fire_col = fire_col | dep_f
-                # advance to the newest consumed upstream epoch, not
-                # just the tick: a round scheduled ahead of the firing
-                # tick must not re-satisfy the next window
-                last_fire = jnp.where(
-                    dep_f | dep_consume,
-                    jnp.maximum(t_rel, round_max), last_fire)
+        if use_tenants:
+            with jax.named_scope("cronsun.tenants"):
+                from .tenancy import admit
+                admitted, tokens, thr_t, shed_t = admit(
+                    fire_col, time_col, exclusive, tokens, tb_rate,
+                    tb_burst, tb_limited, tb_weight, rem_cap,
+                    tn_perm, tn_sorted, tn_segbase, tb_rate.shape[0])
+                fire_col = fire_col & admitted
+        if use_deps:
+            # advance to the newest consumed upstream epoch, not just
+            # the tick: a round scheduled ahead of the firing tick must
+            # not re-satisfy the next window.  A THROTTLED dep fire
+            # (admission refused it) does NOT advance — it retries when
+            # the bucket refills, late-never-lost like every other gate.
+            eff_dep = (dep_f & fire_col) if use_tenants else dep_f
+            last_fire = jnp.where(
+                eff_dep | dep_consume,
+                jnp.maximum(t_rel, round_max), last_fire)
         with jax.named_scope("cronsun.compact"):
             xidx, xvalid, xtotal = _compact(fire_col & exclusive, kx)
             cidx, cvalid, ctotal = _compact(fire_col & ~exclusive, kc)
@@ -145,12 +174,23 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
         out32 = jnp.concatenate([
             jnp.asarray([xtotal, ctotal], jnp.int32),
             xidx, cidx])                               # [2 + kx + kc]
+        if use_tenants:
+            return (load, rem_cap, last_fire, tokens), \
+                (out32, assigned.astype(adt),
+                 jnp.stack([thr_t, shed_t]))           # [2, T]
         return (load, rem_cap, last_fire), (out32, assigned.astype(adt))
 
-    (load, rem_cap, dep_last_fire), (outs32, outs16) = \
-        jax.lax.scan(body, (load, rem_cap, dep_last_fire),
-                     (fire_w.T, t_rel_w))
-    return outs32, outs16, load, rem_cap, dep_last_fire
+    if use_tenants:
+        (load, rem_cap, dep_last_fire, tb_tokens), \
+            (outs32, outs16, outs_t) = jax.lax.scan(
+                body, (load, rem_cap, dep_last_fire, tb_tokens),
+                (fire_w.T, t_rel_w))
+    else:
+        (load, rem_cap, dep_last_fire), (outs32, outs16) = \
+            jax.lax.scan(body, (load, rem_cap, dep_last_fire),
+                         (fire_w.T, t_rel_w))
+        outs_t = tb_tokens = None
+    return outs32, outs16, outs_t, load, rem_cap, dep_last_fire, tb_tokens
 
 
 class _AdaptiveBucket:
@@ -238,6 +278,12 @@ class TickPlan:
                              #     fired[n_excl:] are Common fan-outs —
                              #     dispatchers iterate each half without
                              #     a per-fire kind branch
+    # multi-tenant admission: per-tenant-id refusal counts this second
+    # (None on tenant-free tables — the ops are compiled out).
+    # throttled = all refused fires; shed = the time-triggered subset
+    # (permanently dropped; throttled dep fires retry next tick).
+    tenant_throttled: Optional[np.ndarray] = None   # [T] int32
+    tenant_shed: Optional[np.ndarray] = None        # [T] int32
 
 
 class TickPlanner:
@@ -255,7 +301,8 @@ class TickPlanner:
 
     def __init__(self, job_capacity: int, node_capacity: int,
                  tz=_UTC, rounds: int = 2, impl: str = "auto",
-                 max_fire_bucket: int = 65536):
+                 max_fire_bucket: int = 65536,
+                 tenant_capacity: int = 64):
         # rounds=2 (one waterfill-quota round + one capacity-final round)
         # is the latency/balance sweet spot on v5e: each extra round costs
         # ~5 ms/tick at 10k nodes for marginal placement-spread gains.
@@ -285,6 +332,22 @@ class TickPlanner:
         self.dep_last_fire = jnp.zeros(self.J, jnp.int32)
         self.dep_block = jnp.zeros(self.J, bool)
         self._dep_enabled = False
+        # multi-tenant admission state: per-tenant token-bucket columns
+        # (rate/burst/limited scattered from quota records, tokens
+        # carried through the window scan) and the host row->tenant
+        # snapshot the admission permutation derives from.  Compiled
+        # OUT (use_tenants static arg) until the scheduler arms it —
+        # tenant-free tables run the exact pre-tenancy program.
+        self.T = _next_pow2(max(2, tenant_capacity))
+        self.tb_rate = jnp.zeros(self.T, jnp.float32)
+        self.tb_burst = jnp.zeros(self.T, jnp.float32)
+        self.tb_limited = jnp.zeros(self.T, bool)
+        self.tb_weight = jnp.ones(self.T, jnp.float32)
+        self.tb_tokens = jnp.zeros(self.T, jnp.float32)
+        self._tenants_enabled = False
+        self._tenant_np = np.zeros(self.J, np.int32)
+        self._tn_dirty = True
+        self._tn_perm = self._tn_sorted = self._tn_segbase = None
         # Adaptive fired-buckets (one per kind — exclusive fires pay the
         # bid rounds, Common fires only the fan-out): sized from the last
         # observed fire count so quiet tables don't pay the max-SLA solve.
@@ -398,6 +461,74 @@ class TickPlanner:
             np.asarray(last_fire, np.int32))
         self.dep_block = jnp.asarray(np.asarray(block, bool))
 
+    # -- multi-tenant admission state (scheduler-driven) -------------------
+
+    @property
+    def tenants_enabled(self) -> bool:
+        return self._tenants_enabled
+
+    def set_tenants_enabled(self, flag: bool = True):
+        """Arm (or disarm) the admission ops in the plan program.  Like
+        the dep plane, flipping recompiles the window executable once
+        (a static jit arg); the scheduler arms it when the first
+        LIMITED tenant quota lands and leaves it on."""
+        self._tenants_enabled = bool(flag)
+
+    def set_row_tenants(self, rows, tids):
+        """Update the host row->tenant snapshot (the device ``tenant``
+        table column rides the normal row scatters; THIS copy feeds the
+        admission permutation, recomputed lazily on the next dispatch).
+        """
+        if len(rows):
+            self._tenant_np[np.asarray(rows, np.int32)] = \
+                np.asarray(tids, np.int32)
+            self._tn_dirty = True
+
+    def set_tenant_quota(self, tid: int, rate: float, burst: float,
+                         weight: float = 1.0):
+        """Install/refresh one tenant's bucket column.  Tokens reset to
+        a FULL bucket (a fresh/raised quota must not inherit a starved
+        bucket; a lowered one clamps at the next refill's min)."""
+        t = jnp.asarray([int(tid)], jnp.int32)
+        limited = rate > 0
+        self.tb_rate = self.tb_rate.at[t].set(np.float32(rate))
+        self.tb_burst = self.tb_burst.at[t].set(np.float32(burst))
+        self.tb_limited = self.tb_limited.at[t].set(bool(limited))
+        self.tb_weight = self.tb_weight.at[t].set(
+            np.float32(max(weight, 1e-6)))
+        self.tb_tokens = self.tb_tokens.at[t].set(
+            np.float32(burst if limited else 0.0))
+
+    def clear_tenant_quota(self, tid: int):
+        """Quota record deleted: the tenant reverts to unlimited."""
+        self.set_tenant_quota(tid, 0.0, 0.0, 1.0)
+
+    def _tenant_args(self):
+        """The admission operands for a plan dispatch: a consistent
+        device snapshot of (perm, sorted tenant, segment base),
+        recomputed host-side only when the row->tenant map changed."""
+        if self._tn_dirty:
+            from .tenancy import tenant_order
+            perm, ts, segbase = tenant_order(self._tenant_np)
+            self._tn_perm = jnp.asarray(perm)
+            self._tn_sorted = jnp.asarray(ts)
+            self._tn_segbase = jnp.asarray(segbase)
+            self._tn_dirty = False
+        return dict(tn_perm=self._tn_perm, tn_sorted=self._tn_sorted,
+                    tn_segbase=self._tn_segbase, tb_rate=self.tb_rate,
+                    tb_burst=self.tb_burst, tb_limited=self.tb_limited,
+                    tb_weight=self.tb_weight)
+
+    def tenant_state(self) -> dict:
+        """Host copies of the mutable tenant vectors (checkpoint
+        capture).  Rate/burst/limited re-derive from the quota registry
+        the scheduler checkpoints; tokens are the dynamic state."""
+        return dict(tokens=np.asarray(self.tb_tokens))
+
+    def set_tenant_state(self, tokens):
+        """Install checkpointed token columns whole (restore path)."""
+        self.tb_tokens = jnp.asarray(np.asarray(tokens, np.float32))
+
     def job_finished(self, node_col: int, cost: float):
         """Exclusive execution completed: release the capacity slot the
         solve reserved and retire its load."""
@@ -474,14 +605,27 @@ class TickPlanner:
             # scheduler's reconcile rewrites load/capacity absolutely
             # every step (dep epoch folds are monotone max — a lost
             # window re-applies at the next drain's scatter).
-            outs32, outs16, self.load, self.rem_cap, \
-                self.dep_last_fire = _plan_window_step(
+            tkw = {}
+            if self._tenants_enabled:
+                tkw = dict(self._tenant_args(),
+                           tb_tokens=self.tb_tokens + 0.0,
+                           use_tenants=True)
+            outs32, outs16, outs_t, self.load, self.rem_cap, \
+                self.dep_last_fire, tokens = _plan_window_step(
                     self.table, jnp.asarray(fields_w),
                     self.elig, self.exclusive, self.cost, self.load + 0.0,
                     self.rem_cap | 0, self.dep_succ, self.dep_fail,
                     self.dep_block, self.dep_last_fire | 0,
-                    kx, kc, self.rounds, impl, self._dep_enabled)
-        return epoch_s, kx, kc, outs32, outs16
+                    kx, kc, self.rounds, impl, self._dep_enabled, **tkw)
+            # overflow-escalation replans (sla_bucket set) RE-plan
+            # seconds whose refill/spend already advanced the carried
+            # bucket: persisting a second pass would permanently drift
+            # a throttled tenant below its quota (spend exceeds the
+            # burst-clamped refill on exactly the herd seconds that
+            # overflow) — replans read the bucket, never write it back
+            if tokens is not None and sla_bucket is None:
+                self.tb_tokens = tokens
+        return epoch_s, kx, kc, outs32, outs16, outs_t
 
     def gather_window(self, handle):
         """Materialize a window dispatch into a list of TickPlans.
@@ -489,10 +633,10 @@ class TickPlanner:
         Exclusive placements come first in ``fired``/``assigned``; Common
         fires follow with assigned = -1 (fan-out is the dispatcher's job).
         """
-        epoch_s, kx, kc, outs32, outs16 = handle
+        epoch_s, kx, kc, outs32, outs16, outs_t = handle
         with jax.profiler.TraceAnnotation("cronsun.plan.gather"):
-            # one tunnel transaction for both arrays
-            o, oa = jax.device_get((outs32, outs16))
+            # one tunnel transaction for all arrays
+            o, oa, ot = jax.device_get((outs32, outs16, outs_t))
         plans = []
         W = o.shape[0]
         for w in range(W):
@@ -507,7 +651,9 @@ class TickPlanner:
             plans.append(TickPlan(
                 epoch_s=epoch_s + w, fired=fired, assigned=assigned,
                 overflow=max(0, xt - kx) + max(0, ct - kc),
-                total_fired=xt + ct, n_excl=nx))
+                total_fired=xt + ct, n_excl=nx,
+                tenant_throttled=(ot[w, 0] if ot is not None else None),
+                tenant_shed=(ot[w, 1] if ot is not None else None)))
         if W:
             # adaptive sizing tracks each bucket's worst second; the shrink
             # hysteresis counts *ticks*, not calls.  Gather may run on the
@@ -544,11 +690,11 @@ class TickPlanner:
         ], axis=1).astype(np.int32)
         # + 0.0 / | 0: fresh buffers so the jit's donation can't
         # invalidate the planner's live load/rem_cap/last_fire
-        outs32, _outs16, _l, _r, _lf = _plan_window_step(
+        outs32 = _plan_window_step(
             self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
             self.cost, self.load + 0.0, self.rem_cap | 0, self.dep_succ,
             self.dep_fail, self.dep_block, self.dep_last_fire | 0, kx, kc,
-            self.rounds, impl, self._dep_enabled)
+            self.rounds, impl, self._dep_enabled, **self._warm_tkw())[0]
         np.asarray(outs32[0, 0])   # a data fetch truly syncs the tunnel
 
     def warm_escalation(self, epoch_s: int, factor: int = 4) -> int:
@@ -570,14 +716,22 @@ class TickPlanner:
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
             np.asarray([epoch_s - FRAMEWORK_EPOCH], np.int64),
         ], axis=1).astype(np.int32)
-        outs32, _o, _l, _r, _lf = _plan_window_step(
+        outs32 = _plan_window_step(
             self.table, jnp.asarray(fields_w), self.elig, self.exclusive,
             self.cost, self.load + 0.0, self.rem_cap | 0, self.dep_succ,
             self.dep_fail, self.dep_block, self.dep_last_fire | 0, k, k,
-            self.rounds, impl, self._dep_enabled)
+            self.rounds, impl, self._dep_enabled, **self._warm_tkw())[0]
         np.asarray(outs32[0, 0])
         self._warmed_single.add(k)
         return k
+
+    def _warm_tkw(self) -> dict:
+        """Tenant operands for the warm-compile paths: fresh token
+        copies so the warm run can't mutate carried bucket state."""
+        if not self._tenants_enabled:
+            return {}
+        return dict(self._tenant_args(),
+                    tb_tokens=self.tb_tokens + 0.0, use_tenants=True)
 
     def snap_escalation(self, want: int) -> int:
         """Smallest warmed single-second bucket >= ``want``, else

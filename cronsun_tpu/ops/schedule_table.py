@@ -84,6 +84,12 @@ class ScheduleTable:
     has_dep: jax.Array   # bool
     dep_policy: jax.Array  # int32 (POLICY_SKIP/FIRE/HOLD)
     dep_cols: jax.Array    # int32 [capacity, MAX_DEPS]
+    # multi-tenant control plane: small tenant id per row (0 = the
+    # default, never-limited tenant).  The admission pass itself runs
+    # off the planner's host-snapshotted permutation (ops/tenancy.py),
+    # so this column is the durable row->tenant record (it rides
+    # checkpoints with the table) rather than a per-tick operand.
+    tenant: jax.Array      # int32
 
     @property
     def capacity(self) -> int:
@@ -94,7 +100,7 @@ _NO_DEPS = (DEP_EMPTY,) * MAX_DEPS
 
 
 def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
-             paused: bool = False) -> dict:
+             paused: bool = False, tenant: int = 0) -> dict:
     """Host-side row dict for one spec (strings are parsed)."""
     if isinstance(spec, str):
         spec = parse(spec)
@@ -106,7 +112,7 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
             period=period,
             phase_mod=int((phase_epoch_s - FRAMEWORK_EPOCH) % period),
             active=True, paused=paused,
-            has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
+            has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant)
     sec_lo, sec_hi = _split64(spec.second)
     min_lo, min_hi = _split64(spec.minute)
     return dict(
@@ -115,10 +121,11 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
         month=spec.month & _MASK32, dow=spec.dow & _MASK32,
         dom_star=spec.dom_star, dow_star=spec.dow_star,
         is_every=False, period=1, phase_mod=0, active=True, paused=paused,
-        has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
+        has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant)
 
 
-def make_dep_row(upstream_rows, policy: int, paused: bool = False) -> dict:
+def make_dep_row(upstream_rows, policy: int, paused: bool = False,
+                 tenant: int = 0) -> dict:
     """Row dict for a dep-triggered job: cron masks empty (the row never
     time-fires), dep columns padded to MAX_DEPS with DEP_EMPTY.
     ``upstream_rows`` entries are table rows or DEP_BROKEN for
@@ -127,7 +134,7 @@ def make_dep_row(upstream_rows, policy: int, paused: bool = False) -> dict:
     cols = tuple(ups) + (DEP_EMPTY,) * (MAX_DEPS - len(ups))
     row = dict(_INACTIVE_ROW)
     row.update(active=True, paused=paused, has_dep=True,
-               dep_policy=int(policy), dep_cols=cols)
+               dep_policy=int(policy), dep_cols=cols, tenant=int(tenant))
     return row
 
 
@@ -137,6 +144,7 @@ _DTYPES = dict(
     dom_star=np.bool_, dow_star=np.bool_, is_every=np.bool_,
     period=np.int32, phase_mod=np.int32, active=np.bool_, paused=np.bool_,
     has_dep=np.bool_, dep_policy=np.int32, dep_cols=np.int32,
+    tenant=np.int32,
 )
 
 # per-field trailing shape beyond [capacity] (only the dep matrix is 2-D)
@@ -146,7 +154,7 @@ _INACTIVE_ROW = dict(
     sec_lo=0, sec_hi=0, min_lo=0, min_hi=0, hour=0, dom=0, month=0, dow=0,
     dom_star=False, dow_star=False, is_every=False, period=1, phase_mod=0,
     active=False, paused=False,
-    has_dep=False, dep_policy=0, dep_cols=_NO_DEPS)
+    has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=0)
 
 
 def build_table(specs: List[Union[CronSpec, EverySpec, str]],
