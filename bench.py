@@ -629,6 +629,28 @@ def main():
         except Exception as e:  # noqa: BLE001
             detail["dag_bench_error"] = str(e)
 
+    # ---- trace plane: per-stage lag + sampling overhead @ 50k --------------
+    # Fire-lifecycle tracing at 50k jobs x 512 nodes: a live mini-fleet
+    # answers "which stage owns fire latency" from the trace plane
+    # itself (trace_stage_p99_ms, one key per waterfall stage), and a
+    # paired-interleave gate pins head-sampling's scheduler cost at
+    # < 2% step p99 vs CRONSUN_TRACE=off (trace_overhead_* keys).
+    if not quick:
+        log("trace plane: stage breakdown + overhead @ 50k x 512")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--trace", "--jobs", "50000", "--nodes", "512",
+                 "--seconds", "8"],
+                capture_output=True, text=True, timeout=1800, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["trace_bench_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["trace_bench_error"] = str(e)
+
     # ---- multi-tenant admission: skewed-tenant workload --------------------
     # Zipf victim tenants + one noisy tenant offering 10x its fire-rate
     # quota: the noisy tenant must clamp to its quota (±5%) with loud

@@ -629,6 +629,115 @@ def cmd_tenant_rm(api, args):
     print(f"quota removed for tenant {args.id!r} (now unlimited)")
 
 
+def _fmt_ms(v):
+    return f"{v:.1f}ms" if isinstance(v, (int, float)) else "-"
+
+
+def cmd_trace_show(api, args):
+    """Render one fire's waterfall: per executing node, the six stage
+    durations between the scheduled tick and the flushed record."""
+    res = api.call("GET",
+                   f"/v1/trace/{urllib.parse.quote(args.job)}/"
+                   f"{int(args.second)}")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    print(f"trace {res['trace_id']}  job {res.get('group', '')}/"
+          f"{res['job']}  second {res['second']}  "
+          f"total {_fmt_ms(res['total_ms'])}")
+    from ..trace import STAGES
+    rows = []
+    for nd in res["nodes"]:
+        st = nd.get("stages", {})
+        rows.append([nd["node"], "ok" if nd.get("ok") else "FAIL"]
+                    + [_fmt_ms(st[s]) if s in st else "-"
+                       for s in STAGES]
+                    + [_fmt_ms(nd.get("total_ms"))])
+    table(rows, ["NODE", "RESULT"] + [s.upper() for s in STAGES]
+          + ["TOTAL"])
+
+
+def cmd_trace_top(api, args):
+    """Slowest recent traces (by total or one stage) from the logd
+    trace rings."""
+    q = f"?n={args.n}"
+    if args.stage:
+        q += f"&stage={urllib.parse.quote(args.stage)}"
+    res = api.call("GET", f"/v1/trace/top{q}")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    rows = []
+    for t in res["traces"]:
+        worst = max(t.get("nodes", []),
+                    key=lambda nd: nd.get("total_ms", 0), default={})
+        st = worst.get("stages", {})
+        slowest = max(st.items(), key=lambda kv: kv[1])[0] if st else "-"
+        rows.append([t.get("grp", ""), t["job"], t["sec"],
+                     len(t.get("nodes", [])), _fmt_ms(t["total_ms"]),
+                     slowest])
+    table(rows, ["GROUP", "JOB", "SECOND", "NODES", "TOTAL",
+                 "SLOWEST STAGE"])
+    if not rows:
+        print("(no traces in the ring — sampling off, or no recent "
+              "fires)")
+
+
+def cmd_slos(api, args):
+    res = api.call("GET", "/v1/slos")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    rows = [[s["name"], s.get("scope") or "global", s["target"],
+             s.get("latency_ms") or "-"] for s in res]
+    table(rows, ["SLO", "SCOPE", "TARGET", "LATENCY_MS"])
+
+
+def cmd_slo_show(api, args):
+    """Current burn rates + alert states from the web tier's engine."""
+    res = api.call("GET", "/v1/slo/status")
+    if args.json:
+        print(json.dumps(res, indent=2))
+        return
+    if res.get("engine") != "on":
+        print("slo engine: off (web server started without one)")
+        return
+    rows = []
+    for name in sorted(res["slos"]):
+        st = res["slos"][name]
+        b = st.get("burn", {})
+        rows.append([name, st.get("scope") or "global",
+                     st.get("target"),
+                     b.get("5m", 0), b.get("1h", 0),
+                     b.get("30m", 0), b.get("6h", 0),
+                     st.get("alert") or "-"])
+    table(rows, ["SLO", "SCOPE", "TARGET", "BURN 5M", "1H", "30M",
+                 "6H", "ALERT"])
+    stats = res.get("stats") or {}
+    if stats:
+        print(f"evals={stats.get('slo_evals_total', 0)} "
+              f"alerts={stats.get('slo_alerts_total', 0)} "
+              f"notices={stats.get('slo_notices_total', 0)} "
+              f"recoveries={stats.get('slo_recoveries_total', 0)}")
+
+
+def cmd_slo_set(api, args):
+    body = {"name": args.name, "scope": args.scope or "",
+            "target": args.target}
+    if args.latency_ms is not None:
+        body["latency_ms"] = args.latency_ms
+    res = api.call("PUT", "/v1/slo", body=body)
+    print(f"slo {res['name']!r} set: scope="
+          f"{res.get('scope') or 'global'} target={res['target']}"
+          + (f" latency<={res['latency_ms']}ms"
+             if res.get("latency_ms") else ""))
+
+
+def cmd_slo_rm(api, args):
+    api.call("DELETE", f"/v1/slo/{urllib.parse.quote(args.name)}")
+    print(f"slo {args.name!r} removed")
+
+
 def cmd_dag_show(api, args):
     """Render the group's dependency graph: topological order, each
     job's upstreams, misfire policy and in-flight cap, plus broken
@@ -971,6 +1080,46 @@ def build_parser() -> argparse.ArgumentParser:
     p = tsub.add_parser("rm", help="remove a tenant's quota (admin)")
     p.set_defaults(fn=cmd_tenant_rm)
     p.add_argument("id")
+
+    tr = sub.add_parser("trace", help="fire-lifecycle trace plane")
+    trsub = tr.add_subparsers(dest="tracecmd", required=True)
+    p = trsub.add_parser("show",
+                         help="one fire's waterfall: per-stage "
+                              "durations tick -> record")
+    p.set_defaults(fn=cmd_trace_show)
+    p.add_argument("job", help="job id")
+    p.add_argument("second", type=int, help="scheduled epoch second")
+    p = trsub.add_parser("top",
+                         help="slowest recent traces (by total or one "
+                              "stage)")
+    p.set_defaults(fn=cmd_trace_top)
+    p.add_argument("--n", type=int, default=10)
+    p.add_argument("--stage", default="",
+                   help="sort by one stage: sched publish claim queue "
+                        "run record")
+
+    add("slos", cmd_slos, "list SLO specs")
+    slo = sub.add_parser("slo", help="SLO burn-rate engine")
+    ssub = slo.add_subparsers(dest="slocmd", required=True)
+    p = ssub.add_parser("show", help="current burn rates + alert "
+                                     "states")
+    p.set_defaults(fn=cmd_slo_show)
+    p = ssub.add_parser("set", help="create/update an SLO (admin)")
+    p.set_defaults(fn=cmd_slo_set)
+    p.add_argument("name")
+    p.add_argument("--scope", default="",
+                   help="'' (global), tenant:<name>, or "
+                        "chain:<group>/<job>")
+    p.add_argument("--target", type=float, default=0.999,
+                   help="good-fire ratio objective (default 0.999)")
+    p.add_argument("--latency-ms", dest="latency_ms", type=float,
+                   default=None,
+                   help="runs longer than this count as bad (pick a "
+                        "histogram bucket bound; 0/omitted = "
+                        "success-only SLO)")
+    p = ssub.add_parser("rm", help="remove an SLO (admin)")
+    p.set_defaults(fn=cmd_slo_rm)
+    p.add_argument("name")
 
     dag = sub.add_parser("dag", help="workflow DAG views")
     dsub = dag.add_subparsers(dest="dagcmd", required=True)

@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                          "merge back in.  0 (default) = no day aging; "
                          "CRONSUN_TIERING=off also disables the hot "
                          "read mirrors entirely")
+    ap.add_argument("--health-port", type=int, default=0, metavar="P",
+                    help="serve /healthz + /readyz on this port "
+                         "(readiness: every shard accepting TCP + the "
+                         "WAL/DB directory writable; 0 disables)")
     ap.add_argument("--shards", type=int, default=1, metavar="N",
                     help="serve a RESULT-PLANE SHARD SET: N logd "
                          "servers on ports port..port+N-1, each with "
@@ -118,6 +122,15 @@ def main(argv=None) -> int:
                   args.shards, addrs, db_base,
                   " (tls)" if sslctx is not None else "")
     print(f"READY {addrs}", flush=True)
+    if args.health_port:
+        from ..health import HealthServer, tcp_accept_check, \
+            wal_writable_check
+        checks = {"wal": wal_writable_check(
+            None if db_base == ":memory:" else db_base)}
+        for i, s in enumerate(servers):
+            checks[f"shard{i}"] = tcp_accept_check(s.host, s.port)
+        health = HealthServer(checks, port=args.health_port).start()
+        events.on(events.EXIT, health.stop)
     for s in servers:
         events.on(events.EXIT, s.stop)
     if watcher:

@@ -32,7 +32,8 @@ def main(argv=None) -> int:
     agent = NodeAgent(store, sink, node_id=args.node_id, ks=ks,
                       ttl=cfg.node_ttl, proc_ttl=cfg.proc_ttl,
                       lock_ttl=cfg.lock_ttl, proc_req=cfg.proc_req,
-                      on_fatal=on_fatal)
+                      on_fatal=on_fatal,
+                      trace_shift=cfg.trace_sample_shift)
     try:
         agent.start()
     except DuplicateNode as e:

@@ -5,6 +5,7 @@
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -97,6 +98,10 @@ def main(argv=None) -> int:
                          "reconcile (O(fired-bucket) exchange per round) "
                          "instead of bucket-sharded bidding (O(nodes)); "
                          "every rank of a multi-host mesh must agree")
+    ap.add_argument("--health-port", type=int, default=0, metavar="P",
+                    help="serve /healthz + /readyz on this port "
+                         "(readiness: leader lease / watches / step "
+                         "loop; 0 disables)")
     args = ap.parse_args(argv)
     if args.mesh2d is not None:
         try:
@@ -196,8 +201,24 @@ def main(argv=None) -> int:
         checkpoint_interval_s=float(cfg.checkpoint_interval),
         checkpoint_delta=cfg.checkpoint_delta,
         delta_max_chain=cfg.checkpoint_rebase_chain,
-        delta_max_bytes=cfg.checkpoint_rebase_bytes)
+        delta_max_bytes=cfg.checkpoint_rebase_bytes,
+        trace_shift=cfg.trace_sample_shift)
     sched.start()
+    health = None
+    if args.health_port:
+        from ..health import HealthServer
+
+        def leader_check():
+            h = sched.health()
+            return h["leader"], json.dumps(h)
+
+        def watches_check():
+            h = sched.health()
+            return h["watches_open"] > 0 and h["loop_alive"], \
+                json.dumps(h)
+        health = HealthServer(
+            {"leader": leader_check, "watches": watches_check},
+            port=args.health_port).start()
     log.infof("cronsun-sched %s up (store %s, tz %s)",
               args.node_id, args.store, cfg.timezone)
     print(f"READY {args.node_id}", flush=True)
@@ -208,6 +229,8 @@ def main(argv=None) -> int:
                   store.close)
     else:
         events.on(events.EXIT, sched.stop, store.close)
+    if health is not None:
+        events.on(events.EXIT, health.stop)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()

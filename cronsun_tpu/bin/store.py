@@ -54,6 +54,10 @@ def main(argv=None) -> int:
                          "(FILE.s<i>) — clients connect with the "
                          "comma-joined address list and route by the "
                          "deterministic key hash (store/sharded.py)")
+    ap.add_argument("--health-port", type=int, default=0, metavar="P",
+                    help="serve /healthz + /readyz on this port "
+                         "(readiness: every shard accepting TCP + the "
+                         "WAL directory writable; 0 disables)")
     args = ap.parse_args(argv)
     if args.shards < 1:
         ap.error(f"--shards must be >= 1 (got {args.shards})")
@@ -131,6 +135,14 @@ def _serve_shard_set(args, token, sslctx, watcher) -> int:
         log.infof("cronsun-store serving %d shards on %s%s", args.shards,
                   addrs, " (tls)" if sslctx is not None else "")
     print(f"READY {addrs}", flush=True)
+    if args.health_port:
+        from ..health import HealthServer, tcp_accept_check, \
+            wal_writable_check
+        checks = {"wal": wal_writable_check(args.wal)}
+        for i, s in enumerate(servers):
+            checks[f"shard{i}"] = tcp_accept_check(s.host, s.port)
+        health = HealthServer(checks, port=args.health_port).start()
+        events.on(events.EXIT, health.stop)
     for s in servers:
         events.on(events.EXIT, s.stop)
     if watcher:

@@ -30,11 +30,17 @@ def main(argv=None) -> int:
     store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls,
                           prefix=cfg.prefix)
     sink = make_sink(cfg, args.logsink)
+    # SLO engine: multi-window burn-rate evaluation over the agents'
+    # scraped execution counters, paging through the noticer this
+    # process hosts (web/slo.py)
+    from ..web.slo import SloEngine
+    slo = SloEngine(store, ks=ks, interval_s=cfg.slo_eval_s).start()
     api = ApiServer(store, sink, ks=ks, security=cfg.security,
                     alarm=cfg.mail.enable,
                     auth_enabled=cfg.web.auth_enabled,
                     host=args.host or cfg.web.host,
-                    port=cfg.web.port if args.port is None else args.port)
+                    port=cfg.web.port if args.port is None else args.port,
+                    slo_engine=slo)
     api.start()
 
     if cfg.mail.enable and cfg.mail.host:
@@ -51,7 +57,7 @@ def main(argv=None) -> int:
     log.infof("cronsun-web on %s:%d (store %s)", api.host, api.port,
               args.store)
     print(f"READY {api.host}:{api.port}", flush=True)
-    events.on(events.EXIT, noticer.stop, api.stop, store.close)
+    events.on(events.EXIT, noticer.stop, api.stop, slo.stop, store.close)
     if watcher:
         events.on(events.EXIT, watcher.stop)
     events.wait()

@@ -111,6 +111,17 @@ class Config:
     checkpoint_rebase_bytes: int = 64 << 20
                                 # ... or once the chain's on-disk bytes
                                 # cross this bound
+    trace_sample_shift: int = 8
+                                # fire-lifecycle tracing: head-sample
+                                # fires whose trace id's low SHIFT bits
+                                # are zero (8 = 1/256).  0 samples every
+                                # fire, -1 disables scheduler stamping;
+                                # CRONSUN_TRACE=off kills the whole
+                                # plane.  Per-job ``trace: true`` and
+                                # failed executions sample regardless.
+    slo_eval_s: int = 15        # web-tier SLO engine evaluation cadence
+                                # (burn-rate windows are 5m/30m/1h/6h;
+                                # the scrape ring keeps ~6h of samples)
     compile_cache: str = "~/.cache/cronsun-tpu/xla"
                                 # persistent XLA compilation cache: a
                                 # restarted scheduler (or a cold failover

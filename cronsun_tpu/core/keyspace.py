@@ -204,3 +204,15 @@ class Keyspace:
 
     def tenant_job_key(self, tenant: str, group: str, job_id: str) -> str:
         return f"{self.tenant_jobs(tenant)}{group}/{job_id}"
+
+    # -- SLO engine (trace plane) ------------------------------------------
+
+    @property
+    def slo(self) -> str:
+        """Declarative SLO records (core.models.SloSpec JSON): the web
+        tier lists the prefix each evaluation tick and alerts on
+        multi-window burn rates over the scraped execution counters."""
+        return f"{self.prefix}/slo/"
+
+    def slo_key(self, name: str) -> str:
+        return f"{self.slo}{name}"

@@ -202,6 +202,10 @@ class Job:
     # workflow DAG trigger: when set, the job fires on upstream success
     # instead of a cron mask (rules keep carrying placement)
     deps: Optional[DepSpec] = None
+    # trace plane: force head-sampling of every fire of this job
+    # regardless of the fleet's trace_sample_shift (failure runs are
+    # always sampled either way)
+    trace: bool = False
 
     # ---- validation (reference job.go:502-537) ---------------------------
 
@@ -228,6 +232,7 @@ class Job:
             raise ValidationError(f"unknown kind {self.kind}")
         if not _clean(self.command):
             raise ValidationError("command required")
+        self.trace = bool(self.trace)
         if isinstance(self.deps, dict):
             self.deps = DepSpec.from_dict(self.deps)
         if self.deps is not None:
@@ -279,6 +284,9 @@ class Job:
         if not self.tenant:
             # wire compat: default-tenant jobs keep the pre-tenancy bytes
             d.pop("tenant", None)
+        if not self.trace:
+            # wire compat: untraced jobs keep the pre-trace bytes
+            d.pop("trace", None)
         return json.dumps(d, separators=(",", ":"))
 
     _FIELDS = None   # lazily cached field-name set (NOT annotated: an
@@ -383,6 +391,73 @@ class Account:
 
     @classmethod
     def from_json(cls, s: str) -> "Account":
+        d = json.loads(s)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# SLO scopes — which slice of the fleet's executions a spec covers.
+# The scope string doubles as the counter key agents publish in their
+# metrics snapshots ("" global, "t:<tenant>", "c:<group>/<job>").
+SLO_SCOPE_GLOBAL = ""
+
+
+@dataclasses.dataclass
+class SloSpec:
+    """Declarative service-level objective, stored under
+    ``slo/<name>``.  ``target`` is the good-fire ratio (e.g. 0.999);
+    ``latency_ms`` > 0 additionally counts an execution as bad when its
+    run time exceeds the threshold (snapped DOWN to a histogram bucket
+    bound — pick thresholds from trace.BUCKETS_MS for exactness).
+
+    ``scope`` picks the slice: "" = every execution fleet-wide;
+    ``tenant:<name>`` = one tenant's executions; ``chain:<group>/<job>``
+    = one DAG chain, keyed by its terminal (dep-triggered) job.
+
+    The web tier evaluates each spec as multi-window multi-burn-rate
+    alerts (Google SRE workbook): fast page at burn >= 14.4 over BOTH
+    5m and 1h, slow page at burn >= 6 over BOTH 30m and 6h, where
+    burn = bad_fraction / (1 - target)."""
+    name: str = ""
+    scope: str = SLO_SCOPE_GLOBAL
+    target: float = 0.999
+    latency_ms: float = 0.0
+
+    def validate(self):
+        self.name = _clean(self.name)
+        if not self.name:
+            raise ValidationError("slo name required")
+        if "/" in self.name:
+            raise ValidationError("slo name must not contain '/'")
+        self.scope = _clean(self.scope)
+        if self.scope:
+            kind, _, rest = self.scope.partition(":")
+            if kind not in ("tenant", "chain") or not rest:
+                raise ValidationError(
+                    f"slo scope {self.scope!r}: expected '', "
+                    "'tenant:<name>' or 'chain:<group>/<job>'")
+            if kind == "chain" and "/" not in rest:
+                raise ValidationError(
+                    f"slo chain scope {rest!r}: expected <group>/<job>")
+        if not (0.0 < self.target < 1.0):
+            raise ValidationError("slo target must be in (0, 1)")
+        if self.latency_ms < 0:
+            raise ValidationError("slo latency_ms must be >= 0")
+
+    @property
+    def counter_scope(self) -> str:
+        """The agent-snapshot counter key this spec reads."""
+        if not self.scope:
+            return ""
+        kind, _, rest = self.scope.partition(":")
+        return ("t:" + rest) if kind == "tenant" else ("c:" + rest)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self),
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "SloSpec":
         d = json.loads(s)
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})

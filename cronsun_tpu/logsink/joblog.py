@@ -179,6 +179,11 @@ class JobLogStore:
         self._cold_boundary = 0            # ids <= this live in segments
         self._segments: list = []          # tiering.scan_segments index
         self._age_mu = threading.Lock()    # one age-out pass at a time
+        # trace plane: bounded span ring + per-day spill beside the
+        # tiered store's segment directory (file-backed sinks only)
+        from .traces import TraceStore
+        self.traces = TraceStore(
+            spill_dir=None if path == ":memory:" else path + ".traces")
         with self._lock:
             if path != ":memory:":
                 self._db.execute("PRAGMA journal_mode=WAL")
@@ -220,8 +225,33 @@ class JobLogStore:
             self._h_recs.append(self._row_to_rec(row, False))
 
     def close(self):
+        self.traces.close()
         with self._lock:
             self._db.close()
+
+    # ---- trace plane (fire-lifecycle spans) ------------------------------
+
+    def trace_ingest(self, spans: list) -> int:
+        t0 = time.perf_counter_ns()
+        n = self.traces.ingest(spans)
+        self._op_record("trace_ingest", t0)
+        return n
+
+    def trace_get(self, job_id: str, epoch_s: int) -> list:
+        """Raw span dicts of one (job, second) trace — the web tier
+        assembles the waterfall (trace.assemble)."""
+        t0 = time.perf_counter_ns()
+        out = self.traces.get(job_id, int(epoch_s))
+        self._op_record("trace_get", t0)
+        return out
+
+    def trace_top(self, n: int = 256) -> list:
+        return self.traces.top(int(n))
+
+    def trace_stats(self) -> dict:
+        """Cumulative per-stage histogram counters (fixed fleet-wide
+        buckets — addable across shards and replicas)."""
+        return self.traces.stats()
 
     # ---- op timing (delegates to the shared metrics.OpStats) -------------
 
@@ -333,7 +363,8 @@ class JobLogStore:
                                 or len(self._h_recs) > self._hot_max):
             self._h_recs.popleft()
 
-    def create_job_logs(self, recs, idem: str = "") -> list:
+    def create_job_logs(self, recs, idem: str = "",
+                        spans: Optional[list] = None) -> list:
         """Bulk insert: the agents' record flushers write whole batches
         in ONE transaction (one fsync).  The per-record side writes
         COALESCE per batch — one stat UPDATE per (day) touched plus one
@@ -346,8 +377,13 @@ class JobLogStore:
         transaction sees.  Returns the assigned row ids in order.
         ``idem`` is accepted for surface parity with the networked
         sink; in-process writes have no reply to lose, so it is
-        unused."""
+        unused.  ``spans`` is the trace plane's piggybacked sidecar —
+        ingested into the trace ring/spill before the row writes (its
+        merge is LWW-idempotent, so ordering vs the transaction does
+        not matter)."""
         del idem
+        if spans:
+            self.trace_ingest(spans)
         if not recs:
             return []
         t0 = time.perf_counter_ns()

@@ -45,7 +45,8 @@ _PLAIN_OPS = ("get_log", "stat_overall", "stat_day", "stat_days",
               "upsert_node", "set_node_alived", "get_nodes", "get_node",
               "upsert_account", "get_account", "list_accounts",
               "delete_account", "op_stats", "revision", "logmap",
-              "age_out", "tier_info")
+              "age_out", "tier_info",
+              "trace_get", "trace_top", "trace_stats")
 
 
 def _rec_wire(rec: Optional[LogRecord]):
@@ -122,9 +123,10 @@ class _Conn(LineJsonHandler):
                                               else None)})
             elif op == "create_job_logs":
                 self._send({"i": rid,
-                            "r": self._create_bulk(sink, args[0],
-                                                   args[1] if len(args) > 1
-                                                   else None)})
+                            "r": self._create_bulk(
+                                sink, args[0],
+                                args[1] if len(args) > 1 else None,
+                                args[2] if len(args) > 2 else None)})
             elif op == "query_logs":
                 if not self._latest_reply_cached(sink, rid, args[0]):
                     recs, total = sink.query_logs(**args[0])
@@ -193,11 +195,16 @@ class _Conn(LineJsonHandler):
         ent["done"].set()
         return result
 
-    def _create_bulk(self, sink: JobLogStore, wires, idem):
+    def _create_bulk(self, sink: JobLogStore, wires, idem, spans=None):
         """Bulk insert (agent record flushers): one idempotency token
         covers the whole batch — a retried batch whose first attempt
-        committed replays the original ids, never double-inserts."""
+        committed replays the original ids, never double-inserts.  The
+        trace-span sidecar rides INSIDE the idempotent thunk, so a
+        replayed batch does not double-count the stage histograms."""
         recs = [_rec_unwire(w) for w in wires]      # parse before reserving
+        if spans:
+            return self._idempotent(
+                idem, lambda: sink.create_job_logs(recs, spans=spans))
         return self._idempotent(idem, lambda: sink.create_job_logs(recs))
 
     def _create(self, sink: JobLogStore, wire, idem):
@@ -400,17 +407,26 @@ class RemoteJobLogStore:
         rec.id = self._call("create_job_log", _rec_wire(rec),
                             idem or uuid.uuid4().hex)
 
-    def create_job_logs(self, recs: List[LogRecord], idem: str = ""):
+    def create_job_logs(self, recs: List[LogRecord], idem: str = "",
+                        spans: Optional[list] = None):
         """Bulk insert in one round trip (one idempotency token per
         batch) — the agents' record flushers use this so a 10k-order
         burst is tens of calls, not 10k.  Callers that re-flush a
         failed batch pass a stable ``idem`` so an applied-but-reply-
-        lost write dedups server-side instead of double-inserting."""
-        if not recs:
+        lost write dedups server-side instead of double-inserting.
+        ``spans`` is the trace plane's piggybacked sidecar: shipped as
+        a third wire argument (older servers ignore it)."""
+        if not recs and not spans:
             return
-        ids = self._call("create_job_logs", [_rec_wire(r) for r in recs],
-                         idem or uuid.uuid4().hex)
-        for r, i in zip(recs, ids):
+        if spans:
+            ids = self._call("create_job_logs",
+                             [_rec_wire(r) for r in recs],
+                             idem or uuid.uuid4().hex, spans)
+        else:
+            ids = self._call("create_job_logs",
+                             [_rec_wire(r) for r in recs],
+                             idem or uuid.uuid4().hex)
+        for r, i in zip(recs, ids or []):
             r.id = i
 
     def query_logs(self, **kw) -> Tuple[List[LogRecord], int]:
@@ -462,6 +478,17 @@ class RemoteJobLogStore:
         if n is None:
             return self._call("logmap")
         return self._call("logmap", n, hash)
+
+    # -- trace plane -------------------------------------------------------
+
+    def trace_get(self, job_id: str, epoch_s: int) -> list:
+        return self._call("trace_get", job_id, int(epoch_s))
+
+    def trace_top(self, n: int = 256) -> list:
+        return self._call("trace_top", int(n))
+
+    def trace_stats(self) -> dict:
+        return self._call("trace_stats")
 
     def upsert_node(self, node_id: str, doc: str, alived: bool):
         self._call("upsert_node", node_id, doc, alived)

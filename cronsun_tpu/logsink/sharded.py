@@ -274,7 +274,8 @@ class ShardedJobLogStore:
             rec.id = encode_log_id(rec.id, si, self.nshards)
         return rec.id
 
-    def create_job_logs(self, recs, idem: str = "") -> list:
+    def create_job_logs(self, recs, idem: str = "",
+                        spans: Optional[list] = None) -> list:
         """Split the batch by job token, fan the sub-batches out
         concurrently — one bulk RPC per shard touched, each riding a
         per-shard idempotency token DERIVED from the batch token
@@ -286,19 +287,33 @@ class ShardedJobLogStore:
         failing (after every sub-batch settles), matching the
         unsharded client's all-or-retry contract."""
         recs = list(recs)
-        if not recs:
+        # trace spans route by the SAME job token as their records, so
+        # a trace's spans co-locate with its job's history
+        span_groups: Dict[int, list] = {}
+        for sp in spans or []:
+            jid = sp.get("job") if isinstance(sp, dict) else None
+            if isinstance(jid, str):
+                span_groups.setdefault(self._idx(jid), []).append(sp)
+        if not recs and not span_groups:
             return []
         groups: Dict[int, list] = {}
         for pos, r in enumerate(recs):
             groups.setdefault(self._idx(r.job_id), []).append((pos, r))
+        for si in span_groups:
+            groups.setdefault(si, [])
 
         def send(si, group):
             sub = [r for _p, r in group]
             # no caller token -> each shard's wire client mints its own
             # per-call token (a bare ".s<i>" suffix would be one shared
             # token for EVERY token-less batch — a dedup collision)
-            self.shards[si].create_job_logs(
-                sub, idem=f"{idem}.s{si}" if idem else "")
+            sp = span_groups.get(si)
+            if sp:
+                self.shards[si].create_job_logs(
+                    sub, idem=f"{idem}.s{si}" if idem else "", spans=sp)
+            else:
+                self.shards[si].create_job_logs(
+                    sub, idem=f"{idem}.s{si}" if idem else "")
         self._fan([lambda si=si, g=g: send(si, g)
                    for si, g in groups.items()])
         for si, group in groups.items():
@@ -487,6 +502,47 @@ class ShardedJobLogStore:
 
     def logmap(self, n=None, hash=None):
         return self.shards[0].logmap(n, hash)
+
+    # ---- trace plane -----------------------------------------------------
+
+    def trace_get(self, job_id: str, epoch_s: int) -> list:
+        """One trace lives on ONE shard (spans route by job token with
+        their records) — a direct read, no scatter."""
+        return self.shards[self._idx(job_id)].trace_get(job_id,
+                                                        int(epoch_s))
+
+    def trace_top(self, n: int = 256) -> list:
+        """Recent-trace summaries from every shard, concatenated (the
+        web tier sorts); a degraded shard contributes nothing."""
+        parts = self._fan([
+            self._tolerant(i, lambda s=s, m=n: s.trace_top(m),
+                           default=[])
+            for i, s in enumerate(self.shards)])
+        return [t for part in parts for t in (part or [])]
+
+    def trace_stats(self) -> dict:
+        """Per-stage histogram counters SUMMED across shards — sound
+        because the bucket bounds are fixed fleet-wide."""
+        parts = self._fan([
+            self._tolerant(i, lambda s=s: s.trace_stats(), default={})
+            for i, s in enumerate(self.shards)])
+        merged: dict = {"spans_total": 0, "stages": {}}
+        for part in parts:
+            if not part:
+                continue
+            merged["spans_total"] += part.get("spans_total", 0)
+            for stage, ent in (part.get("stages") or {}).items():
+                m = merged["stages"].setdefault(
+                    stage, {"buckets": [0] * len(ent.get("buckets", [])),
+                            "sum": 0.0, "count": 0})
+                b = m["buckets"]
+                for i, v in enumerate(ent.get("buckets", [])):
+                    if i >= len(b):
+                        b.extend([0] * (i + 1 - len(b)))
+                    b[i] += int(v)
+                m["sum"] = round(m["sum"] + ent.get("sum", 0.0), 3)
+                m["count"] += ent.get("count", 0)
+        return merged
 
     # ---- node mirror + accounts (tiny, single-writer: shard 0) -----------
 

@@ -96,6 +96,51 @@ class LatencyRing:
         return vals[min(len(vals) - 1, int(p * len(vals)))]
 
 
+def parse_exposition(text: str):
+    """Small Prometheus text-exposition parser used by the metrics
+    smoke tests (and anything that wants to machine-check /v1/metrics).
+    Returns {(name, frozenset(label items)): float}; raises ValueError
+    on any line that does not parse or any duplicate
+    (metric, label-set) series."""
+    import re
+    series: Dict[tuple, float] = {}
+    line_rx = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(-?[0-9.eE+-]+|'
+        r'[+-]?Inf|NaN)$')
+    lbl_rx = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        m = line_rx.match(ln)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {ln!r}")
+        name, labels_s, val = m.groups()
+        labels = {}
+        if labels_s:
+            consumed = 0
+            for lm in lbl_rx.finditer(labels_s):
+                if lm.start() != consumed:
+                    # unmatched bytes BETWEEN pairs (or before the
+                    # first) must fail too, not just trailing ones
+                    raise ValueError(
+                        f"bad label section in: {ln!r}")
+                labels[lm.group(1)] = lm.group(2)
+                consumed = lm.end()
+                if consumed < len(labels_s):
+                    if labels_s[consumed] != ",":
+                        raise ValueError(
+                            f"bad label separator in: {ln!r}")
+                    consumed += 1
+            if consumed < len(labels_s):
+                raise ValueError(f"trailing label garbage in: {ln!r}")
+        key = (name, frozenset(labels.items()))
+        if key in series:
+            raise ValueError(
+                f"duplicate series {name}{{{labels_s or ''}}}")
+        series[key] = float(val)
+    return series
+
+
 class MetricsPublisher:
     def __init__(self, store, ks: Keyspace, component: str, instance: str,
                  snapshot_fn: Callable[[], dict], interval_s: float = 10.0,

@@ -28,7 +28,7 @@ import time
 import uuid
 from typing import Callable, Dict, Optional, Tuple
 
-from .. import log
+from .. import log, trace as _trace
 from ..core import Group, Job, Keyspace, Node
 from ..core.backoff import REC_FLUSH
 from ..core.errors import DuplicateNode
@@ -96,7 +96,8 @@ class NodeAgent:
                  executor: Optional[Executor] = None,
                  clock: Callable[[], float] = time.time,
                  on_fatal: Optional[Callable] = None,
-                 dep_events: bool = True):
+                 dep_events: bool = True,
+                 trace_shift: int = _trace.DEFAULT_SHIFT):
         self.store = store
         self.sink = sink
         self.ks = ks or Keyspace()
@@ -192,8 +193,8 @@ class NodeAgent:
         # sink outage coverage
         self.rec_flush_max_fails = 30
         self._rec_flush_fails = 0
-        # (batch, batch idem token, per-record idem tokens)
-        self._rec_retry: Optional[Tuple[list, str, list]] = None
+        # (batch, batch idem token, per-record idem tokens, trace spans)
+        self._rec_retry: Optional[Tuple[list, str, list, list]] = None
         self._rec_retry_at = 0.0
         # sink-outage backstop: the live buffer stops growing here
         # (oldest dropped, counted) instead of absorbing the outage in
@@ -208,6 +209,7 @@ class NodeAgent:
         # INSIDE a conforming sink as "no idem support" and silently
         # disable dedup forever
         self._sink_takes_idem: Optional[bool] = None
+        self._sink_spans_ok: Optional[bool] = None
         # record-plane flush telemetry: flush count, records shipped,
         # and the largest batch one flush carried (the coalescing win
         # the bench reads as records-per-flush)
@@ -240,7 +242,24 @@ class NodeAgent:
                       "ack_flush_total": 0, "ack_flush_orders_total": 0,
                       "rec_flush_total": 0, "rec_flush_records_total": 0,
                       "rec_dropped_total": 0, "dep_events_total": 0,
-                      "dep_event_failures_total": 0}
+                      "dep_event_failures_total": 0,
+                      "trace_spans_total": 0, "trace_spans_dropped_total": 0}
+        # fire-lifecycle tracing: head-sampled (or failed, or per-job
+        # trace:true) executions buffer a span here and ride the record
+        # flush — zero extra RPCs on the hot path.  The verdict is the
+        # same deterministic trace-id hash the scheduler stamps bundles
+        # by; CRONSUN_TRACE=off (or trace_shift < 0) disables stamping.
+        self.trace_shift = trace_shift if _trace.armed() else -1
+        self._span_buf: list = []          # guarded by _rec_mu
+        self._span_buf_max = 10_000
+        # SLO counters: per-scope execution latency histogram + failure
+        # count over EVERY execution (not the sampled subset — burn
+        # rates must be unbiased).  Scopes: "" fleet-wide, "t:<tenant>"
+        # per tenant, "c:<group>/<job>" per DAG chain member.  The web
+        # tier's SLO engine scrapes these from the leased metrics
+        # snapshot and sums them across agents (fixed buckets add).
+        self._slo: Dict[str, list] = {}    # scope -> [count, fail,
+        self._slo_cap = 256                #           sum_ms, buckets]
         self._stats_mu = threading.Lock()
         # scheduled-second -> exec-start lag samples (the end-to-end
         # dispatch SLA), published as p50/p99 in the metrics snapshot
@@ -351,6 +370,17 @@ class NodeAgent:
         snap["rec_flush_max_batch"] = self._rec_flush_max_batch
         with self._rec_mu:
             snap["rec_buf"] = len(self._rec_buf)
+            snap["trace_span_buf"] = len(self._span_buf)
+        # per-scope SLO counters (nested — the generic /v1/metrics
+        # numeric-leaf renderer skips it; the web SLO engine and the
+        # exec-latency histogram renderer read it explicitly)
+        with self._stats_mu:
+            if self._slo:
+                snap["slo"] = {
+                    s: {"count": e[0], "fail": e[1],
+                        "sum_ms": round(e[2], 3), "buckets": list(e[3]),
+                        "fbuckets": list(e[4])}
+                    for s, e in self._slo.items()}
         return snap
 
     def _record_flushed(self, n: int):
@@ -493,12 +523,16 @@ class NodeAgent:
 
     def _execute(self, job: Job, epoch_s: int, fenced: bool,
                  use_gate: bool = True, order_key: Optional[str] = None,
-                 pre: Optional[tuple] = None):
+                 pre: Optional[tuple] = None,
+                 tr: Optional[tuple] = None):
         """Run one fire.  ``pre`` = (proc_registered, alone) marks an
         execution whose (job, second) fence — and KindAlone lifetime
         lock — were already settled by a bundle claim (_run_bundle): the
         fence/claim section is skipped, the rest (proc lifecycle,
-        executor, record) is identical."""
+        executor, record) is identical.  ``tr`` = (tb, recv, claim)
+        carries the trace-plane stamps collected upstream (any may be
+        None); this path adds its own claim stamp when it settles the
+        fence itself."""
         if not self._wait_until(epoch_s):
             return
         # the user-visible SLA: scheduled second -> execution start.
@@ -556,6 +590,9 @@ class NodeAgent:
                     self._bump("orders_consumed_total")
                 if not won:
                     return  # another node already ran this (job, second)
+                if self.trace_shift >= 0:
+                    tr = ((tr[0], tr[1]) if tr else (None, None)) \
+                        + (self.clock(),)
                 if with_proc:
                     proc_registered = True
                     with self._procs_mu:
@@ -610,14 +647,27 @@ class NodeAgent:
                 with self._procs_mu:
                     finished[0] = True
                     if self._procs.pop(proc_key, None) is not None:
-                        self.store.delete(proc_key)
+                        try:
+                            self.store.delete(proc_key)
+                        except Exception as e:  # noqa: BLE001
+                            # registry cleanup is bookkeeping — the
+                            # leased key ages out; a degraded store
+                            # must not destroy a FINISHED execution's
+                            # record (and span) below
+                            log.warnf("proc delete for %s failed "
+                                      "(lease will expire it): %s",
+                                      proc_key, e)
         finally:
             if alone is not None:
                 lease, stop = alone
                 stop.set()
-                self.store.revoke(lease)   # deletes the alone lock key
+                try:
+                    self.store.revoke(lease)  # deletes the alone lock
+                except Exception as e:  # noqa: BLE001 — TTL cleans up
+                    log.warnf("alone lock revoke failed (lease will "
+                              "expire it): %s", e)
             consume_order()                # consume the order regardless
-        self._record(job, res, epoch_s)
+        self._record(job, res, epoch_s, tr=tr)
         self._update_avg_time(job, res)
 
     _FENCE_GRACE = 60.0
@@ -860,12 +910,14 @@ class NodeAgent:
             if self.store.put_if_mod_rev(key, cur.to_json(), kv.mod_rev):
                 return
 
-    def _record(self, job: Job, res: ExecResult, epoch_s: int = 0):
+    def _record(self, job: Job, res: ExecResult, epoch_s: int = 0,
+                tr: Optional[tuple] = None):
         if res.skipped:
             return
         self._bump("execs_total")
         if not res.success:
             self._bump("execs_failed_total")
+        self._slo_observe(job, res)
         if self.dep_events and epoch_s:
             # the workflow DAG edge signal: last-write-wins per job, the
             # value carries the SCHEDULED round so N Common nodes
@@ -888,12 +940,19 @@ class NodeAgent:
             output=res.output if res.success
             else f"{res.output}\n[error] {res.error}".strip(),
             success=res.success, begin_ts=res.begin_ts, end_ts=res.end_ts)
+        span = self._trace_span(job, res, epoch_s, tr)
         # batch the result-store write: records buffer here and a
         # flusher writes whole batches per interval (create_job_logs —
         # one round trip and one sink transaction per batch, not per
         # execution)
         with self._rec_mu:
             self._rec_buf.append(rec)
+            if span is not None:
+                self._span_buf.append(span)
+                if len(self._span_buf) > self._span_buf_max:
+                    drop = len(self._span_buf) - self._span_buf_max
+                    del self._span_buf[:drop]
+                    self._bump("trace_spans_dropped_total", drop)
             # trim in 4096-record chunks: a per-append del of the list
             # head is an O(buffer) memmove inside _rec_mu on every
             # record once the cap pins — chunking amortizes it away
@@ -923,6 +982,65 @@ class NodeAgent:
                    "to": job.to}
             self.store.put(self.ks.noticer_key(self.id),
                            json.dumps(msg, separators=(",", ":")))
+
+    def _trace_span(self, job: Job, res: ExecResult, epoch_s: int,
+                    tr: Optional[tuple]) -> Optional[dict]:
+        """Build this execution's trace span, or None when the fire is
+        not sampled.  Head-sampling re-derives the scheduler's verdict
+        from the same deterministic hash; failed executions and
+        ``trace: true`` jobs sample regardless (tail capture — their
+        scheduler stages may be absent when the head said no)."""
+        if self.trace_shift < 0 or not epoch_s:
+            return None
+        tid = _trace.trace_id(job.id, epoch_s)
+        if not (getattr(job, "trace", False)
+                or not res.success
+                or _trace.head_sampled(tid, self.trace_shift)):
+            return None
+        ts = {"start": res.begin_ts, "end": res.end_ts}
+        if tr is not None:
+            for name, v in zip(("b", "recv", "claim"), tr):
+                if v is not None:
+                    ts[name] = v
+        span = {"tid": str(tid), "job": job.id, "grp": job.group,
+                "sec": int(epoch_s), "node": self.id,
+                "ok": bool(res.success), "ts": ts}
+        if job.tenant:
+            span["ten"] = job.tenant
+        self._bump("trace_spans_total")
+        return span
+
+    def _slo_observe(self, job: Job, res: ExecResult):
+        """Per-scope SLO counters over EVERY execution: latency
+        histogram (fixed fleet-wide buckets) + failure count + failure
+        latency histogram, keyed "" / "t:<tenant>" / "c:<group>/<job>"
+        (chain scope only for DAG members — bounded cardinality).  The
+        failure buckets let the burn-rate engine count slow SUCCESSES
+        exactly (bad = failed OR slow; without them a fast failure and
+        a slow success are indistinguishable in the joint)."""
+        import bisect
+        lat_ms = max(0.0, (res.end_ts - res.begin_ts)) * 1e3
+        bi = bisect.bisect_left(_trace.BUCKETS_MS, lat_ms)
+        scopes = [""]
+        if job.tenant:
+            scopes.append("t:" + job.tenant)
+        if job.deps is not None:
+            scopes.append(f"c:{job.group}/{job.id}")
+        with self._stats_mu:
+            for s in scopes:
+                ent = self._slo.get(s)
+                if ent is None:
+                    if len(self._slo) >= self._slo_cap:
+                        continue       # bounded; global "" always fits
+                    ent = self._slo[s] = [
+                        0, 0, 0.0, [0] * (len(_trace.BUCKETS_MS) + 1),
+                        [0] * (len(_trace.BUCKETS_MS) + 1)]
+                ent[0] += 1
+                if not res.success:
+                    ent[1] += 1
+                    ent[4][bi] += 1
+                ent[2] += lat_ms
+                ent[3][bi] += 1
 
     def _schedule_proc_put(self, fn) -> int:
         """Register a ProcReq-delayed proc put on the shared monitor
@@ -973,8 +1091,27 @@ class NodeAgent:
                 return
             self._flush_records()
 
+    def _sink_takes_spans(self) -> bool:
+        """Does the sink's bulk create accept the trace-span sidecar?
+        Resolved once from the signature (the _sink_idem_ok contract:
+        never from a caught TypeError)."""
+        if self._sink_spans_ok is None:
+            try:
+                import inspect
+                fn = getattr(self.sink, "create_job_logs", None)
+                if fn is None:
+                    self._sink_spans_ok = False
+                else:
+                    params = inspect.signature(fn).parameters
+                    self._sink_spans_ok = "spans" in params or any(
+                        p.kind == p.VAR_KEYWORD for p in params.values())
+            except (TypeError, ValueError):
+                self._sink_spans_ok = False
+        return self._sink_spans_ok
+
     def _send_records(self, batch: list, idem: str,
-                      toks: Optional[list] = None) -> bool:
+                      toks: Optional[list] = None,
+                      spans: Optional[list] = None) -> bool:
         """One write attempt.  On a mid-batch failure of the per-record
         path the already-written head is removed from ``batch`` (and
         ``toks``) in place, so a caller that re-buffers retries only
@@ -987,9 +1124,20 @@ class NodeAgent:
         token contract of logsink/serve.py) — the same guarantee the
         bulk path gets from the batch-level ``idem``."""
         written = 0
+        if spans:
+            # record-flush stamp: when this attempt ships the batch —
+            # re-stamped per retry so the stage measures the time the
+            # records actually became visible, outages included
+            fts = self.clock()
+            for sp in spans:
+                sp["ts"]["flush"] = fts
         try:
             if hasattr(self.sink, "create_job_logs"):
-                self.sink.create_job_logs(batch, idem=idem)
+                if spans and self._sink_takes_spans():
+                    self.sink.create_job_logs(batch, idem=idem,
+                                              spans=spans)
+                else:
+                    self.sink.create_job_logs(batch, idem=idem)
             else:                   # minimal sink: per-record
                 use_idem = toks is not None and self._sink_idem_ok()
                 for k, r in enumerate(batch):
@@ -1047,8 +1195,8 @@ class NodeAgent:
                 early = self.clock() < self._rec_retry_at
                 if not (final or force) and early:
                     return   # between backoff attempts; fresh waits too
-                batch, idem, toks = self._rec_retry
-                if self._send_records(batch, idem, toks):
+                batch, idem, toks, spans = self._rec_retry
+                if self._send_records(batch, idem, toks, spans):
                     self._record_flushed(len(batch))
                     self._rec_retry = None
                     self._rec_flush_fails = 0
@@ -1081,23 +1229,26 @@ class NodeAgent:
                         return   # sink still down; fresh records wait
             with self._rec_mu:
                 batch, self._rec_buf = self._rec_buf, []
-            if not batch:
+                spans, self._span_buf = self._span_buf, []
+            if not batch and not spans:
                 return
             # batch token + per-record tokens minted ONCE per logical
             # batch: both stay pinned in the retry slot so every
             # re-send (bulk or per-record degraded path) dedups
-            # server-side
+            # server-side.  Spans ride the same batch (and retry slot);
+            # their ingest is last-write-wins per (trace, node), so a
+            # replayed batch re-merges identical values.
             idem = uuid.uuid4().hex
             toks = [f"{idem}.{i}" for i in range(len(batch))]
             sent = len(batch)
-            if self._send_records(batch, idem, toks):
+            if self._send_records(batch, idem, toks, spans):
                 self._record_flushed(sent)
             elif final:
                 log.errorf("record flush failed (%d records dropped "
                            "at shutdown)", len(batch))
                 self._bump("rec_dropped_total", len(batch))
-            elif batch:
-                self._rec_retry = (batch, idem, toks)
+            elif batch or spans:
+                self._rec_retry = (batch, idem, toks, spans)
                 self._rec_retry_at = self.clock() + REC_FLUSH.delay(1)
 
     # ---- event processing (synchronous; threads call these) --------------
@@ -1170,7 +1321,9 @@ class NodeAgent:
         # the order key stays in the store until the execution's proc
         # key exists — the scheduler counts it as an outstanding
         # capacity reservation in the meantime
-        self._spawn(job, epoch_s, fenced=True, order_key=order_key)
+        tr = (None, self.clock(), None) if self.trace_shift >= 0 else None
+        self._spawn(job, epoch_s, fenced=True, order_key=order_key,
+                    tr=tr)
         return 1
 
     def _handle_bundle(self, key: str, epoch_s: int, value: str) -> int:
@@ -1183,20 +1336,29 @@ class NodeAgent:
         except (json.JSONDecodeError, TypeError):
             entries = None
         pairs = []
+        tb = None
         if isinstance(entries, list):
             for e in entries:
                 if isinstance(e, str) and "/" in e:
                     group, _, job_id = e.partition("/")
                     pairs.append((group, job_id))
+                elif isinstance(e, dict):
+                    # trace header the scheduler appends to a bundle
+                    # with >= 1 sampled member (order-build wall time);
+                    # spanless legacy bundles simply lack it
+                    t = e.get("tb")
+                    if isinstance(t, (int, float)):
+                        tb = float(t)
         if not pairs:
             self._ack(key)           # malformed/empty: release the
             return 0                 # capacity reservation
+        recv = self.clock() if self.trace_shift >= 0 else None
         NodeAgent._spawn_seq += 1
         name = f"bundle-{epoch_s}-{NodeAgent._spawn_seq}"
 
         def run():
             try:
-                self._run_bundle(key, epoch_s, pairs)
+                self._run_bundle(key, epoch_s, pairs, tb=tb, recv=recv)
             except Exception as e:  # noqa: BLE001 — log, don't die silent
                 log.errorf("bundle %s failed: %s", name, e)
             finally:
@@ -1207,7 +1369,9 @@ class NodeAgent:
         self._stage_task(name, task, epoch_s)
         return len(pairs)
 
-    def _run_bundle(self, order_key: str, epoch_s: int, pairs: list):
+    def _run_bundle(self, order_key: str, epoch_s: int, pairs: list,
+                    tb: Optional[float] = None,
+                    recv: Optional[float] = None):
         """Consume one coalesced order: resolve the bundle's jobs (one
         get_many), settle KindAlone lifetime locks per job (lock FIRST —
         a skip because the previous run is still live must not consume
@@ -1259,6 +1423,9 @@ class NodeAgent:
                         ent[1] = None
                 return
             self._bump("orders_consumed_total", len(items))
+            # fence settled for the whole bundle: the claim-lag stamp
+            # every member's span shares
+            claim_ts = self.clock() if self.trace_shift >= 0 else None
             for won, ent in zip(wins, runnable):
                 job, alone, with_proc, proc_key, proc_val = ent
                 if not won:
@@ -1275,7 +1442,8 @@ class NodeAgent:
                         self._procs[proc_key] = proc_val
                 ent[1] = None   # the execution owns the lock from here
                 self._spawn(job, epoch_s, fenced=True,
-                            pre=(with_proc, alone))
+                            pre=(with_proc, alone),
+                            tr=(tb, recv, claim_ts))
         except BaseException:
             # an escaping error (a transport hiccup mid-acquire, a
             # degraded-path claim failure) must not leak a live Alone
@@ -1558,7 +1726,8 @@ class NodeAgent:
             cut = self.clock() - 1800
             for k2 in [k2 for k2, ts in self._bseen.items() if ts < cut]:
                 del self._bseen[k2]
-        self._spawn(job, epoch_s, fenced=True)
+        tr = (None, self.clock(), None) if self.trace_shift >= 0 else None
+        self._spawn(job, epoch_s, fenced=True, tr=tr)
         return 1
 
     def _poll_broadcast(self) -> int:
@@ -1605,14 +1774,15 @@ class NodeAgent:
 
     def _spawn(self, job: Job, epoch_s: int, fenced: bool,
                use_gate: bool = True, order_key: Optional[str] = None,
-               immediate: bool = False, pre: Optional[tuple] = None):
+               immediate: bool = False, pre: Optional[tuple] = None,
+               tr: Optional[tuple] = None):
         NodeAgent._spawn_seq += 1
         name = f"exec-{job.id}-{epoch_s}-{NodeAgent._spawn_seq}"
 
         def run():
             try:
                 self._execute(job, epoch_s, fenced, use_gate, order_key,
-                              pre=pre)
+                              pre=pre, tr=tr)
             except Exception as e:  # noqa: BLE001 — log, don't die silent
                 log.errorf("execution %s failed: %s", name, e)
             finally:

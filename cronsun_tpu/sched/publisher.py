@@ -76,9 +76,60 @@ class OrderPublisher:
         # there (late, never lost) — the HWM must never advance past a
         # second whose orders are not actually in the store
         self._failed_epoch: "int | None" = None
+        # HWM advances ride a COALESCING background thread: the mark is
+        # recovery metadata (a fresh leader resumes planning from it),
+        # and its get+CAS against the store was on the publish thread —
+        # a browned-out shard hosting the hwm key taxed EVERY landed
+        # second's publish by its round trip (measured by the
+        # brownout_dispatch drill).  Only the LATEST landed mark is
+        # written (intermediates coalesce); a crash before the write
+        # re-plans a few already-published seconds, which fences and
+        # broadcast dedup absorb — the exact crash contract the
+        # synchronous write had between seconds.  flush() still
+        # barriers on the mark landing.
+        self._hwm_want = 0
+        self._hwm_done = 0
+        self._hwm_cv = threading.Condition()
+        self._hwm_thread = threading.Thread(target=self._hwm_run,
+                                            daemon=True,
+                                            name="hwm-advance")
+        self._hwm_thread.start()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="order-publisher")
         self._thread.start()
+
+    def _hwm_note(self, value: int):
+        with self._hwm_cv:
+            if value > self._hwm_want:
+                self._hwm_want = value
+                self._hwm_cv.notify()
+
+    def _hwm_run(self):
+        while True:
+            with self._hwm_cv:
+                while self._hwm_want <= self._hwm_done:
+                    if self._stopping:
+                        return
+                    self._hwm_cv.wait(0.5)
+                v = self._hwm_want
+            try:
+                self._advance_hwm(v)
+            except Exception as e:  # noqa: BLE001 — keep _hwm_done
+                # behind so the advance RETRIES (flush()'s contract is
+                # 'the mark is written'; marking a failed write done
+                # would let a checkpoint/kill drill restore from a mark
+                # that never landed).  The lagging HWM itself is only
+                # the bounded re-plan window, never a correctness loss.
+                log.warnf("hwm advance to %d failed (will retry): %s",
+                          v, e)
+                with self._hwm_cv:
+                    if self._stopping:
+                        return
+                    self._hwm_cv.wait(0.5)   # pace the retry
+                continue
+            with self._hwm_cv:
+                self._hwm_done = max(self._hwm_done, v)
+                self._hwm_cv.notify_all()
 
     # -- producer side -----------------------------------------------------
 
@@ -142,7 +193,10 @@ class OrderPublisher:
             return self._failed_epoch
 
     def flush(self, timeout: float = 120.0) -> bool:
-        """Block until every submitted window has been published."""
+        """Block until every submitted window has been published AND
+        the latest landed HWM mark is written (the background advance
+        joined — kill drills and checkpoints rely on flush meaning
+        'persisted')."""
         deadline = time.monotonic() + timeout
         with self._idle:
             while self._inflight:
@@ -150,13 +204,22 @@ class OrderPublisher:
                 if left <= 0:
                     return False
                 self._idle.wait(left)
+        with self._hwm_cv:
+            while self._hwm_done < self._hwm_want:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._hwm_cv.wait(left)
         return True
 
     def stop(self, timeout: float = 120.0):
         self.flush(timeout)
         self._stopping = True
         self._q.put(None)
+        with self._hwm_cv:
+            self._hwm_cv.notify_all()
         self._thread.join(timeout=5)
+        self._hwm_thread.join(timeout=5)
         for p in self._pools:
             p.shutdown(wait=False)
 
@@ -261,12 +324,12 @@ class OrderPublisher:
                     # unpublished tail (a rare double fire beats
                     # silently missing one; fences/broadcast-dedup
                     # absorb the dup)
-                    self._advance_hwm(epoch + 1)
+                    self._hwm_note(epoch + 1)
                     self.published_through = max(self.published_through,
                                                  epoch + 1)
                 else:
                     if hwm:
-                        self._advance_hwm(hwm)
+                        self._hwm_note(hwm)
                         self.published_through = max(self.published_through,
                                                      hwm)
             except Exception as e:  # noqa: BLE001 — keep publishing

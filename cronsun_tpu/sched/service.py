@@ -127,6 +127,7 @@ class SchedulerService:
                  delta_max_chain: int = 64,
                  delta_max_bytes: int = 64 << 20,
                  delta_max_events: int = 1_000_000,
+                 trace_shift: int = -1,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -176,6 +177,24 @@ class SchedulerService:
         self._rd_suffix: list = [None] * J       # "/group/job" key tail
         self._rd_bentry: list = [None] * J       # json-quoted bundle entry
         self._rd_job: list = [None] * J          # (group, job_id)
+        # trace plane (fire-lifecycle tracing): per-row FNV-1a partial
+        # hash over "<job_id>|" — the per-second trace ids continue it
+        # with the epoch string in ONE vectorized pass (O(digits), not
+        # O(fires) Python hashing) — plus the per-job force-sample flag.
+        # trace_shift < 0 (the default for direct constructions — every
+        # bit-identity differential and divergence gate in the repo
+        # builds services directly) disables stamping entirely and the
+        # order wire stays byte-identical; bin/sched arms it from
+        # conf.trace_sample_shift.  CRONSUN_TRACE=off overrides.
+        from .. import trace as _trace
+        self._trace = _trace
+        self.trace_shift = trace_shift if _trace.armed() else -1
+        self._rd_tbase = np.zeros(J, np.uint64)
+        self._rd_tflag = np.zeros(J, bool)
+        # build-time stamp per epoch second, cached so the vectorized
+        # build, the reference build and an overflow replan of the same
+        # second all stamp ONE value (differentials stay byte-identical)
+        self._tb_cache: Dict[int, float] = {}
         # reverse col -> node-id map, maintained on node churn instead of
         # being rebuilt from universe.index every step (+ a bool mask of
         # live columns for the vectorized build)
@@ -829,6 +848,9 @@ class SchedulerService:
         self._rd_suffix[row] = suffix
         self._rd_bentry[row] = bentry
         self._rd_job[row] = (group, job_id)
+        self._rd_tbase[row] = np.uint64(
+            self._trace.fnv_partial(job_id + "|"))
+        self._rd_tflag[row] = bool(getattr(job, "trace", False))
         self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
                                | (4 if job.kind == KIND_ALONE else 0))
 
@@ -2317,6 +2339,20 @@ class SchedulerService:
         self._rd_suffix = rd["suffix"]
         self._rd_bentry = rd["bentry"]
         self._rd_job = rd["job"]
+        # trace-plane row caches are NOT checkpointed (pre-trace
+        # checkpoints must keep restoring): re-derive them from the
+        # restored rows when stamping is armed
+        if self.trace_shift >= 0:
+            self._rd_tbase = np.zeros(len(self._rd_flags), np.uint64)
+            self._rd_tflag = np.zeros(len(self._rd_flags), bool)
+            for row, gj in enumerate(self._rd_job):
+                if gj is None or not (self._rd_flags[row] & 1):
+                    continue
+                self._rd_tbase[row] = np.uint64(
+                    self._trace.fnv_partial(gj[1] + "|"))
+                job = self.jobs.get((gj[0], gj[1]))
+                self._rd_tflag[row] = bool(job and
+                                           getattr(job, "trace", False))
         self._col_node = st["col_node"]
         self._col_live = st["col_live"]
         m = st["mirrors"]
@@ -3174,6 +3210,23 @@ class SchedulerService:
         self._builder.stats["stalls_total"] = 0
         self._builder.stats["stall_ms_total"] = 0.0
 
+    def _tb_stamp(self, epoch_s: int) -> float:
+        """Order-build wall stamp for one planned second, cached so the
+        vectorized build, the reference build and an overflow replan of
+        the SAME second stamp one value (the build differentials and
+        the re-publish-overwrites contract stay byte-identical).  The
+        first build of a second wins — a replan's bundle overwrite
+        keeps the original plan-build time, which is the stage the
+        waterfall measures."""
+        t = self._tb_cache.get(epoch_s)
+        if t is None:
+            t = round(self.clock(), 3)
+            self._tb_cache[epoch_s] = t
+            if len(self._tb_cache) > 256:
+                for k in sorted(self._tb_cache)[:-128]:
+                    self._tb_cache.pop(k, None)
+        return t
+
     def _build_plan_orders(self, plan, seconds: List[Tuple[int, list]],
                            excl_acct: List[Tuple[str, str, list]],
                            pending_excl: Optional[Dict[int, int]] = None
@@ -3206,6 +3259,19 @@ class SchedulerService:
         n_fires = 0
         n_bundles = 0
         n_excl = 0
+        # trace plane: vectorized head-sampling verdicts for this
+        # second's fires (per-row partial hash continued with the epoch
+        # string — O(digits) vector ops, not O(fires) Python hashing).
+        # A coalesced bundle with >= 1 sampled member gets ONE trailing
+        # {"tb": <build ts>} element; agents re-derive the per-member
+        # verdict from the same hash.  trace_shift < 0: samp stays None
+        # and the wire is byte-identical to the pre-trace format.
+        samp = None
+        if self.trace_shift >= 0 and rows.size:
+            tids = self._trace.fnv_continue_vec(
+                self._rd_tbase[rows], str(plan.epoch_s))
+            mask = np.uint64((1 << self.trace_shift) - 1)
+            samp = ((tids & mask) == np.uint64(0)) | self._rd_tflag[rows]
         if plan.tenant_throttled is not None and \
                 (plan.tenant_throttled.any() or plan.tenant_shed.any()):
             # device-side admission refusals: hand the per-tenant counts
@@ -3287,9 +3353,22 @@ class SchedulerService:
                 pfx = self.ks.dispatch
                 tail = "/" + ep
                 keys = [pfx + col_node[sc_l[s]] + tail for s in starts_g]
+                if samp is not None:
+                    # any-member-sampled per coalesced group (reduceat
+                    # over the node-sorted verdicts), in gorder order
+                    gs = np.add.reduceat(
+                        samp[sx].astype(np.int8),
+                        np.asarray(starts, np.int64)) > 0
+                    tb = self._tb_stamp(plan.epoch_s)
+                    ttails = [',{"tb":%.3f}' % tb if gs[g] else ""
+                              for g in gorder]
+                else:
+                    ttails = None
                 orders += zip(keys,
-                              ("[" + ",".join(bent_l[s:e]) + "]"
-                               for s, e in zip(starts_g, ends_g)))
+                              ("[" + ",".join(bent_l[s:e])
+                               + (ttails[i] if ttails else "") + "]"
+                               for i, (s, e)
+                               in enumerate(zip(starts_g, ends_g))))
                 excl_acct += zip(keys,
                                  (col_node[sc_l[s]] for s in starts_g),
                                  (list(rj_l[s:e])
@@ -3341,6 +3420,9 @@ class SchedulerService:
         orders: List[Tuple[str, str]] = []
         bundles: Dict[str, list] = {}       # node -> [bundle entry json]
         bundle_jobs: Dict[str, list] = {}   # node -> [(group, job_id)]
+        bundle_samp: Set[str] = set()       # nodes with a sampled member
+        trace_on = self.trace_shift >= 0
+        tmask = (1 << self.trace_shift) - 1 if trace_on else 0
         n_fires = 0
         for row, node_col in zip(plan.fired.tolist(),
                                  plan.assigned.tolist()):
@@ -3365,6 +3447,12 @@ class SchedulerService:
                         bundles.setdefault(node, []).append(bentry)
                         bundle_jobs.setdefault(node, []).append(
                             (group, job_id))
+                        if trace_on and (
+                                self._rd_tflag[row] or
+                                (self._trace.fnv_continue(
+                                    int(self._rd_tbase[row]), ep)
+                                 & tmask) == 0):
+                            bundle_samp.add(node)
                         n_fires += 1
             else:
                 orders.append((f"{bcast_pfx}{ep}{suffix}", payload))
@@ -3372,7 +3460,9 @@ class SchedulerService:
         n_excl = 0
         for node, entries in bundles.items():
             key = f"{disp_pfx}{node}/{ep}"
-            orders.append((key, "[" + ",".join(entries) + "]"))
+            ttail = (',{"tb":%.3f}' % self._tb_stamp(plan.epoch_s)
+                     if node in bundle_samp else "")
+            orders.append((key, "[" + ",".join(entries) + ttail + "]"))
             excl_acct.append((key, node, bundle_jobs[node]))
             n_excl += len(entries)
         if len(bundles) > self.max_second_node_keys:
@@ -3469,6 +3559,21 @@ class SchedulerService:
         return replan
 
     # ---- operator metrics ------------------------------------------------
+
+    def health(self) -> dict:
+        """Readiness facts for the ``--health-port`` endpoint (bin/
+        sched): leader lease held, watch streams open, step loop
+        alive.  A warm standby reports leader=False — operators decide
+        whether a standby counts as 'ready' for their probe; the
+        /readyz endpoint fails only on dead watches or a dead loop,
+        and names the leader fact in the body either way."""
+        watches = [w for w in self._all_watches() if w is not None]
+        thread = getattr(self, "_thread", None)
+        return {
+            "leader": bool(self.is_leader),
+            "watches_open": len(watches),
+            "loop_alive": bool(thread is not None and thread.is_alive()),
+        }
 
     def metrics_snapshot(self) -> dict:
         # pipeline overlap: the builder-stage work that did NOT re-enter

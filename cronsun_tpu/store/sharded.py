@@ -454,18 +454,38 @@ class ShardedStore:
         # sequential with rollback (the C++ mirror's shape): a later
         # shard failing must not strand live TTL leases on the earlier
         # ones — callers retry grants in a loop, and each stranded set
-        # would pin its keys for the full TTL
+        # would pin its keys for the full TTL.
+        #
+        # BROWNOUT tolerance: a shard whose breaker is OPEN gets the
+        # server-impossible -1 sentinel as its leg instead of failing
+        # the WHOLE composite — one browned-out shard must not take
+        # every healthy shard's lease plane (fences, proc registry,
+        # node liveness) down with it.  Writes that would use the -1
+        # leg are refused by the open breaker anyway; once the shard
+        # heals, -1 is rejected LOUDLY server-side and the caller's
+        # rotate/regrant ladder mints a full composite (the PR 6
+        # xlease contract — never silently unleased).
         ids: List[int] = []
+        degraded = 0
         try:
             for s in self.shards:
-                ids.append(s.grant(ttl))
+                try:
+                    ids.append(s.grant(ttl))
+                except ShardDegradedError:
+                    ids.append(-1)
+                    degraded += 1
         except BaseException:
             for s, i in zip(self.shards, ids):
+                if i == -1:
+                    continue
                 try:
                     s.revoke(i)
                 except Exception:  # noqa: BLE001 — already failing
                     pass
             raise
+        if degraded == self.nshards:
+            raise ShardDegradedError(
+                "every shard's breaker is open; no lease granted")
         with self._lease_mu:
             cid = next(self._lease_ctr)
             self._lease_map[cid] = ids
@@ -478,7 +498,22 @@ class ShardedStore:
             ids = self._lease_map.get(lease_id)
         if ids is None:
             return False
-        oks = self._fan([lambda s=s, i=i: s.keepalive(i)
+        # a -1 leg (granted while that shard's breaker was open) has
+        # nothing to keep alive; a leg whose shard is degraded NOW is
+        # UNKNOWN — treated alive, because the caller's reaction to
+        # False (revoke + regrant + re-put every key) would fail
+        # against the same open breaker and thrash the healthy shards.
+        # The degraded shard's leg may expire server-side meanwhile:
+        # that shard's keys are its own bounded brownout loss, exactly
+        # the fail-fast contract's blast radius.
+        def one(s, i):
+            if i == -1:
+                return True
+            try:
+                return s.keepalive(i)
+            except ShardDegradedError:
+                return True
+        oks = self._fan([lambda s=s, i=i: one(s, i)
                          for s, i in zip(self.shards, ids)])
         return all(oks)
 
@@ -489,7 +524,15 @@ class ShardedStore:
             ids = self._lease_map.pop(lease_id, None)
         if ids is None:
             return False
-        oks = self._fan([lambda s=s, i=i: s.revoke(i)
+
+        def one(s, i):
+            if i == -1:
+                return False
+            try:
+                return s.revoke(i)
+            except ShardDegradedError:
+                return False   # leg expires by TTL on the open shard
+        oks = self._fan([lambda s=s, i=i: one(s, i)
                          for s, i in zip(self.shards, ids)])
         return any(oks)
 
@@ -500,7 +543,14 @@ class ShardedStore:
             ids = self._lease_map.get(lease_id)
         if ids is None:
             return None
-        outs = self._fan([lambda s=s, i=i: s.lease_ttl_remaining(i)
+        def one(s, i):
+            if i == -1:
+                return None    # leg never granted (degraded shard)
+            try:
+                return s.lease_ttl_remaining(i)
+            except ShardDegradedError:
+                return None
+        outs = self._fan([lambda s=s, i=i: one(s, i)
                           for s, i in zip(self.shards, ids)])
         live = [o for o in outs if o is not None]
         return min(live) if len(live) == len(outs) else None

@@ -18,6 +18,7 @@ reference's semantics:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import re
 import threading
@@ -27,11 +28,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from .. import log
+from .. import log, trace as _trace
 from ..core import (
     Account, Group, Job, Keyspace, ROLE_ADMIN, TenantQuota,
     ValidationError, next_id, validate_dag)
-from ..core.models import hash_password
+from ..core.models import SloSpec, hash_password
 from ..logsink import JobLogStore
 from ..store.memstore import MemStore
 from .sessions import Session, SessionStore
@@ -40,6 +41,14 @@ from .ui import INDEX_HTML
 VERSION = "v0.1.0-tpu"
 BOOTSTRAP_ADMIN = "admin@admin.com"
 BOOTSTRAP_PASSWORD = "admin"
+
+
+def _esc_label(v) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote AND newline (the one the ad-hoc escapes missed — a tenant or
+    op name containing a newline emitted a torn, unparseable line)."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 class HttpError(Exception):
@@ -68,7 +77,8 @@ class ApiServer:
                  ks: Optional[Keyspace] = None, security=None, alarm=None,
                  auth_enabled: bool = True,
                  host: str = "127.0.0.1", port: int = 7079,
-                 cache_enabled: Optional[bool] = None):
+                 cache_enabled: Optional[bool] = None,
+                 slo_engine=None):
         # auth_enabled=False replicates the reference's Web.Auth.Enabled
         # switch (web/base.go:98: every request passes as an implicit
         # admin; the UI skips login).  Unlike the reference — whose Go
@@ -102,6 +112,10 @@ class ApiServer:
                 arm(self.store, self.ks.prefix)
             except Exception as e:  # noqa: BLE001 — paging is optional
                 log.warnf("breaker notice arming failed: %s", e)
+        # SLO engine (web/slo.py): burn-rate evaluation + paging runs
+        # in THIS process; None = engine hosted elsewhere (or off) —
+        # the /v1/slo surfaces then serve specs without live burn rates
+        self.slo_engine = slo_engine
         self.routes = self._build_routes()
 
     # ---- bootstrap (web/authentication.go:20-52) -------------------------
@@ -169,6 +183,19 @@ class ApiServer:
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
         route("POST", r"/v1/checkpoint", self.checkpoint, admin=True)
+        # trace plane: assembled waterfalls + slowest-trace summaries
+        route("GET", r"/v1/trace/top", self.trace_top)
+        route("GET", r"/v1/trace/(?P<job>[^/]+)/(?P<sec>\d+)",
+              self.trace_show)
+        # SLO engine: declarative specs + live burn rates
+        route("GET", r"/v1/slos", self.slo_list)
+        route("PUT", r"/v1/slo", self.slo_set, admin=True)
+        route("DELETE", r"/v1/slo/(?P<name>[^/]+)", self.slo_delete,
+              admin=True)
+        route("GET", r"/v1/slo/status", self.slo_status)
+        # liveness/readiness (unauthenticated: probes don't log in)
+        route("GET", r"/healthz", self.healthz, auth=False)
+        route("GET", r"/readyz", self.readyz, auth=False)
         # unauthenticated like /v1/version: Prometheus scrapers don't
         # hold sessions, and the surface carries only operational gauges
         route("GET", r"/v1/metrics", self.metrics, auth=False)
@@ -1180,6 +1207,138 @@ class ApiServer:
                             "/v1/metrics (cronsun_sched_checkpoint_*)")
         return out
 
+    # ---- handlers: trace plane ------------------------------------------
+
+    def trace_show(self, ctx):
+        """Assembled waterfall for one (job, scheduled second): per
+        executing node, the six stage durations (sched / publish /
+        claim / queue / run / record) from the stored span stamps."""
+        job = ctx.path_args["job"]
+        sec = int(ctx.path_args["sec"])
+        tg = getattr(self.sink, "trace_get", None)
+        if tg is None:
+            raise HttpError(501, "result store lacks the trace plane")
+        try:
+            spans = tg(job, sec)
+        except Exception as e:  # noqa: BLE001 — degraded sink
+            raise HttpError(503, f"trace read failed: {e}")
+        wf = _trace.assemble(job, sec, spans)
+        if wf is None:
+            raise HttpError(
+                404, "no trace recorded for this (job, second): not "
+                     "sampled (trace_sample_shift), not yet flushed, "
+                     "or aged out of the ring and spill")
+        return wf
+
+    def trace_top(self, ctx):
+        """Slowest recent traces, optionally by one stage
+        (?stage=claim&n=10) — summaries straight off the logd rings."""
+        n = ctx.q_int("n", 10)
+        stage = ctx.q("stage")
+        if stage and stage not in _trace.STAGES:
+            raise HttpError(400, f"unknown stage {stage!r} (one of "
+                                 f"{', '.join(_trace.STAGES)})")
+        tt = getattr(self.sink, "trace_top", None)
+        if tt is None:
+            raise HttpError(501, "result store lacks the trace plane")
+        ents = tt(max(64, n * 4))
+
+        def key(ent):
+            if not stage:
+                return ent.get("total_ms", 0.0)
+            return max((nd.get("stages", {}).get(stage, 0.0)
+                        for nd in ent.get("nodes", [])), default=0.0)
+        ents.sort(key=key, reverse=True)
+        return {"stage": stage or "total", "traces": ents[:max(1, n)]}
+
+    # ---- handlers: SLO engine -------------------------------------------
+
+    def slo_list(self, ctx):
+        out = []
+        for kv in self._degraded_prefix(self.ks.slo):
+            try:
+                out.append(dataclasses.asdict(SloSpec.from_json(kv.value)))
+            except (json.JSONDecodeError, TypeError):
+                continue
+        return out
+
+    def slo_set(self, ctx):
+        body = ctx.json()
+        try:
+            # no `or`-defaulting: target=0 must reach validate() and
+            # 400 ("target must be in (0, 1)"), not silently become
+            # the default; a non-numeric value is a 400 too, like
+            # every sibling route, not an unexplained 500
+            spec = SloSpec(
+                name=str(body.get("name", "")),
+                scope=str(body.get("scope", "")),
+                target=float(body.get("target", 0.999)),
+                latency_ms=float(body.get("latency_ms", 0)))
+            spec.validate()
+        except (ValidationError, TypeError, ValueError) as e:
+            raise HttpError(400, str(e))
+        self.store.put(self.ks.slo_key(spec.name), spec.to_json())
+        return dataclasses.asdict(spec)
+
+    def slo_delete(self, ctx):
+        name = ctx.path_args["name"]
+        if not self.store.delete(self.ks.slo_key(name)):
+            raise HttpError(404, "no such slo")
+        return {}
+
+    def slo_status(self, ctx):
+        """Current burn rates + alert states (the `cronsun-ctl slo
+        show` surface)."""
+        if self.slo_engine is None:
+            return {"engine": "off", "slos": {}, "stats": {}}
+        snap = self.slo_engine.snapshot()
+        snap["engine"] = "on"
+        return snap
+
+    # ---- handlers: health ------------------------------------------------
+
+    def healthz(self, ctx):
+        return {"ok": True}
+
+    def readyz(self, ctx):
+        """Readiness: the coordination store and result store answer,
+        and no shard breaker is OPEN.  503 with the failing check named
+        otherwise (the shared health contract — see
+        cronsun_tpu/health.py for the TCP servers' twin)."""
+        checks = {}
+
+        def check(name, fn):
+            try:
+                ok, detail = fn()
+            except Exception as e:  # noqa: BLE001
+                ok, detail = False, str(e)
+            checks[name] = {"ok": bool(ok), "detail": detail}
+
+        def store_ok():
+            self.store.get(self.ks.hwm)   # raises when unreachable
+            return True, ""
+
+        def sink_ok():
+            return True, f"revision {self.sink.revision()}"
+
+        check("store", store_ok)
+        check("logsink", sink_ok)
+        for label, backend in (("store", self.store),
+                               ("logsink", self.sink)):
+            bs = getattr(backend, "breaker_snapshot", None)
+            if bs is None:
+                continue
+            snaps = bs() or []
+            opened = [s["shard"] for s in snaps
+                      if s.get("state") == "open"]
+            checks[f"{label}_breakers"] = {
+                "ok": not opened,
+                "detail": f"open shards: {opened}" if opened else ""}
+        ok = all(c["ok"] for c in checks.values())
+        if not ok:
+            ctx.out_status = 503
+        return {"ok": ok, "checks": checks}
+
     # ---- handlers: metrics ----------------------------------------------
 
     def metrics(self, ctx):
@@ -1209,7 +1368,7 @@ class ApiServer:
                 snap = json.loads(kv.value)
             except json.JSONDecodeError:
                 continue
-            inst = instance.replace('\\', r'\\').replace('"', r'\"')
+            inst = _esc_label(instance)
             if component == "tenant":
                 # per-tenant admission snapshots are NESTED
                 # ({tenant: {field: n}}): render each numeric leaf as
@@ -1217,8 +1376,7 @@ class ApiServer:
                 for tname, fields in sorted(snap.items()):
                     if not isinstance(fields, dict):
                         continue
-                    tn = str(tname).replace('\\', r'\\') \
-                        .replace('"', r'\"')
+                    tn = _esc_label(tname)
                     for field, val in sorted(fields.items()):
                         if not isinstance(val, (int, float)):
                             continue
@@ -1293,7 +1451,7 @@ class ApiServer:
                     for op, ent in sorted(stats.items()):
                         if field not in ent:
                             continue
-                        o = op.replace('\\', r'\\').replace('"', r'\"')
+                        o = _esc_label(op)
                         lines.append(
                             f'{name}{{op="{o}"{shard}}} {ent[field]}')
             # per-shard brownout breakers (store/sharded.py PR 12):
@@ -1324,6 +1482,82 @@ class ApiServer:
                         val = state_num.get(val, -1)
                     lines.append(
                         f'{name}{{shard="{snap["shard"]}"}} {val}')
+
+        def render_hist(name, label_kv, snap):
+            """One Prometheus histogram (cumulative _bucket + _sum +
+            _count) from a {buckets, sum, count} snapshot."""
+            buckets = snap.get("buckets") or []
+            lbl = "".join(f'{k}="{_esc_label(v)}",'
+                          for k, v in label_kv)
+            cum = 0
+            for i, n in enumerate(buckets):
+                cum += int(n)
+                le = (f"{_trace.BUCKETS_MS[i]:g}"
+                      if i < len(_trace.BUCKETS_MS) else "+Inf")
+                lines.append(f'{name}_bucket{{{lbl}le="{le}"}} {cum}')
+            lbl = lbl[:-1]
+            lbl = f"{{{lbl}}}" if lbl else ""
+            lines.append(f'{name}_sum{lbl} {snap.get("sum", 0)}')
+            lines.append(f'{name}_count{lbl} {snap.get("count", 0)}')
+
+        # trace plane: per-stage latency histograms from the logd
+        # span rings (fixed buckets — summed across shards by the
+        # sharded client, addable across web replicas by Prometheus)
+        ts = getattr(self.sink, "trace_stats", None)
+        if ts is not None:
+            try:
+                tstats = ts()
+            except Exception:  # noqa: BLE001 — older/degraded sink
+                tstats = None
+            if tstats and tstats.get("stages"):
+                name = "cronsun_trace_stage_ms"
+                lines.append(f"# TYPE {name} histogram")
+                for stage in _trace.STAGES:
+                    ent = tstats["stages"].get(stage)
+                    if ent:
+                        render_hist(name, [("stage", stage)], ent)
+                lines.append("# TYPE cronsun_trace_spans_total counter")
+                lines.append(f"cronsun_trace_spans_total "
+                             f"{tstats.get('spans_total', 0)}")
+        # SLO engine: per-scope exec-latency histograms (every
+        # execution, unbiased — the burn-rate source) + live burn
+        # rates and alert states
+        if self.slo_engine is not None:
+            sums = self.slo_engine.scrape_sums()
+            if sums:
+                name = "cronsun_exec_latency_ms"
+                lines.append(f"# TYPE {name} histogram")
+                for scope in sorted(sums):
+                    count, fail, sum_ms, buckets = sums[scope]
+                    render_hist(name, [("scope", scope or "global")],
+                                {"buckets": buckets, "count": count,
+                                 "sum": round(sum_ms, 3)})
+                lines.append("# TYPE cronsun_exec_fail_total counter")
+                for scope in sorted(sums):
+                    lines.append(
+                        f'cronsun_exec_fail_total{{scope='
+                        f'"{_esc_label(scope or "global")}"}} '
+                        f'{sums[scope][1]}')
+            snap = self.slo_engine.snapshot()
+            if snap["slos"]:
+                lines.append("# TYPE cronsun_slo_burn_rate gauge")
+                for sname in sorted(snap["slos"]):
+                    st = snap["slos"][sname]
+                    for w, v in sorted(st["burn"].items()):
+                        lines.append(
+                            f'cronsun_slo_burn_rate{{slo='
+                            f'"{_esc_label(sname)}",window="{w}"}} {v}')
+                lines.append("# TYPE cronsun_slo_alert gauge")
+                sev_num = {"": 0, "slow": 1, "fast": 2}
+                for sname in sorted(snap["slos"]):
+                    st = snap["slos"][sname]
+                    lines.append(
+                        f'cronsun_slo_alert{{slo="{_esc_label(sname)}"}}'
+                        f' {sev_num.get(st["alert"], 0)}')
+            for field, val in sorted(snap["stats"].items()):
+                name = f"cronsun_{field}"
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {val}")
         return PlainText("\n".join(lines) + "\n")
 
     # ---- plumbing --------------------------------------------------------
@@ -1385,7 +1619,7 @@ class ApiServer:
                         ctype = "text/plain; version=0.0.4"
                     else:
                         payload = json.dumps(result).encode()
-                    self.send_response(200)
+                    self.send_response(ctx.out_status or 200)
                     for k, v in ctx.out_cookies.items():
                         self.send_header(
                             "Set-Cookie", f"sid={v}; Path=/; HttpOnly")
@@ -1450,6 +1684,7 @@ class _Ctx:
         self.session = None
         self.out_cookies: dict = {}
         self.out_headers: dict = {}
+        self.out_status = 200     # handlers may override (503 readyz)
 
     @property
     def sid(self) -> str:

@@ -1831,9 +1831,11 @@ class Executor {
 // ---------------------------------------------------------------------------
 
 struct JobSpec {
-  std::string id, group, name, command, user;
+  std::string id, group, name, command, user, tenant;
   int timeout = 0, retry = 0, interval = 0, parallels = 0, kind = 0;
   bool pause = false, fail_notify = false;
+  bool trace = false;     // per-job force-sample (trace plane)
+  bool has_deps = false;  // DAG member (the SLO "chain" scope)
   double avg_time = 0;
   std::vector<std::string> to;
   // per-rule placement for IsRunOn
@@ -1868,6 +1870,7 @@ static bool parse_job(const std::string& json, JobSpec& j) {
   S("name", j.name);
   S("command", j.command);
   S("user", j.user);
+  S("tenant", j.tenant);
   I("timeout", j.timeout);
   I("retry", j.retry);
   I("interval", j.interval);
@@ -1876,6 +1879,8 @@ static bool parse_job(const std::string& json, JobSpec& j) {
   if (const JV* f = v.get("pause")) j.pause = f->t == JV::BOOL && f->b;
   if (const JV* f = v.get("fail_notify"))
     j.fail_notify = f->t == JV::BOOL && f->b;
+  if (const JV* f = v.get("trace")) j.trace = f->t == JV::BOOL && f->b;
+  if (const JV* f = v.get("deps")) j.has_deps = f->t == JV::OBJ;
   if (const JV* f = v.get("avg_time")) j.avg_time = f->as_dbl();
   j.to = str_list(v.get("to"));
   if (const JV* rs = v.get("rules"))
@@ -1912,6 +1917,7 @@ class Agent {
   void set_rec_flush_interval(double s) {
     if (s > 0) rec_flush_interval_ = s;
   }
+  void set_trace_shift(int v) { trace_shift_ = v; }
 
   bool start() {
     if (probe_duplicate() != ProbeResult::kOk) return false;
@@ -2174,6 +2180,43 @@ class Agent {
       std::lock_guard<std::mutex> rg(rec_mu_);
       snap += ",\"rec_buf\":";
       jint(snap, (long long)rec_buf_.size());
+      snap += ",\"trace_spans_total\":";
+      jint(snap, trace_spans_);
+      snap += ",\"trace_span_buf\":";
+      jint(snap, (long long)span_buf_.size());
+    }
+    {
+      // per-scope SLO counters (nested — the web tier's burn-rate
+      // engine reads "slo" explicitly; the generic numeric-leaf
+      // renderer skips it), shape-identical to agent.py's snapshot
+      std::lock_guard<std::mutex> sg(slo_mu_);
+      if (!slo_.empty()) {
+        snap += ",\"slo\":{";
+        bool first = true;
+        for (const auto& [scope, e] : slo_) {
+          if (!first) snap += ',';
+          first = false;
+          jesc(snap, scope);
+          snap += ":{\"count\":";
+          jint(snap, e.count);
+          snap += ",\"fail\":";
+          jint(snap, e.fail);
+          snap += ",\"sum_ms\":";
+          jdbl(snap, e.sum_ms);
+          snap += ",\"buckets\":[";
+          for (int i = 0; i < 14; i++) {
+            if (i) snap += ',';
+            jint(snap, e.buckets[i]);
+          }
+          snap += "],\"fbuckets\":[";
+          for (int i = 0; i < 14; i++) {
+            if (i) snap += ',';
+            jint(snap, e.fbuckets[i]);
+          }
+          snap += "]}";
+        }
+        snap += "}";
+      }
     }
     snap += ",\"running\":";
     jint(snap, running_.load());
@@ -2314,7 +2357,8 @@ class Agent {
       return;
     }
     enqueue(j, epoch, /*fenced=*/true, /*gate=*/true,
-            consume ? key : std::string());
+            consume ? key : std::string(),
+            trace_shift_ >= 0 ? now_s() : 0);
   }
 
   void handle_bundle(const std::string& key, long long epoch,
@@ -2322,14 +2366,23 @@ class Agent {
     JParser jp(value);
     JV v;
     std::vector<std::string> entries;
+    double tr_b = 0;
     if (jp.value(v) && v.t == JV::ARR)
-      for (const JV& e : v.arr)
+      for (const JV& e : v.arr) {
         if (e.t == JV::STR && e.s.find('/') != std::string::npos)
           entries.push_back(e.s);
+        else if (e.t == JV::OBJ) {
+          // trace header the scheduler appends when >= 1 member is
+          // sampled (spanless legacy bundles simply lack it)
+          if (const JV* f = e.get("tb"))
+            if (f->t == JV::INT || f->t == JV::DBL) tr_b = f->as_dbl();
+        }
+      }
     if (entries.empty()) {
       ack_order(key);   // malformed/empty: release the reservation
       return;
     }
+    double tr_recv = trace_shift_ >= 0 ? now_s() : 0;
     // Oversized bundles split into chunk tasks the worker pool claims
     // CONCURRENTLY: one worker serially resolving + claiming a
     // 10k-member bundle (one get_many + one claim_bundle of 10k items)
@@ -2353,6 +2406,8 @@ class Agent {
       t->bundle = true;
       t->order_key = key;
       t->chunks_left = left;
+      t->tr_b = tr_b;
+      t->tr_recv = tr_recv;
       t->entries.assign(entries.begin() + (long)off,
                         entries.begin() + (long)end);
       enqueue_task(std::move(t), epoch);
@@ -2379,7 +2434,7 @@ class Agent {
     }
     JobSpec j;
     if (!fetch_job(group, job_id, j) || j.pause || !is_run_on(j)) return;
-    enqueue(j, epoch, true, true, "");
+    enqueue(j, epoch, true, true, "", trace_shift_ >= 0 ? now_s() : 0);
   }
 
   void handle_once(const std::string& key) {
@@ -2442,16 +2497,21 @@ class Agent {
     bool proc_written = false;
     long long alone_lease = 0;
     std::shared_ptr<std::atomic<bool>> alone_stop;
+    // trace plane stamps collected upstream (0 = absent): order-build
+    // wall time from the bundle's {"tb":...} header, watch receipt,
+    // bundle-claim settle
+    double tr_b = 0, tr_recv = 0, tr_claim = 0;
   };
 
   void enqueue(const JobSpec& j, long long epoch, bool fenced, bool gate,
-               const std::string& order_key) {
+               const std::string& order_key, double tr_recv = 0) {
     auto t = std::make_shared<Task>();
     t->job = j;
     t->epoch = epoch;
     t->fenced = fenced;
     t->gate = gate;
     t->order_key = order_key;
+    t->tr_recv = tr_recv;
     enqueue_task(std::move(t), epoch);
   }
 
@@ -2502,7 +2562,8 @@ class Agent {
       }
       execute(task->job, task->epoch, task->fenced, task->gate,
               task->order_key, task->preclaimed, task->proc_written,
-              task->alone_lease, task->alone_stop);
+              task->alone_lease, task->alone_stop,
+              task->tr_b, task->tr_recv, task->tr_claim);
     }
   }
 
@@ -2540,7 +2601,9 @@ class Agent {
                const std::string& order_key, bool preclaimed = false,
                bool proc_written = false, long long alone_lease_in = 0,
                std::shared_ptr<std::atomic<bool>> alone_stop_in =
-                   nullptr) {
+                   nullptr,
+               double tr_b = 0, double tr_recv = 0,
+               double tr_claim = 0) {
     {
       // scheduled second -> exec start: the end-to-end dispatch SLA
       // (orders arrive ahead of time and are held to their instant, so
@@ -2610,6 +2673,7 @@ class Agent {
         consume();
         return;  // another node already ran this (job, second)
       }
+      if (trace_shift_ >= 0) tr_claim = now_s();
       if (proc_written) {
         std::lock_guard<std::mutex> g(procs_mu_);
         procs_[proc_key] = proc_val;
@@ -2658,7 +2722,7 @@ class Agent {
     }
     consume();
     if (!res.skipped) {
-      record(j, res);
+      record(j, res, epoch, tr_b, tr_recv, tr_claim);
       update_avg_time(j, res);
     }
   }
@@ -2764,6 +2828,9 @@ class Agent {
     }
     settle();
     orders_consumed_ += (long long)members.size();
+    // fence settled for the whole bundle: the claim-lag stamp every
+    // member's span shares
+    double tr_claim = trace_shift_ >= 0 ? now_s() : 0;
     for (size_t i = 0; i < members.size(); i++) {
       BundleMember& m = members[i];
       if (i >= wins.size() || !wins[i]) {
@@ -2786,6 +2853,9 @@ class Agent {
       t->proc_written = m.with_proc;
       t->alone_lease = m.alone_lease;
       t->alone_stop = m.alone_stop;
+      t->tr_b = task.tr_b;
+      t->tr_recv = task.tr_recv;
+      t->tr_claim = tr_claim;
       enqueue_task(std::move(t), task.epoch);
     }
   }
@@ -3050,9 +3120,92 @@ class Agent {
   // pinned, exponential backoff, bounded attempts) while fresh records
   // keep buffering behind a drop cap.
 
-  void record(const JobSpec& j, const ExecResult& res) {
+  // fixed histogram bucket UPPER bounds (ms) — must stay identical to
+  // cronsun_tpu/trace.py BUCKETS_MS (the counters add fleet-wide)
+  static constexpr double kBucketsMs[13] = {
+      1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000};
+
+  void slo_observe(const JobSpec& j, const ExecResult& res) {
+    double lat_ms = (res.end - res.begin) * 1e3;
+    if (lat_ms < 0) lat_ms = 0;
+    int bi = 0;
+    while (bi < 13 && lat_ms > kBucketsMs[bi]) bi++;
+    std::vector<std::string> scopes{""};
+    if (!j.tenant.empty()) scopes.push_back("t:" + j.tenant);
+    if (j.has_deps) scopes.push_back("c:" + j.group + "/" + j.id);
+    std::lock_guard<std::mutex> g(slo_mu_);
+    for (const auto& s : scopes) {
+      if (slo_.size() >= 256 && !slo_.count(s)) continue;  // bounded
+      SloEnt& e = slo_[s];
+      e.count++;
+      if (!res.success) {
+        e.fail++;
+        e.fbuckets[bi]++;
+      }
+      e.sum_ms += lat_ms;
+      e.buckets[bi]++;
+    }
+  }
+
+  void record(const JobSpec& j, const ExecResult& res,
+              long long epoch = 0, double tr_b = 0, double tr_recv = 0,
+              double tr_claim = 0) {
     execs_++;
     if (!res.success) execs_failed_++;
+    slo_observe(j, res);
+    // trace plane: head-sampled (or failed, or trace:true) executions
+    // buffer a span that rides the record flush — the same
+    // deterministic fnv1a verdict the scheduler and agent.py reach
+    if (trace_shift_ >= 0 && epoch) {
+      unsigned long long tid =
+          fnv1a64(j.id + "|" + std::to_string(epoch));
+      unsigned long long mask =
+          trace_shift_ >= 64 ? ~0ull
+                             : ((1ull << trace_shift_) - 1);
+      if (j.trace || !res.success || (tid & mask) == 0) {
+        // "ts" LAST and left open: send_records appends the per-
+        // attempt ",\"flush\":<now>}}" tail when the batch ships
+        std::string sp = "{\"tid\":\"" + std::to_string(tid) +
+                         "\",\"job\":";
+        jesc(sp, j.id);
+        sp += ",\"grp\":";
+        jesc(sp, j.group);
+        sp += ",\"sec\":";
+        jint(sp, epoch);
+        sp += ",\"node\":";
+        jesc(sp, id_);
+        sp += ",\"ok\":";
+        sp += res.success ? "true" : "false";
+        if (!j.tenant.empty()) {
+          sp += ",\"ten\":";
+          jesc(sp, j.tenant);
+        }
+        sp += ",\"ts\":{";
+        bool first = true;
+        auto T = [&](const char* k, double v) {
+          if (v <= 0) return;
+          if (!first) sp += ',';
+          first = false;
+          sp += '"';
+          sp += k;
+          sp += "\":";
+          jdbl(sp, v);
+        };
+        T("b", tr_b);
+        T("recv", tr_recv);
+        T("claim", tr_claim);
+        T("start", res.begin);
+        T("end", res.end);
+        {
+          std::lock_guard<std::mutex> g(rec_mu_);
+          span_buf_.emplace_back(j.id, std::move(sp));
+          if (span_buf_.size() > 10000)
+            span_buf_.erase(span_buf_.begin(),
+                            span_buf_.begin() + 2000);
+          trace_spans_++;
+        }
+      }
+    }
     std::string out = res.output;
     if (!res.success && !res.error.empty()) {
       if (!out.empty()) out += "\n";
@@ -3132,14 +3285,29 @@ class Agent {
   // double-inserting — the whole-batch retry contract, per shard.
   bool send_records(
       const std::vector<std::pair<std::string, std::string>>& batch,
-      const std::string& idem) {
+      const std::string& idem,
+      const std::vector<std::pair<std::string, std::string>>& spans =
+          {}) {
     size_t n = logd_.n();
     std::vector<std::vector<const std::string*>> groups(n);
     for (const auto& [jid, rec] : batch)
       groups[logd_.shard_of(jid)].push_back(&rec);
+    // trace spans route by the SAME job token as their records; the
+    // open "ts" tail is closed with the per-attempt flush stamp here
+    // (re-stamped per retry — the stage measures when the records
+    // actually became visible)
+    std::vector<std::vector<const std::string*>> sgroups(n);
+    for (const auto& [jid, sp] : spans)
+      sgroups[logd_.shard_of(jid)].push_back(&sp);
+    std::string flush_tail;
+    if (!spans.empty()) {
+      flush_tail = ",\"flush\":";
+      jdbl(flush_tail, now_s());
+      flush_tail += "}}";
+    }
     std::vector<std::pair<size_t, std::string>> calls;
     for (size_t i = 0; i < n; i++) {
-      if (groups[i].empty()) continue;
+      if (groups[i].empty() && sgroups[i].empty()) continue;
       std::string args = "[[";
       for (size_t k = 0; k < groups[i].size(); k++) {
         if (k) args += ',';
@@ -3147,6 +3315,15 @@ class Agent {
       }
       args += "],";
       jesc(args, n == 1 ? idem : idem + ".s" + std::to_string(i));
+      if (!sgroups[i].empty()) {
+        args += ",[";
+        for (size_t k = 0; k < sgroups[i].size(); k++) {
+          if (k) args += ',';
+          args += *sgroups[i][k];
+          args += flush_tail;
+        }
+        args += "]";
+      }
       args += "]";
       calls.emplace_back(i, std::move(args));
     }
@@ -3177,11 +3354,12 @@ class Agent {
   // caller can never return while a popped batch is still in flight.
   void flush_records(bool final_flush) {
     std::lock_guard<std::mutex> fg(rec_flush_mu_);
-    if (!rec_retry_.empty()) {
+    if (!rec_retry_.empty() || !span_retry_.empty()) {
       if (!final_flush && now_s() < rec_retry_at_) return;  // backoff
-      if (send_records(rec_retry_, rec_retry_idem_)) {
+      if (send_records(rec_retry_, rec_retry_idem_, span_retry_)) {
         note_flush(rec_retry_.size());
         rec_retry_.clear();
+        span_retry_.clear();
         rec_flush_fails_ = 0;
       } else {
         rec_flush_fails_++;
@@ -3191,6 +3369,7 @@ class Agent {
                   rec_flush_fails_);
           rec_dropped_ += (long long)rec_retry_.size();
           rec_retry_.clear();
+          span_retry_.clear();
           rec_flush_fails_ = 0;
         } else {
           rec_retry_at_ = now_s() + std::min(
@@ -3199,14 +3378,15 @@ class Agent {
         }
       }
     }
-    std::vector<std::pair<std::string, std::string>> batch;
+    std::vector<std::pair<std::string, std::string>> batch, spans;
     {
       std::lock_guard<std::mutex> g(rec_mu_);
       batch.swap(rec_buf_);
+      spans.swap(span_buf_);
     }
-    if (batch.empty()) return;
+    if (batch.empty() && spans.empty()) return;
     std::string idem = idem_token();
-    if (send_records(batch, idem)) {
+    if (send_records(batch, idem, spans)) {
       note_flush(batch.size());
     } else if (final_flush) {
       fprintf(stderr, "record flush failed (%zu records dropped at "
@@ -3214,6 +3394,7 @@ class Agent {
       rec_dropped_ += (long long)batch.size();
     } else {
       rec_retry_ = std::move(batch);
+      span_retry_ = std::move(spans);
       rec_retry_idem_ = idem;
       rec_retry_at_ = now_s() + 0.5;
     }
@@ -3329,9 +3510,30 @@ class Agent {
   // record flusher state (the Python agent's _flush_records twin);
   // each buffered record carries its job_id so the flusher can split
   // the batch per result-store shard without re-parsing the JSON
-  std::mutex rec_mu_;                    // guards rec_buf_
+  std::mutex rec_mu_;                    // guards rec_buf_ + span_buf_
   std::vector<std::pair<std::string, std::string>> rec_buf_;
   size_t rec_buf_max_ = 100000;
+  // trace plane: (job_id, span JSON with an OPEN "ts" tail) — closed
+  // with the per-attempt flush stamp in send_records; spans ride the
+  // record batches (and their retry slot) with zero extra RPCs
+  std::vector<std::pair<std::string, std::string>> span_buf_;
+  std::vector<std::pair<std::string, std::string>> span_retry_;
+  long long trace_spans_ = 0;            // under rec_mu_
+  int trace_shift_ = 8;                  // -1 = stamping off
+  // SLO counters: per-scope latency histogram + failure count over
+  // EVERY execution ("" global, "t:<tenant>", "c:<group>/<job>" for
+  // DAG members) — published in the metrics snapshot, summed by the
+  // web tier's burn-rate engine (fixed fleet-wide buckets)
+  struct SloEnt {
+    long long count = 0, fail = 0;
+    double sum_ms = 0;
+    long long buckets[14] = {0};
+    long long fbuckets[14] = {0};  // failure latencies — lets the
+                                   // burn engine count slow successes
+                                   // exactly (bad = failed OR slow)
+  };
+  std::mutex slo_mu_;
+  std::map<std::string, SloEnt> slo_;
   std::mutex rec_flush_mu_;              // pop+send atomicity: the stop
                                          // barrier can't return while a
                                          // popped batch is in flight
@@ -3366,6 +3568,13 @@ int main(int argc, char** argv) {
   double rec_flush_interval = 0.05;
   bool instant_exec = false;
   int workers = 64;
+  // fire-lifecycle tracing: head-sample 1/2^shift of fires (matches
+  // conf.trace_sample_shift and agent.py); CRONSUN_TRACE=off disables
+  int trace_shift = 8;
+  if (const char* te = getenv("CRONSUN_TRACE")) {
+    std::string t = te;
+    if (t == "off" || t == "0" || t == "false") trace_shift = -1;
+  }
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -3379,6 +3588,10 @@ int main(int argc, char** argv) {
     else if (a == "--proc-req") proc_req = atof(next());
     else if (a == "--rec-flush-interval") rec_flush_interval = atof(next());
     else if (a == "--workers") workers = atoi(next());
+    else if (a == "--trace-shift") {
+      if (trace_shift >= 0) trace_shift = atoi(next());  // env off wins
+      else next();
+    }
     else if (a == "--store-token") store_token = next();
     else if (a == "--log-token") log_token = next();
     else if (a == "--instant-exec") instant_exec = true;
@@ -3491,6 +3704,7 @@ int main(int argc, char** argv) {
               proc_req, workers);
   agent.set_instant_exec(instant_exec);
   agent.set_rec_flush_interval(rec_flush_interval);
+  agent.set_trace_shift(trace_shift);
   if (!agent.start()) return 1;
   printf("READY %s\n", node_id.c_str());
   fflush(stdout);

@@ -58,6 +58,14 @@ struct Rec {
   double begin = 0, end = 0;
 };
 
+// 64-bit FNV-1a — the trace plane's deterministic id hash (bit-twin of
+// cronsun_tpu/trace.py fnv1a64 and agentd.cc's fnv1a64)
+static unsigned long long trace_fnv1a64(const std::string& s) {
+  unsigned long long h = 0xcbf29ce484222325ull;
+  for (unsigned char c : s) h = (h ^ c) * 0x100000001b3ull;
+  return h;
+}
+
 // LogRecord wire form: plain dict of the Python dataclass fields.
 static void rec_wire(std::string& out, const Rec& r, bool with_id) {
   out += "{\"job_id\":";
@@ -314,7 +322,7 @@ class LogStore {
   // sequential outcome), one WAL block append — so a 1k-record batch
   // pays ~4 table touches, not 4k.
   bool create_many(const std::vector<Rec>& recs, const std::string& idem,
-                   std::string& res) {
+                   std::string& res, const JV* spans = nullptr) {
     std::lock_guard<std::mutex> g(mu);
     long long first = -1;
     if (!idem.empty()) {
@@ -322,6 +330,11 @@ class LogStore {
       if (it != idem_.end()) first = it->second;  // replayed retry
     }
     if (first < 0) {
+      // the trace-span sidecar ingests only on the NON-replay branch:
+      // an idempotent batch retry must not double-count the stage
+      // histograms (the Python serve layer's idem thunk, here)
+      if (spans != nullptr && spans->t == JV::ARR)
+        trace_ingest_locked(*spans);
       first = next_id_;
       std::string block;
       std::map<std::pair<std::string, std::string>, Rec> last;
@@ -375,6 +388,247 @@ class LogStore {
     }
     res += ']';
     return true;
+  }
+
+  // -- trace plane (fire-lifecycle spans) --------------------------------
+  // Bounded in-memory ring keyed by trace id (decimal STRINGS on the
+  // wire — 64-bit ids overflow a JSON double), per-(trace, node)
+  // overwrite so a retried batch re-merges instead of duplicating.
+  // Ingest folds stage durations into fixed-bucket histograms (the
+  // trace.BUCKETS_MS twin — counters add across shards/replicas).
+  // In-memory only: the per-day spill is the Python server's job; a
+  // native logd restart starts with an empty ring.
+
+  static constexpr double kTraceBucketsMs[13] = {
+      1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000};
+  static constexpr const char* kTraceStages[6] = {
+      "sched", "publish", "claim", "queue", "run", "record"};
+
+  struct NodeSpan {
+    bool ok = true;
+    double b = 0, recv = 0, claim = 0, start = 0, end = 0, flush = 0;
+  };
+  struct TraceEnt {
+    std::string tid, job, grp;
+    long long sec = 0;
+    std::map<std::string, NodeSpan> nodes;
+  };
+
+  // clamped stage durations (ms): the exact formulas of
+  // cronsun_tpu/trace.py stage_durations (0 timestamps = absent)
+  static void trace_stage_ms(long long sec, const NodeSpan& s,
+                             double out[6], bool present[6]) {
+    for (int i = 0; i < 6; i++) present[i] = false;
+    auto st = [&](int i, double a, double b2) {
+      if (a <= 0 || b2 <= 0) return;
+      out[i] = std::max(0.0, (b2 - a) * 1e3);
+      present[i] = true;
+    };
+    st(0, (double)sec, s.b);
+    st(1, s.b, s.recv);
+    if (s.claim > 0)
+      st(2, s.recv > 0 ? std::max((double)sec, s.recv) : (double)sec,
+         s.claim);
+    st(3, s.claim > 0 ? s.claim : s.recv, s.start);
+    st(4, s.start, s.end);
+    st(5, s.end, s.flush);
+  }
+
+  static double trace_total_ms(long long sec, const NodeSpan& s) {
+    double last = (double)sec;
+    for (double v : {s.b, s.recv, s.claim, s.start, s.end, s.flush})
+      last = std::max(last, v);
+    return std::max(0.0, (last - (double)sec) * 1e3);
+  }
+
+  void trace_ingest_locked(const JV& arr) {
+    for (const JV& sp : arr.arr) {
+      if (sp.t != JV::OBJ) continue;
+      const JV* tidf = sp.get("tid");
+      const JV* jobf = sp.get("job");
+      const JV* secf = sp.get("sec");
+      const JV* tsf = sp.get("ts");
+      if (!tidf || tidf->t != JV::STR || !jobf || jobf->t != JV::STR ||
+          !secf || !tsf || tsf->t != JV::OBJ)
+        continue;
+      auto [it, fresh] = traces_.try_emplace(tidf->s);
+      TraceEnt& ent = it->second;
+      if (fresh) {
+        ent.tid = tidf->s;
+        ent.job = jobf->s;
+        if (const JV* g2 = sp.get("grp"))
+          if (g2->t == JV::STR) ent.grp = g2->s;
+        ent.sec = secf->as_int();
+        trace_fifo_.push_back(tidf->s);
+        while (trace_fifo_.size() > 4096) {
+          traces_.erase(trace_fifo_.front());
+          trace_fifo_.pop_front();
+        }
+      }
+      std::string node;
+      if (const JV* nf = sp.get("node"))
+        if (nf->t == JV::STR) node = nf->s;
+      NodeSpan& ns = ent.nodes[node];
+      if (const JV* f = sp.get("ok")) ns.ok = !(f->t == JV::BOOL && !f->b);
+      auto D = [&](const char* k, double& dst) {
+        if (const JV* f = tsf->get(k))
+          if (f->t == JV::INT || f->t == JV::DBL) dst = f->as_dbl();
+      };
+      D("b", ns.b);
+      D("recv", ns.recv);
+      D("claim", ns.claim);
+      D("start", ns.start);
+      D("end", ns.end);
+      D("flush", ns.flush);
+      double ms[6];
+      bool present[6];
+      trace_stage_ms(ent.sec, ns, ms, present);
+      for (int i = 0; i < 6; i++) {
+        if (!present[i]) continue;
+        int bi = 0;
+        while (bi < 13 && ms[i] > kTraceBucketsMs[bi]) bi++;
+        trace_hist_[i][bi]++;
+        trace_sum_[i] += ms[i];
+        trace_cnt_[i]++;
+      }
+      trace_spans_++;
+    }
+  }
+
+  void span_json(std::string& out, const TraceEnt& ent,
+                 const std::string& node, const NodeSpan& s) {
+    out += "{\"tid\":\"" + ent.tid + "\",\"job\":";
+    jesc(out, ent.job);
+    out += ",\"grp\":";
+    jesc(out, ent.grp);
+    out += ",\"sec\":";
+    jint(out, ent.sec);
+    out += ",\"node\":";
+    jesc(out, node);
+    out += ",\"ok\":";
+    out += s.ok ? "true" : "false";
+    out += ",\"ts\":{";
+    bool first = true;
+    auto T = [&](const char* k, double v) {
+      if (v <= 0) return;
+      if (!first) out += ',';
+      first = false;
+      out += '"';
+      out += k;
+      out += "\":";
+      jdbl(out, v);
+    };
+    T("b", s.b);
+    T("recv", s.recv);
+    T("claim", s.claim);
+    T("start", s.start);
+    T("end", s.end);
+    T("flush", s.flush);
+    out += "}}";
+  }
+
+  void trace_get(const std::string& job, long long sec,
+                 std::string& res) {
+    std::string tid = std::to_string(
+        trace_fnv1a64(job + "|" + std::to_string(sec)));
+    std::lock_guard<std::mutex> g(mu);
+    res += '[';
+    auto it = traces_.find(tid);
+    if (it != traces_.end()) {
+      bool first = true;
+      for (const auto& [node, s] : it->second.nodes) {
+        if (!first) res += ',';
+        first = false;
+        span_json(res, it->second, node, s);
+      }
+    }
+    res += ']';
+  }
+
+  void trace_top(long long n, std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    if (n < 1) n = 1;
+    res += '[';
+    bool firstent = true;
+    size_t start = trace_fifo_.size() > (size_t)n
+                       ? trace_fifo_.size() - (size_t)n
+                       : 0;
+    for (size_t i = start; i < trace_fifo_.size(); i++) {
+      auto it = traces_.find(trace_fifo_[i]);
+      if (it == traces_.end() || it->second.nodes.empty()) continue;
+      const TraceEnt& ent = it->second;
+      if (!firstent) res += ',';
+      firstent = false;
+      double total = 0;
+      std::string nodes = "[";
+      bool firstnode = true;
+      for (const auto& [node, s] : ent.nodes) {
+        if (!firstnode) nodes += ',';
+        firstnode = false;
+        double nt = trace_total_ms(ent.sec, s);
+        total = std::max(total, nt);
+        nodes += "{\"node\":";
+        jesc(nodes, node);
+        nodes += ",\"ok\":";
+        nodes += s.ok ? "true" : "false";
+        nodes += ",\"stages\":{";
+        double ms[6];
+        bool present[6];
+        trace_stage_ms(ent.sec, s, ms, present);
+        bool firststage = true;
+        for (int k = 0; k < 6; k++) {
+          if (!present[k]) continue;
+          if (!firststage) nodes += ',';
+          firststage = false;
+          nodes += '"';
+          nodes += kTraceStages[k];
+          nodes += "\":";
+          jdbl(nodes, ms[k]);
+        }
+        nodes += "},\"total_ms\":";
+        jdbl(nodes, nt);
+        nodes += "}";
+      }
+      nodes += "]";
+      res += "{\"tid\":\"" + ent.tid + "\",\"job\":";
+      jesc(res, ent.job);
+      res += ",\"grp\":";
+      jesc(res, ent.grp);
+      res += ",\"sec\":";
+      jint(res, ent.sec);
+      res += ",\"total_ms\":";
+      jdbl(res, total);
+      res += ",\"nodes\":";
+      res += nodes;
+      res += "}";
+    }
+    res += ']';
+  }
+
+  void trace_stats(std::string& res) {
+    std::lock_guard<std::mutex> g(mu);
+    res += "{\"spans_total\":";
+    jint(res, trace_spans_);
+    res += ",\"stages\":{";
+    bool first = true;
+    for (int i = 0; i < 6; i++) {
+      if (!trace_cnt_[i]) continue;
+      if (!first) res += ',';
+      first = false;
+      res += '"';
+      res += kTraceStages[i];
+      res += "\":{\"buckets\":[";
+      for (int b = 0; b < 14; b++) {
+        if (b) res += ',';
+        jint(res, trace_hist_[i][b]);
+      }
+      res += "],\"sum\":";
+      jdbl(res, trace_sum_[i]);
+      res += ",\"count\":";
+      jint(res, trace_cnt_[i]);
+      res += "}";
+    }
+    res += "}}";
   }
 
   void upsert_node(const std::string& id, const std::string& doc, bool alived) {
@@ -1413,6 +1667,13 @@ class LogStore {
   std::string logmap_;
   std::unordered_map<std::string, long long> idem_;
   std::deque<std::string> idem_fifo_;
+  // trace plane (all under mu)
+  std::unordered_map<std::string, TraceEnt> traces_;
+  std::deque<std::string> trace_fifo_;
+  long long trace_hist_[6][14] = {{0}};
+  double trace_sum_[6] = {0};
+  long long trace_cnt_[6] = {0};
+  long long trace_spans_ = 0;
   Wal wal_storage_;
   Wal* wal_ = nullptr;
 };
@@ -1480,7 +1741,8 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
       out += ",\"e\":\"bad record\"}\n";
       return;
     }
-    store.create_many(recs, arg_s(args, 1), res);
+    store.create_many(recs, arg_s(args, 1), res,
+                      args.arr.size() > 2 ? &args.arr[2] : nullptr);
   } else if (op == "query_logs") {
     store.query(args.arr.empty() ? JV{} : args.arr[0], res);
   } else if (op == "get_log") {
@@ -1504,6 +1766,13 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
       hash = arg_s(args, 1);
     }
     store.logmap(n, hash, res);
+  } else if (op == "trace_get") {
+    store.trace_get(arg_s(args, 0),
+                    args.arr.size() > 1 ? args.arr[1].as_int() : 0, res);
+  } else if (op == "trace_top") {
+    store.trace_top(args.arr.empty() ? 256 : args.arr[0].as_int(), res);
+  } else if (op == "trace_stats") {
+    store.trace_stats(res);
   } else if (op == "stat_overall") {
     store.stat("", res);
   } else if (op == "stat_day") {

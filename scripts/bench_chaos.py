@@ -110,16 +110,26 @@ class Fleet:
                  n_scheds=1, lease_ttl=2.0, dispatch_ttl=300.0,
                  shard_deadline=0.0, window_s=2, agent_ttl=10.0,
                  proc_ttl=600.0, block_jobs=(), checkpoint_dir=None,
-                 client_timeout=8.0, backend="py"):
+                 client_timeout=8.0, backend="py", trace_shift=-1,
+                 sched_shard_deadline=None, publish_lanes=0):
         self.seed = seed
         self.n_jobs = n_jobs
         self.client_timeout = client_timeout
         self.shard_deadline = shard_deadline
+        # the scheduler's client can arm a DIFFERENT deadline than the
+        # agents': a publisher behind an open breaker fail-fasts its
+        # window writes and the plan cursor rewinds forever, so the
+        # brownout-dispatch drill arms agents only (publishes wait out
+        # the slow shard; its orders are late, not lost)
+        self.sched_shard_deadline = shard_deadline \
+            if sched_shard_deadline is None else sched_shard_deadline
         self.backend = backend
         self.ks = KS
         self.ledger = []
         self.ledger_mu = threading.Lock()
         self.step_errors = 0        # faulted-window step/poll failures
+        self.agent_ttl = agent_ttl
+        self._last_ka = 0.0         # drive()'s keepalive cadence anchor
         self._clients = []
 
         # store shards, each behind its own proxy (schedule seeds are
@@ -172,7 +182,8 @@ class Fleet:
             a = NodeAgent(self.store_client(), self.sink_client(),
                           node_id=f"node-{i}", ttl=agent_ttl,
                           proc_ttl=proc_ttl, lock_ttl=120.0,
-                          proc_req=0.0, executor=ex)
+                          proc_req=0.0, executor=ex,
+                          trace_shift=trace_shift)
             a.register()
             self.agents.append(a)
 
@@ -185,10 +196,12 @@ class Fleet:
         self.dead_scheds = []
         for i in range(n_scheds):
             self.scheds.append(SchedulerService(
-                self.store_client(), job_capacity=cap, node_capacity=64,
+                self.store_client(deadline=self.sched_shard_deadline),
+                job_capacity=cap, node_capacity=64,
                 window_s=window_s, lease_ttl=lease_ttl,
                 dispatch_ttl=dispatch_ttl, node_id=f"sched-{i}",
-                checkpoint_dir=checkpoint_dir))
+                checkpoint_dir=checkpoint_dir, trace_shift=trace_shift,
+                publish_lanes=publish_lanes))
 
         # auditor connections (never faulted mid-drill: audits run
         # after heal)
@@ -197,14 +210,15 @@ class Fleet:
 
     # -- client factories --------------------------------------------------
 
-    def store_client(self):
+    def store_client(self, deadline=None):
         conns = [RemoteStore("127.0.0.1", p.port,
                              timeout=self.client_timeout)
                  for p in self.store_proxies]
         if len(conns) == 1:
             c = conns[0]
         else:
-            c = ShardedStore(conns, shard_deadline=self.shard_deadline)
+            c = ShardedStore(conns, shard_deadline=self.shard_deadline
+                             if deadline is None else deadline)
         self._clients.append(c)
         return c
 
@@ -243,6 +257,29 @@ class Fleet:
 
     # -- drive/settle ------------------------------------------------------
 
+    def keepalive_agents(self):
+        """Run the agents' lease keepalives at the production cadence
+        (``ttl / 3`` — agent.start()'s keepalive_loop).  Drills drive
+        ``poll()`` by hand and never start that thread, so without this
+        any drill whose WALL time outruns ``agent_ttl`` watches every
+        node lease expire mid-drill: the node keys vanish, the
+        scheduler marks the whole fleet dead and silently stops
+        publishing (found as total dispatch starvation in the paced
+        brownout_dispatch drill — the only drill long enough to hit
+        it).  Exceptions are swallowed exactly like the production
+        loop's: a faulted store must not kill liveness, and the
+        composite keepalive already treats a degraded shard's leg as
+        its own bounded loss."""
+        now = time.monotonic()
+        if now - self._last_ka < max(1.0, self.agent_ttl / 3):
+            return
+        self._last_ka = now
+        for a in self.live_agents():
+            try:
+                a.keepalive_once()
+            except Exception:  # noqa: BLE001 — faulted plane
+                pass
+
     def live_scheds(self):
         return [s for s in self.scheds if s not in self.dead_scheds]
 
@@ -265,6 +302,7 @@ class Fleet:
                     sc.step(now=t)
                 except Exception:  # noqa: BLE001 — faulted plane
                     self.step_errors += 1
+            self.keepalive_agents()
             for a in self.live_agents():
                 try:
                     a.poll()
@@ -317,6 +355,7 @@ class Fleet:
         deadline = time.monotonic() + timeout
         stable = 0
         while time.monotonic() < deadline:
+            self.keepalive_agents()
             for a in self.live_agents():
                 try:
                     a.poll()
@@ -738,6 +777,235 @@ def drill_brownout(seed=19, reads=150, delay_ms=250.0,
         fleet.close()
 
 
+def drill_brownout_dispatch(seed=37, delay_ms=250.0, deadline_s=0.08,
+                            on_log=print):
+    """Brownout under LIVE DISPATCH LOAD (the ROADMAP remainder — the
+    read-plane drill above measures dashboards, this one measures
+    FIRES): one of two store shards answers 250 ms late while the
+    scheduler keeps publishing and both agents keep claiming.  With
+    the per-shard breakers armed, fires whose keys avoid the degraded
+    shard must stay within 2x the healthy baseline, exactly-once must
+    hold fleet-wide, and the trace plane's waterfalls of the SLOW
+    fires are the drill's diagnostic artifact (which stage ate the
+    brownout)."""
+    from cronsun_tpu import trace as _trace
+    from cronsun_tpu.store.sharded import shard_index
+    # publish_lanes=4: a browned-out shard slows ITS put legs; extra
+    # lanes keep one slow second's publish from serializing the next
+    # second's healthy keys behind it (the PR 2 knob, production conf)
+    fleet = Fleet(seed=seed, n_jobs=24, n_agents=2, store_shards=2,
+                  shard_deadline=deadline_s, sched_shard_deadline=0.0,
+                  trace_shift=0, publish_lanes=4)
+    try:
+        # Pin each job to the agent whose SHARD its fence routes to:
+        # node-X runs only jobs whose whole key family (fence by job,
+        # bundle/proc by node) lives on one shard, so "fires that
+        # avoid the degraded shard" is a property of the LAYOUT, not
+        # luck — the gate's population.  (A mixed bundle claims both
+        # shards in one claim_bundle and every member rides the slow
+        # sub-claim; production fleets see both shapes, the gate needs
+        # the separable one.)
+        node_shard = {a.id: shard_index(
+            KS.dispatch_bundle_key(a.id, 0), 2) for a in fleet.agents}
+        by_shard = {s: [a for a, sh in node_shard.items() if sh == s]
+                    for s in (0, 1)}
+        healthy_ids, degraded_ids = [], []
+        i = 0
+        while len(healthy_ids) < 10 or len(degraded_ids) < 10:
+            jid = f"bd{i:04d}"
+            i += 1
+            s = shard_index(KS.lock_key(jid, 0), 2)
+            tgt = healthy_ids if s == 0 else degraded_ids
+            if len(tgt) >= 10:
+                continue
+            # prefer a node on the same shard; fall back to any agent
+            nodes = by_shard[s] or [a.id for a in fleet.agents]
+            job = Job(id=jid, name=jid, command="true",
+                      kind=KIND_INTERVAL,
+                      rules=[JobRule(timer="* * * * * *",
+                                     nids=[nodes[0]])])
+            job.check()
+            fleet.audit_store.put(KS.job_key(job.group, job.id),
+                                  job.to_json())
+            tgt.append(jid)
+        jobs = healthy_ids + degraded_ids
+        deadline_reg = time.monotonic() + 10.0
+        while time.monotonic() < deadline_reg:
+            for sc in fleet.live_scheds():
+                sc.drain_watches()
+            if all(sc.rows.rules_of("default", jid)
+                   for sc in fleet.live_scheds() for jid in jobs):
+                break
+            time.sleep(0.02)
+        sink = fleet.logd.sink
+
+        def fire_lats(lo, hi):
+            """Per-fire dispatch latency (order BUILT -> exec start;
+            wall stamps stay valid over synthetic seconds) keyed by
+            (job, sec), from the trace plane."""
+            out = {}
+            for jid in jobs:
+                for sec in range(lo, hi):
+                    for sp in sink.trace_get(jid, sec):
+                        ts = sp.get("ts", {})
+                        a = ts.get("b") or ts.get("recv")
+                        if a and ts.get("start"):
+                            out[(jid, sec)] = (ts["start"] - a) * 1e3
+            return out
+
+        # healthy baseline
+        mid = fleet.drive(T0, T0 + 3)
+        fleet.settle(timeout=30.0)
+        base = fire_lats(T0 + 1, mid)
+        base_p99 = pctl(list(base.values()), 0.99)
+
+        # 250 ms brownout on shard 1, dispatch still live underneath.
+        # The faulted window drives at ~real time (the rest of the
+        # drill free-runs synthetic seconds): each second's window
+        # pays the slow shard's 250 ms on its publish lane, so
+        # free-running 5 synthetic seconds in 1 wall second would
+        # measure an artificial publisher backlog no real-time fleet
+        # has — pacing keeps the lane caught up, which is the claim
+        # under test (healthy fires, not publisher head-of-line).
+        el = fleet.store_proxies[1].elapsed()
+        rid = fleet.store_scheds[1].add("delay", start=el, ms=delay_ms,
+                                        direction="s2c")
+        # pace >= the publish plane's per-window cost on the slow
+        # shard (per planned second: bundle put_many + HWM advance,
+        # ~2 RPCs x delay_ms; a drive() iteration advances a whole
+        # window_s=2 window).  Agents keep POLLING through the pace
+        # window — a once-per-iteration poll would stamp every
+        # receipt a full pace late and measure the drill loop, not
+        # the plane.
+        def pace(_t):
+            until = time.monotonic() + max(0.8, delay_ms / 1e3 * 5)
+            while time.monotonic() < until:
+                for a in fleet.live_agents():
+                    try:
+                        a.poll()
+                    except Exception:  # noqa: BLE001 — faulted plane
+                        pass
+                time.sleep(0.05)
+        end = fleet.drive(mid, mid + 7, stall_timeout=120.0,
+                          on_second=pace)
+        fleet.store_scheds[1].remove(rid)
+        time.sleep(1.0)        # breaker cooldown probe closes shard 1
+        for a in fleet.live_agents():
+            try:
+                # re-list leftover bundles the fail-fast claims left
+                # leased (the redelivery half of the breaker contract)
+                a.resync_watches()
+            except Exception:  # noqa: BLE001 — still healing
+                pass
+        # a publish timing out right at the fault boundary leaves a
+        # HOLE at the tail window; two healed seconds let the rewind
+        # re-plan it (late, never lost — the production loop's path)
+        end = fleet.drive(end, end + 2, stall_timeout=60.0)
+        fleet.settle(timeout=45.0)
+
+        lats = fire_lats(mid + 1, end)
+        # the gate covers the fault's STEADY interior: the first
+        # faulted second is the breaker's detection episode
+        # (fail_threshold slow calls per shard client) and the last
+        # window's publish is truncated mid-flight when the drive
+        # stops pacing — both are reported in the full ``lats`` set,
+        # neither is the sustained-brownout claim under test
+        steady = {k: v for k, v in lats.items()
+                  if mid + 1 < k[1] < end - 2}
+        healthy_lats = [v for (jid, _s), v in steady.items()
+                        if jid in set(healthy_ids)]
+        degraded_lats = [v for (jid, _s), v in steady.items()
+                         if jid in set(degraded_ids)]
+        # coverage gate over the HEALTHY population only: the degraded
+        # shard's fires are late (post-heal redelivery) or consumed by
+        # a fence their interrupted claim already burned — the PR 6/12
+        # at-most-once brownout contract; counted, not failed
+        findings, info = fleet.audit(expect_jobs=healthy_ids,
+                                     planned_range=(T0 + 1, end))
+        # a degraded-shard proc key whose post-exec delete was refused
+        # by the open breaker is LEASED residue (expires at proc_ttl),
+        # not a leak — count it, don't fail on it
+        residual = [f for f in findings if f.code == "orphan_proc" and
+                    shard_index(f.key, 2) == 1]
+        findings = [f for f in findings if f not in residual]
+        with fleet.ledger_mu:
+            ran = {(j, s) for j, s in fleet.ledger}
+        degraded_missing = sum(
+            1 for jid in degraded_ids for sec in range(mid + 1, end)
+            if (jid, sec) not in ran)
+        res = {
+            "baseline_fire_p99_ms": round(base_p99, 2),
+            "healthy_fire_p99_ms": round(pctl(healthy_lats, 0.99), 2),
+            "degraded_fire_p99_ms": round(pctl(degraded_lats, 0.99), 2),
+            "healthy_fires": len(healthy_lats),
+            "degraded_fires": len(degraded_lats),
+            "degraded_fires_missing_in_window": degraded_missing,
+            "degraded_proc_residue": len(residual),
+            "delay_ms": delay_ms,
+            "node_shards": node_shard,
+        }
+        info.update(res)
+        if not healthy_lats:
+            findings.append(invariants.Finding(
+                "no_healthy_fires", "",
+                "no fire avoided the degraded shard (seed layout?)"))
+        # the bound: 2x the healthy baseline, floored at the publish
+        # plane's structural cost on the slow shard — per planned
+        # second the publisher pays ~2 slow RPCs (the window's
+        # composite dispatch-lease grant leg amortized, plus the
+        # second's bundle put_many leg), seconds serialize per window
+        # inside the one publish worker, and the proxied connection
+        # stacks concurrent delayed replies (instrumented: grants
+        # 250-500 ms, gets up to 1 s mid-fault) — so the LAST second
+        # of a window_s window observes ~2 x window_s x delay.
+        # Per-shard publish decoupling is the ROADMAP follow-on.  The
+        # gate still catches every coupling this drill flushed out
+        # while being built: the synchronous HWM get+CAS on the
+        # publish path (+250 ms x seconds, compounding), composite
+        # lease grants failing whole on one open breaker (healthy
+        # agents losing their fence plane), cleanup RPCs destroying
+        # finished executions' records, and the harness's own silent
+        # node-lease expiry — each landed at 4-10x this bound (or
+        # starved dispatch outright).
+        bound = max(2.0 * base_p99,
+                    (2.0 * fleet.scheds[0].window_s + 0.5) * delay_ms)
+        if healthy_lats and res["healthy_fire_p99_ms"] > bound:
+            findings.append(invariants.Finding(
+                "brownout_dispatch_unbounded", "",
+                f"healthy-shard fire p99 {res['healthy_fire_p99_ms']}ms "
+                f"exceeds {bound:.1f}ms (max(2x baseline "
+                f"{res['baseline_fire_p99_ms']}ms, 2.5x delay)) — "
+                "breaker fail-fast did not contain the brownout"))
+        # diagnostic artifact: the slowest fires' waterfalls name the
+        # stage that ate the brownout
+        slowest = sorted(lats.items(), key=lambda kv: -kv[1])[:3]
+        slowest += sorted(
+            ((k, v) for k, v in lats.items() if k[0] in set(healthy_ids)),
+            key=lambda kv: -kv[1])[:3]
+        waterfalls = []
+        for (jid, sec), ms in slowest:
+            wf = _trace.assemble(jid, sec, sink.trace_get(jid, sec))
+            if wf:
+                stages = wf["nodes"][0]["stages"]
+                # drills run over SYNTHETIC seconds: the sched stage
+                # (wall "b" vs synthetic second) is meaningless here
+                stages.pop("sched", None)
+                waterfalls.append({"job": jid, "sec": sec,
+                                   "fire_ms": round(ms, 1),
+                                   "stages": stages})
+                on_log(f"  slow fire {jid}@{sec}: {round(ms, 1)}ms "
+                       f"stages={stages}")
+        info["slow_waterfalls"] = waterfalls
+        on_log(f"brownout_dispatch: baseline p99 "
+               f"{res['baseline_fire_p99_ms']}ms, healthy-shard p99 "
+               f"{res['healthy_fire_p99_ms']}ms, degraded-shard p99 "
+               f"{res['degraded_fire_p99_ms']}ms, "
+               f"{len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
 def drill_ckpt_race(seed=23, on_log=print):
     """Checkpoint save racing a store partition: saves land or fail
     LOUDLY (no torn/adopted state), the scheduler keeps dispatching
@@ -862,6 +1130,7 @@ DRILLS = {
     "shard_partition": drill_shard_partition,
     "logd_flap": drill_logd_flap,
     "brownout": drill_brownout,
+    "brownout_dispatch": drill_brownout_dispatch,
     "ckpt_race": drill_ckpt_race,
     "agent_kill": drill_agent_kill,
 }

@@ -283,6 +283,44 @@ def test_bench_sched_dag_smoke():
     assert res["dag_chain_p99_ms"] > 0
 
 
+def test_bench_sched_trace_smoke():
+    """Tier-1 smoke for the trace-plane bench (ISSUE 14 satellite): a
+    quick live-fleet run must assemble per-stage latencies from real
+    sampled spans (every wire stage present, durations non-negative)
+    and the paired sampling-overhead leg must produce both arms.  The
+    < 2% gate itself runs at the 50k x 512 shape (slow tier / bench.py
+    full runs) — single-step timings at this toy shape are noise."""
+    import bench_sched
+    res = bench_sched.run_trace_bench(
+        n_jobs=800, n_nodes=32, steps=4, window_s=2, traced_jobs=12,
+        seconds=4, on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res["trace_stage_fires"] > 0
+    stages = res["trace_stage_p99_ms"]
+    for st in ("publish", "claim", "queue", "run", "record"):
+        assert st in stages, f"stage {st} missing from {stages}"
+        assert stages[st] >= 0.0
+    assert res["trace_overhead_on_p99_ms"] > 0
+    assert res["trace_overhead_off_p99_ms"] > 0
+
+
+@pytest.mark.slow
+def test_bench_sched_trace_overhead_gate():
+    """ISSUE 14 acceptance: at 50k jobs x 512 nodes, head sampling at
+    the default shift costs < 2% step p99 vs CRONSUN_TRACE=off
+    (trace_shift=-1 — the exact construction-time effect of the env
+    switch, byte-identical order wire pinned by test_trace)."""
+    import bench_sched
+    res = bench_sched.run_trace_bench(
+        n_jobs=50_000, n_nodes=512, steps=12, window_s=4,
+        traced_jobs=64, seconds=6,
+        on_log=lambda *a: print(*a, file=sys.stderr))
+    assert res["trace_stage_fires"] > 0
+    assert res["trace_overhead_gate_ok"] == 1, (
+        f"sampling-on p99 {res['trace_overhead_on_p99_ms']}ms vs off "
+        f"{res['trace_overhead_off_p99_ms']}ms (ratio "
+        f"{res['trace_overhead_ratio']})")
+
+
 def test_bench_query_smoke():
     """Tier-1 smoke for the read-plane bench: a short run against one
     py-logd shard with concurrent readers and a full-drain writer must

@@ -63,6 +63,26 @@ def test_brownout_drill_bounded_p99():
 
 @pytest.mark.slow
 @pytest.mark.chaos
+def test_brownout_dispatch_drill():
+    """ISSUE 14 satellite (ROADMAP chaos remainder): 250 ms store-shard
+    delay under LIVE dispatch load — breaker fail-fast must keep fires
+    that avoid the degraded shard within the publish plane's structural
+    bound (~2 x window_s x delay; 2x baseline when larger), with
+    exactly-once intact fleet-wide and the slow fires' trace waterfalls
+    naming the stage that ate the brownout."""
+    res = _run("brownout_dispatch")
+    assert res["findings"] == [], res["findings"]
+    info = res["info"]
+    assert info["lost_fires"] == 0
+    assert info["healthy_fires"] > 0 and info["degraded_fires"] > 0
+    assert info["degraded_fire_p99_ms"] >= info["delay_ms"]
+    assert info["slow_waterfalls"], "no trace waterfalls captured"
+    stages = info["slow_waterfalls"][0]["stages"]
+    assert "publish" in stages and "claim" in stages
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
 def test_native_backend_drill():
     """ISSUE 13 satellite (PR 12 chaos-plane remainder): the smoke
     fault set against the NATIVE stored/logd backends — the FaultProxy

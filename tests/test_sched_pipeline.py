@@ -321,3 +321,34 @@ def test_pipeline_smoke_bench_cpu():
     finally:
         store.close()
         srv.stop()
+
+
+def test_hwm_advance_retries_failed_write_before_flush_reports_done():
+    """flush()'s contract is 'the latest landed HWM mark is WRITTEN'.
+    A failed _advance_hwm must therefore keep retrying (not be marked
+    done and silently dropped) — otherwise a kill drill right after a
+    store blip restores from a mark that never landed."""
+    from cronsun_tpu.sched.publisher import OrderPublisher
+
+    class Lane:
+        def put_many(self, chunk, lease=0):
+            pass
+
+    landed = []
+    fails = [2]                       # first two advances blow up
+
+    def advance(v):
+        if fails[0] > 0:
+            fails[0] -= 1
+            raise RuntimeError("store blip")
+        landed.append(v)
+
+    pub = OrderPublisher([Lane()], advance)
+    try:
+        pub.submit([(100, [("k", "v")])], lease=0, hwm=100)
+        # flush must block through both failures (0.5 s retry pacing)
+        # and only report True once the mark actually landed
+        assert pub.flush(timeout=10.0)
+        assert len(landed) == 1 and landed[0] >= 100
+    finally:
+        pub.stop(timeout=5.0)

@@ -1,0 +1,242 @@
+"""SLO engine: spec validation, burn-rate math, and the breach drill —
+an injected exec-latency regression on one tenant flips the fast-burn
+alert, pages exactly once (rate-limited), and clears after recovery.
+"""
+
+import json
+import time
+
+import pytest
+
+from cronsun_tpu import trace
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.core.models import SloSpec, ValidationError
+from cronsun_tpu.logsink import JobLogStore
+from cronsun_tpu.node.agent import NodeAgent
+from cronsun_tpu.node.executor import ExecResult
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.web.slo import SloEngine
+
+KS = Keyspace()
+
+
+def test_slo_spec_validation():
+    SloSpec(name="a", target=0.999).validate()
+    SloSpec(name="a", scope="tenant:acme", target=0.9,
+            latency_ms=500).validate()
+    SloSpec(name="a", scope="chain:g/j1", target=0.99).validate()
+    for bad in (SloSpec(name="", target=0.9),
+                SloSpec(name="a", target=0.0),
+                SloSpec(name="a", target=1.0),
+                SloSpec(name="a", scope="team:x", target=0.9),
+                SloSpec(name="a", scope="chain:nogroup", target=0.9),
+                SloSpec(name="a/b", target=0.9),
+                SloSpec(name="a", target=0.9, latency_ms=-1)):
+        with pytest.raises(ValidationError):
+            bad.validate()
+    assert SloSpec(name="a", scope="tenant:acme").counter_scope \
+        == "t:acme"
+    assert SloSpec(name="a", scope="chain:g/j").counter_scope == "c:g/j"
+    assert SloSpec(name="a").counter_scope == ""
+
+
+def _snap(store, node, scope, count, fail, slow=0, slow_fail=None):
+    """Publish one agent snapshot: ``slow`` of the ``count`` total
+    landed past every finite bucket (the latency-regression shape).
+    ``slow_fail`` None omits the failure buckets entirely (a legacy
+    agent); an int places that many failures in the slow bucket and
+    the rest in the fast one."""
+    buckets = [count - slow] + [0] * (len(trace.BUCKETS_MS) - 1) + [slow]
+    ent = {"count": count, "fail": fail, "sum_ms": 0.0,
+           "buckets": buckets}
+    if slow_fail is not None:
+        ent["fbuckets"] = ([fail - slow_fail]
+                           + [0] * (len(trace.BUCKETS_MS) - 1)
+                           + [slow_fail])
+    store.put(KS.metrics_key("node", node), json.dumps(
+        {"slo": {scope: ent}}))
+
+
+def test_burn_rate_latency_threshold_from_buckets():
+    store = MemStore()
+    t = [1_700_000_000.0]
+    eng = SloEngine(store, ks=KS, clock=lambda: t[0])
+    spec = SloSpec(name="lat", scope="tenant:acme", target=0.99,
+                   latency_ms=1000.0)
+    _snap(store, "n1", "t:acme", 100, 0, slow=0)
+    eng.tick()
+    t[0] += 60
+    # 50 more execs, 25 of them slower than the 1000 ms threshold —
+    # counted bad purely from the histogram buckets, zero failures
+    _snap(store, "n1", "t:acme", 150, 0, slow=25)
+    eng.tick()
+    burn = eng.burn_rates(spec)
+    assert burn["5m"] == pytest.approx(0.5 / 0.01, rel=0.01)
+    store.close()
+
+
+def test_burn_rate_counts_slow_successes_despite_fast_failures():
+    """bad = failed OR slow, exactly: 20 FAST failures must not mask
+    10 slow successes (the failure-bucket joint).  Without fbuckets
+    the engine's clamp assumed every failure was slow and undercounted
+    bad by the whole slow-success population."""
+    store = MemStore()
+    t = [1_700_000_000.0]
+    eng = SloEngine(store, ks=KS, clock=lambda: t[0])
+    spec = SloSpec(name="joint", target=0.9, latency_ms=1000.0)
+    _snap(store, "n1", "", 0, 0, slow=0, slow_fail=0)
+    eng.tick()
+    t[0] += 60
+    # 100 new execs: 20 fast failures + 10 slow successes + 70 fast OK
+    _snap(store, "n1", "", 100, 20, slow=10, slow_fail=0)
+    eng.tick()
+    # true bad = 30 -> frac 0.3 / budget 0.1 = 3.0 (legacy clamp: 2.0)
+    assert eng.burn_rates(spec)["5m"] == pytest.approx(3.0, rel=0.01)
+
+    # legacy snapshot (no fbuckets at all): conservative fallback —
+    # failures assumed slow, burn = max(fail, slow)/total/budget = 2.0
+    eng2 = SloEngine(store, ks=KS, clock=lambda: t[0] - 60)
+    _snap(store, "n1", "", 0, 0)
+    eng2.tick()
+    eng2.clock = lambda: t[0]
+    _snap(store, "n1", "", 100, 20, slow=10)
+    eng2.tick()
+    assert eng2.burn_rates(spec)["5m"] == pytest.approx(2.0, rel=0.01)
+    store.close()
+
+
+def test_deleted_spec_pruned_from_state():
+    """`slo rm` of an ALERTING spec must drop its state (and gauges)
+    at the next tick, not render cronsun_slo_alert forever."""
+    store = MemStore()
+    t = [1_700_000_000.0]
+    eng = SloEngine(store, ks=KS, clock=lambda: t[0])
+    store.put(KS.slo_key("doomed"),
+              SloSpec(name="doomed", target=0.99).to_json())
+    _snap(store, "n1", "", 100, 0)
+    eng.tick()
+    t[0] += 60
+    _snap(store, "n1", "", 200, 100)   # 100% bad -> alerting
+    eng.tick()
+    assert eng.snapshot()["slos"]["doomed"]["alert"] == "fast"
+    store.delete(KS.slo_key("doomed"))
+    t[0] += 15
+    eng.tick()
+    assert "doomed" not in eng.snapshot()["slos"]
+    store.close()
+
+
+def test_burn_rate_sums_across_agents():
+    store = MemStore()
+    t = [1_700_000_000.0]
+    eng = SloEngine(store, ks=KS, clock=lambda: t[0])
+    spec = SloSpec(name="g", target=0.9)
+    _snap(store, "n1", "", 50, 0)
+    _snap(store, "n2", "", 50, 0)
+    eng.tick()
+    t[0] += 60
+    _snap(store, "n1", "", 100, 25)
+    _snap(store, "n2", "", 100, 25)
+    eng.tick()
+    # 50 bad / 100 new across both agents -> 0.5 frac / 0.1 budget = 5
+    assert eng.burn_rates(spec)["5m"] == pytest.approx(5.0, rel=0.01)
+    store.close()
+
+
+def test_breach_drill_fast_alert_one_notice_and_recovery():
+    """The acceptance drill, with REAL agent counters: a latency
+    regression injected into one tenant's executions flips the fast
+    burn alert within its window, writes exactly ONE rate-limited
+    notice key, keeps burning without re-paging, and clears once the
+    regression ages out of every window."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="na")
+    agent.register()
+    from cronsun_tpu.core import Job, JobRule, KIND_INTERVAL
+    job = Job(name="tj", command="true", kind=KIND_INTERVAL,
+              tenant="acme",
+              rules=[JobRule(timer="* * * * * *", nids=["na"])])
+    job.check()
+
+    def execs(n, seconds):
+        """n executions of the tenant's job at the given run time —
+        the injected regression is just a slower ExecResult."""
+        now = time.time()
+        for _ in range(n):
+            agent._record(job, ExecResult(
+                success=True, output="", begin_ts=now,
+                end_ts=now + seconds))
+        agent.metrics._next_at = 0.0
+        agent.metrics.maybe_publish()
+
+    t = [1_700_000_000.0]
+    eng = SloEngine(store, ks=KS, clock=lambda: t[0],
+                    notice_interval_s=300.0)
+    store.put(KS.slo_key("acme-lat"), SloSpec(
+        name="acme-lat", scope="tenant:acme", target=0.99,
+        latency_ms=1000.0).to_json())
+
+    execs(200, 0.01)             # healthy baseline
+    eng.tick()
+    assert eng.snapshot()["slos"]["acme-lat"]["alert"] == ""
+
+    # REGRESSION: the tenant's runs jump to 5 s (> the 1000 ms SLO
+    # threshold); the fast alert must flip within the 5m window
+    t[0] += 60
+    execs(100, 5.0)
+    eng.tick()
+    st = eng.snapshot()["slos"]["acme-lat"]
+    assert st["alert"] == "fast", st
+    notices = [kv.key for kv in store.get_prefix(KS.noticer)]
+    assert notices == [f"{KS.prefix}/noticer/slo-acme-lat"]
+    body = json.loads(store.get(notices[0]).value)
+    assert "acme-lat" in body["subject"]
+
+    # still burning 2 minutes later: rate-limited — NO second notice
+    t[0] += 120
+    execs(100, 5.0)
+    eng.tick()
+    assert eng.snapshot()["slos"]["acme-lat"]["alert"] == "fast"
+    assert eng.stats["slo_notices_total"] == 1
+
+    # RECOVERY: healthy traffic while the bad window ages out
+    for _ in range(30):
+        t[0] += 1800
+        execs(50, 0.01)
+        eng.tick()
+    st = eng.snapshot()["slos"]["acme-lat"]
+    assert st["alert"] == "", st
+    assert eng.stats["slo_recoveries_total"] == 1
+    agent.stop()
+    store.close()
+
+
+def test_agent_slo_scopes():
+    """Agents count every execution into the global scope, the tenant
+    scope, and (DAG members) the chain scope — unbiased, not the
+    sampled subset."""
+    store, sink = MemStore(), JobLogStore()
+    agent = NodeAgent(store, sink, node_id="ns")
+    from cronsun_tpu.core import Job, JobRule, KIND_INTERVAL
+    from cronsun_tpu.core.models import DepSpec
+    plain = Job(name="p", command="true", kind=KIND_INTERVAL,
+                rules=[JobRule(timer="* * * * * *", nids=["ns"])])
+    plain.check()
+    chained = Job(name="c", command="true", kind=KIND_INTERVAL,
+                  tenant="acme", deps=DepSpec(on=[plain.id]),
+                  rules=[JobRule(nids=["ns"])])
+    chained.check()
+    now = time.time()
+    agent._record(plain, ExecResult(success=True, output="",
+                                    begin_ts=now, end_ts=now + 0.001))
+    agent._record(chained, ExecResult(success=False, output="",
+                                      begin_ts=now, end_ts=now + 3.0))
+    snap = agent.metrics_snapshot()
+    slo = snap["slo"]
+    assert slo[""]["count"] == 2 and slo[""]["fail"] == 1
+    assert slo["t:acme"]["count"] == 1
+    chain_scope = f"c:{chained.group}/{chained.id}"
+    assert slo[chain_scope]["count"] == 1
+    assert sum(slo[""]["buckets"]) == 2
+    agent.stop()
+    store.close()
