@@ -608,6 +608,28 @@ def main():
         except Exception as e:  # noqa: BLE001
             detail["sched_bench_error"] = str(e)
 
+    # ---- partitioned scheduler plane: the P-leader ladder ------------------
+    # The same job set planned by 1/2/4 independent partition leaders
+    # (ISSUE 15): aggregate planned-fire throughput over the slowest
+    # partition's busy time, per-partition step p99, FNV-split
+    # fairness, and zero fire-set divergence vs the P=1 scheduler
+    # (sched_partition_* keys).
+    if not quick:
+        log("partitioned scheduler plane: ladder 1,2,4 @ 200k jobs")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--partition-ladder", "1,2,4", "--jobs", "200000",
+                 "--nodes", "1024", "--steps", "6"],
+                capture_output=True, text=True, timeout=3600, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["partition_ladder_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["partition_ladder_error"] = str(e)
+
     # ---- workflow DAG plane: chain latency + exactly-once @ 50k ------------
     # Dependency-triggered jobs evaluated in the batched tick: a 3-stage
     # fan-out/fan-in DAG at 50k jobs x 512 nodes, chain-latency p50/p99

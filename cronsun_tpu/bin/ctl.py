@@ -343,6 +343,7 @@ def cmd_logs(api, args):
         "node": args.node,
         "ids": args.job,
         "names": args.names,
+        "tenant": args.tenant,
         "failedOnly": "true" if args.failed else None,
         "latest": "true" if args.latest else None,
         "page": args.page,
@@ -510,6 +511,38 @@ def cmd_passwd(api, args):
     api.call("POST", "/v1/user/setpwd",
              body={"password": old, "newPassword": new})
     print("password changed")
+
+
+def cmd_sched_status(api, args):
+    """Per-partition scheduler fleet view: who leads each job-space
+    slice, its step health, and whether any partition is leaderless —
+    a stalled partition must be one command away, not averaged into a
+    fleet mean."""
+    out = api.call("GET", "/v1/sched")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    p = out.get("partitions")
+    print(f"partitions: {p if p else 'unpartitioned'}")
+    rows = []
+    for d in out.get("instances", []):
+        part = d.get("partition")
+        rows.append([
+            "-" if part is None else part,
+            d["instance"],
+            "leader" if d.get("is_leader") else "standby",
+            d.get("jobs", 0),
+            d.get("dispatches_total", 0),
+            _fmt_ms(d.get("sched_step_p99_ms")),
+            d.get("lease_resigns_total", 0),
+            d.get("watch_losses_total", 0),
+            d.get("skipped_seconds_total", 0),
+        ])
+    table(rows, ["PART", "INSTANCE", "ROLE", "JOBS", "DISPATCHES",
+                 "STEP_P99", "RESIGNS", "WATCHLOSS", "SKIPPED"])
+    missing = out.get("leaderless") or []
+    if missing:
+        print(f"WARNING: leaderless partition(s): {missing}")
 
 
 def cmd_metrics(api, args):
@@ -966,6 +999,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default=None)
     p.add_argument("--job", default=None, help="job id (comma-list ok)")
     p.add_argument("--names", default=None, help="name substring")
+    p.add_argument("--tenant", default=None,
+                   help="only this tenant's jobs (enforced server-side "
+                        "for tenant-pinned accounts)")
     p.add_argument("--failed", action="store_true")
     p.add_argument("--latest", action="store_true",
                    help="latest record per (job, node)")
@@ -1024,6 +1060,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = add("passwd", cmd_passwd, "change your own password")
     p.add_argument("--old", default=None, help="prompted when omitted")
     p.add_argument("--new", default=None, help="prompted when omitted")
+    sch = sub.add_parser("sched",
+                         help="scheduler plane (partition leaders)")
+    schsub = sch.add_subparsers(dest="schedcmd", required=True)
+    p = schsub.add_parser("status",
+                          help="per-partition leaders, step health, "
+                               "leaderless partitions")
+    p.set_defaults(fn=cmd_sched_status)
+
     add("metrics", cmd_metrics, "Prometheus metrics text")
     add("checkpoint", cmd_checkpoint,
         "trigger store WAL snapshot + scheduler checkpoints (admin)")

@@ -102,7 +102,29 @@ def main(argv=None) -> int:
                     help="serve /healthz + /readyz on this port "
                          "(readiness: leader lease / watches / step "
                          "loop; 0 disables)")
+    ap.add_argument("--partitions", type=int, default=1, metavar="P",
+                    help="partitioned scheduler plane: total number of "
+                         "job-space partitions (the fleet runs one "
+                         "leader, plus standbys, per partition; the "
+                         "first leader pins sched/partmap and "
+                         "mismatched counts refuse to start; default "
+                         "1 = the unpartitioned scheduler)")
+    ap.add_argument("--partition", type=int, default=0, metavar="I",
+                    help="this scheduler's partition index in "
+                         "[0, --partitions)")
     args = ap.parse_args(argv)
+    if args.partitions < 1 or not 0 <= args.partition < args.partitions:
+        print(f"error: --partition {args.partition} out of range for "
+              f"--partitions {args.partitions}", file=sys.stderr)
+        return 2
+    if args.partitions > 1 and args.node_id == "scheduler-1":
+        # the default node id must not collide across partition
+        # processes OR between a partition's leader and its warm
+        # standbys launched with the same flags (it keys the leased
+        # metrics snapshot — a collision makes the fleet view flap);
+        # the pid disambiguates, operators wanting stable instance
+        # labels set explicit --node-id
+        args.node_id = f"scheduler-p{args.partition}-{os.getpid()}"
     if args.mesh2d is not None:
         try:
             dj, dn = (int(x) for x in args.mesh2d.lower().split("x"))
@@ -180,6 +202,26 @@ def main(argv=None) -> int:
         return 0
     store = connect_store(args.store, token=cfg.store_token, tls=cfg.store_tls,
                           prefix=cfg.prefix)
+    if args.partitions > 1:
+        # a duplicate --node-id across partition processes silently
+        # corrupts the fleet view (the leased metrics snapshot is
+        # keyed by instance — one partition's numbers overwrite the
+        # other's, readyz pages a healthy partition as leaderless):
+        # scheduling itself stays correct, so warn LOUDLY rather than
+        # refuse (the colliding snapshot may be our own previous
+        # incarnation's unexpired lease)
+        try:
+            kv = store.get(ks.metrics_key("sched", args.node_id))
+            other = (json.loads(kv.value).get("partition")
+                     if kv is not None else None)
+        except Exception:  # noqa: BLE001 — advisory check only
+            other = None
+        if other is not None and int(other) != args.partition:
+            log.errorf(
+                "node-id %r already publishes sched metrics as "
+                "partition %s — duplicate --node-id across partitions "
+                "corrupts /v1/sched and readyz; give each partition "
+                "process a distinct --node-id", args.node_id, other)
     sync_proxy = None
     if args.mesh_hosts > 1:
         from ..parallel.hostsync import PlannerSyncProxy
@@ -191,6 +233,12 @@ def main(argv=None) -> int:
     # planners are still refused by SchedulerService itself (it logs why)
     ckpt_dir = os.path.expanduser(cfg.checkpoint_dir) \
         if cfg.checkpoint_dir else None
+    if ckpt_dir and args.partitions > 1:
+        # per-partition checkpoint chains: each partition's built state
+        # is its own restore point (a foreign partition's checkpoint is
+        # refused by the restore's slice validation anyway)
+        ckpt_dir = os.path.join(ckpt_dir, f"p{args.partition}")
+        os.makedirs(ckpt_dir, exist_ok=True)
     sched = SchedulerService(
         store, ks=ks, job_capacity=cfg.job_capacity,
         node_capacity=cfg.node_capacity, window_s=cfg.window_s,
@@ -202,7 +250,8 @@ def main(argv=None) -> int:
         checkpoint_delta=cfg.checkpoint_delta,
         delta_max_chain=cfg.checkpoint_rebase_chain,
         delta_max_bytes=cfg.checkpoint_rebase_bytes,
-        trace_shift=cfg.trace_sample_shift)
+        trace_shift=cfg.trace_sample_shift,
+        partitions=args.partitions, partition=args.partition)
     sched.start()
     health = None
     if args.health_port:
@@ -219,8 +268,13 @@ def main(argv=None) -> int:
         health = HealthServer(
             {"leader": leader_check, "watches": watches_check},
             port=args.health_port).start()
-    log.infof("cronsun-sched %s up (store %s, tz %s)",
-              args.node_id, args.store, cfg.timezone)
+    if args.partitions > 1:
+        log.infof("cronsun-sched %s up (store %s, tz %s, partition "
+                  "%d/%d)", args.node_id, args.store, cfg.timezone,
+                  args.partition, args.partitions)
+    else:
+        log.infof("cronsun-sched %s up (store %s, tz %s)",
+                  args.node_id, args.store, cfg.timezone)
     print(f"READY {args.node_id}", flush=True)
     if sync_proxy is not None:
         # stop order matters: join the service loop FIRST so no plan

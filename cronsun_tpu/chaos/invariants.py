@@ -132,15 +132,14 @@ def check_fixpoint(store, ks: Optional[Keyspace] = None) -> List[Finding]:
 
 def _dispatch_epoch(key: str, ks: Keyspace) -> Optional[int]:
     """Scheduled epoch of a dispatch key, any wire format: coalesced
-    ``dispatch/<node>/<epoch>``, legacy
+    ``dispatch/<node>/<epoch>`` (or the partitioned scheduler's
+    ``<epoch>.<partition>`` form), legacy
     ``dispatch/<node>/<epoch>/<grp>/<job>``, broadcast
     ``dispatch/_all/<epoch>/<grp>/<job>``."""
     seg = key[len(ks.dispatch):].split("/")
     if len(seg) >= 2:
-        try:
-            return int(seg[1])
-        except ValueError:
-            return None
+        parsed = Keyspace.split_bundle_epoch(seg[1])
+        return parsed[0] if parsed is not None else None
     return None
 
 
@@ -224,6 +223,10 @@ def fsck(store, sink=None, ks: Optional[Keyspace] = None,
     fences: Dict[str, int] = {}
     for kv in _scan(store, ks.lock):
         rest = kv.key[len(ks.lock):]
+        if rest.startswith("sched/"):
+            # partitioned scheduler leader leases (lock/sched/p<i>) —
+            # election state, not fences
+            continue
         if rest.startswith("alone/"):
             jid = rest[len("alone/"):]
             if jid and jid not in job_ids:

@@ -85,6 +85,43 @@ class Keyspace:
     def hwm(self) -> str:        # scheduler planning high-water mark
         return f"{self.prefix}/hwm"
 
+    def hwm_partition_key(self, partition: int) -> str:
+        """Per-partition planning high-water mark (partitioned
+        scheduler plane): each partition leader resumes from ITS mark.
+        The unpartitioned (P=1) scheduler keeps the bare :attr:`hwm`
+        key — pure passthrough."""
+        return f"{self.prefix}/hwm/p{partition}"
+
+    # -- partitioned scheduler plane --------------------------------------
+
+    def partition_leader_key(self, partition: int) -> str:
+        """Leader-election key for ONE scheduler partition.  P
+        independent leases, one per job-space slice; the unpartitioned
+        scheduler keeps the bare :attr:`leader` key."""
+        return f"{self.lock}sched/p{partition}"
+
+    @property
+    def partmap(self) -> str:
+        """Partition-topology pin (sched/partition.py): the first
+        partition leader publishes ``{"p": P, "hash": SCHEME}``; every
+        later scheduler verifies its configured partition count against
+        it and refuses loudly on mismatch — the shardmap pattern (PR 6)
+        lifted to the scheduler plane."""
+        return f"{self.prefix}/sched/partmap"
+
+    @property
+    def sched_acct(self) -> str:
+        """Per-partition node-demand summaries (leased): each partition
+        leader periodically publishes its per-node outstanding
+        exclusive slots + running load under ``.../acct/p<i>``; every
+        other partition folds the summaries into its capacity view, so
+        shared node rem_cap stays reconciled without cross-partition
+        coordination on the fire path."""
+        return f"{self.prefix}/sched/acct/"
+
+    def sched_acct_key(self, partition: int) -> str:
+        return f"{self.sched_acct}p{partition}"
+
     @property
     def shardmap(self) -> str:
         """Shard-topology pin (store/sharded.py): lives on shard 0 by
@@ -156,6 +193,19 @@ class Keyspace:
         consumed by both agents for rollout tolerance, but the scheduler
         now publishes :meth:`dispatch_bundle_key` instead."""
         return f"{self.dispatch}{node_id}/{epoch_s}/{group}/{job_id}"
+
+    @staticmethod
+    def split_bundle_epoch(segment: str):
+        """Parse a coalesced bundle key's epoch segment — ``<epoch>``
+        plain, or the partitioned scheduler's ``<epoch>.<partition>``
+        form.  Returns ``(epoch, partition-or-None)``, or None when
+        the segment is neither — THE one home of the suffix grammar
+        (agents, fsck, mirrors and benches all parse through here;
+        native/agentd.cc mirrors it)."""
+        ep, dot, part = segment.partition(".")
+        if not ep.isdigit() or (dot and not part.isdigit()):
+            return None
+        return int(ep), (int(part) if part else None)
 
     def dispatch_bundle_key(self, node_id: str, epoch_s: int) -> str:
         """Coalesced exclusive order: ONE key per (node, second), value =

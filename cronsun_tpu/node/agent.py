@@ -1304,11 +1304,18 @@ class NodeAgent:
                             order_key: Optional[str] = None) -> int:
         rest = key[len(self.ks.dispatch) + len(self.id) + 1:]
         parts = rest.split("/")
-        if len(parts) == 1 and parts[0].isdigit():
+        if len(parts) == 1:
             # coalesced (node, second) bundle: value = the job list.
-            # A re-delivery (hole-rewind overwrite, resync re-list) is
-            # absorbed by the per-(job, second) fences at claim time.
-            return self._handle_bundle(key, int(parts[0]), value)
+            # "<epoch>" plain, or the partitioned scheduler's
+            # "<epoch>.<partition>" form (the suffix scopes the
+            # reservation to its publishing partition; the epoch is
+            # what matters here).  A re-delivery (hole-rewind
+            # overwrite, resync re-list) is absorbed by the
+            # per-(job, second) fences at claim time.
+            parsed = Keyspace.split_bundle_epoch(parts[0])
+            if parsed is not None:
+                return self._handle_bundle(key, parsed[0], value)
+            return 0
         if len(parts) != 3:
             return 0
         # legacy per-(node, second, job) order — rollout tolerance for

@@ -42,7 +42,7 @@ import queue
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from .. import log
 from ..core.backoff import PUBLISH, PUBLISH_ATTEMPTS
@@ -50,12 +50,38 @@ from ..core.backoff import PUBLISH, PUBLISH_ATTEMPTS
 
 class OrderPublisher:
     def __init__(self, lanes: Sequence, advance_hwm: Callable[[int], None],
-                 chunk: int = 20_000, max_backlog: int = 2):
+                 chunk: int = 20_000, max_backlog: int = 2,
+                 shard_of: Optional[Callable[[str], int]] = None):
         self._lane_conns = list(lanes)
         self._pools = [ThreadPoolExecutor(1, thread_name_prefix=f"pub{i}")
                        for i in range(len(self._lane_conns))]
         self._advance_hwm = advance_hwm
         self.chunk = chunk
+        # per-shard publish decoupling: with ``shard_of`` each lane is
+        # pinned to ONE store shard and a second's orders are routed
+        # by key instead of round-robined — a browned-out shard's
+        # writes queue on its own lane, and (because every second's
+        # chunks are staged onto the lanes up front, with the
+        # write-then-mark barrier applied per second IN ORDER
+        # afterwards) the healthy shards' orders of LATER seconds land
+        # at healthy latency instead of serializing behind the slow
+        # shard's earlier seconds (~2·window_s·delay measured by the
+        # brownout_dispatch drill).  None keeps the round-robin path.
+        self._shard_of = shard_of
+        self.shard_lanes = shard_of is not None
+        # shard-lane mode runs a second, ORDERED barrier thread: the
+        # _run worker stages each window's chunks the moment it
+        # dequeues it, the barrier thread completes windows FIFO and
+        # advances the HWM — so one slow shard delays its own lane's
+        # writes and the mark, never the other shards' later windows
+        self._bq: "queue.Queue | None" = (queue.Queue()
+                                          if self.shard_lanes else None)
+        self._barrier_thread: "threading.Thread | None" = None
+        if self._bq is not None:
+            self._barrier_thread = threading.Thread(
+                target=self._barrier_run, daemon=True,
+                name="order-publish-barrier")
+            self._barrier_thread.start()
         self._sem = threading.Semaphore(max_backlog)
         self._q: "queue.Queue" = queue.Queue()
         self.stats = {"published_total": 0, "publish_failures": 0,
@@ -219,6 +245,8 @@ class OrderPublisher:
         with self._hwm_cv:
             self._hwm_cv.notify_all()
         self._thread.join(timeout=5)
+        if self._barrier_thread is not None:
+            self._barrier_thread.join(timeout=5)
         self._hwm_thread.join(timeout=5)
         for p in self._pools:
             p.shutdown(wait=False)
@@ -247,54 +275,97 @@ class OrderPublisher:
             if self._failed_epoch is None or epoch < self._failed_epoch:
                 self._failed_epoch = epoch
 
-    def _run(self):
+    def _stage_sharded(self, seconds, lease) -> List[list]:
+        """Route every second's orders by store shard and submit the
+        chunks to the per-shard lanes immediately; returns the futures
+        grouped per second for the in-order barrier in _run."""
         n = len(self._pools)
-        while True:
-            item = self._q.get()
-            if item is None:
-                return
-            seconds, lease, hwm, covers_from = item
-            t0 = time.perf_counter()
-            with self._mu:
-                holed = self._failed_epoch is not None
-                if holed and covers_from is not None and \
-                        covers_from <= self._failed_epoch:
-                    # the scheduler's REWOUND re-plan: its contiguous
-                    # window starts at/before the hole, so publishing
-                    # it re-covers every second the hole shadowed
-                    self._failed_epoch = None
-                    holed = False
-            if holed:
-                # a hole is outstanding: publishing the already-queued
-                # LATER windows would advance the monotone HWM past it,
-                # and a crash before the rewound re-publish landed
-                # would lose the hole's fires forever.  Abandon them —
-                # extending the hole to this window's own oldest second
-                # (it may carry matured replan fires older than the
-                # hole) — and let the rewind re-plan everything from
-                # there forward.
-                if seconds:
-                    self._mark_failed(min(ep for ep, _ in seconds))
-                log.warnf("publish hole outstanding; abandoning queued "
-                          "window of %d seconds for the re-plan",
-                          len(seconds))
-                with self._mu:
-                    # a hole episode must be visible from metrics alone:
-                    # abandoned windows count as windows AND separately
-                    self.stats["publish_abandoned"] += 1
-                    self.stats["publish_windows"] += 1
-                self.last_window_ms = 0.0
-                self._sem.release()
-                with self._idle:
-                    self._inflight -= 1
-                    self._idle.notify_all()
-                continue
-            try:
-                for si, (epoch, orders) in enumerate(seconds):
-                    ok = True
-                    if len(orders) > self.max_second_keys:
-                        self.max_second_keys = len(orders)
-                    if orders:
+        staged: List[list] = []
+        for _epoch, orders in seconds:
+            futs = []
+            if orders:
+                buckets: List[list] = [[] for _ in range(n)]
+                shard_of = self._shard_of
+                for kv in orders:
+                    buckets[shard_of(kv[0]) % n].append(kv)
+                for lane, bucket in enumerate(buckets):
+                    for i in range(0, len(bucket), self.chunk):
+                        futs.append(self._pools[lane].submit(
+                            self._send, lane,
+                            bucket[i:i + self.chunk], lease))
+            staged.append(futs)
+        return staged
+
+    def _check_hole(self, covers_from) -> bool:
+        """True when an outstanding hole shadows further publishing;
+        clears the hole when ``covers_from`` proves this window is the
+        scheduler's REWOUND re-plan (its contiguous start at/before
+        the hole re-covers every second the hole shadowed).  Clearing
+        belongs to the thread that OWNS publish ordering — _run on the
+        round-robin path, the barrier thread in shard-lane mode (see
+        _peek_hole_stale)."""
+        with self._mu:
+            holed = self._failed_epoch is not None
+            if holed and covers_from is not None and \
+                    covers_from <= self._failed_epoch:
+                self._failed_epoch = None
+                holed = False
+        return holed
+
+    def _peek_hole_stale(self, covers_from) -> bool:
+        """Side-effect-free hole check for the shard-lane STAGING
+        thread: True when an outstanding hole shadows this window and
+        the window does not cover it.  The staging thread must NOT
+        clear the hole for a covering re-plan — stale pre-rewind
+        windows may still sit in the barrier queue ahead of it, and a
+        clear here would let the barrier publish them past the hole's
+        unpublished seconds (the write-then-mark violation).  The
+        ORDERED barrier thread clears it when the covering window's
+        turn comes."""
+        with self._mu:
+            return self._failed_epoch is not None and \
+                not (covers_from is not None
+                     and covers_from <= self._failed_epoch)
+
+    def _abandon(self, seconds):
+        """Abandon one window behind an outstanding hole: publishing it
+        would advance the monotone HWM past the hole, and a crash
+        before the rewound re-publish landed would lose the hole's
+        fires forever.  Extends the hole to this window's own oldest
+        second (it may carry matured replan fires older than the hole)
+        and lets the rewind re-plan everything from there forward."""
+        if seconds:
+            self._mark_failed(min(ep for ep, _ in seconds))
+        log.warnf("publish hole outstanding; abandoning queued "
+                  "window of %d seconds for the re-plan", len(seconds))
+        with self._mu:
+            # a hole episode must be visible from metrics alone:
+            # abandoned windows count as windows AND separately
+            self.stats["publish_abandoned"] += 1
+            self.stats["publish_windows"] += 1
+        self.last_window_ms = 0.0
+        self._sem.release()
+        with self._idle:
+            self._inflight -= 1
+            self._idle.notify_all()
+
+    def _publish_window(self, seconds, lease, hwm, staged, t0):
+        """Publish (or, in shard-lane mode, barrier) one window:
+        per-second completion strictly oldest-first, the mark moving
+        ONLY once a second's orders are in the store — a crash between
+        seconds re-plans the unpublished tail (a rare double fire
+        beats silently missing one; fences/broadcast-dedup absorb the
+        dup)."""
+        n = len(self._pools)
+        try:
+            for si, (epoch, orders) in enumerate(seconds):
+                ok = True
+                if len(orders) > self.max_second_keys:
+                    self.max_second_keys = len(orders)
+                if orders:
+                    if staged is not None:
+                        futs = staged[si]
+                    else:
                         futs = []
                         for ci, i in enumerate(range(0, len(orders),
                                                      self.chunk)):
@@ -302,47 +373,104 @@ class OrderPublisher:
                             futs.append(self._pools[lane].submit(
                                 self._send, lane,
                                 orders[i:i + self.chunk], lease))
-                        sent = sum(f.result() for f in futs)
-                        with self._mu:
-                            self.stats["published_total"] += sent
-                        ok = sent == len(orders)
-                    if not ok:
-                        # the write-then-mark contract: the HWM must
-                        # NOT move past a second whose orders are not
-                        # in the store.  Abandon the rest of the window
-                        # too (it would land out of order past the
-                        # hole) and hand the epoch back for a re-plan —
-                        # late, never lost.
-                        self._mark_failed(epoch)
-                        log.errorf(
-                            "publish failed at epoch %d; window "
-                            "abandoned for re-plan (%d seconds held "
-                            "back)", epoch, len(seconds) - si)
-                        break
-                    # the mark moves ONLY once this second's orders are
-                    # in the store: a crash between seconds re-plans the
-                    # unpublished tail (a rare double fire beats
-                    # silently missing one; fences/broadcast-dedup
-                    # absorb the dup)
-                    self._hwm_note(epoch + 1)
+                    sent = sum(f.result() for f in futs)
+                    with self._mu:
+                        self.stats["published_total"] += sent
+                    ok = sent == len(orders)
+                if not ok:
+                    # the write-then-mark contract: the HWM must NOT
+                    # move past a second whose orders are not in the
+                    # store.  Abandon the rest of the window too (it
+                    # would land out of order past the hole) and hand
+                    # the epoch back for a re-plan — late, never lost.
+                    self._mark_failed(epoch)
+                    log.errorf(
+                        "publish failed at epoch %d; window "
+                        "abandoned for re-plan (%d seconds held "
+                        "back)", epoch, len(seconds) - si)
+                    break
+                self._hwm_note(epoch + 1)
+                self.published_through = max(self.published_through,
+                                             epoch + 1)
+            else:
+                if hwm:
+                    self._hwm_note(hwm)
                     self.published_through = max(self.published_through,
-                                                 epoch + 1)
-                else:
-                    if hwm:
-                        self._hwm_note(hwm)
-                        self.published_through = max(self.published_through,
-                                                     hwm)
-            except Exception as e:  # noqa: BLE001 — keep publishing
-                log.errorf("window publish failed: %s", e)
-                if seconds:
-                    self._mark_failed(seconds[0][0])
-            finally:
-                self.last_window_ms = (time.perf_counter() - t0) * 1e3
-                self.stats["publish_windows"] += 1
-                self._sem.release()
-                with self._idle:
-                    self._inflight -= 1
-                    self._idle.notify_all()
+                                                 hwm)
+        except Exception as e:  # noqa: BLE001 — keep publishing
+            log.errorf("window publish failed: %s", e)
+            if seconds:
+                self._mark_failed(seconds[0][0])
+        finally:
+            self.last_window_ms = (time.perf_counter() - t0) * 1e3
+            self.stats["publish_windows"] += 1
+            self._sem.release()
+            with self._idle:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                if self._bq is not None:
+                    self._bq.put(None)
+                return
+            seconds, lease, hwm, covers_from = item
+            t0 = time.perf_counter()
+            if self._bq is None:
+                if self._check_hole(covers_from):
+                    self._abandon(seconds)
+                    continue
+                self._publish_window(seconds, lease, hwm, staged=None,
+                                     t0=t0)
+            else:
+                if self._peek_hole_stale(covers_from):
+                    # stale window behind an uncleared hole: abandon at
+                    # stage time (cheap); a COVERING re-plan stages
+                    # through and the barrier clears the hole in order
+                    self._abandon(seconds)
+                    continue
+                # shard-lane mode: stage this window's chunks onto the
+                # per-shard lanes NOW (per-lane FIFO keeps each shard's
+                # write order across seconds AND windows) and hand the
+                # in-order completion barrier to the barrier thread —
+                # window N+1's healthy-shard writes land at healthy
+                # latency while window N still waits out a slow
+                # shard's legs (the pre-decoupling structural term:
+                # the LAST second of every window paid ~2·window_s·
+                # delay behind one slow shard)
+                staged = self._stage_sharded(seconds, lease)
+                self._bq.put((seconds, staged, hwm, covers_from, t0))
+
+    def _barrier_run(self):
+        """Ordered completion barrier for shard-lane mode: windows
+        complete strictly FIFO, the HWM advances per landed second,
+        and a window staged BEFORE a hole surfaced is drained but
+        never advances the mark past the hole.  Its landed writes are
+        normally re-covered by the rewound re-plan's bundle overwrites
+        (the documented re-publish contract); if the hole instead ages
+        past max_catchup_s and is SKIPPED (clear_failed_epoch_below),
+        the already-landed orders execute late instead of being
+        re-planned — leased (bounded life), fence-deduped, and agents
+        re-fetch the job at claim time (deleted/paused -> skipped):
+        the same late-never-lost posture as every re-publish path."""
+        while True:
+            item = self._bq.get()
+            if item is None:
+                return
+            seconds, staged, hwm, covers_from, t0 = item
+            if self._check_hole(covers_from):
+                for futs in staged:
+                    for f in futs:
+                        try:
+                            f.result()
+                        except Exception:  # noqa: BLE001 — the send
+                            pass           # already counted failures
+                self._abandon(seconds)
+                continue
+            self._publish_window(seconds, lease=0, hwm=hwm,
+                                 staged=staged, t0=t0)
 
 
 class WindowBuilder:

@@ -128,6 +128,9 @@ class SchedulerService:
                  delta_max_bytes: int = 64 << 20,
                  delta_max_events: int = 1_000_000,
                  trace_shift: int = -1,
+                 partitions: int = 1,
+                 partition: int = 0,
+                 acct_exchange_s: float = 2.0,
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.ks = ks or Keyspace()
@@ -137,6 +140,56 @@ class SchedulerService:
         self.dispatch_ttl = dispatch_ttl
         self.default_node_cap = default_node_cap
         self.node_id = node_id
+
+        # ---- partitioned scheduler plane --------------------------------
+        # P independent leaders, each owning the job-space slice whose
+        # 64-bit FNV job token (the store's own routing token) lands on
+        # its index: own leader lease, own watch slice, own HWM, own
+        # checkpoint chain.  P=1 is pure passthrough — same keys, same
+        # wire bytes as the unpartitioned scheduler (pinned by test).
+        self.partitions = max(1, int(partitions))
+        self.partition = int(partition)
+        if not 0 <= self.partition < self.partitions:
+            raise ValueError(
+                f"partition {self.partition} out of range for "
+                f"{self.partitions} partitions")
+        from .partition import pin_partition_map
+        # publish-or-verify the topology pin BEFORE any state loads: a
+        # mismatched scheduler must refuse, not double-schedule
+        pin_partition_map(self.store, self.ks, self.partitions)
+        # ownership predicate, bound once: None at P=1 so the per-event
+        # filters cost a single None check on the unpartitioned path
+        if self.partitions > 1:
+            from .partition import job_partition as _jp
+            _P, _i = self.partitions, self.partition
+            self._owns: Optional[Callable[[str], bool]] = \
+                lambda jid: _jp(jid, _P) == _i
+        else:
+            self._owns = None
+        if self.partitions > 1:
+            self._leader_key = self.ks.partition_leader_key(self.partition)
+            self._hwm_key = self.ks.hwm_partition_key(self.partition)
+            # exclusive bundles carry the owning partition in the key
+            # (".<p>" epoch suffix): two partitions firing jobs on the
+            # same (node, second) must not overwrite each other's
+            # reservation, and the suffix scopes each partition's
+            # order mirror to its own publishes
+            self._bundle_sfx = f".{self.partition}"
+        else:
+            self._leader_key = self.ks.leader
+            self._hwm_key = self.ks.hwm
+            self._bundle_sfx = ""
+        # foreign partitions' per-node demand (sched/acct/p<j> mirror):
+        # key -> {node: (excl_slots, load)}, merged lazily into the
+        # flat fold reconcile_capacity subtracts each step
+        self.acct_exchange_s = max(0.25, float(acct_exchange_s))
+        self._part_foreign: Dict[str, Dict[str, Tuple[int, float]]] = {}
+        self._foreign_dirty = False
+        self._foreign_excl: Dict[str, int] = {}
+        self._foreign_load: Dict[str, float] = {}
+        self._acct_lease: Optional[int] = None
+        self._acct_next = 0.0
+        self._w_acct = None
 
         planner_kw = {} if tz is None else {"tz": tz}
         self.planner = planner or TickPlanner(
@@ -379,17 +432,44 @@ class SchedulerService:
         # rides OFF the step's critical path (r4: 2.1 s of a 4 s window
         # inside the step); backpressure puts it back on the step —
         # visibly — only when the plane can't keep up.
-        if publish_lanes <= 0:
-            import os as _os
-            publish_lanes = max(1, min(4, (_os.cpu_count() or 1) - 1))
-        if hasattr(store, "clone"):
-            lanes = [store.clone() for _ in range(publish_lanes)]
+        #
+        # Against a SHARDED store the default is one lane PER SHARD
+        # with shard-routed chunking (shard_of): a browned-out shard's
+        # writes queue on ITS lane only, so the healthy shards' orders
+        # of every second land at healthy latency instead of the last
+        # second of each window paying ~2·window_s·delay behind the
+        # slow shard (the brownout_dispatch drill's old structural
+        # bound).  Explicit publish_lanes (or
+        # CRONSUN_PUB_SHARD_LANES=off) keeps the round-robin path —
+        # the rollback switch.
+        shard_of = None
+        nsh = getattr(store, "nshards", 1)
+        shard_lanes = (publish_lanes <= 0 and nsh > 1
+                       and hasattr(store, "clone")
+                       and os.environ.get("CRONSUN_PUB_SHARD_LANES",
+                                          "on").lower()
+                       not in ("off", "0"))
+        if shard_lanes:
+            lanes = [store.clone() for _ in range(nsh)]
             self._owned_lanes = lanes
+            from ..store.sharded import shard_index
+            _pfx = getattr(store, "prefix", self.ks.prefix)
+
+            def shard_of(key, _n=nsh, _p=_pfx):
+                return shard_index(key, _n, _p)
         else:
-            lanes = [store]
-            self._owned_lanes = []
+            if publish_lanes <= 0:
+                import os as _os
+                publish_lanes = max(1, min(4, (_os.cpu_count() or 1) - 1))
+            if hasattr(store, "clone"):
+                lanes = [store.clone() for _ in range(publish_lanes)]
+                self._owned_lanes = lanes
+            else:
+                lanes = [store]
+                self._owned_lanes = []
         from .publisher import OrderPublisher, WindowBuilder
-        self.publisher = OrderPublisher(lanes, self._advance_hwm)
+        self.publisher = OrderPublisher(lanes, self._advance_hwm,
+                                        shard_of=shard_of)
         # in-process stores (tests, demo) publish synchronously: their
         # put_many is microseconds and callers assert store contents
         # right after step(); the networked path keeps the overlap
@@ -448,7 +528,8 @@ class SchedulerService:
         self.stats = {"overflow_drops": 0, "overflow_late_fires": 0,
                       "skipped_seconds": 0,
                       "watch_losses": 0, "dispatches_total": 0,
-                      "steps_total": 0, "lease_resigns_total": 0}
+                      "steps_total": 0, "lease_resigns_total": 0,
+                      "acct_exchanges_total": 0}
         # herd gauges, tracked where orders are built: the most
         # EXCLUSIVE (per-node) keys any one second published — bounded
         # by active nodes under coalescing, it was one per fire before —
@@ -545,6 +626,10 @@ class SchedulerService:
             # checkpoint-plane control keys: operator save requests and
             # the save barrier nonces
             self._w_ckpt = w(self.ks.ckpt)
+            # partitioned plane: foreign partitions' leased demand
+            # summaries (shared node capacity reconciliation)
+            self._w_acct = (w(self.ks.sched_acct)
+                            if self.partitions > 1 else None)
         except BaseException:
             for wx in opened:
                 try:
@@ -554,9 +639,85 @@ class SchedulerService:
             raise
 
     def _all_watches(self):
-        return (self._w_jobs, self._w_groups, self._w_nodes,
+        base = (self._w_jobs, self._w_groups, self._w_nodes,
                 self._w_procs, self._w_orders, self._w_alone,
                 self._w_deps, self._w_tenants, self._w_ckpt)
+        return base + (self._w_acct,) if self._w_acct is not None \
+            else base
+
+    # ---- partitioned scheduler plane ------------------------------------
+
+    def owns_job(self, job_id: str) -> bool:
+        """True when this partition owns the job's token slice (always
+        True unpartitioned)."""
+        return self._owns is None or self._owns(job_id)
+
+    def _apply_acct_ev(self, typ: str, key: str, value: str):
+        """Fold one foreign partition's demand-summary event into the
+        acct mirror (the flat per-node sums recompute lazily at the
+        next reconcile).  Own-key echoes are skipped — own demand is
+        already exact in the local counters."""
+        if key == self.ks.sched_acct_key(self.partition):
+            return
+        if typ == DELETE:
+            if self._part_foreign.pop(key, None) is not None:
+                self._foreign_dirty = True
+            return
+        from .partition import decode_demand
+        demand = decode_demand(value)
+        if demand is None:
+            log.warnf("malformed partition demand summary at %s; "
+                      "ignored", key)
+            return
+        self._part_foreign[key] = demand
+        self._foreign_dirty = True
+
+    def _fold_foreign_demand(self):
+        """Merge the per-partition demand mirrors into the flat
+        {node: excl}/{node: load} sums reconcile_capacity subtracts —
+        O(partitions x active nodes), only when a summary changed."""
+        if not self._foreign_dirty:
+            return
+        fex: Dict[str, int] = {}
+        fld: Dict[str, float] = {}
+        for demand in self._part_foreign.values():
+            for node, (e, l) in demand.items():
+                if e:
+                    fex[node] = fex.get(node, 0) + e
+                if l:
+                    fld[node] = fld.get(node, 0.0) + l
+        self._foreign_excl = fex
+        self._foreign_load = fld
+        self._foreign_dirty = False
+
+    def _publish_acct(self):
+        """Leased per-node demand summary publish (partition leaders,
+        every ``acct_exchange_s``): the summary is this partition's
+        outstanding exclusive slots + running load per node — the
+        exact counters reconcile_capacity trusts locally — so every
+        other partition's capacity view converges to the fleet-wide
+        truth within one exchange period.  The lease (3x the period)
+        ages a dead partition's demand out instead of pinning its
+        capacity claim forever."""
+        now = self.clock()
+        if now < self._acct_next:
+            return
+        self._acct_next = now + self.acct_exchange_s
+        from .partition import encode_demand
+        value = encode_demand(self._excl_cnt, self._load_sum)
+        try:
+            if self._acct_lease is None or \
+                    not self.store.keepalive(self._acct_lease):
+                self._acct_lease = self.store.grant(
+                    max(10.0, 3.0 * self.acct_exchange_s))
+            self.store.put(self.ks.sched_acct_key(self.partition),
+                           value, lease=self._acct_lease)
+            self.stats["acct_exchanges_total"] += 1
+        except Exception as e:  # noqa: BLE001 — a missed exchange is
+            # bounded staleness (over-commit absorbed by the agents'
+            # Parallels gate), never a step failure
+            self._acct_lease = None
+            log.warnf("partition demand exchange failed: %s", e)
 
     # ---- bootstrap (reference loadJobs, node/node.go:121-141) ------------
 
@@ -577,6 +738,11 @@ class SchedulerService:
         # the first window plans).  The same listing doubles as the
         # resync liveness diff: quotas deleted during a lost-watch gap
         # are dropped here.
+        # partitioned plane: current foreign demand summaries (the acct
+        # watch only carries changes from here on)
+        if self.partitions > 1:
+            for kv in _list_prefix(self.store, self.ks.sched_acct):
+                self._apply_acct_ev(PUT, kv.key, kv.value)
         live_quotas = set()
         for kv in _list_prefix(self.store, self.ks.tenant):
             rest = kv.key[len(self.ks.tenant):]
@@ -678,8 +844,8 @@ class SchedulerService:
         t_el = time.monotonic()
         lease = self.store.grant(self.lease_ttl)
         try:
-            won = self.store.put_if_absent(self.ks.leader, self.node_id,
-                                           lease=lease)
+            won = self.store.put_if_absent(self._leader_key,
+                                           self.node_id, lease=lease)
         except KeyError:
             # the fresh lease expired before the put landed (pegged
             # host, link stall longer than lease_ttl): not leading this
@@ -739,6 +905,8 @@ class SchedulerService:
         if "/" not in rest:
             return
         group, job_id = rest.split("/", 1)
+        if self._owns is not None and not self._owns(job_id):
+            return      # another partition's token slice
         try:
             job = Job.from_json(value)
         except (json.JSONDecodeError, TypeError):
@@ -1093,6 +1261,25 @@ class SchedulerService:
                     "across devices) — the job will NOT fire",
                     jk[0], jk[1], type(self.planner).__name__)
             new = None
+        if new is not None and self._owns is not None:
+            # cross-partition dep edges: an upstream in another token
+            # slice has no rows in THIS partition's table, so its
+            # completion epochs have nowhere to scatter — the same
+            # shape as the mesh planners' dep refusal (a replicated
+            # success-epoch exchange / co-sharded dep layout is the
+            # named remainder).  Refuse LOUDLY: the dependent holds.
+            foreign = [u for u in new.on if not self._owns(u)]
+            if foreign:
+                if jk not in self._dep_warned:
+                    self._dep_warned.add(jk)
+                    log.errorf(
+                        "job %s/%s depends on %s owned by other "
+                        "scheduler partition(s) — cross-partition dep "
+                        "edges are not supported (dep columns "
+                        "reference this partition's rows); the job "
+                        "will NOT fire until the chain co-locates",
+                        jk[0], jk[1], foreign)
+                new = None
         if old is None and new is None:
             return None
         group = jk[0]
@@ -1423,6 +1610,13 @@ class SchedulerService:
         # into the delta buffer — barrier nonces and save requests are
         # transient control flow, and replaying a request on fold would
         # trigger a spurious save.
+        # partitioned plane: foreign demand summaries (transient leased
+        # control state, like the ckpt stream NOT recorded into the
+        # delta buffer — a restore re-mirrors live summaries within one
+        # exchange period anyway)
+        if self._w_acct is not None:
+            for ev in self._w_acct.drain():
+                self._apply_acct_ev(ev.type, ev.kv.key, ev.kv.value)
         for ev in self._w_ckpt.drain():
             if ev.type == DELETE:
                 continue
@@ -1485,6 +1679,10 @@ class SchedulerService:
             if "/" not in rest:
                 return
             group, job_id = rest.split("/", 1)
+            if self._owns is not None and not self._owns(job_id):
+                return      # foreign slice (cross-partition dep edges
+                            # are refused at registration — see
+                            # _dep_spec_apply)
             jk = (group, job_id)
             if typ == DELETE:
                 # an operator wiped the key: forget the host mirror (a
@@ -1522,17 +1720,20 @@ class SchedulerService:
                 self._acct_del(self._procs, key)
             else:
                 t = self._parse_proc(key)
-                if t:
+                if t and (self._owns is None or self._owns(t[2])):
                     self._acct_add(self._procs, key, *t)
         elif sid == "orders":
             if typ == DELETE:
-                self._acct_del(self._orders, key)
+                self._acct_del(self._orders, key)   # no-op for keys a
+                # partitioned mirror never held (foreign partitions')
             else:       # defensive: the delete-only filter should
                 t = self._parse_order(key)             # suppress these
-                if t:
+                if t and (self._owns is None or self._owns(t[2])):
                     self._acct_add(self._orders, key, *t)
         elif sid == "alone":
             jid = key[len(self._alone_pfx):]
+            if self._owns is not None and not self._owns(jid):
+                return
             if typ == DELETE:
                 self._alone_live.discard(jid)
             else:
@@ -1703,7 +1904,7 @@ class SchedulerService:
 
         for kv in _list_prefix(store, self.ks.proc):
             t = self._parse_proc(kv.key)
-            if t:
+            if t and (self._owns is None or self._owns(t[2])):
                 add(procs, kv.key, *t)
         for kv in _list_prefix(store, self.ks.dispatch):
             rest = kv.key[len(self.ks.dispatch):].split("/")
@@ -1713,7 +1914,18 @@ class SchedulerService:
                 continue
             if len(rest) == 2:
                 # coalesced (node, second) bundle: value is the node's
-                # job list; the key reserves len(jobs) exclusive slots
+                # job list; the key reserves len(jobs) exclusive slots.
+                # Partitioned: the ".<p>" epoch suffix scopes the key —
+                # only OWN bundles enter the mirror (foreign demand
+                # arrives via the acct exchange, never double-counted);
+                # an unsuffixed leftover from an unpartitioned past is
+                # attributed per entry by job token below.
+                parsed = Keyspace.split_bundle_epoch(rest[1])
+                if parsed is None:
+                    continue
+                if self._owns is not None and parsed[1] is not None \
+                        and parsed[1] != self.partition:
+                    continue
                 try:
                     entries = json.loads(kv.value)
                 except (json.JSONDecodeError, TypeError):
@@ -1723,11 +1935,14 @@ class SchedulerService:
                 node_id = rest[0]
                 cost = 0.0
                 slots = 0
+                per_entry = self._owns is not None and parsed[1] is None
                 tids: Dict[int, int] = {}
                 for e in entries:
                     if not isinstance(e, str) or "/" not in e:
                         continue
                     group, _, job_id = e.partition("/")
+                    if per_entry and not self._owns(job_id):
+                        continue
                     job = self.jobs.get((group, job_id))
                     cost += job.avg_time if job and job.avg_time > 0 \
                         else 1.0
@@ -1736,6 +1951,8 @@ class SchedulerService:
                         t = self._tenant_ids.get(job.tenant, 0)
                         if t:
                             tids[t] = tids.get(t, 0) + 1
+                if per_entry and not slots:
+                    continue    # bundle entirely foreign-owned
                 if tids:
                     order_tids[kv.key] = tids
                 orders[kv.key] = (node_id, cost, slots)
@@ -1744,10 +1961,12 @@ class SchedulerService:
                     excl[node_id] = excl.get(node_id, 0) + slots
                 continue
             t = self._parse_order(kv.key)
-            if t:
+            if t and (self._owns is None or self._owns(t[2])):
                 add(orders, kv.key, *t)
         alone = {kv.key[len(self._alone_pfx):]
-                 for kv in _list_prefix(store, self._alone_pfx)}
+                 for kv in _list_prefix(store, self._alone_pfx)
+                 if self._owns is None
+                 or self._owns(kv.key[len(self._alone_pfx):])}
         return procs, orders, alone, excl, load, order_tids
 
     def _install_mirrors(self, built):
@@ -2082,6 +2301,11 @@ class SchedulerService:
         return dict(
             rev=rev, saved_at=time.time(), node_id=self.node_id,
             prefix=self.ks.prefix, J=self.planner.J, N=self.planner.N,
+            # partitioned plane: a checkpoint is ONE partition's chain
+            # — restoring it under a different slice would install a
+            # foreign job-space (absent fields = pre-partition saves,
+            # restorable on the unpartitioned scheduler only)
+            partitions=self.partitions, partition=self.partition,
             mesh=self._mesh_topology(),
             # device state materialized to host numpy: the packed
             # schedule table (no cron re-parse on restore), eligibility
@@ -2181,6 +2405,17 @@ class SchedulerService:
                 raise CheckpointError(
                     f"keyspace prefix {st.get('prefix')!r} != "
                     f"{self.ks.prefix!r}")
+            # per-partition chains: the slice must match exactly (a
+            # pre-partition checkpoint carries no fields and defaults
+            # to the unpartitioned identity)
+            if (int(st.get("partitions", 1) or 1),
+                    int(st.get("partition", 0) or 0)) != \
+                    (self.partitions, self.partition):
+                raise CheckpointError(
+                    f"checkpoint is partition "
+                    f"{st.get('partition', 0)} of "
+                    f"{st.get('partitions', 1)}; this scheduler is "
+                    f"partition {self.partition} of {self.partitions}")
             if st.get("J") != self.planner.J \
                     or st.get("N") != self.planner.N:
                 raise CheckpointError(
@@ -2734,16 +2969,27 @@ class SchedulerService:
         old O(outstanding) re-iteration was 548 ms/step at 1M (r4)."""
         running_excl = self._excl_cnt
         running_load = self._load_sum
+        # partitioned plane: fold the other partitions' published
+        # demand into this view — their reservations/procs are
+        # invisible to this partition's watch slice, but they consume
+        # the same nodes.  Bounded staleness (one exchange period);
+        # the over-commit inside it is absorbed by the agents'
+        # Parallels gate, exactly like the order->proc gap.
+        self._fold_foreign_demand()
+        fex = self._foreign_excl
+        fld = self._foreign_load
         cols, caps = [], []
         avail = 0
         loads = np.zeros(self.planner.N, np.float32)
         for node_id, col in self.universe.index.items():
             cap = self.node_caps.get(node_id, self.default_node_cap)
             cols.append(col)
-            c = max(0, cap - running_excl.get(node_id, 0))
+            c = max(0, cap - running_excl.get(node_id, 0)
+                    - fex.get(node_id, 0))
             caps.append(c)
             avail += c
-            loads[col] = running_load.get(node_id, 0.0)
+            loads[col] = running_load.get(node_id, 0.0) \
+                + fld.get(node_id, 0.0)
         # the fleet's remaining exclusive-slot budget — the fair-share
         # build clamps tenants to weighted max-min shares of this when
         # a second's aggregate demand exceeds it
@@ -2852,6 +3098,11 @@ class SchedulerService:
                 self._ae_rekick = True
             self._maybe_antientropy_bg()
         self.reconcile_capacity()
+        if self.partitions > 1:
+            # leaders announce their per-node demand so every OTHER
+            # partition's next reconcile subtracts it (O(active nodes)
+            # JSON once per exchange period, not per step)
+            self._publish_acct()
         t = span("reconcile", t)
         self._flush_device()
         t = span("flush", t)
@@ -2861,7 +3112,7 @@ class SchedulerService:
             # seconds the previous leader already dispatched aren't planned
             # twice (Common jobs have no per-second fence)
             start = now + 1
-            hwm_kv = self.store.get(self.ks.hwm)
+            hwm_kv = self.store.get(self._hwm_key)
             if hwm_kv is not None:
                 try:
                     # never ahead of a sane bound; the catch-up clamp below
@@ -3351,7 +3602,9 @@ class SchedulerService:
                 starts_g = [starts[g] for g in gorder]
                 ends_g = [ends[g] for g in gorder]
                 pfx = self.ks.dispatch
-                tail = "/" + ep
+                # partitioned: the ".<p>" suffix scopes the bundle key
+                # to this partition (empty at P=1 — byte-identical)
+                tail = "/" + ep + self._bundle_sfx
                 keys = [pfx + col_node[sc_l[s]] + tail for s in starts_g]
                 if samp is not None:
                     # any-member-sampled per coalesced group (reduceat
@@ -3459,7 +3712,7 @@ class SchedulerService:
                 n_fires += 1
         n_excl = 0
         for node, entries in bundles.items():
-            key = f"{disp_pfx}{node}/{ep}"
+            key = f"{disp_pfx}{node}/{ep}{self._bundle_sfx}"
             ttail = (',{"tb":%.3f}' % self._tb_stamp(plan.epoch_s)
                      if node in bundle_samp else "")
             orders.append((key, "[" + ",".join(entries) + ttail + "]"))
@@ -3573,6 +3826,8 @@ class SchedulerService:
             "leader": bool(self.is_leader),
             "watches_open": len(watches),
             "loop_alive": bool(thread is not None and thread.is_alive()),
+            "partition": self.partition,
+            "partitions": self.partitions,
         }
 
     def metrics_snapshot(self) -> dict:
@@ -3583,7 +3838,18 @@ class SchedulerService:
         stall_ms = self._builder.stats["stall_ms_total"]
         hidden_ms = max(0.0, self._pl_offstep_ms - stall_ms)
         denom_ms = self._pl_step_ms + hidden_ms
+        # partitioned plane: the partition index rides every sched
+        # series as a partition= label on /v1/metrics (a stalled
+        # partition must be visible, not averaged away); absent
+        # entirely at P=1 so the unpartitioned snapshot is unchanged
+        part = ({"partition": self.partition,
+                 "partitions": self.partitions,
+                 "acct_exchanges_total":
+                     self.stats["acct_exchanges_total"],
+                 "acct_partitions_seen": len(self._part_foreign)}
+                if self.partitions > 1 else {})
         return {
+            **part,
             "tick_p50_ms": round(self._tick_ms.percentile(0.50), 3),
             "tick_p99_ms": round(self._tick_ms.percentile(0.99), 3),
             # the FULL cycle (drain+reconcile+flush+plan+build+publish);
@@ -3618,6 +3884,13 @@ class SchedulerService:
             "watch_losses_total": self.stats["watch_losses"],
             "dispatches_total": self.stats["dispatches_total"],
             "steps_total": self.stats["steps_total"],
+            # lease watchdog health (per partition when partitioned —
+            # the partition= label rides every series above)
+            "lease_resigns_total": self.stats["lease_resigns_total"],
+            # per-shard publish decoupling: 1 when the publisher runs
+            # one shard-routed lane per store shard
+            "publish_shard_lanes":
+                1 if self.publisher.shard_lanes else 0,
             # outstanding exclusive-slot reservations: slot counts over
             # the ORDERS mirror only (coalesced keys reserve len(jobs)
             # each, so key count would understate it; _excl_cnt would
@@ -3683,14 +3956,14 @@ class SchedulerService:
 
     def _advance_hwm(self, value: int):
         for _ in range(8):
-            kv = self.store.get(self.ks.hwm)
+            kv = self.store.get(self._hwm_key)
             if kv is not None:
                 try:
                     if int(kv.value) >= value:
                         return
                 except ValueError:
                     pass
-            if self.store.put_if_mod_rev(self.ks.hwm, str(value),
+            if self.store.put_if_mod_rev(self._hwm_key, str(value),
                                          kv.mod_rev if kv else 0):
                 return
 
@@ -3765,6 +4038,12 @@ class SchedulerService:
                 lane.close()
             except Exception:  # noqa: BLE001 — already dead
                 pass
+        if self._acct_lease is not None:
+            try:
+                self.store.revoke(self._acct_lease)
+            except Exception:  # noqa: BLE001 — TTL is the backstop
+                pass
+            self._acct_lease = None
         self.metrics.revoke()
         self._tenant_metrics.revoke()
         if self._mesh_metrics is not None:

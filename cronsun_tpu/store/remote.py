@@ -486,8 +486,18 @@ class RemoteStore:
                     log.warnf("watch %r resume rev %d compacted; "
                               "consumer must re-list", w.prefix, resume)
                     w._mark_lost()
-            except (RemoteStoreError, OSError) as e:
-                log.errorf("watch %r re-establish failed: %s", w.prefix, e)
+            except Exception as e:  # noqa: BLE001 — ANY re-establish
+                # failure (timeout, refused, reply lost, unexpected)
+                # leaves this stream NOT live: mark it LOST so the
+                # consumer re-lists, exactly like the compacted-resume
+                # path.  Logging alone left a silently dead watcher —
+                # an agent's dispatch stream starved with no signal
+                # until its leased orders expired (found by the
+                # shard_partition drill once per-shard publish lanes
+                # shifted the heal's timing).
+                log.errorf("watch %r re-establish failed (%s); marking "
+                           "LOST for consumer re-list", w.prefix, e)
+                w._mark_lost()
         log.infof("store connection re-established (%s:%d)",
                   self.host, self.port)
 
@@ -531,7 +541,17 @@ class RemoteStore:
                 raise RemoteStoreError("connection lost mid-call")
             if not done.wait(self._timeout):
                 raise RemoteStoreError(f"rpc timeout: {op}")
-            msg = self._pending.pop(rid)
+            msg = self._pending.pop(rid, None)
+            if msg is None:
+                # the reply vanished between done.set and this pop: a
+                # FIXED-rid call (the heal path re-watches with
+                # rid=wid) can collide with a previous attempt's
+                # timed-out call on the same rid — its finally clause
+                # sweeps _pending[rid] from under us.  A failed RPC,
+                # never a local KeyError crashing the caller (a crashed
+                # heal thread used to leave every remaining watcher
+                # silently dead).
+                raise RemoteStoreError(f"rpc reply lost: {op}")
         finally:
             self._pending_ev.pop(rid, None)
             self._pending.pop(rid, None)

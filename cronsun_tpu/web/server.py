@@ -180,6 +180,7 @@ class ApiServer:
         route("GET", r"/v1/tenant/(?P<id>[^/]+)", self.tenant_get)
         route("DELETE", r"/v1/tenant/(?P<id>[^/]+)", self.tenant_delete,
               admin=True)
+        route("GET", r"/v1/sched", self.sched_status)
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
         route("POST", r"/v1/checkpoint", self.checkpoint, admin=True)
@@ -835,13 +836,74 @@ class ApiServer:
         self.cache.bump("shard_recomputed_total", len(shards) - reused)
         return body
 
+    def _tenant_scope(self, ctx):
+        """Effective tenant filter for the log/stat views: the explicit
+        ``tenant=`` query, FORCED server-side to the account's pinned
+        tenant for tenant-pinned sessions (a pinned dashboard cannot
+        read other tenants' history by omitting or spoofing the
+        parameter).  Returns ``(tenant, job_ids)``; ``job_ids`` is None
+        when unscoped, else the tenant's job ids from the
+        ``tenant/<t>/job/`` index markers set_job maintains (possibly
+        empty — the caller short-circuits to an empty view)."""
+        tenant = ctx.q("tenant")
+        acc = self._account_tenant(ctx)
+        if acc:
+            if tenant and tenant != acc:
+                raise HttpError(
+                    403, f"account is pinned to tenant {acc!r}; cannot "
+                         f"read tenant {tenant!r}")
+            tenant = acc
+        if not tenant:
+            return "", None
+        # short-TTL memo of the tenant -> job-ids resolution: the
+        # latest view is THE dashboard poll, and an uncached index
+        # scan per poll would put an O(tenant jobs) prefix RPC in
+        # front of the response cache it exists to protect.  2 s of
+        # staleness matches the poll cadence; a removed/added job's
+        # records follow within one memo window.
+        import time as _time
+        memo = getattr(self, "_tenant_ids_memo", None)
+        if memo is None:
+            memo = self._tenant_ids_memo = {}
+        now = _time.monotonic()
+        ent = memo.get(tenant)
+        if ent is not None and ent[0] > now:
+            return tenant, ent[1]
+        pfx = self.ks.tenant_jobs(tenant)
+        ids = set()
+        for kv in self.store.get_prefix(pfx):
+            rest = kv.key[len(pfx):]
+            if "/" in rest:
+                ids.add(rest.split("/", 1)[1])
+        out = sorted(ids)
+        if len(memo) > 4096:    # unbounded-tenant-name backstop
+            memo.clear()
+        memo[tenant] = (now + 2.0, out)
+        return tenant, out
+
+    @staticmethod
+    def _scoped_ids(ctx, tids):
+        """Intersect the request's explicit ids filter with a tenant
+        scope; either side absent passes the other through."""
+        job_ids = ctx.q("ids").split(",") if ctx.q("ids") else None
+        if tids is None:
+            return job_ids
+        if job_ids is None:
+            return list(tids)
+        allowed = set(tids)
+        return [j for j in job_ids if j in allowed]
+
     def log_list(self, ctx):
+        tenant, tids = self._tenant_scope(ctx)
         latest = ctx.q("latest") in ("true", "1")
         if latest:
             # the latest view is THE dashboard poll: revision-keyed 304
             # (and the response cache's partial reuse) makes an idle
             # dashboard O(1) per poll and a busy one O(changed shards)
-            return self._log_latest(ctx)
+            return self._log_latest(ctx, tenant, tids)
+        job_ids = self._scoped_ids(ctx, tids)
+        if tids is not None and not job_ids:
+            return {"total": 0, "list": []}
         nshards = getattr(self.sink, "nshards", 1)
         after_raw = ctx.q("afterId")
         after_id = None
@@ -865,6 +927,11 @@ class ApiServer:
                     recs = []
                 if rev is None:
                     raise HttpError(400, "sink has no revision support")
+                if tids is not None:
+                    # tenant scope is a security boundary: the tail
+                    # bootstrap page must not leak foreign records
+                    allowed = set(tids)
+                    recs = [r for r in recs if r.job_id in allowed]
                 return {"total": -1,
                         "list": [self._log_dict(r) for r in recs],
                         "cursor": self._rev_str(rev)}
@@ -879,7 +946,7 @@ class ApiServer:
         try:
             recs, total = self.sink.query_logs(
                 node=ctx.q("node") or None,
-                job_ids=ctx.q("ids").split(",") if ctx.q("ids") else None,
+                job_ids=job_ids,
                 name_like=ctx.q("names") or None,
                 begin=ctx.q_float("begin"),
                 end=ctx.q_float("end"),
@@ -913,17 +980,22 @@ class ApiServer:
                 out["cursor"] = str(nxt)
         return out
 
-    def _log_latest(self, ctx):
+    def _log_latest(self, ctx, tenant: str = "", tids=None):
         """The latest view through the response cache: each shard's
         partial is its filtered top rows (exactly the sharded client's
         scatter fetch), the merge is the documented (begin_ts DESC,
         job_id, node) order — byte-identical to the direct
-        ``sink.query_logs(latest=True, ...)`` path, pinned by test."""
+        ``sink.query_logs(latest=True, ...)`` path, pinned by test.
+        A tenant scope narrows the job-ids filter server-side (and
+        keys the cache, so scoped and unscoped polls never share a
+        body)."""
         from ..logsink.sharded import (fetch_top, log_shard_index,
                                        merge_latest_parts)
         page = max(1, min(ctx.q_int("page", 1), 1 << 40))
         page_size = max(1, min(ctx.q_int("pageSize", 50), 500))
-        job_ids = ctx.q("ids").split(",") if ctx.q("ids") else None
+        job_ids = self._scoped_ids(ctx, tids)
+        if tids is not None and not job_ids:
+            return {"total": 0, "list": []}
         kw = dict(node=ctx.q("node") or None,
                   job_ids=job_ids,
                   name_like=ctx.q("names") or None,
@@ -932,9 +1004,15 @@ class ApiServer:
                   failed_only=ctx.q("failedOnly") in ("true", "1"),
                   latest=True)
         need = page * page_size
+        # the tenant scope keys the cache by its RESOLVED id set, not
+        # the name: membership changes (job moved out of the tenant)
+        # must change the key — the shard revisions only move on sink
+        # writes, and a name-only key would keep serving the removed
+        # job's cached records across the boundary
         key = ("latest", ctx.q("node"), ctx.q("ids"), ctx.q("names"),
                ctx.q("begin"), ctx.q("end"), ctx.q("failedOnly"),
-               page, page_size)
+               page, page_size, tenant,
+               tuple(job_ids) if tids is not None else None)
         # a job-filtered poll touches only the filter's shards — the
         # sharded client's routing win, kept through the cache: pruned
         # shards contribute a constant empty partial without an RPC
@@ -972,27 +1050,119 @@ class ApiServer:
         rec = self.sink.get_log(int(ctx.path_args["id"]))
         if rec is None:
             raise HttpError(404, "no such log")
+        # the tenant boundary covers the detail endpoint too: ids are
+        # sequential, so without this a pinned account could enumerate
+        # every tenant's command/output history around the list
+        # filters.  404, not 403 — existence is part of the secret.
+        _tenant, tids = self._tenant_scope(ctx)
+        if tids is not None and rec.job_id not in set(tids):
+            raise HttpError(404, "no such log")
         return self._log_dict(rec)
 
     # ---- handlers: stats (revision-keyed, 304 on unchanged) -------------
 
     def stat_overall(self, ctx):
         from ..logsink.sharded import ShardedJobLogStore
+        tenant, tids = self._tenant_scope(ctx)
+        if tids is not None:
+            return self._tenant_stat_overall(tids)
         return self._cached_scatter(
             ctx, ("stat_overall",), "so:",
             lambda s, _i: s.stat_overall(),
             ShardedJobLogStore._sum_stats,
             self.sink.stat_overall)
 
+    def _tenant_stat_overall(self, tids) -> dict:
+        """Tenant-scoped overall stats, computed from the filtered
+        record counts (the sink's aggregate tables are fleet-wide).
+        Memoized a few seconds like _tenant_stat_days — the counts
+        bypass the revision-keyed response cache and a pinned
+        dashboard polls this every refresh."""
+        if not tids:
+            return {"total": 0, "successed": 0, "failed": 0}
+        import time as _time
+        memo = getattr(self, "_tenant_stat_memo", None)
+        if memo is None:
+            memo = self._tenant_stat_memo = {}
+        mkey = ("overall", tuple(tids))
+        now = _time.monotonic()
+        ent = memo.get(mkey)
+        if ent is not None and ent[0] > now:
+            return ent[1]
+        _r, total = self.sink.query_logs(job_ids=tids, page=1,
+                                         page_size=1)
+        _r, failed = self.sink.query_logs(job_ids=tids, failed_only=True,
+                                          page=1, page_size=1)
+        total = max(0, total)
+        failed = max(0, failed)
+        out = {"total": total, "successed": max(0, total - failed),
+               "failed": failed}
+        if len(memo) > 1024:
+            memo.clear()
+        memo[mkey] = (now + 5.0, out)
+        return out
+
     def stat_days(self, ctx):
         from ..logsink.sharded import merge_stat_days
+        tenant, tids = self._tenant_scope(ctx)
         n = ctx.q_int("days", 7)
+        if tids is not None:
+            if (n or 0) > 62:
+                # the scoped path counts per day (no aggregate table):
+                # refuse loudly rather than silently truncating a
+                # quarterly dashboard to 62 days
+                raise HttpError(
+                    400, "tenant-scoped stat/days supports at most 62 "
+                         "days")
+            return self._tenant_stat_days(tids, max(0, n or 0))
         days = max(0, min(n or 0, 3660))
         return self._cached_scatter(
             ctx, ("stat_days", days), f"sd{n}:",
             lambda s, _i: s.stat_days(days),
             lambda parts: merge_stat_days(parts, days),
             lambda: self.sink.stat_days(days))
+
+    def _tenant_stat_days(self, tids, n_days: int) -> list:
+        """Tenant-scoped per-day stats over UTC day windows (clamped to
+        62 days: up to two filtered counts per day).  Days with no
+        records are omitted, matching the fleet-wide view's shape.
+        Memoized for a few seconds per (tenant ids, days): the per-day
+        counts bypass the revision-keyed response cache, and a pinned
+        dashboard must not pay ~2·days count scans per poll."""
+        import datetime as _dt
+        import time as _time
+        out = []
+        if not tids:
+            return out
+        memo = getattr(self, "_tenant_stat_memo", None)
+        if memo is None:
+            memo = self._tenant_stat_memo = {}
+        mkey = (tuple(tids), n_days)
+        now = _time.monotonic()
+        ent = memo.get(mkey)
+        if ent is not None and ent[0] > now:
+            return ent[1]
+        today = _dt.datetime.now(_dt.timezone.utc).replace(
+            hour=0, minute=0, second=0, microsecond=0)
+        for i in range(n_days):
+            day0 = today - _dt.timedelta(days=i)
+            b, e = day0.timestamp(), day0.timestamp() + 86399.999
+            _r, total = self.sink.query_logs(job_ids=tids, begin=b,
+                                             end=e, page=1, page_size=1)
+            if total <= 0:
+                continue
+            _r, failed = self.sink.query_logs(job_ids=tids, begin=b,
+                                              end=e, failed_only=True,
+                                              page=1, page_size=1)
+            failed = max(0, failed)
+            out.append({"day": day0.strftime("%Y-%m-%d"),
+                        "total": total,
+                        "successed": max(0, total - failed),
+                        "failed": failed})
+        if len(memo) > 1024:
+            memo.clear()
+        memo[mkey] = (now + 5.0, out)
+        return out
 
     # ---- handlers: nodes + groups ---------------------------------------
 
@@ -1321,8 +1491,32 @@ class ApiServer:
         def sink_ok():
             return True, f"revision {self.sink.revision()}"
 
+        def sched_partitions_ok():
+            """With a pinned partition map, readiness demands a live
+            leader PER PARTITION (leased sched snapshots expire with
+            dead processes, so a leaderless partition shows up within
+            one lease ttl).  Unpartitioned fleets skip the check."""
+            p, malformed, _snaps, leaderless = self._sched_fleet_view()
+            if malformed:
+                return False, "malformed partmap"
+            if p is None:
+                return True, "unpartitioned"
+            if p <= 1:
+                return True, "p=1"
+            if leaderless:
+                return False, f"{p} partitions, leaderless: {leaderless}"
+            return True, f"all {p} partitions led"
+
         check("store", store_ok)
         check("logsink", sink_ok)
+        # INFORMATIONAL: a leaderless scheduler partition is surfaced
+        # here (and on /v1/sched, metrics, and the schedulers' own
+        # health ports) but must NOT 503 the web tier — everything
+        # this server serves still works, and failing readiness would
+        # drain every healthy web replica from the load balancer over
+        # a routine partition failover
+        check("sched_partitions", sched_partitions_ok)
+        checks["sched_partitions"]["informational"] = True
         for label, backend in (("store", self.store),
                                ("logsink", self.sink)):
             bs = getattr(backend, "breaker_snapshot", None)
@@ -1334,10 +1528,78 @@ class ApiServer:
             checks[f"{label}_breakers"] = {
                 "ok": not opened,
                 "detail": f"open shards: {opened}" if opened else ""}
-        ok = all(c["ok"] for c in checks.values())
+        ok = all(c["ok"] for c in checks.values()
+                 if not c.get("informational"))
         if not ok:
             ctx.out_status = 503
         return {"ok": ok, "checks": checks}
+
+    # ---- handlers: scheduler plane status -------------------------------
+
+    def _sched_fleet_view(self):
+        """Shared source for readyz's partition check and /v1/sched:
+        the pinned topology (None = no pin, ``malformed`` flagged
+        separately) plus every live scheduler's leased snapshot and
+        the leaderless-partition set — ONE implementation so the two
+        surfaces cannot drift."""
+        partitions = None
+        malformed = False
+        kv = self.store.get(self.ks.partmap)
+        if kv is not None:
+            try:
+                doc = json.loads(kv.value)
+                if not isinstance(doc, dict):
+                    raise ValueError("partmap is not an object")
+                partitions = int(doc.get("p", 1))
+            except (json.JSONDecodeError, TypeError, ValueError):
+                malformed = True
+        snaps = []
+        for mkv in self.store.get_prefix(self.ks.metrics + "sched/"):
+            instance = mkv.key[len(self.ks.metrics) + len("sched/"):]
+            try:
+                snap = json.loads(mkv.value)
+            except json.JSONDecodeError:
+                continue
+            snaps.append((instance, snap))
+        leaderless = []
+        if partitions and partitions > 1:
+            led = {int(s["partition"]) for _i, s in snaps
+                   if s.get("is_leader")
+                   and isinstance(s.get("partition"), (int, float))}
+            leaderless = [i for i in range(partitions) if i not in led]
+        return partitions, malformed, snaps, leaderless
+
+    def sched_status(self, ctx):
+        """Per-partition scheduler fleet view (the ``cronsun-ctl sched
+        status`` surface): the pinned partition topology plus every
+        live scheduler's leased snapshot — leaders AND warm standbys —
+        so a stalled or leaderless partition is one call away."""
+        partitions, _malformed, snaps, leaderless = \
+            self._sched_fleet_view()
+        insts = []
+        for instance, snap in snaps:
+            insts.append({
+                "instance": instance,
+                "partition": snap.get("partition"),
+                "is_leader": int(snap.get("is_leader", 0) or 0),
+                "steps_total": snap.get("steps_total", 0),
+                "dispatches_total": snap.get("dispatches_total", 0),
+                "sched_step_p99_ms": snap.get("sched_step_p99_ms", 0),
+                "jobs": snap.get("jobs", 0),
+                "watch_losses_total": snap.get("watch_losses_total", 0),
+                "lease_resigns_total":
+                    snap.get("lease_resigns_total", 0),
+                "skipped_seconds_total":
+                    snap.get("skipped_seconds_total", 0),
+                "checkpoint_restored":
+                    snap.get("checkpoint_restored", 0),
+                "acct_partitions_seen":
+                    snap.get("acct_partitions_seen"),
+            })
+        insts.sort(key=lambda d: (d["partition"] if d["partition"]
+                                  is not None else -1, d["instance"]))
+        return {"partitions": partitions, "instances": insts,
+                "leaderless": leaderless}
 
     # ---- handlers: metrics ----------------------------------------------
 
@@ -1359,6 +1621,7 @@ class ApiServer:
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {val}")
         seen_types: set = set()
+        sched_snaps: list = []    # partitioned-plane aggregation input
         for kv in self._degraded_prefix(self.ks.metrics):
             rest = kv.key[len(self.ks.metrics):].split("/", 1)
             if len(rest) != 2:
@@ -1369,6 +1632,16 @@ class ApiServer:
             except json.JSONDecodeError:
                 continue
             inst = _esc_label(instance)
+            # partitioned scheduler plane: every sched series carries
+            # its partition as a LABEL (a stalled partition must be
+            # visible per series, not averaged away); unpartitioned
+            # snapshots carry no partition field and render unchanged
+            extra = ""
+            if component == "sched":
+                sched_snaps.append(snap)
+                part = snap.get("partition")
+                if isinstance(part, (int, float)):
+                    extra = f',partition="{int(part)}"'
             if component == "tenant":
                 # per-tenant admission snapshots are NESTED
                 # ({tenant: {field: n}}): render each numeric leaf as
@@ -1395,12 +1668,41 @@ class ApiServer:
             for field, val in sorted(snap.items()):
                 if not isinstance(val, (int, float)):
                     continue
+                if field == "partition" and extra:
+                    continue    # rides every series as the label
                 name = f"cronsun_{component}_{field}"
                 if name not in seen_types:
                     kind = "counter" if field.endswith("_total") else "gauge"
                     lines.append(f"# TYPE {name} {kind}")
                     seen_types.add(name)
-                lines.append(f'{name}{{instance="{inst}"}} {val}')
+                lines.append(f'{name}{{instance="{inst}"{extra}}} {val}')
+        # aggregate scheduler-plane view: sums over the LIVE leaders'
+        # snapshots (one per partition when partitioned; the single
+        # leader otherwise), so "what is the fleet dispatching" is one
+        # series however many partitions tick behind it.  Gauges on
+        # purpose — the leader set changes across failovers, so the
+        # sums are not monotone.
+        leaders = [s for s in sched_snaps if s.get("is_leader")]
+        if leaders:
+            led_parts = {int(s["partition"]) for s in leaders
+                         if isinstance(s.get("partition"), (int, float))}
+            lines.append("# TYPE cronsun_sched_fleet_leaders gauge")
+            lines.append(f"cronsun_sched_fleet_leaders {len(leaders)}")
+            lines.append("# TYPE cronsun_sched_fleet_partitions gauge")
+            lines.append(f"cronsun_sched_fleet_partitions "
+                         f"{max(len(led_parts), 1)}")
+            for field in ("dispatches_total", "steps_total", "jobs",
+                          "procs_running", "dispatch_queue_depth",
+                          "overflow_drops_total",
+                          "skipped_seconds_total",
+                          "lease_resigns_total"):
+                vals = [s.get(field) for s in leaders]
+                vals = [v for v in vals if isinstance(v, (int, float))]
+                if not vals:
+                    continue
+                name = f"cronsun_sched_fleet_{field}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {sum(vals)}")
         # server-side op timings from BOTH backing servers (their own
         # op_stats op).  Store: names the component that owns a
         # dispatch-plane ceiling — claim paths, bulk writes, watch

@@ -2342,10 +2342,22 @@ class Agent {
                        bool consume) {
     std::string rest = key.substr((pfx_ + "/dispatch/" + id_ + "/").size());
     if (rest.find('/') == std::string::npos) {
-      if (rest.empty() || rest.find_first_not_of("0123456789") !=
-                              std::string::npos)
+      // "<epoch>" plain, or the partitioned scheduler's
+      // "<epoch>.<partition>" form (suffix scopes the reservation to
+      // its publishing partition; only the epoch matters here)
+      std::string ep = rest;
+      size_t dot = rest.find('.');
+      if (dot != std::string::npos) {
+        std::string part = rest.substr(dot + 1);
+        if (part.empty() || part.find_first_not_of("0123456789") !=
+                                std::string::npos)
+          return;
+        ep = rest.substr(0, dot);
+      }
+      if (ep.empty() || ep.find_first_not_of("0123456789") !=
+                            std::string::npos)
         return;
-      handle_bundle(key, atoll(rest.c_str()), value);
+      handle_bundle(key, atoll(ep.c_str()), value);
       return;
     }
     long long epoch;

@@ -1062,6 +1062,180 @@ def run_trace_bench(n_jobs=50_000, n_nodes=512, steps=12, window_s=4,
     return out
 
 
+def run_partition_ladder(n_jobs=40_000, n_nodes=256, parts=(1, 2, 4),
+                         steps=6, window_s=4, on_log=print):
+    """Partitioned scheduler plane ladder (ISSUE 15 acceptance): the
+    SAME job set planned by P independent partition leaders, P in
+    ``parts``.  Per rung: aggregate planned-fire throughput (total
+    fires over the SLOWEST partition's busy time — partitions tick
+    concurrently in deployment, so the fleet's rate is bounded by its
+    slowest slice), per-partition step p99 at that load, fire-set
+    fairness (min/max per-partition fires — the FNV token split's
+    balance), and ZERO divergence: every rung must plan exactly the
+    fire set (job, second) the P=1 scheduler plans.
+
+    Fresh store per rung (the partmap pins a topology per store
+    incarnation); schedules are made identical across rungs by
+    pre-seeding every @every phase anchor."""
+    import numpy as np
+    from cronsun_tpu.bin.common import enable_compile_cache
+    from cronsun_tpu.core import Keyspace
+    from cronsun_tpu.sched import SchedulerService
+    from cronsun_tpu.store import MemStore
+    from cronsun_tpu.store.remote import RemoteStore, StoreServer
+
+    enable_compile_cache("~/.cache/cronsun-tpu/xla")
+    # ascending rungs: the smallest P is the divergence baseline and
+    # must run first whatever order the CLI passed
+    parts = tuple(sorted(set(int(p) for p in parts)))
+    ks = Keyspace()
+    t0 = 1_760_000_000
+    rng = np.random.default_rng(11)
+    # @every 60s with anchors spread over the period: the per-second
+    # fire rate stays ~n_jobs/60 (steady, no herd), so the measured
+    # step is PLAN-dominated — the O(table) device scan the partition
+    # split actually halves — rather than publish-dominated against
+    # the one shared bench store
+    periods = rng.integers(0, 60, n_jobs)
+    kinds = rng.random(n_jobs)
+    nodes_of = rng.integers(0, n_nodes, n_jobs)
+
+    def seed_rung(store):
+        store.put_many([(ks.node_key(f"pn{i:05d}"), "bench:1")
+                        for i in range(n_nodes)])
+        items, anchors = [], []
+        for i in range(n_jobs):
+            kind = 0 if kinds[i] < 0.4 else 2
+            doc = (f'{{"name":"p{i}","command":"true","kind":{kind},'
+                   f'"rules":[{{"id":"r","timer":"@every 60s",'
+                   f'"nids":["pn{int(nodes_of[i]) :05d}"]}}]}}')
+            items.append((f"{ks.cmd}pbench/pj{i}", doc))
+            anchors.append((ks.phase_key("pbench", f"pj{i}", "r"),
+                            f"@every 60s|{t0 - int(periods[i])}"))
+            if len(items) >= 20_000:
+                store.put_many(items)
+                store.put_many(anchors)
+                items, anchors = [], []
+        if items:
+            store.put_many(items)
+            store.put_many(anchors)
+
+    def fire_set(store):
+        """Planned (job, second) pairs from the leased order keys:
+        coalesced exclusive bundles (suffix-tolerant) + broadcasts."""
+        out = set()
+        for kv in store.get_prefix_paged(ks.dispatch):
+            rest = kv.key[len(ks.dispatch):].split("/")
+            if rest[0] == Keyspace.BROADCAST:
+                if len(rest) == 4:
+                    out.add((rest[3], int(rest[1])))
+                continue
+            if len(rest) == 2:
+                parsed = Keyspace.split_bundle_epoch(rest[1])
+                if parsed is None:
+                    continue
+                for e in json.loads(kv.value):
+                    if isinstance(e, str) and "/" in e:
+                        out.add((e.partition("/")[2], parsed[0]))
+        return out
+
+    results = {}
+    base_set = None
+    for P in parts:
+        srv = StoreServer(MemStore()).start()
+        svcs = []
+        try:
+            seed_store = RemoteStore(srv.host, srv.port, timeout=600)
+            seed_rung(seed_store)
+            cap = 256
+            while cap < (n_jobs // P) * 1.5 + 64:
+                cap *= 2
+            on_log(f"[P={P}] cold-loading {P} partition(s) "
+                   f"(cap {cap} each)")
+            t_load = time.time()
+            for i in range(P):
+                svcs.append(SchedulerService(
+                    RemoteStore(srv.host, srv.port, timeout=600),
+                    job_capacity=cap, node_capacity=n_nodes,
+                    window_s=window_s, dispatch_ttl=3600.0,
+                    node_id=f"ladder-p{i}", partitions=P, partition=i))
+            load_s = time.time() - t_load
+            # warm step: pays XLA compile + first-window costs; the
+            # measured loop below starts from a clean latency slate
+            t = t0
+            for svc in svcs:
+                svc.step(now=t)
+            t = svcs[0]._next_epoch
+            for svc in svcs:
+                svc.reset_latency_stats()
+            busy = [0.0] * P
+            for _s in range(steps):
+                for i, svc in enumerate(svcs):
+                    ts = time.perf_counter()
+                    svc.step(now=t)
+                    busy[i] += time.perf_counter() - ts
+                t = svcs[0]._next_epoch
+            for i, svc in enumerate(svcs):
+                ts = time.perf_counter()
+                builder = getattr(svc, "_builder", None)
+                if builder is not None:
+                    builder.flush()
+                svc.publisher.flush()
+                busy[i] += time.perf_counter() - ts
+            # fires come from the STORE (the leased order keys), not
+            # the in-process counters: the async build accounting lags
+            # the step, and the store is the rung-comparable truth.
+            # Every rung covers the same planned seconds, so the sets
+            # must be EQUAL — divergence is the acceptance gate.
+            from cronsun_tpu.sched.partition import job_partition
+            fset = fire_set(seed_store)
+            if P == min(parts):
+                base_set = fset
+                divergence = 0
+            else:
+                divergence = len(fset ^ base_set)
+            fires = [0] * P
+            for (jid, _sec) in fset:
+                fires[job_partition(jid, P)] += 1
+            total = len(fset)
+            thr = total / max(max(busy), 1e-9)
+            p99 = max(svc._step_ms.percentile(0.99) for svc in svcs)
+            fairness = (min(fires) / max(fires)) if max(fires) > 0 \
+                else 0.0
+            results[P] = {
+                "fires": total,
+                "fires_per_partition": fires,
+                "agg_fires_per_s": round(thr, 1),
+                "step_p99_ms": round(p99, 3),
+                "slowest_busy_s": round(max(busy), 3),
+                "fairness": round(fairness, 4),
+                "divergence": divergence,
+                "cold_load_s": round(load_s, 2),
+            }
+            on_log(f"[P={P}] {total} fires, agg {thr:,.0f} fires/s, "
+                   f"step p99 {p99:.1f} ms, fairness {fairness:.3f}, "
+                   f"divergence {divergence}")
+        finally:
+            for svc in svcs:
+                try:
+                    svc.stop()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+            srv.stop()
+    out = {"sched_partition_ladder": {str(p): r
+                                      for p, r in results.items()},
+           "sched_partition_jobs": n_jobs,
+           "sched_partition_nodes": n_nodes}
+    base = min(parts)
+    for P in parts:
+        if P == base:
+            continue
+        out[f"sched_partition_speedup_{P}x"] = round(
+            results[P]["agg_fires_per_s"]
+            / max(results[base]["agg_fires_per_s"], 1e-9), 2)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--jobs", type=int, default=100_000)
@@ -1094,10 +1268,20 @@ def main():
                     help="--tenants: virtual seconds to drive per "
                          "run; --trace: LIVE wall seconds to drive "
                          "the mini-fleet (8 is plenty)")
+    ap.add_argument("--partition-ladder", default=None, metavar="P,P,..",
+                    help="run the partitioned-scheduler ladder (e.g. "
+                         "1,2,4): aggregate fires/s, per-partition "
+                         "step p99, fairness and P=1 divergence, "
+                         "instead of the step/failover bench")
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
     on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
-    if args.trace:
+    if args.partition_ladder:
+        parts = tuple(int(x) for x in args.partition_ladder.split(","))
+        res = run_partition_ladder(
+            n_jobs=args.jobs, n_nodes=args.nodes, parts=parts,
+            steps=args.steps, window_s=args.window, on_log=on_log)
+    elif args.trace:
         res = run_trace_bench(
             args.jobs, args.nodes, steps=args.steps,
             window_s=args.window, traced_jobs=args.traced_jobs,

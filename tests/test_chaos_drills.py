@@ -49,6 +49,21 @@ def test_chaos_drills():
 
 @pytest.mark.slow
 @pytest.mark.chaos
+def test_partition_leader_kill_drill():
+    """ISSUE 15's named gate: a 2-partition fleet kill -9s one
+    partition leader mid-window — its standby takes the slice over
+    within a bounded window, the other partition never stalls, and
+    the fleet-wide audit shows zero duplicate/missing fires (the
+    exactly-once invariant holds ACROSS partitions)."""
+    res = _run("partition_leader_kill")
+    assert res["findings"] == [], res["findings"]
+    assert res["info"]["recovery_s"] < 16.0
+    assert res["info"]["executions"] > 0
+    assert all(n > 0 for n in res["info"]["slice_sizes"].values())
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
 def test_brownout_drill_bounded_p99():
     """Acceptance criterion: with one shard browned out, the
     breaker-hardened client's read p99 stays <= 2x the healthy

@@ -300,3 +300,33 @@ def test_follow_logs_streams_new_records(world, capsys):
     out = capsys.readouterr().out
     assert "fresh" in out and "FAIL" in out
     assert "pre" not in out          # only records after the HWM stream
+
+
+def test_sched_status_lists_partitions_and_leaderless(world, capsys):
+    """`cronsun-ctl sched status`: per-partition leader table from the
+    leased sched snapshots + the partmap pin; a leaderless partition
+    is called out loudly (ISSUE 15 satellite)."""
+    store, _, run = world
+    _login(run, capsys)
+    store.put(KS.partmap, '{"p":2,"hash":"fnv1a-jobtoken-v1"}')
+    store.put(KS.metrics_key("sched", "s0"), json.dumps(
+        {"partition": 0, "partitions": 2, "is_leader": 1,
+         "steps_total": 5, "dispatches_total": 42,
+         "sched_step_p99_ms": 3.2, "jobs": 7,
+         "lease_resigns_total": 1, "watch_losses_total": 0,
+         "skipped_seconds_total": 0}))
+    rc = run("sched", "status")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "partitions: 2" in out
+    assert "s0" in out and "leader" in out and "42" in out
+    assert "leaderless partition(s): [1]" in out
+    # unpartitioned fleet: no pin, no warning
+    store.delete(KS.partmap)
+    store.put(KS.metrics_key("sched", "solo"), json.dumps(
+        {"is_leader": 1, "steps_total": 1, "dispatches_total": 0,
+         "sched_step_p99_ms": 1.0, "jobs": 0}))
+    rc = run("sched", "status")
+    out = capsys.readouterr().out
+    assert rc == 0 and "unpartitioned" in out
+    assert "leaderless" not in out

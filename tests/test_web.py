@@ -672,3 +672,85 @@ def test_dag_group_move_of_upstream_refused(world):
     assert code == 200
     assert store.get(KS.job_key("etl", "up")) is None
     assert store.get(KS.job_key("other", "up")) is not None
+
+
+def _tenant_log_world(store, sink):
+    """Two tenants' job-index markers + one fresh record each (begin_ts
+    now, so the UTC day-window stats see them)."""
+    import time as _t
+    now = _t.time()
+    store.put(KS.tenant_job_key("acme", "g", "ja"), "1")
+    store.put(KS.tenant_job_key("globex", "g", "jb"), "1")
+    sink.create_job_log(LogRecord(
+        job_id="ja", job_group="g", name="a", node="n1", user="",
+        command="c", output="", success=True,
+        begin_ts=now - 5, end_ts=now - 4))
+    sink.create_job_log(LogRecord(
+        job_id="jb", job_group="g", name="b", node="n1", user="",
+        command="c", output="", success=False,
+        begin_ts=now - 5, end_ts=now - 4))
+
+
+def test_logs_and_stats_tenant_scoped(world):
+    """ISSUE 15 satellite: tenant= narrows /v1/logs (history + latest)
+    and /v1/stat/* to the tenant's job-index slice."""
+    store, sink, _, c = world
+    c.login()
+    _tenant_log_world(store, sink)
+    code, d = c.req("GET", "/v1/logs?tenant=acme")
+    assert code == 200 and [r["jobId"] for r in d["list"]] == ["ja"]
+    code, d = c.req("GET", "/v1/logs?tenant=acme&latest=true")
+    assert code == 200 and [r["jobId"] for r in d["list"]] == ["ja"]
+    # explicit ids intersect with the scope (a foreign id yields none)
+    code, d = c.req("GET", "/v1/logs?tenant=acme&ids=jb")
+    assert code == 200 and d["total"] == 0 and d["list"] == []
+    # unknown tenant: empty view, not an error
+    code, d = c.req("GET", "/v1/logs?tenant=nobody")
+    assert code == 200 and d["total"] == 0
+    code, d = c.req("GET", "/v1/stat/overall?tenant=acme")
+    assert (code, d) == (200, {"total": 1, "successed": 1, "failed": 0})
+    code, d = c.req("GET", "/v1/stat/overall?tenant=globex")
+    assert (code, d) == (200, {"total": 1, "successed": 0, "failed": 1})
+    code, d = c.req("GET", "/v1/stat/days?tenant=globex&days=3")
+    assert code == 200 and len(d) == 1
+    assert (d[0]["total"], d[0]["failed"]) == (1, 1)
+    # unscoped views keep today's bytes
+    code, d = c.req("GET", "/v1/stat/overall")
+    assert code == 200 and d["total"] == 2
+
+
+def test_tenant_pinned_account_logs_enforced_server_side(world):
+    """A tenant-pinned account's log/stat reads are FORCED to its
+    tenant: omitting the parameter scopes anyway, spoofing another
+    tenant 403s."""
+    store, sink, srv, c = world
+    c.login()
+    _tenant_log_world(store, sink)
+    code, _ = c.req("PUT", "/v1/admin/account",
+                    {"email": "dev@acme.io", "password": "pass1",
+                     "tenant": "acme"})
+    assert code == 200
+    c2 = Client(srv.port)
+    code, _ = c2.login("dev@acme.io", "pass1")
+    assert code == 200
+    code, d = c2.req("GET", "/v1/logs")
+    assert code == 200 and [r["jobId"] for r in d["list"]] == ["ja"]
+    code, d = c2.req("GET", "/v1/logs?latest=true")
+    assert code == 200 and [r["jobId"] for r in d["list"]] == ["ja"]
+    code, d = c2.req("GET", "/v1/logs?tenant=globex")
+    assert code == 403
+    # the detail endpoint honors the pin too (ids are sequential —
+    # enumeration must not leak another tenant's output); 404, not 403
+    _, own = c.req("GET", "/v1/logs")
+    by_job = {r["jobId"]: r["id"] for r in own["list"]}
+    code, _ = c2.req("GET", f"/v1/log/{by_job['ja']}")
+    assert code == 200
+    code, _ = c2.req("GET", f"/v1/log/{by_job['jb']}")
+    assert code == 404
+    code, d = c2.req("GET", "/v1/stat/overall")
+    assert (code, d) == (200, {"total": 1, "successed": 1, "failed": 0})
+    code, d = c2.req("GET", "/v1/stat/days?tenant=globex")
+    assert code == 403
+    # admins stay unpinned: the same calls see the fleet
+    code, d = c.req("GET", "/v1/logs")
+    assert code == 200 and d["total"] == 2
