@@ -572,9 +572,12 @@ def main():
             cmd += ["--devices", "1,2,4,8", "--shapes", "65536x1024",
                     "--out", os.path.join(here, "MULTICHIP_ladder.json")]
         # outer budget >= worst-case sum of per-worker budgets (the
-        # full ladder is up to 12 workers x 600 s each)
+        # full ladder is up to 12 workers x 600 s each, plus the six
+        # sparse-tick rungs at 900 s each; merged keys now include
+        # multichip_sparse_ladder / multichip_demand_format /
+        # multichip_divergence_*)
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=7260, cwd=here)
+                              timeout=12660, cwd=here)
         if proc.returncode == 0:
             merged = json.loads(proc.stdout)
             # the parent's provenance stamp wins over the child's

@@ -98,6 +98,15 @@ def main(argv=None) -> int:
                          "reconcile (O(fired-bucket) exchange per round) "
                          "instead of bucket-sharded bidding (O(nodes)); "
                          "every rank of a multi-host mesh must agree")
+    ap.add_argument("--mesh-demand-format", default="auto",
+                    choices=("auto", "dense", "compacted"),
+                    metavar="FMT",
+                    help="demand wire format for the sharded reconcile: "
+                         "auto picks dense vs compacted per plan from "
+                         "the collective-bytes crossover; dense/"
+                         "compacted pin it (the compacted-gather "
+                         "rollback knob); every rank of a multi-host "
+                         "mesh must agree")
     ap.add_argument("--health-port", type=int, default=0, metavar="P",
                     help="serve /healthz + /readyz on this port "
                          "(readiness: leader lease / watches / step "
@@ -174,18 +183,22 @@ def main(argv=None) -> int:
         from ..parallel.mesh import Sharded2DTickPlanner, make_mesh2d
         planner = Sharded2DTickPlanner(
             make_mesh2d(dj, dn), job_capacity=cfg.job_capacity,
-            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids)
+            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids,
+            demand_format=args.mesh_demand_format)
         log.infof("planner sharded over a %dx%d (jobs x nodes) mesh "
-                  "(%s bidding)", dj, dn,
-                  "bucket-sharded" if shard_bids else "replicated")
+                  "(%s bidding, %s demand)", dj, dn,
+                  "bucket-sharded" if shard_bids else "replicated",
+                  args.mesh_demand_format)
     elif args.mesh > 1:
         from ..parallel.mesh import ShardedTickPlanner, make_mesh
         planner = ShardedTickPlanner(
             make_mesh(args.mesh), job_capacity=cfg.job_capacity,
-            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids)
-        log.infof("planner sharded over %d devices (%s bidding)",
-                  args.mesh,
-                  "bucket-sharded" if shard_bids else "replicated")
+            node_capacity=cfg.node_capacity, tz=tz, shard_bids=shard_bids,
+            demand_format=args.mesh_demand_format)
+        log.infof("planner sharded over %d devices (%s bidding, "
+                  "%s demand)", args.mesh,
+                  "bucket-sharded" if shard_bids else "replicated",
+                  args.mesh_demand_format)
     if args.mesh_hosts > 1 and args.mesh_proc_id > 0:
         # mesh worker: no store, no leadership — replay the leader's
         # broadcast deltas and join its collective plans until told to

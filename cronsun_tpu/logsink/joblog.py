@@ -703,7 +703,10 @@ class JobLogStore:
                 from . import tiering as tg
                 for seg in self._segments:
                     if seg["min"] <= log_id <= seg["max"]:
-                        for r in tg.read_segment(seg["path"]):
+                        # sparse-index seek: parses O(stride) lines of
+                        # the day, not the whole segment
+                        for r in tg.read_segment_range(
+                                seg["path"], lo=log_id, hi=log_id):
                             if r.id == log_id:
                                 self.op_count("q_get_cold")
                                 return r

@@ -39,6 +39,10 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from .joblog import LogRecord
 
 SEG_SUFFIX = ".seg"
+IDX_SUFFIX = ".idx"
+# one sparse-index mark every this many records: a seek lands within
+# IDX_STRIDE parsed lines of the target id instead of the whole file
+IDX_STRIDE = 64
 
 
 def day_of(ts: float) -> str:
@@ -70,6 +74,11 @@ def seg_dir(db_path: str) -> Optional[str]:
 
 def seg_path(dirp: str, day: str) -> str:
     return os.path.join(dirp, day + SEG_SUFFIX)
+
+
+def idx_path(path: str) -> str:
+    """``<day>.idx`` sidecar next to a ``<day>.seg``."""
+    return path[:-len(SEG_SUFFIX)] + IDX_SUFFIX
 
 
 def _rec_line(r: LogRecord) -> str:
@@ -106,6 +115,102 @@ def read_segment(path: str) -> List[LogRecord]:
     return out
 
 
+def _read_index(path: str, seg_header: list) -> Optional[List[Tuple[int,
+                                                                    int]]]:
+    """Sparse (id, offset) marks for ``path``'s segment, or None when
+    the ``.idx`` sidecar is missing or STALE — its mirrored header must
+    equal the segment's (day, count, min, max), which any crash
+    ordering between the two renames fails, so a stale index can only
+    cost a full scan, never a wrong seek."""
+    try:
+        with open(idx_path(path), "r", encoding="utf-8") as f:
+            h = json.loads(f.readline())
+            if not (isinstance(h, list) and len(h) >= 5 and h[0] == "i"
+                    and list(h[1:5]) == list(seg_header[1:5])):
+                return None
+            marks = []
+            for line in f:
+                v = json.loads(line)
+                if not (isinstance(v, list) and len(v) >= 3
+                        and v[0] == "e"):
+                    return None
+                marks.append((int(v[1]), int(v[2])))
+            return marks
+    except (OSError, ValueError):
+        return None
+
+
+def read_segment_range(path: str, lo: Optional[int] = None,
+                       hi: Optional[int] = None) -> List[LogRecord]:
+    """Records of one segment with ``lo <= id <= hi``, id ASCENDING —
+    the memory-mapped ranged read.  With a fresh ``.idx`` sidecar the
+    scan SEEKS to within IDX_STRIDE lines of ``lo`` and stops at the
+    first id past ``hi`` (ids are ascending on disk), so a single-id
+    lookup or a watermark/floor-bounded cold scan parses O(stride +
+    matches) lines instead of the whole day.  Missing/stale sidecars
+    fall back to scanning from the top; torn or garbage files read as
+    empty, exactly like ``read_segment``."""
+    import bisect
+    import mmap
+    if lo is None and hi is None:
+        return read_segment(path)
+    out: List[LogRecord] = []
+    try:
+        with open(path, "rb") as fh:
+            try:
+                mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+            except (OSError, ValueError):      # empty file can't map
+                return []
+            try:
+                end = mm.find(b"\n")
+                if end < 0:
+                    return []
+                h = json.loads(mm[:end])
+                if not (isinstance(h, list) and len(h) >= 5
+                        and h[0] == "d"):
+                    return []
+                if lo is not None and int(h[4]) < lo:
+                    return []
+                if hi is not None and int(h[3]) > hi:
+                    return []
+                pos = end + 1
+                if lo is not None:
+                    marks = _read_index(path, h)
+                    if marks:
+                        i = bisect.bisect_right(
+                            [m[0] for m in marks], lo) - 1
+                        if i >= 0:
+                            pos = marks[i][1]
+                size = mm.size()
+                while pos < size:
+                    nl = mm.find(b"\n", pos)
+                    if nl < 0:
+                        nl = size
+                    line = mm[pos:nl]
+                    pos = nl + 1
+                    if not line:
+                        continue
+                    v = json.loads(line)
+                    if not (isinstance(v, list) and len(v) >= 12
+                            and v[0] == "L"):
+                        return []
+                    rid = int(v[1])
+                    if hi is not None and rid > hi:
+                        break                  # ids ascend on disk
+                    if lo is not None and rid < lo:
+                        continue
+                    out.append(LogRecord(
+                        id=rid, job_id=v[2], job_group=v[3], name=v[4],
+                        node=v[5], user=v[6], command=v[7], output=v[8],
+                        success=bool(v[9]), begin_ts=float(v[10]),
+                        end_ts=float(v[11])))
+            finally:
+                mm.close()
+    except (OSError, ValueError):
+        return []
+    return out
+
+
 def write_segment(dirp: str, day: str, recs: Iterable[LogRecord]) -> dict:
     """Write (or extend) ``day``'s segment with ``recs``, UNIONED by id
     with whatever the existing file holds — idempotent, so the crash
@@ -119,16 +224,45 @@ def write_segment(dirp: str, day: str, recs: Iterable[LogRecord]) -> dict:
         by_id[r.id] = r
     rows = [by_id[i] for i in sorted(by_id)]
     tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(json.dumps(
-            ["d", day, len(rows), rows[0].id if rows else 0,
-             rows[-1].id if rows else 0],
-            separators=(",", ":")) + "\n")
-        for r in rows:
-            f.write(_rec_line(r) + "\n")
+    header = json.dumps(
+        ["d", day, len(rows), rows[0].id if rows else 0,
+         rows[-1].id if rows else 0],
+        separators=(",", ":")) + "\n"
+    marks: List[Tuple[int, int]] = []    # (id, byte offset) every stride
+    with open(tmp, "wb") as f:
+        f.write(header.encode("utf-8"))
+        off = len(header.encode("utf-8"))
+        for i, r in enumerate(rows):
+            line = (_rec_line(r) + "\n").encode("utf-8")
+            if i % IDX_STRIDE == 0:
+                marks.append((r.id, off))
+            f.write(line)
+            off += len(line)
         f.flush()
         os.fdatasync(f.fileno())
     os.replace(tmp, path)
+    # sparse-index sidecar: (id, offset) marks every IDX_STRIDE records
+    # so ranged reads SEEK instead of parsing the whole day.  Its header
+    # mirrors the segment's — a reader uses the index only when the two
+    # match, so any crash ordering between the renames (fresh seg +
+    # stale idx, or idx written but seg redo pending) degrades to the
+    # full-scan path, never to wrong offsets.  Advisory data: a failed
+    # sidecar write must not fail the durable segment write.
+    try:
+        itmp = idx_path(path) + ".tmp"
+        with open(itmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                ["i", day, len(rows), rows[0].id if rows else 0,
+                 rows[-1].id if rows else 0],
+                separators=(",", ":")) + "\n")
+            for rid, o in marks:
+                f.write(json.dumps(["e", rid, o],
+                                   separators=(",", ":")) + "\n")
+            f.flush()
+            os.fdatasync(f.fileno())
+        os.replace(itmp, idx_path(path))
+    except OSError:
+        pass
     # fsync the DIRECTORY: the rename is only a directory-entry update,
     # and the caller durably advances the cold watermark right after —
     # a power loss could otherwise persist a watermark pointing at a
@@ -248,9 +382,11 @@ def cold_query(segments: List[dict], boundary: int, match,
                     total += seg["count"]
                     continue
         touched += 1
-        for r in read_segment(seg["path"]):
-            if r.id <= min_id or r.id > boundary:
-                continue
+        # ranged read: the retention floor and the durable watermark
+        # become the seek bounds — a cursor poll deep into the tier
+        # seeks past everything already served instead of re-parsing it
+        for r in read_segment_range(seg["path"], lo=min_id + 1,
+                                    hi=boundary):
             if match is not None and not match(r):
                 continue
             total += 1

@@ -250,6 +250,51 @@ def local_bid_demand(cand, choice, cost, n_padded: int):
     return rank, cum, jnp.stack([cnt, wn])
 
 
+def compact_demand(demand, k_comp: int):
+    """Compact a dense [2, N] per-node demand block (count, cost-sum)
+    into [3, k_comp] f32 triples (node_idx, count, cost_sum) — the
+    sparse-tick wire format the mesh reconcile gathers instead of the
+    dense block.
+
+    A shard's demand has at most min(#candidates, N) nonzero nodes, so
+    ``k_comp = min(k_local, N)`` NEVER truncates: every nonzero entry
+    survives compaction by construction.  Node indices ride f32 (exact
+    below 2^24 — N tops out at ~100k), so the gathered payload is ONE
+    [3, k_comp] array: 12 B x k_comp per shard vs 8 B x N dense.  Pad
+    entries carry distinct zero-demand node ids with count = cost = 0,
+    so the scatter-add in :func:`scatter_demand` is a no-op for them.
+    """
+    nz = demand[0] > 0
+    # stable argsort of the ~nonzero mask: nonzero node ids first, in
+    # ascending node order (the planner's _compact idiom)
+    order = jnp.argsort(~nz, stable=True)
+    idx = order[:k_comp]
+    take = nz[idx]
+    cnt = jnp.where(take, demand[0][idx], 0.0)
+    w = jnp.where(take, demand[1][idx], 0.0)
+    return jnp.stack([idx.astype(jnp.float32), cnt, w]), idx
+
+
+def scatter_demand(comp, n_padded: int):
+    """Gathered compacted triples [D, 3, k_comp] -> dense [D, 2, N]
+    per-shard demand blocks, scatter-added back so downstream prefix
+    sums see BYTE-identical inputs to the dense all_gather path.
+
+    Within one shard the compacted node ids are distinct (they come
+    from a permutation), so the scatter-add never accumulates twice
+    into one slot — the dense block it rebuilds equals the block
+    :func:`compact_demand` started from, value for value, and the
+    shard-major prefix reduction over it is bit-identical to the dense
+    path's."""
+    D = comp.shape[0]
+    idx = jnp.clip(comp[:, 0].astype(jnp.int32), 0, n_padded - 1)
+    rows = jnp.arange(D, dtype=jnp.int32)[:, None]
+    dense = jnp.zeros((D, 2, n_padded), jnp.float32)
+    dense = dense.at[rows, 0, idx].add(comp[:, 1])
+    dense = dense.at[rows, 1, idx].add(comp[:, 2])
+    return dense
+
+
 def waterfill_accept_presplit(cand, choice, cost, load, rem_cap, is_final,
                               rank_g, cum_g, tot_w):
     """Accept decision for candidates whose GLOBAL within-node rank and

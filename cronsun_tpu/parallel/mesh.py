@@ -49,7 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.assign import (_steps, local_bid_demand, waterfill_accept,
+from ..ops.assign import (_steps, compact_demand, local_bid_demand,
+                          scatter_demand, waterfill_accept,
                           waterfill_accept_presplit)
 from ..ops.planner import TickPlan, TickPlanner, _compact, _next_pow2
 from ..ops.schedule_table import FRAMEWORK_EPOCH, ScheduleTable
@@ -58,6 +59,12 @@ from ..ops.timecal import window_fields
 
 AXIS = "jobs"
 NAXIS = "nodes"
+
+# node width at which the 2-D mesh's Common fan-out psum shards by node
+# blocks (each device reduces only its [N/Dn] block; one gather
+# assembles) instead of psumming the full [N] — below it the dense psum
+# compiles as before
+NODE_BLOCK_PSUM_MIN_N = 65536
 
 
 def _shard_map(body, mesh, in_specs, out_specs):
@@ -76,28 +83,52 @@ def _shard_map(body, mesh, in_specs, out_specs):
                   check_rep=False)
 
 
-def _reconcile_sharded(cand, choice, cost, load, rem_cap, is_final, axis):
+def _reconcile_sharded(cand, choice, cost, load, rem_cap, is_final, axis,
+                       compact_k=None):
     """One bucket-sharded accept round: exchange per-node demand
-    summaries ([2, N] per shard) instead of the candidate bids
-    ([k_local] x 3 per shard) — payload independent of the fired
-    bucket.
+    summaries instead of the candidate bids ([k_local] x 3 per shard).
+
+    Two wire formats for the same reconcile, selected statically by
+    ``compact_k`` (None = dense):
+
+    - **dense** ([2, N] per shard): payload independent of the fired
+      bucket — 8N x D gathered + one 8N psum per round.  Right for the
+      herd regime.
+    - **compacted** ([3, compact_k] per shard, compact_k =
+      min(k_local, N)): only the NONZERO per-node demand entries travel,
+      as (node_idx, count, cost_sum) f32 triples — 12 B x compact_k x D
+      gathered per exchange, proportional to DEMAND, not fleet width.
+      Each shard scatter-adds the gathered triples back into the dense
+      [D, 2, N] accumulator (assign.scatter_demand), so the prefix
+      reduction below consumes byte-identical inputs and the accepts
+      stay bit-identical to the dense path.  The accepted exchange rides
+      the same compacted node list (accepted nodes are a subset of
+      demand nodes), replacing the dense psum with a second 12 B x
+      compact_k x D gather + local shard-axis sum.
 
     1. local: rank + exclusive cumulative cost among same-node
        candidates of THIS shard, and the [2, N] (count, cost-sum)
        demand block (assign.local_bid_demand);
-    2. all_gather the demand blocks along ``axis`` -> [D, 2, N]; the
+    2. exchange the demand blocks along ``axis`` -> [D, 2, N] (dense
+       all_gather, or compacted gather + scatter-add); the
        earlier-shards prefix (shard-major, matching the gathered
        bucket's candidate order) lifts local rank/cum-cost to global;
     3. the replicated waterfill's accept predicate, evaluated locally
        (assign.waterfill_accept_presplit);
-    4. psum the accepted (count, cost) block so load/rem_cap stay
-       replicated — integer counts exact, cost sums exact for integer
-       costs (ulp-order-different otherwise).
+    4. exchange the accepted (count, cost) block so load/rem_cap stay
+       replicated (psum dense, gather+sum compacted) — integer counts
+       exact, cost sums exact for integer costs (ulp-order-different
+       otherwise).
     """
     n_padded = load.shape[0]
     rank_l, cum_l, demand = local_bid_demand(cand, choice, cost, n_padded)
     d = jax.lax.axis_index(axis)
-    demand_g = jax.lax.all_gather(demand, axis)            # [D, 2, N]
+    if compact_k is None:
+        demand_g = jax.lax.all_gather(demand, axis)        # [D, 2, N]
+    else:
+        comp, comp_idx = compact_demand(demand, compact_k)  # [3, k], [k]
+        comp_g = jax.lax.all_gather(comp, axis)            # [D, 3, k]
+        demand_g = scatter_demand(comp_g, n_padded)        # [D, 2, N]
     nsh = demand_g.shape[0]
     before = (jnp.arange(nsh) < d)[:, None, None]
     prefix = jnp.sum(jnp.where(before, demand_g, 0.0), axis=0)  # [2, N]
@@ -108,10 +139,18 @@ def _reconcile_sharded(cand, choice, cost, load, rem_cap, is_final, axis):
     accept = waterfill_accept_presplit(
         cand, choice, cost, load, rem_cap, is_final, rank_g, cum_g, tot_w)
     a32 = accept.astype(jnp.float32)
-    upd = jax.lax.psum(jnp.stack([
+    acc = jnp.stack([
         jnp.zeros(n_padded, jnp.float32).at[safe].add(a32),
         jnp.zeros(n_padded, jnp.float32).at[safe].add(
-            jnp.where(accept, cost, 0.0))]), axis)
+            jnp.where(accept, cost, 0.0))])
+    if compact_k is None:
+        upd = jax.lax.psum(acc, axis)
+    else:
+        # accepted nodes are candidate nodes, so the demand compaction's
+        # node list covers them; ship (idx, acc_cnt, acc_cost) triples
+        acc_comp = jnp.stack([comp[0], acc[0][comp_idx], acc[1][comp_idx]])
+        acc_g = jax.lax.all_gather(acc_comp, axis)         # [D, 3, k]
+        upd = jnp.sum(scatter_demand(acc_g, n_padded), axis=0)
     load = load + upd[1]
     rem_cap = rem_cap - upd[0].astype(jnp.int32)
     return accept, load, rem_cap
@@ -140,13 +179,13 @@ def make_mesh2d(dj: int, dn: int) -> Mesh:
 
 def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
                 k_local: int, rounds: int, bid, fanout,
-                shard_bids: bool = False):
+                shard_bids: bool = False, compact_k=None):
     """One second of the jobs-mesh plan, per shard: local compact + bid,
-    then the per-round reconcile — bucket-sharded (O(N) demand exchange,
-    ``shard_bids=True``) or the replicated waterfill on the gathered
-    candidate bucket (O(K)).  THE single definition — both the per-tick
-    body and the fused windowed scan call it, so their semantics cannot
-    drift."""
+    then the per-round reconcile — bucket-sharded (demand exchange,
+    ``shard_bids=True``; dense [2, N] or compacted triples per
+    ``compact_k``) or the replicated waterfill on the gathered candidate
+    bucket (O(K)).  THE single definition — both the per-tick body and
+    the fused windowed scan call it, so their semantics cannot drift."""
     d = jax.lax.axis_index(AXIS)
     j_local = elig.shape[0]
     idx, valid, total = _compact(fire_col, k_local)
@@ -167,7 +206,7 @@ def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
         if shard_bids:
             accept_l, load, rem_cap = _reconcile_sharded(
                 cand_l, choice, cost_k, load, rem_cap,
-                r == rounds - 1, AXIS)
+                r == rounds - 1, AXIS, compact_k=compact_k)
         else:
             # Exchange compacted bids; every shard sees the same global
             # bucket.
@@ -189,19 +228,19 @@ def _tick_local(fire_col, elig, exclusive, cost, load, rem_cap,
 
 def _sharded_plan_body(table, fields, elig, exclusive, cost, load, rem_cap,
                        k_local: int, rounds: int, impl: str,
-                       shard_bids: bool):
+                       shard_bids: bool, compact_k=None):
     """Runs per-shard inside shard_map.  All [J/D]-shaped inputs are the
     local shard; load/rem_cap are replicated."""
     bid, fanout = _steps(impl)
     f = [fields[i:i + 1] for i in range(7)]
     fire = _fire_mask_jit(table, *f)[:, 0]
     return _tick_local(fire, elig, exclusive, cost, load, rem_cap,
-                       k_local, rounds, bid, fanout, shard_bids)
+                       k_local, rounds, bid, fanout, shard_bids, compact_k)
 
 
 def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
                          rem_cap, k_local: int, rounds: int, impl: str,
-                         shard_bids: bool):
+                         shard_bids: bool, compact_k=None):
     """Fused windowed plan per shard: W seconds under one lax.scan with
     the tick collectives inside — the production cadence (plan ahead of
     wall-clock, one dispatch per window) composed with the jobs mesh.
@@ -216,7 +255,7 @@ def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
         load, rem_cap = carry
         out, load, rem_cap = _tick_local(
             fire_col, elig, exclusive, cost, load, rem_cap,
-            k_local, rounds, bid, fanout, shard_bids)
+            k_local, rounds, bid, fanout, shard_bids, compact_k)
         return (load, rem_cap), out
 
     (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
@@ -225,7 +264,8 @@ def _sharded_window_body(table, fields_w, elig, exclusive, cost, load,
 
 def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
                   k_local: int, rounds: int, impl: str, bid_k, fanout,
-                  shard_bids: bool = False):
+                  shard_bids: bool = False, compact_k=None,
+                  node_block_fanout: bool = False):
     """One second of the (jobs x nodes) mesh plan, per device — THE
     single definition shared by the per-tick body and the fused windowed
     scan (same no-drift contract as the 1-D _tick_local).
@@ -255,12 +295,22 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
     excl_k = exclusive[idx]
     cost_k = cost[idx].astype(jnp.float32)
 
-    # Common fan-out: per-block partial -> concat along nodes -> sum along
-    # jobs; load stays replicated everywhere.
+    # Common fan-out: per-block partial -> sum along jobs -> concat along
+    # nodes; load stays replicated everywhere.  Order of the two
+    # collectives is the node-block knob: reducing FIRST (``True``, the
+    # >=64k-node default) psums only this device's [N/Dn] block — each
+    # (jobs-column, node-block) group reduces its own block and one
+    # gather assembles — instead of psumming the full [N]; elementwise
+    # sum and concat commute, so the assembled load is the same array
+    # either way (pinned by differential test).
     common_w = jnp.where(valid & ~excl_k, cost_k, 0.0)
     block = fanout(packed_k, common_w)                         # [n_local]
-    full = jax.lax.all_gather(block, NAXIS, tiled=True)        # [N]
-    load = load + jax.lax.psum(full, AXIS)
+    if node_block_fanout:
+        blk = jax.lax.psum(block, AXIS)                        # [n_local]
+        load = load + jax.lax.all_gather(blk, NAXIS, tiled=True)
+    else:
+        full = jax.lax.all_gather(block, NAXIS, tiled=True)    # [N]
+        load = load + jax.lax.psum(full, AXIS)
 
     def bid_block(packed, load_blk):
         if impl in ("jnp", "mixed"):
@@ -290,11 +340,12 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
         choice = jnp.where(jnp.isfinite(best), choice, 0)
         cand_l = need0 & (assigned < 0) & jnp.isfinite(best)
         if shard_bids:
-            # demand-summary exchange along jobs (O(N)); the node-axis
+            # demand-summary exchange along jobs (dense O(N) or
+            # compacted O(compact_k) per compact_k); the node-axis
             # argmin reduce above already made `choice` global
             accept_l, load, rem_cap = _reconcile_sharded(
                 cand_l, choice, cost_k, load, rem_cap,
-                r == rounds - 1, AXIS)
+                r == rounds - 1, AXIS, compact_k=compact_k)
         else:
             # candidate exchange along jobs; identical accept on every
             # shard
@@ -316,19 +367,22 @@ def _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
 
 def _sharded2d_plan_body(table, fields, elig, exclusive, cost, load,
                          rem_cap, k_local: int, rounds: int, impl: str,
-                         shard_bids: bool):
+                         shard_bids: bool, compact_k=None,
+                         node_block_fanout: bool = False):
     """Per-tick body over the (jobs, nodes) mesh — fire mask + one
     _tick2d_local."""
     bid_k, fanout = _steps(impl)
     f = [fields[i:i + 1] for i in range(7)]
     fire = _fire_mask_jit(table, *f)[:, 0]
     return _tick2d_local(fire, elig, exclusive, cost, load, rem_cap,
-                         k_local, rounds, impl, bid_k, fanout, shard_bids)
+                         k_local, rounds, impl, bid_k, fanout, shard_bids,
+                         compact_k, node_block_fanout)
 
 
 def _sharded2d_window_body(table, fields_w, elig, exclusive, cost, load,
                            rem_cap, k_local: int, rounds: int, impl: str,
-                           shard_bids: bool):
+                           shard_bids: bool, compact_k=None,
+                           node_block_fanout: bool = False):
     """Fused windowed plan over the 2-D mesh: W seconds under one
     lax.scan with all collectives inside — one dispatch per window (the
     RTT-amortizing production cadence, same as the 1-D planner's fused
@@ -343,7 +397,8 @@ def _sharded2d_window_body(table, fields_w, elig, exclusive, cost, load,
         load, rem_cap = carry
         out, load, rem_cap = _tick2d_local(
             fire_col, elig, exclusive, cost, load, rem_cap,
-            k_local, rounds, impl, bid_k, fanout, shard_bids)
+            k_local, rounds, impl, bid_k, fanout, shard_bids,
+            compact_k, node_block_fanout)
         return (load, rem_cap), out
 
     (load, rem_cap), outs = jax.lax.scan(body, (load, rem_cap), fire_w.T)
@@ -359,7 +414,9 @@ class _ShardedPlannerBase:
     def _init_common(self, mesh: Mesh, job_capacity: int,
                      node_capacity: int, rounds: int, impl: str,
                      max_fire_bucket: int, tz, word_align: int,
-                     shard_bids: bool = True):
+                     shard_bids: bool = True,
+                     demand_format: str = "auto",
+                     node_block_psum=None):
         import datetime
         self.mesh = mesh
         self.tz = tz or datetime.timezone.utc
@@ -371,11 +428,33 @@ class _ShardedPlannerBase:
         # rollback path — the randomized differential test pins the two
         # fire-set-identical
         self.shard_bids = shard_bids
+        # demand wire format for the sharded reconcile: "dense" ([2, N]
+        # blocks, bucket-independent), "compacted" ((idx, count, cost)
+        # triples — 12 B x min(k_local, N) x D, proportional to demand:
+        # the sparse-tick/wide-fleet corner), or "auto" (per-plan pick
+        # by the estimate_collective_bytes crossover at the resolved
+        # bucket — _resolve_demand_format, the _resolve_impl pattern).
+        # Both formats produce bit-identical accepts (differential-
+        # pinned); the knob is the pin/rollback.
+        if demand_format not in ("auto", "dense", "compacted"):
+            raise ValueError(f"demand_format {demand_format!r} not in "
+                             "auto/dense/compacted")
+        self.demand_format = demand_format
         self.J = _next_pow2(max(job_capacity, self.Dj * 256))
         if self.J % self.Dj:
             raise ValueError("job capacity must shard evenly")
         self.N = ((node_capacity + word_align - 1)
                   // word_align) * word_align
+        # node-block-sharded Common fan-out (2-D meshes): psum only this
+        # device's [N/Dn] block along the jobs axis, then gather — the
+        # full-[N] psum compiles out at >=NODE_BLOCK_PSUM_MIN_N widths
+        # (None = auto by width; True/False pins).  1-D meshes have no
+        # node axis to block over.
+        dn_ = getattr(self, "Dn", 1)
+        if node_block_psum is None:
+            node_block_psum = (dn_ > 1
+                               and self.N >= NODE_BLOCK_PSUM_MIN_N)
+        self.node_block_psum = bool(node_block_psum) and dn_ > 1
         self.max_fire_bucket = max_fire_bucket
         self._shard = NamedSharding(mesh, P(AXIS))
         self._shard2 = NamedSharding(mesh, self._elig_spec)
@@ -398,7 +477,11 @@ class _ShardedPlannerBase:
         self.tick_ms = LatencyRing()
         self._ticks_total = 0
         self._collective_bytes_total = 0
+        self._compacted_bytes_total = 0      # bytes of compacted rounds
+        self._compacted_ticks_total = 0      # ticks the compacted path ran
         self._last_k_local = 0
+        self._last_demand_format = ("dense" if not self.shard_bids
+                                    else self.demand_format)
         self._phase_profile: dict = {}
         # multi-host meshes (jax.distributed over DCN / Gloo): per-shard
         # plan outputs span non-addressable devices, so fetching them
@@ -413,11 +496,11 @@ class _ShardedPlannerBase:
                 arr, tiled=True))
         return np.asarray(arr)
 
-    def _step(self, k_local: int, impl: str):
-        key = (k_local, impl, self.shard_bids)
+    def _step(self, k_local: int, impl: str, fmt: str = "dense"):
+        key = (k_local, impl, self.shard_bids, fmt, self.node_block_psum)
         if key not in self._step_cache:
             sm = _shard_map(
-                self._body(k_local, impl), mesh=self.mesh,
+                self._body(k_local, impl, fmt), mesh=self.mesh,
                 in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
                           P(), P()),
                 out_specs=(P(None, AXIS), P(), P()))
@@ -508,6 +591,21 @@ class _ShardedPlannerBase:
         from ..ops.assign import choose_impl
         return choose_impl(self.N // getattr(self, "Dn", 1), k_local)
 
+    def _resolve_demand_format(self, k_local: int) -> str:
+        """Static per-plan pick of the demand wire format (the
+        _resolve_impl pattern: k_local is static per compiled program,
+        so the choice is host-side — no collective inside a cond).
+        "auto" compares the compacted vs dense branch of the byte
+        model at this bucket; an explicit pin wins; the replicated
+        path has no demand exchange to format."""
+        return self.estimate_collective_bytes(
+            k_local=k_local)["demand_format"]
+
+    def _compact_k(self, k_local: int, fmt: str):
+        # a shard's demand touches at most min(#candidates, N) distinct
+        # nodes, so this pad never truncates (see ops.assign.compact_demand)
+        return min(k_local, self.N) if fmt == "compacted" else None
+
     def _decode(self, o, epoch_s: int, k_local: int) -> TickPlan:
         """[3, Dj*k_local] per-shard-concatenated output -> TickPlan."""
         fired, assigned, total = [], [], 0
@@ -528,23 +626,26 @@ class _ShardedPlannerBase:
         k = sla_bucket or self.max_fire_bucket
         k_local = max(256, _next_pow2(k) // self.Dj)
         impl = self._resolve_impl(k_local)
+        fmt = self._resolve_demand_format(k_local)
         f = window_fields(epoch_s, 1, tz=self.tz)
         fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
                            f["dom"][0], f["month"][0], f["dow"][0],
                            epoch_s - FRAMEWORK_EPOCH], dtype=np.int32)
         t0 = _time.perf_counter()
-        out, self.load, self.rem_cap = self._step(k_local, impl)(
+        out, self.load, self.rem_cap = self._step(k_local, impl, fmt)(
             self.table, jax.device_put(fields, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
         o = self._fetch(out)             # [3, Dj*k_local]
-        self._account_ticks(1, (_time.perf_counter() - t0) * 1e3, k_local)
+        self._account_ticks(1, (_time.perf_counter() - t0) * 1e3, k_local,
+                            fmt)
         return self._decode(o, epoch_s, k_local)
 
-    def _window_step(self, k_local: int, impl: str):
-        key = ("window", k_local, impl, self.shard_bids)
+    def _window_step(self, k_local: int, impl: str, fmt: str = "dense"):
+        key = ("window", k_local, impl, self.shard_bids, fmt,
+               self.node_block_psum)
         if key not in self._step_cache:
             sm = _shard_map(
-                self._window_body(k_local, impl), mesh=self.mesh,
+                self._window_body(k_local, impl, fmt), mesh=self.mesh,
                 in_specs=(P(AXIS), P(), self._elig_spec, P(AXIS), P(AXIS),
                           P(), P()),
                 out_specs=(P(None, None, AXIS), P(), P()))
@@ -560,6 +661,7 @@ class _ShardedPlannerBase:
         k = sla_bucket or self.max_fire_bucket
         k_local = max(256, _next_pow2(k) // self.Dj)
         impl = self._resolve_impl(k_local)
+        fmt = self._resolve_demand_format(k_local)
         f = window_fields(epoch_s, window_s, tz=self.tz)
         fields_w = np.stack([
             f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
@@ -567,29 +669,39 @@ class _ShardedPlannerBase:
         ], axis=1).astype(np.int32)
         import time as _time
         t0 = _time.perf_counter()
-        outs, self.load, self.rem_cap = self._window_step(k_local, impl)(
+        outs, self.load, self.rem_cap = self._window_step(
+            k_local, impl, fmt)(
             self.table, jax.device_put(fields_w, self._repl), self.elig,
             self.exclusive, self.cost, self.load, self.rem_cap)
         o = self._fetch(outs)            # [W, 3, Dj*k_local]
         self._account_ticks(window_s, (_time.perf_counter() - t0) * 1e3,
-                            k_local)
+                            k_local, fmt)
         return [self._decode(o[w], epoch_s + w, k_local)
                 for w in range(window_s)]
 
     # -- observability -----------------------------------------------------
 
-    def _account_ticks(self, n_ticks: int, total_ms: float, k_local: int):
+    def _account_ticks(self, n_ticks: int, total_ms: float, k_local: int,
+                       fmt: str = "dense"):
         # ONE ring sample per plan call (the window-averaged per-tick
         # ms): repeating it per tick would let a single long window
         # evict every real sample and flatten p99 onto p50
         self.tick_ms.add(total_ms / max(1, n_ticks))
         self._ticks_total += n_ticks
         self._last_k_local = k_local
-        est = self.estimate_collective_bytes(k_local=k_local)
+        self._last_demand_format = fmt
+        est = self.estimate_collective_bytes(k_local=k_local,
+                                             demand_format=fmt)
         self._collective_bytes_total += n_ticks * est["per_tick"]
+        if fmt == "compacted":
+            self._compacted_ticks_total += n_ticks
+            self._compacted_bytes_total += (
+                n_ticks * self.rounds * est["compacted_per_round"])
 
     def estimate_collective_bytes(self, sla_bucket: Optional[int] = None,
-                                  k_local: Optional[int] = None) -> dict:
+                                  k_local: Optional[int] = None,
+                                  demand_format: Optional[str] = None,
+                                  ) -> dict:
         """Analytic per-tick inter-chip payload model at the planner's
         shapes — the number the bench ladder reports and the slow-tier
         gate compares.  ONE convention for every collective: the full
@@ -608,27 +720,97 @@ class _ShardedPlannerBase:
           regime the optimization targets; at sparse ticks on wide
           fleets (K below that) the replicated exchange is smaller
           (see ROADMAP: compacted demand gather);
+        - compacted round: the same demand exchange as (idx, count,
+          cost) f32 triples padded to k_comp = min(k_local, N) — two
+          [3, k_comp] all_gathers (demand out, accepted back), 12 B x
+          k_comp x Dj gathered each: 24*k_comp*Dj per round,
+          proportional to DEMAND instead of fleet width.  vs dense
+          8N(Dj+1) the crossover sits near k_comp ~ N(Dj+1)/(3Dj) ~
+          N/3: sparse ticks on wide fleets go compacted, the herd
+          regime stays dense ("auto" picks per plan from this model);
         - 2-D meshes add the node-axis (best, choice) reduce — 8 B x
           Dn*k_local gathered per round — and the [N] Common fan-out
-          gather; both paths pay those identically.
+          gather; both paths pay those identically.  With node-block
+          psum the Common fan-out reduces only this shard's [N/Dn]
+          block along jobs (4N/Dn) before the [N] assembly gather.
         """
         if k_local is None:
             k = sla_bucket or self.max_fire_bucket
             k_local = max(256, _next_pow2(k) // self.Dj)
         N = self.N
         dn = getattr(self, "Dn", 1)
+        k_comp = min(k_local, N)
         repl_round = 9 * self.Dj * k_local
         shard_round = 2 * N * 4 * (self.Dj + 1)
-        common = 4 * N * (2 if dn > 1 else 1)   # fanout psum (+2-D gather)
+        comp_round = 2 * 3 * 4 * k_comp * self.Dj
+        if dn > 1:                       # fanout psum + 2-D assembly gather
+            common = (4 * N // dn if self.node_block_psum else 4 * N) + 4 * N
+        else:
+            common = 4 * N
         naxis_round = 8 * dn * k_local if dn > 1 else 0
-        mine = shard_round if self.shard_bids else repl_round
+        fmt = demand_format
+        if fmt is None:
+            fmt = self.demand_format if self.shard_bids else "dense"
+        if fmt == "auto":
+            fmt = "compacted" if comp_round < shard_round else "dense"
+        mine = (repl_round if not self.shard_bids
+                else comp_round if fmt == "compacted" else shard_round)
         return {
             "replicated_per_round": repl_round + naxis_round,
             "sharded_per_round": shard_round + naxis_round,
+            "compacted_per_round": comp_round + naxis_round,
             "per_round": mine + naxis_round,
             "per_tick": self.rounds * (mine + naxis_round) + common,
             "k_local": k_local,
+            "demand_format": fmt if self.shard_bids else "dense",
         }
+
+    def measured_collective_bytes(self, sla_bucket: Optional[int] = None,
+                                  demand_format: Optional[str] = None):
+        """Per-tick collective bytes as actually COMPILED: lower the
+        single-tick step at the planner's current shapes and sum the
+        collective-op result shapes out of the HLO text, under the same
+        convention as estimate_collective_bytes (gathered output size
+        for an all-gather, logical payload once for a reduce).  The
+        bench ladder reports this next to the analytic estimate so a
+        crossover-model drift is a bench fact, not a hope.  Returns
+        None when the backend's compiled text isn't inspectable."""
+        import re
+        k = sla_bucket or self.max_fire_bucket
+        k_local = max(256, _next_pow2(k) // self.Dj)
+        impl = self._resolve_impl(k_local)
+        fmt = (demand_format if demand_format in ("dense", "compacted")
+               else self._resolve_demand_format(k_local))
+        f = window_fields(0, 1, tz=self.tz)
+        fields = np.array([f["sec"][0], f["min"][0], f["hour"][0],
+                           f["dom"][0], f["month"][0], f["dow"][0],
+                           -FRAMEWORK_EPOCH], dtype=np.int32)
+        try:
+            txt = self._step(k_local, impl, fmt).lower(
+                self.table, jax.device_put(fields, self._repl), self.elig,
+                self.exclusive, self.cost, self.load,
+                self.rem_cap).compile().as_text()
+        except Exception:
+            return None
+        widths = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                  "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                  "s64": 8, "u64": 8, "f64": 8}
+        shape_re = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
+        total = 0
+        for line in txt.splitlines():
+            m = re.search(r"=\s*(\(?[^)]*?\)?)\s*"
+                          r"(all-gather|all-reduce|reduce-scatter)\(", line)
+            if not m:
+                continue
+            for dt, dims in shape_re.findall(m.group(1)):
+                if dt not in widths:
+                    continue
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                total += n * widths[dt]
+        return total if total else None
 
     def profile_phases(self, sla_bucket: Optional[int] = None,
                        iters: int = 10) -> dict:
@@ -659,13 +841,25 @@ class _ShardedPlannerBase:
         bid_f = jax.jit(lambda p, l: bid(p, l))
 
         if self.shard_bids:
-            def gather_body(d2):
-                g = jax.lax.all_gather(d2, AXIS)
-                return jax.lax.psum(d2, AXIS) + g.sum(0)
+            fmt = self._resolve_demand_format(k_local)
+            if fmt == "compacted":
+                # two triple gathers (demand out, accepted back) — the
+                # +1.0 defeats CSE folding them into one collective
+                k_comp = min(k_local, self.N)
+
+                def gather_body(c3):
+                    g1 = jax.lax.all_gather(c3, AXIS)
+                    g2 = jax.lax.all_gather(c3 + 1.0, AXIS)
+                    return g1.sum(0) + g2.sum(0)
+                gather_arg = (jnp.zeros((3, k_comp), jnp.float32),)
+            else:
+                def gather_body(d2):
+                    g = jax.lax.all_gather(d2, AXIS)
+                    return jax.lax.psum(d2, AXIS) + g.sum(0)
+                gather_arg = (jnp.zeros((2, self.N), jnp.float32),)
             gather_f = jax.jit(_shard_map(
                 gather_body, mesh=self.mesh,
                 in_specs=(P(),), out_specs=P()))
-            gather_arg = (jnp.zeros((2, self.N), jnp.float32),)
 
             def rec_f(cand, choice, cost, load, cap):
                 rank, cum, demand = local_bid_demand(
@@ -728,7 +922,8 @@ class _ShardedPlannerBase:
         distribution, tick totals, the analytic collective-bytes
         estimate, and the last per-phase microbench if one ran."""
         est = self.estimate_collective_bytes(
-            k_local=self._last_k_local or None)
+            k_local=self._last_k_local or None,
+            demand_format=self._last_demand_format)
         return {
             "tick_p50_ms": round(self.tick_ms.percentile(0.50), 3),
             "tick_p99_ms": round(self.tick_ms.percentile(0.99), 3),
@@ -736,6 +931,12 @@ class _ShardedPlannerBase:
             "collective_bytes_total": self._collective_bytes_total,
             "collective_bytes_per_tick": est["per_tick"],
             "collective_bytes_per_round": est["per_round"],
+            "compacted_bytes_total": self._compacted_bytes_total,
+            "compacted_ticks_total": self._compacted_ticks_total,
+            # string field: /v1/metrics renders it as the demand_format
+            # LABEL on every cronsun_mesh_tick_* sample, not a gauge
+            "demand_format": est["demand_format"],
+            "node_block_psum": 1 if self.node_block_psum else 0,
             "devices": int(self.mesh.devices.size),
             "shard_bids": 1 if self.shard_bids else 0,
             "rounds": self.rounds,
@@ -750,22 +951,25 @@ class ShardedTickPlanner(_ShardedPlannerBase):
     def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
                  rounds: int = 3, impl: str = "auto",
                  max_fire_bucket: int = 65536, tz=None,
-                 shard_bids: bool = True):
+                 shard_bids: bool = True, demand_format: str = "auto"):
         self.Dj = self.D = mesh.devices.size
         self._elig_spec = P(AXIS, None)
         self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
                           max_fire_bucket, tz, word_align=32,
-                          shard_bids=shard_bids)
+                          shard_bids=shard_bids,
+                          demand_format=demand_format)
 
-    def _body(self, k_local: int, impl: str):
+    def _body(self, k_local: int, impl: str, fmt: str = "dense"):
         return partial(_sharded_plan_body, k_local=k_local,
                        rounds=self.rounds, impl=impl,
-                       shard_bids=self.shard_bids)
+                       shard_bids=self.shard_bids,
+                       compact_k=self._compact_k(k_local, fmt))
 
-    def _window_body(self, k_local: int, impl: str):
+    def _window_body(self, k_local: int, impl: str, fmt: str = "dense"):
         return partial(_sharded_window_body, k_local=k_local,
                        rounds=self.rounds, impl=impl,
-                       shard_bids=self.shard_bids)
+                       shard_bids=self.shard_bids,
+                       compact_k=self._compact_k(k_local, fmt))
 
 
 class Sharded2DTickPlanner(_ShardedPlannerBase):
@@ -782,7 +986,8 @@ class Sharded2DTickPlanner(_ShardedPlannerBase):
     def __init__(self, mesh: Mesh, job_capacity: int, node_capacity: int,
                  rounds: int = 3, impl: str = "jnp",
                  max_fire_bucket: int = 65536, tz=None,
-                 shard_bids: bool = True):
+                 shard_bids: bool = True, demand_format: str = "auto",
+                 node_block_psum=None):
         if mesh.axis_names != (AXIS, NAXIS):
             raise ValueError(f"need a ({AXIS!r}, {NAXIS!r}) mesh")
         self.Dj = mesh.shape[AXIS]
@@ -790,14 +995,20 @@ class Sharded2DTickPlanner(_ShardedPlannerBase):
         self._elig_spec = P(AXIS, NAXIS)
         self._init_common(mesh, job_capacity, node_capacity, rounds, impl,
                           max_fire_bucket, tz, word_align=32 * self.Dn,
-                          shard_bids=shard_bids)
+                          shard_bids=shard_bids,
+                          demand_format=demand_format,
+                          node_block_psum=node_block_psum)
 
-    def _body(self, k_local: int, impl: str):
+    def _body(self, k_local: int, impl: str, fmt: str = "dense"):
         return partial(_sharded2d_plan_body, k_local=k_local,
                        rounds=self.rounds, impl=impl,
-                       shard_bids=self.shard_bids)
+                       shard_bids=self.shard_bids,
+                       compact_k=self._compact_k(k_local, fmt),
+                       node_block_fanout=self.node_block_psum)
 
-    def _window_body(self, k_local: int, impl: str):
+    def _window_body(self, k_local: int, impl: str, fmt: str = "dense"):
         return partial(_sharded2d_window_body, k_local=k_local,
                        rounds=self.rounds, impl=impl,
-                       shard_bids=self.shard_bids)
+                       shard_bids=self.shard_bids,
+                       compact_k=self._compact_k(k_local, fmt),
+                       node_block_fanout=self.node_block_psum)

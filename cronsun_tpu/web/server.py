@@ -1642,6 +1642,16 @@ class ApiServer:
                 part = snap.get("partition")
                 if isinstance(part, (int, float)):
                     extra = f',partition="{int(part)}"'
+            # mesh plane: every cronsun_mesh_tick_* series carries the
+            # demand wire format its ticks ran with (dense vs
+            # compacted must be tellable apart per series — a format
+            # flip mid-scrape-window is an auto-select event, not
+            # noise); the string field itself renders only as this
+            # label
+            if component == "mesh":
+                fmt = snap.get("demand_format")
+                if isinstance(fmt, str) and fmt:
+                    extra = f',demand_format="{_esc_label(fmt)}"'
             if component == "tenant":
                 # per-tenant admission snapshots are NESTED
                 # ({tenant: {field: n}}): render each numeric leaf as

@@ -879,7 +879,8 @@ class LogStore {
       for (const Seg& s : segs_) {
         if (id < s.min_id || id > s.max_id) continue;
         std::vector<Rec> rows;
-        read_segment(s.path, rows);
+        // sparse-index seek: O(stride) lines, not the whole day
+        read_segment_range(s.path, id, id, rows);
         for (const Rec& r : rows)
           if (r.id == id) {
             rec_wire(res, r, true);
@@ -1302,6 +1303,117 @@ class LogStore {
     return ok;
   }
 
+  static std::string idx_path_of(const std::string& seg_path) {
+    return seg_path.substr(0, seg_path.size() - 4) + ".idx";
+  }
+
+  // ranged cold read: ids in [lo, hi] from one segment, id ASC.  With a
+  // FRESH .idx sidecar (its mirrored header equals the segment's — any
+  // crash ordering between the two renames fails the match and degrades
+  // to a top-of-file scan, never a wrong seek) the scan fseeks to
+  // within IDX_STRIDE lines of lo and stops at the first id past hi
+  // (ids ascend on disk), so a single-id lookup or a floor/watermark-
+  // bounded history scan parses O(stride + matches) lines, not the
+  // whole day (logsink/tiering.py read_segment_range pins the same
+  // contract via mmap).
+  static bool read_segment_range(const std::string& path, long long lo,
+                                 long long hi, std::vector<Rec>& out) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return false;
+    char* lineptr = nullptr;
+    size_t cap = 0;
+    ssize_t n = getline(&lineptr, &cap, f);
+    if (n == -1) {
+      free(lineptr);
+      fclose(f);
+      return false;
+    }
+    std::string hline(lineptr, (size_t)n);
+    while (!hline.empty() &&
+           (hline.back() == '\n' || hline.back() == '\r'))
+      hline.pop_back();
+    JParser hp(hline);
+    JV hv;
+    if (!hp.value(hv) || hv.t != JV::ARR || hv.arr.size() < 5 ||
+        hv.arr[0].t != JV::STR || hv.arr[0].s != "d") {
+      free(lineptr);
+      fclose(f);
+      return false;
+    }
+    if (hv.arr[4].as_int() < lo || hv.arr[3].as_int() > hi) {
+      free(lineptr);
+      fclose(f);
+      return true;            // disjoint by header: nothing in range
+    }
+    long long seek_off = -1;
+    if (FILE* fi = fopen(idx_path_of(path).c_str(), "r")) {
+      char* il = nullptr;
+      size_t icap = 0;
+      ssize_t in_;
+      bool first = true;
+      while ((in_ = getline(&il, &icap, fi)) != -1) {
+        std::string line(il, (size_t)in_);
+        while (!line.empty() &&
+               (line.back() == '\n' || line.back() == '\r'))
+          line.pop_back();
+        if (line.empty()) continue;
+        JParser jp(line);
+        JV v;
+        if (!jp.value(v) || v.t != JV::ARR || v.arr.size() < 3 ||
+            v.arr[0].t != JV::STR) {
+          seek_off = -1;      // garbage sidecar: scan from the top
+          break;
+        }
+        if (first) {
+          first = false;
+          bool fresh = v.arr[0].s == "i" && v.arr.size() >= 5 &&
+                       v.arr[1].t == JV::STR &&
+                       v.arr[1].s == hv.arr[1].s &&
+                       v.arr[2].as_int() == hv.arr[2].as_int() &&
+                       v.arr[3].as_int() == hv.arr[3].as_int() &&
+                       v.arr[4].as_int() == hv.arr[4].as_int();
+          if (!fresh) break;
+          continue;
+        }
+        if (v.arr[0].s != "e") {
+          seek_off = -1;
+          break;
+        }
+        if (v.arr[1].as_int() <= lo)
+          seek_off = v.arr[2].as_int();
+        else
+          break;              // marks ascend: first mark past lo ends it
+      }
+      free(il);
+      fclose(fi);
+    }
+    if (seek_off > 0) fseek(f, (long)seek_off, SEEK_SET);
+    bool ok = true;
+    while ((n = getline(&lineptr, &cap, f)) != -1) {
+      std::string line(lineptr, (size_t)n);
+      while (!line.empty() &&
+             (line.back() == '\n' || line.back() == '\r'))
+        line.pop_back();
+      if (line.empty()) continue;
+      JParser jp(line);
+      JV v;
+      Rec r;
+      if (!jp.value(v) || v.t != JV::ARR || v.arr.empty() ||
+          v.arr[0].t != JV::STR || v.arr[0].s != "L" ||
+          !parse_rec(v, 1, r)) {
+        ok = false;
+        break;
+      }
+      if (r.id > hi) break;   // id ASC on disk: nothing further matches
+      if (r.id < lo) continue;
+      out.push_back(std::move(r));
+    }
+    free(lineptr);
+    fclose(f);
+    if (!ok) out.clear();     // torn/garbage: absent, like read_segment
+    return ok;
+  }
+
   bool write_segment(const std::string& day, std::vector<Rec>& recs,
                      Seg& entry) {
     // union by id with the existing file — idempotent, so the crash
@@ -1329,10 +1441,33 @@ class LogStore {
     jint(line, by_id.empty() ? 0 : by_id.rbegin()->first);
     line += "]\n";
     bool wok = fwrite(line.data(), 1, line.size(), out) == line.size();
+    // sparse-index sidecar body built alongside: a mirrored header
+    // (freshness check for read_segment_range) + one (id, byte offset)
+    // mark every kIdxStride records
+    constexpr int kIdxStride = 64;
+    long long off = (long long)line.size();
+    std::string idx = "[\"i\",";
+    jesc(idx, day);
+    idx += ',';
+    jint(idx, (long long)by_id.size());
+    idx += ',';
+    jint(idx, by_id.empty() ? 0 : by_id.begin()->first);
+    idx += ',';
+    jint(idx, by_id.empty() ? 0 : by_id.rbegin()->first);
+    idx += "]\n";
+    long long row_i = 0;
     for (const auto& [id, r] : by_id) {
       line.clear();
       wal_create(line, r);
       line += '\n';
+      if (row_i++ % kIdxStride == 0) {
+        idx += "[\"e\",";
+        jint(idx, id);
+        idx += ',';
+        jint(idx, off);
+        idx += "]\n";
+      }
+      off += (long long)line.size();
       wok = wok && fwrite(line.data(), 1, line.size(), out) == line.size();
     }
     wok = wok && fflush(out) == 0 && fdatasync(fileno(out)) == 0;
@@ -1349,6 +1484,18 @@ class LogStore {
     if (dfd >= 0) {
       fsync(dfd);
       close(dfd);
+    }
+    // publish the sidecar AFTER the segment (a fresh idx never
+    // describes an unpublished seg); advisory data — a failed write
+    // leaves ranged reads on the full-scan path, never wrong
+    std::string ipath = idx_path_of(path);
+    std::string itmp = ipath + ".tmp";
+    if (FILE* fi = fopen(itmp.c_str(), "w")) {
+      bool iok = fwrite(idx.data(), 1, idx.size(), fi) == idx.size();
+      iok = iok && fflush(fi) == 0 && fdatasync(fileno(fi)) == 0;
+      fclose(fi);
+      if (!iok || rename(itmp.c_str(), ipath.c_str()) != 0)
+        remove(itmp.c_str());
     }
     entry.day = day;
     entry.path = path;
@@ -1460,9 +1607,11 @@ class LogStore {
       }
       touched++;
       std::vector<Rec> rows;
-      read_segment(s.path, rows);
+      // ranged read: the retention floor and durable watermark become
+      // the seek bounds — a cursor poll deep into the tier seeks past
+      // everything already served instead of re-parsing it
+      read_segment_range(s.path, min_id + 1, cold_boundary_, rows);
       for (Rec& r : rows) {
-        if (r.id <= min_id || r.id > cold_boundary_) continue;
         if (match(r)) {
           total++;
           out.push_back(std::move(r));

@@ -69,6 +69,14 @@ def run_worker(cfg: dict) -> None:
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
+    if cfg.get("dcn"):
+        # multi-host DCN rung: each participating host runs this same
+        # worker; the coordinator address/process topology comes from the
+        # BENCH_MESH_DCN_* env (_spawn passes it through untouched)
+        jax.distributed.initialize(
+            coordinator_address=os.environ["BENCH_MESH_DCN_COORD"],
+            num_processes=int(os.environ.get("BENCH_MESH_DCN_NPROC", "1")),
+            process_id=int(os.environ.get("BENCH_MESH_DCN_PID", "0")))
     import numpy as np
     from bench import synth_table
     from cronsun_tpu.parallel.mesh import (Sharded2DTickPlanner,
@@ -79,29 +87,34 @@ def run_worker(cfg: dict) -> None:
     assert len(jax.devices()) >= D, (jax.devices(), D)
     J, N = cfg["J"], cfg["N"]
     bucket = cfg["bucket"]
-    if cfg["mesh"] == "2d":
-        dj, dn = cfg["dj"], cfg["dn"]
-        sp = Sharded2DTickPlanner(
-            make_mesh2d(dj, dn), job_capacity=J, node_capacity=N,
-            max_fire_bucket=bucket, shard_bids=cfg["path"] == "sharded")
-    else:
-        sp = ShardedTickPlanner(
-            make_mesh(D), job_capacity=J, node_capacity=N,
-            max_fire_bucket=bucket, impl="jnp",
-            shard_bids=cfg["path"] == "sharded")
+    fmtarg = cfg.get("demand_format", "auto")
 
-    rng = np.random.default_rng(0)
-    # fire-rate sized so a healthy slice of the bucket fires every tick
-    # (the reconcile paths differ exactly in how fired-bucket bytes
-    # scale, so an idle table would measure nothing)
-    period_lo, period_hi = cfg["period_lo"], cfg["period_hi"]
-    sp.set_table(synth_table(sp.J, period_lo, period_hi))
-    elig = rng.integers(0, 2**32, (sp.J, sp.N // 32), dtype=np.uint32)
-    sp.set_eligibility(elig)
-    sp.set_job_meta_full(rng.random(sp.J) < 0.5,
-                         np.ones(sp.J, np.float32))
-    sp.set_node_capacity_full(np.full(sp.N, 1 << 20, np.int32))
+    def mk(fmt_):
+        if cfg["mesh"] == "2d":
+            dj, dn = cfg["dj"], cfg["dn"]
+            p = Sharded2DTickPlanner(
+                make_mesh2d(dj, dn), job_capacity=J, node_capacity=N,
+                max_fire_bucket=bucket,
+                shard_bids=cfg["path"] == "sharded", demand_format=fmt_)
+        else:
+            p = ShardedTickPlanner(
+                make_mesh(D), job_capacity=J, node_capacity=N,
+                max_fire_bucket=bucket, impl="jnp",
+                shard_bids=cfg["path"] == "sharded", demand_format=fmt_)
+        rng = np.random.default_rng(0)
+        # fire-rate sized so a healthy slice of the bucket fires every
+        # tick (the reconcile paths differ exactly in how fired-bucket
+        # bytes scale, so an idle table would measure nothing); sparse
+        # rungs pin period_lo == period_hi == 1/fire_fraction
+        p.set_table(synth_table(p.J, cfg["period_lo"], cfg["period_hi"]))
+        p.set_eligibility(rng.integers(
+            0, 2**32, (p.J, p.N // 32), dtype=np.uint32))
+        p.set_job_meta_full(rng.random(p.J) < 0.5,
+                            np.ones(p.J, np.float32))
+        p.set_node_capacity_full(np.full(p.N, 1 << 20, np.int32))
+        return p
 
+    sp = mk(fmtarg)
     T0 = 1_753_000_000
     sp.plan(T0 - 10)                      # compile + warm
     sp.plan(T0 - 9)
@@ -123,8 +136,29 @@ def run_worker(cfg: dict) -> None:
         win_ms = (time.perf_counter() - s) * 1e3 / (cfg["win_reps"] * W)
 
     est = sp.estimate_collective_bytes(bucket)
+    fmt = est["demand_format"]
+    # predicted vs COMPILED bytes: the analytic crossover model next to
+    # what XLA actually lowered, so model drift is a bench fact
+    measured = sp.measured_collective_bytes(bucket)
+
+    # fire-set divergence vs the OTHER demand format on the same seed
+    # and tick sequence (the tier-1 smoke asserts this stays zero)
+    divergence = None
+    if cfg.get("check_divergence") and cfg["path"] == "sharded":
+        alt = "dense" if fmt == "compacted" else "compacted"
+        divergence = 0
+        # replay both planners fresh so carried load/rem_cap histories
+        # match tick for tick
+        sa, sb = mk(fmt), mk(alt)
+        for t in [T0 - 10, T0 - 9] + [T0 + i for i in range(cfg["ticks"])]:
+            pa, pb = sa.plan(t), sb.plan(t)
+            if (sorted(pa.fired.tolist()) != sorted(pb.fired.tolist())
+                    or dict(zip(pa.fired.tolist(), pa.assigned.tolist()))
+                    != dict(zip(pb.fired.tolist(), pb.assigned.tolist()))):
+                divergence += 1
+
     prof = sp.profile_phases(bucket, iters=3 if cfg["quick"] else 8)
-    print(json.dumps({
+    rec = {
         "devices": D, "mesh": cfg["mesh"], "path": cfg["path"],
         "jobs": sp.J, "nodes": sp.N, "k_local": est["k_local"],
         "ticks": cfg["ticks"], "fired_per_tick": fired,
@@ -135,8 +169,20 @@ def run_worker(cfg: dict) -> None:
         "collective_bytes_per_tick": est["per_tick"],
         "replicated_bytes_per_round": est["replicated_per_round"],
         "sharded_bytes_per_round": est["sharded_per_round"],
+        "compacted_bytes_per_round": est["compacted_per_round"],
+        "demand_format": fmt,
+        "demand_format_requested": fmtarg,
+        "predicted_bytes_per_tick": est["per_tick"],
+        "measured_bytes_per_tick": measured,
         **{f"phase_{k}": v for k, v in prof.items()},
-    }))
+    }
+    if cfg.get("fire_fraction") is not None:
+        rec["fire_fraction"] = cfg["fire_fraction"]
+    if divergence is not None:
+        rec["fire_set_divergence"] = divergence
+    if cfg.get("dcn"):
+        rec["dcn_processes"] = jax.process_count()
+    print(json.dumps(rec))
 
 
 # ---------------------------------------------------------------------------
@@ -178,7 +224,8 @@ def _tpu_device_count() -> int:
         return 0
 
 
-def run_ladder(devices, shapes, ticks, quick, use_tpu, on_log=log):
+def run_ladder(devices, shapes, ticks, quick, use_tpu, on_log=log,
+               demand_format="auto"):
     if use_tpu:
         # real chips: only the rungs this host can actually form
         have = _tpu_device_count()
@@ -206,6 +253,8 @@ def run_ladder(devices, shapes, ticks, quick, use_tpu, on_log=log):
                         bucket=max(2048, J // 4), ticks=ticks,
                         window=1 if quick else 4,
                         win_reps=2, quick=quick, tpu=use_tpu,
+                        demand_format=demand_format,
+                        check_divergence=quick,
                         # ~8-25% of jobs fire per tick: enough candidate
                         # pressure that the bucket is the traffic term
                         period_lo=4, period_hi=12)
@@ -245,6 +294,52 @@ def run_ladder(devices, shapes, ticks, quick, use_tpu, on_log=log):
     return ladder
 
 
+# sparse-tick rungs: the corner the compacted demand gather targets —
+# few fires on wide fleets, where the dense [2, N] exchange pays O(N)
+# bytes for O(fired) demand.  fire fraction f is realized through the
+# synth table's @every period (uniform phases -> ~J*f candidates/tick)
+SPARSE_FRACTIONS = (0.001, 0.01, 0.1)
+SPARSE_WIDTHS = (10_000, 100_000)
+
+
+def run_sparse_ladder(devices, quick, use_tpu, on_log=log,
+                      demand_format="auto", dcn=False):
+    D = max(devices)
+    J = 16_384 if quick else 65_536
+    rungs = []
+    for N in SPARSE_WIDTHS:
+        for f in SPARSE_FRACTIONS:
+            period = max(1, round(1 / f))
+            cfg = dict(
+                devices=D, mesh="1d", dj=D, dn=1, J=J, N=N,
+                path="sharded", fire_fraction=f,
+                # 4x headroom over the ~J*f mean so bursty ticks don't
+                # clip the very bucket term being measured
+                bucket=max(2048, int(4 * J * f)),
+                ticks=3 if quick else 10, window=1, win_reps=1,
+                quick=quick, tpu=use_tpu, dcn=dcn,
+                demand_format=demand_format,
+                check_divergence=True,
+                period_lo=period, period_hi=period)
+            try:
+                r = _spawn(cfg, timeout=900)
+            except Exception as e:  # noqa: BLE001
+                on_log(f"sparse {D}dev {J}x{N} f={f}: FAILED ({e})")
+                rungs.append({"devices": D, "jobs": J, "nodes": N,
+                              "fire_fraction": f, "path": "sharded",
+                              "error": str(e)[-500:]})
+                continue
+            rungs.append(r)
+            on_log(f"sparse {D}dev {J}x{N} f={f}: fmt={r['demand_format']}"
+                   f" bytes/round={r['collective_bytes_per_round']}"
+                   f" (dense={r['sharded_bytes_per_round']}"
+                   f" comp={r['compacted_bytes_per_round']})"
+                   f" predicted={r['predicted_bytes_per_tick']}"
+                   f" measured={r['measured_bytes_per_tick']}"
+                   f" divergence={r.get('fire_set_divergence')}")
+    return rungs
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -260,6 +355,15 @@ def main(argv=None) -> int:
                     help="timed sync ticks per config")
     ap.add_argument("--quick", action="store_true",
                     help="tier-1 smoke: 2 devices, small shape, few ticks")
+    ap.add_argument("--mesh-demand-format", default="auto",
+                    choices=("auto", "dense", "compacted"),
+                    help="pin the sharded reconcile's demand wire format "
+                         "(auto = per-plan crossover pick; the rollback "
+                         "knob for the compacted gather)")
+    ap.add_argument("--sparse", action="store_true",
+                    help="also run the sparse-tick rungs (fire fractions "
+                         f"{SPARSE_FRACTIONS} x widths {SPARSE_WIDTHS}; "
+                         "always on in full mode)")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="also write a MULTICHIP-sidecar-format JSON")
     args = ap.parse_args(argv)
@@ -280,17 +384,38 @@ def main(argv=None) -> int:
         ticks = args.ticks
 
     t0 = time.time()
-    ladder = run_ladder(devices, shapes, ticks, args.quick, use_tpu)
+    ladder = run_ladder(devices, shapes, ticks, args.quick, use_tpu,
+                        demand_format=args.mesh_demand_format)
+    # sparse-tick rungs: always in full mode, opt-in (--sparse) in quick;
+    # BENCH_MESH_DCN=1 re-runs them over a jax.distributed multi-host
+    # mesh (coordinator topology from BENCH_MESH_DCN_* — the same
+    # opt-in-env contract as BENCH_MESH_TPU)
+    sparse = []
+    if args.sparse or not args.quick:
+        sparse = run_sparse_ladder(
+            devices, args.quick, use_tpu,
+            demand_format=args.mesh_demand_format)
+    if os.environ.get("BENCH_MESH_DCN") == "1":
+        sparse += run_sparse_ladder(
+            [int(os.environ.get("BENCH_MESH_DCN_DEVICES", max(devices)))],
+            args.quick, use_tpu,
+            demand_format=args.mesh_demand_format, dcn=True)
     measured = [r for r in ladder
                 if r.get("path") != "compare" and "error" not in r]
-    failed = [r for r in ladder if "error" in r]
+    failed = [r for r in ladder + sparse if "error" in r]
     compares = [r for r in ladder if r.get("path") == "compare"]
+    divergences = [r["fire_set_divergence"] for r in ladder + sparse
+                   if r.get("fire_set_divergence") is not None]
     out = {
         "multichip_backend": "tpu" if use_tpu else "cpu-forced-host",
         "multichip_devices": devices,
         "multichip_ticks_total": sum(r["ticks"] for r in measured),
         "multichip_failed_configs": len(failed),
         "multichip_ladder": ladder,
+        "multichip_sparse_ladder": sparse,
+        "multichip_demand_format": args.mesh_demand_format,
+        "multichip_divergence_total": sum(divergences),
+        "multichip_divergence_checks": len(divergences),
         "multichip_bytes_ratio_worst": max(
             (c["bytes_ratio"] for c in compares), default=0.0),
         "multichip_wall_s": round(time.time() - t0, 1),
@@ -307,7 +432,7 @@ def main(argv=None) -> int:
                 "skipped": False, "git_rev": out["git_rev"],
                 "generated_at_utc": out["generated_at_utc"],
                 "tail": f"bench_mesh ladder OK: {tail}",
-                "ladder": ladder,
+                "ladder": ladder + sparse,
             }, f, indent=1)
         log(f"sidecar written: {args.out}")
     print(json.dumps(out))

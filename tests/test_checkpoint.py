@@ -176,8 +176,13 @@ def test_memstore_sweeper_compacts_oversized_wal(tmp_path):
     s.start_sweeper(interval=0.05)
     for i in range(300):
         s.put("/hot", f"value-{i}")
+    # wait for the op-stat too: the staggered snapshot rotates the WAL
+    # (size drops) at the PIN but records the op only when imaging
+    # finishes, so size alone races the counter
     deadline = time.time() + 5
-    while time.time() < deadline and s._wal.size() > 2048:
+    while time.time() < deadline and (
+            s._wal.size() > 2048
+            or s.op_stats()["snapshot"]["count"] < 2):
         time.sleep(0.05)
     assert s._wal.size() <= 2048, "sweeper never compacted the WAL"
     assert s.op_stats()["snapshot"]["count"] >= 2   # boot + sweeper

@@ -208,12 +208,15 @@ def test_scheduler_publishes_mesh_metrics(forced_host_devices):
 
 
 def test_bench_mesh_quick_smoke():
-    """`bench_mesh.py --quick` exits 0 with nonzero tick counts — the
-    tier-1 pin that the ladder keeps running end to end (it spawns its
-    own forced-host subprocesses, so it is backend-independent)."""
+    """`bench_mesh.py --quick --mesh-demand-format compacted` exits 0
+    with nonzero tick counts and ZERO fire-set divergence vs the dense
+    path on the same seed — the tier-1 pin that the ladder keeps
+    running end to end AND that the compacted wire format stays
+    exact (it spawns its own forced-host subprocesses, so it is
+    backend-independent)."""
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "bench_mesh.py"),
-         "--quick"],
+         "--quick", "--mesh-demand-format", "compacted"],
         capture_output=True, text=True, timeout=420, cwd=ROOT,
         env=forced_cpu_env(2))
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -223,7 +226,187 @@ def test_bench_mesh_quick_smoke():
                 if r.get("path") in ("sharded", "replicated")]
     assert measured and all(r["fired_per_tick"] > 0 for r in measured)
     assert all(r["tick_p99_ms"] > 0 for r in measured)
+    # the sharded rung ran compacted, checked itself against dense on
+    # the same seed, and predicted == what XLA actually compiled
+    sharded = [r for r in measured if r["path"] == "sharded"]
+    assert sharded and sharded[0]["demand_format"] == "compacted"
+    assert out["multichip_divergence_checks"] >= 1
+    assert out["multichip_divergence_total"] == 0
+    for r in sharded:
+        if r["measured_bytes_per_tick"] is not None:
+            assert r["predicted_bytes_per_tick"] == \
+                r["measured_bytes_per_tick"], r
     assert out["git_rev"] and out["generated_at_utc"]
+
+
+# ---------------------------------------------------------------------------
+# compacted demand gather: the sparse-aware wire format's differential
+# contract — scatter-add of the gathered (idx, count, cost) triples
+# rebuilds the exact dense accumulator, so everything downstream of the
+# exchange must be BIT-identical to the dense path (assign.py
+# compact_demand/scatter_demand derive why; these pin it empirically)
+# ---------------------------------------------------------------------------
+
+def test_compacted_demand_differential_1d(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    for seed in (41, 47):
+        J, N = 4096, 96
+        state = _random_state(J, N, seed)
+        a = _build(ShardedTickPlanner, mesh, J, N, state, True,
+                   impl="jnp", demand_format="compacted")
+        b = _build(ShardedTickPlanner, mesh, J, N, state, True,
+                   impl="jnp", demand_format="dense")
+        _assert_identical(a, b, 1_753_000_000 + seed * 100)
+
+
+def test_compacted_demand_differential_2d(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+    for dj, dn in ((4, 2), (2, 4)):
+        J, N = 4096, 128
+        state = _random_state(J, N, seed=51 + dj)
+        a = _build(Sharded2DTickPlanner, make_mesh2d(dj, dn), J, N,
+                   state, True, demand_format="compacted")
+        b = _build(Sharded2DTickPlanner, make_mesh2d(dj, dn), J, N,
+                   state, True, demand_format="dense")
+        _assert_identical(a, b, 1_753_000_000)
+
+
+def test_node_block_psum_differential_2d(forced_host_devices):
+    """psum-then-gather commutes with gather-then-psum exactly
+    (elementwise sum and concat), so the node-block-sharded Common
+    fan-out is a pure traffic change: fire sets, placements, and
+    carried load bit-identical — alone and composed with the compacted
+    demand gather."""
+    from cronsun_tpu.parallel.mesh import Sharded2DTickPlanner, make_mesh2d
+    J, N = 4096, 128
+    state = _random_state(J, N, seed=61)
+    base = _build(Sharded2DTickPlanner, make_mesh2d(4, 2), J, N,
+                  state, True, demand_format="dense")
+    for kw in ({"demand_format": "dense"},
+               {"demand_format": "compacted"}):
+        nb = _build(Sharded2DTickPlanner, make_mesh2d(4, 2), J, N,
+                    state, True, node_block_psum=True, **kw)
+        assert nb.node_block_psum
+        _assert_identical(nb, base, 1_753_000_000)
+        base = _build(Sharded2DTickPlanner, make_mesh2d(4, 2), J, N,
+                      state, True, demand_format="dense")
+
+
+def test_compacted_windowed_matches_dense(forced_host_devices):
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    J, N = 2048, 64
+    state = _random_state(J, N, seed=71)
+    a = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp",
+               demand_format="compacted")
+    b = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp",
+               demand_format="dense")
+    t0, W = 1_753_000_000, 4
+    for pa, pb in zip(a.plan_window(t0, W), b.plan_window(t0, W)):
+        assert set(pa.fired.tolist()) == set(pb.fired.tolist())
+        assert dict(zip(pa.fired.tolist(), pa.assigned.tolist())) == \
+            dict(zip(pb.fired.tolist(), pb.assigned.tolist()))
+    np.testing.assert_array_equal(np.asarray(a.load), np.asarray(b.load))
+    np.testing.assert_array_equal(np.asarray(a.rem_cap),
+                                  np.asarray(b.rem_cap))
+
+
+def test_compacted_crossover_and_empty_bucket(forced_host_devices):
+    """Shapes straddling the crossover (k_comp well below and above
+    ~N/3) and the empty-bucket edge (a tick where nothing fires) — all
+    bit-identical between the formats."""
+    from cronsun_tpu.cron.parser import parse
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    # wide-N (k_comp=256 << N/3): compacted's home turf; narrow-N
+    # (k_comp=64 > N/3=21): dense's home turf — exactness either side
+    for J, N in ((2048, 2048), (2048, 64)):
+        state = _random_state(J, N, seed=81)
+        a = _build(ShardedTickPlanner, mesh, J, N, state, True,
+                   impl="jnp", demand_format="compacted")
+        b = _build(ShardedTickPlanner, mesh, J, N, state, True,
+                   impl="jnp", demand_format="dense")
+        _assert_identical(a, b, 1_753_000_000, ticks=2)
+    # empty bucket: every job pinned to second 30, planned at second 40
+    # (1_753_000_000 % 60 == 40) — zero candidates through the whole
+    # compact/scatter path
+    J, N = 2048, 96
+    specs, elig, excl, cost, caps = _random_state(J, N, seed=82)
+    specs = [parse("30 * * * * *")] * J
+    state = (specs, elig, excl, cost, caps)
+    a = _build(ShardedTickPlanner, mesh, J, N, state, True,
+               impl="jnp", demand_format="compacted")
+    b = _build(ShardedTickPlanner, mesh, J, N, state, True,
+               impl="jnp", demand_format="dense")
+    pa, pb = a.plan(1_753_000_000), b.plan(1_753_000_000)
+    assert pa.total_fired == pb.total_fired == 0
+    np.testing.assert_array_equal(np.asarray(a.load), np.asarray(b.load))
+
+
+def test_demand_format_autoselect_and_model(forced_host_devices):
+    """The compacted branch of the byte model (24*k_comp*Dj per round)
+    and auto-selection from it: compacted in the sparse/wide corner,
+    dense at the herd bucket; explicit pins win; bad formats raise."""
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    sp = ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                            node_capacity=100_000, impl="jnp")
+    sparse = sp.estimate_collective_bytes(2048)       # k_local=256
+    herd = sp.estimate_collective_bytes(65536 * 8)    # k_local=65536
+    # exact model values at this shape (Dj=8, N=100_000->100_000+pad)
+    assert sparse["compacted_per_round"] == 24 * 256 * 8
+    assert sparse["compacted_per_round"] < sparse["sharded_per_round"]
+    assert sparse["demand_format"] == "compacted"
+    assert sparse["per_round"] == sparse["compacted_per_round"]
+    # k_comp caps at N: the triples can never exceed the dense width
+    assert herd["compacted_per_round"] == 24 * min(65536, sp.N) * 8
+    assert herd["demand_format"] == "dense"
+    assert herd["per_round"] == herd["sharded_per_round"]
+    assert sp._resolve_demand_format(256) == "compacted"
+    assert sp._resolve_demand_format(65536) == "dense"
+    # pins override the crossover in both directions
+    pinned = ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                                node_capacity=100_000, impl="jnp",
+                                demand_format="dense")
+    assert pinned._resolve_demand_format(256) == "dense"
+    pinned = ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                                node_capacity=100_000, impl="jnp",
+                                demand_format="compacted")
+    assert pinned._resolve_demand_format(65536) == "compacted"
+    # the replicated rollback path has no demand exchange to format
+    repl = ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                              node_capacity=100_000, impl="jnp",
+                              shard_bids=False)
+    assert repl._resolve_demand_format(256) == "dense"
+    with pytest.raises(ValueError):
+        ShardedTickPlanner(make_mesh(8), job_capacity=65536,
+                           node_capacity=1024, demand_format="sparse")
+
+
+def test_mesh_snapshot_demand_format_fields(forced_host_devices):
+    """stats_snapshot carries the demand_format label field and the
+    compacted-bytes/ticks counters, and they advance only when the
+    compacted path actually ran."""
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    J, N = 2048, 64
+    state = _random_state(J, N, seed=91)
+    dense = _build(ShardedTickPlanner, make_mesh(8), J, N, state, True,
+                   impl="jnp", demand_format="dense")
+    dense.plan(1_753_000_000)
+    snap = dense.stats_snapshot()
+    assert snap["demand_format"] == "dense"
+    assert snap["compacted_bytes_total"] == 0
+    assert snap["compacted_ticks_total"] == 0
+    comp = _build(ShardedTickPlanner, make_mesh(8), J, N, state, True,
+                  impl="jnp", demand_format="compacted")
+    comp.plan(1_753_000_000)
+    comp.plan_window(1_753_000_010, 2)
+    snap = comp.stats_snapshot()
+    assert snap["demand_format"] == "compacted"
+    assert snap["compacted_ticks_total"] == 3
+    est = comp.estimate_collective_bytes(demand_format="compacted")
+    assert snap["compacted_bytes_total"] == \
+        3 * comp.rounds * est["compacted_per_round"]
 
 
 # ---------------------------------------------------------------------------
@@ -271,7 +454,61 @@ def test_mesh_bid_scaling():
         assert r["sharded_per_round"] < r["replicated_per_round"], r
 
 
+def _sparse_worker():
+    """Runs in a subprocess with 8 forced-host CPU devices: the sparse
+    corner (small bucket, wide fleet) COMPILED — compacted per-tick
+    collective bytes from the lowered HLO must be strictly below
+    dense's, auto-select must pick compacted there and dense at the
+    herd bucket, and the two formats' fire sets must match."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= 8, jax.devices()
+    from cronsun_tpu.parallel.mesh import ShardedTickPlanner, make_mesh
+    mesh = make_mesh(8)
+    J, N = 4096, 12_800          # fire fraction << 1: k_comp=256 << N/3
+    state = _random_state(J, N, seed=101)
+    a = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp",
+               demand_format="compacted")
+    b = _build(ShardedTickPlanner, mesh, J, N, state, True, impl="jnp",
+               demand_format="dense")
+    _assert_identical(a, b, 1_753_000_000, ticks=2)
+    comp_bytes = a.measured_collective_bytes()
+    dense_bytes = b.measured_collective_bytes()
+    auto = ShardedTickPlanner(mesh, job_capacity=J, node_capacity=N,
+                              max_fire_bucket=2048, impl="jnp")
+    print(json.dumps({
+        "compacted_measured": comp_bytes,
+        "dense_measured": dense_bytes,
+        "sparse_pick": auto._resolve_demand_format(256),
+        "herd_pick": auto._resolve_demand_format(65536),
+        "identical": True,
+    }))
+
+
+@pytest.mark.slow
+def test_compacted_sparse_corner_gate():
+    """The acceptance gate: in the sparse-tick/wide-fleet corner the
+    compacted gather's COMPILED per-tick bytes are strictly below the
+    dense path's with zero fire-set divergence, and auto-select picks
+    the cheaper format on both sides of the crossover."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sparse-worker"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+        env=forced_cpu_env(8))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert r["identical"]
+    assert r["compacted_measured"] is not None
+    assert r["dense_measured"] is not None
+    assert r["compacted_measured"] < r["dense_measured"], r
+    assert r["sparse_pick"] == "compacted"
+    assert r["herd_pick"] == "dense"
+
+
 if __name__ == "__main__":
     if "--scaling-worker" in sys.argv:
         sys.path.insert(0, ROOT)
         _scaling_worker()
+    elif "--sparse-worker" in sys.argv:
+        sys.path.insert(0, ROOT)
+        _sparse_worker()

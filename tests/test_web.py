@@ -337,6 +337,27 @@ def test_metrics_endpoint(world):
     assert "# TYPE cronsun_sched_tick_p99_ms gauge" in text
 
 
+def test_metrics_mesh_series_carry_demand_format_label(world):
+    """Every cronsun_mesh_tick_* series carries the demand wire format
+    its ticks ran with as a LABEL (dense vs compacted must be tellable
+    apart per series), and the per-tick compacted-bytes counter
+    renders."""
+    store, _, _, c = world
+    store.put(KS.metrics_key("mesh", "sched-1"), json.dumps({
+        "tick_p99_ms": 4.2, "ticks_total": 9,
+        "collective_bytes_total": 1234,
+        "compacted_bytes_total": 567, "compacted_ticks_total": 3,
+        "demand_format": "compacted"}))
+    text = urllib.request.urlopen(c.base + "/v1/metrics").read().decode()
+    assert ('cronsun_mesh_tick_p99_ms{instance="sched-1",'
+            'demand_format="compacted"} 4.2') in text
+    assert ('cronsun_mesh_compacted_bytes_total{instance="sched-1",'
+            'demand_format="compacted"} 567') in text
+    assert "# TYPE cronsun_mesh_compacted_bytes_total counter" in text
+    # the string field rides only as the label, never as a sample
+    assert "cronsun_mesh_demand_format{" not in text
+
+
 def test_metrics_endpoint_surfaces_store_op_stats(world):
     """/v1/metrics renders the store's server-side per-op timings
     (cronsun_store_op_*) so an operator can attribute a dispatch-plane
