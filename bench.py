@@ -534,6 +534,26 @@ def main():
             detail["query_plane_error"] = proc.stderr[-500:]
     except Exception as e:  # noqa: BLE001
         detail["query_plane_error"] = str(e)
+    # the PUSH plane: M concurrent SSE viewers on /v1/stream against
+    # paced live ingest — publish-lag p50/p99, bytes-per-viewer, and
+    # logd read ops vs the equivalent poll load at the same freshness
+    # (the >= 10x claim).  Quick runs use a smaller fleet; full runs
+    # drive the 1k-viewer gate.
+    log("push plane: SSE fan-out vs poll at equal freshness")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "scripts",
+                                          "bench_push.py"),
+             "--viewers", "150" if quick else "1000",
+             "--seconds", "3" if quick else "8",
+             "--write-rate", "50" if quick else "20"],
+            capture_output=True, text=True, timeout=600, cwd=here)
+        if proc.returncode == 0:
+            detail.update(json.loads(proc.stdout))
+        else:
+            detail["push_plane_error"] = proc.stderr[-500:]
+    except Exception as e:  # noqa: BLE001
+        detail["push_plane_error"] = str(e)
 
     # ---- store snapshot write-stall probe ----------------------------------
     # the staggered-imaging claim: p99 client-visible put latency DURING

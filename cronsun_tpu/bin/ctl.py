@@ -290,6 +290,76 @@ def _log_line(r) -> str:
             f"{r['node']:<12} {took:5.1f}s  #{r['id']}")
 
 
+def _drain_cursor(api, params, cursor: str, as_json: bool) -> str:
+    """Drain everything past ``cursor`` via the PR 7 cursor query (one
+    page loop), printing each record; returns the advanced cursor."""
+    while True:
+        out = api.call("GET", "/v1/logs",
+                       dict(params, afterId=cursor, page=1,
+                            pageSize=500))
+        for r in out["list"]:
+            print(json.dumps(r) if as_json else _log_line(r),
+                  flush=True)
+        if out["list"]:
+            cursor = out.get("cursor", str(out["list"][-1]["id"]))
+        if len(out["list"]) < 500:
+            return cursor
+
+
+def _follow_sse(api, params, cursor: str, as_json: bool):
+    """One /v1/stream connection: print pushed records as they land.
+    Returns ``(cursor, why)`` — ``why`` is "lost" (server dropped this
+    stream; the caller re-lists via the cursor) or "closed" (EOF, a
+    drain ``bye``, or a read timeout; the caller reconnects).  Raises
+    ApiError on HTTP errors (the fallback signal)."""
+    qs = {k: v for k, v in params.items() if v not in (None, "")}
+    if cursor:
+        qs["cursor"] = cursor
+    url = api.url + "/v1/stream"
+    if qs:
+        url += "?" + urllib.parse.urlencode(qs)
+    try:
+        resp = api.opener.open(urllib.request.Request(url), timeout=60)
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace").strip()
+        try:
+            detail = json.loads(detail).get("error", detail)
+        except (json.JSONDecodeError, AttributeError):
+            pass
+        raise ApiError(e.code, detail or e.reason)
+    except urllib.error.URLError as e:
+        raise ApiError(0, f"cannot reach {api.url}: {e.reason}")
+    event, data = "message", []
+    try:
+        with resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").rstrip("\r\n")
+                if not line:                   # frame boundary
+                    if event == "log" and data:
+                        r = json.loads("\n".join(data))
+                        print(json.dumps(r) if as_json else _log_line(r),
+                              flush=True)
+                    elif event == "lost":
+                        return cursor, "lost"
+                    elif event == "bye":
+                        return cursor, "closed"
+                    event, data = "message", []
+                    continue
+                if line.startswith(":"):       # heartbeat comment
+                    continue
+                field, _, value = line.partition(":")
+                value = value[1:] if value.startswith(" ") else value
+                if field == "event":
+                    event = value
+                elif field == "data":
+                    data.append(value)
+                elif field == "id":
+                    cursor = value
+    except (OSError, TimeoutError):
+        pass                                   # reconnect with cursor
+    return cursor, "closed"
+
+
 def _follow_logs(api, params, interval: float, as_json: bool):
     """tail -f over the result store, cursor-exact: the afterId query
     returns rows in per-shard insertion order, so records inserted with
@@ -297,8 +367,14 @@ def _follow_logs(api, params, interval: float, as_json: bool):
     cursor is OPAQUE to this loop (a scalar id for one sink, a
     comma-joined per-shard vector for a sharded one): bootstrap asks
     the server for the tail (``afterId=tail`` — the sink revision IS
-    the tail cursor, one cheap read instead of draining history) and
-    every poll carries forward the ``cursor`` the server returns."""
+    the tail cursor, one cheap read instead of draining history).
+
+    Transport: live push (/v1/stream SSE) when the server offers it —
+    records print at publish lag, zero polls — resuming through the
+    cursor on reconnects and re-listing on ``lost``.  Falls back to
+    the PR 7 cursor-poll protocol when the server predates /v1/stream
+    or push is disabled (and for the begin/end/names filters, which
+    only the query path evaluates)."""
     try:
         out = api.call("GET", "/v1/logs",
                        dict(params, afterId="tail", page=1, pageSize=1))
@@ -323,19 +399,29 @@ def _follow_logs(api, params, interval: float, as_json: bool):
                 break
             cursor = nxt.get("cursor", str(nxt["list"][-1]["id"]))
     print(f"following (cursor {cursor}; ^C to stop)", file=sys.stderr)
+    # the stream evaluates node/ids/tenant/failedOnly server-side;
+    # begin/end/names exist only on the query path — poll for those
+    sse_ok = not any(params.get(k) for k in ("begin", "end", "names"))
+    while sse_ok:
+        try:
+            cursor, why = _follow_sse(api, params, cursor, as_json)
+        except ApiError as e:
+            if e.status in (400, 404, 501, 503):
+                print(f"live stream unavailable ({e}); polling every "
+                      f"{interval:g}s", file=sys.stderr)
+                break                          # poll fallback below
+            raise
+        if why == "lost":
+            # this viewer fell behind (or resumed past the replay
+            # window): the cursor re-list is the documented recovery
+            print("stream lost; re-listing from cursor",
+                  file=sys.stderr)
+            cursor = _drain_cursor(api, params, cursor, as_json)
+        else:
+            time.sleep(min(interval, 1.0))     # reconnect backoff
     while True:
         time.sleep(interval)
-        while True:      # drain bursts larger than one page
-            out = api.call("GET", "/v1/logs",
-                           dict(params, afterId=cursor, page=1,
-                                pageSize=500))
-            for r in out["list"]:
-                print(json.dumps(r) if as_json else _log_line(r),
-                      flush=True)
-            if out["list"]:
-                cursor = out.get("cursor", str(out["list"][-1]["id"]))
-            if len(out["list"]) < 500:
-                break
+        cursor = _drain_cursor(api, params, cursor, as_json)
 
 
 def cmd_logs(api, args):

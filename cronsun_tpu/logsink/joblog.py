@@ -117,6 +117,101 @@ class LogRecord:
 _UNSET = object()
 
 
+class SubscriptionLost(Exception):
+    """The subscriber fell behind its bounded buffer (or the stream
+    died): pending events were dropped.  The consumer re-lists (cursor
+    query from its last delivered id) and re-subscribes — the store
+    watch plane's ``WatchLost`` contract, result-plane edition."""
+
+
+def sub_event(r: LogRecord) -> tuple:
+    """The change-stream summary of one record: the 8 fields a
+    dashboard row needs, WITHOUT user/command/output (a stream carrying
+    every job's stdout would make one chatty job the fan-out's
+    bandwidth ceiling; the detail endpoint serves bodies by id).  Wire
+    form is the same fields as a JSON list, both backends byte-alike:
+    ``[id, job_id, job_group, name, node, success, begin_ts,
+    end_ts]``."""
+    return (r.id, r.job_id, r.job_group, r.name, r.node, r.success,
+            r.begin_ts, r.end_ts)
+
+
+class LogSubscription:
+    """A bounded, lossy, per-subscriber event buffer (the store's
+    watcher shape: ``on_ready`` callback for pump loops, blocking
+    ``get`` for thread-per-subscription consumers).  Writers push
+    summaries; overflow drops EVERYTHING pending and latches ``lost``
+    — a slow consumer costs itself a re-list, never the writer a
+    stall."""
+
+    def __init__(self, store, cap: int = 4096):
+        self._store = store
+        self._cap = max(1, int(cap))
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._buf: deque = deque()
+        self.lost = False
+        self.closed = False
+        # set by subscribe(): the revision the stream starts after, and
+        # whether the requested resume gap was NOT replayable (the
+        # consumer re-lists once; the stream itself is live from rev)
+        self.rev = 0
+        self.gap = False
+        self.on_ready = None       # pump nudge: called outside _mu
+
+    def _push(self, evs) -> None:
+        """Writer side — events for this subscriber (already
+        filtered/ordered).  Never blocks."""
+        if not evs:
+            return
+        with self._cv:
+            if self.lost or self.closed:
+                return
+            if len(self._buf) + len(evs) > self._cap:
+                self._buf.clear()
+                self.lost = True
+            else:
+                self._buf.extend(evs)
+            self._cv.notify_all()
+            ready = self.on_ready
+        if ready is not None:
+            ready(self)
+
+    def drain(self) -> list:
+        """All pending events, non-blocking.  Raises
+        :class:`SubscriptionLost` once the buffer overflowed (after
+        which the subscription is dead)."""
+        with self._cv:
+            if self.lost:
+                raise SubscriptionLost("log subscription overflowed")
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def get(self, timeout: Optional[float] = None) -> list:
+        """Pending events, blocking up to ``timeout`` for the first one
+        (empty list on timeout).  Raises :class:`SubscriptionLost` when
+        the buffer overflowed or the stream closed under the consumer."""
+        with self._cv:
+            if not self._buf and not self.lost and not self.closed:
+                self._cv.wait(timeout)
+            if self.lost:
+                raise SubscriptionLost("log subscription overflowed")
+            if self.closed and not self._buf:
+                raise SubscriptionLost("log subscription closed")
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def close(self):
+        store, self._store = self._store, None
+        if store is not None:
+            store.unsubscribe(self)
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+
+
 def copy_rec(r: LogRecord, id=_UNSET) -> LogRecord:
     """Positional-field copy — ~6x faster than dataclasses.replace
     (which routes through __init__ via a keyword dict); the hot read
@@ -179,6 +274,10 @@ class JobLogStore:
         self._cold_boundary = 0            # ids <= this live in segments
         self._segments: list = []          # tiering.scan_segments index
         self._age_mu = threading.Lock()    # one age-out pass at a time
+        # change-stream plane: live subscriptions (registered and fed
+        # under self._lock, so a subscriber snapshot-then-register can
+        # never miss a record between its revision and its first event)
+        self._subs: dict = {}
         # trace plane: bounded span ring + per-day spill beside the
         # tiered store's segment directory (file-backed sinks only)
         from .traces import TraceStore
@@ -227,6 +326,11 @@ class JobLogStore:
     def close(self):
         self.traces.close()
         with self._lock:
+            for s in list(self._subs.values()):
+                with s._cv:
+                    s.closed = True
+                    s._cv.notify_all()
+            self._subs.clear()
             self._db.close()
 
     # ---- trace plane (fire-lifecycle spans) ------------------------------
@@ -284,6 +388,8 @@ class JobLogStore:
                 with self._hot_mu:
                     self._mirror_locked([(rec, ok)],
                                         {day: (1, ok, 1 - ok)}, rec.id)
+            if self._subs:
+                self._sub_emit([rec])
         self._op_record("create_job_log", t0)
 
     def _create_locked(self, rec: LogRecord) -> str:
@@ -428,6 +534,8 @@ class JobLogStore:
             if self._tier:
                 with self._hot_mu:
                     self._mirror_locked(mirror, days, ids[-1])
+            if self._subs:
+                self._sub_emit([r for r, _ in mirror])
         self._op_record("create_job_logs", t0)
         self.op_count("log_records", len(ids))
         return ids
@@ -772,6 +880,82 @@ class JobLogStore:
                 "SELECT * FROM job_log ORDER BY id DESC LIMIT ?",
                 (limit,)).fetchall() if limit else []
         return rev, [self._row_to_rec(r, False) for r in reversed(rows)]
+
+    # ---- change stream (the store watch plane, result-plane edition) -----
+
+    def subscribe(self, after_id: int = 0, cap: int = 4096
+                  ) -> LogSubscription:
+        """Open a live event stream of new-record summaries.
+
+        ``after_id`` <= 0 (or >= revision) starts from NOW; a positive
+        cursor replays the gap ``(after_id, revision]`` when the store
+        can still prove completeness — from the contiguous hot deque or
+        from SQL rows above the retention/cold floor — and otherwise
+        sets ``sub.gap`` (the consumer re-lists once; the stream itself
+        is live from ``sub.rev`` regardless).  ``cap`` bounds the
+        per-subscriber buffer: overflow drops everything pending and
+        latches ``lost`` (store watch semantics).
+
+        Registration and the revision snapshot share one ``self._lock``
+        hold with the write path's emission, so no record can land
+        between the snapshot and the first event."""
+        t0 = time.perf_counter_ns()
+        after_id = int(after_id)
+        with self._lock:
+            if self._tier:
+                with self._hot_mu:
+                    rev = self._h_rev
+            else:
+                rev = self._sql_revision()
+            sub = LogSubscription(self, cap)
+            sub.rev = rev
+            replay: list = []
+            if 0 < after_id < rev:
+                served = False
+                if self._tier:
+                    with self._hot_mu:
+                        if self._h_recs and \
+                                self._h_recs[0].id <= after_id + 1:
+                            # contiguous-id invariant: the deque holds
+                            # EVERY id in [head, rev], so covering
+                            # after_id+1 proves the replay is complete
+                            replay = [sub_event(r) for r in self._h_recs
+                                      if r.id > after_id]
+                            served = True
+                if not served:
+                    floor = max(self._retain_floor(rev),
+                                self._cold_boundary)
+                    if after_id < floor:
+                        sub.gap = True
+                    else:
+                        rows = self._db.execute(
+                            "SELECT * FROM job_log WHERE id > ? "
+                            "ORDER BY id ASC", (after_id,)).fetchall()
+                        replay = [sub_event(self._row_to_rec(r, False))
+                                  for r in rows]
+            self._subs[id(sub)] = sub
+            if replay:
+                sub._push(replay)
+        self._op_record("subscribe", t0)
+        return sub
+
+    def unsubscribe(self, sub: LogSubscription) -> None:
+        with self._lock:
+            self._subs.pop(id(sub), None)
+
+    def _sub_emit(self, recs) -> None:
+        """Fan a committed batch to every live subscription — called
+        under ``self._lock`` from both create paths, AFTER the commit
+        (an event must never precede the row it announces)."""
+        evs = [sub_event(r) for r in recs]
+        self.op_count("sub_events", len(evs) * len(self._subs))
+        dead = []
+        for k, s in self._subs.items():
+            s._push(evs)
+            if s.lost or s.closed:
+                dead.append(k)
+        for k in dead:
+            self._subs.pop(k, None)
 
     def logmap(self, n=None, hash=None):
         """The sharded-result-plane topology pin (the store's shardmap,

@@ -28,16 +28,18 @@ carries Mongo credentials through config the same way
 from __future__ import annotations
 
 import json
+import queue
 import socket
 import socketserver
 import threading
 import uuid
+from collections import deque
 from typing import List, Optional, Tuple
 
 from .. import log
 from ..chaos.hooks import hooks as _chaos
 from ..store.wire import LineJsonHandler
-from .joblog import JobLogStore, LogRecord
+from .joblog import JobLogStore, LogRecord, SubscriptionLost
 
 # ops dispatched 1:1 onto the JobLogStore surface (auth + create_job_log
 # + query_logs + tail_snapshot get special marshalling)
@@ -68,6 +70,70 @@ def _rec_unwire(w) -> Optional[LogRecord]:
 
 
 class _Conn(LineJsonHandler):
+    # Wire form of the change stream (see JobLogStore.subscribe): the
+    # ``subscribe`` op acks {"rev": R, "lost": gap?} on the request id,
+    # then the server pushes frames on the SAME connection —
+    #   {"s": <rid>, "evs": [[id, job_id, job_group, name, node,
+    #                         success, begin_ts, end_ts], ...]}
+    # in id order, and {"s": <rid>, "lost": true} once the bounded
+    # buffer overflowed (after which the subscription is dead and the
+    # consumer re-lists + re-subscribes).  Both backends pin the same
+    # frames byte-for-byte-compatibly.
+
+    def setup(self):
+        super().setup()
+        # per-connection change-stream state: subscriptions opened on
+        # this connection and the pump thread that writes their frames
+        # (lazy — request/response-only connections never pay a thread)
+        self._subs: dict = {}
+        self._sub_ready: "queue.Queue" = queue.Queue()
+        self._pump: Optional[threading.Thread] = None
+
+    def finish(self):
+        for sub in list(self._subs.values()):
+            sub.close()
+        self._subs.clear()
+        if self._pump is not None:
+            self._sub_ready.put(None)
+        super().finish()
+
+    def _subscribe(self, sink, rid, after_id, cap):
+        sub = sink.subscribe(after_id=after_id, cap=cap)
+        # ack FIRST, then arm the pump: events landing in between just
+        # buffer in the subscription, and the nudge below flushes them —
+        # so the client always reads the ack before any frame
+        self._send({"i": rid, "r": {"rev": sub.rev,
+                                    "lost": bool(sub.gap)}})
+        sid = int(rid)
+        self._subs[sid] = sub
+        if self._pump is None:
+            self._pump = threading.Thread(target=self._sub_pump,
+                                          daemon=True,
+                                          name="logsink-sub-pump")
+            self._pump.start()
+        sub.on_ready = lambda _s, q=self._sub_ready, i=sid: q.put(i)
+        self._sub_ready.put(sid)
+
+    def _sub_pump(self):
+        while self.alive:
+            sid = self._sub_ready.get()
+            if sid is None:
+                return
+            sub = self._subs.get(sid)
+            if sub is None:
+                continue
+            try:
+                evs = sub.drain()
+            except SubscriptionLost:
+                self._send_raw('{"s":%d,"lost":true}\n' % sid)
+                self._subs.pop(sid, None)
+                sub.close()
+                continue
+            for i in range(0, len(evs), 2048):
+                self._send_raw(json.dumps(
+                    {"s": sid, "evs": evs[i:i + 2048]},
+                    separators=(",", ":")) + "\n")
+
     def _send_raw(self, line: str):
         data = line.encode()
         with self.wlock:
@@ -138,6 +204,15 @@ class _Conn(LineJsonHandler):
                 self._send({"i": rid, "r": {
                     "revision": rev,
                     "list": [_rec_wire(r) for r in recs]}})
+            elif op == "subscribe":
+                self._subscribe(sink, rid,
+                                int(args[0]) if args else 0,
+                                int(args[1]) if len(args) > 1 else 4096)
+            elif op == "unsubscribe":
+                sub = self._subs.pop(int(args[0]), None)
+                if sub is not None:
+                    sub.close()
+                self._send({"i": rid, "r": sub is not None})
             elif op in _PLAIN_OPS:
                 r = getattr(sink, op)(*args)
                 if op == "get_log":
@@ -293,6 +368,142 @@ class LogSinkServer:
 
 class LogSinkError(RuntimeError):
     pass
+
+
+class RemoteLogSubscription:
+    """Client side of the ``subscribe`` wire op, on a DEDICATED
+    connection (the shared request/response connection is strictly
+    synchronous — one streaming op is not worth teaching every caller
+    a demux).  A reader thread feeds a local bounded buffer with the
+    same ``get``/``drain``/``lost``/``on_ready`` surface as the
+    in-process :class:`~.joblog.LogSubscription`, and ANY transport
+    failure latches ``lost`` (never silent staleness): the consumer
+    re-lists from its cursor and re-subscribes, exactly as after an
+    overflow."""
+
+    def __init__(self, host: str, port: int, timeout: float,
+                 token: str, sslctx, tls_hostname: str,
+                 after_id: int, cap: int):
+        sock = socket.create_connection((host, port), timeout=timeout)
+        if sslctx is not None:
+            from ..tlsutil import wrap_client
+            sock = wrap_client(sock, sslctx, tls_hostname)
+        sock.settimeout(timeout)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._cap = max(1, int(cap))
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._buf: deque = deque()
+        self.lost = False
+        self.closed = False
+        self.on_ready = None
+        try:
+            if token:
+                self._handshake("auth", token)
+            r = self._handshake("subscribe", int(after_id), int(cap))
+            self.rev = int(r.get("rev", 0))
+            self.gap = bool(r.get("lost"))
+        except Exception:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        # subscribed: frames arrive whenever the server has events, so
+        # reads must be allowed to block indefinitely
+        sock.settimeout(None)
+        self._thread = threading.Thread(target=self._read_loop,
+                                        daemon=True,
+                                        name="logsink-sub-reader")
+        self._thread.start()
+
+    def _handshake(self, op: str, *args):
+        data = (json.dumps({"i": 1, "o": op, "a": list(args)},
+                           separators=(",", ":")) + "\n").encode()
+        self._sock.sendall(data)
+        line = self._rfile.readline()
+        if not line:
+            raise LogSinkError(f"{op}: connection closed")
+        msg = json.loads(line)
+        if "e" in msg:
+            raise LogSinkError(msg["e"])
+        return msg.get("r")
+
+    def _read_loop(self):
+        while True:
+            try:
+                line = self._rfile.readline()
+            except (OSError, ValueError):
+                line = b""
+            if not line:
+                self._mark_lost()
+                return
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                self._mark_lost()
+                return
+            if msg.get("lost"):
+                self._mark_lost()
+                return
+            evs = msg.get("evs") or []
+            ready = None
+            with self._cv:
+                if self.closed:
+                    return
+                if len(self._buf) + len(evs) > self._cap:
+                    # local overflow mirrors the server-side contract
+                    self._buf.clear()
+                    self.lost = True
+                else:
+                    self._buf.extend(tuple(e) for e in evs)
+                self._cv.notify_all()
+                ready = self.on_ready
+            if ready is not None:
+                ready(self)
+            if self.lost:
+                return
+
+    def _mark_lost(self):
+        ready = None
+        with self._cv:
+            if not self.closed:
+                self._buf.clear()
+                self.lost = True
+                ready = self.on_ready
+            self._cv.notify_all()
+        if ready is not None:
+            ready(self)
+
+    def drain(self) -> list:
+        with self._cv:
+            if self.lost:
+                raise SubscriptionLost("log subscription lost")
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def get(self, timeout: Optional[float] = None) -> list:
+        with self._cv:
+            if not self._buf and not self.lost and not self.closed:
+                self._cv.wait(timeout)
+            if self.lost:
+                raise SubscriptionLost("log subscription lost")
+            if self.closed and not self._buf:
+                raise SubscriptionLost("log subscription closed")
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def close(self):
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class RemoteJobLogStore:
@@ -461,6 +672,20 @@ class RemoteJobLogStore:
         JobLogStore.tail_snapshot for why two reads can skip)."""
         r = self._call("tail_snapshot", limit)
         return r["revision"], [_rec_unwire(w) for w in r["list"]]
+
+    def subscribe(self, after_id: int = 0,
+                  cap: int = 4096) -> RemoteLogSubscription:
+        """Open a live change stream (see JobLogStore.subscribe) on a
+        dedicated connection.  Raises LogSinkError when the server is
+        unreachable or predates the ``subscribe`` op."""
+        if self._closed:
+            raise LogSinkError("logsink connection closed")
+        try:
+            return RemoteLogSubscription(
+                self.host, self.port, self._timeout, self._token,
+                self._sslctx, self._tls_hostname, after_id, cap)
+        except (OSError, ValueError) as e:
+            raise LogSinkError(f"subscribe: {e}") from e
 
     def age_out(self, now: Optional[float] = None) -> int:
         """Force a cold-aging pass (the sweeper runs it periodically);

@@ -68,6 +68,7 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -76,7 +77,7 @@ from ..core.breaker import BreakerBank, ShardDegradedError  # noqa: F401
 # fail-fast into the agents' retry ladders when a shard's breaker is
 # open)
 from ..store.sharded import breaker_env_deadline, fnv1a
-from .joblog import LogRecord
+from .joblog import LogRecord, SubscriptionLost
 
 LOG_HASH_SCHEME = "fnv1a-job-v1"
 
@@ -163,6 +164,138 @@ def merge_stat_days(parts: List[List[dict]], n_days: int) -> List[dict]:
     return [{"day": day, "total": t, "successed": s, "failed": f}
             for day, (t, s, f) in
             sorted(days.items(), reverse=True)[:max(0, n_days)]]
+
+
+class ShardedLogSubscription:
+    """Merged change stream over one subscription PER SHARD — the
+    cursor-vector machinery, live.  Each shard's drainer re-encodes its
+    raw ids (``raw * N + shard``) and appends into one bounded merged
+    buffer; per-shard order is preserved (cross-shard interleave is
+    arbitrary, exactly like concurrent writes).  ``vector`` is the
+    per-shard resume cursor advanced per DELIVERED event — hand it to
+    ``query_logs(after_id=vector)`` to re-list after a ``lost``, or to
+    ``subscribe`` to resume.  Any shard's loss (overflow, transport)
+    latches the merged stream ``lost``: one vector describes one
+    consistent resume point, so a half-lost stream is not a thing."""
+
+    def __init__(self, sharded: "ShardedJobLogStore", vec: List[int],
+                 cap: int):
+        self._n = sharded.nshards
+        self._cap = max(1, int(cap))
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._buf: deque = deque()
+        self.lost = False
+        self.closed = False
+        self.on_ready = None
+        self._subs: list = []
+        try:
+            # raw clients, not breaker guards: a stream is long-lived —
+            # failure latches ``lost`` and the consumer re-subscribes
+            # at its own cadence, which IS the breaker story here
+            for si in range(self._n):
+                self._subs.append(
+                    sharded._raw[si].subscribe(after_id=vec[si],
+                                               cap=self._cap))
+        except BaseException:
+            for s in self._subs:
+                s.close()
+            raise
+        self.rev = [s.rev for s in self._subs]
+        self.gap = any(s.gap for s in self._subs)
+        # resume vector: a gap (or from-now) shard starts at its stream
+        # revision — the caller re-lists the gap once, signalled by
+        # ``gap`` — a replayed shard at the requested cursor
+        self._vec = [self._subs[si].rev
+                     if vec[si] <= 0 or self._subs[si].gap else vec[si]
+                     for si in range(self._n)]
+        self._threads = [
+            threading.Thread(target=self._drain_loop, args=(si,),
+                             daemon=True, name=f"logsub-merge-{si}")
+            for si in range(self._n)]
+        for t in self._threads:
+            t.start()
+
+    def _drain_loop(self, si: int):
+        sub = self._subs[si]
+        while True:
+            try:
+                evs = sub.get(timeout=0.5)
+            except SubscriptionLost:
+                self._mark_lost()
+                return
+            with self._cv:
+                if self.closed or self.lost:
+                    return
+            if not evs:
+                continue
+            enc = [(encode_log_id(e[0], si, self._n),) + tuple(e[1:])
+                   for e in evs]
+            ready = None
+            with self._cv:
+                if self.closed or self.lost:
+                    return
+                if len(self._buf) + len(enc) > self._cap:
+                    self._buf.clear()
+                    self.lost = True
+                else:
+                    self._buf.extend(enc)
+                self._cv.notify_all()
+                ready = self.on_ready
+            if ready is not None:
+                ready(self)
+            if self.lost:
+                return
+
+    def _mark_lost(self):
+        ready = None
+        with self._cv:
+            if not self.closed:
+                self._buf.clear()
+                self.lost = True
+                ready = self.on_ready
+            self._cv.notify_all()
+        if ready is not None:
+            ready(self)
+
+    @property
+    def vector(self) -> List[int]:
+        """Per-shard resume cursor of everything DELIVERED so far."""
+        with self._mu:
+            return list(self._vec)
+
+    def _take_locked(self) -> list:
+        out = list(self._buf)
+        self._buf.clear()
+        for e in out:
+            raw, si = decode_log_id(e[0], self._n)
+            if raw > self._vec[si]:
+                self._vec[si] = raw
+        return out
+
+    def drain(self) -> list:
+        with self._cv:
+            if self.lost:
+                raise SubscriptionLost("sharded log subscription lost")
+            return self._take_locked()
+
+    def get(self, timeout: Optional[float] = None) -> list:
+        """Pending events (encoded ids), blocking up to ``timeout``."""
+        with self._cv:
+            if not self._buf and not self.lost and not self.closed:
+                self._cv.wait(timeout)
+            if self.lost:
+                raise SubscriptionLost("sharded log subscription lost")
+            if self.closed and not self._buf:
+                raise SubscriptionLost("sharded log subscription closed")
+            return self._take_locked()
+
+    def close(self):
+        with self._cv:
+            self.closed = True
+            self._cv.notify_all()
+        for s in self._subs:
+            s.close()
 
 
 class ShardedJobLogStore:
@@ -464,6 +597,27 @@ class ShardedJobLogStore:
             r.id = encode_log_id(raw, si, self.nshards)
             out.append(r)
         return vec, out
+
+    def subscribe(self, after_id=0, cap: int = 8192
+                  ) -> ShardedLogSubscription:
+        """Merged live change stream across every shard.  ``after_id``
+        is a per-shard cursor VECTOR (scalar <= 0 means from-now on
+        every shard) — the same shape ``query_logs`` cursor mode takes
+        and ``tail_snapshot`` returns.  Delivered events carry ENCODED
+        ids; resume from ``sub.vector``."""
+        if isinstance(after_id, (list, tuple)):
+            if len(after_id) != self.nshards:
+                raise ValueError(
+                    f"cursor vector has {len(after_id)} entries for "
+                    f"{self.nshards} shards")
+            vec = [int(v) for v in after_id]
+        elif int(after_id) <= 0:
+            vec = [0] * self.nshards
+        else:
+            raise ValueError(
+                "a sharded sink subscribes from a per-shard cursor "
+                "vector (sub.vector), not a scalar id")
+        return ShardedLogSubscription(self, vec, cap)
 
     def age_out(self, now=None) -> int:
         """Run a cold-aging pass on every shard; returns total aged."""

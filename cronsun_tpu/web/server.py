@@ -22,6 +22,7 @@ import dataclasses
 import json
 import re
 import threading
+from collections import OrderedDict
 from http import HTTPStatus
 from http.cookies import SimpleCookie
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -72,13 +73,70 @@ class PlainText(str):
     (the /v1/metrics Prometheus exposition)."""
 
 
+class SseStream:
+    """Handler return type that takes over the transport: the HTTP
+    layer sends ``text/event-stream`` headers and calls ``serve`` on
+    the request thread, which writes events until the client drops,
+    falls behind (terminal ``lost``), or the server drains (final
+    ``bye`` with a long ``retry:``).  Event ``id:`` is the cursor
+    vector — a reconnecting client resumes exactly-once via
+    ``Last-Event-ID``."""
+
+    def __init__(self, manager, client, replay: list):
+        self.manager = manager
+        self.client = client
+        self.replay = replay
+
+    def _event_bytes(self, ev) -> bytes:
+        from .push import event_data_json
+        self.client.advance(ev[0])
+        cursor = ",".join(str(v) for v in self.client.vec)
+        data = event_data_json(ev)
+        return (f"id: {cursor}\nevent: log\ndata: {data}\n\n").encode()
+
+    def serve(self, wfile):
+        c, pm = self.client, self.manager
+        try:
+            wfile.write(b"retry: 3000\n\n")
+            if self.replay:
+                wfile.write(b"".join(
+                    self._event_bytes(ev) for ev in self.replay))
+            wfile.flush()
+            while True:
+                evs, state = c.take(timeout=pm.heartbeat)
+                if evs:
+                    # one syscall per wakeup, not per event: under load
+                    # take() batches, so write count degrades gracefully
+                    wfile.write(b"".join(
+                        self._event_bytes(ev) for ev in evs))
+                if state == "lost":
+                    # terminal: this viewer overflowed (or resumed past
+                    # the replay window) — it re-lists and reconnects
+                    wfile.write(b"event: lost\ndata: {}\n\n")
+                    wfile.flush()
+                    return
+                if state == "closed":
+                    # graceful drain: tell the browser to back off the
+                    # dying replica before the socket closes
+                    wfile.write(b"retry: 30000\nevent: bye\ndata: {}\n\n")
+                    wfile.flush()
+                    return
+                if not evs:
+                    wfile.write(b": hb\n\n")
+                wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            pm.unregister(c)
+
+
 class ApiServer:
     def __init__(self, store: MemStore, sink: JobLogStore,
                  ks: Optional[Keyspace] = None, security=None, alarm=None,
                  auth_enabled: bool = True,
                  host: str = "127.0.0.1", port: int = 7079,
                  cache_enabled: Optional[bool] = None,
-                 slo_engine=None):
+                 slo_engine=None, push_enabled: Optional[bool] = None):
         # auth_enabled=False replicates the reference's Web.Auth.Enabled
         # switch (web/base.go:98: every request passes as an implicit
         # admin; the UI skips login).  Unlike the reference — whose Go
@@ -116,6 +174,23 @@ class ApiServer:
         # in THIS process; None = engine hosted elsewhere (or off) —
         # the /v1/slo surfaces then serve specs without live burn rates
         self.slo_engine = slo_engine
+        # live-push plane (web/push.py): one subscription per logd
+        # shard feeding SSE fan-out and push-driven cache refresh.
+        # CRONSUN_WEB_PUSH=off (or push_enabled=False) is the rollback:
+        # no subscriptions, /v1/stream 503s, poll behavior unchanged.
+        from .push import PushManager, push_default
+        if push_enabled is None:
+            push_enabled = push_default()
+        self._push = None
+        self._push_refreshers: OrderedDict = OrderedDict()
+        self._push_ref_mu = threading.Lock()
+        if push_enabled and hasattr(sink, "subscribe"):
+            try:
+                self._push = PushManager(
+                    sink, on_change=self._push_refresh).start()
+            except Exception as e:  # noqa: BLE001 — degrade to polling
+                log.warnf("live push unavailable: %s", e)
+                self._push = None
         self.routes = self._build_routes()
 
     # ---- bootstrap (web/authentication.go:20-52) -------------------------
@@ -167,6 +242,8 @@ class ApiServer:
         route("PUT", r"/v1/job/(?P<group>[^/]+)-(?P<id>[^/-]+)/execute",
               self.job_execute)
         route("GET", r"/v1/logs", self.log_list)
+        # live event stream (SSE) — the poll loop's push replacement
+        route("GET", r"/v1/stream", self.log_stream)
         route("GET", r"/v1/log/(?P<id>\d+)", self.log_detail)
         route("GET", r"/v1/stat/overall", self.stat_overall)
         route("GET", r"/v1/stat/days", self.stat_days)
@@ -834,7 +911,57 @@ class ApiServer:
             self.cache.bump("misses_total")
         self.cache.bump("shard_reused_total", reused)
         self.cache.bump("shard_recomputed_total", len(shards) - reused)
+        if self._push is not None and self._push.running:
+            # remember how to rebuild this entry: the push refresher
+            # recomputes the changed shard's partial when events land,
+            # so the NEXT poll body-hits instead of scattering.  The
+            # closures capture only request-static filter state (never
+            # ctx), so replaying them off-request is sound.
+            with self._push_ref_mu:
+                self._push_refreshers[key] = (per_shard, merge)
+                self._push_refreshers.move_to_end(key)
+                while len(self._push_refreshers) > 64:
+                    self._push_refreshers.popitem(last=False)
         return body
+
+    def _push_refresh(self) -> bool:
+        """Recompute registered cache entries' CHANGED shard partials
+        from the push-maintained vector (debounced by the manager).
+        Labels are read BEFORE the recompute (the cache's documented
+        soundness direction: a label older than the data can only cause
+        an extra recompute, never a stale hit).  Returns True when any
+        entry was refreshed."""
+        if self.cache is None or self._push is None:
+            return False
+        with self._push_ref_mu:
+            items = list(self._push_refreshers.items())
+        if not items:
+            return False
+        shards = self._sink_shards()
+        vec = self._push.vector()
+        if len(vec) != len(shards):
+            return False
+        did = False
+        for key, (per_shard, merge) in items:
+            ent = self.cache.lookup(key)
+            if ent is None:          # evicted: stop refreshing it
+                with self._push_ref_mu:
+                    self._push_refreshers.pop(key, None)
+                continue
+            revs = list(vec)
+            if ent["revs"] == revs or len(ent["revs"]) != len(revs):
+                continue
+            parts = list(ent["parts"])
+            try:
+                for i, s in enumerate(shards):
+                    if ent["revs"][i] != revs[i]:
+                        parts[i] = per_shard(s, i)
+                body = merge(parts)
+            except Exception:  # noqa: BLE001 — next poll recomputes
+                continue
+            self.cache.store(key, revs, parts, body)
+            did = True
+        return did
 
     def _tenant_scope(self, ctx):
         """Effective tenant filter for the log/stat views: the explicit
@@ -1045,6 +1172,53 @@ class ApiServer:
                 "command": r.command, "output": r.output,
                 "success": r.success, "beginTime": r.begin_ts,
                 "endTime": r.end_ts}
+
+    def log_stream(self, ctx):
+        """``GET /v1/stream`` — live SSE feed of new-record summaries,
+        filtered SERVER-side (tenant pinning is forced exactly like the
+        list endpoints: a pinned account cannot widen its stream by
+        omitting or spoofing ``tenant=``).  ``Last-Event-ID`` (or
+        ``cursor=``) resumes from a prior cursor vector through the
+        PR 7 cursor query — exactly-once across the reconnect.  503
+        when push is off/unavailable: clients fall back to polling."""
+        pm = self._push
+        if pm is None or not pm.running:
+            raise HttpError(
+                503, "live push is disabled on this server "
+                     "(CRONSUN_WEB_PUSH=off or no subscribe support)")
+        _tenant, tids = self._tenant_scope(ctx)
+        job_ids = self._scoped_ids(ctx, tids)
+        filters = {
+            # the tenant scope is a security boundary; the ids filter a
+            # convenience — both resolve to job-id sets evaluated per
+            # event.  frozenset(()) (empty tenant) matches nothing.
+            "tenant_ids": frozenset(tids) if tids is not None else None,
+            "job_ids": frozenset(job_ids) if job_ids is not None
+            else None,
+            "node": ctx.q("node") or None,
+            "failed_only": ctx.q("failedOnly") in ("true", "1"),
+        }
+        cursor_raw = ctx.header("Last-Event-ID") or ctx.q("cursor")
+        client = pm.register(filters)
+        replay: list = []
+        if cursor_raw:
+            try:
+                vec = [int(v) for v in cursor_raw.split(",")]
+            except ValueError:
+                pm.unregister(client)
+                raise HttpError(400, f"bad cursor {cursor_raw!r}")
+            if len(vec) != pm.nshards:
+                pm.unregister(client)
+                raise HttpError(
+                    400, f"cursor has {len(vec)} entries; this sink "
+                         f"has {pm.nshards} shard(s)")
+            try:
+                replay = pm.replay(client, vec)
+            except (ValueError, TypeError) as e:
+                pm.unregister(client)
+                raise HttpError(400, str(e))
+            client.vec = list(vec) if pm.nshards > 1 else [vec[0]]
+        return SseStream(pm, client, replay)
 
     def log_detail(self, ctx):
         rec = self.sink.get_log(int(ctx.path_args["id"]))
@@ -1509,6 +1683,14 @@ class ApiServer:
 
         check("store", store_ok)
         check("logsink", sink_ok)
+        if self._push is not None:
+            # a dead shard subscription is a NAMED failing check, not
+            # silent staleness: the stream (and push-refreshed cache)
+            # for that shard is stale until the loop resubscribes, and
+            # the operator's rollback is CRONSUN_WEB_PUSH=off
+            for si, (ok_, detail) in enumerate(self._push.health()):
+                checks[f"push_shard_{si}"] = {"ok": bool(ok_),
+                                              "detail": detail}
         # INFORMATIONAL: a leaderless scheduler partition is surfaced
         # here (and on /v1/sched, metrics, and the schedulers' own
         # health ports) but must NOT 503 the web tier — everything
@@ -1619,6 +1801,14 @@ class ApiServer:
             for field, val in sorted(self.cache.snapshot().items()):
                 name = f"cronsun_web_cache_{field}"
                 lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {val}")
+        if self._push is not None:
+            # live-push observability: viewer count, fan-out volume,
+            # slow-consumer drops, resumes (this web server's own)
+            for field, val in sorted(self._push.stats().items()):
+                name = f"cronsun_web_sse_{field}"
+                kind = "counter" if field.endswith("_total") else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {val}")
         seen_types: set = set()
         sched_snaps: list = []    # partitioned-plane aggregation input
@@ -1926,6 +2116,20 @@ class ApiServer:
                     result, ctx = server.handle(method, parsed.path, query,
                                                 body, cookies,
                                                 dict(self.headers))
+                    if isinstance(result, SseStream):
+                        # streaming escape hatch: no Content-Length —
+                        # this request thread becomes the SSE writer
+                        # until the viewer drops or the server drains
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/event-stream")
+                        self.send_header("Cache-Control", "no-cache")
+                        self.send_header("X-Accel-Buffering", "no")
+                        for k, v in ctx.out_headers.items():
+                            self.send_header(k, v)
+                        self.end_headers()
+                        result.serve(self.wfile)
+                        return
                     if isinstance(result, PlainText):
                         payload = result.encode()
                         ctype = "text/plain; version=0.0.4"
@@ -1975,6 +2179,11 @@ class ApiServer:
         return self
 
     def stop(self):
+        # drain SSE viewers FIRST (final bye + long retry:, bounded
+        # wait) so their writer threads close cleanly instead of dying
+        # mid-write when the listener goes away
+        if self._push is not None:
+            self._push.stop(drain_timeout=2.0)
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -33,6 +33,7 @@
 #include <algorithm>
 #include <cerrno>
 #include <chrono>
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
@@ -282,6 +283,28 @@ struct Seg {
   long long min_id = 0, max_id = 0, count = 0;
 };
 
+// ---------------------------------------------------------------------------
+// change-stream subscribers (the `subscribe` wire op — the bit-twin of
+// logsink/joblog.py's LogSubscription): a bounded lossy per-connection
+// queue of pre-serialized event summaries.  Overflow drops EVERYTHING
+// and latches `lost` — the store's watch semantics; the consumer
+// re-lists and re-subscribes.  Each subscription owns a dup of the
+// connection's fd plus a pusher thread that writes frames under the
+// connection's shared write mutex, so pushes interleave with replies
+// at line granularity.
+// ---------------------------------------------------------------------------
+
+struct Subscriber {
+  long long sid = 0;                 // the subscribe request's rid
+  int fd = -1;                       // dup'd conn fd (pusher closes it)
+  std::shared_ptr<std::mutex> wmu;   // the connection's write mutex
+  size_t cap = 4096;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> buf;       // serialized "[id,...]" event bodies
+  bool lost = false, closed = false;
+};
+
 class LogStore {
  public:
   explicit LogStore(size_t retain, size_t hot_days = 0)
@@ -301,6 +324,11 @@ class LogStore {
       std::string line;
       wal_create(line, r);
       wal_->append(line);
+    }
+    if (!subs_.empty()) {
+      std::vector<std::string> evs(1);
+      sub_event_json(evs[0], r);
+      sub_emit_locked(evs);
     }
     if (!idem.empty()) {
       idem_[idem] = r.id;
@@ -339,10 +367,15 @@ class LogStore {
       std::string block;
       std::map<std::pair<std::string, std::string>, Rec> last;
       std::map<std::string, Stat> deltas;
+      std::vector<std::string> evs;
       Stat overall;
       for (Rec r : recs) {
         r.id = next_id_++;
         recs_.push_back(r);
+        if (!subs_.empty()) {
+          evs.emplace_back();
+          sub_event_json(evs.back(), r);
+        }
         Stat& d = deltas[day_of(r.begin)];
         d.total++;
         (r.success ? d.ok : d.fail)++;
@@ -372,6 +405,7 @@ class LogStore {
       // (the Python backend counts inside create_job_logs the same
       // way — the serve-layer dedup skips the thunk)
       op_count("log_records", (long long)recs.size());
+      sub_emit_locked(evs);
       if (!idem.empty()) {
         idem_[idem] = first;
         idem_fifo_.push_back(idem);
@@ -388,6 +422,102 @@ class LogStore {
     }
     res += ']';
     return true;
+  }
+
+  // -- change stream (the store watch plane, result-plane edition) -------
+
+  // Event summary: the wire twin of joblog.sub_event — 8 fields, the
+  // heavy payload (user/command/output) stays behind get_log.
+  static void sub_event_json(std::string& out, const Rec& r) {
+    out += '[';
+    jint(out, r.id);
+    out += ',';
+    jesc(out, r.job_id);
+    out += ',';
+    jesc(out, r.group);
+    out += ',';
+    jesc(out, r.name);
+    out += ',';
+    jesc(out, r.node);
+    out += r.success ? ",true," : ",false,";
+    jdbl(out, r.begin);
+    out += ',';
+    jdbl(out, r.end);
+    out += ']';
+  }
+
+  // Open a change stream.  Revision snapshot, replay, and registration
+  // happen in ONE mu hold, so no record lands between the snapshot and
+  // the first pushed event.  Replay comes only from the contiguous hot
+  // deque (get_log's id-indexing invariant); a resume below its floor —
+  // retention-dropped or cold-aged — acks lost:true and the consumer
+  // re-lists.  The ack JSON lands in `res`; the caller must SEND it
+  // before starting the pusher (frames never precede the ack).
+  std::shared_ptr<Subscriber> subscribe(long long sid, long long after_id,
+                                        long long cap, int fd,
+                                        std::shared_ptr<std::mutex> wmu,
+                                        std::string& res) {
+    auto s = std::make_shared<Subscriber>();
+    s->sid = sid;
+    s->fd = fd;
+    s->wmu = std::move(wmu);
+    if (cap > 0) s->cap = (size_t)cap;
+    std::lock_guard<std::mutex> g(mu);
+    long long rev = next_id_ - 1;
+    bool gap = false;
+    if (after_id > 0 && after_id < rev) {
+      if (!recs_.empty() && recs_.front().id <= after_id + 1) {
+        size_t start = (size_t)(after_id + 1 - recs_.front().id);
+        for (size_t i = start; i < recs_.size(); i++) {
+          s->buf.emplace_back();
+          sub_event_json(s->buf.back(), recs_[i]);
+        }
+        if (s->buf.size() > s->cap) {  // replay alone overflows: stream
+          s->buf.clear();              // is born lost (python parity)
+          s->lost = true;
+        }
+      } else {
+        gap = true;
+      }
+    }
+    subs_.push_back(s);
+    res += "{\"rev\":";
+    jint(res, rev);
+    res += gap ? ",\"lost\":true}" : ",\"lost\":false}";
+    return s;
+  }
+
+  void unsubscribe_sub(const std::shared_ptr<Subscriber>& s) {
+    std::lock_guard<std::mutex> g(mu);
+    subs_.erase(std::remove(subs_.begin(), subs_.end(), s), subs_.end());
+  }
+
+  // called under mu by the create paths
+  void sub_emit_locked(const std::vector<std::string>& evs) {
+    if (subs_.empty() || evs.empty()) return;
+    op_count("sub_events", (long long)(evs.size() * subs_.size()));
+    bool prune = false;
+    for (auto& s : subs_) {
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (s->lost || s->closed) {
+        prune = true;
+        continue;
+      }
+      if (s->buf.size() + evs.size() > s->cap) {
+        s->buf.clear();  // watch semantics: drop ALL buffered + latch
+        s->lost = true;
+      } else {
+        for (const auto& e : evs) s->buf.push_back(e);
+      }
+      s->cv.notify_all();
+    }
+    if (prune)
+      subs_.erase(std::remove_if(subs_.begin(), subs_.end(),
+                                 [](const std::shared_ptr<Subscriber>& s) {
+                                   std::lock_guard<std::mutex> lk(s->mu);
+                                   return s->closed;
+                                 }),
+                  subs_.end());
   }
 
   // -- trace plane (fire-lifecycle spans) --------------------------------
@@ -1803,6 +1933,7 @@ class LogStore {
   long long next_id_ = 1;
   long long snapshot_watermark_ = 0;
   std::deque<Rec> recs_;
+  std::vector<std::shared_ptr<Subscriber>> subs_;  // live change streams
   std::map<std::pair<std::string, std::string>, Rec> latest_;
   // serialized-reply memo for the latest view, keyed on the request's
   // canonical filter string -> (revision, marshalled reply).  Guarded
@@ -1828,8 +1959,66 @@ class LogStore {
 };
 
 // ---------------------------------------------------------------------------
-// connections: request/response only (no pushes) — one thread per conn
+// connections: request/response, plus subscription push frames — one
+// reader thread per conn, one pusher thread per live subscription, all
+// writes serialized by the connection's shared write mutex
 // ---------------------------------------------------------------------------
+
+// Per-subscription pusher: waits for buffered events, serializes
+// {"s":sid,"evs":[...]} frames (2048-event chunks, serve.py's bound)
+// and writes them under the connection's write mutex.  On overflow it
+// sends the terminal {"s":sid,"lost":true} frame and exits — the
+// subscription is dead, the client re-lists and re-subscribes.
+static void sub_pusher(std::shared_ptr<Subscriber> s, LogStore* store) {
+  while (true) {
+    std::vector<std::string> evs;
+    bool lost = false;
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [&] { return s->closed || s->lost || !s->buf.empty(); });
+      if (s->closed) break;
+      lost = s->lost;
+      if (!lost) {
+        evs.assign(s->buf.begin(), s->buf.end());
+        s->buf.clear();
+      }
+    }
+    std::string frame;
+    if (lost) {
+      frame = "{\"s\":" + std::to_string(s->sid) + ",\"lost\":true}\n";
+    } else {
+      size_t i = 0;
+      while (i < evs.size()) {
+        size_t n = std::min(evs.size() - i, (size_t)2048);
+        frame += "{\"s\":" + std::to_string(s->sid) + ",\"evs\":[";
+        for (size_t k = 0; k < n; k++) {
+          if (k) frame += ',';
+          frame += evs[i + k];
+        }
+        frame += "]}\n";
+        i += n;
+      }
+    }
+    bool ok = true;
+    {
+      std::lock_guard<std::mutex> wl(*s->wmu);
+      size_t off = 0;
+      while (off < frame.size()) {
+        ssize_t w =
+            ::send(s->fd, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+        if (w <= 0) { ok = false; break; }
+        off += (size_t)w;
+      }
+    }
+    if (lost || !ok) {
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->closed = true;
+      break;
+    }
+  }
+  store->unsubscribe_sub(s);
+  ::close(s->fd);  // our dup — the reader's fd stays live
+}
 
 static std::string g_token;
 
@@ -1842,7 +2031,10 @@ static bool arg_b(const JV& a, size_t i) {
 }
 
 static void handle(LogStore& store, const std::string& line, bool& authed,
-                   std::string& out) {
+                   std::string& out, int fd,
+                   const std::shared_ptr<std::mutex>& wmu,
+                   std::vector<std::shared_ptr<Subscriber>>& conn_subs,
+                   std::shared_ptr<Subscriber>& pending_sub) {
   long long rid = 0;
   std::string op;
   JV args;
@@ -1899,6 +2091,34 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
     if (!store.get_log(id, res)) res = "null";
   } else if (op == "revision") {
     jint(res, store.revision());
+  } else if (op == "subscribe") {
+    long long after = args.arr.empty() ? 0 : args.arr[0].as_int();
+    long long cap = args.arr.size() > 1 ? args.arr[1].as_int() : 4096;
+    int sfd = ::dup(fd);
+    if (sfd < 0) {
+      out += ",\"e\":\"subscribe: dup failed\"}\n";
+      return;
+    }
+    // registered (buffering) now; the caller sends the ack in `out`
+    // FIRST and only then starts the pusher — frames never precede it
+    pending_sub = store.subscribe(rid, after, cap, sfd, wmu, res);
+  } else if (op == "unsubscribe") {
+    long long sid = args.arr.empty() ? -1 : args.arr[0].as_int();
+    bool found = false;
+    for (auto& s : conn_subs) {
+      if (s->sid != sid) continue;
+      found = true;
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->closed = true;  // pusher exits and closes its dup fd
+      s->cv.notify_all();
+    }
+    conn_subs.erase(
+        std::remove_if(conn_subs.begin(), conn_subs.end(),
+                       [&](const std::shared_ptr<Subscriber>& s) {
+                         return s->sid == sid;
+                       }),
+        conn_subs.end());
+    res = found ? "true" : "false";
   } else if (op == "tail_snapshot") {
     store.tail_snapshot(args.arr.empty() ? 0 : args.arr[0].as_int(), res);
   } else if (op == "age_out") {
@@ -1961,6 +2181,8 @@ static void handle(LogStore& store, const std::string& line, bool& authed,
 
 static void serve_conn(int fd, LogStore* store) {
   bool authed = g_token.empty();
+  auto wmu = std::make_shared<std::mutex>();       // serializes ALL writes
+  std::vector<std::shared_ptr<Subscriber>> subs;   // this conn's streams
   std::string buf;
   char chunk[65536];
   while (true) {
@@ -1973,24 +2195,46 @@ static void serve_conn(int fd, LogStore* store) {
       size_t nl = buf.find('\n', start);
       if (nl == std::string::npos) break;
       std::string out;
-      handle(*store, buf.substr(start, nl - start), authed, out);
+      std::shared_ptr<Subscriber> pending;
+      handle(*store, buf.substr(start, nl - start), authed, out, fd, wmu,
+             subs, pending);
       start = nl + 1;
       if (out.empty()) { closing = true; break; }   // protocol violation
       if (!out.empty() && out.back() == '\0') {     // auth refusal
         out.pop_back();
         closing = true;
       }
-      size_t off = 0;
-      while (off < out.size()) {
-        ssize_t w = ::send(fd, out.data() + off, out.size() - off,
-                           MSG_NOSIGNAL);
-        if (w <= 0) { closing = true; break; }
-        off += (size_t)w;
+      {
+        std::lock_guard<std::mutex> wl(*wmu);
+        size_t off = 0;
+        while (off < out.size()) {
+          ssize_t w = ::send(fd, out.data() + off, out.size() - off,
+                             MSG_NOSIGNAL);
+          if (w <= 0) { closing = true; break; }
+          off += (size_t)w;
+        }
+      }
+      if (pending) {
+        if (closing) {  // ack never made it: tear down, nobody else will
+          store->unsubscribe_sub(pending);
+          ::close(pending->fd);
+        } else {        // ack is on the wire — frames may now follow
+          subs.push_back(pending);
+          std::thread(sub_pusher, pending, store).detach();
+        }
       }
       if (closing) break;
     }
     if (closing) break;
     if (start) buf.erase(0, start);
+  }
+  // sever this conn's streams: pushers wake on closed, unregister, and
+  // close their dup'd fds; ours closes now (peer sees FIN once the
+  // last dup goes)
+  for (auto& s : subs) {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->closed = true;
+    s->cv.notify_all();
   }
   ::close(fd);
 }

@@ -1,0 +1,488 @@
+"""Push-plane benchmark: M concurrent SSE viewers against live ingest.
+
+Drives a real ApiServer (HTTP, push enabled) over native/py logd
+shards, connects ``--viewers`` SSE clients to ``/v1/stream`` (raw
+sockets, one selector thread — the driver must stay cheaper than the
+plane it measures), and paces a writer subprocess at ``--write-rate``
+records/s.  Measured:
+
+- **publish lag** p50/p99 — record ``begin_ts`` (stamped at create) to
+  client receipt, parsed from the SSE ``data:`` JSON on a sampled
+  subset of viewers (parsing every event on every viewer would measure
+  the driver's json.loads, not the plane)
+- **connection ceiling** — viewers that completed the SSE handshake
+  and were still streaming at window end (evictions show up here AND
+  in ``sse_dropped_slow``)
+- **bytes per viewer per second** — the fan-out wire cost
+- **logd read ops** — op-counter delta over the push window vs the
+  SAME freshness served by polling: a second poll phase (push
+  disabled, response cache on, ``--poll-interval`` freshness) measures
+  reads-per-viewer-second, extrapolated to M viewers for the ratio the
+  slow gate asserts (push issues >= 10x fewer logd reads)
+
+    python scripts/bench_push.py [--viewers M] [--seconds S]
+        [--write-rate R] [--logd-shards N] [--poll-viewers P]
+        [--poll-interval F] [--json out.json]
+
+Backend: native logd when the binary exists, BENCH_LOGD=py forces the
+Python/SQLite server.  Run standalone or via bench.py (which merges
+``push_plane_*`` into bench_detail.json).
+"""
+
+import argparse
+import json
+import os
+import selectors
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ops that are NOT dashboard reads: ingest, the push plane itself, and
+# maintenance.  Reads = everything else — robust across the native and
+# python backends' differing op names, and applied identically to both
+# phases so the ratio stays apples-to-apples.
+_NONREAD_OPS = ("create_job_log", "create_job_logs", "log_records",
+                "subscribe", "unsubscribe", "sub_events", "age_out",
+                "aged_records", "auth", "trace_ingest", "trace_get")
+
+
+def _pctl(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _read_ops(dops):
+    return sum(v for k, v in dops.items()
+               if v > 0 and k not in _NONREAD_OPS)
+
+
+def _raise_nofile(need):
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(hard, max(soft, need))
+        if want > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except Exception:  # noqa: BLE001 — best-effort; connect errors count
+        pass
+
+
+class _SseViewer:
+    __slots__ = ("sock", "buf", "sampled", "connected", "streaming",
+                 "bytes", "events", "lost")
+
+    def __init__(self, sock, sampled):
+        self.sock = sock
+        self.buf = b""
+        self.sampled = sampled
+        self.connected = False   # saw HTTP 200 + header terminator
+        self.streaming = True
+        self.bytes = 0
+        self.events = 0
+        self.lost = False
+
+
+def run_push_bench(viewers=200, seconds=6.0, write_rate=50,
+                   logd_shards=1, poll_viewers=8, poll_interval=1.0,
+                   sample=64, on_log=print):
+    from cronsun_tpu.logsink import LogRecord
+    from cronsun_tpu.logsink.native import find_binary as find_logd
+    from cronsun_tpu.logsink.native import NativeLogSinkServer
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
+    from cronsun_tpu.store.memstore import MemStore
+    from cronsun_tpu.web.server import ApiServer, NotModified
+    from bench_dispatch import _PyLogShardServer  # noqa: E402 — same dir
+
+    viewers = max(1, viewers)
+    logd_shards = max(1, logd_shards)
+    _raise_nofile(2 * viewers + 512)
+    logd_bin = (None if os.environ.get("BENCH_LOGD") == "py"
+                else find_logd())
+    backend = ("native-logd" if logd_bin else "py-logd") + (
+        f"x{logd_shards}-shards" if logd_shards > 1 else "")
+    tmpdir = tempfile.mkdtemp(prefix="bench_push_")
+    logds, socks = [], []
+    sink = web = web_poll = wproc = None
+    try:
+        for si in range(logd_shards):
+            if logd_bin:
+                logds.append(NativeLogSinkServer(
+                    binary=logd_bin,
+                    db=os.path.join(tmpdir, f"p{si}.wal")))
+            else:
+                logds.append(_PyLogShardServer(
+                    ("--db", os.path.join(tmpdir, f"p{si}.db"))))
+        addrs = [f"{l.host}:{l.port}" for l in logds]
+        sink = connect_sharded_sink(addrs)
+        seed = [LogRecord(job_id=f"pj{i % 16}", job_group="p",
+                          name=f"push-bench-{i % 16}", node=f"pn{i % 4}",
+                          user="", command="true", output="seed",
+                          success=True, begin_ts=time.time(),
+                          end_ts=time.time()) for i in range(200)]
+        sink.create_job_logs(seed)
+
+        web = ApiServer(MemStore(), sink, auth_enabled=False,
+                        cache_enabled=True, port=0,
+                        push_enabled=True).start()
+        if web._push is None or not web._push.running:
+            raise RuntimeError("push plane failed to start "
+                               "(backend lacks subscribe?)")
+        on_log(f"web up on :{web.port} ({backend}); "
+               f"connecting {viewers} SSE viewers")
+
+        # ---- connect ramp (sequential: a clean ceiling count) ----
+        req = (f"GET /v1/stream HTTP/1.1\r\nHost: {web.host}\r\n"
+               f"Accept: text/event-stream\r\n\r\n").encode()
+        vs = []
+        sel = selectors.DefaultSelector()
+        connect_errs = 0
+        for k in range(viewers):
+            try:
+                s = socket.create_connection((web.host, web.port),
+                                             timeout=5.0)
+                s.sendall(req)
+                s.setblocking(False)
+            except OSError:
+                connect_errs += 1
+                continue
+            v = _SseViewer(s, sampled=k < sample)
+            vs.append(v)
+            socks.append(s)
+            sel.register(s, selectors.EVENT_READ, v)
+            if k % 100 == 99:
+                time.sleep(0.01)   # let the accept loop breathe
+
+        lags = []
+        llock = threading.Lock()
+        stop = threading.Event()
+
+        def pump():
+            now = time.time
+            while not stop.is_set():
+                for key, _ in sel.select(timeout=0.25):
+                    v = key.data
+                    try:
+                        chunk = v.sock.recv(65536)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        chunk = b""
+                    if not chunk:
+                        v.streaming = False
+                        sel.unregister(v.sock)
+                        continue
+                    v.bytes += len(chunk)
+                    if not v.connected:
+                        v.buf += chunk
+                        i = v.buf.find(b"\r\n\r\n")
+                        if i < 0:
+                            continue
+                        v.connected = v.buf.startswith(b"HTTP/1.") and \
+                            b" 200 " in v.buf[:32]
+                        chunk, v.buf = v.buf[i + 4:], b""
+                    if v.sampled:
+                        v.buf += chunk
+                        t = now()
+                        while True:
+                            j = v.buf.find(b"\n\n")
+                            if j < 0:
+                                break
+                            frame, v.buf = v.buf[:j], v.buf[j + 2:]
+                            if b"event: log" not in frame:
+                                if b"event: lost" in frame:
+                                    v.lost = True
+                                continue
+                            v.events += 1
+                            d = frame.find(b"data: ")
+                            if d < 0:
+                                continue
+                            try:
+                                ev = json.loads(
+                                    frame[d + 6:].split(b"\n", 1)[0])
+                                with llock:
+                                    lags.append(
+                                        (t - ev["beginTime"]) * 1000.0)
+                            except (ValueError, KeyError, TypeError):
+                                pass
+                    else:
+                        v.events += chunk.count(b"event: log")
+                        if b"event: lost" in chunk:
+                            v.lost = True
+
+        pt = threading.Thread(target=pump, daemon=True, name="sse-pump")
+        pt.start()
+        deadline = time.time() + 3.0
+        while (time.time() < deadline
+               and sum(1 for v in vs if v.connected) < len(vs)):
+            time.sleep(0.05)
+        n_conn = sum(1 for v in vs if v.connected)
+        on_log(f"{n_conn}/{viewers} viewers streaming "
+               f"({connect_errs} connect errors)")
+
+        def ops_counts():
+            try:
+                return {k: x["count"] for k, x in sink.op_stats().items()}
+            except Exception:  # noqa: BLE001 — older server
+                return {}
+
+        # ---- measured push window (ingest via its own process: the
+        # driver's selector loop is GIL-hungry enough that an in-driver
+        # writer would pace itself, not the plane) ----
+        ops0 = ops_counts()
+        wproc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--writer-mode",
+             "--writer-addrs", ",".join(addrs),
+             "--write-rate", str(write_rate)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        wrote = [0]
+
+        def writer_counts():
+            for line in wproc.stdout:
+                parts = line.split()
+                if len(parts) == 2 and parts[0] == "W":
+                    wrote[0] = int(parts[1])
+        wt = threading.Thread(target=writer_counts, daemon=True)
+        wt.start()
+        t0 = time.time()
+        time.sleep(seconds)
+        elapsed = time.time() - t0
+        wrote_window = wrote[0]   # the writer keeps driving the poll
+        ops1 = ops_counts()       # phase; this metric is window-only
+        push_stats = web._push.stats()
+        alive = sum(1 for v in vs if v.connected and v.streaming
+                    and not v.lost)
+        total_bytes = sum(v.bytes for v in vs)
+        total_events = sum(v.events for v in vs)
+        # window cost only: subtract the handshake-time snapshot noise
+        # by measuring ops strictly inside [ops0, ops1]
+        push_dops = {k: ops1.get(k, 0) - ops0.get(k, 0)
+                     for k in set(ops0) | set(ops1)}
+        push_reads = _read_ops(push_dops)
+
+        # ---- teardown viewers before the poll phase ----
+        stop.set()
+        pt.join(timeout=10)
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        with llock:
+            lag_list = list(lags)
+
+        # ---- poll baseline at the same freshness: a push-disabled
+        # ApiServer over the SAME sink (in-process dispatch — no HTTP
+        # socket cost in the poll numbers), P pollers carrying
+        # If-None-Match at --poll-interval, writer still running ----
+        prev = os.environ.get("CRONSUN_WEB_PUSH")
+        os.environ["CRONSUN_WEB_PUSH"] = "off"
+        try:
+            web_poll = ApiServer(MemStore(), sink, auth_enabled=False,
+                                 cache_enabled=True)
+        finally:
+            if prev is None:
+                os.environ.pop("CRONSUN_WEB_PUSH", None)
+            else:
+                os.environ["CRONSUN_WEB_PUSH"] = prev
+        poll_secs = min(seconds, 4.0)
+        pstop = threading.Event()
+        pcounts = {"polls": 0, "nm": 0, "bytes": 0, "errors": 0}
+        plock = threading.Lock()
+
+        def poller(k):
+            etag = None
+            q = {"latest": "true", "pageSize": "500"}
+            time.sleep((k / max(1, poll_viewers)) * poll_interval)
+            while not pstop.is_set():
+                hdr = {"If-None-Match": etag} if etag else {}
+                try:
+                    r, ctx = web_poll.handle("GET", "/v1/logs", q, b"",
+                                             {}, hdr)
+                    etag = ctx.out_headers.get("ETag", etag)
+                    body = len(json.dumps(r, separators=(",", ":")))
+                    with plock:
+                        pcounts["polls"] += 1
+                        pcounts["bytes"] += body + 150
+                except NotModified:
+                    with plock:
+                        pcounts["polls"] += 1
+                        pcounts["nm"] += 1
+                        pcounts["bytes"] += 150
+                except Exception:  # noqa: BLE001 — counted
+                    with plock:
+                        pcounts["errors"] += 1
+                pstop.wait(poll_interval)
+
+        ops2 = ops_counts()
+        pts = [threading.Thread(target=poller, args=(k,), daemon=True)
+               for k in range(max(1, poll_viewers))]
+        pt0 = time.time()
+        for t in pts:
+            t.start()
+        time.sleep(poll_secs)
+        pstop.set()
+        for t in pts:
+            t.join(timeout=10)
+        poll_elapsed = time.time() - pt0
+        ops3 = ops_counts()
+        wproc.terminate()
+        try:
+            wproc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            wproc.kill()
+        poll_dops = {k: ops3.get(k, 0) - ops2.get(k, 0)
+                     for k in set(ops2) | set(ops3)}
+        poll_reads = _read_ops(poll_dops)
+
+        pv = max(1, poll_viewers)
+        push_rps = push_reads / max(1, n_conn) / elapsed
+        poll_rps = poll_reads / pv / poll_elapsed
+        # the gate's number: poll reads extrapolated to the SAME viewer
+        # fleet over the push window, vs what push actually issued
+        poll_equiv = poll_rps * max(1, n_conn) * elapsed
+        ratio = poll_equiv / max(1.0, float(push_reads))
+        res = {
+            "push_plane_backend": backend,
+            "push_plane_logd_shards": logd_shards,
+            "push_plane_viewers": viewers,
+            "push_plane_viewers_connected": n_conn,
+            "push_plane_viewers_alive_at_end": alive,
+            "push_plane_connect_errors": connect_errs,
+            "push_plane_seconds": round(elapsed, 2),
+            "push_plane_write_rate_target": write_rate,
+            "push_plane_write_records_per_s": round(
+                wrote_window / elapsed, 1),
+            "push_plane_publish_lag_p50_ms": round(_pctl(lag_list, 0.50), 2),
+            "push_plane_publish_lag_p99_ms": round(_pctl(lag_list, 0.99), 2),
+            "push_plane_lag_samples": len(lag_list),
+            "push_plane_events_per_viewer_s": round(
+                total_events / max(1, n_conn) / elapsed, 1),
+            "push_plane_bytes_per_viewer_s": round(
+                total_bytes / max(1, n_conn) / elapsed, 1),
+            "push_plane_sse_events_total": push_stats.get("events_total", 0),
+            "push_plane_sse_dropped_slow": push_stats.get(
+                "dropped_slow_total", 0),
+            "push_plane_read_ops": push_reads,
+            "push_plane_read_ops_per_viewer_s": round(push_rps, 4),
+            "push_plane_poll_viewers": pv,
+            "push_plane_poll_interval_s": poll_interval,
+            "push_plane_poll_read_ops": poll_reads,
+            "push_plane_poll_read_ops_per_viewer_s": round(poll_rps, 4),
+            "push_plane_poll_304_rate": round(
+                pcounts["nm"] / max(1, pcounts["polls"]), 3),
+            "push_plane_poll_bytes_per_viewer_s": round(
+                pcounts["bytes"] / pv / poll_elapsed, 1),
+            "push_plane_poll_errors": pcounts["errors"],
+            "push_plane_read_op_ratio": round(ratio, 1),
+        }
+        on_log(f"viewers={n_conn} lag p50={res['push_plane_publish_lag_p50_ms']}ms "
+               f"p99={res['push_plane_publish_lag_p99_ms']}ms "
+               f"bytes/viewer/s={res['push_plane_bytes_per_viewer_s']} "
+               f"reads push={push_reads} poll~{round(poll_equiv)} "
+               f"(ratio {res['push_plane_read_op_ratio']}x)")
+        return res
+    finally:
+        if wproc is not None and wproc.poll() is None:
+            wproc.kill()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for w in (web, web_poll):
+            if w is not None:
+                try:
+                    w.stop()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for l in logds:
+            try:
+                l.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def writer_main(addrs: str, write_rate: int) -> int:
+    """Paced ingest as its own process: ``write_rate`` records/s in
+    10 Hz beats, ``begin_ts`` stamped at creation (the publish-lag
+    clock source), reporting "W <wrote>" per beat."""
+    from cronsun_tpu.logsink import LogRecord
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
+    sink = connect_sharded_sink(addrs.split(","))
+    rate = max(1, write_rate)
+    wrote = 0
+    t_start = time.time()
+    while True:
+        target = int((time.time() - t_start) * rate)
+        n = target - wrote
+        if n <= 0:
+            time.sleep(0.02)
+            continue
+        t = time.time()
+        batch = [LogRecord(job_id=f"pj{(wrote + k) % 16}", job_group="p",
+                           name=f"push-bench-{(wrote + k) % 16}",
+                           node=f"pn{(wrote + k) % 4}", user="",
+                           command="true", output="bench",
+                           success=(wrote + k) % 7 != 0,
+                           begin_ts=t, end_ts=t)
+                 for k in range(min(n, 500))]
+        try:
+            sink.create_job_logs(batch)
+            wrote += len(batch)
+        except Exception:  # noqa: BLE001 — keep driving
+            time.sleep(0.1)
+        print(f"W {wrote}", flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--viewers", type=int, default=200)
+    ap.add_argument("--seconds", type=float, default=6.0)
+    ap.add_argument("--write-rate", type=int, default=50,
+                    help="paced ingest records/s during the window")
+    ap.add_argument("--logd-shards", type=int, default=1)
+    ap.add_argument("--poll-viewers", type=int, default=8,
+                    help="pollers in the comparison phase (rate is "
+                         "extrapolated to --viewers for the ratio)")
+    ap.add_argument("--poll-interval", type=float, default=1.0,
+                    help="poll freshness the ratio compares against")
+    ap.add_argument("--json", default=None)
+    # internal: the ingest subprocess (run_push_bench spawns it)
+    ap.add_argument("--writer-mode", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--writer-addrs", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.writer_mode:
+        return writer_main(args.writer_addrs, args.write_rate)
+    on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    res = run_push_bench(viewers=args.viewers, seconds=args.seconds,
+                         write_rate=args.write_rate,
+                         logd_shards=args.logd_shards,
+                         poll_viewers=args.poll_viewers,
+                         poll_interval=args.poll_interval,
+                         on_log=on_log)
+    out = json.dumps(res, indent=1)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -343,3 +343,25 @@ def test_bench_query_smoke():
         assert res[f"query_plane_{shape}_qps"] > 0, (
             f"no {shape} queries completed")
     assert res["query_plane_write_records_per_s"] > 0
+
+
+def test_bench_push_smoke():
+    """Tier-1 smoke for the push-plane bench: a short run with a small
+    SSE fleet against one py-logd shard must connect every viewer,
+    deliver pushed events (nonzero lag samples), and complete the poll
+    comparison without errors — the live-push path stays runnable end
+    to end over the real wire."""
+    os.environ["BENCH_LOGD"] = "py"
+    try:
+        import bench_push
+        res = bench_push.run_push_bench(
+            viewers=20, seconds=1.5, write_rate=50, poll_viewers=3,
+            on_log=lambda *a: print(*a, file=sys.stderr))
+    finally:
+        os.environ.pop("BENCH_LOGD", None)
+    assert res["push_plane_viewers_connected"] == 20
+    assert res["push_plane_connect_errors"] == 0
+    assert res["push_plane_lag_samples"] > 0
+    assert res["push_plane_events_per_viewer_s"] > 0
+    assert res["push_plane_poll_errors"] == 0
+    assert res["push_plane_publish_lag_p99_ms"] > 0

@@ -1,0 +1,572 @@
+"""Live-push plane: logd ``subscribe`` change streams (python, wire,
+native — one conformance suite), the sharded merge, and the web tier's
+SSE fan-out (/v1/stream): push-after-connect with ZERO logd reads,
+Last-Event-ID resume, tenant isolation, slow-consumer eviction, the
+push-refreshed cache's byte-parity with polling, and the
+CRONSUN_WEB_PUSH=off rollback."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from cronsun_tpu.logsink import (JobLogStore, LogRecord, LogSinkServer,
+                                 RemoteJobLogStore)
+from cronsun_tpu.logsink.joblog import SubscriptionLost
+from cronsun_tpu.logsink.native import NativeLogSinkServer, find_binary
+from cronsun_tpu.logsink.sharded import ShardedJobLogStore, decode_log_id
+from cronsun_tpu.core import Keyspace
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.web.server import ApiServer
+
+KS = Keyspace()
+
+
+def _rec(job="j1", node="n1", ok=True, begin=1000.0, **kw):
+    d = dict(job_id=job, job_group="g", name=f"name-{job}", node=node,
+             user="", command="echo hi", output="out", success=ok,
+             begin_ts=begin, end_ts=begin + 2)
+    d.update(kw)
+    return LogRecord(**d)
+
+
+@pytest.fixture(params=["local", "remote", "native"])
+def sink(request):
+    if request.param == "local":
+        s = JobLogStore()
+        yield s
+        s.close()
+        return
+    if request.param == "native":
+        binary = find_binary()
+        if binary is None:
+            pytest.skip("native logd binary unavailable")
+        srv = NativeLogSinkServer(binary=binary)
+    else:
+        srv = LogSinkServer().start()
+    c = RemoteJobLogStore(srv.host, srv.port)
+    yield c
+    c.close()
+    srv.stop()
+
+
+# ------------------------------------------------------- subscribe op
+
+
+def test_subscribe_streams_new_records(sink):
+    """Events arrive on a live subscription as 8-field summaries whose
+    id IS the record id — no polling between create and delivery."""
+    r0 = _rec(job="pre")
+    sink.create_job_log(r0)
+    sub = sink.subscribe()
+    assert sub.rev >= r0.id and not sub.gap
+    try:
+        r1 = _rec(job="live", node="n9", ok=False, begin=2000.0)
+        sink.create_job_log(r1)
+        evs = sub.get(timeout=5.0)
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev[0] == r1.id
+        assert (ev[1], ev[2], ev[3], ev[4]) == ("live", "g",
+                                                "name-live", "n9")
+        assert ev[5] is False or ev[5] == 0
+        assert (ev[6], ev[7]) == (2000.0, 2002.0)
+        # batch create: one summary per record, in id order
+        batch = [_rec(job=f"b{i}") for i in range(3)]
+        sink.create_job_logs(batch)
+        got = []
+        deadline = time.time() + 5.0
+        while len(got) < 3 and time.time() < deadline:
+            got.extend(sub.get(timeout=1.0))
+        assert [e[0] for e in got] == [r.id for r in batch]
+    finally:
+        sub.close()
+
+
+def test_subscribe_replays_from_cursor(sink):
+    """A positive after_id replays the gap (after_id, revision] before
+    going live — the resume path a reconnecting web tier rides."""
+    rs = [_rec(job=f"r{i}") for i in range(5)]
+    for r in rs:
+        sink.create_job_log(r)
+    sub = sink.subscribe(after_id=rs[1].id)
+    try:
+        assert not sub.gap
+        got = []
+        deadline = time.time() + 5.0
+        while len(got) < 3 and time.time() < deadline:
+            got.extend(sub.get(timeout=1.0))
+        assert [e[0] for e in got] == [r.id for r in rs[2:]]
+        # and the stream is LIVE after the replay
+        r5 = _rec(job="after")
+        sink.create_job_log(r5)
+        evs = sub.get(timeout=5.0)
+        assert [e[0] for e in evs] == [r5.id]
+    finally:
+        sub.close()
+
+
+def test_subscribe_from_now_skips_history(sink):
+    sink.create_job_log(_rec(job="old"))
+    sub = sink.subscribe()            # after_id <= 0: from now
+    try:
+        assert sub.get(timeout=0.3) == []
+    finally:
+        sub.close()
+
+
+def test_subscribe_overflow_latches_lost(sink):
+    """An undrained subscriber past ``cap`` loses EVERYTHING pending
+    and the subscription is dead — the writer never stalls, the slow
+    consumer re-lists."""
+    sub = sink.subscribe(cap=4)
+    try:
+        sink.create_job_logs([_rec(job=f"o{i}") for i in range(8)])
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            try:
+                sub.get(timeout=0.2)
+            except SubscriptionLost:
+                break
+        else:
+            pytest.fail("overflowed subscription never latched lost")
+    finally:
+        sub.close()
+
+
+def test_subscribe_born_lost_when_replay_exceeds_cap(sink):
+    """A resume whose replay would not fit the buffer is lost at birth
+    (gap/lost), never silently truncated."""
+    rs = [_rec(job=f"g{i}") for i in range(10)]
+    for r in rs:
+        sink.create_job_log(r)
+    sub = sink.subscribe(after_id=rs[0].id, cap=4)
+    try:
+        if not sub.gap:
+            with pytest.raises(SubscriptionLost):
+                for _ in range(20):
+                    sub.get(timeout=0.2)
+    finally:
+        sub.close()
+
+
+def test_unsubscribe_stops_delivery(sink):
+    sub = sink.subscribe()
+    sub.close()
+    sink.create_job_log(_rec(job="after-close"))
+    # closed subscription never sees it (get raises or returns empty)
+    try:
+        assert sub.get(timeout=0.3) == []
+    except SubscriptionLost:
+        pass
+    # and the sink keeps working for everyone else
+    sub2 = sink.subscribe()
+    try:
+        r = _rec(job="still-live")
+        sink.create_job_log(r)
+        assert [e[0] for e in sub2.get(timeout=5.0)] == [r.id]
+    finally:
+        sub2.close()
+
+
+def test_sharded_subscribe_merges_with_encoded_ids():
+    """The sharded subscription carries globally-unique encoded ids
+    (raw * N + shard) and sees every shard's stream."""
+    shards = [JobLogStore() for _ in range(3)]
+    ss = ShardedJobLogStore(shards)
+    try:
+        sub = ss.subscribe()
+        jobs = [f"mj{i}" for i in range(9)]
+        recs = [_rec(job=j) for j in jobs]
+        ss.create_job_logs(recs)
+        got = []
+        deadline = time.time() + 5.0
+        while len(got) < len(jobs) and time.time() < deadline:
+            got.extend(sub.get(timeout=1.0))
+        assert sorted(e[0] for e in got) == sorted(r.id for r in recs)
+        for e in got:
+            raw, si = decode_log_id(e[0], 3)
+            assert 0 <= si < 3 and raw >= 1
+        sub.close()
+    finally:
+        ss.close()
+
+
+# ---------------------------------------------------- SSE over HTTP
+
+
+class _SseSock:
+    """Raw-socket SSE client: parse frames off /v1/stream."""
+
+    def __init__(self, port, query="", cookie="", timeout=5.0):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=timeout)
+        path = "/v1/stream" + (f"?{query}" if query else "")
+        hdrs = f"GET {path} HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+        if cookie:
+            hdrs += f"Cookie: {cookie}\r\n"
+        self.sock.sendall((hdrs + "\r\n").encode())
+        self.buf = b""
+        while b"\r\n\r\n" not in self.buf:
+            self.buf += self.sock.recv(4096)
+        head, _, self.buf = self.buf.partition(b"\r\n\r\n")
+        self.status = int(head.split(b" ", 2)[1])
+        self.headers = head.decode("latin-1")
+
+    def frame(self, timeout=5.0):
+        """Next non-comment SSE frame as a dict of field -> value."""
+        deadline = time.time() + timeout
+        while True:
+            i = self.buf.find(b"\n\n")
+            if i >= 0:
+                raw, self.buf = self.buf[:i], self.buf[i + 2:]
+                f = {}
+                for line in raw.decode().splitlines():
+                    if line.startswith(":"):
+                        continue
+                    k, _, v = line.partition(":")
+                    f[k] = v.lstrip(" ")
+                if f:
+                    return f
+                continue
+            self.sock.settimeout(max(0.05, deadline - time.time()))
+            try:
+                chunk = self.sock.recv(4096)
+            except (socket.timeout, TimeoutError):
+                return None
+            if not chunk:
+                return None
+            self.buf += chunk
+
+    def event(self, timeout=5.0):
+        """Next frame that is a pushed log event (skips retry/hb)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            f = self.frame(timeout=max(0.05, deadline - time.time()))
+            if f is None:
+                return None
+            if f.get("event") in ("log", "lost", "bye"):
+                return f
+        return None
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def push_world():
+    store = MemStore()
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, auth_enabled=False, port=0,
+                    cache_enabled=True, push_enabled=True).start()
+    yield store, sink, srv
+    srv.stop()
+    store.close()
+    sink.close()
+
+
+def _read_op_count(sink):
+    return sum(v["count"] for k, v in sink.op_stats().items()
+               if k not in ("create_job_log", "create_job_logs",
+                            "log_records", "subscribe", "sub_events"))
+
+
+def test_sse_receives_push_with_zero_reads(push_world):
+    """The tier-1 smoke the rollout gates on: a connected SSE viewer
+    receives a record pushed AFTER connect without the web tier issuing
+    a single logd read on its behalf."""
+    _, sink, srv = push_world
+    c = _SseSock(srv.port)
+    try:
+        assert c.status == 200
+        assert "text/event-stream" in c.headers
+        reads0 = _read_op_count(sink)
+        r = _rec(job="zp", node="n7", ok=False, begin=3000.0)
+        sink.create_job_log(r)
+        f = c.event()
+        assert f is not None and f["event"] == "log"
+        d = json.loads(f["data"])
+        assert d == {"id": r.id, "jobId": "zp", "jobGroup": "g",
+                     "name": "name-zp", "node": "n7", "success": False,
+                     "beginTime": 3000.0, "endTime": 3002.0}
+        # the heavy payload stays behind /v1/log/<id>
+        assert "output" not in d and "command" not in d
+        assert f["id"] == str(r.id)          # cursor = the event id
+        assert _read_op_count(sink) == reads0
+    finally:
+        c.close()
+
+
+def test_sse_resume_last_event_id_exactly_once(push_world):
+    """A reconnect carrying Last-Event-ID (or ?cursor=) replays exactly
+    the records created while away, then goes live — no gaps, no
+    duplicates."""
+    _, sink, srv = push_world
+    c = _SseSock(srv.port)
+    r1 = _rec(job="s1")
+    sink.create_job_log(r1)
+    f = c.event()
+    cursor = f["id"]
+    c.close()
+    # records created while disconnected
+    away = [_rec(job=f"away{i}") for i in range(3)]
+    for r in away:
+        sink.create_job_log(r)
+    c2 = _SseSock(srv.port, query=f"cursor={cursor}")
+    try:
+        got = []
+        while len(got) < 3:
+            f = c2.event()
+            assert f is not None and f["event"] == "log"
+            got.append(json.loads(f["data"])["id"])
+        assert got == [r.id for r in away]
+        live = _rec(job="back")
+        sink.create_job_log(live)
+        f = c2.event()
+        assert json.loads(f["data"])["id"] == live.id
+    finally:
+        c2.close()
+
+
+def test_sse_filters_server_side(push_world):
+    """ids/node/failedOnly narrow the stream ON THE SERVER — a viewer
+    never receives (or pays the bytes for) events outside its filter."""
+    _, sink, srv = push_world
+    c = _SseSock(srv.port, query="ids=want&failedOnly=true")
+    try:
+        sink.create_job_log(_rec(job="other", ok=False))
+        sink.create_job_log(_rec(job="want", ok=True))
+        r = _rec(job="want", ok=False)
+        sink.create_job_log(r)
+        f = c.event()
+        assert json.loads(f["data"])["id"] == r.id
+        assert c.event(timeout=0.3) is None  # nothing else leaked
+    finally:
+        c.close()
+
+
+@pytest.fixture
+def tenant_world():
+    store = MemStore()
+    sink = JobLogStore()
+    srv = ApiServer(store, sink, port=0, cache_enabled=True,
+                    push_enabled=True).start()
+    yield store, sink, srv
+    srv.stop()
+    store.close()
+    sink.close()
+
+
+def _login(port, email="admin@admin.com", password="admin"):
+    import urllib.request
+    url = (f"http://127.0.0.1:{port}/v1/session"
+           f"?email={email}&password={password}")
+    resp = urllib.request.urlopen(url)
+    cookie = resp.headers.get("Set-Cookie", "")
+    resp.read()
+    return cookie.split(";")[0]
+
+
+def test_sse_tenant_isolation_and_spoof_403(tenant_world):
+    """PR 15's forced scoping holds on the stream: a tenant-pinned
+    account's SSE connection only ever receives its tenant's events —
+    omitting tenant= scopes anyway, spoofing another tenant 403s, and
+    an anonymous stream 401s."""
+    import urllib.request
+    store, sink, srv = tenant_world
+    store.put(KS.tenant_job_key("acme", "g", "ja"), "1")
+    store.put(KS.tenant_job_key("globex", "g", "jb"), "1")
+    admin = _login(srv.port)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/admin/account", method="PUT",
+        data=json.dumps({"email": "dev@acme.io", "password": "pass1",
+                         "tenant": "acme"}).encode())
+    req.add_header("Cookie", admin)
+    urllib.request.urlopen(req).read()
+    pinned = _login(srv.port, "dev@acme.io", "pass1")
+
+    anon = _SseSock(srv.port)
+    assert anon.status == 401
+    anon.close()
+    spoof = _SseSock(srv.port, query="tenant=globex", cookie=pinned)
+    assert spoof.status == 403
+    spoof.close()
+
+    cp = _SseSock(srv.port, cookie=pinned)       # forced to acme
+    ca = _SseSock(srv.port, cookie=admin)        # fleet-wide
+    try:
+        assert cp.status == 200 and ca.status == 200
+        rb = _rec(job="jb")
+        sink.create_job_log(rb)
+        ra = _rec(job="ja")
+        sink.create_job_log(ra)
+        # pinned viewer: ONLY the acme record, even though jb came first
+        f = cp.event()
+        assert json.loads(f["data"])["id"] == ra.id
+        assert cp.event(timeout=0.3) is None
+        # admin sees both
+        seen = {json.loads(ca.event()["data"])["id"] for _ in range(2)}
+        assert seen == {ra.id, rb.id}
+    finally:
+        cp.close()
+        ca.close()
+
+
+def test_slow_consumer_evicted_with_lost(push_world):
+    """A viewer that cannot drain its bounded queue is cut loose with a
+    terminal ``lost`` (it re-lists); the writer and other viewers never
+    stall, and the drop is counted."""
+    _, sink, srv = push_world
+    pm = srv._push
+    slow = pm.register({}, cap=2)
+    fast = pm.register({}, cap=256)
+    try:
+        sink.create_job_logs([_rec(job=f"f{i}") for i in range(10)])
+        deadline = time.time() + 5.0
+        state = None
+        while time.time() < deadline and state != "lost":
+            _, state = slow.take(timeout=0.2)
+        assert state == "lost"
+        got = []
+        while len(got) < 10 and time.time() < deadline:
+            evs, st = fast.take(timeout=0.2)
+            got.extend(evs)
+            assert st is None
+        assert len(got) == 10
+        assert pm.stats()["dropped_slow_total"] >= 1
+        assert pm.stats()["client_lost_total"] >= 1
+    finally:
+        pm.unregister(slow)
+        pm.unregister(fast)
+
+
+def test_push_refresh_matches_poll_bytes(push_world):
+    """The differential the rollback pin rides: a cache partial
+    refreshed BY PUSH must serve byte-identical JSON to a poll-mode
+    server recomputing from the sink."""
+    store, sink, srv = push_world
+    poll_srv = ApiServer(MemStore(), sink, auth_enabled=False, port=0,
+                         cache_enabled=True, push_enabled=False).start()
+    try:
+        q = {"latest": "true", "pageSize": "500"}
+        r0, _ = srv.handle("GET", "/v1/logs", q, b"", {}, {})
+        sink.create_job_logs([_rec(job=f"d{i}", begin=5000.0 + i)
+                              for i in range(4)])
+        # wait for the push refresher to fold the new revision in
+        deadline = time.time() + 5.0
+        want_rev = sink.revision()
+        while time.time() < deadline:
+            if srv._push.vector()[0] >= want_rev and \
+                    not srv._push._dirty.is_set():
+                break
+            time.sleep(0.02)
+        time.sleep(0.15)                 # debounced refresh window
+        pushed, _ = srv.handle("GET", "/v1/logs", q, b"", {}, {})
+        polled, _ = poll_srv.handle("GET", "/v1/logs", q, b"", {}, {})
+        a = json.dumps(pushed, sort_keys=True)
+        b = json.dumps(polled, sort_keys=True)
+        assert a == b
+        assert pushed != r0              # the refresh actually moved
+    finally:
+        poll_srv.stop()
+
+
+def test_push_off_rollback_is_byte_identical(monkeypatch):
+    """CRONSUN_WEB_PUSH=off: /v1/stream answers 503 (clients fall back
+    to cursor-polling) and every poll surface serves byte-identical
+    bodies to a push-enabled server over the same sink."""
+    sink = JobLogStore()
+    sink.create_job_logs([_rec(job=f"rb{i}") for i in range(5)])
+    monkeypatch.setenv("CRONSUN_WEB_PUSH", "off")
+    off = ApiServer(MemStore(), sink, auth_enabled=False, port=0,
+                    cache_enabled=True).start()
+    monkeypatch.delenv("CRONSUN_WEB_PUSH")
+    on = ApiServer(MemStore(), sink, auth_enabled=False, port=0,
+                   cache_enabled=True).start()
+    try:
+        assert off._push is None and on._push is not None
+        c = _SseSock(off.port)
+        assert c.status == 503
+        c.close()
+        for path, q in (("/v1/logs", {"latest": "true"}),
+                        ("/v1/logs", {"ids": "rb1"}),
+                        ("/v1/stat/overall", {}),
+                        ("/v1/stat/days", {"days": "7"})):
+            ra, _ = off.handle("GET", path, q, b"", {}, {})
+            rb, _ = on.handle("GET", path, q, b"", {}, {})
+            assert json.dumps(ra, sort_keys=True) == \
+                json.dumps(rb, sort_keys=True)
+    finally:
+        on.stop()
+        off.stop()
+        sink.close()
+
+
+def test_readyz_and_metrics_expose_push_health(push_world):
+    """/readyz carries a NAMED per-shard subscription check;
+    /v1/metrics exposes the sse family through the strict exposition
+    parser (duplicates would raise)."""
+    from cronsun_tpu.metrics import parse_exposition
+    _, sink, srv = push_world
+    body, ctx = srv.handle("GET", "/readyz", {}, b"", {}, {})
+    assert body["checks"]["push_shard_0"]["ok"] is True
+    c = _SseSock(srv.port)
+    try:
+        text, _ = srv.handle("GET", "/v1/metrics", {}, b"", {}, {})
+        series = parse_exposition(str(text))
+        names = {n for n, _ in series}
+        for want in ("cronsun_web_sse_connections",
+                     "cronsun_web_sse_events_total",
+                     "cronsun_web_sse_dropped_slow_total",
+                     "cronsun_web_sse_resumes_total"):
+            assert want in names, want
+        assert series[("cronsun_web_sse_connections", frozenset())] >= 1
+        # the logd side counts the plane too
+        sink.create_job_log(_rec(job="m1"))
+        c.event()
+        ops = sink.op_stats()
+        assert ops["subscribe"]["count"] >= 1
+        assert ops["sub_events"]["count"] >= 1
+    finally:
+        c.close()
+
+
+def test_graceful_shutdown_sends_bye(push_world):
+    """stop() drains viewers: a final ``bye`` with a long retry: so
+    browsers back off the dying replica, within a bounded timeout."""
+    _, sink, srv = push_world
+    c = _SseSock(srv.port)
+    try:
+        sink.create_job_log(_rec(job="pre-stop"))
+        assert c.event()["event"] == "log"
+        t0 = time.time()
+        srv.stop()
+        assert time.time() - t0 < 10.0
+        f = c.event()
+        assert f is not None and f["event"] == "bye"
+        assert "retry" in f
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+def test_thousand_viewer_push_gate():
+    """The slow-tier rollout gate: 1k concurrent SSE viewers on one
+    replica hold publish-lag p99 under a second while the plane issues
+    >= 10x fewer logd reads than the same freshness served by
+    polling."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    from bench_push import run_push_bench
+    res = run_push_bench(viewers=1000, seconds=8.0, write_rate=20,
+                         on_log=lambda *a: None)
+    assert res["push_plane_viewers_connected"] >= 990
+    assert res["push_plane_publish_lag_p99_ms"] < 1000.0
+    assert res["push_plane_sse_dropped_slow"] == 0
+    assert res["push_plane_read_op_ratio"] >= 10.0
