@@ -50,7 +50,8 @@ def check_artifact_provenance(rev: str) -> None:
     import os
     here = os.path.dirname(os.path.abspath(__file__))
     arts = [os.path.join(here, "bench_detail.json")] + sorted(
-        glob.glob(os.path.join(here, "MULTICHIP_*.json")))
+        glob.glob(os.path.join(here, "MULTICHIP_*.json"))
+        + glob.glob(os.path.join(here, "PUSH_*.json")))
     for path in arts:
         if not os.path.exists(path):
             continue
@@ -554,6 +555,34 @@ def main():
             detail["push_plane_error"] = proc.stderr[-500:]
     except Exception as e:  # noqa: BLE001
         detail["push_plane_error"] = str(e)
+
+    # ---- web-replica scale-out ladder --------------------------------------
+    # N web replicas (subprocesses) share nothing but the logd
+    # addresses; aggregate connected viewers should scale near-
+    # linearly at equal lag — benched, not asserted.  Full runs also
+    # refresh the PUSH_ladder.json sidecar (git_rev-stamped).
+    log("push plane: web-replica scale-out ladder")
+    try:
+        cmd = [sys.executable, os.path.join(here, "scripts",
+                                            "bench_push.py"),
+               "--replicas", "1,2" if quick else "1,2,4",
+               "--viewers", "100" if quick else "400",
+               "--seconds", "3" if quick else "6",
+               "--write-rate", "20"]
+        if not quick:
+            cmd += ["--out", os.path.join(here, "PUSH_ladder.json")]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=900, cwd=here)
+        if proc.returncode == 0:
+            merged = json.loads(proc.stdout)
+            # the parent's provenance stamp wins over the child's
+            merged.pop("git_rev", None)
+            merged.pop("generated_at_utc", None)
+            detail.update(merged)
+        else:
+            detail["push_ladder_error"] = proc.stderr[-500:]
+    except Exception as e:  # noqa: BLE001
+        detail["push_ladder_error"] = str(e)
 
     # ---- store snapshot write-stall probe ----------------------------------
     # the staggered-imaging claim: p99 client-visible put latency DURING

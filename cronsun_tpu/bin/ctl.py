@@ -35,6 +35,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from ..core.backoff import SSE_RECONNECT
+
 DEFAULT_URL = os.environ.get("CRONSUN_URL", "http://127.0.0.1:7079")
 DEFAULT_SESSION = os.environ.get(
     "CRONSUN_SESSION",
@@ -402,23 +404,40 @@ def _follow_logs(api, params, interval: float, as_json: bool):
     # the stream evaluates node/ids/tenant/failedOnly server-side;
     # begin/end/names exist only on the query path — poll for those
     sse_ok = not any(params.get(k) for k in ("begin", "end", "names"))
+    fails = 0
     while sse_ok:
+        t0 = time.monotonic()
+        err = None
         try:
             cursor, why = _follow_sse(api, params, cursor, as_json)
         except ApiError as e:
             if e.status in (400, 404, 501, 503):
+                # the server doesn't speak /v1/stream (or push is
+                # off): that's a capability signal, not an outage —
+                # degrade to the poll protocol permanently
                 print(f"live stream unavailable ({e}); polling every "
                       f"{interval:g}s", file=sys.stderr)
                 break                          # poll fallback below
-            raise
+            # transient: unreachable (status 0), 5xx, mid-connect
+            # resets — the cursor survives, so resume the stream on
+            # the jittered ladder instead of crashing or falling back
+            # to polls against a replica that is merely restarting
+            why, err = "error", e
+        if err is None and time.monotonic() - t0 >= 2.0:
+            fails = 0              # the stream served; outage healed
         if why == "lost":
             # this viewer fell behind (or resumed past the replay
             # window): the cursor re-list is the documented recovery
             print("stream lost; re-listing from cursor",
                   file=sys.stderr)
             cursor = _drain_cursor(api, params, cursor, as_json)
-        else:
-            time.sleep(min(interval, 1.0))     # reconnect backoff
+            continue
+        fails += 1
+        delay = SSE_RECONNECT.delay(fails)
+        if err is not None:
+            print(f"stream error ({err}); retrying in {delay:.1f}s",
+                  file=sys.stderr)
+        time.sleep(delay)
     while True:
         time.sleep(interval)
         cursor = _drain_cursor(api, params, cursor, as_json)

@@ -106,3 +106,10 @@ NOTICER = Backoff(base=0.5, cap=30.0)
 #: hole and the cursor rewinds.
 PUBLISH = Backoff(base=0.2, cap=2.0)
 PUBLISH_ATTEMPTS = 4
+
+#: ctl ``logs --follow`` stream reconnects (bin/ctl.py): a transient
+#: SSE disconnect resumes from the follower's cursor on 0.5 s .. 30 s,
+#: jittered up to 50% — a fleet of followers dropped by one replica
+#: restart must not reconnect as a herd.  Unseeded on purpose: nothing
+#: replays this ladder, and herd spreading wants real randomness.
+SSE_RECONNECT = Backoff(base=0.5, cap=30.0, jitter=0.5)

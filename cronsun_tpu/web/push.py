@@ -64,27 +64,52 @@ def event_dict(ev) -> dict:
             "beginTime": ev[6], "endTime": ev[7]}
 
 
-_json_memo: "OrderedDict[int, str]" = OrderedDict()
+_json_memo: "OrderedDict[tuple, str]" = OrderedDict()
+_frame_memo: "OrderedDict[tuple, bytes]" = OrderedDict()
 _json_memo_mu = threading.Lock()
 _JSON_MEMO_CAP = 8192
 
 
 def event_data_json(ev) -> str:
-    """``data:`` line payload, memoized by event id: every connected
-    viewer serializes the SAME summary, so at N viewers the naive path
-    pays N json.dumps per record — the memo makes fan-out cost one
-    dumps per record plus N string copies."""
-    eid = ev[0]
+    """``data:`` line payload, memoized: every connected viewer
+    serializes the SAME summary, so at N viewers the naive path pays
+    N json.dumps per record — the memo makes fan-out cost one dumps
+    per record plus N string copies.  Keyed by the WHOLE summary
+    tuple, not the id: the memo is process-global and record ids are
+    per-sink, so two sinks in one process (tests, a future
+    multi-sink replica) would otherwise serve each other stale
+    frames."""
+    key = tuple(ev)
     with _json_memo_mu:
-        s = _json_memo.get(eid)
+        s = _json_memo.get(key)
         if s is not None:
             return s
     s = json.dumps(event_dict(ev), separators=(",", ":"))
     with _json_memo_mu:
-        _json_memo[eid] = s
+        _json_memo[key] = s
         while len(_json_memo) > _JSON_MEMO_CAP:
             _json_memo.popitem(last=False)
     return s
+
+
+def event_frame_tail(ev) -> bytes:
+    """The per-event constant SSE frame suffix
+    (``event: log\\ndata: <json>\\n\\n``), memoized like
+    :func:`event_data_json` (same whole-tuple key).  Only the ``id:``
+    line differs per viewer (it carries that viewer's cursor vector),
+    so both writers serialize AND encode each record once per
+    replica; fan-out to N viewers is N cheap concatenations."""
+    key = tuple(ev)
+    with _json_memo_mu:
+        b = _frame_memo.get(key)
+        if b is not None:
+            return b
+    b = (b"event: log\ndata: " + event_data_json(ev).encode() + b"\n\n")
+    with _json_memo_mu:
+        _frame_memo[key] = b
+        while len(_frame_memo) > _JSON_MEMO_CAP:
+            _frame_memo.popitem(last=False)
+    return b
 
 
 class SseClient:
@@ -106,6 +131,11 @@ class SseClient:
         self._buf: deque = deque()
         self.lost = False
         self.stopping = False
+        # event-driven writer hook (web/sse_epoll.py): wakes the epoll
+        # loop that owns this viewer's socket whenever the queue state
+        # changes.  None under the threaded writer — take() blocks on
+        # the condvar instead.
+        self.signal = None
 
     def matches(self, ev) -> bool:
         f = self.filters
@@ -132,9 +162,11 @@ class SseClient:
                 self._buf.clear()
                 self.lost = True
                 self._cv.notify_all()
+                self._signal()
                 return False
             self._buf.extend(evs)
             self._cv.notify_all()
+            self._signal()
             return True
 
     def mark_lost(self):
@@ -142,11 +174,21 @@ class SseClient:
             self._buf.clear()
             self.lost = True
             self._cv.notify_all()
+            self._signal()
 
     def stop(self):
         with self._cv:
             self.stopping = True
             self._cv.notify_all()
+            self._signal()
+
+    def _signal(self):
+        sig = self.signal
+        if sig is not None:
+            try:
+                sig()
+            except Exception:  # noqa: BLE001 — a dying loop can't veto
+                pass           # the fan-out path; the pool reaps it
 
     def take(self, timeout: Optional[float]):
         """-> (events, state): state is None (keep streaming), "lost"
@@ -197,7 +239,8 @@ class PushManager:
         self._health: list = [(False, "connecting")] * self.nshards
         self._stats = {"events_total": 0, "dropped_slow_total": 0,
                        "resumes_total": 0, "cache_refreshes_total": 0,
-                       "client_lost_total": 0}
+                       "client_lost_total": 0,
+                       "ring_evictions_total": 0}
         self._stop = threading.Event()
         self._dirty = threading.Event()
         self._threads: list = []

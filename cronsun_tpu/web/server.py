@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import re
 import threading
 from collections import OrderedDict
@@ -136,7 +137,8 @@ class ApiServer:
                  auth_enabled: bool = True,
                  host: str = "127.0.0.1", port: int = 7079,
                  cache_enabled: Optional[bool] = None,
-                 slo_engine=None, push_enabled: Optional[bool] = None):
+                 slo_engine=None, push_enabled: Optional[bool] = None,
+                 sse_writer: Optional[str] = None):
         # auth_enabled=False replicates the reference's Web.Auth.Enabled
         # switch (web/base.go:98: every request passes as an implicit
         # admin; the UI skips login).  Unlike the reference — whose Go
@@ -191,7 +193,43 @@ class ApiServer:
             except Exception as e:  # noqa: BLE001 — degrade to polling
                 log.warnf("live push unavailable: %s", e)
                 self._push = None
+        # SSE writer mode: the epoll pool (web/sse_epoll.py) owns every
+        # viewer socket by default; CRONSUN_SSE_WRITER=threads (or
+        # sse_writer="threads") is the rollback to the PR 17
+        # thread-per-connection writer — byte-identical on the wire,
+        # pinned by tests/test_sse_epoll.py
+        mode = (sse_writer or os.environ.get("CRONSUN_SSE_WRITER", "")
+                or "epoll").strip().lower()
+        self.sse_writer = "threads" if mode in ("threads", "thread") \
+            else "epoll"
+        self._sse_pool = None
+        self._sse_adopted: set = set()
+        self._sse_adopt_mu = threading.Lock()
+        if self._push is not None and self.sse_writer == "epoll":
+            from .sse_epoll import EpollSsePool
+            self._sse_pool = EpollSsePool(
+                self._push, on_close=self._sse_forget)
         self.routes = self._build_routes()
+
+    # ---- SSE socket adoption (epoll writer) ------------------------------
+    # The HTTP layer marks a streaming socket adopted BEFORE handing it
+    # to the pool; socketserver's per-request teardown then skips it
+    # (shutdown_request would otherwise send FIN under the pool).  The
+    # marker is consumed by whichever side tears down first — the
+    # request thread exiting or the pool closing the socket — and both
+    # paths are safe against the other having already run because a
+    # closed Python socket's fd is -1 (no fd-reuse hazard).
+
+    def _sse_adopt(self, sock):
+        with self._sse_adopt_mu:
+            self._sse_adopted.add(sock)
+
+    def _sse_forget(self, sock) -> bool:
+        with self._sse_adopt_mu:
+            if sock in self._sse_adopted:
+                self._sse_adopted.discard(sock)
+                return True
+            return False
 
     # ---- bootstrap (web/authentication.go:20-52) -------------------------
 
@@ -1804,12 +1842,27 @@ class ApiServer:
                 lines.append(f"{name} {val}")
         if self._push is not None:
             # live-push observability: viewer count, fan-out volume,
-            # slow-consumer drops, resumes (this web server's own)
-            for field, val in sorted(self._push.stats().items()):
+            # slow-consumer drops, resumes (this web server's own) —
+            # plus the epoll writer pool's loop lag, ring evictions,
+            # and write-queue depth when that writer is active
+            sse_stats = dict(self._push.stats())
+            per_loop = None
+            if self._sse_pool is not None:
+                pool_stats = self._sse_pool.stats()
+                per_loop = pool_stats.pop("loop_connections", None)
+                sse_stats.update(pool_stats)
+            for field, val in sorted(sse_stats.items()):
                 name = f"cronsun_web_sse_{field}"
                 kind = "counter" if field.endswith("_total") else "gauge"
                 lines.append(f"# TYPE {name} {kind}")
                 lines.append(f"{name} {val}")
+            if per_loop is not None:
+                # a hot loop must be visible per loop, not averaged
+                # away across the pool
+                name = "cronsun_web_sse_loop_connections"
+                lines.append(f"# TYPE {name} gauge")
+                for i, nconns in enumerate(per_loop):
+                    lines.append(f'{name}{{loop="{i}"}} {nconns}')
         seen_types: set = set()
         sched_snaps: list = []    # partitioned-plane aggregation input
         for kv in self._degraded_prefix(self.ks.metrics):
@@ -2117,9 +2170,7 @@ class ApiServer:
                                                 body, cookies,
                                                 dict(self.headers))
                     if isinstance(result, SseStream):
-                        # streaming escape hatch: no Content-Length —
-                        # this request thread becomes the SSE writer
-                        # until the viewer drops or the server drains
+                        # streaming escape hatch: no Content-Length
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "text/event-stream")
@@ -2128,6 +2179,20 @@ class ApiServer:
                         for k, v in ctx.out_headers.items():
                             self.send_header(k, v)
                         self.end_headers()
+                        pool = server._sse_pool
+                        if pool is not None:
+                            # epoll writer: mark the socket adopted
+                            # (teardown skips it), hand it to the
+                            # pool, and this request thread exits —
+                            # 50k viewers, zero parked threads
+                            self.close_connection = True
+                            server._sse_adopt(self.connection)
+                            pool.adopt(self.connection, result.client,
+                                       result.replay)
+                            return
+                        # threaded writer (rollback): this request
+                        # thread writes until the viewer drops, falls
+                        # behind, or the server drains
                         result.serve(self.wfile)
                         return
                     if isinstance(result, PlainText):
@@ -2171,7 +2236,25 @@ class ApiServer:
             def do_DELETE(self):
                 self._run("DELETE")
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        class _Httpd(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5: a viewer
+            # fleet reconnecting en masse (replica restart, LB
+            # failover) overflows it instantly and every dropped SYN
+            # costs that client a full 1 s retransmit — measured
+            # ~150 ms/conn average on a fast ramp, vs ~1 ms with a
+            # real backlog.  The kernel clamps to net.core.somaxconn.
+            request_queue_size = 1024
+
+            def shutdown_request(httpd_self, request):
+                # a socket adopted by the epoll pool outlives its
+                # request thread: skipping the base teardown here is
+                # what keeps socketserver's shutdown(SHUT_WR)+close
+                # from half-closing a live stream under the pool
+                if server._sse_forget(request):
+                    return
+                ThreadingHTTPServer.shutdown_request(httpd_self, request)
+
+        self._httpd = _Httpd((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
                              name="api-server")
@@ -2184,6 +2267,9 @@ class ApiServer:
         # mid-write when the listener goes away
         if self._push is not None:
             self._push.stop(drain_timeout=2.0)
+        if self._sse_pool is not None:
+            self._sse_pool.stop()
+            self._sse_pool = None
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -22,11 +22,25 @@ records/s.  Measured:
 
     python scripts/bench_push.py [--viewers M] [--seconds S]
         [--write-rate R] [--logd-shards N] [--poll-viewers P]
-        [--poll-interval F] [--json out.json]
+        [--poll-interval F] [--writer epoll|threads] [--json out.json]
+
+Two more shapes ride the same harness:
+
+- ``--quick``: a small epoll-vs-threaded differential run — exits
+  NONZERO when the epoll writer under-delivers the threaded baseline
+  on connected count or publish lag (the CI regression gate for the
+  event-driven writer).
+- ``--replicas 1,2,4``: the web-replica scale-out ladder — each rung
+  spins N ApiServer subprocesses sharing nothing but the logd
+  addresses, drives one viewer-fleet subprocess per replica (separate
+  processes keep each side under the fd rlimit and let RSS-per-
+  connection be read per replica from /proc), and reports per-replica
+  + aggregate connected / lag-p99 / drop counts.  ``--out`` writes the
+  git_rev-stamped PUSH_ladder.json sidecar.
 
 Backend: native logd when the binary exists, BENCH_LOGD=py forces the
 Python/SQLite server.  Run standalone or via bench.py (which merges
-``push_plane_*`` into bench_detail.json).
+``push_plane_*``/``push_ladder_*`` into bench_detail.json).
 """
 
 import argparse
@@ -90,9 +104,91 @@ class _SseViewer:
         self.lost = False
 
 
+def _pump_viewers(sel, stop, lags, llock):
+    """The viewer fleet's single reader loop: drain every readable SSE
+    socket, detect the handshake, count events/bytes on all viewers
+    and parse publish lag on the sampled subset."""
+    now = time.time
+    while not stop.is_set():
+        for key, _ in sel.select(timeout=0.25):
+            v = key.data
+            try:
+                chunk = v.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                chunk = b""
+            if not chunk:
+                v.streaming = False
+                sel.unregister(v.sock)
+                continue
+            v.bytes += len(chunk)
+            if not v.connected:
+                v.buf += chunk
+                i = v.buf.find(b"\r\n\r\n")
+                if i < 0:
+                    continue
+                v.connected = v.buf.startswith(b"HTTP/1.") and \
+                    b" 200 " in v.buf[:32]
+                chunk, v.buf = v.buf[i + 4:], b""
+            if v.sampled:
+                v.buf += chunk
+                t = now()
+                while True:
+                    j = v.buf.find(b"\n\n")
+                    if j < 0:
+                        break
+                    frame, v.buf = v.buf[:j], v.buf[j + 2:]
+                    if b"event: log" not in frame:
+                        if b"event: lost" in frame:
+                            v.lost = True
+                        continue
+                    v.events += 1
+                    d = frame.find(b"data: ")
+                    if d < 0:
+                        continue
+                    try:
+                        ev = json.loads(
+                            frame[d + 6:].split(b"\n", 1)[0])
+                        with llock:
+                            lags.append(
+                                (t - ev["beginTime"]) * 1000.0)
+                    except (ValueError, KeyError, TypeError):
+                        pass
+            else:
+                v.events += chunk.count(b"event: log")
+                if b"event: lost" in chunk:
+                    v.lost = True
+
+
+def _connect_fleet(host, port, viewers, sample):
+    """Sequential SSE connect ramp; returns (viewers, socks, selector,
+    connect_errors).  The handshake completes later, in the pump."""
+    req = (f"GET /v1/stream HTTP/1.1\r\nHost: {host}\r\n"
+           f"Accept: text/event-stream\r\n\r\n").encode()
+    vs, socks = [], []
+    sel = selectors.DefaultSelector()
+    errs = 0
+    for k in range(viewers):
+        try:
+            s = socket.create_connection((host, port), timeout=10.0)
+            s.sendall(req)
+            s.setblocking(False)
+        except OSError:
+            errs += 1
+            continue
+        v = _SseViewer(s, sampled=k < sample)
+        vs.append(v)
+        socks.append(s)
+        sel.register(s, selectors.EVENT_READ, v)
+        if k % 100 == 99:
+            time.sleep(0.01)   # let the accept loop breathe
+    return vs, socks, sel, errs
+
+
 def run_push_bench(viewers=200, seconds=6.0, write_rate=50,
                    logd_shards=1, poll_viewers=8, poll_interval=1.0,
-                   sample=64, on_log=print):
+                   sample=64, sse_writer=None, on_log=print):
     from cronsun_tpu.logsink import LogRecord
     from cronsun_tpu.logsink.native import find_binary as find_logd
     from cronsun_tpu.logsink.native import NativeLogSinkServer
@@ -130,94 +226,24 @@ def run_push_bench(viewers=200, seconds=6.0, write_rate=50,
         sink.create_job_logs(seed)
 
         web = ApiServer(MemStore(), sink, auth_enabled=False,
-                        cache_enabled=True, port=0,
-                        push_enabled=True).start()
+                        cache_enabled=True, port=0, push_enabled=True,
+                        sse_writer=sse_writer).start()
         if web._push is None or not web._push.running:
             raise RuntimeError("push plane failed to start "
                                "(backend lacks subscribe?)")
-        on_log(f"web up on :{web.port} ({backend}); "
-               f"connecting {viewers} SSE viewers")
+        on_log(f"web up on :{web.port} ({backend}, {web.sse_writer} "
+               f"writer); connecting {viewers} SSE viewers")
 
         # ---- connect ramp (sequential: a clean ceiling count) ----
-        req = (f"GET /v1/stream HTTP/1.1\r\nHost: {web.host}\r\n"
-               f"Accept: text/event-stream\r\n\r\n").encode()
-        vs = []
-        sel = selectors.DefaultSelector()
-        connect_errs = 0
-        for k in range(viewers):
-            try:
-                s = socket.create_connection((web.host, web.port),
-                                             timeout=5.0)
-                s.sendall(req)
-                s.setblocking(False)
-            except OSError:
-                connect_errs += 1
-                continue
-            v = _SseViewer(s, sampled=k < sample)
-            vs.append(v)
-            socks.append(s)
-            sel.register(s, selectors.EVENT_READ, v)
-            if k % 100 == 99:
-                time.sleep(0.01)   # let the accept loop breathe
+        vs, socks, sel, connect_errs = _connect_fleet(
+            web.host, web.port, viewers, sample)
 
         lags = []
         llock = threading.Lock()
         stop = threading.Event()
-
-        def pump():
-            now = time.time
-            while not stop.is_set():
-                for key, _ in sel.select(timeout=0.25):
-                    v = key.data
-                    try:
-                        chunk = v.sock.recv(65536)
-                    except (BlockingIOError, InterruptedError):
-                        continue
-                    except OSError:
-                        chunk = b""
-                    if not chunk:
-                        v.streaming = False
-                        sel.unregister(v.sock)
-                        continue
-                    v.bytes += len(chunk)
-                    if not v.connected:
-                        v.buf += chunk
-                        i = v.buf.find(b"\r\n\r\n")
-                        if i < 0:
-                            continue
-                        v.connected = v.buf.startswith(b"HTTP/1.") and \
-                            b" 200 " in v.buf[:32]
-                        chunk, v.buf = v.buf[i + 4:], b""
-                    if v.sampled:
-                        v.buf += chunk
-                        t = now()
-                        while True:
-                            j = v.buf.find(b"\n\n")
-                            if j < 0:
-                                break
-                            frame, v.buf = v.buf[:j], v.buf[j + 2:]
-                            if b"event: log" not in frame:
-                                if b"event: lost" in frame:
-                                    v.lost = True
-                                continue
-                            v.events += 1
-                            d = frame.find(b"data: ")
-                            if d < 0:
-                                continue
-                            try:
-                                ev = json.loads(
-                                    frame[d + 6:].split(b"\n", 1)[0])
-                                with llock:
-                                    lags.append(
-                                        (t - ev["beginTime"]) * 1000.0)
-                            except (ValueError, KeyError, TypeError):
-                                pass
-                    else:
-                        v.events += chunk.count(b"event: log")
-                        if b"event: lost" in chunk:
-                            v.lost = True
-
-        pt = threading.Thread(target=pump, daemon=True, name="sse-pump")
+        pt = threading.Thread(target=_pump_viewers,
+                              args=(sel, stop, lags, llock),
+                              daemon=True, name="sse-pump")
         pt.start()
         deadline = time.time() + 3.0
         while (time.time() < deadline
@@ -352,6 +378,7 @@ def run_push_bench(viewers=200, seconds=6.0, write_rate=50,
         ratio = poll_equiv / max(1.0, float(push_reads))
         res = {
             "push_plane_backend": backend,
+            "push_plane_sse_writer": web.sse_writer,
             "push_plane_logd_shards": logd_shards,
             "push_plane_viewers": viewers,
             "push_plane_viewers_connected": n_conn,
@@ -417,6 +444,347 @@ def run_push_bench(viewers=200, seconds=6.0, write_rate=50,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _rss_kb(pid: int) -> int:
+    """VmRSS of a process in KiB (0 when unreadable)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
+def _bench_git_rev() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        return out.stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 — not a git checkout
+        return "unknown"
+
+
+def _read_child_line(proc, prefix: str, timeout: float):
+    """Next stdout line starting with ``prefix`` from a child, bounded;
+    None on timeout/death (the caller counts the replica out)."""
+    import select as _select
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r, _, _ = _select.select([proc.stdout], [], [], 0.5)
+        if r:
+            line = proc.stdout.readline()
+            if not line:
+                return None
+            line = line.strip()
+            if line.startswith(prefix):
+                return line
+        elif proc.poll() is not None:
+            return None
+    return None
+
+
+def _scrape_sse_stats(port: int) -> dict:
+    """The replica's unlabeled cronsun_web_sse_* series off
+    /v1/metrics — server-side drop/eviction/loop-lag truth the viewer
+    fleet can't observe from its end of the socket."""
+    import urllib.request
+    out = {}
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/metrics", timeout=10
+        ).read().decode()
+    except Exception:  # noqa: BLE001 — replica died; counted elsewhere
+        return out
+    for line in text.splitlines():
+        if not line.startswith("cronsun_web_sse_") or "{" in line:
+            continue
+        try:
+            name, val = line.split()
+            out[name[len("cronsun_web_sse_"):]] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def serve_main(addrs: str, writer: str, nofile: int) -> int:
+    """One web replica as its own process: an ApiServer (push on) over
+    the shared logd addresses.  Prints ``PORT <p>`` once up, serves
+    until ``STOP`` (or EOF) on stdin.  Share-nothing by construction —
+    the only thing replicas have in common is ``addrs``."""
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
+    from cronsun_tpu.store.memstore import MemStore
+    from cronsun_tpu.web.server import ApiServer
+    _raise_nofile(nofile)
+    sink = connect_sharded_sink(addrs.split(","))
+    web = ApiServer(MemStore(), sink, auth_enabled=False,
+                    cache_enabled=True, port=0, push_enabled=True,
+                    sse_writer=writer or None).start()
+    if web._push is None or not web._push.running:
+        print("ERR push unavailable", flush=True)
+        return 1
+    print(f"PORT {web.port}", flush=True)
+    try:
+        for line in sys.stdin:
+            if line.strip() == "STOP":
+                break
+    except KeyboardInterrupt:
+        pass
+    web.stop()
+    sink.close()
+    return 0
+
+
+def viewer_main(port: int, viewers: int, sample: int) -> int:
+    """One replica's viewer fleet as its own process (the fd budget:
+    10k server sockets + 10k client sockets can't share one process
+    under a 20k RLIMIT_NOFILE).  Connects, prints ``READY <n>``, pumps
+    until ``STOP``/EOF on stdin, then prints ``RESULT <json>``."""
+    _raise_nofile(viewers + 512)
+    vs, socks, sel, errs = _connect_fleet("127.0.0.1", port, viewers,
+                                          sample)
+    lags = []
+    llock = threading.Lock()
+    stop = threading.Event()
+    pt = threading.Thread(target=_pump_viewers,
+                          args=(sel, stop, lags, llock),
+                          daemon=True, name="sse-pump")
+    pt.start()
+    deadline = time.time() + 10.0 + viewers * 0.005
+    while (time.time() < deadline
+           and sum(1 for v in vs if v.connected) < len(vs)):
+        time.sleep(0.05)
+    n_conn = sum(1 for v in vs if v.connected)
+    print(f"READY {n_conn}", flush=True)
+    try:
+        for line in sys.stdin:
+            if line.strip() == "STOP":
+                break
+    except KeyboardInterrupt:
+        pass
+    stop.set()
+    pt.join(timeout=10)
+    with llock:
+        lag_list = list(lags)
+    if len(lag_list) > 8000:     # bounded child->driver payload
+        lag_list = lag_list[::len(lag_list) // 8000 + 1]
+    res = {
+        "connected": n_conn,
+        "alive": sum(1 for v in vs if v.connected and v.streaming
+                     and not v.lost),
+        "lost": sum(1 for v in vs if v.lost),
+        "connect_errors": errs,
+        "events": sum(v.events for v in vs),
+        "bytes": sum(v.bytes for v in vs),
+        "lags": [round(x, 3) for x in lag_list],
+    }
+    print("RESULT " + json.dumps(res), flush=True)
+    for s in socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    return 0
+
+
+def run_replica_ladder(replicas, viewers_per_replica=200, seconds=5.0,
+                       write_rate=20, logd_shards=1, sample=64,
+                       sse_writer=None, on_log=print):
+    """The web-replica scale-out ladder: for each rung, N serve-mode
+    subprocesses share only the logd addresses, one viewer-mode
+    subprocess per replica drives its fleet, and one paced writer
+    feeds the shared sink.  Reports per-replica and aggregate
+    connected / lag / drop counts plus RSS-per-connection read from
+    each replica's /proc — the share-nothing scale-out claim, benched
+    rather than asserted."""
+    from cronsun_tpu.logsink import LogRecord
+    from cronsun_tpu.logsink.native import find_binary as find_logd
+    from cronsun_tpu.logsink.native import NativeLogSinkServer
+    from cronsun_tpu.logsink.sharded import connect_sharded_sink
+    from bench_dispatch import _PyLogShardServer  # noqa: E402 — same dir
+
+    me = os.path.abspath(__file__)
+    here = os.path.dirname(me)
+    replicas = sorted(set(max(1, int(r)) for r in replicas))
+    logd_shards = max(1, logd_shards)
+    logd_bin = (None if os.environ.get("BENCH_LOGD") == "py"
+                else find_logd())
+    backend = ("native-logd" if logd_bin else "py-logd") + (
+        f"x{logd_shards}-shards" if logd_shards > 1 else "")
+    tmpdir = tempfile.mkdtemp(prefix="bench_pushladder_")
+    logds = []
+    sink = None
+    rungs = []
+    try:
+        for si in range(logd_shards):
+            if logd_bin:
+                logds.append(NativeLogSinkServer(
+                    binary=logd_bin,
+                    db=os.path.join(tmpdir, f"p{si}.wal")))
+            else:
+                logds.append(_PyLogShardServer(
+                    ("--db", os.path.join(tmpdir, f"p{si}.db"))))
+        addrs = [f"{l.host}:{l.port}" for l in logds]
+        sink = connect_sharded_sink(addrs)
+        t = time.time()
+        sink.create_job_logs([
+            LogRecord(job_id=f"pj{i % 16}", job_group="p",
+                      name=f"push-bench-{i % 16}", node=f"pn{i % 4}",
+                      user="", command="true", output="seed",
+                      success=True, begin_ts=t, end_ts=t)
+            for i in range(200)])
+
+        for nrep in replicas:
+            on_log(f"rung {nrep} replica(s) x {viewers_per_replica} "
+                   f"viewers ({backend})")
+            serve_procs, viewer_procs = [], []
+            wproc = None
+            try:
+                ports = []
+                for _ in range(nrep):
+                    p = subprocess.Popen(
+                        [sys.executable, me, "--serve-mode",
+                         "--serve-addrs", ",".join(addrs),
+                         "--writer", sse_writer or "",
+                         "--nofile", str(viewers_per_replica + 2048)],
+                        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, text=True, cwd=here)
+                    serve_procs.append(p)
+                    line = _read_child_line(p, "PORT ", 60.0)
+                    if line is None:
+                        raise RuntimeError("replica failed to start")
+                    ports.append(int(line.split()[1]))
+                rss0 = [_rss_kb(p.pid) for p in serve_procs]
+                for port in ports:
+                    vp = subprocess.Popen(
+                        [sys.executable, me, "--viewer-mode",
+                         "--viewer-port", str(port),
+                         "--viewers", str(viewers_per_replica),
+                         "--sample", str(sample)],
+                        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, text=True, cwd=here)
+                    viewer_procs.append(vp)
+                readys = []
+                ramp_budget = 60.0 + viewers_per_replica * 0.02
+                for vp in viewer_procs:
+                    line = _read_child_line(vp, "READY ", ramp_budget)
+                    readys.append(0 if line is None
+                                  else int(line.split()[1]))
+                rss1 = [_rss_kb(p.pid) for p in serve_procs]
+
+                # ---- measured window ----
+                wproc = subprocess.Popen(
+                    [sys.executable, me, "--writer-mode",
+                     "--writer-addrs", ",".join(addrs),
+                     "--write-rate", str(write_rate)],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL, cwd=here)
+                t0 = time.time()
+                time.sleep(seconds)
+                elapsed = time.time() - t0
+                stats = [_scrape_sse_stats(port) for port in ports]
+                for vp in viewer_procs:
+                    try:
+                        vp.stdin.write("STOP\n")
+                        vp.stdin.flush()
+                    except OSError:
+                        pass
+                results = []
+                for vp in viewer_procs:
+                    line = _read_child_line(vp, "RESULT ", 30.0)
+                    results.append(
+                        json.loads(line[len("RESULT "):])
+                        if line else {})
+            finally:
+                if wproc is not None:
+                    wproc.terminate()
+                    try:
+                        wproc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        wproc.kill()
+                for p in viewer_procs + serve_procs:
+                    try:
+                        p.stdin.close()
+                    except OSError:
+                        pass
+                for p in viewer_procs + serve_procs:
+                    try:
+                        p.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+
+            lag_all = [x for r in results for x in r.get("lags", [])]
+            connected = [r.get("connected", 0) for r in results]
+            rss_per_conn = [
+                round((b - a) / c, 1) if c > 0 else 0.0
+                for a, b, c in zip(rss0, rss1, connected)]
+            rung = {
+                "replicas": nrep,
+                "viewers_per_replica": viewers_per_replica,
+                "connected": connected,
+                "connected_aggregate": sum(connected),
+                "alive_aggregate": sum(r.get("alive", 0)
+                                       for r in results),
+                "lost": sum(r.get("lost", 0) for r in results),
+                "connect_errors": sum(r.get("connect_errors", 0)
+                                      for r in results),
+                "events_aggregate": sum(r.get("events", 0)
+                                        for r in results),
+                "seconds": round(elapsed, 2),
+                "lag_p50_ms": round(_pctl(lag_all, 0.50), 2),
+                "lag_p99_ms": round(_pctl(lag_all, 0.99), 2),
+                "lag_samples": len(lag_all),
+                "sse_dropped_slow": sum(
+                    s.get("dropped_slow_total", 0) for s in stats),
+                "sse_ring_evictions": sum(
+                    s.get("ring_evictions_total", 0) for s in stats),
+                "sse_loop_lag_p99_ms": max(
+                    [s.get("loop_lag_p99_ms", 0.0) for s in stats]
+                    or [0.0]),
+                "rss_per_conn_kb": rss_per_conn,
+            }
+            rungs.append(rung)
+            on_log(f"  connected {sum(connected)}/"
+                   f"{nrep * viewers_per_replica} "
+                   f"lag p99={rung['lag_p99_ms']}ms "
+                   f"drops={rung['sse_dropped_slow']} "
+                   f"rss/conn={rss_per_conn}KiB")
+
+        res = {
+            "push_ladder_backend": backend,
+            "push_ladder_sse_writer": sse_writer or "epoll",
+            "push_ladder_viewers_per_replica": viewers_per_replica,
+            "push_ladder_write_rate": write_rate,
+            "push_ladder": rungs,
+        }
+        base = next((r for r in rungs if r["replicas"] == 1), None)
+        for r in rungs:
+            if base is None or r is base or \
+                    base["connected_aggregate"] == 0:
+                continue
+            k = r["replicas"]
+            res[f"push_ladder_{k}x_connected_ratio"] = round(
+                r["connected_aggregate"]
+                / base["connected_aggregate"], 2)
+            res[f"push_ladder_{k}x_lag_ratio"] = round(
+                r["lag_p99_ms"] / max(base["lag_p99_ms"], 1e-9), 2)
+        return res
+    finally:
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        for l in logds:
+            try:
+                l.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def writer_main(addrs: str, write_rate: int) -> int:
     """Paced ingest as its own process: ``write_rate`` records/s in
     10 Hz beats, ``begin_ts`` stamped at creation (the publish-lag
@@ -449,9 +817,52 @@ def writer_main(addrs: str, write_rate: int) -> int:
         print(f"W {wrote}", flush=True)
 
 
+def quick_compare(args, on_log) -> int:
+    """The CI regression gate: a small epoll run vs the threaded
+    baseline on the same knobs.  Exit nonzero when epoll under-
+    delivers on connected count or publish lag (1.5x + 150 ms slack —
+    small-run lag percentiles on a loaded CPU host are noisy, but a
+    regression that matters blows through both)."""
+    res = {}
+    for mode in ("epoll", "threads"):
+        on_log(f"quick compare: {mode} writer")
+        res[mode] = run_push_bench(
+            viewers=args.viewers, seconds=args.seconds,
+            write_rate=args.write_rate, logd_shards=args.logd_shards,
+            poll_viewers=args.poll_viewers,
+            poll_interval=args.poll_interval, sse_writer=mode,
+            on_log=on_log)
+    e, t = res["epoll"], res["threads"]
+    conn_ok = (e["push_plane_viewers_connected"]
+               >= t["push_plane_viewers_connected"])
+    lag_ok = (e["push_plane_publish_lag_p99_ms"]
+              <= 1.5 * t["push_plane_publish_lag_p99_ms"] + 150.0)
+    out = {
+        "push_quick_epoll_connected": e["push_plane_viewers_connected"],
+        "push_quick_threads_connected":
+            t["push_plane_viewers_connected"],
+        "push_quick_epoll_lag_p99_ms":
+            e["push_plane_publish_lag_p99_ms"],
+        "push_quick_threads_lag_p99_ms":
+            t["push_plane_publish_lag_p99_ms"],
+        "push_quick_ok": bool(conn_ok and lag_ok),
+    }
+    text = json.dumps(out, indent=1)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text)
+    print(text)
+    if not conn_ok:
+        on_log("GATE FAIL: epoll connected below threaded baseline")
+    if not lag_ok:
+        on_log("GATE FAIL: epoll publish lag regressed vs threaded")
+    return 0 if (conn_ok and lag_ok) else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--viewers", type=int, default=200)
+    ap.add_argument("--viewers", type=int, default=200,
+                    help="SSE viewers (per replica in ladder mode)")
     ap.add_argument("--seconds", type=float, default=6.0)
     ap.add_argument("--write-rate", type=int, default=50,
                     help="paced ingest records/s during the window")
@@ -461,20 +872,70 @@ def main():
                          "extrapolated to --viewers for the ratio)")
     ap.add_argument("--poll-interval", type=float, default=1.0,
                     help="poll freshness the ratio compares against")
+    ap.add_argument("--writer", default="",
+                    choices=["", "epoll", "threads"],
+                    help="SSE writer mode (default: server default)")
+    ap.add_argument("--sample", type=int, default=64,
+                    help="viewers whose frames are parsed for lag")
+    ap.add_argument("--quick", action="store_true",
+                    help="small epoll-vs-threads compare; exits "
+                         "nonzero when epoll under-delivers")
+    ap.add_argument("--replicas", default="",
+                    help="comma ladder (e.g. 1,2,4): web-replica "
+                         "scale-out bench instead of the single run")
+    ap.add_argument("--out", default=None,
+                    help="replica-ladder sidecar path (git_rev-"
+                         "stamped, like MULTICHIP_ladder.json)")
     ap.add_argument("--json", default=None)
-    # internal: the ingest subprocess (run_push_bench spawns it)
+    # internal: the subprocess personalities this driver spawns
     ap.add_argument("--writer-mode", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--writer-addrs", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--serve-mode", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--serve-addrs", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--nofile", type=int, default=4096,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--viewer-mode", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--viewer-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.writer_mode:
         return writer_main(args.writer_addrs, args.write_rate)
+    if args.serve_mode:
+        return serve_main(args.serve_addrs, args.writer, args.nofile)
+    if args.viewer_mode:
+        return viewer_main(args.viewer_port, args.viewers, args.sample)
     on_log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    if args.quick:
+        return quick_compare(args, on_log)
+    if args.replicas:
+        reps = [int(x) for x in args.replicas.split(",") if x.strip()]
+        res = run_replica_ladder(
+            reps, viewers_per_replica=args.viewers,
+            seconds=args.seconds, write_rate=args.write_rate,
+            logd_shards=args.logd_shards, sample=args.sample,
+            sse_writer=args.writer or None, on_log=on_log)
+        res["git_rev"] = _bench_git_rev()
+        res["generated_at_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        out = json.dumps(res, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out)
+        if args.json:
+            with open(args.json, "w") as f:
+                f.write(out)
+        print(out)
+        return 0
     res = run_push_bench(viewers=args.viewers, seconds=args.seconds,
                          write_rate=args.write_rate,
                          logd_shards=args.logd_shards,
                          poll_viewers=args.poll_viewers,
                          poll_interval=args.poll_interval,
+                         sample=args.sample,
+                         sse_writer=args.writer or None,
                          on_log=on_log)
     out = json.dumps(res, indent=1)
     if args.json:

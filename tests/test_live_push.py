@@ -257,12 +257,15 @@ class _SseSock:
             pass
 
 
-@pytest.fixture
-def push_world():
+# both writer modes: the epoll pool (default) and the threaded
+# rollback must satisfy every contract below identically
+@pytest.fixture(params=["epoll", "threads"])
+def push_world(request):
     store = MemStore()
     sink = JobLogStore()
     srv = ApiServer(store, sink, auth_enabled=False, port=0,
-                    cache_enabled=True, push_enabled=True).start()
+                    cache_enabled=True, push_enabled=True,
+                    sse_writer=request.param).start()
     yield store, sink, srv
     srv.stop()
     store.close()
@@ -349,12 +352,13 @@ def test_sse_filters_server_side(push_world):
         c.close()
 
 
-@pytest.fixture
-def tenant_world():
+@pytest.fixture(params=["epoll", "threads"])
+def tenant_world(request):
     store = MemStore()
     sink = JobLogStore()
     srv = ApiServer(store, sink, port=0, cache_enabled=True,
-                    push_enabled=True).start()
+                    push_enabled=True,
+                    sse_writer=request.param).start()
     yield store, sink, srv
     srv.stop()
     store.close()

@@ -1,0 +1,411 @@
+"""Epoll SSE writer conformance (web/sse_epoll.py).
+
+The event-driven fan-out must be a drop-in for the PR 17 threaded
+writer: the wire contract — preamble, ``id:`` cursor lines, ``log``
+event frames, replay, the latched ``lost`` frame, the graceful-drain
+``bye`` — is pinned BYTE-FOR-BYTE by a differential test that runs the
+same scenario through both writers and compares raw bodies.  On top of
+that: the ring-overflow/eviction path (a slow consumer costs itself the
+stream, never tears a frame), heartbeats from the loop tick (no
+per-connection timer threads), the new /v1/metrics surface, and a
+tier-1 smoke at a few hundred concurrent viewers.  The ISSUE 18
+acceptance gates (10k viewers, replica-ladder scale-out) live at the
+bottom behind ``@pytest.mark.slow``.
+"""
+
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from cronsun_tpu.logsink import JobLogStore, LogRecord
+from cronsun_tpu.metrics import parse_exposition
+from cronsun_tpu.store import MemStore
+from cronsun_tpu.web.server import ApiServer
+
+
+def _rec(job="j1", node="n1", ok=True, begin=1000.0):
+    return LogRecord(job_id=job, job_group="g", name=f"name-{job}",
+                     node=node, user="", command="true", output="out",
+                     success=ok, begin_ts=begin, end_ts=begin + 2.0)
+
+
+def _connect(port, query=""):
+    """Open a raw SSE viewer; returns (socket, body-bytes-so-far) with
+    the HTTP response headers already stripped off."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    path = "/v1/stream" + (f"?{query}" if query else "")
+    s.sendall(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = s.recv(4096)
+        if not chunk:
+            raise AssertionError(f"EOF before headers: {buf!r}")
+        buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    assert b" 200 " in head.split(b"\r\n", 1)[0], head
+    return s, body
+
+
+def _read_until(s, body, nsep, timeout=10.0):
+    """Read until the body holds ``nsep`` frame separators (\\n\\n)."""
+    deadline = time.monotonic() + timeout
+    while body.count(b"\n\n") < nsep:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            break
+        s.settimeout(min(left, 1.0))
+        try:
+            chunk = s.recv(65536)
+        except (socket.timeout, TimeoutError):
+            continue
+        if not chunk:
+            break
+        body += chunk
+    return body
+
+
+def _read_to_eof(s, body, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            break
+        s.settimeout(min(left, 1.0))
+        try:
+            chunk = s.recv(65536)
+        except (socket.timeout, TimeoutError):
+            continue
+        except OSError:
+            break
+        if not chunk:
+            break
+        body += chunk
+    return body
+
+
+def _server(writer, **kw):
+    sink = JobLogStore()
+    srv = ApiServer(MemStore(), sink, auth_enabled=False, port=0,
+                    cache_enabled=False, push_enabled=True,
+                    sse_writer=writer, **kw).start()
+    return srv, sink
+
+
+# ---------------------------------------------------------------------------
+# Differential: both writers must emit the identical byte stream
+# ---------------------------------------------------------------------------
+
+def _scenario(writer):
+    """One full SSE lifecycle against a fresh server: live events, a
+    cursor resume, a queue-overflow eviction, and graceful drain.
+    Returns the raw bodies each viewer saw — record ids auto-increment
+    from 1 in a fresh JobLogStore, so two runs of this function produce
+    comparable bytes."""
+    srv, sink = _server(writer)
+    out = {}
+    try:
+        # -- live: a fresh viewer sees 3 events ------------------------
+        s1, b1 = _connect(srv.port)
+        sink.create_job_logs([_rec(job=f"a{i}") for i in range(3)])
+        b1 = _read_until(s1, b1, 4)          # preamble + 3 events
+        out["live"] = b1
+        cursor = b1.rsplit(b"id: ", 1)[1].split(b"\n", 1)[0].decode()
+        s1.close()
+
+        # -- resume: 2 records land while disconnected -----------------
+        sink.create_job_logs([_rec(job=f"b{i}") for i in range(2)])
+        time.sleep(0.3)                      # let the push vector advance
+        s2, b2 = _connect(srv.port, query=f"cursor={cursor}")
+        b2 = _read_until(s2, b2, 3)          # preamble + 2 replayed
+        out["resume"] = b2
+
+        # -- eviction: a tiny queue overflows on one batch -------------
+        srv._push.client_cap = 2
+        s3, b3 = _connect(srv.port)
+        sink.create_job_logs([_rec(job=f"c{i}") for i in range(6)])
+        # one create call is one batch to the client: 6 > cap 2 latches
+        # lost deterministically; the writer emits the frame and closes
+        b3 = _read_to_eof(s3, b3, timeout=8.0)
+        out["evict"] = b3
+        s3.close()
+        # s2 (cap 256) absorbed the c* batch; collect it before drain
+        b2 = _read_until(s2, b2, 3 + 6)
+
+        # -- graceful drain: bye on stop -------------------------------
+        stopper = threading.Thread(target=srv.stop, daemon=True)
+        stopper.start()
+        b2 = _read_to_eof(s2, b2, timeout=8.0)
+        stopper.join(timeout=15.0)
+        out["drain"] = b2
+        s2.close()
+    finally:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+        sink.close()
+    return out
+
+
+def test_epoll_and_threaded_writers_are_byte_identical(monkeypatch):
+    """ISSUE 18 rollback guarantee: CRONSUN_SSE_WRITER=threads restores
+    the old writer BYTE-IDENTICALLY — which also pins the epoll pool to
+    the PR 17 wire contract (preamble/id-cursor/replay/lost/bye)."""
+    monkeypatch.setenv("CRONSUN_SSE_HEARTBEAT", "60")  # no hb phase noise
+    threaded = _scenario("threads")
+    epoll = _scenario("epoll")
+    assert epoll == threaded
+    # and the shape itself is what PR 17 pinned, not just mutually equal
+    live = epoll["live"]
+    assert live.startswith(b"retry: 3000\n\n")
+    assert live.count(b"event: log\ndata: ") == 3
+    assert live.count(b"id: ") == 3
+    assert epoll["resume"].count(b"event: log\ndata: ") == 2
+    assert b'"job_id": "b0"' in epoll["resume"] \
+        or b'"b0"' in epoll["resume"]
+    assert epoll["evict"].endswith(b"event: lost\ndata: {}\n\n")
+    assert epoll["drain"].endswith(b"retry: 30000\nevent: bye\ndata: {}\n\n")
+
+
+# ---------------------------------------------------------------------------
+# Ring overflow -> latched lost, never a torn frame
+# ---------------------------------------------------------------------------
+
+def test_ring_overflow_evicts_and_latches_lost():
+    """A viewer whose kernel socket stops draining fills its bounded
+    outbound ring; the pool must evict it — latched ``lost`` frame,
+    counters bumped — WITHOUT ever tearing a frame mid-byte (a torn SSE
+    stream desyncs every subsequent frame boundary)."""
+    from cronsun_tpu.web.push import PushManager
+    from cronsun_tpu.web.sse_epoll import EpollSsePool
+
+    sink = JobLogStore()
+    pm = PushManager(sink)
+    pm.start()
+    pool = EpollSsePool(pm, nloops=1, sendbuf=8192)
+    a = b = None
+    try:
+        a, b = socket.socketpair()
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+        # a huge event queue so the PushManager-side cap never trips:
+        # the overflow under test is the pool's byte-bounded ring
+        client = pm.register({}, cap=100000)
+        pool.adopt(a, client, [])
+        a = None                              # pool owns it now
+        # push events in small batches while NOT reading b: the ring
+        # drains into the kernel buffer until it jams, then accumulates
+        # past sendbuf and the eviction path fires
+        deadline = time.monotonic() + 15.0
+        i = 0
+        while time.monotonic() < deadline:
+            sink.create_job_logs([_rec(job=f"o{i}-{j}") for j in range(20)])
+            i += 1
+            if pm.stats().get("ring_evictions_total", 0) >= 1:
+                break
+            time.sleep(0.02)
+        st = pm.stats()
+        assert st["ring_evictions_total"] >= 1, st
+        assert st["dropped_slow_total"] >= 1, st
+        assert st["client_lost_total"] >= 1, st
+        assert client.lost
+        # now drain the reader: everything that made it out must still
+        # parse frame-by-frame, and the stream must END with lost
+        data = _read_to_eof(b, b"", timeout=10.0)
+        assert data.endswith(b"event: lost\ndata: {}\n\n"), data[-120:]
+        for frame in data.split(b"\n\n"):
+            if not frame:
+                continue
+            assert frame.startswith((b"retry: ", b"id: ", b": hb",
+                                     b"event: lost")), frame[:80]
+        # the loop reaps the evicted conn
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sum(pool.stats()["loop_connections"]) == 0:
+                break
+            time.sleep(0.05)
+        assert sum(pool.stats()["loop_connections"]) == 0
+    finally:
+        pool.stop()
+        pm.stop()
+        for s in (a, b):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats come from the loop tick; idle viewers park threadless
+# ---------------------------------------------------------------------------
+
+def test_heartbeats_from_loop_tick(monkeypatch):
+    monkeypatch.setenv("CRONSUN_SSE_HEARTBEAT", "0.3")
+    srv, sink = _server("epoll")
+    try:
+        s, body = _connect(srv.port)
+        body = _read_until(s, body, 3, timeout=8.0)  # preamble + 2 hbs
+        assert body.count(b": hb\n\n") >= 2, body
+        s.close()
+    finally:
+        srv.stop()
+        sink.close()
+
+
+def test_idle_epoll_viewers_hold_no_threads(monkeypatch):
+    """The whole point of the refactor: N idle viewers cost the fixed
+    writer-loop pool, not N parked threads.  Under the threaded writer
+    20 viewers hold 20 handler threads; under epoll the handler thread
+    exits after socket adoption."""
+    monkeypatch.setenv("CRONSUN_SSE_HEARTBEAT", "60")
+    srv, sink = _server("epoll")
+    socks = []
+    try:
+        base = threading.active_count()
+        for _ in range(20):
+            socks.append(_connect(srv.port)[0])
+        # handler threads unwind after adopting; give them a beat
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if threading.active_count() - base <= 3:
+                break
+            time.sleep(0.05)
+        grown = threading.active_count() - base
+        assert grown <= 3, f"{grown} threads for 20 idle epoll viewers"
+        assert sum(srv._sse_pool.stats()["loop_connections"]) == 20
+    finally:
+        for s in socks:
+            s.close()
+        srv.stop()
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# Metrics surface
+# ---------------------------------------------------------------------------
+
+def test_metrics_expose_epoll_pool(monkeypatch):
+    monkeypatch.setenv("CRONSUN_SSE_HEARTBEAT", "60")
+    srv, sink = _server("epoll")
+    socks = []
+    try:
+        for _ in range(3):
+            socks.append(_connect(srv.port)[0])
+        sink.create_job_logs([_rec(job="m1"), _rec(job="m2")])
+        time.sleep(0.3)
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/metrics", timeout=5) as r:
+            text = r.read().decode()
+        m = parse_exposition(text)
+        flat = frozenset()
+        for name in ("cronsun_web_sse_writer_loops",
+                     "cronsun_web_sse_loop_lag_p50_ms",
+                     "cronsun_web_sse_loop_lag_p99_ms",
+                     "cronsun_web_sse_ring_evictions_total",
+                     "cronsun_web_sse_write_queue_bytes",
+                     "cronsun_web_sse_write_queue_frames"):
+            assert (name, flat) in m, name
+        nloops = int(m[("cronsun_web_sse_writer_loops", flat)])
+        per_loop = [m[("cronsun_web_sse_loop_connections",
+                       frozenset({("loop", str(i))}))]
+                    for i in range(nloops)]
+        assert sum(per_loop) == 3, per_loop
+        assert m[("cronsun_web_sse_ring_evictions_total", flat)] == 0
+    finally:
+        for s in socks:
+            s.close()
+        srv.stop()
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 smoke: a few hundred concurrent viewers through one pool
+# ---------------------------------------------------------------------------
+
+def test_smoke_three_hundred_viewers(monkeypatch):
+    monkeypatch.setenv("CRONSUN_SSE_HEARTBEAT", "60")
+    srv, sink = _server("epoll")
+    socks = []
+    try:
+        for _ in range(300):
+            socks.append(_connect(srv.port))
+        sink.create_job_logs([_rec(job=f"w{i}") for i in range(5)])
+        bodies = [_read_until(s, b, 6, timeout=20.0) for s, b in socks]
+        # every viewer registered before the batch at the same vector,
+        # so all 300 streams carry the same bytes: preamble + 5 events
+        assert all(b == bodies[0] for b in bodies)
+        assert bodies[0].count(b"event: log\ndata: ") == 5
+        st = srv._push.stats()
+        assert st["connections"] == 300
+        assert st["dropped_slow_total"] == 0
+        assert st["ring_evictions_total"] == 0
+    finally:
+        for s, _ in socks:
+            s.close()
+        srv.stop()
+        sink.close()
+
+
+# ---------------------------------------------------------------------------
+# Slow-tier acceptance gates (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _bench_push():
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import bench_push
+    return bench_push
+
+
+@pytest.mark.slow
+def test_ten_thousand_viewer_gate():
+    """ISSUE 18 acceptance, one replica: >=10k viewers with >=99%
+    connected, p99 lag < 1 s, zero drops, and RSS/conn <= 1/5 of the
+    threaded writer's (threaded baseline measured at 1k viewers — it
+    cannot hold 10k threads on this host, which is the point)."""
+    bp = _bench_push()
+    lad = bp.run_replica_ladder([1], viewers_per_replica=10000,
+                                seconds=10.0, write_rate=2,
+                                sse_writer="epoll",
+                                on_log=lambda m: None)
+    rung = lad["push_ladder"][0]
+    assert rung["connected_aggregate"] >= 9900, rung
+    assert rung["lag_p99_ms"] < 1000.0, rung
+    assert rung["sse_dropped_slow"] == 0, rung
+    assert rung["lost"] == 0, rung
+
+    base = bp.run_replica_ladder([1], viewers_per_replica=1000,
+                                 seconds=4.0, write_rate=2,
+                                 sse_writer="threads",
+                                 on_log=lambda m: None)
+    rss_epoll = rung["rss_per_conn_kb"][0]
+    rss_threads = base["push_ladder"][0]["rss_per_conn_kb"][0]
+    assert rss_epoll <= rss_threads / 5.0, (rss_epoll, rss_threads)
+
+
+@pytest.mark.slow
+def test_replica_ladder_two_rung_scaleout():
+    """ISSUE 18 acceptance, scale-out: the 2-replica rung sustains
+    >=1.8x the aggregate connected viewers of one replica at equal lag
+    (equal within noise — absolute lags at this load sit in the tens of
+    milliseconds, so a floor absorbs jitter)."""
+    bp = _bench_push()
+    lad = bp.run_replica_ladder([1, 2], viewers_per_replica=2000,
+                                seconds=6.0, write_rate=3,
+                                sse_writer="epoll",
+                                on_log=lambda m: None)
+    r1, r2 = lad["push_ladder"]
+    assert r2["connected_aggregate"] >= 1.8 * r1["connected_aggregate"], \
+        (r1["connected_aggregate"], r2["connected_aggregate"])
+    assert r2["lag_p99_ms"] <= max(2.0 * r1["lag_p99_ms"], 750.0), \
+        (r1["lag_p99_ms"], r2["lag_p99_ms"])
+    assert r2["sse_dropped_slow"] == 0, r2
