@@ -89,7 +89,8 @@ def synth_table(J, fire_period_lo, fire_period_hi, seed=0):
         active=np.ones(J, bool), paused=np.zeros(J, bool),
         has_dep=np.zeros(J, bool), dep_policy=np.zeros(J, np.int32),
         dep_cols=np.full((J, 8), -1, np.int32),
-        tenant=np.zeros(J, np.int32))
+        tenant=np.zeros(J, np.int32),
+        jitter=np.zeros(J, np.int32))
     # Uniform phases over each job's own period: steady aggregate fire rate
     # (clustered phases make bursty seconds that overflow the fired bucket).
     cols["phase_mod"] = (rng.integers(0, 1 << 30, J) % cols["period"]).astype(np.int32)
@@ -724,6 +725,27 @@ def main():
                 detail["trace_bench_error"] = proc.stderr[-500:]
         except Exception as e:  # noqa: BLE001
             detail["trace_bench_error"] = str(e)
+
+    # ---- herd smearing: minute-boundary p99 A/B @ 50k ----------------------
+    # Deterministic per-job jitter (ISSUE 19): the same minute-boundary
+    # herd with jitter 0 vs 30 s — the smeared arm's herd-second
+    # build+publish p99 must improve >= 2x with the fire set exactly
+    # matching the pure-Python reference (herd_* / herd_smear_* keys).
+    if not quick:
+        log("herd smearing: minute-boundary A/B @ 50k x 512")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "scripts",
+                                              "bench_sched.py"),
+                 "--herd", "--jobs", "50000", "--nodes", "512",
+                 "--jitter", "30"],
+                capture_output=True, text=True, timeout=1800, cwd=here)
+            if proc.returncode == 0:
+                detail.update(json.loads(proc.stdout))
+            else:
+                detail["herd_bench_error"] = proc.stderr[-500:]
+        except Exception as e:  # noqa: BLE001
+            detail["herd_bench_error"] = str(e)
 
     # ---- multi-tenant admission: skewed-tenant workload --------------------
     # Zipf victim tenants + one noisy tenant offering 10x its fire-rate

@@ -232,8 +232,10 @@ def cmd_jobs(api, args):
         rows.append([_gid(j), j.get("name"), KINDS.get(j.get("kind"), "?"),
                      "paused" if j.get("pause") else "",
                      len(j.get("rules") or []),
+                     j.get("jitter") or 0,
                      st.get("success", 0), st.get("failed", 0)])
-    table(rows, ["ID", "NAME", "KIND", "STATE", "RULES", "OK", "FAIL"])
+    table(rows, ["ID", "NAME", "KIND", "STATE", "RULES", "JITTER",
+                 "OK", "FAIL"])
 
 
 def cmd_job_get(api, args):

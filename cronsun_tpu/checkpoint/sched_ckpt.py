@@ -76,7 +76,8 @@ def pack_jobs(jobs: dict) -> list:
               # deps ride as (on, misfire, max_in_flight) or None —
               # positional like every other column
               None if j.deps is None
-              else (j.deps.on, j.deps.misfire, j.deps.max_in_flight)),
+              else (j.deps.on, j.deps.misfire, j.deps.max_in_flight),
+              j.jitter),
              [(r.id, r.timer, r.gids, r.nids, r.exclude_nids)
               for r in j.rules])
             for key, j in jobs.items()]
@@ -87,8 +88,11 @@ def unpack_jobs(packed: list) -> dict:
     out = {}
     with gc_paused():
         for key, f, rules in packed:
-            # pre-DAG checkpoints packed 14 columns; deps default None
+            # pre-DAG checkpoints packed 14 columns; deps default None.
+            # pre-jitter checkpoints packed 15; jitter defaults 0 (the
+            # smear arm stays disarmed for them, bit-identically).
             d = f[14] if len(f) > 14 else None
+            jit = f[15] if len(f) > 15 else 0
             out[tuple(key)] = Job(
                 id=f[0], name=f[1], group=f[2], command=f[3], user=f[4],
                 rules=[JobRule(id=r[0], timer=r[1], gids=r[2], nids=r[3],
@@ -98,7 +102,8 @@ def unpack_jobs(packed: list) -> dict:
                 fail_notify=f[12], to=f[13],
                 deps=None if d is None
                 else DepSpec(on=list(d[0]), misfire=d[1],
-                             max_in_flight=d[2]))
+                             max_in_flight=d[2]),
+                jitter=jit)
     return out
 
 

@@ -68,6 +68,11 @@ class Keyspace:
         return f"{self.once}{group}/{job_id}"
 
     def lock_key(self, job_id: str, epoch_s: int) -> str:
+        """Per-(job, second) execution dedup fence.  ``epoch_s`` is the
+        SCHEDULED epoch as emitted by the planner — for jobs with
+        ``jitter`` set that is the smeared epoch
+        (``s + fnv1a64("<job>|<s>") % (jitter+1)``), so a replayed or
+        re-planned window fences against exactly the same key."""
         return f"{self.lock}{job_id}/{epoch_s}"
 
     @property
@@ -190,8 +195,11 @@ class Keyspace:
     def dispatch_key(self, node_id: str, epoch_s: int, group: str,
                      job_id: str) -> str:
         """Legacy per-(node, second, job) exclusive order key — still
-        consumed by both agents for rollout tolerance, but the scheduler
-        now publishes :meth:`dispatch_bundle_key` instead."""
+        consumed by both agents for rollout tolerance; the scheduler
+        publishes :meth:`dispatch_bundle_key` for in-window fires, but
+        late smeared arrivals (spill-ring entries whose carrying window
+        has moved on) are emitted on this per-job form.  ``epoch_s`` is
+        always the SMEARED scheduled epoch when the job sets jitter."""
         return f"{self.dispatch}{node_id}/{epoch_s}/{group}/{job_id}"
 
     @staticmethod
@@ -213,7 +221,10 @@ class Keyspace:
         herd publishes at most one key per active node instead of one
         per fire (~20x fewer keys at the 1M x 10k scale); the key doubles
         as the scheduler's outstanding-capacity reservation for
-        len(value) exclusive slots until the per-job proc keys exist."""
+        len(value) exclusive slots until the per-job proc keys exist.
+        ``epoch_s`` is the scheduled second AFTER herd smearing: a
+        jittered job's order coalesces under its smeared epoch, which is
+        exactly what flattens the (node, second) key herd."""
         return f"{self.dispatch}{node_id}/{epoch_s}"
 
     # Common-kind fan-out: ONE broadcast order per (second, job); each
@@ -227,6 +238,8 @@ class Keyspace:
         return f"{self.dispatch}{self.BROADCAST}/"
 
     def dispatch_all_key(self, epoch_s: int, group: str, job_id: str) -> str:
+        """Broadcast Common-kind order.  Like every dispatch/fence key,
+        ``epoch_s`` is the smeared scheduled epoch for jittered jobs."""
         return f"{self.dispatch_all}{epoch_s}/{group}/{job_id}"
 
     def sess_key(self, sid: str) -> str:

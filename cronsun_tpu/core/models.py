@@ -206,6 +206,12 @@ class Job:
     # regardless of the fleet's trace_sample_shift (failure runs are
     # always sampled either way)
     trace: bool = False
+    # herd smearing: deterministic per-fire delay width in seconds
+    # (0..300).  A fire matched at logical second s is dispatched at
+    # s + fnv1a64("<id>|<s>") % (jitter+1) — no randomness, the same
+    # job/second pair always lands on the same smeared epoch across
+    # leaders and restores.  0 keeps today's exact-second behaviour.
+    jitter: int = 0
 
     # ---- validation (reference job.go:502-537) ---------------------------
 
@@ -233,6 +239,17 @@ class Job:
         if not _clean(self.command):
             raise ValidationError("command required")
         self.trace = bool(self.trace)
+        j = self.jitter
+        if isinstance(j, bool) or \
+                (not isinstance(j, int) and
+                 not (isinstance(j, float) and j.is_integer())):
+            raise ValidationError(
+                f"jitter must be an integer number of seconds, got {j!r}")
+        j = int(j)
+        if not 0 <= j <= 300:
+            raise ValidationError(
+                f"jitter must be in 0..300 seconds, got {j}")
+        self.jitter = j
         if isinstance(self.deps, dict):
             self.deps = DepSpec.from_dict(self.deps)
         if self.deps is not None:
@@ -241,6 +258,11 @@ class Job:
                 raise ValidationError(
                     f"job {self.id!r} cannot depend on itself")
         dep_triggered = self.deps is not None
+        if dep_triggered and self.jitter:
+            raise ValidationError(
+                "dep-triggered jobs cannot set jitter: their fires are "
+                "event-driven (upstream success), not cron-matched, so "
+                "there is no herd second to smear")
         if dep_triggered and not self.rules:
             raise ValidationError(
                 "dep-triggered jobs need at least one rule for "
@@ -287,6 +309,9 @@ class Job:
         if not self.trace:
             # wire compat: untraced jobs keep the pre-trace bytes
             d.pop("trace", None)
+        if not self.jitter:
+            # wire compat: unsmeared jobs keep the pre-jitter bytes
+            d.pop("jitter", None)
         return json.dumps(d, separators=(",", ":"))
 
     _FIELDS = None   # lazily cached field-name set (NOT annotated: an

@@ -118,7 +118,14 @@ def _plan_window_step(table: ScheduleTable, fields_w, elig, exclusive, cost,
     throttle/shed counts a third scan output.  False compiles ALL of it
     out — carry, outputs and every tenant operand vanish from the
     lowered module (they default to None), so a tenant-free table runs
-    the exact pre-tenancy program (pinned like the dep test)."""
+    the exact pre-tenancy program (pinned like the dep test).
+
+    The herd-smearing ``table.jitter`` column never appears in this
+    function: plans are built at logical seconds and the deterministic
+    per-fire shift is applied by the scheduler host at emission, so
+    jitter needs no static arm at all — the unused leaf is pruned by
+    jit and the lowered module is identical with or without it (pinned
+    in tests/test_jitter.py)."""
     from .tick import _fire_mask_jit
     cols = [fields_w[:, i] for i in range(7)]
     t_rel_w = fields_w[:, 6]

@@ -90,6 +90,14 @@ class ScheduleTable:
     # so this column is the durable row->tenant record (it rides
     # checkpoints with the table) rather than a per-tick operand.
     tenant: jax.Array      # int32
+    # herd smearing: per-row deterministic jitter width in seconds
+    # (0..300, 0 = fire exactly at the matched second).  The device tick
+    # never reads this column — the smear delta is evaluated on the host
+    # at plan emission (sched/service.py) from the cached per-row FNV
+    # state, so the lowered program is identical whether or not any row
+    # sets jitter.  Riding the table means checkpoints carry it for
+    # free, exactly like ``tenant``.
+    jitter: jax.Array      # int32
 
     @property
     def capacity(self) -> int:
@@ -100,7 +108,8 @@ _NO_DEPS = (DEP_EMPTY,) * MAX_DEPS
 
 
 def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
-             paused: bool = False, tenant: int = 0) -> dict:
+             paused: bool = False, tenant: int = 0,
+             jitter: int = 0) -> dict:
     """Host-side row dict for one spec (strings are parsed)."""
     if isinstance(spec, str):
         spec = parse(spec)
@@ -112,7 +121,8 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
             period=period,
             phase_mod=int((phase_epoch_s - FRAMEWORK_EPOCH) % period),
             active=True, paused=paused,
-            has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant)
+            has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant,
+            jitter=int(jitter))
     sec_lo, sec_hi = _split64(spec.second)
     min_lo, min_hi = _split64(spec.minute)
     return dict(
@@ -121,7 +131,8 @@ def make_row(spec: Union[CronSpec, EverySpec, str], phase_epoch_s: int = 0,
         month=spec.month & _MASK32, dow=spec.dow & _MASK32,
         dom_star=spec.dom_star, dow_star=spec.dow_star,
         is_every=False, period=1, phase_mod=0, active=True, paused=paused,
-        has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant)
+        has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=tenant,
+        jitter=int(jitter))
 
 
 def make_dep_row(upstream_rows, policy: int, paused: bool = False,
@@ -144,7 +155,7 @@ _DTYPES = dict(
     dom_star=np.bool_, dow_star=np.bool_, is_every=np.bool_,
     period=np.int32, phase_mod=np.int32, active=np.bool_, paused=np.bool_,
     has_dep=np.bool_, dep_policy=np.int32, dep_cols=np.int32,
-    tenant=np.int32,
+    tenant=np.int32, jitter=np.int32,
 )
 
 # per-field trailing shape beyond [capacity] (only the dep matrix is 2-D)
@@ -154,7 +165,7 @@ _INACTIVE_ROW = dict(
     sec_lo=0, sec_hi=0, min_lo=0, min_hi=0, hour=0, dom=0, month=0, dow=0,
     dom_star=False, dow_star=False, is_every=False, period=1, phase_mod=0,
     active=False, paused=False,
-    has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=0)
+    has_dep=False, dep_policy=0, dep_cols=_NO_DEPS, tenant=0, jitter=0)
 
 
 def build_table(specs: List[Union[CronSpec, EverySpec, str]],

@@ -92,7 +92,13 @@ def _fire_mask_jit(t: ScheduleTable, sec, mnt, hour, dom, month, dow, t_rel):
 def fire_mask(table: ScheduleTable, start_epoch_s: int, window_s: int = 1,
               tz=_UTC) -> jax.Array:
     """[J, window_s] bool: fire decisions for every job over the window of
-    seconds [start, start + window_s), wall-decomposed in ``tz``."""
+    seconds [start, start + window_s), wall-decomposed in ``tz``.
+
+    Fires are evaluated at the LOGICAL (cron-matched) second; the
+    ``table.jitter`` column is deliberately unread here — herd smearing
+    is a host-side shift applied at plan emission (sched/service.py), so
+    the lowered program is byte-identical whether or not any row sets
+    jitter."""
     f = window_fields(start_epoch_s, window_s, step_s=1, tz=tz)
     t_rel = np.arange(window_s, dtype=np.int64) + (start_epoch_s - FRAMEWORK_EPOCH)
     return _fire_mask_jit(table, jnp.asarray(f["sec"]), jnp.asarray(f["min"]),

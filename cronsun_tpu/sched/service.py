@@ -248,6 +248,43 @@ class SchedulerService:
         # build, the reference build and an overflow replan of the same
         # second all stamp ONE value (differentials stay byte-identical)
         self._tb_cache: Dict[int, float] = {}
+        # herd smearing: per-row jitter width (seconds, 0 = unsmeared),
+        # mirrored from Job.jitter beside the other _rd_* columns.  The
+        # smear delta for a fire of row r matched at logical second s is
+        # fnv_continue(tbase[r], str(s)) % (jitter[r]+1) — the SAME
+        # cached FNV state the trace ids continue, so the whole fired
+        # vector smears in one O(digits) numpy pass.  _jitter_jobs
+        # counts registered jobs with jitter > 0: while it is zero and
+        # the spill ring is empty, _build_plan_orders dispatches
+        # straight to the unsmeared build and the order wire stays
+        # byte-identical to the pre-jitter program (the use_deps/
+        # use_tenants disarm pattern, host-side edition).
+        self._rd_jitter = np.zeros(J, np.int32)
+        self._jitter_jobs = 0
+        self._max_jitter_seen = 0     # monotone max of live jitters
+        # spill ring: fires whose smeared epoch lands past the window
+        # being built wait here for a later window.  target epoch ->
+        # {src_epoch: [rows, cols, emitted]} — GROUPED arrays, one
+        # group per source second (all of a source's deferred fires for
+        # one target share a fate: merged together, late-flushed
+        # together, re-marked together), so the herd second's ~J/s
+        # deferrals cost <= jitter vectorized slices instead of J dict
+        # inserts.  NOT consumed on read (a hole-rewind rebuild must
+        # re-emit the same arrivals so the bundle overwrite stays a
+        # superset); pruned once the publisher's landed watermark
+        # passes the target.  ``emitted`` gates the rare LATE path only
+        # (an overflow replan smearing into an already-published
+        # second) — those go out as standalone legacy per-job orders,
+        # exactly once unless a publish failure clears the marks for a
+        # merge-idempotent re-emission.
+        self._smear_ring: Dict[int, Dict[int, list]] = {}
+        self._smear_ring_n = 0
+        self._smear_ring_cap = max(65536, 4 * J)
+        self._smear_recovered = False
+        self._smear_stats = {"deferred_total": 0, "emitted_total": 0,
+                             "merged_dups_total": 0, "late_emits_total": 0,
+                             "ring_drops_total": 0, "max_spread_s": 0,
+                             "max_second_arrivals": 0}
         # reverse col -> node-id map, maintained on node churn instead of
         # being rebuilt from universe.index every step (+ a bool mask of
         # live columns for the vectorized build)
@@ -914,8 +951,17 @@ class SchedulerService:
         job.group, job.id = group, job_id
         old_rules = self.rows.rules_of(group, job_id)
         new_rules = set()
+        prev_reg = self.jobs.get((group, job_id))
         self.jobs[(group, job_id)] = job
         jk = (group, job_id)
+        # herd-smear arm counter: registry-level (rows churn through
+        # _drop_rule which deliberately leaves stale cells behind flags)
+        self._jitter_jobs += ((1 if getattr(job, "jitter", 0) > 0 else 0)
+                              - (1 if prev_reg is not None
+                                 and getattr(prev_reg, "jitter", 0) > 0
+                                 else 0))
+        if getattr(job, "jitter", 0) > self._max_jitter_seen:
+            self._max_jitter_seen = int(job.jitter)
         tid = self._tenant_id(job.tenant) if job.tenant else 0
         dep_spec = self._dep_spec_apply(jk, job)
         dep_row_dict = None
@@ -972,7 +1018,7 @@ class SchedulerService:
                 self._row_phase[row] = (rule.timer, phase_epoch)
             self._table_updates[row] = make_row(
                 spec, phase_epoch_s=phase_epoch, paused=job.pause,
-                tenant=tid)
+                tenant=tid, jitter=getattr(job, "jitter", 0))
             if self._row_tenant[row] != tid:
                 self._row_tenant[row] = tid
                 self._tenant_row_updates[row] = tid
@@ -1019,6 +1065,7 @@ class SchedulerService:
         self._rd_tbase[row] = np.uint64(
             self._trace.fnv_partial(job_id + "|"))
         self._rd_tflag[row] = bool(getattr(job, "trace", False))
+        self._rd_jitter[row] = int(getattr(job, "jitter", 0) or 0)
         self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
                                | (4 if job.kind == KIND_ALONE else 0))
 
@@ -1475,7 +1522,9 @@ class SchedulerService:
     def _drop_job(self, group: str, job_id: str):
         for rule_id in self.rows.rules_of(group, job_id):
             self._drop_rule(group, job_id, rule_id)
-        self.jobs.pop((group, job_id), None)
+        dropped = self.jobs.pop((group, job_id), None)
+        if dropped is not None and getattr(dropped, "jitter", 0) > 0:
+            self._jitter_jobs -= 1
         jk = (group, job_id)
         spec = self._dep_jobs.pop(jk, None)
         if spec is not None:
@@ -2468,6 +2517,11 @@ class SchedulerService:
                 if "tenant" not in tbl and "sec_lo" in tbl:
                     tbl["tenant"] = np.zeros(
                         len(tbl["sec_lo"]), np.int32)
+                # pre-jitter checkpoints predate the jitter column:
+                # default it (no smear) under the same contract
+                if "jitter" not in tbl and "sec_lo" in tbl:
+                    tbl["jitter"] = np.zeros(
+                        len(tbl["sec_lo"]), np.int32)
                 table = ScheduleTable(**{k: jnp.asarray(v)
                                          for k, v in tbl.items()})
                 elig = jnp.asarray(st["elig"])
@@ -2574,10 +2628,21 @@ class SchedulerService:
         self._rd_suffix = rd["suffix"]
         self._rd_bentry = rd["bentry"]
         self._rd_job = rd["job"]
-        # trace-plane row caches are NOT checkpointed (pre-trace
-        # checkpoints must keep restoring): re-derive them from the
-        # restored rows when stamping is armed
-        if self.trace_shift >= 0:
+        # trace-plane and smear-plane row caches are NOT checkpointed
+        # (pre-trace / pre-jitter checkpoints must keep restoring):
+        # re-derive them from the restored rows.  The jitter registry
+        # counters come from the restored jobs either way — they gate
+        # the smear arm and cost nothing when zero.
+        self._jitter_jobs = 0
+        self._max_jitter_seen = 0
+        for job in self.jobs.values():
+            jw = int(getattr(job, "jitter", 0) or 0)
+            if jw > 0:
+                self._jitter_jobs += 1
+                if jw > self._max_jitter_seen:
+                    self._max_jitter_seen = jw
+        self._rd_jitter = np.zeros(len(self._rd_flags), np.int32)
+        if self.trace_shift >= 0 or self._jitter_jobs:
             self._rd_tbase = np.zeros(len(self._rd_flags), np.uint64)
             self._rd_tflag = np.zeros(len(self._rd_flags), bool)
             for row, gj in enumerate(self._rd_job):
@@ -2588,6 +2653,8 @@ class SchedulerService:
                 job = self.jobs.get((gj[0], gj[1]))
                 self._rd_tflag[row] = bool(job and
                                            getattr(job, "trace", False))
+                self._rd_jitter[row] = int(
+                    getattr(job, "jitter", 0) or 0) if job else 0
         self._col_node = st["col_node"]
         self._col_live = st["col_live"]
         m = st["mirrors"]
@@ -3097,6 +3164,12 @@ class SchedulerService:
             if self._ae_thread is not None:
                 self._ae_rekick = True
             self._maybe_antientropy_bg()
+        if not led_before:
+            # herd smearing: the spill ring is planning-derived state
+            # and never checkpointed — a fresh leadership (cold or warm)
+            # re-derives the in-flight deferred fires from a bounded
+            # lookback once the cursor is known (below)
+            self._smear_recovered = False
         self.reconcile_capacity()
         if self.partitions > 1:
             # leaders announce their per-node demand so every OTHER
@@ -3107,12 +3180,15 @@ class SchedulerService:
         self._flush_device()
         t = span("flush", t)
         start = self._next_epoch
+        fresh_cursor = start is None
+        had_hwm = False
         if start is None:
             # fresh leadership: resume from the persisted high-water mark so
             # seconds the previous leader already dispatched aren't planned
             # twice (Common jobs have no per-second fence)
             start = now + 1
             hwm_kv = self.store.get(self._hwm_key)
+            had_hwm = hwm_kv is not None
             if hwm_kv is not None:
                 try:
                     # never ahead of a sane bound; the catch-up clamp below
@@ -3121,6 +3197,16 @@ class SchedulerService:
                 except ValueError:
                     pass
         fe = self.publisher.take_failed_epoch()
+        if fe is not None and self._smear_ring:
+            # spill entries emitted by windows at/after the hole are
+            # unconfirmed: clear their marks so the rebuild (or the
+            # next window's late flush) re-emits them — idempotent
+            # downstream (bundle re-read is the same superset; legacy/
+            # broadcast keys are per-fire puts behind fences)
+            for bucket in self._smear_ring.values():
+                for g in bucket.values():
+                    if g[2] is not None and g[2] >= fe:
+                        g[2] = None
         if fe is not None and fe < start:
             # a window's publish failed after retries: the HWM stopped
             # there, and so must the in-memory cursor — rewind and
@@ -3142,6 +3228,14 @@ class SchedulerService:
             if self.publisher.clear_failed_epoch_below(start):
                 log.warnf("publish hole aged past max_catchup_s; its "
                           "seconds were skipped and the hole cleared")
+        if self._jitter_jobs and not self._smear_recovered:
+            self._smear_recovered = True
+            if fresh_cursor and had_hwm:
+                # a previous leader dispatched up to the HWM: re-derive
+                # whatever it smeared past that point.  A fresh cluster
+                # (no HWM) has no in-flight spill — and must not invent
+                # fires for seconds older than its own birth.
+                self._smear_recover(start)
         window = max(1, self.window_s)
         if self.pipelined:
             n_dispatch = n_done + self._step_pipelined(start, window,
@@ -3207,6 +3301,10 @@ class SchedulerService:
                     (self.planner.gather_window(
                         self._resolve_handle(handle))[0], False))
         build_list += [(p, True) for p in plans]
+        if self._smear_ring:
+            self._smear_begin(
+                min([start] + [p.epoch_s for p, _ in build_list]),
+                seconds, excl_acct)
         for plan, may_replan in build_list:
             if plan.overflow:
                 # never drop a fire: re-plan this second with a bucket
@@ -3358,6 +3456,11 @@ class SchedulerService:
             t = time.perf_counter()
             seconds: List[Tuple[int, list]] = []
             wpend: Dict[int, int] = {}
+            if self._smear_ring:
+                self._smear_begin(
+                    min([item.covers_from]
+                        + [p.epoch_s for p, _ in build_list]),
+                    seconds, acct["excl"])
             for plan, may_replan in build_list:
                 if plan.overflow:
                     if may_replan:
@@ -3482,6 +3585,324 @@ class SchedulerService:
                            excl_acct: List[Tuple[str, str, list]],
                            pending_excl: Optional[Dict[int, int]] = None
                            ) -> int:
+        """Emission dispatch: while no registered job sets jitter and
+        the spill ring is empty, run the unsmeared vectorized build
+        directly — zero per-plan overhead, order wire byte-identical to
+        the pre-jitter program (the host-side analogue of the
+        use_deps/use_tenants disarm).  Armed, the smear pass splits the
+        plan at the deterministic per-fire deltas first."""
+        if self._jitter_jobs or self._smear_ring:
+            return self._build_plan_orders_smeared(
+                plan, seconds, excl_acct, pending_excl=pending_excl)
+        return self._build_plan_orders_native(
+            plan, seconds, excl_acct, pending_excl=pending_excl)
+
+    def _build_plan_orders_smeared(self, plan,
+                                   seconds: List[Tuple[int, list]],
+                                   excl_acct: List[Tuple[str, str, list]],
+                                   pending_excl: Optional[Dict[int, int]]
+                                   = None) -> int:
+        """Herd-smearing emission pass.  A fire of row r matched at
+        logical second s is scheduled at s + fnv_continue(tbase[r],
+        str(s)) % (jitter[r]+1): the delta vector is ONE vectorized FNV
+        continuation over the fired rows (the same cached per-row
+        partial hash the trace ids continue — O(digits) numpy ops per
+        second, no per-fire Python hashing) — deterministic, so every
+        leader/restore smears a given (job, second) to the SAME epoch.
+
+        delta == 0 fires stay native.  delta > 0 fires enter the spill
+        ring keyed by their smeared target second; when the build
+        reaches that second (same window, a later window, or a
+        hole-rewind rebuild) the target's arrivals are PREPENDED to its
+        native fires — oldest source second first — and
+        the merged plan runs through the unsmeared vectorized build, so
+        coalescing, the KindAlone live-lock skip, the tenancy
+        max_running clamp, the herd gauges and trace sampling all apply
+        at the EMISSION second.  Fences, (node, second) bundle keys and
+        dedup therefore key on the smeared epoch with no downstream
+        change, and agents derive trace ids from the order-key epoch
+        exactly as before.
+
+        The ring is NOT consumed on read: a rebuilt window re-reads the
+        same arrivals, keeping the bundle-overwrite-is-a-superset
+        contract; entries are pruned once the publisher's landed
+        watermark passes both the target second and the second that
+        emitted them (see _smear_begin, which also flushes the rare
+        LATE arrivals an overflow replan smears into already-published
+        seconds)."""
+        ep = int(plan.epoch_s)
+        rows = np.asarray(plan.fired)
+        keep = None
+        if rows.size:
+            jit = self._rd_jitter[rows]
+            if jit.any():
+                tids = self._trace.fnv_continue_vec(
+                    self._rd_tbase[rows], str(ep))
+                delta = (tids % (jit.astype(np.uint64) + np.uint64(1))
+                         ).astype(np.int64)
+                defer = np.flatnonzero(delta > 0)
+                if defer.size:
+                    cols_all = np.asarray(plan.assigned)
+                    st = self._smear_stats
+                    st["deferred_total"] += int(defer.size)
+                    spread = int(delta.max())
+                    if spread > st["max_spread_s"]:
+                        st["max_spread_s"] = spread
+                    ring = self._smear_ring
+                    drops = 0
+                    d_rows = rows[defer].astype(np.int64)
+                    d_cols = cols_all[defer].astype(np.int64)
+                    d_del = delta[defer]
+                    # one grouped insert per distinct delta (<= jitter
+                    # of them): the herd second's ~J deferrals are a
+                    # handful of array slices, not J dict entries
+                    order = np.argsort(d_del, kind="stable")
+                    uniq, starts = np.unique(d_del[order],
+                                             return_index=True)
+                    bounds = np.append(starts, order.size)
+                    for u in range(uniq.size):
+                        sl = order[bounds[u]:bounds[u + 1]]
+                        tgt = ep + int(uniq[u])
+                        bucket = ring.get(tgt)
+                        if bucket is None:
+                            bucket = ring[tgt] = {}
+                        if ep in bucket:
+                            continue    # window rebuild: the group (and
+                            #             its emitted mark) is present —
+                            #             deterministic smear, same set
+                        room = self._smear_ring_cap - self._smear_ring_n
+                        if room <= 0:
+                            drops += sl.size
+                            continue
+                        if sl.size > room:
+                            drops += sl.size - room
+                            sl = sl[:room]
+                        bucket[ep] = [d_rows[sl], d_cols[sl], None]
+                        self._smear_ring_n += int(sl.size)
+                    if drops:
+                        st["ring_drops_total"] += drops
+                        log.errorf("smear spill ring full (cap %d): "
+                                   "dropped %d deferred fires of second "
+                                   "%d", self._smear_ring_cap, drops, ep)
+                    keep = delta == 0
+        bucket = self._smear_ring.get(ep)
+        if not bucket and keep is None:
+            # nothing smears away and nothing arrives: the native build
+            # byte-identically (the common case for off-herd seconds)
+            return self._build_plan_orders_native(
+                plan, seconds, excl_acct, pending_excl=pending_excl)
+        nat_rows = rows if keep is None else rows[keep]
+        if keep is not None:
+            nat_cols = np.asarray(plan.assigned)[keep]
+        else:
+            nat_cols = np.asarray(plan.assigned)
+        if bucket:
+            st = self._smear_stats
+            gr: List[np.ndarray] = []
+            gc: List[np.ndarray] = []
+            for _src, g in sorted(bucket.items()):
+                g[2] = ep   # emitted with (and re-marked by any rebuild
+                #             of) this second; un-marked on publish holes
+                gr.append(g[0])
+                gc.append(g[1])
+            comb_r = np.concatenate(gr)
+            comb_c = np.concatenate(gc)
+            # one (job, second) fire: keep each row's FIRST arrival
+            # (oldest source), drop rows that also fire natively at the
+            # target — the fence would absorb the twin anyway, don't
+            # publish it twice in one bundle
+            _, first = np.unique(comb_r, return_index=True)
+            keep_m = np.zeros(comb_r.size, bool)
+            keep_m[first] = True
+            if nat_rows.size:
+                keep_m &= ~np.isin(comb_r, nat_rows)
+            arr_rows = comb_r[keep_m]
+            arr_cols = comb_c[keep_m]
+            dups = int(comb_r.size - arr_rows.size)
+            if dups:
+                st["merged_dups_total"] += dups
+            st["emitted_total"] += int(arr_rows.size)
+            if arr_rows.size > st["max_second_arrivals"]:
+                st["max_second_arrivals"] = int(arr_rows.size)
+            fired = np.concatenate(
+                [arr_rows, np.asarray(nat_rows, np.int64)])
+            assigned = np.concatenate(
+                [arr_cols, np.asarray(nat_cols, np.int64)])
+        else:
+            fired = nat_rows
+            assigned = nat_cols
+        from ..ops.planner import TickPlan
+        synth = TickPlan(epoch_s=ep, fired=fired, assigned=assigned,
+                         overflow=0, total_fired=int(fired.size),
+                         tenant_throttled=plan.tenant_throttled,
+                         tenant_shed=plan.tenant_shed)
+        return self._build_plan_orders_native(
+            synth, seconds, excl_acct, pending_excl=pending_excl)
+
+    def _smear_begin(self, cover_from: int,
+                     seconds: List[Tuple[int, list]],
+                     excl_acct: List[Tuple[str, str, list]]):
+        """Spill-ring window prologue (build thread, before the plan
+        loop): flush LATE arrivals and prune landed targets.
+
+        LATE: an overflow replan re-plans second s a step after s's
+        window shipped; fires it smears to (s, s+jitter] may target
+        seconds this build no longer covers.  Those can't ride their
+        target's (node, second) bundle — it may already be published,
+        and overwriting it with a reconstruction is exactly the
+        non-superset hazard the ring exists to avoid — so they go out
+        as standalone seconds entries on the LEGACY per-(node, second,
+        job) order keys (agents keep that parser for rollout
+        tolerance); Common fires reuse their idempotent per-(job,
+        second) broadcast key.  Entries are marked with the second that
+        emitted them rather than removed: a publish hole >= that mark
+        clears it (step()) and the re-emission is idempotent
+        downstream.
+
+        PRUNE: a target drops once the landed watermark has passed both
+        the target and every entry's emitting second — nothing can
+        rewind to re-build it anymore."""
+        ring = self._smear_ring
+        if not ring:
+            return
+        n_late = 0
+        late_orders = []
+        for t in sorted(k for k in ring if k < cover_from):
+            bucket = ring[t]
+            if all(g[2] is not None for g in bucket.values()):
+                continue
+            orders: List[Tuple[str, str]] = []
+            ep = str(t)
+            for _src, g in sorted(bucket.items()):
+                if g[2] is not None:
+                    continue
+                g[2] = cover_from
+                # per-fire loop is fine here: LATE arrivals are the
+                # rare overflow-replan tail, never the herd
+                for row, col in zip(g[0].tolist(), g[1].tolist()):
+                    flags = self._rd_flags[row]
+                    if not flags & 1:
+                        continue    # job dropped since the source plan
+                    if flags & 4 and self._alone_live and \
+                            self._rd_job[row][1] in self._alone_live:
+                        continue    # KindAlone lifetime lock is live
+                    if flags & 2:
+                        if not (0 <= col < len(self._col_node)
+                                and self._col_live[col]):
+                            continue    # placed node left the fleet
+                        node = self._col_node[col]
+                        key = (self.ks.dispatch + node + "/" + ep
+                               + self._rd_suffix[row])
+                        orders.append((key, self._rd_payload[row]))
+                        excl_acct.append((key, node,
+                                          [self._rd_job[row]]))
+                    else:
+                        orders.append((self.ks.dispatch_all + ep
+                                       + self._rd_suffix[row],
+                                       self._rd_payload[row]))
+                    n_late += 1
+            if orders:
+                late_orders.append((t, orders))
+        if late_orders:
+            # oldest first, ahead of this window's native seconds
+            seconds.extend(late_orders)
+            self._smear_stats["late_emits_total"] += n_late
+            log.warnf("smear: %d late fire(s) across %d second(s) "
+                      "published on legacy order keys (overflow replan "
+                      "smeared past its window)", n_late,
+                      len(late_orders))
+        pt = self.publisher.published_through
+        if pt:
+            for t in [t for t in ring if t < pt]:
+                bucket = ring[t]
+                if all(g[2] is not None and g[2] < pt
+                       for g in bucket.values()):
+                    self._smear_ring_n -= sum(
+                        int(g[0].size) for g in bucket.values())
+                    del ring[t]
+
+    def _smear_recover(self, start: int):
+        """Fresh-leadership spill reconstruction.  The ring is
+        deliberately NOT checkpointed (delta chains record watch
+        events; planning-derived state must be derivable), but fires a
+        dead leader smeared PAST its final window still owe dispatch:
+        any entry targeting second >= start has its source in
+        [start - max_jitter, start).  Re-plan that lookback, compute
+        ONLY the smear deltas (no emission, no admission hand-backs —
+        throttle state replay would double-count), and insert targets
+        >= start; targets below start were the dead leader's to publish
+        and fences absorb whatever both of us emit.  Runs once per
+        leadership, only while some job arms jitter; planner-state
+        perturbation from re-planning old seconds is the same class a
+        hole rewind already causes and reconcile_capacity self-heals
+        it."""
+        look = min(300, int(self._max_jitter_seen))
+        if look <= 0:
+            return
+        t0 = time.perf_counter()
+        window = max(1, self.window_s)
+        inserted = 0
+        s0 = start - look
+        while s0 < start:
+            w = min(window, start - s0)
+            try:
+                plans = self.planner.plan_window(s0, w)
+            except Exception as e:  # noqa: BLE001 — lookback is best
+                # effort: a failed replay loses only already-published
+                # seconds' spill, which fences would have absorbed
+                log.errorf("smear lookback plan failed at %d: %s", s0, e)
+                break
+            for plan in plans:
+                ep = int(plan.epoch_s)
+                rows = np.asarray(plan.fired)
+                if not rows.size:
+                    continue
+                jit = self._rd_jitter[rows]
+                if not jit.any():
+                    continue
+                tids = self._trace.fnv_continue_vec(
+                    self._rd_tbase[rows], str(ep))
+                delta = (tids % (jit.astype(np.uint64) + np.uint64(1))
+                         ).astype(np.int64)
+                cols = np.asarray(plan.assigned)
+                defer = np.flatnonzero(delta > 0)
+                if not defer.size:
+                    continue
+                d_rows = rows[defer].astype(np.int64)
+                d_cols = cols[defer].astype(np.int64)
+                d_del = delta[defer]
+                order = np.argsort(d_del, kind="stable")
+                uniq, starts = np.unique(d_del[order],
+                                         return_index=True)
+                bounds = np.append(starts, order.size)
+                for u in range(uniq.size):
+                    tgt = ep + int(uniq[u])
+                    if tgt < start:
+                        continue
+                    sl = order[bounds[u]:bounds[u + 1]]
+                    bucket = self._smear_ring.setdefault(tgt, {})
+                    if ep in bucket:
+                        continue
+                    room = self._smear_ring_cap - self._smear_ring_n
+                    if room <= 0:
+                        continue
+                    if sl.size > room:
+                        sl = sl[:room]
+                    bucket[ep] = [d_rows[sl], d_cols[sl], None]
+                    self._smear_ring_n += int(sl.size)
+                    inserted += int(sl.size)
+            s0 += w
+        if inserted:
+            log.infof("smear takeover recovery: re-derived %d in-flight "
+                      "deferred fire(s) from a %ds lookback in %.0f ms",
+                      inserted, look,
+                      (time.perf_counter() - t0) * 1e3)
+
+    def _build_plan_orders_native(self, plan,
+                                  seconds: List[Tuple[int, list]],
+                                  excl_acct: List[Tuple[str, str, list]],
+                                  pending_excl: Optional[Dict[int, int]]
+                                  = None) -> int:
         """Build one TickPlan's dispatch orders into ``seconds`` (and
         the exclusive-accounting list) — the leader's share of the
         dispatch plane, VECTORIZED: the herd-second build was 703 ms
@@ -3752,11 +4173,15 @@ class SchedulerService:
             excl_acct: List[Tuple[str, str, list]] = []
             wpend: Dict[int, int] = {}
             n = 0
-            for _ep, handle, _fires in pending:
+            gathered = [self.planner.gather_window(
+                self._resolve_handle(handle))[0]
+                for _ep, handle, _fires in pending]
+            if self._smear_ring and gathered:
+                self._smear_begin(min(p.epoch_s for p in gathered),
+                                  seconds, excl_acct)
+            for plan in gathered:
                 n += self._build_plan_orders(
-                    self.planner.gather_window(
-                        self._resolve_handle(handle))[0], seconds,
-                    excl_acct, pending_excl=wpend)
+                    plan, seconds, excl_acct, pending_excl=wpend)
             self.publisher.submit(seconds, lease, 0)
             for key, node, jobs in excl_acct:
                 self._acct_add_order(key, node, jobs)
@@ -3914,6 +4339,26 @@ class SchedulerService:
             "publish_max_second_keys": self.publisher.max_second_keys,
             "publish_max_second_node_keys": self.max_second_node_keys,
             "publish_max_second_excl_fires": self.max_second_excl_fires,
+            # herd-smearing plane: jobs arming jitter, fires deferred
+            # past their matched second / re-emitted at their smeared
+            # one, the widest observed delta and the largest arrival
+            # burst any single smeared second absorbed (the smeared
+            # twins of the herd gauges above), plus spill-ring health
+            # (late = overflow-replan spill emitted on legacy keys;
+            # drops = ring cap exceeded, LOUD — fires were lost)
+            "smear_jobs": self._jitter_jobs,
+            "smear_deferred_total": self._smear_stats["deferred_total"],
+            "smear_emitted_total": self._smear_stats["emitted_total"],
+            "smear_merged_dups_total":
+                self._smear_stats["merged_dups_total"],
+            "smear_late_emits_total":
+                self._smear_stats["late_emits_total"],
+            "smear_ring_depth": self._smear_ring_n,
+            "smear_ring_drops_total":
+                self._smear_stats["ring_drops_total"],
+            "smear_max_spread_s": self._smear_stats["max_spread_s"],
+            "smear_max_second_arrivals":
+                self._smear_stats["max_second_arrivals"],
             # checkpoint plane: save cadence health + whether this
             # instance booted warm (restored=1) and how fast
             "checkpoint_saves_total": self._ckpt_stats["saves_total"],
@@ -3952,6 +4397,21 @@ class SchedulerService:
                 for c in self._tenant_counters.values()),
             "tenant_shed_fires_total": sum(
                 c["shed_fires"] for c in self._tenant_counters.values()),
+        }
+
+    def smear_snapshot(self) -> dict:
+        """Per-second smear spread: how many deferred fires currently
+        wait in the spill ring for each upcoming target second (plus
+        the cumulative counters metrics_snapshot flattens).  Operator
+        surface for 'is the herd actually spreading': a healthy smeared
+        herd shows ~herd/(jitter+1) arrivals per second across the
+        jitter width instead of one spike."""
+        return {
+            "ring_depth": self._smear_ring_n,
+            "ring_seconds": len(self._smear_ring),
+            "per_second": {int(t): sum(int(g[0].size) for g in b.values())
+                           for t, b in sorted(self._smear_ring.items())},
+            **self._smear_stats,
         }
 
     def _advance_hwm(self, value: int):

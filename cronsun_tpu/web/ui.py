@@ -83,6 +83,7 @@ const L={en:{
  kCommon:'Common (all eligible nodes)',kAlone:'Alone (exactly one)',
  kInterval:'Interval (one per interval)',user:'user',timeoutS:'timeout s',
  retry:'retry',parallels:'parallels',
+ jitterS:'jitter s (0-300, smears herd)',
  cronTimer:'cron timer (sec min hour dom month dow)',
  nodeIds:'node ids (comma)',groupIds:'group ids',excludeNodes:'exclude nodes',
  delJobQ:'delete job?',delGroupQ:'delete group?',dispatched:'dispatched',
@@ -115,6 +116,7 @@ const L={en:{
  kCommon:'普通（所有可选节点执行）',kAlone:'单机（只在一个节点执行）',
  kInterval:'间隔（每个间隔一次）',user:'用户',timeoutS:'超时(秒)',
  retry:'重试次数',parallels:'并发上限',
+ jitterS:'抖动秒数（0-300，打散同秒任务）',
  cronTimer:'cron 定时器（秒 分 时 日 月 周）',
  nodeIds:'节点 ID（逗号分隔）',groupIds:'分组 ID',excludeNodes:'排除节点',
  delJobQ:'确定删除该任务？',delGroupQ:'确定删除该分组？',dispatched:'已派发',
@@ -177,7 +179,7 @@ const render={
   <table><tr><th>${t('name')}</th><th>${t('group')}</th><th>${t('command')}</th><th>${t('kind')}</th><th>${t('timers')}</th><th>${t('status')}</th><th></th></tr>
   ${js.map((j,i)=>`<tr><td>${esc(j.name)}</td><td>${esc(j.group)}</td><td><code>${esc(j.command)}</code></td>
    <td>${['Common','Alone','Interval'][j.kind]||j.kind}</td>
-   <td>${(j.rules||[]).map(r=>esc(r.timer)).join('<br>')}</td>
+   <td>${(j.rules||[]).map(r=>esc(r.timer)).join('<br>')}${j.jitter?`<br><span class=muted>±${+j.jitter}s</span>`:''}</td>
    <td>${j.pause?`<span class=muted>${t('paused')}</span>`:`<span class=ok>${t('active')}</span>`}</td>
    <td><button class=plain onclick="editJob(_jobs[${i}])">${t('edit')}</button>
     <button class=plain onclick="toggleJob(${i})">${j.pause?t('resume'):t('pause')}</button>
@@ -319,7 +321,8 @@ window.editJob=(j)=>{j=j||{};
   <div><label>${t('user')}</label><input id=ju value="${esc(j.user||'')}"></div></div>
   <div class=row><div><label>${t('timeoutS')}</label><input id=jt type=number value="${j.timeout||0}"></div>
   <div><label>${t('retry')}</label><input id=jr type=number value="${j.retry||0}"></div>
-  <div><label>${t('parallels')}</label><input id=jp type=number value="${j.parallels||0}"></div></div>
+  <div><label>${t('parallels')}</label><input id=jp type=number value="${j.parallels||0}"></div>
+  <div><label>${t('jitterS')}</label><input id=jj type=number min=0 max=300 value="${j.jitter||0}"></div></div>
   <div id=rules></div>
   <button class=plain id=addr style="margin-top:4px">${t('addTimer')}</button>
   <div class=bar style="margin-top:14px"><button id=sv>${t('save')}</button><button class=plain>${t('cancel')}</button></div>
@@ -337,7 +340,7 @@ window.editJob=(j)=>{j=j||{};
  $('#sv').onclick=async e=>{e.preventDefault();harvest();
   try{await api('PUT','/v1/job',{id:j.id,name:$('#jn').value,group:$('#jg').value,oldGroup:j.group,
    command:$('#jc').value,kind:+$('#jk').value,user:$('#ju').value,timeout:+$('#jt').value,
-   retry:+$('#jr').value,parallels:+$('#jp').value,pause:!!j.pause,
+   retry:+$('#jr').value,parallels:+$('#jp').value,jitter:+$('#jj').value,pause:!!j.pause,
    rules:rules.map(r=>({id:r.id,timer:r.timer,nids:r.nids||[],gids:r.gids||[],
            exclude_nids:r.exclude_nids||[]}))});dlg.close();nav('jobs')}catch(x){toast(x)}}};
 window.editGroup=(g)=>{g=g||{};

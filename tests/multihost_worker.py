@@ -45,7 +45,8 @@ cols = dict(
     active=np.ones(J, bool), paused=np.zeros(J, bool),
     has_dep=np.zeros(J, bool), dep_policy=np.zeros(J, np.int32),
     dep_cols=np.full((J, 8), -1, np.int32),
-    tenant=np.zeros(J, np.int32))
+    tenant=np.zeros(J, np.int32),
+    jitter=np.zeros(J, np.int32))
 p.set_table(ScheduleTable(**{k: jnp.asarray(v) for k, v in cols.items()}))
 p.set_eligibility(np.full((J, N // 32), 0xFFFFFFFF, np.uint32))
 p.set_job_meta_full(rng.random(J) < 0.5, np.ones(J, np.float32))

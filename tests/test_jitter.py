@@ -1,0 +1,524 @@
+"""Deterministic herd smearing (ISSUE 19): per-job ``jitter`` spec,
+validation + wire compat, the device-invisible ScheduleTable column,
+disarmed bit-identity (host dispatch AND lowered HLO), the spill ring
+across window edges, randomized differential vs a pure-Python reference
+evaluator, checkpoint/delta ride, and warm-takeover exactly-once while
+a smeared herd is mid-spill.
+
+The spec under test: a row whose cron mask matches logical second ``s``
+dispatches at ``s + fnv1a64("<job>|<s>") % (jitter+1)`` — deterministic
+across leaders and restores; fences, bundle keys, and dedup all key on
+the SMEARED epoch; with jitter 0 (or no jittered jobs at all) the
+emission path is byte-identical to the pre-jitter program.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cronsun_tpu import trace as _trace
+from cronsun_tpu.core import Job, JobRule, Keyspace, ValidationError
+from cronsun_tpu.ops.planner import TickPlanner
+from cronsun_tpu.ops.schedule_table import (
+    _INACTIVE_ROW, build_table, make_dep_row, make_row)
+from cronsun_tpu.sched import SchedulerService
+from cronsun_tpu.store.memstore import MemStore
+
+KS = Keyspace()
+T0 = 1_753_000_000
+
+
+# ---------------------------------------------------------------------------
+# model + wire + table row
+# ---------------------------------------------------------------------------
+
+def test_job_jitter_model_and_wire():
+    j = Job(id="a", name="a", command="true", jitter=30,
+            rules=[JobRule(id="r", timer="0 * * * * *", nids=["n"])])
+    j.check()
+    assert Job.from_json(j.to_json()).jitter == 30
+    # wire compat: unsmeared jobs keep the pre-jitter bytes
+    plain = Job(id="p", name="p", command="true")
+    assert "jitter" not in json.loads(plain.to_json())
+    # integral floats coerce (JSON numbers), everything else refuses
+    f = Job(id="f", name="f", command="true", jitter=30.0)
+    f.check()
+    assert f.jitter == 30
+    for bad in (-1, 301, 2.5, True, "30"):
+        with pytest.raises(ValidationError):
+            Job(id="x", name="x", command="true", jitter=bad).check()
+    # dep-triggered rows refuse jitter loudly: no herd second to smear
+    with pytest.raises(ValidationError, match="dep-triggered"):
+        Job(id="d", name="d", command="true", jitter=5,
+            deps={"on": ["up"], "misfire": "skip"},
+            rules=[JobRule(id="r", timer="@dep", nids=["n"])]).check()
+
+
+def test_put_job_400s_bad_jitter():
+    from cronsun_tpu.logsink import JobLogStore
+    from cronsun_tpu.web import ApiServer
+    store = MemStore()
+    srv = ApiServer(store, JobLogStore(), port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        sid = ""
+
+        def req(method, path, body=None):
+            nonlocal sid
+            data = json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(base + path, data=data,
+                                       method=method)
+            if sid:
+                r.add_header("Cookie", f"sid={sid}")
+            try:
+                resp = urllib.request.urlopen(r)
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+            cookie = resp.headers.get("Set-Cookie", "")
+            if cookie.startswith("sid=") and cookie.split(";")[0][4:]:
+                sid = cookie.split(";")[0][4:]
+            return resp.status, json.loads(resp.read() or b"{}")
+
+        assert req("POST", "/v1/session",
+                   {"email": "admin@admin.com",
+                    "password": "admin"})[0] == 200
+        body = {"id": "sj", "name": "sj", "command": "true",
+                "rules": [{"timer": "0 * * * * *", "nids": ["n1"]}]}
+        for bad in (301, -1, 2.5, "x"):
+            code, resp = req("PUT", "/v1/job", dict(body, jitter=bad))
+            assert code == 400, (bad, resp)
+            assert "jitter" in resp["error"]
+        code, resp = req("PUT", "/v1/job", dict(body, jitter=45))
+        assert code == 200
+        code, got = req("GET", "/v1/job/default-sj")
+        assert code == 200 and got["jitter"] == 45
+    finally:
+        srv.stop()
+        store.close()
+
+
+def test_jitter_rides_schedule_table_row():
+    row = make_row("0 * * * * *", jitter=45)
+    assert row["jitter"] == 45
+    assert make_row("@every 30s", jitter=7)["jitter"] == 7
+    assert make_row("* * * * * *")["jitter"] == 0
+    assert _INACTIVE_ROW["jitter"] == 0
+    assert make_dep_row([3], 0)["jitter"] == 0
+
+
+# ---------------------------------------------------------------------------
+# disarmed bit-identity: device program + host dispatch
+# ---------------------------------------------------------------------------
+
+def test_plan_program_ignores_jitter_column():
+    """The jitter column is host-consumed at emission: the device plan
+    is identical whatever the column holds (differential), and the
+    LOWERED module is byte-identical (the column is an unused leaf,
+    pruned by jit — there is no use_jitter arm to even disarm)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from cronsun_tpu.ops.planner import _plan_window_step
+    from cronsun_tpu.ops.schedule_table import FRAMEWORK_EPOCH
+    from cronsun_tpu.ops.timecal import window_fields
+    rng = np.random.default_rng(5)
+    specs = [f"*/{int(k)} * * * * *" for k in rng.integers(2, 9, 24)]
+    a = TickPlanner(job_capacity=128, node_capacity=96)
+    a.set_table(build_table(specs, capacity=a.J))
+    a.elig = jnp.ones((a.J, a.N // 32), jnp.uint32)
+    a.set_node_capacity([0], [1 << 20])
+    b = TickPlanner(job_capacity=128, node_capacity=96)
+    b.set_table(_dc.replace(
+        build_table(specs, capacity=b.J),
+        jitter=jnp.full((b.J,), 30, jnp.int32)))
+    b.elig = jnp.ones((b.J, b.N // 32), jnp.uint32)
+    b.set_node_capacity([0], [1 << 20])
+    for w0 in (T0, T0 + 7):
+        for x, y in zip(a.plan_window(w0, 4), b.plan_window(w0, 4)):
+            assert x.fired.tolist() == y.fired.tolist()
+            assert x.assigned.tolist() == y.assigned.tolist()
+            assert (x.overflow, x.total_fired, x.n_excl) == \
+                (y.overflow, y.total_fired, y.n_excl)
+    f = window_fields(T0, 2, tz=a.tz)
+    fields_w = np.stack(
+        [f["sec"], f["min"], f["hour"], f["dom"], f["month"], f["dow"],
+         np.arange(2, dtype=np.int64) + (T0 - FRAMEWORK_EPOCH)],
+        axis=1).astype(np.int32)
+    kw = dict(kx=2048, kc=2048, rounds=2, impl="jnp", use_deps=False,
+              use_tenants=False)
+    statics = ("kx", "kc", "rounds", "impl", "use_deps", "use_tenants")
+
+    def lower(p):
+        args = (p.table, jnp.asarray(fields_w), p.elig, p.exclusive,
+                p.cost, p.load + 0.0, p.rem_cap | 0, p.dep_succ,
+                p.dep_fail, p.dep_block, p.dep_last_fire | 0)
+        return jax.jit(_plan_window_step, static_argnames=statics
+                       ).lower(*args, **kw).as_text()
+    assert lower(a) == lower(b)
+
+
+def _herd_store(n_jobs, jitter, timer="* * * * * *", kind=2,
+                node="n1"):
+    store = MemStore()
+    store.put(KS.node_key(node), "x")
+    for i in range(n_jobs):
+        j = Job(id=f"h{i}", name=f"h{i}", command="true", kind=kind,
+                jitter=jitter,
+                rules=[JobRule(id="r", timer=timer, nids=[node])])
+        j.check()
+        store.put(KS.job_key("default", j.id), j.to_json())
+    return store
+
+
+def _window_orders(svc, ep, window=4):
+    secs, acct = [], []
+    n = 0
+    for p in svc.planner.plan_window(ep, window):
+        n += svc._build_plan_orders(p, secs, acct)
+    return n, sorted((e, k, v) for e, orders in secs for k, v in orders)
+
+
+def test_disarmed_dispatch_is_the_native_build():
+    """No registered job sets jitter => the dispatcher routes straight
+    to the native vectorized build: same orders byte-for-byte, counter
+    disarmed, ring untouched."""
+    store = _herd_store(6, jitter=0)
+    svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                           window_s=2, node_id="x")
+    try:
+        assert svc._jitter_jobs == 0
+        n1, o1 = _window_orders(svc, T0)
+        secs, acct = [], []
+        n2 = 0
+        for p in svc.planner.plan_window(T0, 4):
+            n2 += svc._build_plan_orders_native(p, secs, acct)
+        o2 = sorted((e, k, v) for e, orders in secs for k, v in orders)
+        assert (n1, o1) == (n2, o2)
+        assert n1 > 0
+        assert not svc._smear_ring
+        assert svc.metrics_snapshot()["smear_jobs"] == 0
+    finally:
+        svc.stop()
+        store.close()
+
+
+def test_deterministic_placement_across_two_fresh_builds():
+    """Two cold-loaded schedulers over the same store build the SAME
+    smeared window byte-for-byte, and a rebuild on one of them (the
+    hole-rewind path: the ring is read, never consumed) reproduces its
+    own orders exactly."""
+    store = _herd_store(12, jitter=7)
+    a = SchedulerService(store, job_capacity=64, node_capacity=32,
+                         window_s=2, node_id="a")
+    b = SchedulerService(store, job_capacity=64, node_capacity=32,
+                         window_s=2, node_id="b")
+    try:
+        na, oa = _window_orders(a, T0, window=10)
+        nb, ob = _window_orders(b, T0, window=10)
+        assert (na, oa) == (nb, ob)
+        assert na > 0
+        na2, oa2 = _window_orders(a, T0, window=10)   # rebuild
+        assert (na2, oa2) == (na, oa)
+        assert a._smear_ring_n == sum(
+            int(g[0].size) for bk in a._smear_ring.values()
+            for g in bk.values())
+    finally:
+        a.stop()
+        b.stop()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# reference evaluator + observed fires
+# ---------------------------------------------------------------------------
+
+def _smear_ref(jid, s, jitter):
+    return s + (_trace.fnv1a64(f"{jid}|{s}") % (jitter + 1)
+                if jitter else 0)
+
+
+def _reference_fires(specs, lo, hi, horizon):
+    """Expected (job, smeared epoch) pairs from the pure-Python
+    evaluator: ``specs`` maps job id -> (every_k_seconds, jitter); a
+    job matches logical second s when (s % 60) % k == 0 (the */k cron
+    second mask); fires smearing past ``horizon`` (seconds the drive
+    never built) stay in the spill ring and are excluded."""
+    out = set()
+    for jid, (k, jit) in specs.items():
+        for s in range(lo, hi):
+            if (s % 60) % k:
+                continue
+            ep = _smear_ref(jid, s, jit)
+            if ep < horizon:
+                out.add((jid, ep))
+    return out
+
+
+def _observed_fires(store, lo, hi):
+    """(job, epoch) -> count over every emitted order form: coalesced
+    exclusive bundles, Common broadcasts, and the legacy per-job keys
+    late spill arrivals ride."""
+    counts = {}
+
+    def add(jid, ep):
+        if lo <= ep < hi:
+            counts[(jid, ep)] = counts.get((jid, ep), 0) + 1
+    for kv in store.get_prefix(KS.dispatch):
+        rest = kv.key[len(KS.dispatch):].split("/")
+        if rest[0] == Keyspace.BROADCAST:
+            if len(rest) == 4:
+                add(rest[3], int(rest[1]))
+        elif len(rest) == 2:
+            parsed = Keyspace.split_bundle_epoch(rest[1])
+            if parsed is not None:
+                for e in json.loads(kv.value):
+                    add(e.partition("/")[2], parsed[0])
+        elif len(rest) == 4 and rest[1].isdigit():
+            add(rest[3], int(rest[1]))
+    return counts
+
+
+def _drive(svc, seconds, t=T0):
+    svc.step(now=t)
+    start = svc._next_epoch
+    cur = start
+    while cur - start < seconds:
+        svc.step(now=cur)
+        cur = svc._next_epoch
+    svc._builder.flush()
+    svc.publisher.flush()
+    svc._drain_build_acct()
+    return t + 1, cur      # [first planned second, horizon)
+
+
+def test_smeared_herd_smoke_exactly_once_across_window_edges():
+    """The CI tier-1 smoke: an every-second herd with jitter 7 on a
+    window_s=2 scheduler — every deferred fire spills past at least
+    one window edge — dispatches exactly once at exactly the reference
+    epoch, and the ring prunes behind the landed watermark."""
+    n, jit = 10, 7
+    store = _herd_store(n, jitter=jit)
+    # a Common job rides along: broadcast keys smear identically
+    c = Job(id="cm", name="cm", command="true", kind=0, jitter=jit,
+            rules=[JobRule(id="r", timer="* * * * * *", nids=["n1"])])
+    c.check()
+    store.put(KS.job_key("default", c.id), c.to_json())
+    svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                           window_s=2, node_id="smoke")
+    try:
+        assert svc._jitter_jobs == n + 1
+        lo, hi = _drive(svc, 14)
+        specs = {f"h{i}": (1, jit) for i in range(n)}
+        specs["cm"] = (1, jit)
+        want = _reference_fires(specs, lo, hi, hi)
+        got = _observed_fires(store, lo, hi)
+        assert set(got) == want
+        assert all(v == 1 for v in got.values()), got
+        snap = svc.smear_snapshot()
+        assert snap["deferred_total"] > 0
+        assert snap["emitted_total"] > 0
+        assert snap["ring_drops_total"] == 0
+        assert 0 < snap["max_spread_s"] <= jit
+        # spill genuinely crossed window edges (spread > window_s)
+        assert snap["max_spread_s"] > 2
+        # pruning contract: pruning runs at the NEXT build's
+        # _smear_begin — after it, only targets the landed watermark
+        # has not passed (or with a not-yet-landed emitting second)
+        # remain, and nothing behind the watermark was still owed
+        pt = svc.publisher.published_through
+        late_secs, late_acct = [], []
+        svc._smear_begin(pt, late_secs, late_acct)
+        assert not late_secs            # nothing un-emitted behind pt
+        for t, bucket in svc._smear_ring.items():
+            assert t >= pt or any(g[2] is None or g[2] >= pt
+                                  for g in bucket.values()), (t, pt)
+        m = svc.metrics_snapshot()
+        assert m["smear_jobs"] == n + 1
+        assert m["smear_deferred_total"] == snap["deferred_total"]
+        assert m["smear_ring_depth"] == svc._smear_ring_n
+        assert svc.smear_snapshot()["per_second"] == {
+            t: sum(int(g[0].size) for g in bk.values())
+            for t, bk in sorted(svc._smear_ring.items())}
+    finally:
+        svc.stop()
+        store.close()
+
+
+def test_randomized_differential_vs_reference():
+    """Randomized job mixes (jitter widths 0..11 across kinds and cron
+    steps, windows smaller than the widest smear) driven through many
+    window edges: the emitted fire multiset must equal the reference
+    evaluator exactly — no duplicates, no misses, no off-epoch fires."""
+    rng = np.random.default_rng(17)
+    for trial in range(3):
+        store = MemStore()
+        store.put(KS.node_key("n1"), "x")
+        specs = {}
+        n = int(rng.integers(8, 20))
+        for i in range(n):
+            k = int(rng.choice([1, 2, 3, 5]))
+            jit = int(rng.choice([0, 0, 1, 3, 7, 11]))
+            kind = int(rng.choice([0, 2]))
+            jid = f"r{trial}_{i}"
+            j = Job(id=jid, name=jid, command="true", kind=kind,
+                    jitter=jit,
+                    rules=[JobRule(id="r", timer=f"*/{k} * * * * *"
+                                   if k > 1 else "* * * * * *",
+                                   nids=["n1"])])
+            j.check()
+            store.put(KS.job_key("default", jid), j.to_json())
+            specs[jid] = (k, jit)
+        svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                               window_s=int(rng.integers(2, 5)),
+                               node_id=f"diff{trial}")
+        try:
+            t0 = T0 + int(rng.integers(0, 120))
+            lo, hi = _drive(svc, int(rng.integers(10, 18)), t=t0)
+            want = _reference_fires(specs, lo, hi, hi)
+            got = _observed_fires(store, lo, hi)
+            assert set(got) == want, (trial, set(got) ^ want)
+            assert all(v == 1 for v in got.values())
+            assert svc.smear_snapshot()["ring_drops_total"] == 0
+        finally:
+            svc.stop()
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint ride + warm takeover mid-spill
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_delta_ride_and_restore_zero_divergence(tmp_path):
+    """The jitter column rides full checkpoints AND delta chains; a
+    restored standby re-derives the host caches and — after the spill
+    reconstruction a takeover runs — builds the mid-spill window
+    byte-identically to the live leader."""
+    d = str(tmp_path)
+    jit = 9
+    store = _herd_store(8, jitter=jit)
+    a = SchedulerService(store, job_capacity=64, node_capacity=32,
+                         window_s=2, node_id="A", checkpoint_dir=d)
+    b = None
+    try:
+        a.checkpoint_save(path=os.path.join(d, "sched.ckpt"))
+        # the delta between checkpoint and takeover: one job's width
+        # changes, another job arms jitter for the first time
+        h0 = Job(id="h0", name="h0", command="true", kind=2, jitter=3,
+                 rules=[JobRule(id="r", timer="* * * * * *",
+                                nids=["n1"])])
+        h0.check()
+        store.put(KS.job_key("default", "h0"), h0.to_json())
+        nj = Job(id="nj", name="nj", command="true", kind=0, jitter=5,
+                 rules=[JobRule(id="r", timer="* * * * * *",
+                                nids=["n1"])])
+        nj.check()
+        store.put(KS.job_key("default", "nj"), nj.to_json())
+        # lead through a few windows so the ring is mid-spill at save
+        lo, hi = _drive(a, 6)
+        assert a._smear_ring_n > 0
+        a.drain_watches()
+        a._flush_device()
+        out = a.checkpoint_save(path=os.path.join(d, "sched.ckpt"),
+                                kind="delta")
+        assert out["kind"] == "delta"
+
+        b = SchedulerService(store, job_capacity=64, node_capacity=32,
+                             window_s=2, node_id="B", checkpoint_dir=d)
+        assert b.checkpoint_restored
+        b.drain_watches()
+        b._flush_device()
+        assert b.jobs[("default", "h0")].jitter == 3
+        assert b.jobs[("default", "nj")].jitter == 5
+        assert b._jitter_jobs == a._jitter_jobs
+        assert b._max_jitter_seen == a._max_jitter_seen
+        assert np.array_equal(b._rd_jitter[:len(a._rd_jitter)],
+                              a._rd_jitter)
+        assert np.array_equal(np.asarray(a.planner.table.jitter),
+                              np.asarray(b.planner.table.jitter))
+        # the ring is planning-derived, never checkpointed: the
+        # standby re-derives it from the takeover lookback, then the
+        # mid-spill window builds byte-identically
+        assert not b._smear_ring
+        b._smear_recover(hi)
+        assert b._smear_ring_n > 0
+        na, oa = _window_orders(a, hi, window=jit + 3)
+        nb, ob = _window_orders(b, hi, window=jit + 3)
+        assert (na, oa) == (nb, ob)
+        assert any(int(e) > hi for e, _k, _v in oa)   # spill arrivals
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+        store.close()
+
+
+def test_warm_takeover_mid_spill_exactly_once():
+    """Kill the leader with a smeared herd mid-spill; the successor's
+    first leading step re-derives the in-flight deferred fires from
+    the HWM lookback and the UNION of both leaders' emissions is still
+    exactly the reference set — zero duplicate, zero missing."""
+    n, jit = 8, 9
+    store = _herd_store(n, jitter=jit)
+    a = SchedulerService(store, job_capacity=64, node_capacity=32,
+                         window_s=2, node_id="A")
+    lo, hi_a = _drive(a, 8)
+    assert a._smear_ring_n > 0          # mid-spill
+    a.stop()                            # lease revoked, HWM persisted
+
+    b = SchedulerService(store, job_capacity=64, node_capacity=32,
+                         window_s=2, node_id="B")
+    try:
+        for _ in range(50):
+            b.step(now=hi_a)
+            if b.is_leader:
+                break
+        assert b.is_leader
+        cur = b._next_epoch
+        end = hi_a + jit + 6
+        while cur < end:
+            b.step(now=cur)
+            cur = b._next_epoch
+        b._builder.flush()
+        b.publisher.flush()
+        b._drain_build_acct()
+        specs = {f"h{i}": (1, jit) for i in range(n)}
+        want = _reference_fires(specs, lo, cur, cur)
+        got = _observed_fires(store, lo, cur)
+        missing = want - set(got)
+        extra = set(got) - want
+        assert not missing, missing
+        assert not extra, extra
+        assert all(v == 1 for v in got.values())
+    finally:
+        b.stop()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# slow-tier gate: 50k x 512 herd A/B
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_slow_herd_gate_50k():
+    """ISSUE 19 acceptance at 50k x 512: the smeared arm's herd-second
+    build+publish p99 improves >= 2x over unsmeared, with zero
+    duplicate/missing fires and exact reference-epoch agreement in
+    BOTH arms."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import bench_sched
+    out = bench_sched.run_herd_bench(50_000, 512, jitter=30,
+                                     on_log=lambda *a: None)
+    for tag in ("unsmeared", "smeared"):
+        assert out[f"herd_duplicate_fires_{tag}"] == 0
+        assert out[f"herd_missing_fires_{tag}"] == 0
+        assert out[f"herd_reference_divergence_{tag}"] == 0
+    assert out["herd_smear_build_publish_speedup"] >= 2.0, out
