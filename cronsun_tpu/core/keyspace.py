@@ -71,7 +71,8 @@ class Keyspace:
         """Per-(job, second) execution dedup fence.  ``epoch_s`` is the
         SCHEDULED epoch as emitted by the planner — for jobs with
         ``jitter`` set that is the smeared epoch
-        (``s + fnv1a64("<job>|<s>") % (jitter+1)``), so a replayed or
+        (``s + fnv1a64("<group>/<id>|<s>") % (jitter+1)``), so a
+        replayed or
         re-planned window fences against exactly the same key."""
         return f"{self.lock}{job_id}/{epoch_s}"
 

@@ -208,9 +208,10 @@ class Job:
     trace: bool = False
     # herd smearing: deterministic per-fire delay width in seconds
     # (0..300).  A fire matched at logical second s is dispatched at
-    # s + fnv1a64("<id>|<s>") % (jitter+1) — no randomness, the same
-    # job/second pair always lands on the same smeared epoch across
-    # leaders and restores.  0 keeps today's exact-second behaviour.
+    # s + fnv1a64("<group>/<id>|<s>") % (jitter+1) — no randomness,
+    # the same job/second pair always lands on the same smeared epoch
+    # across leaders and restores.  0 keeps today's exact-second
+    # behaviour.
     jitter: int = 0
 
     # ---- validation (reference job.go:502-537) ---------------------------

@@ -251,15 +251,21 @@ class SchedulerService:
         # herd smearing: per-row jitter width (seconds, 0 = unsmeared),
         # mirrored from Job.jitter beside the other _rd_* columns.  The
         # smear delta for a fire of row r matched at logical second s is
-        # fnv_continue(tbase[r], str(s)) % (jitter[r]+1) — the SAME
-        # cached FNV state the trace ids continue, so the whole fired
-        # vector smears in one O(digits) numpy pass.  _jitter_jobs
+        # fnv_continue(sbase[r], str(s)) % (jitter[r]+1) — sbase is a
+        # cached FNV partial over the GROUP-QUALIFIED id
+        # ("<group>/<id>|"), a sibling of the trace plane's tbase (which
+        # stays keyed by the bare id: agents re-derive trace ids from
+        # it, so sharing the seed would couple a smear re-key to an
+        # agent migration), so the whole fired vector smears in one
+        # O(digits) numpy pass and same-id jobs in different groups
+        # still spread relative to each other.  _jitter_jobs
         # counts registered jobs with jitter > 0: while it is zero and
         # the spill ring is empty, _build_plan_orders dispatches
         # straight to the unsmeared build and the order wire stays
         # byte-identical to the pre-jitter program (the use_deps/
         # use_tenants disarm pattern, host-side edition).
         self._rd_jitter = np.zeros(J, np.int32)
+        self._rd_sbase = np.zeros(J, np.uint64)
         self._jitter_jobs = 0
         self._max_jitter_seen = 0     # monotone max of live jitters
         # spill ring: fires whose smeared epoch lands past the window
@@ -276,8 +282,13 @@ class SchedulerService:
         # (an overflow replan smearing into an already-published
         # second) — those go out as standalone legacy per-job orders,
         # exactly once unless a publish failure clears the marks for a
-        # merge-idempotent re-emission.
+        # merge-idempotent re-emission.  _smear_lock serializes ring
+        # structure + mark writes across the step thread (hole
+        # un-marking, takeover recovery) and the WindowBuilder thread
+        # (inserts, merges, late flush, prune) — armed-path only, the
+        # disarmed gate reads a bare truthiness and never takes it.
         self._smear_ring: Dict[int, Dict[int, list]] = {}
+        self._smear_lock = threading.Lock()
         self._smear_ring_n = 0
         self._smear_ring_cap = max(65536, 4 * J)
         self._smear_recovered = False
@@ -1064,6 +1075,8 @@ class SchedulerService:
         self._rd_job[row] = (group, job_id)
         self._rd_tbase[row] = np.uint64(
             self._trace.fnv_partial(job_id + "|"))
+        self._rd_sbase[row] = np.uint64(
+            self._trace.fnv_partial(group + "/" + job_id + "|"))
         self._rd_tflag[row] = bool(getattr(job, "trace", False))
         self._rd_jitter[row] = int(getattr(job, "jitter", 0) or 0)
         self._rd_flags[row] = (1 | (2 if job.exclusive else 0)
@@ -2642,6 +2655,7 @@ class SchedulerService:
                 if jw > self._max_jitter_seen:
                     self._max_jitter_seen = jw
         self._rd_jitter = np.zeros(len(self._rd_flags), np.int32)
+        self._rd_sbase = np.zeros(len(self._rd_flags), np.uint64)
         if self.trace_shift >= 0 or self._jitter_jobs:
             self._rd_tbase = np.zeros(len(self._rd_flags), np.uint64)
             self._rd_tflag = np.zeros(len(self._rd_flags), bool)
@@ -2650,6 +2664,8 @@ class SchedulerService:
                     continue
                 self._rd_tbase[row] = np.uint64(
                     self._trace.fnv_partial(gj[1] + "|"))
+                self._rd_sbase[row] = np.uint64(
+                    self._trace.fnv_partial(gj[0] + "/" + gj[1] + "|"))
                 job = self.jobs.get((gj[0], gj[1]))
                 self._rd_tflag[row] = bool(job and
                                            getattr(job, "trace", False))
@@ -3202,11 +3218,14 @@ class SchedulerService:
             # unconfirmed: clear their marks so the rebuild (or the
             # next window's late flush) re-emits them — idempotent
             # downstream (bundle re-read is the same superset; legacy/
-            # broadcast keys are per-fire puts behind fences)
-            for bucket in self._smear_ring.values():
-                for g in bucket.values():
-                    if g[2] is not None and g[2] >= fe:
-                        g[2] = None
+            # broadcast keys are per-fire puts behind fences).  Locked:
+            # in pipelined mode the WindowBuilder inserts/prunes ring
+            # entries concurrently with this step-thread walk.
+            with self._smear_lock:
+                for bucket in self._smear_ring.values():
+                    for g in bucket.values():
+                        if g[2] is not None and g[2] >= fe:
+                            g[2] = None
         if fe is not None and fe < start:
             # a window's publish failed after retries: the HWM stopped
             # there, and so must the in-memory cursor — rewind and
@@ -3603,11 +3622,12 @@ class SchedulerService:
                                    pending_excl: Optional[Dict[int, int]]
                                    = None) -> int:
         """Herd-smearing emission pass.  A fire of row r matched at
-        logical second s is scheduled at s + fnv_continue(tbase[r],
+        logical second s is scheduled at s + fnv_continue(sbase[r],
         str(s)) % (jitter[r]+1): the delta vector is ONE vectorized FNV
-        continuation over the fired rows (the same cached per-row
-        partial hash the trace ids continue — O(digits) numpy ops per
-        second, no per-fire Python hashing) — deterministic, so every
+        continuation over the fired rows (a cached per-row partial hash
+        over the group-qualified "<group>/<id>|", sibling of the trace
+        plane's bare-id tbase — O(digits) numpy ops per second, no
+        per-fire Python hashing) — deterministic, so every
         leader/restore smears a given (job, second) to the SAME epoch.
 
         delta == 0 fires stay native.  delta > 0 fires enter the spill
@@ -3637,7 +3657,7 @@ class SchedulerService:
             jit = self._rd_jitter[rows]
             if jit.any():
                 tids = self._trace.fnv_continue_vec(
-                    self._rd_tbase[rows], str(ep))
+                    self._rd_sbase[rows], str(ep))
                 delta = (tids % (jit.astype(np.uint64) + np.uint64(1))
                          ).astype(np.int64)
                 defer = np.flatnonzero(delta > 0)
@@ -3648,7 +3668,6 @@ class SchedulerService:
                     spread = int(delta.max())
                     if spread > st["max_spread_s"]:
                         st["max_spread_s"] = spread
-                    ring = self._smear_ring
                     drops = 0
                     d_rows = rows[defer].astype(np.int64)
                     d_cols = cols_all[defer].astype(np.int64)
@@ -3660,33 +3679,87 @@ class SchedulerService:
                     uniq, starts = np.unique(d_del[order],
                                              return_index=True)
                     bounds = np.append(starts, order.size)
-                    for u in range(uniq.size):
-                        sl = order[bounds[u]:bounds[u + 1]]
-                        tgt = ep + int(uniq[u])
-                        bucket = ring.get(tgt)
-                        if bucket is None:
-                            bucket = ring[tgt] = {}
-                        if ep in bucket:
-                            continue    # window rebuild: the group (and
-                            #             its emitted mark) is present —
-                            #             deterministic smear, same set
-                        room = self._smear_ring_cap - self._smear_ring_n
-                        if room <= 0:
-                            drops += sl.size
-                            continue
-                        if sl.size > room:
-                            drops += sl.size - room
-                            sl = sl[:room]
-                        bucket[ep] = [d_rows[sl], d_cols[sl], None]
-                        self._smear_ring_n += int(sl.size)
+                    with self._smear_lock:
+                        ring = self._smear_ring
+                        for u in range(uniq.size):
+                            sl = order[bounds[u]:bounds[u + 1]]
+                            tgt = ep + int(uniq[u])
+                            bucket = ring.get(tgt)
+                            if bucket is None:
+                                bucket = ring[tgt] = {}
+                            g = bucket.get(ep)
+                            if g is not None:
+                                # the group exists: a plain window
+                                # rebuild re-derives the SAME rows
+                                # (deterministic smear) — but an
+                                # OVERFLOW REPLAN of ep re-fires the
+                                # FULL set, and deltas the truncated
+                                # head build already inserted must
+                                # UNION the replanned tail in, or
+                                # those fires are never dispatched
+                                new_m = ~np.isin(d_rows[sl], g[0])
+                                if not new_m.any():
+                                    continue
+                                sl = sl[new_m]
+                                room = (self._smear_ring_cap
+                                        - self._smear_ring_n)
+                                if room <= 0:
+                                    drops += sl.size
+                                    continue
+                                if sl.size > room:
+                                    drops += sl.size - room
+                                    sl = sl[:room]
+                                g[0] = np.concatenate(
+                                    [g[0], d_rows[sl]])
+                                g[1] = np.concatenate(
+                                    [g[1], d_cols[sl]])
+                                if g[2] is not None:
+                                    # the head rows already emitted
+                                    # with a second this leader may
+                                    # never rebuild: clear the mark so
+                                    # the target's rebuild or the late
+                                    # flush re-emits the grown group —
+                                    # the head twins are idempotent
+                                    # downstream (fences / bundle
+                                    # overwrite superset / per-fire
+                                    # legacy keys)
+                                    g[2] = None
+                                self._smear_ring_n += int(sl.size)
+                                continue
+                            room = (self._smear_ring_cap
+                                    - self._smear_ring_n)
+                            if room <= 0:
+                                drops += sl.size
+                                continue
+                            if sl.size > room:
+                                drops += sl.size - room
+                                sl = sl[:room]
+                            bucket[ep] = [d_rows[sl], d_cols[sl], None]
+                            self._smear_ring_n += int(sl.size)
                     if drops:
                         st["ring_drops_total"] += drops
                         log.errorf("smear spill ring full (cap %d): "
                                    "dropped %d deferred fires of second "
                                    "%d", self._smear_ring_cap, drops, ep)
                     keep = delta == 0
-        bucket = self._smear_ring.get(ep)
-        if not bucket and keep is None:
+        with self._smear_lock:
+            bucket = self._smear_ring.get(ep)
+            comb_r = comb_c = None
+            if bucket:
+                gr: List[np.ndarray] = []
+                gc: List[np.ndarray] = []
+                for _src, g in sorted(bucket.items()):
+                    g[2] = ep   # emitted with (and re-marked by any
+                    #             rebuild of) this second; un-marked on
+                    #             publish holes
+                    gr.append(g[0])
+                    gc.append(g[1])
+                # concatenate INSIDE the lock: the copies are this
+                # build's consistent snapshot even if a replan union
+                # grows a group concurrently
+                comb_r = np.concatenate(gr)
+                comb_c = np.concatenate(gc)
+        if comb_r is None and keep is None:
             # nothing smears away and nothing arrives: the native build
             # byte-identically (the common case for off-herd seconds)
             return self._build_plan_orders_native(
@@ -3696,17 +3769,8 @@ class SchedulerService:
             nat_cols = np.asarray(plan.assigned)[keep]
         else:
             nat_cols = np.asarray(plan.assigned)
-        if bucket:
+        if comb_r is not None:
             st = self._smear_stats
-            gr: List[np.ndarray] = []
-            gc: List[np.ndarray] = []
-            for _src, g in sorted(bucket.items()):
-                g[2] = ep   # emitted with (and re-marked by any rebuild
-                #             of) this second; un-marked on publish holes
-                gr.append(g[0])
-                gc.append(g[1])
-            comb_r = np.concatenate(gr)
-            comb_c = np.concatenate(gc)
             # one (job, second) fire: keep each row's FIRST arrival
             # (oldest source), drop rows that also fire natively at the
             # target — the fence would absorb the twin anyway, don't
@@ -3767,42 +3831,52 @@ class SchedulerService:
             return
         n_late = 0
         late_orders = []
-        for t in sorted(k for k in ring if k < cover_from):
-            bucket = ring[t]
-            if all(g[2] is not None for g in bucket.values()):
-                continue
-            orders: List[Tuple[str, str]] = []
-            ep = str(t)
-            for _src, g in sorted(bucket.items()):
-                if g[2] is not None:
+        with self._smear_lock:
+            for t in sorted(k for k in ring if k < cover_from):
+                bucket = ring[t]
+                if all(g[2] is not None for g in bucket.values()):
                     continue
-                g[2] = cover_from
-                # per-fire loop is fine here: LATE arrivals are the
-                # rare overflow-replan tail, never the herd
-                for row, col in zip(g[0].tolist(), g[1].tolist()):
-                    flags = self._rd_flags[row]
-                    if not flags & 1:
-                        continue    # job dropped since the source plan
-                    if flags & 4 and self._alone_live and \
-                            self._rd_job[row][1] in self._alone_live:
-                        continue    # KindAlone lifetime lock is live
-                    if flags & 2:
-                        if not (0 <= col < len(self._col_node)
-                                and self._col_live[col]):
-                            continue    # placed node left the fleet
-                        node = self._col_node[col]
-                        key = (self.ks.dispatch + node + "/" + ep
-                               + self._rd_suffix[row])
-                        orders.append((key, self._rd_payload[row]))
-                        excl_acct.append((key, node,
-                                          [self._rd_job[row]]))
-                    else:
-                        orders.append((self.ks.dispatch_all + ep
-                                       + self._rd_suffix[row],
-                                       self._rd_payload[row]))
-                    n_late += 1
-            if orders:
-                late_orders.append((t, orders))
+                orders: List[Tuple[str, str]] = []
+                ep = str(t)
+                for _src, g in sorted(bucket.items()):
+                    if g[2] is not None:
+                        continue
+                    g[2] = cover_from
+                    # per-fire loop is fine here: LATE arrivals are the
+                    # rare overflow-replan tail, never the herd
+                    for row, col in zip(g[0].tolist(), g[1].tolist()):
+                        flags = self._rd_flags[row]
+                        if not flags & 1:
+                            continue   # job dropped since the source
+                        if flags & 4 and self._alone_live and \
+                                self._rd_job[row][1] in self._alone_live:
+                            continue   # KindAlone lifetime lock is live
+                        if flags & 2:
+                            if not (0 <= col < len(self._col_node)
+                                    and self._col_live[col]):
+                                continue   # placed node left the fleet
+                            node = self._col_node[col]
+                            key = (self.ks.dispatch + node + "/" + ep
+                                   + self._rd_suffix[row])
+                            orders.append((key, self._rd_payload[row]))
+                            excl_acct.append((key, node,
+                                              [self._rd_job[row]]))
+                        else:
+                            orders.append((self.ks.dispatch_all + ep
+                                           + self._rd_suffix[row],
+                                           self._rd_payload[row]))
+                        n_late += 1
+                if orders:
+                    late_orders.append((t, orders))
+            pt = self.publisher.published_through
+            if pt:
+                for t in [t for t in ring if t < pt]:
+                    bucket = ring[t]
+                    if all(g[2] is not None and g[2] < pt
+                           for g in bucket.values()):
+                        self._smear_ring_n -= sum(
+                            int(g[0].size) for g in bucket.values())
+                        del ring[t]
         if late_orders:
             # oldest first, ahead of this window's native seconds
             seconds.extend(late_orders)
@@ -3811,15 +3885,6 @@ class SchedulerService:
                       "published on legacy order keys (overflow replan "
                       "smeared past its window)", n_late,
                       len(late_orders))
-        pt = self.publisher.published_through
-        if pt:
-            for t in [t for t in ring if t < pt]:
-                bucket = ring[t]
-                if all(g[2] is not None and g[2] < pt
-                       for g in bucket.values()):
-                    self._smear_ring_n -= sum(
-                        int(g[0].size) for g in bucket.values())
-                    del ring[t]
 
     def _smear_recover(self, start: int):
         """Fresh-leadership spill reconstruction.  The ring is
@@ -3842,6 +3907,7 @@ class SchedulerService:
         t0 = time.perf_counter()
         window = max(1, self.window_s)
         inserted = 0
+        drops = 0
         s0 = start - look
         while s0 < start:
             w = min(window, start - s0)
@@ -3854,6 +3920,26 @@ class SchedulerService:
                 break
             for plan in plans:
                 ep = int(plan.epoch_s)
+                if plan.overflow:
+                    # a replayed herd second over the adaptive bucket:
+                    # a truncated replay would re-derive an INCOMPLETE
+                    # spill set and silently lose the tail's deferred
+                    # fires — re-plan it with the escalated bucket,
+                    # exactly as the live path does
+                    try:
+                        full = self.planner.plan_window(
+                            ep, 1, sla_bucket=self._escalation_want(
+                                plan.total_fired))[0]
+                        if full.overflow:
+                            log.errorf(
+                                "smear lookback: %d fires still over "
+                                "the escalated bucket at t=%d — their "
+                                "spill is lost", full.overflow, ep)
+                        plan = full
+                    except Exception as e:  # noqa: BLE001 — keep the
+                        # truncated head: partial spill beats none
+                        log.errorf("smear lookback escalation failed "
+                                   "at %d: %s", ep, e)
                 rows = np.asarray(plan.fired)
                 if not rows.size:
                     continue
@@ -3861,7 +3947,7 @@ class SchedulerService:
                 if not jit.any():
                     continue
                 tids = self._trace.fnv_continue_vec(
-                    self._rd_tbase[rows], str(ep))
+                    self._rd_sbase[rows], str(ep))
                 delta = (tids % (jit.astype(np.uint64) + np.uint64(1))
                          ).astype(np.int64)
                 cols = np.asarray(plan.assigned)
@@ -3875,23 +3961,35 @@ class SchedulerService:
                 uniq, starts = np.unique(d_del[order],
                                          return_index=True)
                 bounds = np.append(starts, order.size)
-                for u in range(uniq.size):
-                    tgt = ep + int(uniq[u])
-                    if tgt < start:
-                        continue
-                    sl = order[bounds[u]:bounds[u + 1]]
-                    bucket = self._smear_ring.setdefault(tgt, {})
-                    if ep in bucket:
-                        continue
-                    room = self._smear_ring_cap - self._smear_ring_n
-                    if room <= 0:
-                        continue
-                    if sl.size > room:
-                        sl = sl[:room]
-                    bucket[ep] = [d_rows[sl], d_cols[sl], None]
-                    self._smear_ring_n += int(sl.size)
-                    inserted += int(sl.size)
+                with self._smear_lock:
+                    for u in range(uniq.size):
+                        tgt = ep + int(uniq[u])
+                        if tgt < start:
+                            continue
+                        sl = order[bounds[u]:bounds[u + 1]]
+                        bucket = self._smear_ring.setdefault(tgt, {})
+                        if ep in bucket:
+                            continue
+                        room = (self._smear_ring_cap
+                                - self._smear_ring_n)
+                        if room <= 0:
+                            drops += sl.size
+                            continue
+                        if sl.size > room:
+                            drops += sl.size - room
+                            sl = sl[:room]
+                        bucket[ep] = [d_rows[sl], d_cols[sl], None]
+                        self._smear_ring_n += int(sl.size)
+                        inserted += int(sl.size)
             s0 += w
+        if drops:
+            # the recovery obeys the same LOUD-drop contract the live
+            # insert path does: a full ring turns takeover spill into
+            # counted, paged loss — never silent loss
+            self._smear_stats["ring_drops_total"] += drops
+            log.errorf("smear takeover recovery: spill ring full (cap "
+                       "%d) — dropped %d re-derived deferred fire(s)",
+                       self._smear_ring_cap, drops)
         if inserted:
             log.infof("smear takeover recovery: re-derived %d in-flight "
                       "deferred fire(s) from a %ds lookback in %.0f ms",
@@ -4406,13 +4504,15 @@ class SchedulerService:
         surface for 'is the herd actually spreading': a healthy smeared
         herd shows ~herd/(jitter+1) arrivals per second across the
         jitter width instead of one spike."""
-        return {
-            "ring_depth": self._smear_ring_n,
-            "ring_seconds": len(self._smear_ring),
-            "per_second": {int(t): sum(int(g[0].size) for g in b.values())
-                           for t, b in sorted(self._smear_ring.items())},
-            **self._smear_stats,
-        }
+        with self._smear_lock:
+            return {
+                "ring_depth": self._smear_ring_n,
+                "ring_seconds": len(self._smear_ring),
+                "per_second": {
+                    int(t): sum(int(g[0].size) for g in b.values())
+                    for t, b in sorted(self._smear_ring.items())},
+                **self._smear_stats,
+            }
 
     def _advance_hwm(self, value: int):
         for _ in range(8):

@@ -1261,7 +1261,7 @@ def run_herd_bench(n_jobs=50_000, n_nodes=512, jitter=30, window_s=1,
     publishes, so each fire is charged its emitting window's
     build+publish cost), and the correctness evidence: the smeared
     fire set must EQUAL the pure-Python reference
-    ``(job, m + fnv1a64("<job>|<m>") % (jitter+1))`` with zero
+    ``(job, m + fnv1a64("<group>/<id>|<m>") % (jitter+1))`` with zero
     duplicate or missing fires."""
     import numpy as np
 
@@ -1384,7 +1384,7 @@ def run_herd_bench(n_jobs=50_000, n_nodes=512, jitter=30, window_s=1,
             for m in (base, base + 60):
                 for i in range(n_jobs):
                     jid = f"hj{i}"
-                    ep = m + (_trace.fnv1a64(f"{jid}|{m}")
+                    ep = m + (_trace.fnv1a64(f"herd/{jid}|{m}")
                               % (jit_s + 1) if jit_s else 0)
                     c = counts.pop((jid, ep), 0)
                     if c == 0:

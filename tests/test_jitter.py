@@ -6,10 +6,13 @@ evaluator, checkpoint/delta ride, and warm-takeover exactly-once while
 a smeared herd is mid-spill.
 
 The spec under test: a row whose cron mask matches logical second ``s``
-dispatches at ``s + fnv1a64("<job>|<s>") % (jitter+1)`` — deterministic
-across leaders and restores; fences, bundle keys, and dedup all key on
-the SMEARED epoch; with jitter 0 (or no jittered jobs at all) the
-emission path is byte-identical to the pre-jitter program.
+dispatches at ``s + fnv1a64("<group>/<id>|<s>") % (jitter+1)`` — the
+group-QUALIFIED id, so same-id jobs in different groups spread relative
+to each other (the trace plane keeps its bare-id seed: agents re-derive
+trace ids) — deterministic across leaders and restores; fences, bundle
+keys, and dedup all key on the SMEARED epoch; with jitter 0 (or no
+jittered jobs at all) the emission path is byte-identical to the
+pre-jitter program.
 """
 
 import json
@@ -238,8 +241,8 @@ def test_deterministic_placement_across_two_fresh_builds():
 # reference evaluator + observed fires
 # ---------------------------------------------------------------------------
 
-def _smear_ref(jid, s, jitter):
-    return s + (_trace.fnv1a64(f"{jid}|{s}") % (jitter + 1)
+def _smear_ref(jid, s, jitter, group="default"):
+    return s + (_trace.fnv1a64(f"{group}/{jid}|{s}") % (jitter + 1)
                 if jitter else 0)
 
 
@@ -388,6 +391,109 @@ def test_randomized_differential_vs_reference():
         finally:
             svc.stop()
             store.close()
+
+
+def test_overflow_replan_unions_colliding_spill_groups():
+    """REVIEW regression (high): a second that overflows its bucket
+    builds a TRUNCATED head now and re-fires the FULL set next step
+    via the escalated replan.  Deferred fires of the replanned tail
+    whose smear delta COLLIDES with one the head already inserted must
+    UNION into the stored ring group — the old ``ep in bucket: skip``
+    silently lost them, breaking 'overflow becomes latency, not loss'
+    exactly in the herd scenario jitter targets."""
+    n, jit = 16, 3
+    store = _herd_store(n, jitter=jit, timer="0 * * * * *")
+    svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                           window_s=2, node_id="ovf")
+    try:
+        m0 = (T0 // 60 + 1) * 60
+        full = svc.planner.plan_window(m0, 1)[0]
+        assert np.asarray(full.fired).size == n
+        # the real truncation mechanism: a bucket smaller than the herd
+        head = svc.planner.plan_window(m0, 1, sla_bucket=8)[0]
+        h = int(np.asarray(head.fired).size)
+        assert head.overflow > 0 and 0 < h < n
+        secs, acct = [], []
+        svc._build_plan_orders(head, secs, acct)   # truncated head now
+        ring_head = svc._smear_ring_n
+        # ...and the matured replan re-fires the FULL set (same epoch)
+        svc._build_plan_orders(full, secs, acct)
+        assert svc._smear_ring_n > ring_head       # tail joined the ring
+        # with 16 jobs over 4 deltas at least one (target, source)
+        # group must have GROWN (head rows + unioned tail rows)
+        assert any(int(g[0].size) > 1
+                   for bk in svc._smear_ring.values()
+                   for g in bk.values())
+        for t in range(m0 + 1, m0 + jit + 1):
+            for p in svc.planner.plan_window(t, 1):
+                svc._build_plan_orders(p, secs, acct)
+        # apply in publish order: a bundle re-publish overwrites with
+        # its superset, exactly as the store sees it
+        out = MemStore()
+        for _ep, orders in secs:
+            for k, v in orders:
+                out.put(k, v)
+        got = _observed_fires(out, m0, m0 + jit + 1)
+        want = {(f"h{i}", _smear_ref(f"h{i}", m0, jit))
+                for i in range(n)}
+        assert set(got) == want, set(got) ^ want
+        assert all(v == 1 for v in got.values()), got
+        out.close()
+    finally:
+        svc.stop()
+        store.close()
+
+
+def test_smear_recover_counts_ring_truncation_drops():
+    """REVIEW regression (low): the takeover lookback obeys the same
+    LOUD-drop contract as the live insert path — re-derived fires that
+    do not fit the ring count into ``ring_drops_total`` instead of
+    vanishing."""
+    store = _herd_store(8, jitter=5)
+    svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                           window_s=2, node_id="trunc")
+    try:
+        svc._smear_ring_cap = 3
+        svc._smear_recover(T0 + 60)
+        snap = svc.smear_snapshot()
+        assert svc._smear_ring_n <= 3
+        assert snap["ring_drops_total"] > 0
+    finally:
+        svc.stop()
+        store.close()
+
+
+def test_smear_recover_escalates_overflowed_replay():
+    """REVIEW regression (low): a replayed lookback second that
+    reports overflow is re-planned with the escalated bucket (the
+    truncated head would re-derive an incomplete spill set), and the
+    ring is built from the FULL fire set."""
+    import dataclasses as _dc
+    store = _herd_store(8, jitter=5)
+    svc = SchedulerService(store, job_capacity=64, node_capacity=32,
+                           window_s=2, node_id="esc")
+    try:
+        real = svc.planner.plan_window
+        escalations = []
+
+        def fake(ep, w, sla_bucket=None, **kw):
+            if sla_bucket is not None:
+                escalations.append((ep, sla_bucket))
+                return real(ep, w, sla_bucket=sla_bucket, **kw)
+            # lookback window plans claim overflow: the recover loop
+            # must NOT trust their (pretend-truncated) fire set
+            return [_dc.replace(p, overflow=3)
+                    if np.asarray(p.fired).size else p
+                    for p in real(ep, w, **kw)]
+        svc.planner.plan_window = fake
+        svc._smear_recover(T0 + 60)
+        assert escalations, "overflowed replay was not escalated"
+        assert svc._smear_ring_n > 0
+        # escalated buckets cover the true fire count (capped at J)
+        assert all(b >= 8 for _ep, b in escalations)
+    finally:
+        svc.stop()
+        store.close()
 
 
 # ---------------------------------------------------------------------------
