@@ -86,6 +86,11 @@ def connect_store(addr: str, token: str = "", tls=None,
     the read-only shard-map pin check (a stale single-store config
     pointed at one shard of a sharded layout refuses at startup).
 
+    Each shard entry may itself be an ``a1|a2|a3`` REPLICA GROUP
+    (replication plane, repl/): the shard routes to the group's
+    leader and rotates on failover.  Empty members ("a|,b", "a||b")
+    refuse at parse time with the malformed group named.
+
     The default RPC timeout is generous because bulk operations scale
     with fleet size: a scheduler cold-loading 1M jobs lists the whole
     cmd prefix in one call (hundreds of MB of JSON — measured over 10 s

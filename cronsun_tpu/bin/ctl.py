@@ -652,6 +652,44 @@ def cmd_sched_status(api, args):
         print(f"WARNING: leaderless partition(s): {missing}")
 
 
+def cmd_repl_status(api, args):
+    """Per-shard store replication view (repl/): each replica's role,
+    applied revision, lag behind its leader, and fencing epoch —
+    follower lag and a deposed or unreachable replica must be one
+    command away."""
+    out = api.call("GET", "/v1/repl")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    rows = []
+    for ent in out.get("shards", []):
+        for addr in ent.get("group", []) or \
+                sorted(ent.get("replicas", {})):
+            st = (ent.get("replicas") or {}).get(addr)
+            if not isinstance(st, dict):
+                rows.append([ent.get("shard"), addr, "unreachable",
+                             "-", "-", "-", "-", "-"])
+                continue
+            if not st.get("enabled"):
+                rows.append([ent.get("shard"), addr, "unreplicated",
+                             "-", "-", "-", "-", "-"])
+                continue
+            lag = st.get("lag_records")
+            rows.append([
+                ent.get("shard"), addr, st.get("role", "?"),
+                st.get("epoch", 0), st.get("applied_rev", 0),
+                "-" if lag is None else lag,
+                "-" if st.get("role") == "leader"
+                else st.get("lag_seconds", 0),
+                st.get("ack_mode", "-"),
+            ])
+    table(rows, ["SHARD", "REPLICA", "ROLE", "EPOCH", "REV",
+                 "LAG_RECS", "LAG_S", "ACK"])
+    stale = [r for r in rows if r[2] == "unreachable"]
+    if stale:
+        print(f"WARNING: {len(stale)} unreachable replica(s)")
+
+
 def cmd_metrics(api, args):
     sys.stdout.write(api.call("GET", "/v1/metrics"))
 
@@ -995,12 +1033,16 @@ def cmd_logd_reshard(api, args):
 def cmd_fsck(api, args):
     """Offline global-invariant audit (chaos/invariants.fsck): leaked
     dispatch reservations, orphan proc entries, fences without
-    execution records, dangling dep completions.  Talks to the store
-    (and optionally logd) shards DIRECTLY, read-only — the same checks
-    the chaos drills gate on, runnable against a live fleet.  Exits
-    nonzero when findings exist."""
+    execution records, dangling dep completions — plus, when a shard
+    is served by an ``a1|a2|a3`` replica group, the replication audit
+    (replica state below the min applied revision must match the
+    leader's byte-for-byte; divergence is named with its first key).
+    Talks to the store (and optionally logd) shards DIRECTLY,
+    read-only — the same checks the chaos drills gate on, runnable
+    against a live fleet.  Exits nonzero when findings exist."""
     del api
-    from ..chaos.invariants import fsck, render, to_json
+    from ..chaos.invariants import (fsck, render, replication_audit,
+                                    to_json)
     from ..core import Keyspace
     from ..store.sharded import connect_sharded
     store = sink = None
@@ -1019,6 +1061,7 @@ def cmd_fsck(api, args):
                             ks=Keyspace(prefix=args.prefix),
                             stale_order_s=args.stale_order_s,
                             fence_settle_s=args.fence_settle_s)
+            findings += replication_audit(store)
         except (RuntimeError, ValueError, OSError) as e:
             raise SystemExit(f"error: {e}")
     finally:
@@ -1174,6 +1217,13 @@ def build_parser() -> argparse.ArgumentParser:
                           help="per-partition leaders, step health, "
                                "leaderless partitions")
     p.set_defaults(fn=cmd_sched_status)
+    rp = sub.add_parser("repl",
+                        help="store replication plane (replica groups)")
+    rpsub = rp.add_subparsers(dest="replcmd", required=True)
+    p = rpsub.add_parser("status",
+                         help="per-shard replica roles, applied "
+                              "revisions, lag, fencing epochs")
+    p.set_defaults(fn=cmd_repl_status)
 
     add("metrics", cmd_metrics, "Prometheus metrics text")
     add("checkpoint", cmd_checkpoint,

@@ -57,14 +57,56 @@ def main(argv=None) -> int:
     ap.add_argument("--health-port", type=int, default=0, metavar="P",
                     help="serve /healthz + /readyz on this port "
                          "(readiness: every shard accepting TCP + the "
-                         "WAL directory writable; 0 disables)")
+                         "WAL directory writable; on a replica the "
+                         "'leader' check 503s followers; 0 disables)")
+    ap.add_argument("--repl-group", default="", metavar="A1|A2|A3",
+                    help="replication plane (repl/): serve as ONE "
+                         "member of this '|'-joined replica group "
+                         "(every member lists the same group).  Member "
+                         "0 boots as leader, the rest as followers "
+                         "shipping the WAL record stream; requires "
+                         "--shards 1 (replicate each shard as its own "
+                         "process/group)")
+    ap.add_argument("--repl-self", default="", metavar="HOST:PORT",
+                    help="this server's own address within "
+                         "--repl-group (default: the bound host:port)")
+    ap.add_argument("--repl-ack", choices=("async", "quorum"),
+                    default="async",
+                    help="'async' (default): client writes ack after "
+                         "the leader's local apply — today's latency, "
+                         "single-copy durability until shipped; "
+                         "'quorum': acks wait for >= 1 follower to "
+                         "hold the write, so an acked write survives "
+                         "losing the leader")
+    ap.add_argument("--repl-promote-after", type=float, default=3.0,
+                    metavar="S",
+                    help="follower takeover grace: promote after the "
+                         "leader has been unreachable this long "
+                         "(default 3s)")
     args = ap.parse_args(argv)
     if args.shards < 1:
         ap.error(f"--shards must be >= 1 (got {args.shards})")
+    if args.repl_group:
+        members = [m.strip() for m in args.repl_group.split("|")]
+        if any(not m for m in members) or not members:
+            ap.error(f"--repl-group {args.repl_group!r} has an empty "
+                     "member (want addr1|addr2|...)")
+        if args.shards != 1:
+            ap.error("--repl-group requires --shards 1: replicate a "
+                     "shard set by launching each shard as its own "
+                     "replica-group process set")
     cfg, ks, watcher = setup_common(args)
 
     token = cfg.store_token if args.token is None else args.token
     sslctx = server_tls(cfg.store_tls, args.native, "cronsun-store")
+    if args.repl_group and args.native:
+        # the native server does not speak the repl_* wire ops yet —
+        # refuse loudly (ROADMAP: "native stored.cc replication
+        # follow-on") instead of silently serving an unreplicated shard
+        print("error: --repl-group requires the Python server (drop "
+              "--native; native stored.cc replication is a named "
+              "ROADMAP follow-on)", file=sys.stderr)
+        return 2
     return _serve_shard_set(args, token, sslctx, watcher)
 
 
@@ -124,9 +166,24 @@ def _serve_shard_set(args, token, sslctx, watcher) -> int:
                 if args.compact_wal_bytes >= 0:   # 0 = disable, -1 = default
                     kw["compact_bytes"] = args.compact_wal_bytes
                 store.open_wal(shard_wal(i), **kw)
-            servers.append(StoreServer(store=store, host=args.host,
-                                       port=shard_port(i), token=token,
-                                       sslctx=sslctx).start())
+            srv = StoreServer(store=store, host=args.host,
+                              port=shard_port(i), token=token,
+                              sslctx=sslctx)
+            if args.repl_group:
+                # attach the repl manager BEFORE serving so no client
+                # op can race the follower-refusal / quorum wiring
+                from ..repl import ReplManager
+                members = [m.strip()
+                           for m in args.repl_group.split("|")]
+                self_addr = args.repl_self or f"{srv.host}:{srv.port}"
+                srv.attach_repl(ReplManager(
+                    store, self_addr, members, ack_mode=args.repl_ack,
+                    token=token,
+                    promote_after=args.repl_promote_after))
+            srv.start()
+            if srv.repl is not None:
+                srv.repl.start()
+            servers.append(srv)
     addrs = ",".join(f"{s.host}:{s.port}" for s in servers)
     if args.shards == 1:
         log.infof("cronsun-store serving on %s%s", addrs,
@@ -141,6 +198,14 @@ def _serve_shard_set(args, token, sslctx, watcher) -> int:
         checks = {"wal": wal_writable_check(args.wal)}
         for i, s in enumerate(servers):
             checks[f"shard{i}"] = tcp_accept_check(s.host, s.port)
+        mgr = getattr(servers[0], "repl", None)
+        if mgr is not None:
+            # the PR 14 standby pattern: a FOLLOWER fails exactly the
+            # named 'leader' check (503 from /readyz keeps it out of
+            # writer rotation) while shard/wal checks stay green
+            checks["leader"] = lambda: (
+                mgr.role() == "leader",
+                f"role={mgr.role()} epoch={mgr.store.repl_epoch()}")
         health = HealthServer(checks, port=args.health_port).start()
         events.on(events.EXIT, health.stop)
     for s in servers:

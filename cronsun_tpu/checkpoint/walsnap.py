@@ -16,8 +16,17 @@ backends:
                                         the crash window between the "x"
                                         and its "d"s can't resurrect
                                         leased keys)
+        ["E", epoch]                    replication fencing epoch
+                                        (repl/): stamped by a follower
+                                        promotion; replicas refuse
+                                        records from any lower epoch,
+                                        so a deposed leader's late
+                                        appends cannot land
     snapshot (full state, written whole):
-        ["v", rev, next_lease]          revision tag — FIRST line
+        ["v", rev, next_lease, epoch]   revision tag — FIRST line (the
+                                        4th field is the replication
+                                        fencing epoch; pre-replication
+                                        snapshots omit it = epoch 0)
         ["g", lid, ttl, wall_deadline]  one per live lease
         ["s", key, value, create_rev, mod_rev, lease]   one per key
 

@@ -256,6 +256,17 @@ class MemStore:
         self._wal = None
         self._replaying = False
         self._wal_compact_bytes = 0
+        # replication plane (repl/): when a ReplLog is attached, every
+        # WAL-worthy record is mirrored into it for follower shipping
+        # (same record format — walsnap.py's table).  ``_epoch`` is the
+        # fencing epoch ("E" records / snapshot "v" 4th field): bumped
+        # on promotion so a deposed leader's late appends are
+        # refusable.  ``_repl_follower`` disables LOCAL lease expiry —
+        # the leader is the sole expiry authority, a follower expiring
+        # locally would emit "d"s the leader never shipped.
+        self._repl_log = None
+        self._epoch = 0
+        self._repl_follower = False
         # staggered snapshots (default): image stripes one at a time
         # under their OWN locks against a pinned revision boundary with
         # per-stripe copy-on-write pre-images, so a multi-GB image never
@@ -440,6 +451,7 @@ class MemStore:
                 tp = time.perf_counter_ns()
                 rev = self._rev
                 next_lease = self._next_lease
+                epoch = self._epoch
                 now_c, now_w = self._clock(), time.time()
                 leases = [(l.id, l.ttl, now_w + (l.deadline - now_c))
                           for l in self._leases.values()]
@@ -451,7 +463,7 @@ class MemStore:
                 self._op_record("snapshot_pin", tp)
             try:
                 def lines():
-                    yield ["v", rev, next_lease]
+                    yield ["v", rev, next_lease, epoch]
                     for lid, ttl, wall in leases:
                         yield ["g", lid, ttl, wall]
                     for s in self._stripes:
@@ -502,7 +514,7 @@ class MemStore:
 
     def _snapshot_lines(self):
         """Caller holds every stripe lock + lease + event locks."""
-        yield ["v", self._rev, self._next_lease]
+        yield ["v", self._rev, self._next_lease, self._epoch]
         now_c, now_w = self._clock(), time.time()
         for lid, l in self._leases.items():
             # deadlines persist as WALL-clock instants (the store clock
@@ -553,6 +565,12 @@ class MemStore:
         elif op == "v" and len(rec) >= 3:
             self._rev = int(rec[1])
             self._next_lease = int(rec[2])
+            if len(rec) >= 4:       # pre-replication snapshots: epoch 0
+                self._epoch = int(rec[3])
+        elif op == "E" and len(rec) >= 2:
+            # promotion fencing epoch (replication plane): adopt it so
+            # a restarted replica rejoins at the epoch it last saw
+            self._epoch = int(rec[1])
         elif op == "s" and len(rec) >= 6:
             key, value = rec[1], rec[2]
             kv = KV(key, value, int(rec[3]), int(rec[4]), int(rec[5]))
@@ -569,6 +587,161 @@ class MemStore:
                     l.keys.add(key)
             self._stripes[self._sidx(key)].kv[key] = kv
 
+    def _log(self, rec: list):
+        """Record one mutation in every attached durability/shipping
+        sink: the WAL (if open) and the replication log (if the repl
+        plane is attached).  Replay never re-logs.  The caller holds
+        the lock that ordered the mutation (``_ev_lock`` for KV
+        records, ``_lease_lock`` for lease records), so both sinks see
+        records in the order the store applied them."""
+        if self._replaying:
+            return
+        if self._wal is not None:
+            self._wal.append(rec)
+        if self._repl_log is not None:
+            self._repl_log.append(rec)
+
+    # ---- replication (repl/ plane) ---------------------------------------
+
+    def repl_attach(self, repl_log, follower: bool = False):
+        """Attach the replication plane: every WAL-worthy record is
+        mirrored into ``repl_log`` (repl.log.ReplLog) for follower
+        shipping.  ``follower=True`` puts the store in follower mode:
+        local lease expiry is disabled (the LEADER is the sole expiry
+        authority — a follower expiring locally would generate "d"
+        records the leader never shipped, diverging the replicas), and
+        mutations are expected only via :meth:`repl_apply`."""
+        self._repl_log = repl_log
+        self._repl_follower = bool(follower)
+
+    def repl_epoch(self) -> int:
+        with self._ev_lock:
+            return self._epoch
+
+    def repl_is_follower(self) -> bool:
+        return self._repl_follower
+
+    def repl_apply(self, rec: list):
+        """Apply one shipped WAL record on a FOLLOWER, through the
+        normal mutation paths — watch events fire, the follower's own
+        WAL and repl log record it (chained replication composes), and
+        the revision counter advances exactly as the leader's did.
+
+        Differences from boot replay (:meth:`_replay_record`):
+
+        - a "p" whose lease is missing applies with lease=0 instead of
+          dropping: the leader logs a revoke's "x" under the lease
+          lock while a racing put logs its "p" later under the event
+          lock, so the shipped order can be x-then-p even though the
+          leader's state briefly held the key — the revoke's key-sweep
+          "d" ships next, finds the key, and bumps the revision on
+          both sides, so state AND revision converge.  Boot replay's
+          drop would leave the follower's revision permanently behind.
+        - "x" pops the lease-table entry ONLY: the leader ships one
+          "d" per swept key itself; sweeping here too would
+          double-delete (and double-bump the revision).
+        - "E" adopts the fencing epoch a promotion stamped.
+        """
+        op = rec[0]
+        if op == "p" and len(rec) >= 4:
+            key, value, lease = rec[1], rec[2], int(rec[3] or 0)
+            with self._locked([key]), self._lease_lock:
+                if lease and lease not in self._leases:
+                    lease = 0
+                self._put_locked(key, value, lease)
+        elif op == "d" and len(rec) >= 2:
+            with self._locked([rec[1]]):
+                self._delete_locked(rec[1])
+        elif op == "g" and len(rec) >= 4:
+            lid, ttl, wall = int(rec[1]), float(rec[2]), float(rec[3])
+            with self._lease_lock:
+                self._leases[lid] = Lease(
+                    lid, ttl, self._clock() + (wall - time.time()))
+                if lid >= self._next_lease:
+                    self._next_lease = lid + 1
+                self._log(["g", lid, ttl, wall])
+        elif op == "k" and len(rec) >= 3:
+            with self._lease_lock:
+                l = self._leases.get(int(rec[1]))
+                if l is not None:
+                    l.deadline = self._clock() + (float(rec[2])
+                                                  - time.time())
+                    self._log(["k", l.id, float(rec[2])])
+        elif op == "x" and len(rec) >= 2:
+            lid = int(rec[1])
+            with self._lease_lock:
+                if self._leases.pop(lid, None) is not None:
+                    self._log(["x", lid])
+        elif op == "E" and len(rec) >= 2:
+            with self._ev_lock:
+                self._epoch = int(rec[1])
+                self._log(["E", self._epoch])
+
+    def repl_dump(self) -> Tuple[list, int, int]:
+        """Consistent bootstrap image for a joining follower: the full
+        snapshot line stream plus the repl-log sequence and fencing
+        epoch it corresponds to, captured under every lock so no
+        mutation can land between the image and the cursor."""
+        with self._locked(all_stripes=True), self._lease_lock, \
+                self._ev_lock:
+            lines = [list(r) for r in self._snapshot_lines()]
+            seq = self._repl_log.seq if self._repl_log is not None else 0
+            return lines, seq, self._epoch
+
+    def repl_load(self, lines: Sequence[list], seq: int, epoch: int):
+        """Follower bootstrap: replace local state with a leader's
+        :meth:`repl_dump` image, then (if a WAL is attached) write one
+        fresh local snapshot so the on-disk state is exactly a
+        replica's snap+WAL; the attached repl log resets its cursor to
+        the leader's ``seq`` so the tail stream continues the same
+        numbering.  Only the repl apply thread may mutate during the
+        load (concurrent READS can observe the partial image — the
+        manager reports the follower unready until the load returns)."""
+        with self._locked(all_stripes=True), self._lease_lock, \
+                self._ev_lock:
+            for s in self._stripes:
+                s.kv.clear()
+                s.cow = {}
+            self._leases.clear()
+            self._rev = 0
+            self._next_lease = 1
+        self._replaying = True
+        try:
+            for rec in lines:
+                self._replay_record(rec)
+        finally:
+            self._replaying = False
+        with self._ev_lock:
+            self._epoch = int(epoch)
+        if self._repl_log is not None:
+            self._repl_log.reset(int(seq), int(epoch))
+        if self._wal is not None:
+            self.snapshot()
+
+    def repl_promote(self) -> int:
+        """Follower -> leader takeover: bump the fencing epoch and
+        stamp it into the WAL/repl stream ("E" record), re-arm local
+        lease expiry, give every replicated lease one fresh ttl (its
+        deadline was converted from the OLD leader's wall clock; a
+        takeover must not insta-expire the fleet's live leases — the
+        owners re-keepalive within one ttl), and sweep orphan keys
+        whose lease died in the old leader's crash window between a
+        flushed "x" and its "d"s.  Returns the new epoch."""
+        with self._locked(all_stripes=True), self._lease_lock, \
+                self._ev_lock:
+            self._repl_follower = False
+            self._epoch += 1
+            self._log(["E", self._epoch])
+            now = self._clock()
+            for l in self._leases.values():
+                l.deadline = now + l.ttl
+            for s in self._stripes:
+                doomed = [k for k, kv in s.kv.items()
+                          if kv.lease and kv.lease not in self._leases]
+                for k in doomed:
+                    self._delete_locked(k)
+            return self._epoch
+
     # ---- KV --------------------------------------------------------------
 
     def _lazy_expire(self):
@@ -581,7 +754,8 @@ class MemStore:
         validate their own leases' deadlines (_check_lease), and an
         expired-but-unswept key lingering for one sweep interval is the
         same staleness any etcd client tolerates."""
-        if self._leases and self._sweeper is None:
+        if self._leases and self._sweeper is None \
+                and not self._repl_follower:
             self._expire_leases()
 
     def put(self, key: str, value: str, lease: int = 0) -> int:
@@ -666,8 +840,7 @@ class MemStore:
             kv = KV(key, value, prev.create_rev if prev else self._rev,
                     self._rev, lease)
             kvmap[key] = kv
-            if self._wal is not None and not self._replaying:
-                self._wal.append(["p", key, value, lease])
+            self._log(["p", key, value, lease])
             self._notify(Event(PUT, kv, prev))
             return self._rev
 
@@ -741,8 +914,7 @@ class MemStore:
         with self._ev_lock:
             self._rev += 1
             tomb = KV(key, "", prev.create_rev, self._rev, 0)
-            if self._wal is not None and not self._replaying:
-                self._wal.append(["d", key])
+            self._log(["d", key])
             self._notify(Event(DELETE, tomb, prev))
         return True
 
@@ -994,8 +1166,7 @@ class MemStore:
             lid = self._next_lease
             self._next_lease += 1
             self._leases[lid] = Lease(lid, ttl, self._clock() + ttl)
-            if self._wal is not None and not self._replaying:
-                self._wal.append(["g", lid, ttl, time.time() + ttl])
+            self._log(["g", lid, ttl, time.time() + ttl])
             return lid
 
     def keepalive(self, lease_id: int) -> bool:
@@ -1006,8 +1177,7 @@ class MemStore:
             if l is None or l.deadline <= self._clock():
                 return False
             l.deadline = self._clock() + l.ttl
-            if self._wal is not None and not self._replaying:
-                self._wal.append(["k", lease_id, time.time() + l.ttl])
+            self._log(["k", lease_id, time.time() + l.ttl])
             return True
 
     def revoke(self, lease_id: int) -> bool:
@@ -1015,9 +1185,8 @@ class MemStore:
             l = self._leases.pop(lease_id, None)
             # lease removal logs as "x" (replay deletes attached keys
             # itself); the deletions below log their own "d" records
-            if l is not None and self._wal is not None \
-                    and not self._replaying:
-                self._wal.append(["x", lease_id])
+            if l is not None:
+                self._log(["x", lease_id])
         if l is None:
             return False
         self._delete_keys(sorted(l.keys), only_lease=lease_id)
@@ -1030,8 +1199,10 @@ class MemStore:
 
     def _expire_leases(self):
         # cheap empty-table fast path: the common steady state for
-        # stores carrying no leases
-        if not self._leases:
+        # stores carrying no leases.  Followers NEVER expire locally —
+        # the leader ships the "x"/"d" records (repl_apply), otherwise
+        # the replicas diverge on expiry timing.
+        if not self._leases or self._repl_follower:
             return
         now = self._clock()
         with self._lease_lock:
@@ -1039,8 +1210,7 @@ class MemStore:
                        if l.deadline <= now]
             for l in expired:
                 del self._leases[l.id]
-                if self._wal is not None and not self._replaying:
-                    self._wal.append(["x", l.id])
+                self._log(["x", l.id])
         # key deletion happens OUTSIDE the lease lock through the normal
         # striped path (lock order: stripes before lease) — a doomed
         # key's events and attachments behave exactly as a delete would

@@ -87,10 +87,27 @@ _OPS = ("put", "put_many", "get", "get_many", "get_prefix",
         "grant", "keepalive", "revoke", "lease_ttl_remaining", "op_stats",
         "snapshot", "rev")
 
+# ops a replica-group FOLLOWER refuses (leases and fences are granted
+# only by the leader — the replication plane's exactly-once contract);
+# under --repl-ack quorum these also wait for >= 1 follower ack before
+# the success reply goes out
+_MUTATING = frozenset({
+    "put", "put_many", "delete", "delete_prefix", "delete_many",
+    "put_if_absent", "put_if_mod_rev", "claim", "claim_many",
+    "claim_bundle", "claim_bundle_many", "grant", "keepalive", "revoke"})
+
 
 class _Conn(LineJsonHandler):
     def setup(self):
         super().setup()
+        # register with the owning server so stop()/kill() can sever
+        # established connections (handler threads are daemonic: without
+        # this a "stopped" server keeps serving its open sockets, which
+        # makes a killed replica leader look alive to its followers)
+        conns = getattr(self.server, "conns", None)
+        if conns is not None:
+            with self.server.conns_lock:     # type: ignore[attr-defined]
+                conns.add(self)
         self.watchers: Dict[int, Watcher] = {}
         # one BATCHING pump per connection (not a thread per watcher):
         # watchers signal readiness here; the pump drains every ready
@@ -196,16 +213,58 @@ class _Conn(LineJsonHandler):
                 if w:
                     w.close()
                 self._send({"i": rid, "r": True})
+            elif op == "repl_status":
+                mgr = getattr(self.server, "repl", None)
+                self._send({"i": rid, "r": {"enabled": False}
+                            if mgr is None else mgr.status()})
+            elif op in ("repl_hello", "repl_pull", "repl_ack",
+                        "repl_snapshot"):
+                mgr = getattr(self.server, "repl", None)
+                if mgr is None:
+                    self._send({"i": rid, "e": f"{op}: replication "
+                                "disabled on this server",
+                                "k": "RuntimeError"})
+                else:
+                    fn = {"repl_hello": mgr.hello,
+                          "repl_pull": mgr.pull,
+                          "repl_ack": mgr.ack,
+                          "repl_snapshot": mgr.snapshot_dump}[op]
+                    self._send({"i": rid, "r": fn(*args)})
             elif op in _OPS:
+                mgr = getattr(self.server, "repl", None)
+                mutating = mgr is not None and op in _MUTATING
+                if mutating and mgr.role() != "leader":
+                    # leases/fences/writes are the LEADER's alone: the
+                    # client rotates to the leader on this error
+                    raise NotLeaderError(
+                        f"{op}: this replica is a follower")
                 r = getattr(store, op)(*args)
                 if op == "get":
                     r = _kv_wire(r)
                 elif op in ("get_prefix", "get_prefix_page", "get_many"):
                     r = [_kv_wire(kv) for kv in r]
+                if mutating and mgr.ack_mode == "quorum":
+                    # durability before the ack: the reply waits until
+                    # >= 1 follower's cursor covers this op's records.
+                    # On timeout the op is applied locally but reported
+                    # FAILED — the caller retries idempotently (puts
+                    # overwrite, claims re-check their fence), and a
+                    # failover cannot lose a write we never acked.
+                    seq = mgr.log.seq
+                    if not mgr.ack_wait(seq):
+                        self._send({
+                            "i": rid,
+                            "e": f"{op}: applied locally but no "
+                                 f"follower ack of seq {seq} within "
+                                 f"{mgr.ack_timeout}s (quorum mode)",
+                            "k": "QuorumTimeout"})
+                        return
                 self._send({"i": rid, "r": r})
             else:
                 self._send({"i": rid, "e": f"unknown op {op!r}",
                             "k": "ValueError"})
+        except NotLeaderError as e:
+            self._send({"i": rid, "e": str(e), "k": "NotLeader"})
         except KeyError as e:
             self._send({"i": rid, "e": str(e), "k": "KeyError"})
         except CompactedError as e:
@@ -219,6 +278,10 @@ class _Conn(LineJsonHandler):
     def finish(self):
         super().finish()    # retire the handshake watchdog (wire.py)
         self.alive = False
+        conns = getattr(self.server, "conns", None)
+        if conns is not None:
+            with self.server.conns_lock:     # type: ignore[attr-defined]
+                conns.discard(self)
         # snapshot: the pump thread pops lost watchers concurrently
         for w in list(self.watchers.values()):
             w.close()
@@ -239,11 +302,23 @@ class StoreServer:
             allow_reuse_address = True
             daemon_threads = True
         self._srv = _Server((host, port), _Conn)
+        self._srv.conns = set()                      # type: ignore[attr-defined]
+        self._srv.conns_lock = threading.Lock()      # type: ignore[attr-defined]
         self._srv.store = self.store                 # type: ignore[attr-defined]
         self._srv.token = token                      # type: ignore[attr-defined]
         self._srv.sslctx = sslctx                    # type: ignore[attr-defined]
+        self._srv.repl = None                        # type: ignore[attr-defined]
+        self.repl = None
         self.host, self.port = self._srv.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+
+    def attach_repl(self, mgr) -> "StoreServer":
+        """Wire a repl.ReplManager into the dispatch plane: repl_* ops
+        answer, followers refuse mutations, quorum ack gates replies.
+        Attach before serving clients."""
+        self.repl = mgr
+        self._srv.repl = mgr                         # type: ignore[attr-defined]
+        return self
 
     def start(self) -> "StoreServer":
         self._thread = threading.Thread(target=self._srv.serve_forever,
@@ -251,12 +326,44 @@ class StoreServer:
         self._thread.start()
         return self
 
+    def _sever_conns(self):
+        with self._srv.conns_lock:           # type: ignore[attr-defined]
+            conns = list(self._srv.conns)    # type: ignore[attr-defined]
+        for c in conns:
+            c.alive = False
+            try:
+                c.request.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.request.close()
+            except OSError:
+                pass
+
     def stop(self):
+        if self.repl is not None:
+            self.repl.stop()
         self._srv.shutdown()
         self._srv.server_close()
+        self._sever_conns()
         if self._thread:
             self._thread.join(timeout=3)
         self.store.close()
+
+    def kill(self):
+        """Hard-kill (the in-process kill -9): stop accepting, sever
+        every established connection mid-flight, and abandon the store
+        WITHOUT closing it — no flush, no sweeper shutdown handshake,
+        no repl goodbye.  Followers see their pull connections die
+        exactly as they would for a dead process; the chaos drills'
+        leader-kill is built on this."""
+        if self.repl is not None:
+            self.repl._stop.set()     # silence the loop; no demote/ack
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._sever_conns()
+        if self._thread:
+            self._thread.join(timeout=3)
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +409,12 @@ class RemoteStoreError(RuntimeError):
     pass
 
 
+class NotLeaderError(RemoteStoreError):
+    """The targeted replica is a follower: leases, fences, and writes
+    belong to its group's leader (replication plane).  Replica-group
+    clients rotate to the leader on this error."""
+
+
 class RemoteStore:
     """TCP client with MemStore's exact API — scheduler/agent/web/noticer
     run unchanged against it (the rebuild's etcd clientv3,
@@ -333,6 +446,12 @@ class RemoteStore:
         self._closed = False
         self._sock: Optional[socket.socket] = None
         self._rfile = None
+        # optional hook for replica-group clients (reconnect=False):
+        # called once, with this store, when the connection dies
+        # UNEXPECTEDLY — the group wrapper marks live watchers lost so
+        # their consumers re-list through a freshly discovered leader
+        # instead of starving on a closed-but-not-lost stream
+        self.on_disconnect = None
         self._connect()
 
     # -- plumbing ----------------------------------------------------------
@@ -441,7 +560,15 @@ class RemoteStore:
                                            "k": "RemoteStoreError"})
             ev.set()
         if self._closed or not self._reconnect:
+            unexpected = not self._closed
             self._finalize()
+            if unexpected:
+                cb = self.on_disconnect
+                if cb is not None:
+                    try:
+                        cb(self)
+                    except Exception:  # noqa: BLE001 — reader must die
+                        pass           # clean regardless of the hook
             return
         threading.Thread(target=self._heal, daemon=True,
                          name="remote-store-heal").start()
@@ -563,6 +690,8 @@ class RemoteStore:
                 raise CompactedError(msg["e"])
             if kind == "WatchLost":
                 raise WatchLost(msg["e"])
+            if kind == "NotLeader":
+                raise NotLeaderError(msg["e"])
             raise RemoteStoreError(msg["e"])
         if act is not None:
             act.post(RemoteStoreError, op)   # applied; reply "lost"
@@ -684,6 +813,12 @@ class RemoteStore:
     def rev(self) -> int:
         """Current store revision (memstore.rev)."""
         return self._call("rev")
+
+    def repl_status(self) -> dict:
+        """Replication-plane status of this server: ``{"enabled":
+        False}`` on unreplicated servers, else role / fencing epoch /
+        cursor / applied revision / lag (repl.ReplManager.status)."""
+        return self._call("repl_status")
 
     # -- leases ------------------------------------------------------------
 

@@ -1089,11 +1089,31 @@ def connect_sharded(addrs: Sequence[str], prefix: str = "/cronsun",
                     sslctx=None, tls_hostname: str = ""):
     """Connect a routing client to a shard set.  One address returns a
     plain RemoteStore (byte-identical single-store behavior); several
-    return a ShardedStore that pins/verifies the shard map."""
+    return a ShardedStore that pins/verifies the shard map.
+
+    Each shard entry may be an ``addr1|addr2|addr3`` REPLICA GROUP
+    (replication plane, repl/): the shard's client becomes a
+    ReplicaGroupStore that discovers the group's leader and rotates on
+    leader loss through the breaker/backoff ladders.  A group with an
+    empty member ("a|,b", "a||b") refuses HERE, at parse time — an
+    empty address would otherwise surface as a confusing dial error
+    mid-rotation."""
     from .remote import RemoteStore
     conns = []
     try:
         for addr in addrs:
+            if "|" in addr:
+                members = [m.strip() for m in addr.split("|")]
+                if any(not m for m in members):
+                    raise ValueError(
+                        f"replica group {addr!r} has an empty member "
+                        "(want addr1|addr2|...; no doubled, leading, "
+                        "or trailing '|')")
+                from ..repl.client import ReplicaGroupStore
+                conns.append(ReplicaGroupStore(
+                    members, timeout=timeout, token=token,
+                    sslctx=sslctx, tls_hostname=tls_hostname))
+                continue
             host, _, port = addr.rpartition(":")
             conns.append(RemoteStore(host or "127.0.0.1", int(port),
                                      timeout=timeout, token=token,

@@ -296,6 +296,8 @@ class ApiServer:
         route("DELETE", r"/v1/tenant/(?P<id>[^/]+)", self.tenant_delete,
               admin=True)
         route("GET", r"/v1/sched", self.sched_status)
+        # store replication plane: per-shard role/lag/epoch (repl/)
+        route("GET", r"/v1/repl", self.repl_status)
         route("GET", r"/v1/info/overview", self.overview)
         route("GET", r"/v1/configurations", self.configurations)
         route("POST", r"/v1/checkpoint", self.checkpoint, admin=True)
@@ -1821,6 +1823,14 @@ class ApiServer:
         return {"partitions": partitions, "instances": insts,
                 "leaderless": leaderless}
 
+    def repl_status(self, ctx):
+        """Per-shard store replication view (the ``cronsun-ctl repl
+        status`` surface): every replica's role, applied revision,
+        lag, and fencing epoch — who leads each shard, and how far
+        behind each follower reads, one call away."""
+        from ..repl import fleet_repl_status
+        return {"shards": fleet_repl_status(self.store)}
+
     # ---- handlers: metrics ----------------------------------------------
 
     def metrics(self, ctx):
@@ -2037,6 +2047,47 @@ class ApiServer:
                         val = state_num.get(val, -1)
                     lines.append(
                         f'{name}{{shard="{snap["shard"]}"}} {val}')
+
+        # store replication plane (repl/): per-replica role, lag, and
+        # fencing epoch for every shard served by a replica group.
+        # Absent entirely when nothing is replicated, so unreplicated
+        # deployments' scrape output is unchanged.
+        try:
+            from ..repl import fleet_repl_status
+            repl_shards = [
+                e for e in fleet_repl_status(self.store)
+                if any(isinstance(st, dict) and st.get("enabled")
+                       for st in e.get("replicas", {}).values())]
+        except Exception:  # noqa: BLE001 — degraded shard set
+            repl_shards = []
+        if repl_shards:
+            role_num = {"leader": 1, "follower": 0}
+            series = {"role": [], "lag_records": [],
+                      "lag_seconds": [], "fencing_epoch": []}
+            for e in repl_shards:
+                for addr, st in sorted(e["replicas"].items()):
+                    lbl = (f'shard="{e["shard"]}",'
+                           f'replica="{_esc_label(addr)}"')
+                    if not isinstance(st, dict) or not st.get("enabled"):
+                        # unreachable replica: role -1 is the alert
+                        series["role"].append((lbl, -1))
+                        continue
+                    series["role"].append(
+                        (lbl, role_num.get(st.get("role"), -1)))
+                    lag = st.get("lag_records")
+                    series["lag_records"].append(
+                        (lbl, lag if isinstance(lag, (int, float))
+                         else -1))
+                    series["lag_seconds"].append(
+                        (lbl, st.get("lag_seconds") or 0.0))
+                    series["fencing_epoch"].append(
+                        (lbl, st.get("epoch", 0)))
+            for field in ("role", "lag_records", "lag_seconds",
+                          "fencing_epoch"):
+                name = f"cronsun_store_repl_{field}"
+                lines.append(f"# TYPE {name} gauge")
+                for lbl, val in series[field]:
+                    lines.append(f"{name}{{{lbl}}} {val}")
 
         def render_hist(name, label_kv, snap):
             """One Prometheus histogram (cumulative _bucket + _sum +

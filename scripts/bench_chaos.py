@@ -33,6 +33,13 @@ Drills:
                    either land or fail LOUDLY, invariants hold
   agent_kill       kill -9 an agent mid-execution: fence consumed, no
                    double fire, fsck NAMES the fence-without-record
+  replica_leader_kill  kill -9 the store REPLICA LEADER (repl/) under
+                   live dispatch + a quorum-acked probe writer: a
+                   follower promotes within a bounded window, clients
+                   rotate, exactly-once holds, and ZERO acked records
+                   are lost; run with replicated=False the same drill
+                   FAILS (acked probes vanish with the leader), which
+                   proves it measures the replication plane
 
 The fault schedule is deterministic under --seed: the smoke drill
 asserts byte-identical schedules across two constructions, and every
@@ -112,7 +119,8 @@ class Fleet:
                  proc_ttl=600.0, block_jobs=(), checkpoint_dir=None,
                  client_timeout=8.0, backend="py", trace_shift=-1,
                  sched_shard_deadline=None, publish_lanes=0,
-                 partitions=1):
+                 partitions=1, repl=None, repl_members=3,
+                 promote_after=1.5):
         self.seed = seed
         self.n_jobs = n_jobs
         self.partitions = partitions
@@ -141,7 +149,35 @@ class Fleet:
         # level, so every drill works unchanged against either; this is
         # the plumbing the issue's "drills against the NATIVE backends"
         # remainder asked for (native_available() gates it).
-        if backend == "native":
+        self.repl = repl                # None | "async" | "quorum"
+        self.repl_mgrs = []
+        self.repl_group = []
+        if repl:
+            # REPLICATED store plane (repl/): one shard served by a
+            # leader + (repl_members - 1) followers shipping the WAL
+            # record stream; clients are ReplicaGroupStores that rotate
+            # on leader loss.  The drill's fault is the leader kill
+            # itself, so no FaultProxy fronts the group.
+            if backend != "py" or store_shards != 1:
+                raise RuntimeError("repl drills need the Python backend "
+                                   "and a single store shard")
+            from cronsun_tpu.repl import ReplManager
+            self.store_srvs = [StoreServer(MemStore())
+                               for _ in range(repl_members)]
+            self.repl_group = [f"127.0.0.1:{s.port}"
+                               for s in self.store_srvs]
+            for i, srv in enumerate(self.store_srvs):
+                m = ReplManager(srv.store, self.repl_group[i],
+                                self.repl_group, ack_mode=repl,
+                                promote_after=promote_after)
+                srv.attach_repl(m)
+                srv.start()
+                self.repl_mgrs.append(m)
+            for m in self.repl_mgrs:
+                m.start()
+            self.store_scheds = []
+            self.store_proxies = []
+        elif backend == "native":
             from cronsun_tpu.store.native import NativeStoreServer
             from cronsun_tpu.logsink.native import \
                 find_binary as _logd_bin
@@ -157,13 +193,14 @@ class Fleet:
         else:
             self.store_srvs = [StoreServer(MemStore()).start()
                                for _ in range(store_shards)]
-        self.store_scheds = [FaultSchedule(seed * 1000 + i)
-                             for i in range(store_shards)]
-        self.store_proxies = [
-            FaultProxy(("127.0.0.1", srv.port), sch,
-                       name=f"store-proxy-{i}").start()
-            for i, (srv, sch) in enumerate(zip(self.store_srvs,
-                                               self.store_scheds))]
+        if not repl:
+            self.store_scheds = [FaultSchedule(seed * 1000 + i)
+                                 for i in range(store_shards)]
+            self.store_proxies = [
+                FaultProxy(("127.0.0.1", srv.port), sch,
+                           name=f"store-proxy-{i}").start()
+                for i, (srv, sch) in enumerate(zip(self.store_srvs,
+                                                   self.store_scheds))]
         # result store behind a proxy
         if backend == "native":
             from cronsun_tpu.logsink.native import NativeLogSinkServer
@@ -220,6 +257,12 @@ class Fleet:
     # -- client factories --------------------------------------------------
 
     def store_client(self, deadline=None):
+        if self.repl:
+            from cronsun_tpu.repl import ReplicaGroupStore
+            c = ReplicaGroupStore(list(self.repl_group),
+                                  timeout=self.client_timeout)
+            self._clients.append(c)
+            return c
         conns = [RemoteStore("127.0.0.1", p.port,
                              timeout=self.client_timeout)
                  for p in self.store_proxies]
@@ -424,6 +467,17 @@ class Fleet:
             except Exception:  # noqa: BLE001 — dying anyway
                 pass
         sc.store.close()
+
+    def kill_store_leader(self):
+        """kill -9 the replica-group LEADER: the server severs every
+        established connection mid-flight (followers' pulls, clients'
+        ops and watches) with no flush and no repl goodbye — exactly a
+        dead process as the survivors see it."""
+        for srv in self.store_srvs:
+            if srv.repl is not None and srv.repl.role() == "leader":
+                srv.kill()
+                return srv
+        raise RuntimeError("no replica leader alive to kill")
 
     def kill_agent(self, a):
         self.dead_agents.append(a)
@@ -1259,6 +1313,172 @@ def drill_agent_kill(seed=29, on_log=print):
         fleet.close()
 
 
+def drill_replica_leader_kill(seed=43, replicated=True, on_log=print):
+    """Kill -9 the store replica-group LEADER (replication plane,
+    repl/) while dispatch is live AND a probe writer is collecting
+    quorum-acked puts: a follower must promote within a bounded
+    window, every client (scheduler, agents, probes) must rotate to
+    it, exactly-once must hold across the failover, and EVERY probe
+    the old leader acked must still be readable afterwards — zero
+    acked-record loss, the ``--repl-ack quorum`` contract.
+
+    ``replicated=False`` runs the control experiment: the same
+    topology with replication disabled (a plain single-copy store
+    plus a cold standby that promotes EMPTY).  Acked probes vanish
+    with the killed leader, so the drill FAILS — proving the gate
+    measures the replication plane, not the harness."""
+    if not replicated:
+        return _replica_kill_unreplicated(seed, on_log)
+    from cronsun_tpu.repl import NotLeaderError  # noqa: F401 — plane up
+    promote_after = 1.5
+    fleet = Fleet(seed=seed, n_jobs=16, n_agents=2, lease_ttl=2.0,
+                  repl="quorum", repl_members=3,
+                  promote_after=promote_after)
+    try:
+        jobs = fleet.put_jobs()
+        mid = fleet.drive(T0, T0 + 3)
+        fleet.quiesce_publishers()
+        # quorum-acked probe writer: every put that RETURNS was acked
+        # by the leader only after >= 1 follower held it — the ledger
+        # of writes the failover is not allowed to lose
+        probe_cli = fleet.store_client()
+        acked, stop_probe = [], threading.Event()
+
+        def probe():
+            i = 0
+            while not stop_probe.is_set():
+                key = f"/chaos/probe/{i:05d}"
+                try:
+                    probe_cli.put(key, str(i))
+                    acked.append(key)
+                except Exception:  # noqa: BLE001 — unacked: may or may
+                    pass           # not have applied; not in the ledger
+                i += 1
+                time.sleep(0.01)
+        th = threading.Thread(target=probe, daemon=True)
+        th.start()
+        time.sleep(0.4)              # probes provably in flight
+        leader_mgr = next(m for m in fleet.repl_mgrs
+                          if m.role() == "leader")
+        on_log(f"killing replica leader {leader_mgr.self_addr} "
+               f"(epoch {leader_mgr.store.repl_epoch()}) at epoch {mid}")
+        t_kill = time.monotonic()
+        fleet.kill_store_leader()
+        end = fleet.drive(mid, mid + 4, stall_timeout=90.0)
+        recovery_s = time.monotonic() - t_kill
+        stop_probe.set()
+        th.join(timeout=15)
+        fleet.settle(timeout=45.0)
+        findings, info = fleet.audit(expect_jobs=jobs,
+                                     planned_range=(T0 + 1, end))
+        # ZERO acked-record loss: every quorum-acked probe must read
+        # back from the promoted group
+        lost = [k for k in list(acked)
+                if fleet.audit_store.get(k) is None]
+        for k in lost[:10]:
+            findings.append(invariants.Finding(
+                "acked_record_lost", k,
+                "quorum-acked write missing after leader failover"))
+        if len(lost) > 10:
+            findings.append(invariants.Finding(
+                "acked_record_lost", "...",
+                f"{len(lost) - 10} further acked probes missing"))
+        survivors = [m for m in fleet.repl_mgrs
+                     if m is not leader_mgr and m.role() == "leader"]
+        if not survivors:
+            findings.append(invariants.Finding(
+                "no_promotion", "",
+                "no follower promoted after the leader kill"))
+        # bounded takeover: grace + discovery sweeps + client rotation
+        bound = promote_after * 3 + 10
+        if recovery_s > bound:
+            findings.append(invariants.Finding(
+                "recovery_unbounded", "",
+                f"replica takeover took {recovery_s:.1f}s "
+                f"(> {bound:.0f}s)"))
+        info.update(
+            recovery_s=round(recovery_s, 3),
+            acked_probes=len(acked), lost_probes=len(lost),
+            promoted=[m.self_addr for m in survivors],
+            epoch=max(m.store.repl_epoch() for m in fleet.repl_mgrs))
+        on_log(f"replica_leader_kill: recovery {recovery_s:.2f}s, "
+               f"{info['acked_probes']} acked probes ({len(lost)} "
+               f"lost), {info['executions']} execs, "
+               f"{len(findings)} finding(s)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        fleet.close()
+
+
+def _replica_kill_unreplicated(seed, on_log):
+    """The control arm: same kill, NO replication.  A plain
+    single-copy store acks every write locally; the standby next to it
+    never ships a record, so when the leader dies and the standby
+    promotes (empty), every acked probe is gone.  The returned
+    findings are EXPECTED — tests assert they are non-empty."""
+    from cronsun_tpu.repl import ReplManager, ReplicaGroupStore
+    s0, s1 = MemStore(), MemStore()
+    srv0, srv1 = StoreServer(s0), StoreServer(s1)
+    group = [f"127.0.0.1:{srv0.port}", f"127.0.0.1:{srv1.port}"]
+    # the standby is a repl follower in a group whose member 0 does
+    # NOT speak the replication plane: it can promote, it just never
+    # receives a record — the misconfigured-standby scenario
+    m1 = ReplManager(s1, group[1], group, promote_after=1.0,
+                     initial_role="follower")
+    srv1.attach_repl(m1)
+    srv0.start()
+    srv1.start()
+    m1.start()
+    cli = None
+    try:
+        cli = ReplicaGroupStore(group, timeout=8.0)
+        acked = []
+        for i in range(50):
+            key = f"/chaos/probe/{i:05d}"
+            cli.put(key, str(i))     # acked single-copy, instantly
+            acked.append(key)
+        on_log(f"killing unreplicated store {group[0]} with "
+               f"{len(acked)} acked probes on it alone")
+        srv0.kill()
+        deadline = time.monotonic() + 20.0
+        while m1.role() != "leader" and time.monotonic() < deadline:
+            time.sleep(0.1)
+        findings = []
+        if m1.role() != "leader":
+            findings.append(invariants.Finding(
+                "no_promotion", "", "standby never promoted"))
+        lost = []
+        for k in acked:
+            try:
+                if cli.get(k) is None:
+                    lost.append(k)
+            except Exception:  # noqa: BLE001 — unreachable = lost too
+                lost.append(k)
+        for k in lost[:5]:
+            findings.append(invariants.Finding(
+                "acked_record_lost", k,
+                "acked write missing after failover (replication "
+                "disabled: single-copy durability)"))
+        if len(lost) > 5:
+            findings.append(invariants.Finding(
+                "acked_record_lost", "...",
+                f"{len(lost) - 5} further acked probes missing"))
+        info = {"acked_probes": len(acked), "lost_probes": len(lost),
+                "replicated": False}
+        on_log(f"replica_leader_kill[unreplicated]: {len(lost)}/"
+               f"{len(acked)} acked probes lost, "
+               f"{len(findings)} finding(s) (failure EXPECTED)")
+        return {"findings": _findings_json(findings), "info": info}
+    finally:
+        if cli is not None:
+            cli.close()
+        srv1.stop()
+        try:
+            srv0.stop()
+        except Exception:  # noqa: BLE001 — already killed
+            pass
+
+
 DRILLS = {
     "smoke": drill_smoke,
     "native_smoke": drill_native_smoke,
@@ -1270,6 +1490,7 @@ DRILLS = {
     "brownout_dispatch": drill_brownout_dispatch,
     "ckpt_race": drill_ckpt_race,
     "agent_kill": drill_agent_kill,
+    "replica_leader_kill": drill_replica_leader_kill,
 }
 
 

@@ -1,0 +1,477 @@
+"""Replication plane tests (ISSUE 20): per-shard leader/follower WAL
+shipping, bounded-lag follower reads, quorum ack durability, fencing
+epochs, replica-group clients, the fsck divergence audit and the
+zero-acked-record-loss failover drill.
+
+Tier-1 tests assemble small in-process replica groups (MemStore +
+StoreServer + ReplManager over loopback TCP); the heavyweight
+``replica_leader_kill`` chaos drill and its must-fail unreplicated
+control arm ride the slow tier alongside test_chaos_drills.py.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from cronsun_tpu.repl import ReplManager, ReplicaGroupStore
+from cronsun_tpu.chaos.invariants import replication_audit
+from cronsun_tpu.store.memstore import MemStore
+from cronsun_tpu.store.remote import (NotLeaderError, RemoteStore,
+                                      RemoteStoreError, StoreServer)
+from cronsun_tpu.store.sharded import connect_sharded
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class _Group:
+    """An in-process replica group: n MemStores served over loopback,
+    member 0 boots leader, the rest boot followers."""
+
+    def __init__(self, n=2, ack="async", promote_after=60.0,
+                 ack_timeout=5.0, wal_dir=None, start_followers=True):
+        self.stores, self.srvs, self.mgrs = [], [], []
+        self.wal_paths = []
+        for i in range(n):
+            st = MemStore()
+            if wal_dir is not None:
+                p = os.path.join(str(wal_dir), f"m{i}.wal")
+                st.open_wal(p)
+                self.wal_paths.append(p)
+            self.stores.append(st)
+            self.srvs.append(StoreServer(store=st))
+        self.addrs = [f"{s.host}:{s.port}" for s in self.srvs]
+        for i, (st, sv) in enumerate(zip(self.stores, self.srvs)):
+            m = ReplManager(st, self.addrs[i], self.addrs,
+                            ack_mode=ack if i == 0 else "async",
+                            promote_after=promote_after,
+                            ack_timeout=ack_timeout)
+            sv.attach_repl(m)
+            sv.start()
+            self.mgrs.append(m)
+        self.mgrs[0].start()
+        if start_followers:
+            for m in self.mgrs[1:]:
+                m.start()
+
+    def dial(self, i) -> RemoteStore:
+        host, _, port = self.addrs[i].rpartition(":")
+        return RemoteStore(host, int(port), timeout=5.0,
+                           reconnect=False)
+
+    def settle(self, timeout=10.0):
+        """Wait until every running follower has applied the leader's
+        full history (lag 0 at the leader's current revision)."""
+        lead = self.stores[0].rev()
+
+        def ok():
+            return all(
+                m.status().get("lag_records") == 0
+                and s.rev() >= lead
+                for m, s in zip(self.mgrs[1:], self.stores[1:])
+                if m._thread is not None and m._thread.is_alive())
+        _wait(ok, timeout, "follower lag -> 0")
+
+    def close(self):
+        for m in self.mgrs:
+            try:
+                m.stop()
+            except Exception:
+                pass
+        for sv in self.srvs:
+            try:
+                sv.stop()
+            except Exception:
+                pass
+
+
+@pytest.fixture
+def group_factory():
+    groups = []
+
+    def make(*a, **kw):
+        g = _Group(*a, **kw)
+        groups.append(g)
+        return g
+    yield make
+    for g in groups:
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL-shipping conformance
+# ---------------------------------------------------------------------------
+
+def _split_dump(lines):
+    v = [json.dumps(r) for r in lines if r[0] == "v"]
+    g = sorted((r for r in lines if r[0] == "g"),
+               key=lambda r: r[1])
+    s = sorted((json.dumps(r) for r in lines if r[0] == "s"))
+    return v, g, s
+
+
+def test_wal_shipping_conformance(group_factory, tmp_path):
+    """The ISSUE's conformance gate: after bootstrap + tail streaming
+    the follower's state is byte-identical to the leader's — same kv
+    lines, same revision/lease-counter/epoch "v" line, same lease
+    table (wall deadlines within clock-conversion tolerance) — and the
+    follower's on-disk snap+WAL reboots to the same state."""
+    g = group_factory(2, wal_dir=tmp_path, start_followers=False)
+    s1 = g.stores[0]
+
+    # pre-follower history: the follower must BOOTSTRAP this via
+    # repl_snapshot, not tail it
+    lid = s1.grant(ttl=30.0)
+    for i in range(40):
+        s1.put(f"/boot/{i:03d}", f"v{i}")
+    s1.put("/boot/leased", "x", lease=lid)
+    s1.delete("/boot/007")
+
+    g.mgrs[1].start()
+    g.settle()
+
+    # tail phase: shipped record-by-record through the live stream
+    lid2 = s1.grant(ttl=30.0)
+    s1.put_many([(f"/tail/{i:03d}", f"t{i}") for i in range(25)])
+    s1.put("/tail/leased", "y", lease=lid2)
+    s1.keepalive(lid)
+    s1.revoke(lid2)           # cascades the delete of /tail/leased
+    s1.delete("/tail/003")
+    g.settle()
+
+    d1, seq1, ep1 = s1.repl_dump()
+    d2, seq2, ep2 = g.stores[1].repl_dump()
+    assert (seq1, ep1) == (seq2, ep2)
+    v1, g1, kv1 = _split_dump(d1)
+    v2, g2, kv2 = _split_dump(d2)
+    assert v1 == v2                     # rev + next-lease + epoch
+    assert kv1 == kv2                   # byte-identical kv state
+    assert len(kv1) > 60
+    assert [r[:3] for r in g1] == [r[:3] for r in g2]
+    for a, b in zip(g1, g2):
+        # deadlines are wall instants recomputed from the monotonic
+        # clock on each side; allow the conversion jitter
+        assert abs(a[3] - b[3]) < 1.0
+
+    # the follower's on-disk state is exactly a replica's snap+WAL:
+    # stop it and reboot a fresh store from its files
+    follower_rev = g.stores[1].rev()
+    g.mgrs[1].stop()
+    g.srvs[1].stop()
+    fresh = MemStore().open_wal(g.wal_paths[1])
+    try:
+        assert fresh.rev() == follower_rev
+        assert fresh.repl_epoch() == ep1
+        assert fresh.get("/boot/001").value == "v1"
+        assert fresh.get("/boot/007") is None
+        assert fresh.get("/tail/leased") is None
+        assert fresh.get("/boot/leased").lease == lid
+    finally:
+        fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# follower reads + mutation refusal
+# ---------------------------------------------------------------------------
+
+def test_follower_serves_bounded_lag_reads(group_factory):
+    g = group_factory(2)
+    lead = g.dial(0)
+    try:
+        for i in range(20):
+            lead.put(f"/r/{i:02d}", str(i))
+    finally:
+        lead.close()
+    g.settle()
+
+    fol = g.dial(1)
+    try:
+        st = fol.repl_status()
+        assert st["role"] == "follower" and st["lag_records"] == 0
+        assert fol.rev() == g.stores[0].rev()
+        assert len(fol.get_prefix("/r/")) == 20
+        assert fol.get("/r/07").value == "7"
+        # leases/fences/mutations are granted ONLY by the leader
+        with pytest.raises(NotLeaderError):
+            fol.put("/r/xx", "no")
+        with pytest.raises(NotLeaderError):
+            fol.grant(ttl=5.0)
+        with pytest.raises(NotLeaderError):
+            fol.delete("/r/00")
+    finally:
+        fol.close()
+    assert g.stores[1].get("/r/xx") is None
+
+
+# ---------------------------------------------------------------------------
+# quorum ack durability + failover
+# ---------------------------------------------------------------------------
+
+def test_quorum_ack_durability_across_failover(group_factory, tmp_path):
+    """--repl-ack quorum: an acked write is durable on >= 1 follower
+    BEFORE the client sees success, so it survives losing the leader;
+    a write that failed its quorum window is allowed to vanish — and
+    the promoted follower stamps a fencing "E" record that persists
+    through its own WAL reboot."""
+    g = group_factory(2, ack="quorum", ack_timeout=1.0,
+                      wal_dir=tmp_path)
+    lead = g.dial(0)
+    try:
+        lead.put("/q/acked", "survives")      # both copies before reply
+        g.settle()
+
+        # freeze shipping: the follower's pull loop goes away, so the
+        # next quorum write can never be acked
+        g.mgrs[1].stop()
+        with pytest.raises(RemoteStoreError) as ei:
+            lead.put("/q/unacked", "lost")
+        assert "quorum" in str(ei.value)
+        assert g.stores[0].get("/q/unacked") is not None   # local only
+        assert g.stores[1].get("/q/unacked") is None
+    finally:
+        lead.close()
+
+    # kill -9 the leader; restart the follower's manager so it runs
+    # the election clock and takes over
+    g.srvs[0].kill()
+    m1b = ReplManager(g.stores[1], g.addrs[1], g.addrs,
+                      promote_after=0.5, initial_role="follower")
+    g.srvs[1].attach_repl(m1b)
+    g.mgrs.append(m1b)
+    m1b.start()
+    _wait(lambda: m1b.role() == "leader", 15.0, "follower promotion")
+
+    s2 = g.stores[1]
+    assert s2.repl_epoch() >= 1
+    assert s2.get("/q/acked").value == "survives"   # zero acked loss
+    assert s2.get("/q/unacked") is None             # unacked may die
+
+    # the epoch and the acked record both survive a WAL reboot
+    m1b.stop()
+    g.srvs[1].stop()
+    fresh = MemStore().open_wal(g.wal_paths[1])
+    try:
+        assert fresh.repl_epoch() == s2.repl_epoch()
+        assert fresh.get("/q/acked").value == "survives"
+        assert fresh.get("/q/unacked") is None
+    finally:
+        fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# fencing epochs
+# ---------------------------------------------------------------------------
+
+def test_fencing_epoch_refuses_deposed_leader(group_factory):
+    """Split brain: promote the follower while the old leader still
+    runs.  The old leader's probe sees the newer fencing epoch,
+    demotes, refuses late appends, and resyncs away its divergent
+    tail."""
+    g = group_factory(2)
+    lead = g.dial(0)
+    try:
+        lead.put("/f/shared", "pre")
+        g.settle()
+
+        g.mgrs[1]._promote()
+        assert g.mgrs[1].role() == "leader"
+        assert g.stores[1].repl_epoch() == 1
+
+        # the deposed leader may briefly accept a divergent append...
+        try:
+            lead.put("/f/divergent", "stale")
+        except (NotLeaderError, RemoteStoreError, OSError):
+            pass        # ...or already refuse it; both are correct
+        _wait(lambda: g.mgrs[0].role() == "follower", 15.0,
+              "old leader demotion")
+        with pytest.raises((NotLeaderError, RemoteStoreError, OSError)):
+            lead.put("/f/late", "refused")
+    finally:
+        lead.close()
+
+    # the resync discards the divergent tail and converges both
+    # replicas on the new leader's history at the new epoch
+    _wait(lambda: g.stores[0].repl_epoch() == 1
+          and g.stores[0].get("/f/divergent") is None, 15.0,
+          "deposed leader resync")
+    assert g.stores[0].get("/f/shared").value == "pre"
+    assert g.stores[0].get("/f/late") is None
+
+
+def test_hello_with_newer_epoch_deposes():
+    """A follower announcing a newer fencing epoch at hello deposes a
+    stale leader immediately (wire-level log matching)."""
+    st = MemStore()
+    try:
+        m = ReplManager(st, "a:1", ["a:1", "b:2"],
+                        initial_role="leader")
+        with pytest.raises(NotLeaderError):
+            m.hello("b:2", 5, 0)
+        assert m.role() == "follower"
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: round-trip, lag -> 0, clean promotion
+# ---------------------------------------------------------------------------
+
+def test_repl_smoke_promotion_serves_reads(group_factory):
+    """ISSUE's tier-1 smoke: 1 leader + 1 follower in process, writes
+    round-trip, lag converges to zero, and after a hard leader kill
+    the promoted follower serves reads (and writes) cleanly."""
+    g = group_factory(2, promote_after=0.75)
+    lead = g.dial(0)
+    try:
+        for i in range(10):
+            lead.put(f"/s/{i}", str(i))
+    finally:
+        lead.close()
+    g.settle()
+    assert g.mgrs[1].status()["lag_records"] == 0
+
+    g.srvs[0].kill()
+    _wait(lambda: g.mgrs[1].role() == "leader", 15.0, "promotion")
+
+    cli = g.dial(1)
+    try:
+        assert len(cli.get_prefix("/s/")) == 10
+        assert cli.repl_status()["role"] == "leader"
+        cli.put("/s/after", "promoted")
+        assert cli.get("/s/after").value == "promoted"
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# replica-group client
+# ---------------------------------------------------------------------------
+
+def test_replica_group_client_rotation(group_factory):
+    """connect_store's addr1|addr2|addr3 client: discovers the leader
+    regardless of member order, and rotates onto the promoted member
+    after a leader kill without losing acked writes."""
+    g = group_factory(3, promote_after=1.0)
+    # follower-first ordering: discovery must still route to member 0
+    cli = ReplicaGroupStore([g.addrs[1], g.addrs[2], g.addrs[0]],
+                            timeout=5.0)
+    try:
+        assert cli.leader_addr() == g.addrs[0]
+        for i in range(10):
+            cli.put(f"/g/{i}", str(i))
+        g.settle()
+
+        g.srvs[0].kill()
+
+        def promoted_write():
+            try:
+                cli.put("/g/after", "rotated")
+                return True
+            except (RemoteStoreError, OSError):
+                return False
+        _wait(promoted_write, 20.0, "client rotation onto new leader")
+        assert cli.leader_addr() in (g.addrs[1], g.addrs[2])
+        assert cli.get("/g/after").value == "rotated"
+        assert len(cli.get_prefix("/g/")) == 11
+    finally:
+        cli.close()
+
+
+def test_connect_sharded_refuses_empty_group_member():
+    """Satellite: a replica group with an empty member is refused at
+    parse time, before any dial."""
+    from cronsun_tpu.bin.common import connect_store
+    for bad in ("a:1|,b:2", "a:1||b:2", "|a:1", "a:1|b:2|"):
+        with pytest.raises(ValueError, match="empty member"):
+            connect_store(bad)
+        with pytest.raises(ValueError, match="empty member"):
+            connect_sharded([bad.split(",")[0]])
+    with pytest.raises(ValueError, match="empty member"):
+        ReplicaGroupStore(["127.0.0.1:1", "  "])
+
+
+# ---------------------------------------------------------------------------
+# fsck replication audit
+# ---------------------------------------------------------------------------
+
+def test_fsck_replication_audit(group_factory):
+    """Clean groups audit clean; a follower whose applied prefix
+    diverges below the minimum applied revision is a named finding
+    carrying the first divergent key."""
+    g = group_factory(2)
+    lead = g.dial(0)
+    try:
+        for i in range(10):
+            lead.put(f"/a/{i:02d}", str(i))
+    finally:
+        lead.close()
+    g.settle()
+
+    cli = ReplicaGroupStore(list(g.addrs), timeout=5.0)
+    try:
+        assert replication_audit(cli) == []
+
+        # freeze shipping, then corrupt the follower's replicated
+        # prefix IN PLACE (no revision bump — this is exactly the
+        # below-min-rev divergence the audit exists to catch)
+        g.mgrs[1].stop()
+        s2 = g.stores[1]
+        s2._stripes[s2._sidx("/a/03")].kv.pop("/a/03")
+
+        finds = replication_audit(cli)
+        assert [f.code for f in finds] == ["replica_divergence"]
+        assert finds[0].key == "/a/03"
+        assert g.addrs[1] in finds[0].detail
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the chaos drill gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_replica_leader_kill_drill():
+    """The ISSUE's gate: kill -9 of a store-shard leader under live
+    dispatch with quorum ack — bounded takeover, exactly-once intact,
+    ZERO acked-record loss — across 3 seeds."""
+    import bench_chaos
+    for seed in (43, 44, 45):
+        res = bench_chaos.DRILLS["replica_leader_kill"](
+            on_log=lambda *a: None, seed=seed)
+        assert res["findings"] == [], \
+            f"seed {seed}: {res['findings']}"
+        assert res["info"]["acked_probes"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_replica_leader_kill_drill_fails_unreplicated():
+    """The same gate MUST fail with replication disabled — acked
+    single-copy records die with the leader — proving the drill
+    measures the replication plane and not a tautology."""
+    import bench_chaos
+    res = bench_chaos.DRILLS["replica_leader_kill"](
+        on_log=lambda *a: None, replicated=False)
+    codes = {f["code"] if isinstance(f, dict) else f.code
+             for f in res["findings"]}
+    assert "acked_record_lost" in codes

@@ -270,10 +270,13 @@ def test_idle_epoll_viewers_hold_no_threads(monkeypatch):
         base = threading.active_count()
         for _ in range(20):
             socks.append(_connect(srv.port)[0])
-        # handler threads unwind after adopting; give them a beat
+        # handler threads unwind after adopting; give them a beat (and
+        # wait for ALL 20 adoptions — the last handler can still be
+        # mid-adoption when the thread count has already settled)
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
-            if threading.active_count() - base <= 3:
+            if threading.active_count() - base <= 3 and \
+                    sum(srv._sse_pool.stats()["loop_connections"]) == 20:
                 break
             time.sleep(0.05)
         grown = threading.active_count() - base
