@@ -21,10 +21,10 @@ stream ("E" record) so a deposed leader's late appends are refused.
   rotates on leader loss.
 """
 
-from ..store.remote import NotLeaderError
+from ..store.remote import NotLeaderError, QuorumTimeoutError
 from .client import ReplicaGroupStore, fleet_repl_status
 from .log import ReplLog
 from .manager import ReplManager
 
-__all__ = ["NotLeaderError", "ReplLog", "ReplManager",
-           "ReplicaGroupStore", "fleet_repl_status"]
+__all__ = ["NotLeaderError", "QuorumTimeoutError", "ReplLog",
+           "ReplManager", "ReplicaGroupStore", "fleet_repl_status"]

@@ -26,8 +26,8 @@ from typing import Dict, List, Optional
 
 from .. import log as _log
 from ..core.backoff import RECONNECT
-from ..store.remote import (NotLeaderError, RemoteStore, RemoteStoreError,
-                            RemoteWatcher)
+from ..store.remote import (NotLeaderError, QuorumTimeoutError,
+                            RemoteStore, RemoteStoreError, RemoteWatcher)
 
 # every RemoteStore RPC the components call, forwarded with rotation
 _FORWARD = frozenset({
@@ -95,6 +95,8 @@ class ReplicaGroupStore:
                 if not st.get("enabled"):
                     # plain unreplicated server: it IS the leader of
                     # its 1-member group
+                    if best is not None:
+                        best[2].close()
                     best = (0, addr, cli, st)
                     break
                 if st.get("role") == "leader":
@@ -150,6 +152,14 @@ class ReplicaGroupStore:
                 continue
             try:
                 return getattr(cli, name)(*args, **kw)
+            except QuorumTimeoutError:
+                # the op APPLIED on the leader but missed its quorum
+                # window: a blind rotation-retry would double-apply
+                # non-idempotent ops (grant allocates a second lease,
+                # put/delete double-bump the revision and double-fire
+                # watches) — surface the named error, the caller
+                # decides
+                raise
             except NotLeaderError as e:
                 # the replica demoted (or we raced a failover): rotate
                 # immediately, the promoted member answers the sweep

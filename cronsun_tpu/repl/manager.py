@@ -18,13 +18,20 @@ replica group (``addr1|addr2|addr3``):
 - FAILOVER: when no acceptable leader answers for ``promote_after``
   seconds, the most-caught-up live member (ties to lowest group index)
   promotes — ``MemStore.repl_promote`` bumps the fencing epoch and
-  stamps an "E" record into the stream.  This is deterministic
-  COORDINATION, not consensus: a partitioned minority can briefly hold
-  a deposed leader, but its epoch is stale, so followers refuse its
-  records, quorum-acked writes on it fail (no acks), and on contact
-  with the newer epoch it demotes and full-resyncs, discarding its
-  divergent tail.  Operators who need partition-proof election should
-  front the group with a real consensus service (see DESIGN.md).
+  stamps an "E" record into the stream.  Every leader BOOT opens a new
+  epoch the same way, so cursor numbering never survives a process
+  restart unfenced.  This is deterministic COORDINATION, not
+  consensus: a partitioned minority can briefly hold a deposed leader,
+  but followers refuse its records and quorum-acked writes on it fail
+  (no acks); on contact with a newer epoch — or with an EQUAL-epoch
+  rival (a concurrent promotion, or a rebooted ex-leader whose boot
+  term collided with the live leader's), where the HIGHER shipping
+  cursor wins and group index breaks exact ties — it demotes, poisons
+  its cursor, and full-resyncs, discarding its divergent tail.  The
+  seq-first rule matters: a rebooted stale leader must never depose a
+  promoted rival that carries quorum-acked writes it lacks.  Operators who need partition-proof
+  election should front the group with a real consensus service (see
+  DESIGN.md).
 
 Leases and fences are granted only by the leader (followers refuse
 mutations with ``NotLeaderError``), so exactly-once semantics are
@@ -51,6 +58,7 @@ class ReplManager:
     PULL_MAX = 512          # records per pull reply
     PULL_WAIT_MS = 400      # long-poll hold at the leader
     PROBE_S = 1.0           # leader's deposed-epoch sweep cadence
+    SNAP_PAGE = 50_000      # snapshot lines per repl_snapshot page
 
     def __init__(self, store, self_addr: str, group, ack_mode: str = "async",
                  token: str = "", promote_after: float = 3.0,
@@ -78,11 +86,21 @@ class ReplManager:
             raise ValueError(f"repl role {role!r}")
         self.log = ReplLog(epoch=store.repl_epoch())
         if role == "leader":
-            # seed the cursor at the store's boot revision: a store
-            # restored from snap+WAL has state PREDATING the (empty)
-            # ring, so a follower claiming cursor 0 against a nonempty
-            # leader must bootstrap, not tail
-            self.log.reset(store.rev(), store.repl_epoch())
+            # every leader BOOT opens a new fencing term (repl_promote
+            # bumps the epoch and stamps the "E" record into the WAL),
+            # then the cursor seeds at the store's boot revision.  Both
+            # halves matter: the revision seed makes a follower
+            # claiming cursor 0 against a nonempty leader bootstrap
+            # instead of tail, and the epoch bump fences SURVIVING
+            # followers — their cursors are numbered by the previous
+            # process's ring, inflated past the revision by lease
+            # records ("g"/"k"/"x" never bump rev), so once this ring's
+            # seq catches up to such a stale cursor the log-match would
+            # collide and silently skip records.  With the boot term
+            # the baseline epoch no longer matches theirs and hello
+            # full-resyncs them.
+            epoch = store.repl_promote()
+            self.log.reset(store.rev(), epoch)
         else:
             # a (re)starting follower's cursor lives in a DEAD
             # numbering space (the ring is in-memory; the leader's
@@ -104,6 +122,9 @@ class ReplManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._peers: Dict[str, RemoteStore] = {}
+        # fid -> (lines, seq, epoch, pages): per-follower bootstrap
+        # image held across its paged repl_snapshot fetches
+        self._snap_cache: Dict[str, Tuple[list, int, int, int]] = {}
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -180,13 +201,46 @@ class ReplManager:
             self._mu.notify_all()
         return True
 
-    def snapshot_dump(self) -> dict:
+    def snapshot_dump(self, fid: str = "", page: int = 0) -> dict:
         """Bootstrap image: consistent snapshot lines + the repl cursor
-        and fencing epoch they correspond to."""
+        and fencing epoch they correspond to.
+
+        With a ``fid`` the transfer is PAGED: page 0 takes one
+        staggered dump (writers stall at most one stripe's copy — see
+        ``MemStore.repl_dump``), caches it per follower, and every
+        reply ships at most ``SNAP_PAGE`` lines, so a large store never
+        has to serialize into a single wire message inside one client
+        timeout.  The cache entry drops when the last page is served
+        (or on any role change).  Without a ``fid`` the whole image
+        ships in one reply (tooling / conformance compat)."""
         if self.role() != "leader":
             raise NotLeaderError("repl: not the leader")
-        lines, seq, epoch = self.store.repl_dump()
-        return {"lines": lines, "seq": seq, "epoch": epoch}
+        if not fid:
+            lines, seq, epoch = self.store.repl_dump()
+            return {"lines": lines, "seq": seq, "epoch": epoch,
+                    "pages": 1, "page": 0}
+        fid, page = str(fid), int(page)
+        if page == 0:
+            lines, seq, epoch = self.store.repl_dump()
+            pages = max(1, -(-len(lines) // self.SNAP_PAGE))
+            with self._mu:
+                self._snap_cache[fid] = (lines, seq, epoch, pages)
+        with self._mu:
+            cached = self._snap_cache.get(fid)
+        if cached is None:
+            # leader restarted / role flapped mid-transfer: the pages
+            # would come from two different images — restart the
+            # bootstrap from page 0 instead
+            raise RuntimeError(
+                f"repl_snapshot: no cached image for {fid!r} "
+                f"(page {page}); restart from page 0")
+        lines, seq, epoch, pages = cached
+        lo = page * self.SNAP_PAGE
+        if page >= pages - 1:
+            with self._mu:
+                self._snap_cache.pop(fid, None)
+        return {"lines": lines[lo:lo + self.SNAP_PAGE], "seq": seq,
+                "epoch": epoch, "pages": pages, "page": page}
 
     def ack_wait(self, seq: int, timeout: Optional[float] = None) -> bool:
         """Quorum ack: block until >= 1 follower has acked through
@@ -250,17 +304,39 @@ class ReplManager:
         """A leader sweeps its peers for a NEWER fencing epoch — the
         deposed-while-partitioned case: seeing one demotes us, so our
         divergent tail is discarded by the resync instead of serving
-        stale reads forever."""
+        stale reads forever.  An EQUAL-epoch peer leader (two followers
+        promoted concurrently off the same base epoch, or a rebooted
+        ex-leader whose boot term collided with the promoted rival's)
+        is broken deterministically: the HIGHER shipping cursor wins —
+        the contender that lacks writes the other carries is the one
+        that must discard — and group index (lowest wins) only breaks
+        exact seq ties, so exactly one of the pair demotes and resyncs
+        instead of both serving as leader at identical epochs forever.
+        Index-first would let a rebooted stale leader roll the group
+        back over quorum-acked writes it slept through."""
         my_epoch = self.store.repl_epoch()
         for addr in self.group:
             if addr == self.self_addr:
                 continue
             st = self._status_of(addr)
-            if st is not None and int(st.get("epoch", 0)) > my_epoch:
-                _log.warnf("repl: peer %s at epoch %s > ours %d; "
-                           "demoting", addr, st.get("epoch"), my_epoch)
-                self._demote(int(st["epoch"]))
+            if st is None:
+                continue
+            ep = int(st.get("epoch", 0))
+            if ep > my_epoch:
+                _log.warnf("repl: peer %s at epoch %d > ours %d; "
+                           "demoting", addr, ep, my_epoch)
+                self._demote(ep)
                 return
+            if ep == my_epoch and st.get("role") == "leader":
+                peer_seq = int(st.get("seq", -1))
+                my_seq = self.log.seq
+                if peer_seq > my_seq or (peer_seq == my_seq and
+                                         self.group.index(addr) < self.index):
+                    _log.warnf("repl: equal-epoch leader %s (epoch %d, "
+                               "seq %d vs ours %d) wins the tie-break; "
+                               "demoting", addr, ep, peer_seq, my_seq)
+                    self._demote(ep)
+                    return
 
     def _follow_once(self):
         found = self._discover_leader()
@@ -274,9 +350,12 @@ class ReplManager:
             if int(r.get("epoch", -1)) < self.store.repl_epoch():
                 return                      # stale leader: re-discover
             if r.get("resync"):
-                snap = cli._call("repl_snapshot")
-                self.store.repl_load(snap["lines"], snap["seq"],
-                                     snap["epoch"])
+                snap = cli._call("repl_snapshot", self.self_addr, 0)
+                lines = list(snap.get("lines") or [])
+                for p in range(1, int(snap.get("pages", 1))):
+                    nxt = cli._call("repl_snapshot", self.self_addr, p)
+                    lines.extend(nxt.get("lines") or [])
+                self.store.repl_load(lines, snap["seq"], snap["epoch"])
                 _log.infof("repl: bootstrapped from %s (seq %d, "
                            "epoch %d)", addr, self.log.seq,
                            self.store.repl_epoch())
@@ -379,6 +458,7 @@ class ReplManager:
             self._leader_addr = self.self_addr
             self._leader_head = None
             self._followers.clear()
+            self._snap_cache.clear()
             self.promotions += 1
             self._leaderless_since = None
             self._mu.notify_all()
@@ -393,11 +473,15 @@ class ReplManager:
             self._leader_addr = None
             self._leader_head = None
             self._followers.clear()
+            self._snap_cache.clear()
             self._leaderless_since = None
             self._mu.notify_all()
-        # follower mode: local lease expiry off, mutations refused; the
-        # pull loop will hello the new leader and full-resync (our
-        # post-deposition tail log-mismatches its epoch history)
+        # follower mode: local lease expiry off, mutations refused.
+        # The cursor is POISONED so the next hello always full-resyncs:
+        # our pre-deposition tail may carry appends the winning leader
+        # never saw, and with an equal-epoch rival (concurrent
+        # promotions) the epoch history alone cannot flag them.
+        self.log.reset(-1, -1)
         self.store.repl_attach(self.log, follower=True)
         _log.warnf("repl: demoted (saw fencing epoch %d)", seen_epoch)
 

@@ -680,13 +680,75 @@ class MemStore:
     def repl_dump(self) -> Tuple[list, int, int]:
         """Consistent bootstrap image for a joining follower: the full
         snapshot line stream plus the repl-log sequence and fencing
-        epoch it corresponds to, captured under every lock so no
-        mutation can land between the image and the cursor."""
-        with self._locked(all_stripes=True), self._lease_lock, \
-                self._ev_lock:
-            lines = [list(r) for r in self._snapshot_lines()]
-            seq = self._repl_log.seq if self._repl_log is not None else 0
-            return lines, seq, self._epoch
+        epoch it corresponds to.
+
+        Staggered by default, reusing the snapshot plane's machinery
+        (same ``_snap_mu`` / per-stripe COW state, so it serializes
+        with :meth:`snapshot`): a brief all-locks PIN fixes the cursor,
+        revision and lease copy and arms the copy-on-write pre-images,
+        then stripes image ONE AT A TIME under their own locks — a
+        follower bootstrap never stalls the leader's write plane longer
+        than one stripe's copy.  Post-pin mutations revert to their
+        pinned pre-image in the lines, so the image is exactly the
+        state at the captured cursor (their records ship via the tail
+        stream).  ``snapshot_staggered=False`` keeps the full-lock hold
+        (the same rollback switch as :meth:`snapshot`)."""
+        if not self._snap_staggered:
+            with self._locked(all_stripes=True), self._lease_lock, \
+                    self._ev_lock:
+                lines = [list(r) for r in self._snapshot_lines()]
+                seq = self._repl_log.seq \
+                    if self._repl_log is not None else 0
+                return lines, seq, self._epoch
+        with self._snap_mu:
+            t0 = time.perf_counter_ns()
+            # PIN: all locks held only long enough to fix the cursor /
+            # revision boundary, copy the (small) lease table and arm
+            # the per-stripe COW — _log appends happen under _ev_lock
+            # (KV) or _lease_lock (lease records), both held here, so
+            # no record can land between the state capture and the seq
+            with self._locked(all_stripes=True), self._lease_lock, \
+                    self._ev_lock:
+                rev = self._rev
+                next_lease = self._next_lease
+                epoch = self._epoch
+                seq = self._repl_log.seq \
+                    if self._repl_log is not None else 0
+                now_c, now_w = self._clock(), time.time()
+                leases = [(l.id, l.ttl, now_w + (l.deadline - now_c))
+                          for l in self._leases.values()]
+                for s in self._stripes:
+                    s.imaged = False
+                    s.cow = {}
+                self._snap_active = True
+            lines: list = [["v", rev, next_lease, epoch]]
+            try:
+                for lid, ttl, wall in leases:
+                    lines.append(["g", lid, ttl, wall])
+                for s in self._stripes:
+                    with s.lock:
+                        img = dict(s.kv)
+                        cow, s.cow = s.cow, {}
+                        s.imaged = True
+                    # pre-images overlay OUTSIDE the lock: a key
+                    # mutated post-pin reverts to its pinned value
+                    # (None = did not exist at the pin)
+                    for k, pre in cow.items():
+                        if pre is None:
+                            img.pop(k, None)
+                        else:
+                            img[k] = pre
+                    for k, kv in img.items():
+                        lines.append(["s", k, kv.value, kv.create_rev,
+                                      kv.mod_rev, kv.lease])
+            finally:
+                self._snap_active = False
+                for s in self._stripes:
+                    with s.lock:
+                        s.imaged = True
+                        s.cow = {}
+            self._op_record("repl_dump", t0)
+            return lines, seq, epoch
 
     def repl_load(self, lines: Sequence[list], seq: int, epoch: int):
         """Follower bootstrap: replace local state with a leader's

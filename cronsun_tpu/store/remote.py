@@ -247,9 +247,11 @@ class _Conn(LineJsonHandler):
                     # durability before the ack: the reply waits until
                     # >= 1 follower's cursor covers this op's records.
                     # On timeout the op is applied locally but reported
-                    # FAILED — the caller retries idempotently (puts
-                    # overwrite, claims re-check their fence), and a
-                    # failover cannot lose a write we never acked.
+                    # FAILED under the DISTINCT QuorumTimeout kind —
+                    # clients must not blindly retry (grant is not
+                    # idempotent; put/delete double-bump the revision),
+                    # but a failover cannot lose a write we never
+                    # acked.
                     seq = mgr.log.seq
                     if not mgr.ack_wait(seq):
                         self._send({
@@ -413,6 +415,18 @@ class NotLeaderError(RemoteStoreError):
     """The targeted replica is a follower: leases, fences, and writes
     belong to its group's leader (replication plane).  Replica-group
     clients rotate to the leader on this error."""
+
+
+class QuorumTimeoutError(RemoteStoreError):
+    """A ``--repl-ack quorum`` write was APPLIED on the leader but no
+    follower acked it within the window — it is live locally and will
+    ship when a follower catches up, it is just not known replicated.
+    Distinct from a generic failure because a blind retry DOUBLE-
+    APPLIES non-idempotent ops (``grant`` allocates a second lease;
+    put/delete bump the revision and fire watch events twice):
+    replica-group clients surface this instead of rotating, and the
+    caller decides — re-read before re-granting, treat an idempotent
+    overwrite as acceptable, or wait for the follower to rejoin."""
 
 
 class RemoteStore:
@@ -692,6 +706,8 @@ class RemoteStore:
                 raise WatchLost(msg["e"])
             if kind == "NotLeader":
                 raise NotLeaderError(msg["e"])
+            if kind == "QuorumTimeout":
+                raise QuorumTimeoutError(msg["e"])
             raise RemoteStoreError(msg["e"])
         if act is not None:
             act.post(RemoteStoreError, op)   # applied; reply "lost"

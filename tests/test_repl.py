@@ -20,8 +20,9 @@ import pytest
 from cronsun_tpu.repl import ReplManager, ReplicaGroupStore
 from cronsun_tpu.chaos.invariants import replication_audit
 from cronsun_tpu.store.memstore import MemStore
-from cronsun_tpu.store.remote import (NotLeaderError, RemoteStore,
-                                      RemoteStoreError, StoreServer)
+from cronsun_tpu.store.remote import (NotLeaderError, QuorumTimeoutError,
+                                      RemoteStore, RemoteStoreError,
+                                      StoreServer)
 from cronsun_tpu.store.sharded import connect_sharded
 
 sys.path.insert(0, os.path.join(
@@ -276,6 +277,52 @@ def test_quorum_ack_durability_across_failover(group_factory, tmp_path):
         fresh.close()
 
 
+def test_quorum_timeout_named_and_not_blind_retried(group_factory):
+    """A quorum-window timeout surfaces as the DISTINCT
+    QuorumTimeoutError and the replica-group client does NOT rotate-
+    retry it: the op already applied on the leader, so a blind retry
+    would double-apply non-idempotent ops (a second lease from grant,
+    a double revision bump from put)."""
+    g = group_factory(2, ack="quorum", ack_timeout=1.0)
+    g.settle()                          # follower attached and pulling
+    cli = ReplicaGroupStore(list(g.addrs), timeout=5.0)
+    try:
+        cli.put("/q/ok", "1")           # acked while the follower lives
+        g.settle()
+        g.mgrs[1].stop()                # freeze shipping
+
+        rev_before = g.stores[0].rev()
+        with pytest.raises(QuorumTimeoutError):
+            cli.put("/q/stuck", "x")
+        assert g.stores[0].rev() == rev_before + 1   # applied ONCE
+
+        assert len(g.stores[0]._leases) == 0
+        with pytest.raises(QuorumTimeoutError):
+            cli.grant(ttl=30.0)
+        assert len(g.stores[0]._leases) == 1         # no second lease
+    finally:
+        cli.close()
+
+
+def test_paged_snapshot_bootstrap(group_factory):
+    """Follower bootstrap chunks the snapshot transfer into
+    repl_snapshot pages (no single wire message carries the whole
+    store) and still converges byte-identically."""
+    g = group_factory(2, start_followers=False)
+    s1 = g.stores[0]
+    for i in range(60):
+        s1.put(f"/p/{i:03d}", f"v{i}")
+    g.mgrs[0].SNAP_PAGE = 7             # force a many-page transfer
+    g.mgrs[1].start()
+    g.settle()
+    d1, seq1, ep1 = s1.repl_dump()
+    d2, seq2, ep2 = g.stores[1].repl_dump()
+    assert (seq1, ep1) == (seq2, ep2)
+    assert sorted(json.dumps(r) for r in d1) \
+        == sorted(json.dumps(r) for r in d2)
+    assert g.mgrs[0]._snap_cache == {}  # cache dropped after last page
+
+
 # ---------------------------------------------------------------------------
 # fencing epochs
 # ---------------------------------------------------------------------------
@@ -291,9 +338,11 @@ def test_fencing_epoch_refuses_deposed_leader(group_factory):
         lead.put("/f/shared", "pre")
         g.settle()
 
+        ep0 = g.stores[1].repl_epoch()
         g.mgrs[1]._promote()
         assert g.mgrs[1].role() == "leader"
-        assert g.stores[1].repl_epoch() == 1
+        ep_new = g.stores[1].repl_epoch()
+        assert ep_new == ep0 + 1
 
         # the deposed leader may briefly accept a divergent append...
         try:
@@ -309,11 +358,153 @@ def test_fencing_epoch_refuses_deposed_leader(group_factory):
 
     # the resync discards the divergent tail and converges both
     # replicas on the new leader's history at the new epoch
-    _wait(lambda: g.stores[0].repl_epoch() == 1
+    _wait(lambda: g.stores[0].repl_epoch() == ep_new
           and g.stores[0].get("/f/divergent") is None, 15.0,
           "deposed leader resync")
     assert g.stores[0].get("/f/shared").value == "pre"
     assert g.stores[0].get("/f/late") is None
+
+
+def test_leader_restart_fences_stale_cursor(tmp_path):
+    """A restarting leader opens a NEW fencing term, so a surviving
+    follower's cursor — numbered by the dead process's ring, inflated
+    past the revision by lease records — can never log-match once the
+    fresh ring's seq catches up to it (it would silently skip every
+    record between the boot revision and the stale cursor)."""
+    p = os.path.join(str(tmp_path), "lead.wal")
+    s = MemStore()
+    s.open_wal(p)
+    m = ReplManager(s, "a:1", ["a:1", "b:2"], initial_role="leader")
+    old_epoch = s.repl_epoch()
+    lid = s.grant(ttl=30.0)
+    for i in range(5):
+        s.put(f"/k/{i}", "v")
+        s.keepalive(lid)        # "k" records inflate seq past rev
+    stale_seq = m.log.seq
+    assert stale_seq > s.rev()
+    # sanity: a follower current through stale_seq tails today
+    assert not m.hello("b:2", old_epoch, stale_seq)["resync"]
+    s.close()
+
+    # kill -9 + restart: reboot the leader from its own snap+WAL
+    s2 = MemStore()
+    s2.open_wal(p)
+    m2 = ReplManager(s2, "a:1", ["a:1", "b:2"], initial_role="leader")
+    assert s2.repl_epoch() > old_epoch      # the boot opened a new term
+    # append until the fresh ring's numbering collides with the
+    # survivor's stale cursor — the dangerous window
+    i = 0
+    while m2.log.seq < stale_seq:
+        s2.put(f"/new/{i:03d}", "x")
+        i += 1
+    r = m2.hello("b:2", old_epoch, stale_seq)
+    assert r["resync"], \
+        "stale cursor log-matched a restarted leader's fresh ring"
+    s2.close()
+
+
+def test_equal_epoch_split_brain_heals(group_factory):
+    """Two leaders at the SAME fencing epoch (concurrent promotions off
+    one base epoch) must not both serve forever: the seq-first
+    tie-break demotes the one whose shipping cursor is behind — it
+    lacks records its rival carries — which poisons its cursor and
+    resyncs onto the winner (group index only breaks exact seq
+    ties)."""
+    g = group_factory(2)
+    lead = g.dial(0)
+    try:
+        lead.put("/t/pre", "shared")
+    finally:
+        lead.close()
+    g.settle()
+
+    # simulate the concurrent-promotion collision: bump the leader's
+    # epoch in place (no "E" ships, cursor unchanged), then promote
+    # the follower — both now claim leadership at the identical epoch,
+    # and the promoted rival's cursor is one "E" record ahead
+    with g.stores[0]._ev_lock:
+        g.stores[0]._epoch += 1
+    g.mgrs[1]._promote()
+    assert g.stores[0].repl_epoch() == g.stores[1].repl_epoch()
+    assert g.mgrs[0].role() == "leader" and g.mgrs[1].role() == "leader"
+    assert g.mgrs[1].log.seq > g.mgrs[0].log.seq
+
+    # the probe sweeps break the tie: the higher shipping cursor keeps
+    # the lead, the stale one demotes and full-resyncs onto it
+    _wait(lambda: g.mgrs[1].role() == "leader"
+          and g.mgrs[0].role() == "follower", 15.0,
+          "equal-epoch tie-break demotion")
+    lead = g.dial(1)
+    try:
+        lead.put("/t/after", "healed")
+    finally:
+        lead.close()
+    _wait(lambda: g.stores[0].get("/t/after") is not None, 10.0,
+          "demoted ex-leader resyncs onto the winner")
+    assert g.stores[0].get("/t/pre").value == "shared"
+    assert g.stores[0].get("/t/after").value == "healed"
+
+
+def test_rebooted_ex_leader_yields_to_promoted_rival(group_factory,
+                                                     tmp_path):
+    """A kill-9'd leader rebooted from its WAL opens a new boot term
+    that COLLIDES with the epoch of the follower promoted during its
+    outage (both are base+1).  The equal-epoch tie-break must side
+    with the rival carrying the quorum-era writes the rebooted member
+    slept through — an index-first rule would let the stale member
+    (group index 0) retake the lead and full-resync the whole group
+    BACKWARDS over acked revisions."""
+    g = group_factory(3, wal_dir=tmp_path, promote_after=0.5)
+    lead = g.dial(0)
+    try:
+        for i in range(5):
+            lead.put(f"/r/{i}", "pre")
+    finally:
+        lead.close()
+    g.settle()
+    base_epoch = g.stores[0].repl_epoch()
+
+    # kill -9 the leader; a follower promotes during the outage and
+    # accepts more writes
+    g.srvs[0].kill()
+    _wait(lambda: any(m.role() == "leader" for m in g.mgrs[1:]), 15.0,
+          "follower promotion")
+    new_i = next(i for i in (1, 2) if g.mgrs[i].role() == "leader")
+    lead = g.dial(new_i)
+    try:
+        for i in range(5, 12):
+            lead.put(f"/r/{i}", "outage")
+    finally:
+        lead.close()
+    rival_rev = g.stores[new_i].rev()
+
+    # reboot the dead member from its own WAL as a leader (the
+    # bin/store boot path); its boot term equals the rival's epoch
+    s0b = MemStore().open_wal(g.wal_paths[0])
+    m0b = ReplManager(s0b, g.addrs[0], g.addrs, initial_role="leader")
+    assert s0b.repl_epoch() == g.stores[new_i].repl_epoch() == \
+        base_epoch + 1
+    host, _, port = g.addrs[0].rpartition(":")
+    srv0b = StoreServer(store=s0b, host=host, port=int(port))
+    srv0b.attach_repl(m0b)
+    srv0b.start()
+    g.srvs.append(srv0b)
+    g.mgrs.append(m0b)
+    m0b.start()
+
+    # the rebooted member must DEMOTE (its cursor is behind the
+    # rival's) and resync forward; the rival must keep the lead and
+    # every outage write must survive fleet-wide
+    _wait(lambda: m0b.role() == "follower", 15.0,
+          "rebooted ex-leader demotes to the promoted rival")
+    assert g.mgrs[new_i].role() == "leader"
+    _wait(lambda: s0b.rev() >= rival_rev, 15.0,
+          "rebooted ex-leader catches up")
+    assert g.stores[new_i].rev() >= rival_rev       # never rolled back
+    for st in (g.stores[new_i], s0b):
+        for i in range(12):
+            kv = st.get(f"/r/{i}")
+            assert kv is not None, f"/r/{i} lost after ex-leader reboot"
 
 
 def test_hello_with_newer_epoch_deposes():
